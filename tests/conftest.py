@@ -1,0 +1,13 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the driver's multichip dry-run environment
+(xla_force_host_platform_device_count) so every sharding/parallelism test runs the
+real pjit/shard_map path on 8 virtual devices without TPU hardware.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

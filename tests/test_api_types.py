@@ -1,0 +1,200 @@
+"""API layer tests: serde round-trip, defaulting, condition FSM, topology math."""
+import datetime as dt
+
+import pytest
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import Container, ObjectMeta, PodSpec, PodTemplateSpec
+from tpu_on_k8s.api.types import (
+    DAGCondition,
+    ElasticPolicy,
+    JobConditionType,
+    RestartPolicy,
+    TaskSpec,
+    TaskType,
+    TPUJob,
+    TPUJobSpec,
+    TPUPolicy,
+)
+from tpu_on_k8s.api.defaults import set_defaults_tpujob
+from tpu_on_k8s.gang import topology
+from tpu_on_k8s.utils import conditions, serde
+
+
+def make_job(workers=2, master=True, elastic=None, accelerator="tpu-v5-lite-podslice",
+             topo="4x4") -> TPUJob:
+    tasks = {}
+    if master:
+        tasks[TaskType.MASTER] = TaskSpec(
+            num_tasks=1,
+            template=PodTemplateSpec(spec=PodSpec(containers=[Container(name="tpu", image="img")])),
+        )
+    tasks[TaskType.WORKER] = TaskSpec(
+        num_tasks=workers,
+        template=PodTemplateSpec(spec=PodSpec(containers=[Container(name="tpu", image="img")])),
+    )
+    return TPUJob(
+        metadata=ObjectMeta(name="job1", namespace="ns1", uid="uid-1"),
+        spec=TPUJobSpec(
+            tasks=tasks,
+            elastic_policy=elastic,
+            tpu_policy=TPUPolicy(accelerator=accelerator, topology=topo),
+        ),
+    )
+
+
+class TestSerde:
+    def test_round_trip(self):
+        job = make_job()
+        job.status.start_time = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        data = serde.to_dict(job)
+        back = serde.from_dict(TPUJob, data)
+        assert back.metadata.name == "job1"
+        assert back.spec.tasks[TaskType.WORKER].num_tasks == 2
+        assert back.status.start_time == job.status.start_time
+        assert serde.to_dict(back) == data
+
+    def test_deep_copy_isolated(self):
+        job = make_job()
+        cp = serde.deep_copy(job)
+        cp.spec.tasks[TaskType.WORKER].num_tasks = 99
+        cp.metadata.labels["x"] = "y"
+        assert job.spec.tasks[TaskType.WORKER].num_tasks == 2
+        assert "x" not in job.metadata.labels
+
+    def test_unknown_keys_ignored(self):
+        data = serde.to_dict(make_job())
+        data["spec"]["bogus_field"] = 1
+        back = serde.from_dict(TPUJob, data)
+        assert back.metadata.name == "job1"
+
+
+class TestDefaults:
+    def test_restart_policies(self):
+        job = set_defaults_tpujob(make_job())
+        assert job.spec.tasks[TaskType.MASTER].restart_policy is RestartPolicy.ON_EXIT_CODE
+        assert job.spec.tasks[TaskType.WORKER].restart_policy is RestartPolicy.ON_FAILURE
+
+    def test_string_task_keys_normalized(self):
+        job = make_job()
+        job.spec.tasks = {"worker": job.spec.tasks[TaskType.WORKER]}
+        set_defaults_tpujob(job)
+        assert TaskType.WORKER in job.spec.tasks
+
+    def test_port_injected(self):
+        job = set_defaults_tpujob(make_job())
+        ports = job.spec.tasks[TaskType.MASTER].template.spec.containers[0].ports
+        assert any(
+            p.name == constants.DEFAULT_PORT_NAME
+            and p.container_port == constants.DEFAULT_COORDINATOR_PORT
+            for p in ports
+        )
+
+    def test_dag_edges(self):
+        job = set_defaults_tpujob(make_job())
+        worker_dag = job.spec.tasks[TaskType.WORKER].dag_conditions
+        assert worker_dag == [DAGCondition(upstream=TaskType.MASTER, on_phase="Running")]
+
+    def test_min_members_populated(self):
+        # Fixes the reference's nil-map no-op (torchjob_defaults.go:192-197).
+        job = set_defaults_tpujob(make_job(workers=4, topo="4x4"))
+        mm = job.spec.run_policy.scheduling_policy.min_members
+        assert mm[TaskType.WORKER] == 4
+        assert mm[TaskType.MASTER] == 1
+
+    def test_elastic_clamps_workers(self):
+        job = make_job(workers=1, elastic=ElasticPolicy(min_replicas=2, max_replicas=8))
+        set_defaults_tpujob(job)
+        assert job.spec.tasks[TaskType.WORKER].num_tasks == 2
+
+    def test_elastic_snaps_to_legal_quanta(self):
+        # No 3-host v5e topology exists: min snaps up to 4, max 5 snaps down to 4.
+        job = make_job(workers=1, elastic=ElasticPolicy(min_replicas=3, max_replicas=5))
+        set_defaults_tpujob(job)
+        ep = job.spec.elastic_policy
+        assert (ep.min_replicas, ep.max_replicas) == (4, 4)
+        assert job.spec.tasks[TaskType.WORKER].num_tasks == 4
+
+    def test_min_members_full_gang_multislice(self):
+        job = make_job(workers=8, topo="4x4")
+        job.spec.tpu_policy.num_slices = 2
+        set_defaults_tpujob(job)
+        assert job.spec.run_policy.scheduling_policy.min_members[TaskType.WORKER] == 8
+
+    def test_empty_template_gets_container(self):
+        job = TPUJob(spec=TPUJobSpec(tasks={TaskType.WORKER: TaskSpec()}))
+        set_defaults_tpujob(job)
+        c = job.spec.tasks[TaskType.WORKER].template.spec.containers
+        assert c and c[0].name == constants.DEFAULT_CONTAINER_NAME
+
+
+class TestConditions:
+    def test_running_demotes_queuing(self):
+        job = make_job()
+        conditions.update_job_conditions(job.status, JobConditionType.QUEUING)
+        conditions.update_job_conditions(job.status, JobConditionType.RUNNING)
+        assert conditions.is_running(job.status)
+        q = conditions.get_condition(job.status, JobConditionType.QUEUING)
+        assert q.status == "False"
+
+    def test_terminal_demotes_running(self):
+        job = make_job()
+        conditions.update_job_conditions(job.status, JobConditionType.RUNNING)
+        conditions.update_job_conditions(job.status, JobConditionType.SUCCEEDED)
+        assert conditions.is_succeeded(job.status)
+        assert not conditions.is_running(job.status)
+        assert conditions.is_finished(job.status)
+
+    def test_idempotent_update_reports_no_change(self):
+        job = make_job()
+        assert conditions.update_job_conditions(job.status, JobConditionType.CREATED, "r", "m")
+        assert not conditions.update_job_conditions(job.status, JobConditionType.CREATED, "r", "m")
+
+    def test_needs_enqueue(self):
+        job = make_job()
+        conditions.mark_created(job)
+        assert conditions.needs_coordinator_enqueue(job.status)
+        conditions.update_job_conditions(job.status, JobConditionType.RUNNING)
+        assert not conditions.needs_coordinator_enqueue(job.status)
+
+    def test_gen_general_name(self):
+        assert conditions.gen_general_name("j", TaskType.WORKER, 3) == "j-worker-3"
+
+
+class TestTopology:
+    def test_v5e_hosts(self):
+        assert topology.hosts_per_slice("tpu-v5-lite-podslice", "4x4") == 4
+        assert topology.hosts_per_slice("tpu-v5-lite-podslice", "2x4") == 2
+        assert topology.hosts_per_slice("tpu-v5-lite-podslice", "2x2") == 1
+        assert topology.hosts_per_slice("tpu-v5-lite-podslice", "16x16") == 64
+
+    def test_v4_hosts(self):
+        assert topology.hosts_per_slice("tpu-v4-podslice", "2x2x2") == 2
+        assert topology.hosts_per_slice("tpu-v4-podslice", "4x4x4") == 16
+
+    def test_legal_host_counts_monotone(self):
+        counts = topology.legal_host_counts("tpu-v5-lite-podslice")
+        assert counts == sorted(set(counts))
+        assert 1 in counts and 4 in counts
+
+    def test_next_legal_up_down(self):
+        assert topology.next_legal_host_count("tpu-v5-lite-podslice", 4) == 8
+        assert topology.next_legal_host_count("tpu-v5-lite-podslice", 4, direction=-1) == 2
+        assert topology.next_legal_host_count("tpu-v5-lite-podslice", 64) is None
+
+    def test_snap(self):
+        assert topology.snap_host_count("tpu-v5-lite-podslice", 3) == 4
+        assert topology.snap_host_count("tpu-v5-lite-podslice", 1000) == 64
+
+    def test_topology_for_hosts(self):
+        assert topology.topology_for_hosts("tpu-v5-lite-podslice", 4) == "4x4"
+
+    def test_validate_rejects_bogus(self):
+        with pytest.raises(ValueError):
+            topology.validate_slice("tpu-v5-lite-podslice", "3x5")
+        with pytest.raises(KeyError):
+            topology.chips_per_host("tpu-v99")
+
+    def test_malformed_topology(self):
+        with pytest.raises(ValueError):
+            topology.parse_topology("4xx4")

@@ -1,0 +1,20 @@
+"""tpu-on-k8s: a TPU-native distributed-training framework.
+
+Two cooperating planes:
+
+* **Orchestration plane** — a Kubernetes-style operator (pure Python, cluster-backend
+  pluggable) with the full capability set of the reference Go operator
+  hliangzhao/torch-on-k8s (see /root/repo/SURVEY.md): a ``TPUJob`` API whose tasks are
+  gang-scheduled atomically onto Cloud TPU pod slices, a multi-tenant job coordinator
+  (WRR queue selection, quota/priority plugins), DAG task ordering, exit-code-classified
+  failover with in-place restart, two elastic-scaling paths, and a trained-model →
+  OCI-image pipeline.
+
+* **Compute plane** — the training stack the reference delegated to user containers,
+  rebuilt TPU-first on JAX/XLA: models (MNIST CNN, ResNet-50, BERT, GPT-2, Llama),
+  SPMD parallelism over ``jax.sharding.Mesh`` (DP/FSDP/TP/SP + ring attention),
+  Pallas kernels for the hot ops, and an Orbax-backed checkpoint/elastic-resume loop
+  that speaks the orchestration plane's checkpoint protocol.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,66 @@
+"""API layer (L1): TPUJob / Model / ModelVersion types, constants and defaulting.
+
+Mirrors the capability surface of the reference's ``apis/`` tree
+(/root/reference/apis/train/v1alpha1/torchjob_types.go,
+/root/reference/apis/model/v1alpha1/) with a TPU-native spec shape.
+"""
+
+from tpu_on_k8s.api.core import (
+    Condition,
+    Container,
+    ContainerPort,
+    ContainerStateTerminated,
+    ContainerStatus,
+    EnvVar,
+    ObjectMeta,
+    OwnerReference,
+    PodSpec,
+    PodStatus,
+    PodTemplateSpec,
+    ResourceRequirements,
+    Volume,
+    VolumeMount,
+)
+from tpu_on_k8s.api.types import (
+    ElasticPolicy,
+    JobCondition,
+    JobConditionType,
+    JobStatus,
+    ReplicaStatus,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    SpotTaskSpec,
+    TaskSpec,
+    TaskType,
+    TPUJob,
+    TPUJobSpec,
+    TPUPolicy,
+    ElasticStatus,
+)
+from tpu_on_k8s.api.model_types import (
+    Model,
+    ModelSpec,
+    ModelStatus,
+    ModelVersion,
+    ModelVersionSpec,
+    ModelVersionStatus,
+    Storage,
+    LocalStorage,
+    NFSStorage,
+    GCSStorage,
+)
+from tpu_on_k8s.api.defaults import set_defaults_tpujob
+from tpu_on_k8s.api import constants
+
+__all__ = [
+    "Condition", "Container", "ContainerPort", "ContainerStateTerminated",
+    "ContainerStatus", "EnvVar", "ObjectMeta", "OwnerReference", "PodSpec",
+    "PodStatus", "PodTemplateSpec", "ResourceRequirements", "Volume", "VolumeMount",
+    "ElasticPolicy", "ElasticStatus", "JobCondition", "JobConditionType", "JobStatus",
+    "ReplicaStatus", "RestartPolicy", "RunPolicy", "SchedulingPolicy", "SpotTaskSpec",
+    "TaskSpec", "TaskType", "TPUJob", "TPUJobSpec", "TPUPolicy",
+    "Model", "ModelSpec", "ModelStatus", "ModelVersion", "ModelVersionSpec",
+    "ModelVersionStatus", "Storage", "LocalStorage", "NFSStorage", "GCSStorage",
+    "set_defaults_tpujob", "constants",
+]

@@ -1,0 +1,85 @@
+"""Well-known labels, annotations, env names and defaults for TPUJob.
+
+TPU-native rework of /root/reference/apis/train/v1alpha1/constants.go and
+/root/reference/apis/model/v1alpha1/constants.go. The reference wires NCCL/gloo
+rendezvous env (MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE); here the equivalent block
+is PJRT/XLA process wiring consumed by jax.distributed / torch_xla.
+"""
+
+API_GROUP = "distributed.tpu.io"
+API_VERSION = "v1alpha1"
+
+KIND_TPUJOB = "TPUJob"
+KIND_MODEL = "Model"
+KIND_MODELVERSION = "ModelVersion"
+
+# ---- labels (selector surface) ------------------------------------------------
+LABEL_JOB_NAME = "tpujob.distributed.tpu.io/job-name"
+LABEL_GROUP_NAME = "group-name"
+LABEL_TASK_INDEX = "task-index"
+LABEL_TASK_TYPE = "task-type"
+LABEL_TASK_ROLE = "task-role"
+LABEL_JOB_GENERATION = "distributed.tpu.io/job-generation"
+LABEL_SPOT_TASK = "distributed.tpu.io/spot-task"
+LABEL_MODEL_NAME = "model.distributed.tpu.io/model-name"
+
+# ---- annotations (protocol surface) -------------------------------------------
+ANNOTATION_NETWORK_MODE = "distributed.tpu.io/network-mode"
+NETWORK_MODE_HOST = "host"
+ANNOTATION_ENABLE_ELASTIC = "distributed.tpu.io/enable-elastic-training"
+ANNOTATION_SCALE_STATE = "distributed.tpu.io/scale-state"
+SCALE_STATE_INFLIGHT = "inflight"
+SCALE_STATE_DONE = "done"
+# 2-phase checkpoint transaction (operator <-> AIMaster), SURVEY §3.3 / §5.4:
+ANNOTATION_CKPT_REQUESTED_VERSION = "distributed.tpu.io/ckpt-requested-version"
+ANNOTATION_CKPT_COMPLETED_VERSION = "distributed.tpu.io/ckpt-completed-version"
+ANNOTATION_READY_TO_START_WORKER = "distributed.tpu.io/ready-to-start-worker"
+ANNOTATION_IMMEDIATELY_START_WORKER = "distributed.tpu.io/immediately-start-worker"
+ANNOTATION_WORLD_SIZE = "distributed.tpu.io/world-size"
+ANNOTATION_LAST_FAILOVER_TIMESTAMP = "distributed.tpu.io/last-failover-timestamp"
+# gang scheduler podgroup binding (reference: scheduling.k8s.io/group-name,
+# /root/reference/pkg/gangscheduler/volcano/volcano.go:238-287)
+ANNOTATION_GANG_GROUP_NAME = "scheduling.k8s.io/group-name"
+
+# ---- finalizers ----------------------------------------------------------------
+FINALIZER_PREEMPT_PROTECTOR = "distributed.tpu.io/preempt-protector"
+
+# ---- defaults ------------------------------------------------------------------
+DEFAULT_CONTAINER_NAME = "tpu"
+DEFAULT_PORT_NAME = "tpujob-port"
+# XLA distributed coordinator (jax.distributed / torch_xla xla://) default port.
+DEFAULT_COORDINATOR_PORT = 8476
+
+# ---- PJRT/XLA env wiring (the MASTER_ADDR/RANK/WORLD_SIZE analog) --------------
+ENV_PJRT_DEVICE = "PJRT_DEVICE"                    # "TPU"
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"                # task index within the slice
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"  # comma-joined worker DNS names
+ENV_COORDINATOR_ADDRESS = "XLA_COORDINATOR_ADDRESS"  # host:port of master-0
+ENV_NUM_PROCESSES = "TPU_NUM_PROCESSES"            # WORLD_SIZE analog (hosts)
+ENV_PROCESS_ID = "TPU_PROCESS_ID"                  # RANK analog
+ENV_MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"  # multi-slice DCN
+ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+ENV_PYTHONUNBUFFERED = "PYTHONUNBUFFERED"
+
+# torchelastic-analog rendezvous CLI args (prepended to user args when elastic):
+ARG_RDZV_BACKEND = "--rdzv_backend"
+ARG_RDZV_ENDPOINT = "--rdzv_endpoint"
+ARG_RDZV_ID = "--rdzv_id"
+ARG_NPROC_PER_NODE = "--nproc_per_node"
+ARG_NNODES = "--nnodes"
+
+# ---- GKE TPU scheduling surface ------------------------------------------------
+RESOURCE_TPU = "google.com/tpu"                     # chips per host
+NODE_SELECTOR_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+NODE_SELECTOR_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+
+# ---- model pipeline ------------------------------------------------------------
+ENV_MODEL_PATH = "TPU_ON_K8S_MODEL_PATH"
+DEFAULT_MODEL_PATH = "/tpu-on-k8s-model"
+LABEL_FAST_STORAGE_NODE = "distributed.tpu.io/fast-model-storage"
+REGISTRY_SECRET_NAME = "regcred"
+
+# ---- context keys (hostnetwork port map handed through reconcile context) ------
+CONTEXT_HOSTNETWORK_PORTS = "hostnetwork-ports"
+CONTEXT_GANG_SCHEDULER = "gang-scheduler"

@@ -1,0 +1,242 @@
+"""Core object model: the slice of corev1/metav1 the framework needs.
+
+The reference imports k8s.io/api/core/v1 wholesale; this framework only touches a
+narrow surface (pods, services, env, volumes, resource lists), so that surface is
+defined here as plain dataclasses. Everything round-trips through
+``tpu_on_k8s.utils.serde`` — no generated code.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_on_k8s.utils import serde
+
+
+def utcnow() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+    block_owner_deletion: bool = False
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    creation_timestamp: Optional[_dt.datetime] = None
+    deletion_timestamp: Optional[_dt.datetime] = None
+    generation: int = 0
+    resource_version: int = 0
+
+    def controller_ref(self) -> Optional[OwnerReference]:
+        for ref in self.owner_references:
+            if ref.controller:
+                return ref
+        return None
+
+
+@dataclass
+class EnvVarSource:
+    """Downward-API field reference. The reference uses
+    ``fieldRef: metadata.annotations['distributed.io/world-size']`` so an in-place
+    restarted container observes the *new* world size
+    (/root/reference/controllers/train/torchjob_controller.go:419-439)."""
+
+    field_path: str = ""
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+    value_from: Optional[EnvVarSource] = None
+
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    container_port: int = 0
+    host_port: int = 0
+
+
+@dataclass
+class ResourceRequirements:
+    """Resource requests/limits as plain quantity maps.
+
+    Quantities are numeric (chips, cores, bytes) rather than k8s quantity strings —
+    the TPU resource key is ``google.com/tpu`` (chips per host).
+    """
+
+    requests: Dict[str, float] = field(default_factory=dict)
+    limits: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class VolumeMount:
+    name: str = ""
+    mount_path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class Volume:
+    """Tagged-union volume source: exactly one of the source fields is set."""
+
+    name: str = ""
+    host_path: Optional[str] = None
+    nfs_server: Optional[str] = None
+    nfs_path: Optional[str] = None
+    pvc_claim_name: Optional[str] = None
+    config_map_name: Optional[str] = None
+    secret_name: Optional[str] = None
+    empty_dir: bool = False
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    ports: List[ContainerPort] = field(default_factory=list)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    volume_mounts: List[VolumeMount] = field(default_factory=list)
+    working_dir: str = ""
+    termination_message_policy: str = ""
+
+    def env_map(self) -> Dict[str, str]:
+        return {e.name: e.value for e in self.env}
+
+    def set_env(self, name: str, value: str = "", value_from: Optional[EnvVarSource] = None) -> None:
+        for e in self.env:
+            if e.name == name:
+                e.value, e.value_from = value, value_from
+                return
+        self.env.append(EnvVar(name=name, value=value, value_from=value_from))
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    restart_policy: str = "Never"  # pod-level: Never|OnFailure|Always
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    scheduler_name: str = ""
+    priority_class_name: str = ""
+    priority: Optional[int] = None
+    host_network: bool = False
+    hostname: str = ""
+    subdomain: str = ""
+    node_name: str = ""
+    volumes: List[Volume] = field(default_factory=list)
+
+    def container(self, name: str) -> Optional[Container]:
+        for c in self.containers:
+            if c.name == name:
+                return c
+        return None
+
+
+@dataclass
+class ContainerStateTerminated:
+    exit_code: int = 0
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    ready: bool = False
+    restart_count: int = 0
+    terminated: Optional[ContainerStateTerminated] = None
+
+
+@dataclass
+class Condition:
+    type: str = ""
+    status: str = "True"  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: Optional[_dt.datetime] = None
+
+
+class PodPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+    ORDER = {PENDING: 0, RUNNING: 1, SUCCEEDED: 2, FAILED: 3, UNKNOWN: 4}
+
+
+@dataclass
+class PodStatus:
+    phase: str = PodPhase.PENDING
+    reason: str = ""
+    message: str = ""
+    pod_ip: str = ""
+    host_ip: str = ""
+    start_time: Optional[_dt.datetime] = None
+    conditions: List[Condition] = field(default_factory=list)
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
+
+    def is_ready(self) -> bool:
+        return any(c.type == "Ready" and c.status == "True" for c in self.conditions)
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class Pod:
+    api_version: str = "v1"
+    kind: str = "Pod"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+    target_port: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    cluster_ip: str = ""  # "None" => headless
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+
+
+@dataclass
+class Service:
+    api_version: str = "v1"
+    kind: str = "Service"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+
+def deep_copy(obj):
+    return serde.deep_copy(obj)

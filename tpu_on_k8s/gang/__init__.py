@@ -1,0 +1,15 @@
+"""Gang scheduling (L4): atomic TPU-slice allocation.
+
+Analog of /root/reference/pkg/gangscheduler/ with the TPU-specific twist that
+PodGroup MinMember derives from slice host count (``tpu_on_k8s.gang.topology``).
+"""
+
+from tpu_on_k8s.gang.topology import (
+    SliceShape,
+    chips_in_topology,
+    chips_per_host,
+    hosts_per_slice,
+    legal_host_counts,
+    next_legal_host_count,
+    topology_for_hosts,
+)

@@ -1,0 +1,165 @@
+"""TPU slice topology math.
+
+This module encodes the constraint the reference never had to face (SURVEY §7
+"hard parts"): on TPU, a worker replica is a *host* in a pod slice, hosts come in
+fixed chips-per-host quanta, and only certain slice topologies exist. So:
+
+* gang PodGroup ``MinMember`` = ``hosts_per_slice(accelerator, topology)``;
+* elastic rescale may only land on ``legal_host_counts`` — the reference's
+  free-form replica doubling (torchelastic job.go:102-104) is snapped to the
+  nearest legal quantum by ``next_legal_host_count``.
+
+The tables mirror GKE's published accelerator/topology matrix and are data —
+extendable without code changes.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+# accelerator name (cloud.google.com/gke-tpu-accelerator value) →
+#   (chips per host, legal topology strings)
+_ACCELERATORS: Dict[str, Tuple[int, List[str]]] = {
+    # v5e single-host device types: whole slice on one VM.
+    "tpu-v5-lite-device": (8, ["1x1", "2x2", "2x4"]),
+    # v5e pod slices: 4 chips per host, 2D torus.
+    "tpu-v5-lite-podslice": (
+        4,
+        ["1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16"],
+    ),
+    # v4 pod slices: 4 chips per host, 3D torus.
+    "tpu-v4-podslice": (
+        4,
+        ["2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4", "4x4x8", "4x8x8", "8x8x8",
+         "8x8x12", "8x8x16", "8x16x16"],
+    ),
+    # v5p: 4 chips per host, 3D torus.
+    "tpu-v5p-slice": (
+        4,
+        ["2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4", "4x4x8", "4x8x8", "8x8x8",
+         "8x8x16", "8x16x16", "16x16x16"],
+    ),
+    # v6e (Trillium): 2D, 4 chips per host multi-host, up to 256 chips.
+    "tpu-v6e-slice": (
+        4,
+        ["1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16"],
+    ),
+}
+
+_SINGLE_HOST_MAX_CHIPS = {
+    # Slices at or under this many chips fit one host (e.g. v5e ct5lp-hightpu-8t).
+    "tpu-v5-lite-podslice": 4,
+    "tpu-v5-lite-device": 8,
+    "tpu-v6e-slice": 4,
+}
+
+
+@dataclass(frozen=True)
+class SliceShape:
+    accelerator: str
+    topology: str
+
+    @property
+    def chips(self) -> int:
+        return chips_in_topology(self.topology)
+
+    @property
+    def hosts(self) -> int:
+        return hosts_per_slice(self.accelerator, self.topology)
+
+    @property
+    def chips_per_host(self) -> int:
+        return chips_per_host(self.accelerator)
+
+
+def parse_topology(topology: str) -> Tuple[int, ...]:
+    try:
+        dims = tuple(int(d) for d in topology.lower().split("x"))
+    except ValueError as e:
+        raise ValueError(f"malformed topology {topology!r}") from e
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"malformed topology {topology!r}")
+    return dims
+
+
+def chips_in_topology(topology: str) -> int:
+    return math.prod(parse_topology(topology))
+
+
+def chips_per_host(accelerator: str) -> int:
+    spec = _ACCELERATORS.get(accelerator)
+    if spec is None:
+        raise KeyError(f"unknown TPU accelerator {accelerator!r}")
+    return spec[0]
+
+
+def legal_topologies(accelerator: str) -> List[str]:
+    spec = _ACCELERATORS.get(accelerator)
+    if spec is None:
+        raise KeyError(f"unknown TPU accelerator {accelerator!r}")
+    return list(spec[1])
+
+
+def hosts_per_slice(accelerator: str, topology: str) -> int:
+    """Host (VM) count of one slice — the gang MinMember for its worker group."""
+    chips = chips_in_topology(topology)
+    single_max = _SINGLE_HOST_MAX_CHIPS.get(accelerator)
+    if single_max is not None and chips <= single_max:
+        return 1
+    per_host = chips_per_host(accelerator)
+    return max(1, math.ceil(chips / per_host))
+
+
+def legal_host_counts(accelerator: str) -> List[int]:
+    """Sorted unique host counts reachable via legal topologies — the elastic
+    rescale quanta."""
+    counts = {hosts_per_slice(accelerator, t) for t in legal_topologies(accelerator)}
+    return sorted(counts)
+
+
+def topology_for_hosts(accelerator: str, hosts: int) -> Optional[str]:
+    """Smallest legal topology providing at least ``hosts`` hosts (None if the
+    accelerator tops out below that)."""
+    best: Optional[Tuple[int, str]] = None
+    for t in legal_topologies(accelerator):
+        h = hosts_per_slice(accelerator, t)
+        if h >= hosts and (best is None or h < best[0]):
+            best = (h, t)
+    return best[1] if best else None
+
+
+def next_legal_host_count(
+    accelerator: str, current: int, *, direction: int = +1
+) -> Optional[int]:
+    """Next legal host count strictly above (direction=+1) or below (-1)
+    ``current``; None at the boundary. Used by the elastic autoscaler in place of
+    the reference's unconstrained ``replicas *= 2``."""
+    counts = legal_host_counts(accelerator)
+    if direction > 0:
+        for c in counts:
+            if c > current:
+                return c
+        return None
+    for c in reversed(counts):
+        if c < current:
+            return c
+    return None
+
+
+def snap_host_count(accelerator: str, desired: int) -> int:
+    """Snap an arbitrary desired host count to the nearest legal quantum
+    (rounding up, capped at the largest legal topology)."""
+    counts = legal_host_counts(accelerator)
+    for c in counts:
+        if c >= desired:
+            return c
+    return counts[-1]
+
+
+def validate_slice(accelerator: str, topology: str) -> None:
+    if topology not in legal_topologies(accelerator):
+        raise ValueError(
+            f"topology {topology!r} is not legal for {accelerator!r}; "
+            f"legal: {legal_topologies(accelerator)}"
+        )

@@ -1,0 +1,1 @@
+"""Shared utilities (L4 analog of the reference's ``pkg/utils``)."""

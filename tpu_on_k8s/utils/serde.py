@@ -1,0 +1,120 @@
+"""Dataclass <-> plain-dict (de)serialization for API objects.
+
+The reference relies on k8s apimachinery's generated deepcopy/JSON marshalling
+(/root/reference/apis/*/v1alpha1/zz_generated.deepcopy.go). Here a single generic
+reflective codec replaces all of that: every API dataclass round-trips through
+``to_dict`` / ``from_dict`` (used by the in-memory API server for deep-copy
+semantics, by YAML manifest loading, and by tests).
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import enum
+import typing
+from typing import Any, Optional, Type, TypeVar, get_args, get_origin, get_type_hints
+
+T = TypeVar("T")
+
+_HINTS_CACHE: dict[type, dict[str, Any]] = {}
+
+
+def _type_hints(cls: type) -> dict[str, Any]:
+    hints = _HINTS_CACHE.get(cls)
+    if hints is None:
+        hints = get_type_hints(cls)
+        _HINTS_CACHE[cls] = hints
+    return hints
+
+
+def to_dict(obj: Any, *, drop_none: bool = True) -> Any:
+    """Recursively convert dataclasses/enums/datetimes into plain JSON-able data."""
+    if obj is None:
+        return None
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            v = to_dict(getattr(obj, f.name), drop_none=drop_none)
+            if drop_none and (v is None or v == {} or v == []):
+                continue
+            out[f.name] = v
+        return out
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, _dt.datetime):
+        return obj.isoformat()
+    if isinstance(obj, dict):
+        # Keys go through conversion too: task maps are keyed by TaskType enums.
+        return {to_dict(k, drop_none=drop_none): to_dict(v, drop_none=drop_none)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v, drop_none=drop_none) for v in obj]
+    return obj
+
+
+def _construct(tp: Any, data: Any) -> Any:
+    if data is None:
+        return None
+    origin = get_origin(tp)
+    if origin is typing.Union:  # Optional[X] and unions
+        args = [a for a in get_args(tp) if a is not type(None)]
+        for a in args:
+            try:
+                return _construct(a, data)
+            except (TypeError, ValueError, KeyError):
+                continue
+        raise TypeError(f"cannot construct union {tp} from {data!r}")
+    if origin in (list, tuple):
+        (elem,) = get_args(tp) or (Any,)
+        seq = [_construct(elem, v) for v in data]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        kt, vt = get_args(tp) or (Any, Any)
+        return {_construct(kt, k): _construct(vt, v) for k, v in data.items()}
+    if isinstance(tp, type):
+        if dataclasses.is_dataclass(tp):
+            return from_dict(tp, data)
+        if issubclass(tp, enum.Enum):
+            try:
+                return tp(data)
+            except ValueError:
+                # Tolerate case variance in string enum values (e.g. YAML task
+                # keys "master" vs "Master"), matching the reference's
+                # normalization step (torchjob_defaults.go:33-45).
+                if isinstance(data, str):
+                    for member in tp:
+                        if isinstance(member.value, str) and member.value.lower() == data.lower():
+                            return member
+                raise
+        if tp is _dt.datetime and isinstance(data, str):
+            return _dt.datetime.fromisoformat(data)
+        if tp is float and isinstance(data, (int, float)):
+            return float(data)
+        if tp in (int, str, bool) and not isinstance(data, tp):
+            raise TypeError(f"expected {tp} got {type(data)}")
+    return data
+
+
+def from_dict(cls: Type[T], data: Optional[dict]) -> T:
+    """Reconstruct a dataclass instance (recursively) from plain data.
+
+    Unknown keys are ignored (forward compatibility, like k8s JSON decoding).
+    """
+    if data is None:
+        data = {}
+    if not dataclasses.is_dataclass(cls):
+        raise TypeError(f"{cls} is not a dataclass")
+    if not isinstance(data, dict):
+        raise TypeError(f"cannot decode {cls.__name__} from {type(data).__name__} {data!r}")
+    hints = _type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in data:
+            kwargs[f.name] = _construct(hints[f.name], data[f.name])
+    return cls(**kwargs)
+
+
+def deep_copy(obj: T) -> T:
+    """Deep-copy an API dataclass via dict round-trip (the analog of
+    zz_generated.deepcopy.go)."""
+    return from_dict(type(obj), to_dict(obj, drop_none=False))
