@@ -1,0 +1,302 @@
+"""End-to-end reconcile lifecycle tests against the in-memory cluster.
+
+The envtest analog from SURVEY §4: a real (in-memory) API server, no kubelet —
+pod phases driven by KubeletSim, controllers reconciling in between.
+"""
+import pytest
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import Container, ObjectMeta, Pod, PodSpec, PodTemplateSpec, Service
+from tpu_on_k8s.api.model_types import ModelVersion, ModelVersionSpec, Storage, LocalStorage
+from tpu_on_k8s.api.types import (
+    CleanPodPolicy,
+    ElasticPolicy,
+    JobConditionType,
+    TaskSpec,
+    TaskType,
+    TPUJob,
+    TPUJobSpec,
+    TPUPolicy,
+)
+from tpu_on_k8s.client import InMemoryCluster, KubeletSim
+from tpu_on_k8s.controller.runtime import Manager
+from tpu_on_k8s.controller.tpujob import setup_tpujob_controller, submit_job
+from tpu_on_k8s.utils import conditions
+
+
+def make_env():
+    cluster = InMemoryCluster()
+    manager = Manager()
+    engine = setup_tpujob_controller(cluster, manager)
+    return cluster, manager, engine, KubeletSim(cluster)
+
+
+def job_spec(workers=2, master=True, ns="default", name="j1", elastic=None,
+             model_version=None, annotations=None, num_slices=1):
+    tasks = {}
+    template = PodTemplateSpec(spec=PodSpec(containers=[Container(name="tpu", image="img:1")]))
+    if master:
+        tasks[TaskType.MASTER] = TaskSpec(num_tasks=1, template=template)
+    tasks[TaskType.WORKER] = TaskSpec(num_tasks=workers,
+                                      template=PodTemplateSpec(
+                                          spec=PodSpec(containers=[Container(name="tpu", image="img:1")])))
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace=ns, annotations=annotations or {}),
+        spec=TPUJobSpec(
+            tasks=tasks,
+            elastic_policy=elastic,
+            model_version=model_version,
+            tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice", topology="2x4",
+                                 num_slices=num_slices),
+        ),
+    )
+
+
+def pods_of(cluster, ns="default", name="j1"):
+    return sorted(cluster.list(Pod, ns, {constants.LABEL_JOB_NAME: name}),
+                  key=lambda p: p.metadata.name)
+
+
+class TestLifecycle:
+    def test_master_created_first_dag_gates_workers(self):
+        cluster, manager, engine, sim = make_env()
+        submit_job(cluster, job_spec())
+        manager.run_until_idle()
+        pods = pods_of(cluster)
+        assert [p.metadata.name for p in pods] == ["j1-master-0"]
+        # master runs -> workers unlock
+        sim.run_pod("default", "j1-master-0")
+        manager.run_until_idle()
+        names = [p.metadata.name for p in pods_of(cluster)]
+        assert names == ["j1-master-0", "j1-worker-0", "j1-worker-1"]
+
+    def test_tpu_env_wiring(self):
+        cluster, manager, engine, sim = make_env()
+        submit_job(cluster, job_spec())
+        manager.run_until_idle()
+        sim.run_pod("default", "j1-master-0")
+        manager.run_until_idle()
+        pods = {p.metadata.name: p for p in pods_of(cluster)}
+
+        master_env = pods["j1-master-0"].spec.containers[0].env_map()
+        assert master_env[constants.ENV_PJRT_DEVICE] == "TPU"
+        assert master_env[constants.ENV_COORDINATOR_ADDRESS] == "localhost:8476"
+        assert master_env[constants.ENV_TPU_WORKER_ID] == "0"
+        assert master_env[constants.ENV_NUM_PROCESSES] == "3"
+
+        w1 = pods["j1-worker-1"]
+        env = w1.spec.containers[0].env_map()
+        assert env[constants.ENV_COORDINATOR_ADDRESS] == "j1-master-0.default:8476"
+        assert env[constants.ENV_TPU_WORKER_ID] == "2"  # rank shifted past master
+        assert env[constants.ENV_TPU_WORKER_HOSTNAMES] == "j1-master-0,j1-worker-0,j1-worker-1"
+        # GKE TPU scheduling surface
+        assert w1.spec.node_selector[constants.NODE_SELECTOR_TPU_ACCELERATOR] == "tpu-v5-lite-podslice"
+        assert w1.spec.node_selector[constants.NODE_SELECTOR_TPU_TOPOLOGY] == "2x4"
+        assert w1.spec.containers[0].resources.requests[constants.RESOURCE_TPU] == 4
+
+    def test_services_per_replica_headless(self):
+        cluster, manager, engine, sim = make_env()
+        submit_job(cluster, job_spec())
+        manager.run_until_idle()
+        sim.run_pod("default", "j1-master-0")
+        manager.run_until_idle()
+        svcs = {s.metadata.name: s for s in cluster.list(Service, "default")}
+        assert set(svcs) == {"j1-master-0", "j1-worker-0", "j1-worker-1"}
+        assert svcs["j1-master-0"].spec.cluster_ip == "None"
+        assert svcs["j1-master-0"].spec.selector[constants.LABEL_TASK_TYPE] == "master"
+
+    def test_full_success_path_emits_model_version(self):
+        cluster, manager, engine, sim = make_env()
+        mv_spec = ModelVersionSpec(
+            model_name="resnet", image_repo="gcr.io/x/resnet",
+            storage=Storage(local_storage=LocalStorage(path="/mnt/models")))
+        submit_job(cluster, job_spec(model_version=mv_spec))
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        job = cluster.get(TPUJob, "default", "j1")
+        assert conditions.is_running(job.status)
+        # model path env injected into pods
+        pod = pods_of(cluster)[0]
+        assert pod.spec.containers[0].env_map()[constants.ENV_MODEL_PATH] == constants.DEFAULT_MODEL_PATH
+        # workers finish, then master
+        for p in pods_of(cluster):
+            sim.succeed_pod("default", p.metadata.name)
+        manager.run_until_idle()
+        job = cluster.get(TPUJob, "default", "j1")
+        assert conditions.is_succeeded(job.status)
+        mvs = cluster.list(ModelVersion, "default")
+        assert len(mvs) == 1
+        assert mvs[0].spec.created_by == "j1"
+        assert mvs[0].spec.storage.local_storage.node_name  # pinned to master node
+        assert job.status.model_version_name == mvs[0].metadata.name
+
+    def test_retryable_failure_recreates_pod(self):
+        cluster, manager, engine, sim = make_env()
+        submit_job(cluster, job_spec())
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        # worker killed by OOM (retryable reason, exit 137)
+        sim.fail_pod("default", "j1-worker-0", exit_code=137, reason="OOMKilled")
+        manager.run_until_idle()
+        job = cluster.get(TPUJob, "default", "j1")
+        assert conditions.has_condition(job.status, JobConditionType.RESTARTING)
+        # pod was deleted and recreated fresh (Pending again)
+        w0 = cluster.get(Pod, "default", "j1-worker-0")
+        assert w0.status.phase == "Pending"
+        assert not conditions.is_failed(job.status)
+
+    def test_permanent_failure_fails_job(self):
+        cluster, manager, engine, sim = make_env()
+        submit_job(cluster, job_spec())
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        # master policy is OnExitCode: exit 1 classifies permanent
+        # (workers default to OnFailure and would retry forever)
+        sim.fail_pod("default", "j1-master-0", exit_code=1, reason="Error")
+        manager.run_until_idle()
+        job = cluster.get(TPUJob, "default", "j1")
+        assert conditions.is_failed(job.status)
+        # cleanup per Running policy: running pods deleted, failed pod kept
+        remaining = pods_of(cluster)
+        assert [p.metadata.name for p in remaining] == ["j1-master-0"]
+
+    def test_backoff_limit(self):
+        cluster, manager, engine, sim = make_env()
+        spec = job_spec(workers=1)
+        spec.spec.run_policy.backoff_limit = 1
+        submit_job(cluster, spec)
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        for _ in range(4):  # fail worker repeatedly with retryable code
+            job = cluster.get(TPUJob, "default", "j1")
+            if conditions.is_failed(job.status):
+                break
+            sim.fail_pod("default", "j1-worker-0", exit_code=137, reason="OOMKilled")
+            manager.run_until_idle()
+            sim.run_all("default")
+            manager.run_until_idle()
+        job = cluster.get(TPUJob, "default", "j1")
+        assert conditions.is_failed(job.status)
+        failed = conditions.get_condition(job.status, JobConditionType.FAILED)
+        assert failed.reason == "BackoffLimitExceeded"
+
+    def test_ttl_deletes_job_and_cascade(self):
+        cluster, manager, engine, sim = make_env()
+        spec = job_spec(workers=1, master=False)
+        spec.spec.run_policy.ttl_seconds_after_finished = 0
+        spec.spec.run_policy.clean_pod_policy = CleanPodPolicy.NONE
+        submit_job(cluster, spec)
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        sim.succeed_pod("default", "j1-worker-0")
+        manager.run_until_idle()
+        assert cluster.try_get(TPUJob, "default", "j1") is None
+        assert pods_of(cluster) == []  # cascade GC
+
+    def test_hostnetwork_mode(self):
+        cluster, manager, engine, sim = make_env()
+        spec = job_spec(workers=1, master=False,
+                        annotations={constants.ANNOTATION_NETWORK_MODE: "host"})
+        submit_job(cluster, spec)
+        manager.run_until_idle()
+        pod = cluster.get(Pod, "default", "j1-worker-0")
+        assert pod.spec.host_network
+        port = pod.spec.containers[0].ports[0].container_port
+        assert 20000 <= port < 30000
+        svc = cluster.get(Service, "default", "j1-worker-0")
+        assert svc.spec.ports[0].target_port == port
+
+    def test_elastic_wiring(self):
+        cluster, manager, engine, sim = make_env()
+        spec = job_spec(workers=2, elastic=ElasticPolicy(min_replicas=2, max_replicas=8),
+                        annotations={constants.ANNOTATION_ENABLE_ELASTIC: "true"})
+        submit_job(cluster, spec)
+        manager.run_until_idle()
+        sim.run_pod("default", "j1-master-0")
+        manager.run_until_idle()
+        w0 = cluster.get(Pod, "default", "j1-worker-0")
+        # rdzv args prepended
+        args = w0.spec.containers[0].args
+        assert f"{constants.ARG_RDZV_BACKEND}=xla" in args
+        assert f"{constants.ARG_NNODES}=2:8" in args
+        # world size via downward-API annotation
+        assert w0.metadata.annotations[constants.ANNOTATION_WORLD_SIZE] == "3"
+        vf = [e for e in w0.spec.containers[0].env if e.name == constants.ENV_NUM_PROCESSES]
+        assert vf and vf[0].value_from is not None
+        # preempt protector + generation label + init containers
+        assert constants.FINALIZER_PREEMPT_PROTECTOR in w0.metadata.finalizers
+        assert constants.LABEL_JOB_GENERATION in w0.metadata.labels
+        assert {c.name for c in w0.spec.init_containers} == {"image-warmup", "master-waiter"}
+
+    def test_megascale_env_multislice(self):
+        cluster, manager, engine, sim = make_env()
+        spec = job_spec(workers=4, master=False, num_slices=2)
+        submit_job(cluster, spec)
+        manager.run_until_idle()
+        pods = pods_of(cluster)
+        env0 = pods[0].spec.containers[0].env_map()
+        assert env0[constants.ENV_MEGASCALE_NUM_SLICES] == "2"
+        slice_ids = sorted(p.spec.containers[0].env_map()[constants.ENV_MEGASCALE_SLICE_ID]
+                           for p in pods)
+        assert slice_ids == ["0", "0", "1", "1"]  # 2x4 = 2 hosts/slice
+
+    def test_launch_delay_metrics(self):
+        cluster, manager, engine, sim = make_env()
+        submit_job(cluster, job_spec(workers=1, master=False))
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        assert len(engine.metrics.histograms["first_pod_launch_delay_seconds"]) == 1
+        assert len(engine.metrics.histograms["all_pods_launch_delay_seconds"]) == 1
+
+    def test_out_of_range_pod_deleted(self):
+        cluster, manager, engine, sim = make_env()
+        submit_job(cluster, job_spec(workers=2, master=False))
+        manager.run_until_idle()
+        # shrink workers 2 -> 1
+        j = cluster.get(TPUJob, "default", "j1")
+        j.spec.tasks[TaskType.WORKER].num_tasks = 1
+        cluster.update(j)
+        manager.run_until_idle()
+        assert [p.metadata.name for p in pods_of(cluster)] == ["j1-worker-0"]
+
+    def test_orphan_pod_with_job_labels_adopted_and_pruned(self):
+        # Orphans (no ownerRef) must still trigger reconciles via their
+        # job-name label (reference OnPodCreateFunc resolves by label).
+        cluster, manager, engine, sim = make_env()
+        submit_job(cluster, job_spec(workers=1, master=False))
+        manager.run_until_idle()
+        rogue = Pod(metadata=ObjectMeta(
+            name="rogue", namespace="default",
+            labels={constants.LABEL_JOB_NAME: "j1", constants.LABEL_TASK_TYPE: "worker",
+                    constants.LABEL_TASK_INDEX: "7"}),
+            spec=PodSpec(containers=[Container(name="tpu")]))
+        cluster.create(rogue)
+        manager.run_until_idle()
+        assert cluster.try_get(Pod, "default", "rogue") is None
+
+    def test_job_deletion_releases_preempt_finalizers(self):
+        cluster, manager, engine, sim = make_env()
+        spec = job_spec(workers=1, master=False,
+                        annotations={constants.ANNOTATION_ENABLE_ELASTIC: "true"})
+        submit_job(cluster, spec)
+        manager.run_until_idle()
+        w0 = cluster.get(Pod, "default", "j1-worker-0")
+        assert constants.FINALIZER_PREEMPT_PROTECTOR in w0.metadata.finalizers
+        cluster.delete(TPUJob, "default", "j1")
+        manager.run_until_idle()
+        assert cluster.try_get(TPUJob, "default", "j1") is None
+        assert pods_of(cluster) == []

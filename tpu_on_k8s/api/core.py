@@ -151,6 +151,28 @@ class PodSpec:
                 return c
         return None
 
+    def default_container(self) -> Optional[Container]:
+        """The conventional main container ("tpu"), falling back to the first
+        (single shared lookup — the reference re-implemented this scan in three
+        places, one with an index bug, hostnetwork.go:54-62)."""
+        from tpu_on_k8s.api import constants  # late: constants has no deps
+
+        return self.container(constants.DEFAULT_CONTAINER_NAME) or (
+            self.containers[0] if self.containers else None
+        )
+
+    def coordinator_port(self) -> int:
+        """The declared coordinator port of the default container, or the
+        framework default."""
+        from tpu_on_k8s.api import constants
+
+        c = self.default_container()
+        if c is not None:
+            for p in c.ports:
+                if p.name == constants.DEFAULT_PORT_NAME:
+                    return p.container_port
+        return constants.DEFAULT_COORDINATOR_PORT
+
 
 @dataclass
 class ContainerStateTerminated:

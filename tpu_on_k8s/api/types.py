@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 
 from tpu_on_k8s.api import constants
 from tpu_on_k8s.api.core import ObjectMeta, PodTemplateSpec
+from tpu_on_k8s.api.model_types import ModelVersionSpec
 
 
 class TaskType(str, enum.Enum):
@@ -196,8 +197,10 @@ class TPUJobSpec:
     run_policy: RunPolicy = field(default_factory=RunPolicy)
     elastic_policy: Optional[ElasticPolicy] = None
     tpu_policy: TPUPolicy = field(default_factory=TPUPolicy)
-    # Name of the Model this job trains; a ModelVersion is emitted on success.
-    model_name: str = ""
+    # ModelVersion template: when set, task pods get the model volume + path env
+    # and a ModelVersion is emitted on success (reference TorchJobSpec's embedded
+    # model output spec; controllers/common/job.go:465-508,557-581).
+    model_version: Optional[ModelVersionSpec] = None
 
 
 @dataclass

@@ -1,0 +1,23 @@
+"""Cluster client layer (L2).
+
+The reference generates a typed clientset + fake clientset from its CRDs
+(/root/reference/client/, hack/update-codegen.sh). Here the same role is played by
+a small hand-written client API (`ClusterClient`) with two backends:
+
+* `InMemoryCluster` — a faithful in-process stand-in for the k8s API server
+  (resource versions, conflicts, finalizers, deletionTimestamp, ownerRef cascade
+  GC, label selection, watch events). This is both the test substrate (the
+  reference's fake clientset analog) and the default runtime backend when no real
+  cluster is configured.
+* A real-cluster backend can implement the same `ClusterBackend` protocol over
+  the k8s REST API; the controllers never know the difference.
+"""
+
+from tpu_on_k8s.client.cluster import (
+    ApiError,
+    ConflictError,
+    InMemoryCluster,
+    NotFoundError,
+    WatchEvent,
+)
+from tpu_on_k8s.client.testing import KubeletSim

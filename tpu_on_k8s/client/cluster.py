@@ -1,0 +1,281 @@
+"""In-memory cluster: the API-server semantics the controllers depend on.
+
+Implements the k8s behaviors the reference leans on implicitly (SURVEY §1 L0):
+
+* optimistic concurrency — writes bump ``resourceVersion``; stale writes raise
+  ``ConflictError`` (the reference scatters conflict-tolerant status updates,
+  e.g. controllers/common/job.go:331-340 — our controllers must face the same
+  failure mode to be honest);
+* finalizers — delete stamps ``deletionTimestamp`` and the object lingers until
+  its finalizer list drains (the preempt-protector protocol, SURVEY §3.3);
+* ownerReference cascade GC — deleting an owner deletes its dependents (how job
+  deletion cleans up pods/services in the reference);
+* label selection and namespaces;
+* watch events for controller wiring.
+
+Thread-safe: one re-entrant lock around the store; watch callbacks fire outside
+mutation where possible but may re-enter the API.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from tpu_on_k8s.api.core import ObjectMeta, utcnow
+from tpu_on_k8s.utils import serde
+
+
+class ApiError(Exception):
+    pass
+
+
+class NotFoundError(ApiError):
+    pass
+
+
+class AlreadyExistsError(ApiError):
+    pass
+
+
+class ConflictError(ApiError):
+    """resourceVersion mismatch — caller must re-read and retry."""
+
+
+@dataclass
+class WatchEvent:
+    type: str  # "ADDED" | "MODIFIED" | "DELETED"
+    kind: str
+    obj: Any
+    old_obj: Any = None
+
+
+Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+
+def match_labels(labels: Dict[str, str], selector: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class InMemoryCluster:
+    """API-server stand-in. Objects are any dataclass with ``kind``/``metadata``;
+    all reads return deep copies (mutating a returned object never mutates the
+    store — exactly the informer-cache discipline the reference's controllers
+    must respect)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._store: Dict[Key, Any] = {}
+        self._rv = itertools.count(1)
+        self._uid = itertools.count(1)
+        self._watchers: List[Callable[[WatchEvent], None]] = []
+        self.events: List[Tuple[str, str, str, str]] = []  # (obj name, type, reason, msg)
+
+    # ---- watch ----------------------------------------------------------------
+    def watch(self, callback: Callable[[WatchEvent], None]) -> None:
+        self._watchers.append(callback)
+
+    def _emit(self, event: WatchEvent) -> None:
+        for cb in list(self._watchers):
+            cb(event)
+
+    # ---- helpers --------------------------------------------------------------
+    @staticmethod
+    def _key_of(obj: Any) -> Key:
+        return (obj.kind, obj.metadata.namespace, obj.metadata.name)
+
+    def record_event(self, obj: Any, etype: str, reason: str, message: str) -> None:
+        """k8s Event analog (reference record.EventRecorder)."""
+        with self._lock:
+            self.events.append((f"{obj.metadata.namespace}/{obj.metadata.name}", etype, reason, message))
+
+    # ---- CRUD -----------------------------------------------------------------
+    def create(self, obj: Any) -> Any:
+        with self._lock:
+            key = self._key_of(obj)
+            if key in self._store:
+                raise AlreadyExistsError(f"{key} already exists")
+            stored = serde.deep_copy(obj)
+            meta = stored.metadata
+            meta.uid = meta.uid or f"uid-{next(self._uid)}"
+            meta.creation_timestamp = meta.creation_timestamp or utcnow()
+            meta.resource_version = next(self._rv)
+            meta.generation = max(meta.generation, 1)
+            self._store[key] = stored
+            out = serde.deep_copy(stored)
+        self._emit(WatchEvent("ADDED", obj.kind, out))
+        return out
+
+    def get(self, cls: type, namespace: str, name: str) -> Any:
+        kind = cls.__dataclass_fields__["kind"].default  # type: ignore[attr-defined]
+        with self._lock:
+            obj = self._store.get((kind, namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return serde.deep_copy(obj)
+
+    def try_get(self, cls: type, namespace: str, name: str) -> Optional[Any]:
+        try:
+            return self.get(cls, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(
+        self,
+        cls: type,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        kind = cls.__dataclass_fields__["kind"].default  # type: ignore[attr-defined]
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in self._store.items():
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and not match_labels(obj.metadata.labels, label_selector):
+                    continue
+                out.append(serde.deep_copy(obj))
+            return out
+
+    def update(self, obj: Any, *, subresource: str = "") -> Any:
+        """Full-object update with optimistic concurrency. ``subresource="status"``
+        mimics the status subresource: only status (and annotations/labels for
+        protocol updates) are taken from the caller's object; spec is kept.
+        Spec changes bump ``metadata.generation`` (k8s semantics the elastic
+        generation protocol depends on, SURVEY §3.3)."""
+        with self._lock:
+            key = self._key_of(obj)
+            current = self._store.get(key)
+            if current is None:
+                raise NotFoundError(f"{key} not found")
+            if obj.metadata.resource_version != current.metadata.resource_version:
+                raise ConflictError(
+                    f"{key}: resourceVersion {obj.metadata.resource_version} "
+                    f"!= {current.metadata.resource_version}"
+                )
+            old = serde.deep_copy(current)
+            stored = serde.deep_copy(obj)
+            if subresource == "status":
+                stored.spec = current.spec
+                stored.metadata.generation = current.metadata.generation
+            else:
+                old_spec = serde.to_dict(current.spec, drop_none=False) if hasattr(current, "spec") else None
+                new_spec = serde.to_dict(stored.spec, drop_none=False) if hasattr(stored, "spec") else None
+                if old_spec != new_spec:
+                    stored.metadata.generation = current.metadata.generation + 1
+                else:
+                    stored.metadata.generation = current.metadata.generation
+            # Immutable server-side fields.
+            stored.metadata.uid = current.metadata.uid
+            stored.metadata.creation_timestamp = current.metadata.creation_timestamp
+            stored.metadata.deletion_timestamp = current.metadata.deletion_timestamp
+            stored.metadata.resource_version = next(self._rv)
+            self._store[key] = stored
+            out = serde.deep_copy(stored)
+        self._emit(WatchEvent("MODIFIED", obj.kind, out, old))
+        # A finalizer drain on a deleting object may complete the delete.
+        if out.metadata.deletion_timestamp is not None and not out.metadata.finalizers:
+            self._finalize_delete(self._key_of(out))
+        return out
+
+    def patch_meta(
+        self,
+        cls: type,
+        namespace: str,
+        name: str,
+        *,
+        labels: Optional[Dict[str, Optional[str]]] = None,
+        annotations: Optional[Dict[str, Optional[str]]] = None,
+        add_finalizers: Iterable[str] = (),
+        remove_finalizers: Iterable[str] = (),
+    ) -> Any:
+        """Strategic-merge-style metadata patch (reference pkg/utils/patch). A
+        value of None deletes the key. Patches never conflict — they re-read
+        inside the lock (mirroring server-side patch semantics)."""
+        kind = cls.__dataclass_fields__["kind"].default  # type: ignore[attr-defined]
+        with self._lock:
+            current = self._store.get((kind, namespace, name))
+            if current is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            old = serde.deep_copy(current)
+            for src, dst in ((labels, current.metadata.labels),
+                             (annotations, current.metadata.annotations)):
+                if src:
+                    for k, v in src.items():
+                        if v is None:
+                            dst.pop(k, None)
+                        else:
+                            dst[k] = v
+            for f in add_finalizers:
+                if f not in current.metadata.finalizers:
+                    current.metadata.finalizers.append(f)
+            for f in remove_finalizers:
+                if f in current.metadata.finalizers:
+                    current.metadata.finalizers.remove(f)
+            current.metadata.resource_version = next(self._rv)
+            out = serde.deep_copy(current)
+        self._emit(WatchEvent("MODIFIED", kind, out, old))
+        if out.metadata.deletion_timestamp is not None and not out.metadata.finalizers:
+            self._finalize_delete((kind, namespace, name))
+        return out
+
+    def delete(self, cls: type, namespace: str, name: str) -> None:
+        """Graceful delete: with finalizers present, only stamps
+        deletionTimestamp (the object becomes a "victim" in the preemption
+        protocol); otherwise removes and cascades to ownerRef dependents."""
+        kind = cls.__dataclass_fields__["kind"].default  # type: ignore[attr-defined]
+        key = (kind, namespace, name)
+        with self._lock:
+            current = self._store.get(key)
+            if current is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            if current.metadata.finalizers:
+                if current.metadata.deletion_timestamp is None:
+                    current.metadata.deletion_timestamp = utcnow()
+                    current.metadata.resource_version = next(self._rv)
+                    out = serde.deep_copy(current)
+                else:
+                    return  # already deleting
+            else:
+                out = None
+        if out is not None:
+            self._emit(WatchEvent("MODIFIED", kind, out))
+            return
+        self._finalize_delete(key)
+
+    def _finalize_delete(self, key: Key) -> None:
+        with self._lock:
+            obj = self._store.pop(key, None)
+            if obj is None:
+                return
+            uid = obj.metadata.uid
+            dependents = [
+                (k, o) for k, o in self._store.items()
+                if any(ref.uid == uid for ref in o.metadata.owner_references)
+            ]
+        self._emit(WatchEvent("DELETED", key[0], serde.deep_copy(obj)))
+        for (dkind, dns, dname), dobj in dependents:
+            # Cascade GC (background propagation): finalizers still honored.
+            try:
+                self.delete(type(dobj), dns, dname)
+            except NotFoundError:
+                pass
+
+    # ---- conveniences ---------------------------------------------------------
+    def update_with_retry(self, cls: type, namespace: str, name: str,
+                          mutate: Callable[[Any], None], *, subresource: str = "",
+                          attempts: int = 5) -> Any:
+        """Read-mutate-write with conflict retry — the centralized analog of the
+        reference's scattered RetryOnConflict blocks (SURVEY §7 hard parts)."""
+        last: Optional[Exception] = None
+        for _ in range(attempts):
+            obj = self.get(cls, namespace, name)
+            mutate(obj)
+            try:
+                return self.update(obj, subresource=subresource)
+            except ConflictError as e:
+                last = e
+        raise last  # type: ignore[misc]

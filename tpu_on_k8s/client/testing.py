@@ -1,0 +1,93 @@
+"""Kubelet/scheduler simulation for tests and local runs.
+
+The reference's intended envtest strategy runs a real API server but no kubelet,
+so controllers are driven by manipulating pod status (SURVEY §4). ``KubeletSim``
+packages those manipulations: admit pods to nodes, run/succeed/fail containers
+with exit codes, simulate preemption/eviction.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from tpu_on_k8s.api.core import (
+    Condition,
+    ContainerStateTerminated,
+    ContainerStatus,
+    Pod,
+    PodPhase,
+    utcnow,
+)
+from tpu_on_k8s.client.cluster import InMemoryCluster
+
+
+class KubeletSim:
+    def __init__(self, cluster: InMemoryCluster) -> None:
+        self.cluster = cluster
+        self._ip = 0
+
+    def _set(self, namespace: str, name: str, mutate) -> Pod:
+        return self.cluster.update_with_retry(Pod, namespace, name, mutate, subresource="status")
+
+    def run_pod(self, namespace: str, name: str, node: str = "node-0") -> Pod:
+        """Pending → Running + Ready, with IP and node assigned."""
+        self._ip += 1
+        ip = f"10.0.0.{self._ip}"
+
+        def mutate(pod: Pod) -> None:
+            pod.status.phase = PodPhase.RUNNING
+            pod.status.pod_ip = ip
+            pod.status.host_ip = ip
+            pod.status.start_time = pod.status.start_time or utcnow()
+            pod.status.conditions = [Condition(type="Ready", status="True", last_transition_time=utcnow())]
+            pod.status.container_statuses = [
+                ContainerStatus(name=c.name, ready=True) for c in pod.spec.containers
+            ]
+            if not pod.spec.node_name:
+                pod.spec.node_name = node
+
+        pod = self.cluster.get(Pod, namespace, name)
+        if not pod.spec.node_name:
+            # node assignment is a spec write; status subresource won't persist it
+            self.cluster.update_with_retry(
+                Pod, namespace, name, lambda p: setattr(p.spec, "node_name", node))
+        return self._set(namespace, name, mutate)
+
+    def run_all(self, namespace: str, label_selector=None, node: str = "node-0") -> int:
+        n = 0
+        for pod in self.cluster.list(Pod, namespace, label_selector):
+            if pod.status.phase == PodPhase.PENDING and pod.metadata.deletion_timestamp is None:
+                self.run_pod(namespace, pod.metadata.name, node=f"{node[:5]}-{n}")
+                n += 1
+        return n
+
+    def terminate_pod(self, namespace: str, name: str, exit_code: int,
+                      reason: str = "", phase: Optional[str] = None) -> Pod:
+        """Terminate the main container with an exit code; phase derives from the
+        code unless forced."""
+        if phase is None:
+            phase = PodPhase.SUCCEEDED if exit_code == 0 else PodPhase.FAILED
+
+        def mutate(pod: Pod) -> None:
+            pod.status.phase = phase
+            pod.status.reason = reason
+            pod.status.conditions = [Condition(type="Ready", status="False", last_transition_time=utcnow())]
+            pod.status.container_statuses = [
+                ContainerStatus(
+                    name=c.name,
+                    ready=False,
+                    terminated=ContainerStateTerminated(exit_code=exit_code, reason=reason),
+                )
+                for c in pod.spec.containers
+            ]
+
+        return self._set(namespace, name, mutate)
+
+    def succeed_pod(self, namespace: str, name: str) -> Pod:
+        return self.terminate_pod(namespace, name, 0)
+
+    def fail_pod(self, namespace: str, name: str, exit_code: int = 1, reason: str = "Error") -> Pod:
+        return self.terminate_pod(namespace, name, exit_code, reason=reason)
+
+    def evict_pod(self, namespace: str, name: str) -> Pod:
+        """Node-pressure eviction (retryable failure class, failover.go:106-113)."""
+        return self.terminate_pod(namespace, name, 137, reason="Evicted", phase=PodPhase.FAILED)

@@ -1,0 +1,5 @@
+"""Controller plane (L3/L5): generic job engine + concrete reconcilers.
+
+Analog of /root/reference/controllers/ — the shared ``JobEngine``
+(controllers/common/) and the TPUJob / ModelVersion / elastic reconcilers.
+"""

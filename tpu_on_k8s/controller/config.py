@@ -1,0 +1,29 @@
+"""Controller configuration.
+
+Analog of /root/reference/controllers/common/config.go:26-44 (a pflag-set package
+global there; an explicit dataclass threaded through constructors here — the
+reference's hard-coded tunables from SURVEY §5.6 are surfaced as fields).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Tuple
+
+
+@dataclass
+class JobControllerConfig:
+    enable_gang_scheduling: bool = True
+    max_concurrent_reconciles: int = 1
+    sync_period_seconds: float = 30.0
+    hostnetwork_port_range: Tuple[int, int] = (20000, 30000)
+    model_image_builder: str = "gcr.io/kaniko-project/executor:latest"
+
+    # Surfaced tunables (hard-coded in the reference — SURVEY §5.6):
+    coordinator_period_seconds: float = 0.1        # plugins/registry.go:27
+    quota_assume_ttl_seconds: float = 60.0         # plugins/quota.go:48
+    elastic_loop_period_seconds: float = 30.0      # elastictorchjob_controller.go:60
+    elastic_metric_count: int = 5
+    failover_concurrency: int = 50                 # failover.go semaphore widths
+    scale_concurrency: int = 100                   # elastic_scale.go:258
+    victim_cleanup_concurrency: int = 10           # elastic_scale.go:492
+    expectation_ttl_seconds: float = 300.0

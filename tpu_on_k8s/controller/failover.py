@@ -1,0 +1,141 @@
+"""Pod-failure classification and failover actions.
+
+Analog of /root/reference/controllers/common/failover.go — the exit-code taxonomy
+(:64-99), retryable kill reasons (:106-113), the ``shouldPodFailover`` predicate
+(:52-61, only under RestartPolicy.ON_EXIT_CODE), and the two recovery actions:
+recreate (delete + let the engine recreate) and in-place restart (the OpenKruise
+ContainerRecreateRequest protocol, abstracted behind ``InPlaceRestarter`` so a
+GKE backend can post real CRRs while tests use the in-memory executor).
+
+TPU note (SURVEY §5.3): TPU-VM preemption surfaces as an Evicted/Killed pod; it
+classifies as retryable here, and slice-atomicity is enforced one level up — a
+failed host invalidates its whole slice's gang, so the engine fails over the
+slice's task group, not just the single pod.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Optional, Protocol
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import Pod, PodPhase, utcnow
+from tpu_on_k8s.api.types import RestartPolicy
+from tpu_on_k8s.client.cluster import InMemoryCluster, NotFoundError
+
+# Exit-code taxonomy (failover.go:64-99).
+PERMANENT_EXIT_CODES = {1, 2, 126, 127, 128, 139}
+RETRYABLE_EXIT_CODES = {130, 137, 143}
+USER_DEFINED_RETRYABLE_EXIT_CODE = 138
+
+# Pod kill reasons that retry regardless of exit code (failover.go:106-113).
+RETRYABLE_REASONS = {"OOMKilled", "Killed", "Evicted", "UnexpectedAdmissionError"}
+
+
+class ExitClass(str, enum.Enum):
+    PERMANENT = "permanent"
+    RETRYABLE = "retryable"
+    USER_RETRYABLE = "user-retryable"
+    UNKNOWN = "unknown"
+
+
+def classify_exit_code(code: int) -> ExitClass:
+    if code == USER_DEFINED_RETRYABLE_EXIT_CODE:
+        return ExitClass.USER_RETRYABLE
+    if code in RETRYABLE_EXIT_CODES:
+        return ExitClass.RETRYABLE
+    if code in PERMANENT_EXIT_CODES:
+        return ExitClass.PERMANENT
+    return ExitClass.UNKNOWN
+
+
+def pod_exit_code(pod: Pod) -> Optional[int]:
+    """Highest-signal terminated exit code across containers (the reference
+    captures the first non-zero main-container code)."""
+    best: Optional[int] = None
+    for cs in pod.status.container_statuses:
+        if cs.terminated is not None:
+            code = cs.terminated.exit_code
+            if code != 0:
+                return code
+            best = code
+    return best
+
+
+def should_pod_failover(pod: Pod, restart_policy: RestartPolicy) -> bool:
+    """True if a Failed pod should be recovered rather than counted as a
+    permanent failure (failover.go:52-61). Only RestartPolicy.ON_EXIT_CODE
+    consults the taxonomy; OnFailure always retries; Never/Always do not
+    failover here (Always is handled by the kubelet)."""
+    if pod.status.phase != PodPhase.FAILED:
+        return False
+    if restart_policy == RestartPolicy.ON_FAILURE:
+        return True
+    if restart_policy != RestartPolicy.ON_EXIT_CODE:
+        return False
+    if pod.status.reason in RETRYABLE_REASONS:
+        return True
+    code = pod_exit_code(pod)
+    if code is None:
+        return False
+    return classify_exit_code(code) in (ExitClass.RETRYABLE, ExitClass.USER_RETRYABLE)
+
+
+class InPlaceRestarter(Protocol):
+    """CRR executor seam (failover.go:210-307). Returns True on success; the
+    caller falls back to delete+recreate on failure (:242-247)."""
+
+    def restart(self, cluster: InMemoryCluster, pod: Pod) -> bool: ...
+
+
+class InMemoryRestarter:
+    """Test/local executor: resets the pod to Running in place and bumps
+    restart counts — what the kruise daemon's CRI restart looks like from the
+    API server's perspective."""
+
+    def restart(self, cluster: InMemoryCluster, pod: Pod) -> bool:
+        def mutate(p: Pod) -> None:
+            p.status.phase = PodPhase.RUNNING
+            p.status.reason = ""
+            for cs in p.status.container_statuses:
+                cs.ready = True
+                cs.restart_count += 1
+                cs.terminated = None
+
+        try:
+            cluster.update_with_retry(
+                Pod, pod.metadata.namespace, pod.metadata.name, mutate,
+                subresource="status")
+            return True
+        except NotFoundError:
+            return False
+
+
+def failover_recreate(cluster: InMemoryCluster, pod: Pod) -> bool:
+    """Delete the failed pod; the engine's next reconcile recreates it
+    (failover.go:149-172). Stamps the failover timestamp annotation first.
+    Returns False if the pod was already gone (caller must drain any deletion
+    expectation it raised)."""
+    try:
+        cluster.patch_meta(
+            Pod, pod.metadata.namespace, pod.metadata.name,
+            annotations={constants.ANNOTATION_LAST_FAILOVER_TIMESTAMP: utcnow().isoformat()},
+            # The victim must actually go away: failover delete overrides the
+            # preempt-protector (it is not a preemption).
+            remove_finalizers=[constants.FINALIZER_PREEMPT_PROTECTOR],
+        )
+        cluster.delete(Pod, pod.metadata.namespace, pod.metadata.name)
+        return True
+    except NotFoundError:
+        return False
+
+
+def failover_inplace_restart(
+    cluster: InMemoryCluster, pod: Pod, restarter: Optional[InPlaceRestarter]
+) -> bool:
+    """In-place restart via the CRR seam, falling back to recreate
+    (failover.go:210-264). Returns True iff the pod was restarted in place
+    (False means a recreate happened or the pod vanished)."""
+    if restarter is not None and restarter.restart(cluster, pod):
+        return True
+    failover_recreate(cluster, pod)
+    return False

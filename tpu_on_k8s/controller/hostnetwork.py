@@ -1,0 +1,49 @@
+"""Host-network port management.
+
+Analog of /root/reference/controllers/common/hostnetwork.go: when a job is
+annotated ``network-mode=host``, each pod gets a random port from the configured
+range; container port and host port are rewritten to it, and the pod's normal
+Service is target-port-patched so DNS keeps working (service.go:288-303).
+
+Fixes the reference's container scan bug (hostnetwork.go:54-62 starts at index 1
+and can index with ci=-1): we look up the default container by name with a safe
+fallback to index 0.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, Tuple
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import Pod
+
+PortMap = Dict[str, int]  # pod name -> allocated host port
+
+
+def enabled(annotations: Dict[str, str]) -> bool:
+    return annotations.get(constants.ANNOTATION_NETWORK_MODE) == constants.NETWORK_MODE_HOST
+
+
+def allocate_port(port_range: Tuple[int, int], rng: random.Random | None = None) -> int:
+    lo, hi = port_range
+    return (rng or random).randint(lo, hi - 1)
+
+
+def setup_pod_hostnetwork(pod: Pod, port: int) -> None:
+    """Switch the pod to hostNetwork and rewrite the coordinator port
+    (hostnetwork.go:47-81, bug-fixed)."""
+    pod.spec.host_network = True
+    container = pod.spec.default_container()
+    if container is None:
+        return
+    for p in container.ports:
+        if p.name == constants.DEFAULT_PORT_NAME:
+            p.container_port = port
+            p.host_port = port
+            return
+    # No declared port: add one so the rewrite is still visible to env wiring.
+    from tpu_on_k8s.api.core import ContainerPort
+
+    container.ports.append(
+        ContainerPort(name=constants.DEFAULT_PORT_NAME, container_port=port, host_port=port)
+    )

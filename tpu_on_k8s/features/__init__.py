@@ -1,0 +1,3 @@
+"""Feature gates (reference /root/reference/pkg/features/features.go:30-63)."""
+
+from tpu_on_k8s.features.features import FeatureGates, default_gates, gates

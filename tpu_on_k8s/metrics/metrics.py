@@ -1,0 +1,104 @@
+"""Job lifecycle metrics.
+
+Analog of /root/reference/pkg/metrics/metrics.go:33-124: per-kind counters
+(created/deleted/success/failed/restarted), launch-delay histograms (job create →
+first pod ready, job create → all pods ready), and queue-depth gauges. Backed by
+prometheus_client when importable (scrapeable via ``serve()``), always mirrored in
+plain dicts so tests and the local driver can read without a scrape.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+try:  # prometheus_client ships in the image; degrade gracefully anyway
+    import prometheus_client as _prom
+except ImportError:  # pragma: no cover
+    _prom = None
+
+_BUCKETS = (0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
+
+
+class JobMetrics:
+    """One instance per controller manager (kind-labelled like the reference)."""
+
+    def __init__(self, kind: str = "TPUJob", registry=None) -> None:
+        self.kind = kind
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = defaultdict(int)
+        self.histograms: Dict[str, List[float]] = defaultdict(list)
+        self.gauges: Dict[Tuple[str, str], float] = {}
+        self._prom_counters = {}
+        self._prom_hists = {}
+        self._prom_gauges = {}
+        if _prom is not None:
+            registry = registry or _prom.CollectorRegistry()
+            self.registry = registry
+            ns = "tpu_on_k8s"
+            for name in ("created", "deleted", "successful", "failed", "restarted"):
+                self._prom_counters[name] = _prom.Counter(
+                    f"{ns}_jobs_{name}", f"Jobs {name} for kind {kind}",
+                    registry=registry)
+            for name in ("first_pod_launch_delay_seconds", "all_pods_launch_delay_seconds"):
+                self._prom_hists[name] = _prom.Histogram(
+                    f"{ns}_jobs_{name}", f"Job {name}", buckets=_BUCKETS,
+                    registry=registry)
+            for name in ("running", "pending"):
+                self._prom_gauges[name] = _prom.Gauge(
+                    f"{ns}_jobs_{name}", f"Jobs currently {name}", registry=registry)
+            self._prom_gauges["queue_pending"] = _prom.Gauge(
+                f"{ns}_tenant_queue_jobs_pending_count", "Pending jobs per tenant queue",
+                ["queue"], registry=registry)
+        else:  # pragma: no cover
+            self.registry = None
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] += n
+        c = self._prom_counters.get(name)
+        if c is not None:
+            c.inc(n)
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self.histograms[name].append(seconds)
+        h = self._prom_hists.get(name)
+        if h is not None:
+            h.observe(seconds)
+
+    def set_gauge(self, name: str, value: float, label: str = "") -> None:
+        with self._lock:
+            self.gauges[(name, label)] = value
+        g = self._prom_gauges.get(name)
+        if g is not None:
+            (g.labels(label) if label else g).set(value)
+
+    # convenience wrappers matching reference call sites
+    def created(self) -> None:
+        self.inc("created")
+
+    def deleted(self) -> None:
+        self.inc("deleted")
+
+    def success(self) -> None:
+        self.inc("successful")
+
+    def failure(self) -> None:
+        self.inc("failed")
+
+    def restarted(self) -> None:
+        self.inc("restarted")
+
+    def first_pod_launch_delay(self, seconds: float) -> None:
+        self.observe("first_pod_launch_delay_seconds", seconds)
+
+    def all_pods_launch_delay(self, seconds: float) -> None:
+        self.observe("all_pods_launch_delay_seconds", seconds)
+
+
+def serve(metrics: JobMetrics, port: int = 8443):  # pragma: no cover - live mode
+    """Expose /metrics (reference pkg/metrics/server.go:29-37)."""
+    if _prom is None or metrics.registry is None:
+        raise RuntimeError("prometheus_client unavailable")
+    return _prom.start_http_server(port, registry=metrics.registry)

@@ -1,0 +1,9 @@
+"""Model-artifact storage providers (reference /root/reference/pkg/storage/)."""
+
+from tpu_on_k8s.storage.providers import (
+    GCSProvider,
+    LocalStorageProvider,
+    NFSProvider,
+    provider_for_storage,
+    volume_for_storage,
+)

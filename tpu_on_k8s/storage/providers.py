@@ -1,0 +1,166 @@
+"""Storage providers: PV creation + pod volume injection per storage flavor.
+
+Analog of /root/reference/pkg/storage/{interface.go,local_storage.go,nfs.go,
+registry/registry.go}: the provider is picked by which field of the tagged
+``Storage`` union is set (registry.go:36-44). GCS is new — the idiomatic artifact
+store for TPU-on-GKE (mounted via GCS FUSE CSI in a real cluster; modeled as a
+volume here).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import ObjectMeta, PodSpec, Volume, VolumeMount
+from tpu_on_k8s.api.model_types import ModelVersion, Storage
+
+
+@dataclass
+class PersistentVolumeSpec:
+    capacity_gi: int = 10
+    access_modes: list = field(default_factory=lambda: ["ReadWriteOnce"])
+    host_path: Optional[str] = None
+    node_name: Optional[str] = None  # node-affinity pin for local storage
+    nfs_server: Optional[str] = None
+    nfs_path: Optional[str] = None
+    gcs_bucket: Optional[str] = None
+    gcs_prefix: Optional[str] = None
+    claim_ref: str = ""
+
+
+@dataclass
+class PersistentVolume:
+    api_version: str = "v1"
+    kind: str = "PersistentVolume"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeSpec = field(default_factory=PersistentVolumeSpec)
+
+
+@dataclass
+class PersistentVolumeClaimStatus:
+    phase: str = "Pending"  # Pending | Bound
+
+
+@dataclass
+class PersistentVolumeClaimSpec:
+    volume_name: str = ""
+    storage_gi: int = 10
+
+
+@dataclass
+class PersistentVolumeClaim:
+    api_version: str = "v1"
+    kind: str = "PersistentVolumeClaim"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeClaimSpec = field(default_factory=PersistentVolumeClaimSpec)
+    status: PersistentVolumeClaimStatus = field(default_factory=PersistentVolumeClaimStatus)
+
+
+class StorageProvider(Protocol):
+    """Reference Storage interface (pkg/storage/interface.go:26-35)."""
+
+    def create_persistent_volume(self, mv: ModelVersion, pv_name: str) -> PersistentVolume: ...
+    def add_model_volume_to_pod_spec(self, mv: ModelVersion, spec: PodSpec) -> None: ...
+    def get_model_mount_path(self, mv: ModelVersion) -> str: ...
+
+
+def _mount(spec: PodSpec, volume: Volume, mount_path: str) -> None:
+    if not any(v.name == volume.name for v in spec.volumes):
+        spec.volumes.append(volume)
+    for c in spec.containers:
+        if not any(m.name == volume.name for m in c.volume_mounts):
+            c.volume_mounts.append(VolumeMount(name=volume.name, mount_path=mount_path))
+
+
+class LocalStorageProvider:
+    """hostPath PV + node-affinity pin (reference local_storage.go:36-106)."""
+
+    def create_persistent_volume(self, mv: ModelVersion, pv_name: str) -> PersistentVolume:
+        ls = mv.spec.storage.local_storage
+        return PersistentVolume(
+            metadata=ObjectMeta(name=pv_name, namespace=""),
+            spec=PersistentVolumeSpec(
+                host_path=ls.path, node_name=ls.node_name,
+                claim_ref=f"{mv.metadata.namespace}/{pv_name}"),
+        )
+
+    def add_model_volume_to_pod_spec(self, mv: ModelVersion, spec: PodSpec) -> None:
+        ls = mv.spec.storage.local_storage
+        _mount(spec, Volume(name="model-volume", host_path=ls.path),
+               self.get_model_mount_path(mv))
+        if ls.node_name:
+            spec.node_name = ls.node_name
+
+    def get_model_mount_path(self, mv: ModelVersion) -> str:
+        return constants.DEFAULT_MODEL_PATH
+
+
+class NFSProvider:
+    """Reference nfs.go:37-90."""
+
+    def create_persistent_volume(self, mv: ModelVersion, pv_name: str) -> PersistentVolume:
+        nfs = mv.spec.storage.nfs
+        return PersistentVolume(
+            metadata=ObjectMeta(name=pv_name, namespace=""),
+            spec=PersistentVolumeSpec(
+                nfs_server=nfs.server, nfs_path=nfs.path,
+                access_modes=["ReadWriteMany"],
+                claim_ref=f"{mv.metadata.namespace}/{pv_name}"),
+        )
+
+    def add_model_volume_to_pod_spec(self, mv: ModelVersion, spec: PodSpec) -> None:
+        nfs = mv.spec.storage.nfs
+        _mount(spec, Volume(name="model-volume", nfs_server=nfs.server, nfs_path=nfs.path),
+               self.get_model_mount_path(mv))
+
+    def get_model_mount_path(self, mv: ModelVersion) -> str:
+        return mv.spec.storage.nfs.mounted_path or constants.DEFAULT_MODEL_PATH
+
+
+class GCSProvider:
+    """GCS bucket (new): PV modeled as a bucket reference; in-cluster this is a
+    GCS FUSE CSI volume."""
+
+    def create_persistent_volume(self, mv: ModelVersion, pv_name: str) -> PersistentVolume:
+        gcs = mv.spec.storage.gcs
+        return PersistentVolume(
+            metadata=ObjectMeta(name=pv_name, namespace=""),
+            spec=PersistentVolumeSpec(
+                gcs_bucket=gcs.bucket, gcs_prefix=gcs.prefix,
+                access_modes=["ReadWriteMany"],
+                claim_ref=f"{mv.metadata.namespace}/{pv_name}"),
+        )
+
+    def add_model_volume_to_pod_spec(self, mv: ModelVersion, spec: PodSpec) -> None:
+        gcs = mv.spec.storage.gcs
+        _mount(spec, Volume(name="model-volume", host_path=f"gcs://{gcs.bucket}/{gcs.prefix}"),
+               self.get_model_mount_path(mv))
+
+    def get_model_mount_path(self, mv: ModelVersion) -> str:
+        return mv.spec.storage.gcs.mounted_path or constants.DEFAULT_MODEL_PATH
+
+
+def provider_for_storage(storage: Storage) -> Optional[StorageProvider]:
+    """Pick by set field (reference registry.go:36-44)."""
+    if storage.local_storage is not None:
+        return LocalStorageProvider()
+    if storage.nfs is not None:
+        return NFSProvider()
+    if storage.gcs is not None:
+        return GCSProvider()
+    return None
+
+
+def volume_for_storage(storage: Storage) -> Optional[Volume]:
+    """The model-output volume injected into training pods
+    (reference addModelPathEnv, controllers/common/job.go:557-581)."""
+    if storage.local_storage is not None:
+        return Volume(name="model-volume", host_path=storage.local_storage.path)
+    if storage.nfs is not None:
+        return Volume(name="model-volume", nfs_server=storage.nfs.server,
+                      nfs_path=storage.nfs.path)
+    if storage.gcs is not None:
+        return Volume(name="model-volume",
+                      host_path=f"gcs://{storage.gcs.bucket}/{storage.gcs.prefix}")
+    return None
