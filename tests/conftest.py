@@ -6,8 +6,16 @@ real pjit/shard_map path on 8 virtual devices without TPU hardware.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Overwrite, not setdefault: the image pins JAX_PLATFORMS=axon (single real
+# TPU chip) globally and its sitecustomize imports jax before conftest runs —
+# so flip the platform via jax.config (still honored pre-backend-init), and
+# set the flag env before the CPU backend first initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,103 @@
+"""Mesh + partition-rule unit tests (8-device virtual CPU mesh, conftest)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_on_k8s.parallel.mesh import (
+    AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_SEQ, MeshConfig, batch_sharding,
+    create_mesh,
+)
+from tpu_on_k8s.parallel.partition import (
+    PartitionRule, named_sharding, shard_pytree, spec_for_path,
+    specs_for_pytree,
+)
+
+
+class TestMeshConfig:
+    def test_resolve_wildcard(self):
+        cfg = MeshConfig(data=2, fsdp=-1, model=2, seq=1).resolve(8)
+        assert cfg.fsdp == 2
+
+    def test_resolve_exact(self):
+        cfg = MeshConfig(data=8, fsdp=1, model=1, seq=1).resolve(8)
+        assert cfg.axis_sizes() == (8, 1, 1, 1)
+
+    def test_resolve_mismatch_raises(self):
+        with pytest.raises(ValueError, match="needs 6"):
+            MeshConfig(data=3, fsdp=2, model=1, seq=1).resolve(8)
+
+    def test_resolve_indivisible_raises(self):
+        with pytest.raises(ValueError, match="does not divide"):
+            MeshConfig(data=3, fsdp=-1, model=1, seq=1).resolve(8)
+
+    def test_two_wildcards_raise(self):
+        with pytest.raises(ValueError, match="at most one"):
+            MeshConfig(data=-1, fsdp=-1).resolve(8)
+
+
+class TestCreateMesh:
+    def test_default_all_fsdp(self):
+        mesh = create_mesh()
+        assert mesh.shape[AXIS_FSDP] == 8
+        assert mesh.shape[AXIS_DATA] == 1
+
+    def test_axis_order_model_innermost(self):
+        mesh = create_mesh(MeshConfig(data=2, fsdp=2, model=2, seq=1))
+        assert mesh.axis_names[-1] == AXIS_MODEL
+        assert mesh.shape == {AXIS_DATA: 2, AXIS_FSDP: 2, AXIS_SEQ: 1,
+                              AXIS_MODEL: 2}
+
+    def test_batch_sharding_splits_batch(self):
+        mesh = create_mesh(MeshConfig(data=2, fsdp=4, model=1, seq=1))
+        s = batch_sharding(mesh)
+        assert s.spec == P((AXIS_DATA, AXIS_FSDP))
+
+    def test_batch_sharding_seq_axis(self):
+        mesh = create_mesh(MeshConfig(data=1, fsdp=2, model=1, seq=4))
+        s = batch_sharding(mesh)
+        assert s.spec == P((AXIS_DATA, AXIS_FSDP), AXIS_SEQ)
+
+
+RULES = [
+    PartitionRule(r"attn/w[qkv]/kernel", P(None, AXIS_FSDP, AXIS_MODEL)),
+    PartitionRule(r"embed", P(AXIS_MODEL, AXIS_FSDP)),
+]
+
+
+class TestPartitionRules:
+    def test_first_match_wins(self):
+        rules = [PartitionRule(r"kernel", P(AXIS_FSDP)),
+                 PartitionRule(r"attn", P(AXIS_MODEL))]
+        assert spec_for_path("attn/kernel", rules) == P(AXIS_FSDP)
+
+    def test_default_replicated(self):
+        assert spec_for_path("norm/scale", RULES) == P()
+
+    def test_specs_for_pytree(self):
+        tree = {"attn": {"wq": {"kernel": jnp.zeros((2, 8, 8))}},
+                "embed": jnp.zeros((16, 8))}
+        specs = specs_for_pytree(tree, RULES)
+        assert specs["attn"]["wq"]["kernel"] == P(None, AXIS_FSDP, AXIS_MODEL)
+        assert specs["embed"] == P(AXIS_MODEL, AXIS_FSDP)
+
+    def test_validation_catches_indivisible(self):
+        mesh = create_mesh(MeshConfig(data=1, fsdp=4, model=2, seq=1))
+        tree = {"attn": {"wq": {"kernel": jnp.zeros((2, 6, 8))}}}  # 6 % 4 != 0
+        with pytest.raises(ValueError, match="not divisible"):
+            named_sharding(tree, mesh, RULES)
+
+    def test_shard_pytree_places_leaves(self):
+        mesh = create_mesh(MeshConfig(data=1, fsdp=4, model=2, seq=1))
+        tree = {"embed": jnp.zeros((16, 8)), "scale": jnp.zeros((4,))}
+        out = shard_pytree(tree, mesh, RULES)
+        assert out["embed"].sharding.spec == P(AXIS_MODEL, AXIS_FSDP)
+        assert out["scale"].sharding.spec == P()
+        np.testing.assert_array_equal(np.asarray(out["embed"]), 0)
+
+    def test_optimizer_state_inherits_param_specs(self):
+        """Adam mu/nu paths contain the param path as suffix → same spec."""
+        assert (spec_for_path("0/mu/blocks/attn/wq/kernel", RULES)
+                == P(None, AXIS_FSDP, AXIS_MODEL))
+        assert spec_for_path("0/count", RULES) == P()

@@ -1,0 +1,17 @@
+"""Model zoo for the compute plane — one model per BASELINE.json config.
+
+Currently implemented:
+
+* ``transformer``— Llama-style flagship (7B FSDP multi-queue config), the
+                   model behind ``__graft_entry__.py``.
+
+Planned (tracked against BASELINE.json): ``mnist_cnn``, ``resnet`` (ResNet-50),
+``bert``, ``gpt2``.
+"""
+from tpu_on_k8s.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    flagship_partition_rules,
+)
+
+__all__ = ["Transformer", "TransformerConfig", "flagship_partition_rules"]

@@ -1,0 +1,253 @@
+"""Flagship decoder-only transformer (Llama-family), TPU-first.
+
+Design choices driven by the hardware, not by any reference code (the
+reference operator contains no model code — training math lived in user
+containers, SURVEY.md §2.10):
+
+* **scan over layers** (``nn.scan``): one compiled block body regardless of
+  depth — compile time and HLO size are O(1) in ``n_layers``; parameters are
+  stacked on a leading layer axis.
+* **bf16 compute, fp32 params**: matmuls hit the MXU in bf16; RMSNorm/softmax
+  statistics accumulate in fp32.
+* **GQA + RoPE**, SwiGLU MLP — the Llama-2/3 shape, so the 7B benchmark
+  config maps 1:1.
+* **pluggable attention**: ``attn_impl`` selects plain XLA attention, the
+  Pallas flash kernel (`tpu_on_k8s/ops/flash_attention.py`), or ring
+  attention over the mesh ``seq`` axis (`tpu_on_k8s/parallel/ring.py`).
+* **remat** (``jax.checkpoint``) per block, trading FLOPs for HBM.
+
+Sharding is *external*: `flagship_partition_rules()` returns the
+megatron-layout rule list (fsdp on one matmul dim, model/tensor on the
+other) consumed by `tpu_on_k8s/parallel/partition.py`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpu_on_k8s.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_SEQ
+from tpu_on_k8s.parallel.partition import PartitionRule
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16          # compute dtype (MXU-friendly)
+    param_dtype: Any = jnp.float32     # master weights
+    remat: bool = True
+    attn_impl: str = "xla"             # "xla" | "flash" | "ring"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    # ---- named sizes ---------------------------------------------------------
+    @staticmethod
+    def llama2_7b() -> "TransformerConfig":
+        return TransformerConfig()  # defaults are the 7B shape
+
+    @staticmethod
+    def llama2_1b() -> "TransformerConfig":
+        return TransformerConfig(d_model=2048, n_layers=16, n_heads=16,
+                                 n_kv_heads=8, d_ff=5632)
+
+    @staticmethod
+    def tiny() -> "TransformerConfig":
+        """Test/dry-run shape: every sharded dim divisible by an 8-way mesh."""
+        return TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                                 n_heads=4, n_kv_heads=2, d_ff=128,
+                                 max_seq_len=128, remat=False)
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotary position embedding. x: [B, L, H, Dh]; positions: [B, L]."""
+    half = x.shape[-1] // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, L, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                  causal: bool = True) -> jnp.ndarray:
+    """Plain attention, letting XLA fuse; softmax statistics in fp32.
+
+    q: [B, L, H, Dh]; k/v: [B, L, H, Dh] (kv already repeated to H heads).
+    """
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("blhd,bmhd->bhlm", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        l, m = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((l, m), dtype=bool))
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+
+def _select_attention(impl: str) -> Callable[..., jnp.ndarray]:
+    if impl == "xla":
+        return xla_attention
+    if impl == "flash":
+        try:
+            from tpu_on_k8s.ops.flash_attention import flash_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                "attn_impl='flash' requires tpu_on_k8s.ops.flash_attention") from e
+        return flash_attention
+    if impl == "ring":
+        try:
+            from tpu_on_k8s.parallel.ring import ring_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                "attn_impl='ring' requires tpu_on_k8s.parallel.ring") from e
+        return ring_attention
+    raise ValueError(f"unknown attn_impl {impl!r}")
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           self.param_dtype)
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + self.eps)
+        return (y * scale.astype(jnp.float32)).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, name=name, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02))
+        q = dense(cfg.n_heads * cfg.head_dim, "wq")(x)
+        k = dense(cfg.n_kv_heads * cfg.head_dim, "wk")(x)
+        v = dense(cfg.n_kv_heads * cfg.head_dim, "wv")(x)
+        b, l = x.shape[0], x.shape[1]
+        q = q.reshape(b, l, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
+        v = v.reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        # GQA: repeat kv groups up to n_heads before the kernel; XLA folds the
+        # broadcast into the einsum so no HBM copy materialises.
+        rep = cfg.n_heads // cfg.n_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        out = _select_attention(cfg.attn_impl)(q, k, v, causal=True)
+        out = out.reshape(b, l, cfg.n_heads * cfg.head_dim)
+        return dense(cfg.d_model, "wo")(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, name=name, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02))
+        gate = dense(cfg.d_ff, "w_gate")(x)
+        up = dense(cfg.d_ff, "w_up")(x)
+        return dense(cfg.d_model, "w_down")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    """Pre-norm block; returns a (carry, None) pair so it can be nn.scan'd."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, positions: jnp.ndarray):
+        cfg = self.cfg
+        h = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype, name="attn_norm")(x),
+            positions)
+        out = h + MLP(cfg, name="mlp")(
+            RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype, name="mlp_norm")(h))
+        return out, None
+
+
+class Transformer(nn.Module):
+    """Decoder-only LM. __call__([B, L] int tokens) → [B, L, vocab] logits."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        embed = self.param("embed", nn.initializers.normal(0.02),
+                           (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape)
+
+        block_cls = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
+        # One traced block body for the whole stack; params stack on axis 0 —
+        # compile time is O(1) in depth and rules see a leading "layers" dim.
+        stack = nn.scan(
+            block_cls,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=nn.broadcast,
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(cfg, name="blocks")
+        x, _ = stack(x, positions)
+
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype, name="final_norm")(x)
+        head = self.param("lm_head", nn.initializers.normal(0.02),
+                          (cfg.d_model, cfg.vocab_size), cfg.param_dtype)
+        # fp32 logits: the loss softmax wants full precision.
+        return jnp.einsum("bld,dv->blv", x, head.astype(cfg.dtype),
+                          preferred_element_type=jnp.float32)
+
+
+def flagship_partition_rules() -> List[PartitionRule]:
+    """Megatron-layout rules for scan-stacked params (leading ``layers`` dim).
+
+    fsdp shards the non-contracting weight dim that pairs with the model
+    axis's contracting dim, so a layer's forward is: all-gather(fsdp) →
+    sharded matmul(model) → reduce-scatter — XLA derives these from the specs.
+    """
+    return [
+        # attention: qkv column-parallel, output row-parallel
+        PartitionRule(r"attn/w[qkv]/kernel", P(None, AXIS_FSDP, AXIS_MODEL)),
+        PartitionRule(r"attn/wo/kernel", P(None, AXIS_MODEL, AXIS_FSDP)),
+        # mlp: gate/up column-parallel, down row-parallel
+        PartitionRule(r"mlp/w_(gate|up)/kernel", P(None, AXIS_FSDP, AXIS_MODEL)),
+        PartitionRule(r"mlp/w_down/kernel", P(None, AXIS_MODEL, AXIS_FSDP)),
+        # embeddings: vocab-parallel over model, hidden over fsdp
+        PartitionRule(r"(^|/)embed$", P(AXIS_MODEL, AXIS_FSDP)),
+        PartitionRule(r"lm_head", P(AXIS_FSDP, AXIS_MODEL)),
+        # norms and everything else: replicated (default, listed for clarity)
+        PartitionRule(r"norm/scale", P()),
+    ]

@@ -1,0 +1,46 @@
+"""SPMD parallelism over TPU device meshes.
+
+This package is the compute-plane counterpart of the orchestration plane's
+slice-topology math (`tpu_on_k8s/gang/topology.py`): the operator allocates a
+slice; this package lays a logical `jax.sharding.Mesh` over its chips and
+shards models/optimizer/data across it with the standard axis vocabulary
+
+* ``data``  — pure data parallelism (batch split, gradient psum over ICI/DCN);
+* ``fsdp``  — fully-sharded data parallelism (batch + parameter split);
+* ``model`` — tensor parallelism (hidden/heads split, activation collectives);
+* ``seq``   — sequence/context parallelism (ring attention over the seq axis).
+
+The design follows the scaling-book recipe: pick a mesh, annotate shardings
+(regex rules over parameter paths), and let XLA insert the collectives.
+"""
+from tpu_on_k8s.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    MeshConfig,
+    batch_sharding,
+    create_mesh,
+)
+from tpu_on_k8s.parallel.partition import (
+    PartitionRule,
+    named_sharding,
+    shard_pytree,
+    spec_for_path,
+    specs_for_pytree,
+)
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_FSDP",
+    "AXIS_MODEL",
+    "AXIS_SEQ",
+    "MeshConfig",
+    "create_mesh",
+    "batch_sharding",
+    "PartitionRule",
+    "named_sharding",
+    "shard_pytree",
+    "spec_for_path",
+    "specs_for_pytree",
+]

@@ -1,0 +1,95 @@
+"""Logical device meshes for TPU slices.
+
+The orchestration plane hands a job N chips (a slice, or several DCN-connected
+slices); this module folds them into a logical ``jax.sharding.Mesh`` with the
+four standard axes. Axis sizes must multiply to the device count — the same
+"legal quanta" constraint the gang scheduler enforces on hosts
+(`tpu_on_k8s/gang/topology.py`) shows up here on chips.
+
+Axis ordering matters on hardware: ICI bandwidth is highest between
+mesh-adjacent chips, so the axes that carry the chattiest collectives
+(``model``: per-layer all-reduce/all-gather; ``seq``: per-step ppermute) are
+placed innermost, and ``data`` (one gradient reduction per step, may ride DCN
+across slices) outermost. ``create_mesh`` builds the device grid in that order.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+
+#: outermost → innermost; innermost axes map to ICI-nearest chips.
+AXIS_ORDER: Tuple[str, ...] = (AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_MODEL)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. Sizes must multiply to the device count (or use -1
+    on exactly one axis to absorb the remainder)."""
+
+    data: int = 1
+    fsdp: int = -1  # default: all remaining chips do FSDP
+    model: int = 1
+    seq: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        """Replace a single -1 with whatever makes the product n_devices."""
+        sizes = {AXIS_DATA: self.data, AXIS_FSDP: self.fsdp,
+                 AXIS_MODEL: self.model, AXIS_SEQ: self.seq}
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wild}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"cannot fit mesh {sizes} onto {n_devices} devices: "
+                    f"fixed product {fixed} does not divide {n_devices}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices but {n_devices} are available")
+        return MeshConfig(data=sizes[AXIS_DATA], fsdp=sizes[AXIS_FSDP],
+                          model=sizes[AXIS_MODEL], seq=sizes[AXIS_SEQ])
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        by_name = {AXIS_DATA: self.data, AXIS_FSDP: self.fsdp,
+                   AXIS_MODEL: self.model, AXIS_SEQ: self.seq}
+        return tuple(by_name[a] for a in AXIS_ORDER)
+
+
+def create_mesh(config: Optional[MeshConfig] = None,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all of ``jax.devices()``).
+
+    Devices are reshaped in AXIS_ORDER so the ``model``/``seq`` axes land on
+    ICI-adjacent chips. For multi-host / multi-slice runs JAX's device order
+    already groups by slice, so the outer ``data`` axis naturally straddles DCN.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    cfg = (config or MeshConfig()).resolve(len(devs))
+    grid = np.asarray(devs, dtype=object).reshape(cfg.axis_sizes())
+    return Mesh(grid, AXIS_ORDER)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a [batch, ...] input: batch split over every
+    data-parallel-ish axis (data, fsdp); seq axis shards dim 1 when present."""
+    if mesh.shape.get(AXIS_SEQ, 1) > 1:
+        spec = PartitionSpec((AXIS_DATA, AXIS_FSDP), AXIS_SEQ)
+    else:
+        spec = PartitionSpec((AXIS_DATA, AXIS_FSDP))
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,16 @@
+"""Training loop machinery: sharded state, jitted step, checkpoint glue."""
+from tpu_on_k8s.train.trainer import (
+    TrainState,
+    Trainer,
+    cross_entropy_loss,
+    make_sharded_init,
+    make_train_step,
+)
+
+__all__ = [
+    "TrainState",
+    "Trainer",
+    "cross_entropy_loss",
+    "make_sharded_init",
+    "make_train_step",
+]

@@ -1,0 +1,131 @@
+"""Sharded training step: the compute-plane "train()" path.
+
+The whole step — forward, loss, backward, optimizer update — is one jitted
+function over a ``jax.sharding.Mesh``. Gradient reductions across ``data`` /
+``fsdp`` and activation collectives across ``model`` are *not* written here:
+parameter and batch shardings carry the information and XLA's SPMD partitioner
+inserts psum / all-gather / reduce-scatter on ICI (scaling-book recipe).
+
+Optimizer state inherits parameter shardings for free: the partition rules in
+`tpu_on_k8s/parallel/partition.py` use ``re.search`` on the '/'-joined path,
+and optax's Adam moments (``.../mu/<param path>``, ``.../nu/<param path>``)
+contain the parameter path as a suffix — so mu/nu land exactly where their
+parameter lives, and scalars (step counts) fall back to replication.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from tpu_on_k8s.parallel.mesh import batch_sharding
+from tpu_on_k8s.parallel.partition import PartitionRule, named_sharding
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray            # scalar int32
+    params: Any
+    opt_state: Any
+
+
+def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray,
+                       mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Mean next-token CE. logits [B, L, V] fp32; targets [B, L] int."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def default_optimizer(learning_rate: float = 3e-4,
+                      weight_decay: float = 0.1,
+                      warmup_steps: int = 100,
+                      decay_steps: int = 10000,
+                      max_grad_norm: float = 1.0) -> optax.GradientTransformation:
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(decay_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(max_grad_norm),
+        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def make_sharded_init(model: Any, optimizer: optax.GradientTransformation,
+                      mesh: Mesh, rules: Sequence[PartitionRule],
+                      example_tokens: jnp.ndarray) -> Callable[[jax.Array], TrainState]:
+    """Returns init(rng) → TrainState materialised *directly sharded* on the
+    mesh (out_shardings on the jitted initializer — no host-side full copy)."""
+
+    def init(rng: jax.Array) -> TrainState:
+        params = model.init(rng, example_tokens)["params"]
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=optimizer.init(params))
+
+    abstract = jax.eval_shape(init, jax.random.key(0))
+    # named_sharding also validates divisibility: a bad rule fails loudly
+    # here at setup, not as an XLA error inside the jitted init.
+    shardings = named_sharding(abstract, mesh, rules)
+    return jax.jit(init, out_shardings=shardings)
+
+
+def make_train_step(model: Any, optimizer: optax.GradientTransformation,
+                    ) -> Callable[[TrainState, jnp.ndarray], Tuple[TrainState, dict]]:
+    """One language-model train step on a [B, L] token batch (next-token CE,
+    internal shift). Donates the state buffers. jit shardings propagate from
+    the inputs, so the same compiled step serves any mesh."""
+
+    def loss_fn(params: Any, tokens: jnp.ndarray) -> jnp.ndarray:
+        logits = model.apply({"params": params}, tokens[:, :-1])
+        return cross_entropy_loss(logits, tokens[:, 1:])
+
+    def step(state: TrainState, tokens: jnp.ndarray) -> Tuple[TrainState, dict]:
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss,
+                   "grad_norm": optax.global_norm(grads),
+                   "step": state.step}
+        return TrainState(step=state.step + 1, params=params,
+                          opt_state=opt_state), metrics
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+class Trainer:
+    """Convenience wrapper tying model, optimizer, mesh and rules together.
+
+    The orchestration plane launches one Trainer per slice host; all hosts
+    execute the same jitted step (SPMD), with jax.distributed initialisation
+    handled by the pod env the TPUJob reconciler injected
+    (`tpu_on_k8s/controller/tpujob.py`).
+    """
+
+    def __init__(self, model: Any, rules: Sequence[PartitionRule],
+                 mesh: Mesh,
+                 optimizer: Optional[optax.GradientTransformation] = None):
+        self.model = model
+        self.rules = list(rules)
+        self.mesh = mesh
+        self.optimizer = optimizer or default_optimizer()
+        self._step = make_train_step(self.model, self.optimizer)
+        self._init_cache = {}
+
+    def init_state(self, rng: jax.Array, example_tokens: jnp.ndarray) -> TrainState:
+        key = (example_tokens.shape, str(example_tokens.dtype))
+        if key not in self._init_cache:
+            self._init_cache[key] = make_sharded_init(
+                self.model, self.optimizer, self.mesh, self.rules,
+                example_tokens)
+        return self._init_cache[key](rng)
+
+    def shard_batch(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        return jax.device_put(tokens, batch_sharding(self.mesh))
+
+    def train_step(self, state: TrainState, tokens: jnp.ndarray):
+        return self._step(state, tokens)
