@@ -1,0 +1,195 @@
+"""Gang scheduler tests: podgroup shapes, slice-atomic MinMember, admission.
+
+Covers SURVEY §2.8: per-role vs job-wide podgroups, MinMember = slice host
+count for workers, MinResources scaling under MinAvailable override (the
+reference's own TODO at volcano.go:223-227), pod binding, AIMaster exemption,
+and gang-complete atomic admission.
+"""
+import pytest
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from tpu_on_k8s.api.types import (
+    SchedulingPolicy,
+    RunPolicy,
+    TaskSpec,
+    TaskType,
+    TPUJob,
+    TPUJobSpec,
+    TPUPolicy,
+)
+from tpu_on_k8s.client import InMemoryCluster, KubeletSim
+from tpu_on_k8s.controller.runtime import Manager
+from tpu_on_k8s.controller.tpujob import setup_tpujob_controller, submit_job
+from tpu_on_k8s.gang.scheduler import (
+    GANG_SCHEDULER_NAME,
+    GangRegistry,
+    PodGroup,
+    SliceGangAdmission,
+    SliceGangScheduler,
+    default_registry,
+    podgroup_name,
+)
+
+
+def make_job(workers=8, master=True, topology="4x8", queue="", min_available=None,
+             min_members=None, name="gj", cpu=1.0):
+    template = PodTemplateSpec(spec=PodSpec(containers=[
+        Container(name="tpu", image="img:1",
+                  resources=ResourceRequirements(requests={"cpu": cpu}))]))
+    tasks = {}
+    if master:
+        tasks[TaskType.MASTER] = TaskSpec(num_tasks=1, template=template)
+    tasks[TaskType.WORKER] = TaskSpec(num_tasks=workers, template=template)
+    policy = SchedulingPolicy(queue=queue, min_available=min_available,
+                              min_members=min_members or {})
+    job = TPUJob(
+        metadata=ObjectMeta(name=name, uid="uid-12345"),
+        spec=TPUJobSpec(
+            tasks=tasks,
+            run_policy=RunPolicy(scheduling_policy=policy),
+            tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice", topology=topology),
+        ),
+    )
+    return job
+
+
+class TestPodGroupShapes:
+    def test_per_role_worker_minmember_is_slice_host_count(self):
+        cluster = InMemoryCluster()
+        gs = SliceGangScheduler(cluster, per_role=True)
+        job = make_job(workers=8, topology="4x8")  # 32 chips / 4 per host = 8 hosts
+        gs.create_podgroups(job)
+        pg = cluster.get(PodGroup, "default", podgroup_name(job, TaskType.WORKER))
+        assert pg.spec.min_member == 8
+        assert pg.spec.min_resources == {"cpu": 8.0}
+        master_pg = cluster.get(PodGroup, "default", podgroup_name(job, TaskType.MASTER))
+        assert master_pg.spec.min_member == 1
+
+    def test_worker_minmember_never_below_slice_quorum(self):
+        # A user MinMembers override below the slice host count is raised to it:
+        # a partial TPU slice cannot initialize ICI.
+        cluster = InMemoryCluster()
+        gs = SliceGangScheduler(cluster, per_role=True)
+        job = make_job(workers=8, topology="4x8",
+                       min_members={TaskType.WORKER: 2})
+        gs.create_podgroups(job)
+        pg = cluster.get(PodGroup, "default", podgroup_name(job, TaskType.WORKER))
+        assert pg.spec.min_member == 8
+
+    def test_job_wide_group_excludes_aimaster_and_scales_minresources(self):
+        cluster = InMemoryCluster()
+        gs = SliceGangScheduler(cluster, per_role=False)
+        job = make_job(workers=4, topology="2x4")
+        job.spec.tasks[TaskType.AIMASTER] = TaskSpec(
+            num_tasks=1, template=job.spec.tasks[TaskType.WORKER].template)
+        job.spec.run_policy.scheduling_policy.min_available = 3
+        gs.create_podgroups(job)
+        pg = cluster.get(PodGroup, "default", podgroup_name(job))
+        assert pg.spec.min_member == 3  # master + 4 workers = 5, overridden to 3
+        # MinResources scaled 3/5 of total 5 cpu (fixes volcano.go:223-227 TODO)
+        assert pg.spec.min_resources == {"cpu": pytest.approx(3.0)}
+
+    def test_update_on_rescale(self):
+        cluster = InMemoryCluster()
+        gs = SliceGangScheduler(cluster, per_role=True)
+        job = make_job(workers=2, topology="2x4")
+        gs.create_podgroups(job)
+        job.spec.tasks[TaskType.WORKER].num_tasks = 8
+        job.spec.tpu_policy.topology = "4x8"
+        gs.create_podgroups(job)
+        pg = cluster.get(PodGroup, "default", podgroup_name(job, TaskType.WORKER))
+        assert pg.spec.min_member == 8
+
+    def test_queue_and_priority_propagate(self):
+        cluster = InMemoryCluster()
+        gs = SliceGangScheduler(cluster, per_role=True)
+        job = make_job(queue="tenant-a")
+        job.spec.run_policy.scheduling_policy.priority_class_name = "high"
+        gs.create_podgroups(job)
+        pg = cluster.get(PodGroup, "default", podgroup_name(job, TaskType.WORKER))
+        assert pg.spec.queue == "tenant-a"
+        assert pg.spec.priority_class_name == "high"
+
+
+class TestBinding:
+    def test_bind_sets_annotation_and_scheduler(self):
+        cluster = InMemoryCluster()
+        gs = SliceGangScheduler(cluster, per_role=True)
+        job = make_job()
+        pod = Pod(metadata=ObjectMeta(name="p"), spec=PodSpec())
+        gs.bind_pod(job, pod, TaskType.WORKER)
+        assert pod.metadata.annotations[constants.ANNOTATION_GANG_GROUP_NAME] == \
+            podgroup_name(job, TaskType.WORKER)
+        assert pod.spec.scheduler_name == GANG_SCHEDULER_NAME
+
+    def test_aimaster_stays_on_default_scheduler(self):
+        cluster = InMemoryCluster()
+        gs = SliceGangScheduler(cluster, per_role=True)
+        job = make_job()
+        pod = Pod(metadata=ObjectMeta(name="p"), spec=PodSpec())
+        gs.bind_pod(job, pod, TaskType.AIMASTER)
+        assert constants.ANNOTATION_GANG_GROUP_NAME not in pod.metadata.annotations
+        assert pod.spec.scheduler_name == ""
+
+
+class TestAdmission:
+    def test_gang_admits_only_when_complete(self):
+        cluster = InMemoryCluster()
+        gs = SliceGangScheduler(cluster, per_role=True)
+        admission = SliceGangAdmission(cluster)
+        job = make_job(workers=4, topology="2x4", master=False)
+        gs.create_podgroups(job)
+        group = podgroup_name(job, TaskType.WORKER)
+        for i in range(3):  # partial gang: 3 of 4
+            cluster.create(Pod(metadata=ObjectMeta(
+                name=f"gj-worker-{i}",
+                annotations={constants.ANNOTATION_GANG_GROUP_NAME: group})))
+        assert admission.sync() == []
+        cluster.create(Pod(metadata=ObjectMeta(
+            name="gj-worker-3",
+            annotations={constants.ANNOTATION_GANG_GROUP_NAME: group})))
+        assert admission.sync() == [group]
+        pg = cluster.get(PodGroup, "default", group)
+        assert pg.status.phase == "Running"
+        # every gang member got a node, atomically in one pass
+        for pod in cluster.list(Pod, "default"):
+            assert pod.spec.node_name
+
+
+class TestRegistry:
+    def test_register_get(self):
+        cluster = InMemoryCluster()
+        reg = default_registry(cluster)
+        assert reg.get(GANG_SCHEDULER_NAME).name() == GANG_SCHEDULER_NAME
+        with pytest.raises(KeyError):
+            reg.get("volcano")
+
+
+class TestEngineIntegration:
+    def test_one_reconcile_pass_produces_whole_gang(self):
+        """North-star criterion (BASELINE.md): one reconcile pass creates the
+        full gang; one admission pass flips it."""
+        cluster = InMemoryCluster()
+        manager = Manager()
+        gs = SliceGangScheduler(cluster, per_role=True)
+        setup_tpujob_controller(cluster, manager, gang_scheduler=gs)
+        job = make_job(workers=8, topology="4x8", master=False, name="gang1")
+        job.metadata.uid = ""
+        submit_job(cluster, job)
+        manager.run_until_idle()
+        pods = cluster.list(Pod, "default", {constants.LABEL_JOB_NAME: "gang1"})
+        assert len(pods) == 8
+        stored_job = cluster.get(TPUJob, "default", "gang1")
+        group = podgroup_name(stored_job, TaskType.WORKER)
+        assert all(p.metadata.annotations.get(constants.ANNOTATION_GANG_GROUP_NAME)
+                   == group for p in pods)
+        admission = SliceGangAdmission(cluster)
+        assert admission.sync() == [group]

@@ -4,6 +4,15 @@ Analog of /root/reference/pkg/gangscheduler/ with the TPU-specific twist that
 PodGroup MinMember derives from slice host count (``tpu_on_k8s.gang.topology``).
 """
 
+from tpu_on_k8s.gang.scheduler import (
+    GANG_SCHEDULER_NAME,
+    GangRegistry,
+    PodGroup,
+    SliceGangAdmission,
+    SliceGangScheduler,
+    default_registry,
+    podgroup_name,
+)
 from tpu_on_k8s.gang.topology import (
     SliceShape,
     chips_in_topology,
