@@ -1,0 +1,285 @@
+"""Coordinator tests: tenant queues, WRR fairness, quota gating, priority.
+
+Covers SURVEY §2.7: enqueue/dequeue lifecycle with Queuing condition marks,
+smooth-WRR proportional selection, quota filter with assumed reservations +
+TTL expiry, priority scoring (policy value and PriorityClass fallback), and
+the end-to-end held-then-released reconcile path through the TPUJob
+controller.
+"""
+import itertools
+
+import pytest
+
+from tpu_on_k8s.api.core import (
+    Container,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+    PriorityClass,
+    ResourceQuota,
+    ResourceQuotaSpec,
+    ResourceRequirements,
+    Pod,
+)
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.types import (
+    JobConditionType,
+    SchedulingPolicy,
+    RunPolicy,
+    TaskSpec,
+    TaskType,
+    TPUJob,
+    TPUJobSpec,
+    TPUPolicy,
+)
+from tpu_on_k8s.client import InMemoryCluster
+from tpu_on_k8s.coordinator import (
+    Coordinator,
+    PluginConfig,
+    QueueUnit,
+    SmoothWeightedRoundRobinSelector,
+    RoundRobinSelector,
+)
+from tpu_on_k8s.coordinator.queue import Queue
+from tpu_on_k8s.controller.runtime import Manager
+from tpu_on_k8s.controller.tpujob import setup_tpujob_controller, submit_job
+from tpu_on_k8s.utils import conditions
+
+
+class FakeOwner:
+    def __init__(self):
+        self.requests = []
+
+    def enqueue(self, ns, name):
+        self.requests.append((ns, name))
+
+
+def make_job(name, ns="default", queue="", priority=None, priority_class="",
+             workers=2, cpu=1.0, uid=None):
+    policy = SchedulingPolicy(queue=queue, priority=priority,
+                              priority_class_name=priority_class)
+    template = PodTemplateSpec(spec=PodSpec(containers=[
+        Container(name="tpu", image="i",
+                  resources=ResourceRequirements(requests={"cpu": cpu}))]))
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace=ns, uid=uid or f"uid-{name}"),
+        spec=TPUJobSpec(
+            tasks={TaskType.WORKER: TaskSpec(num_tasks=workers, template=template)},
+            run_policy=RunPolicy(scheduling_policy=policy),
+            tpu_policy=TPUPolicy(topology="2x4"),
+        ),
+    )
+
+
+def coordinator_env(clock=None):
+    cluster = InMemoryCluster()
+    kwargs = {}
+    if clock is not None:
+        kwargs["clock"] = clock
+    plugins = PluginConfig.default(cluster, **kwargs)
+    co = Coordinator(cluster, plugins=plugins)
+    return cluster, co, plugins
+
+
+class TestQueueLifecycle:
+    def test_enqueue_marks_queuing_and_dequeue_clears(self):
+        cluster, co, _ = coordinator_env()
+        owner = FakeOwner()
+        job = cluster.create(make_job("a"))
+        co.enqueue_or_update(job, owner)
+        assert co.is_queuing(job.metadata.uid)
+        stored = cluster.get(TPUJob, "default", "a")
+        assert conditions.is_queuing(stored.status)
+
+        key = co.schedule_once()
+        assert key == "default/a"
+        assert not co.is_queuing(job.metadata.uid)
+        assert owner.requests == [("default", "a")]
+        stored = cluster.get(TPUJob, "default", "a")
+        assert not conditions.is_queuing(stored.status)
+
+    def test_tenant_from_scheduling_queue_else_namespace(self):
+        cluster, co, plugins = coordinator_env()
+        unit = QueueUnit.from_job(make_job("a", queue="tenant-x"))
+        assert plugins.tenant.tenant_name(unit) == "tenant-x"
+        unit2 = QueueUnit.from_job(make_job("b", ns="team-ns"))
+        assert plugins.tenant.tenant_name(unit2) == "team-ns"
+
+    def test_requeue_moves_between_tenants(self):
+        cluster, co, _ = coordinator_env()
+        owner = FakeOwner()
+        job = cluster.create(make_job("a", queue="q1"))
+        co.enqueue_or_update(job, owner)
+        job = cluster.get(TPUJob, "default", "a")
+        job.spec.run_policy.scheduling_policy.queue = "q2"
+        co.enqueue_or_update(job, owner)
+        assert co.queued_count() == 1
+
+    def test_delete_dequeues(self):
+        cluster, co, _ = coordinator_env()
+        job = cluster.create(make_job("a"))
+        co.enqueue_or_update(job, FakeOwner())
+        co.dequeue(job, reason="deleted")
+        assert co.queued_count() == 0
+        assert co.schedule_once() is None
+
+    def test_stale_unit_skipped_when_job_vanishes(self):
+        cluster, co, _ = coordinator_env()
+        job = cluster.create(make_job("a"))
+        co.enqueue_or_update(job, FakeOwner())
+        cluster.delete(TPUJob, "default", "a")
+        assert co.schedule_once() is None
+        assert co.queued_count() == 0
+
+
+class TestWRR:
+    def test_smooth_wrr_proportional(self):
+        # Queue A has 5 pending tasks, B has 1: picks should interleave ~5:1.
+        qa, qb = Queue("a"), Queue("b")
+        for i in range(5):
+            qa.add_or_update(QueueUnit.from_job(make_job(f"a{i}", workers=1)))
+        qb.add_or_update(QueueUnit.from_job(make_job("b0", workers=1)))
+        sel = SmoothWeightedRoundRobinSelector()
+        picks = [sel.next([qa, qb]).name for _ in range(6)]
+        assert picks.count("a") == 5
+        assert picks.count("b") == 1
+        # smoothness: b's slot is interior, not a trailing burst
+        assert "b" in picks[1:-1] or picks[0] == "b"
+
+    def test_rr_rotates(self):
+        qa, qb = Queue("a"), Queue("b")
+        qa.add_or_update(QueueUnit.from_job(make_job("a0")))
+        qb.add_or_update(QueueUnit.from_job(make_job("b0")))
+        sel = RoundRobinSelector()
+        picks = [sel.next([qa, qb]).name for _ in range(4)]
+        assert picks == ["a", "b", "a", "b"]
+
+    def test_empty_queues_skipped(self):
+        sel = SmoothWeightedRoundRobinSelector()
+        assert sel.next([Queue("a")]) is None
+
+
+class TestQuota:
+    def test_quota_wait_until_capacity(self):
+        clock = itertools.count()
+        cluster, co, plugins = coordinator_env(clock=lambda: 0.0)
+        cluster.create(ResourceQuota(
+            metadata=ObjectMeta(name="rq", namespace="default"),
+            spec=ResourceQuotaSpec(hard={"cpu": 3.0})))
+        owner = FakeOwner()
+        big = cluster.create(make_job("big", workers=4, cpu=1.0))  # needs 4 cpu
+        co.enqueue_or_update(big, owner)
+        assert co.schedule_once() is None  # blocked by quota
+        small = cluster.create(make_job("small", workers=2, cpu=1.0))
+        co.enqueue_or_update(small, owner)
+        assert co.schedule_once() == "default/small"
+
+    def test_assumed_quota_blocks_second_dequeue(self):
+        cluster, co, plugins = coordinator_env(clock=lambda: 0.0)
+        cluster.create(ResourceQuota(
+            metadata=ObjectMeta(name="rq", namespace="default"),
+            spec=ResourceQuotaSpec(hard={"cpu": 2.0})))
+        owner = FakeOwner()
+        for n in ("j1", "j2"):
+            job = cluster.create(make_job(n, workers=2, cpu=1.0))
+            co.enqueue_or_update(job, owner)
+        assert co.schedule_once() is not None
+        # Second job would fit raw quota but the first holds an assumed
+        # reservation of 2 cpu.
+        assert co.schedule_once() is None
+        assert plugins.filters[0].assumed_count() == 1
+
+    def test_assumed_quota_ttl_expiry(self):
+        now = [0.0]
+        cluster, co, plugins = coordinator_env(clock=lambda: now[0])
+        cluster.create(ResourceQuota(
+            metadata=ObjectMeta(name="rq", namespace="default"),
+            spec=ResourceQuotaSpec(hard={"cpu": 2.0})))
+        owner = FakeOwner()
+        for n in ("j1", "j2"):
+            job = cluster.create(make_job(n, workers=2, cpu=1.0))
+            co.enqueue_or_update(job, owner)
+        assert co.schedule_once() is not None
+        assert co.schedule_once() is None
+        now[0] = 61.0  # past the 60s TTL (quota.go:48)
+        assert co.schedule_once() is not None
+
+    def test_release_on_leaving_queued_state(self):
+        cluster, co, plugins = coordinator_env(clock=lambda: 0.0)
+        cluster.create(ResourceQuota(
+            metadata=ObjectMeta(name="rq", namespace="default"),
+            spec=ResourceQuotaSpec(hard={"cpu": 2.0})))
+        owner = FakeOwner()
+        j1 = cluster.create(make_job("j1", workers=2, cpu=1.0))
+        co.enqueue_or_update(j1, owner)
+        assert co.schedule_once() is not None
+        j1 = cluster.get(TPUJob, "default", "j1")
+        conditions.update_job_conditions(j1.status, JobConditionType.RUNNING, "r", "")
+        co.observe_job_left_queued_state(j1)
+        assert plugins.filters[0].assumed_count() == 0
+
+    def test_no_quota_means_unlimited(self):
+        cluster, co, _ = coordinator_env()
+        job = cluster.create(make_job("a", workers=100, cpu=8.0))
+        co.enqueue_or_update(job, FakeOwner())
+        assert co.schedule_once() == "default/a"
+
+
+class TestPriority:
+    def test_policy_priority_wins(self):
+        cluster, co, _ = coordinator_env()
+        owner = FakeOwner()
+        lo = cluster.create(make_job("lo", priority=1))
+        hi = cluster.create(make_job("hi", priority=10))
+        co.enqueue_or_update(lo, owner)
+        co.enqueue_or_update(hi, owner)
+        assert co.schedule_once() == "default/hi"
+        assert co.schedule_once() == "default/lo"
+
+    def test_priority_class_fallback(self):
+        cluster, co, _ = coordinator_env()
+        cluster.create(PriorityClass(
+            metadata=ObjectMeta(name="gold", namespace=""), value=100))
+        owner = FakeOwner()
+        plain = cluster.create(make_job("plain"))
+        gold = cluster.create(make_job("gold-job", priority_class="gold"))
+        co.enqueue_or_update(plain, owner)
+        co.enqueue_or_update(gold, owner)
+        assert co.schedule_once() == "default/gold-job"
+
+
+class TestControllerIntegration:
+    def test_job_held_until_coordinator_dequeues(self):
+        cluster = InMemoryCluster()
+        manager = Manager()
+        co = Coordinator(cluster)
+        setup_tpujob_controller(cluster, manager, coordinator=co)
+        job = make_job("held", workers=2, uid=None)
+        job.metadata.uid = ""
+        submit_job(cluster, job)
+        manager.run_until_idle()
+        # Held: no pods until the coordinator runs a cycle.
+        assert cluster.list(Pod, "default") == []
+        assert co.drain() == 1
+        manager.run_until_idle()
+        pods = cluster.list(Pod, "default",
+                            {constants.LABEL_JOB_NAME: "held"})
+        assert len(pods) == 2
+
+    def test_quota_starved_job_stays_queued(self):
+        cluster = InMemoryCluster()
+        manager = Manager()
+        co = Coordinator(cluster)
+        setup_tpujob_controller(cluster, manager, coordinator=co)
+        cluster.create(ResourceQuota(
+            metadata=ObjectMeta(name="rq", namespace="default"),
+            spec=ResourceQuotaSpec(hard={"cpu": 1.0})))
+        job = make_job("starved", workers=4, cpu=1.0)
+        job.metadata.uid = ""
+        submit_job(cluster, job)
+        manager.run_until_idle()
+        assert co.drain() == 0
+        manager.run_until_idle()
+        assert cluster.list(Pod, "default") == []
+        stored = cluster.get(TPUJob, "default", "starved")
+        assert conditions.is_queuing(stored.status)

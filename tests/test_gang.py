@@ -84,6 +84,57 @@ class TestPodGroupShapes:
         pg = cluster.get(PodGroup, "default", podgroup_name(job, TaskType.WORKER))
         assert pg.spec.min_member == 8
 
+    def test_per_role_skips_aimaster_group(self):
+        # bind_pod exempts AIMaster, so a per-role AIMaster group would be a
+        # forever-Pending orphan — none must be created.
+        cluster = InMemoryCluster()
+        gs = SliceGangScheduler(cluster, per_role=True)
+        job = make_job(workers=8, topology="4x8")
+        job.spec.tasks[TaskType.AIMASTER] = TaskSpec(
+            num_tasks=1, template=job.spec.tasks[TaskType.WORKER].template)
+        gs.create_podgroups(job)
+        names = {pg.metadata.name for pg in cluster.list(PodGroup, "default")}
+        assert podgroup_name(job, TaskType.AIMASTER) not in names
+        assert podgroup_name(job, TaskType.WORKER) in names
+
+    def test_multislice_quorum_covers_all_slices(self):
+        cluster = InMemoryCluster()
+        gs = SliceGangScheduler(cluster, per_role=True)
+        job = make_job(workers=16, topology="4x8")  # 8 hosts/slice
+        job.spec.tpu_policy.num_slices = 2
+        job.spec.run_policy.scheduling_policy.min_members = {TaskType.WORKER: 8}
+        gs.create_podgroups(job)
+        pg = cluster.get(PodGroup, "default", podgroup_name(job, TaskType.WORKER))
+        # user override below the 2-slice quorum (16) is raised to it
+        assert pg.spec.min_member == 16
+
+    def test_infeasible_gang_fails_job(self):
+        from tpu_on_k8s.api.types import JobConditionType
+        from tpu_on_k8s.utils import conditions as cond
+
+        cluster = InMemoryCluster()
+        manager = Manager()
+        gs = SliceGangScheduler(cluster, per_role=True)
+        setup_tpujob_controller(cluster, manager, gang_scheduler=gs)
+        job = make_job(workers=4, topology="4x8", master=False, name="short")
+        job.metadata.uid = ""
+        submit_job(cluster, job)
+        manager.run_until_idle()
+        stored = cluster.get(TPUJob, "default", "short")
+        assert cond.is_failed(stored.status)
+        failed = cond.get_condition(stored.status, JobConditionType.FAILED)
+        assert failed.reason == "InvalidTPUPolicy"
+
+    def test_queue_change_syncs_to_existing_podgroup(self):
+        cluster = InMemoryCluster()
+        gs = SliceGangScheduler(cluster, per_role=True)
+        job = make_job(queue="")
+        gs.create_podgroups(job)
+        job.spec.run_policy.scheduling_policy.queue = "tenant-b"
+        gs.create_podgroups(job)
+        pg = cluster.get(PodGroup, "default", podgroup_name(job, TaskType.WORKER))
+        assert pg.spec.queue == "tenant-b"
+
     def test_job_wide_group_excludes_aimaster_and_scales_minresources(self):
         cluster = InMemoryCluster()
         gs = SliceGangScheduler(cluster, per_role=False)

@@ -260,5 +260,38 @@ class Service:
     spec: ServiceSpec = field(default_factory=ServiceSpec)
 
 
+@dataclass
+class ResourceQuotaSpec:
+    hard: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuotaStatus:
+    used: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuota:
+    """Namespace resource budget — the quota surface the coordinator's quota
+    plugin sums (reference plugins/quota.go:97-131)."""
+
+    api_version: str = "v1"
+    kind: str = "ResourceQuota"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceQuotaSpec = field(default_factory=ResourceQuotaSpec)
+    status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+
+
+@dataclass
+class PriorityClass:
+    """Priority class value source for the coordinator's priority plugin
+    (reference plugins/priority.go:74-87)."""
+
+    api_version: str = "scheduling.k8s.io/v1"
+    kind: str = "PriorityClass"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+
+
 def deep_copy(obj):
     return serde.deep_copy(obj)

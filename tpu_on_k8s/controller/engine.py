@@ -197,6 +197,12 @@ class JobEngine:
 
             tpu_topology.validate_slice(job.spec.tpu_policy.accelerator,
                                         job.spec.tpu_policy.topology)
+            if self.gang is not None and self.config.enable_gang_scheduling:
+                # A worker group smaller than the slice quorum could never be
+                # gang-admitted — fail loudly instead of pending forever.
+                from tpu_on_k8s.gang.scheduler import validate_gang_feasibility
+
+                validate_gang_feasibility(job)
         except (KeyError, ValueError) as e:
             return self._fail_job(job, pods, services, "InvalidTPUPolicy", str(e))
 

@@ -318,6 +318,10 @@ def setup_tpujob_controller(
                 if use_coordinator and coordinator.is_queuing(event.obj.metadata.uid):
                     coordinator.enqueue_or_update(event.obj, controller)
                     return
+                if use_coordinator:
+                    # Quota reservations drop once the job's usage is real
+                    # (reference quota.go:256-277 assumed-quota expiry).
+                    coordinator.observe_job_left_queued_state(event.obj)
                 controller.enqueue(ns, name)
             elif event.type == "DELETED":
                 engine.forget_job(f"{ns}/{name}")

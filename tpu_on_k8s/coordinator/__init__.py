@@ -1,0 +1,23 @@
+"""Job coordinator (L4): multi-tenant queueing + plugin-driven admission.
+
+Analog of /root/reference/pkg/coordinator/ (SURVEY §2.7). On TPU, tenant queues
+double as the multi-slice coordination surface: each queue maps to a slice pool
+and the smooth-WRR selector apportions dequeues across pools (BASELINE.md's
+"two WRR-coordinated queues on multi-slice v5e").
+"""
+
+from tpu_on_k8s.coordinator.core import (
+    DEFAULT_SCHEDULING_PERIOD_SECONDS,
+    Coordinator,
+)
+from tpu_on_k8s.coordinator.plugins import (
+    PluginConfig,
+    PriorityPlugin,
+    QuotaPlugin,
+)
+from tpu_on_k8s.coordinator.policy import (
+    RoundRobinSelector,
+    SmoothWeightedRoundRobinSelector,
+)
+from tpu_on_k8s.coordinator.queue import Queue
+from tpu_on_k8s.coordinator.types import Code, QueueUnit, Status

@@ -1,0 +1,76 @@
+"""Queue-selection policies: round-robin and smooth weighted round-robin.
+
+Analog of /root/reference/pkg/coordinator/core/policy.go — RoundRobin (:31-76)
+and WeightedRoundRobin (:80-230, the classic nginx gcd/maxWeight scan). Two
+deliberate upgrades over the reference:
+
+* WRR is actually wired in as the default (the reference built it but left
+  plain RR in the ctor — coordinator.go:62, SURVEY §2.7 note);
+* the weighted variant is *smooth* WRR (the reference's own TODO at
+  policy.go:232): each pick adds weight to a running current-weight and picks
+  the max, so a {5,1,1} weighting yields a-b-a-a-c-a-a instead of bursts.
+
+Weight = total pending task count in the queue (calculateQueueWeight,
+policy.go:224-230), recomputed every pick so weights track queue churn.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Protocol
+
+from tpu_on_k8s.coordinator.queue import Queue
+
+
+class QueueSelector(Protocol):
+    def next(self, queues: List[Queue]) -> Optional[Queue]: ...
+
+
+class RoundRobinSelector:
+    """Plain RR over queue names (policy.go:31-76)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._last: Optional[str] = None
+
+    def next(self, queues: List[Queue]) -> Optional[Queue]:
+        candidates = [q for q in queues if len(q) > 0]
+        if not candidates:
+            return None
+        candidates.sort(key=lambda q: q.name)
+        with self._lock:
+            names = [q.name for q in candidates]
+            if self._last is None or self._last not in names:
+                pick = candidates[0]
+            else:
+                pick = candidates[(names.index(self._last) + 1) % len(candidates)]
+            self._last = pick.name
+            return pick
+
+
+class SmoothWeightedRoundRobinSelector:
+    """Smooth WRR (nginx algorithm): current[i] += weight[i]; pick max;
+    current[pick] -= total. Weight = pending task count, floored at 1 so a
+    queue of zero-task units still drains."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._current: Dict[str, float] = {}
+
+    def next(self, queues: List[Queue]) -> Optional[Queue]:
+        candidates = [q for q in queues if len(q) > 0]
+        if not candidates:
+            return None
+        candidates.sort(key=lambda q: q.name)
+        with self._lock:
+            weights = {q.name: max(q.total_tasks(), 1) for q in candidates}
+            total = sum(weights.values())
+            # Drop state for vanished queues so their debt doesn't linger.
+            self._current = {n: v for n, v in self._current.items() if n in weights}
+            best: Optional[Queue] = None
+            for q in candidates:
+                cur = self._current.get(q.name, 0.0) + weights[q.name]
+                self._current[q.name] = cur
+                if best is None or cur > self._current[best.name]:
+                    best = q
+            self._current[best.name] -= total
+            return best

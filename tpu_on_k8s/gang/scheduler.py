@@ -64,6 +64,30 @@ class PodGroup:
     status: PodGroupStatus = field(default_factory=PodGroupStatus)
 
 
+def slice_quorum(job: TPUJob) -> int:
+    """Full slice host complement: hosts_per_slice × num_slices. The atomic
+    admission unit for the job's worker gang."""
+    tpu = job.spec.tpu_policy
+    return topology.hosts_per_slice(tpu.accelerator, tpu.topology) * max(tpu.num_slices, 1)
+
+
+def validate_gang_feasibility(job: TPUJob) -> None:
+    """Reject statically-deadlocked gangs: a worker group smaller than the
+    slice quorum can never be admitted (fewer pods will ever exist than
+    min_member requires), so surface it as a job failure instead of a
+    silently forever-Pending podgroup."""
+    task = job.spec.tasks.get(TaskType.WORKER)
+    if task is None:
+        return
+    quorum = slice_quorum(job)
+    if task.num_tasks < quorum:
+        raise ValueError(
+            f"worker num_tasks={task.num_tasks} is below the slice quorum "
+            f"{quorum} (hosts_per_slice × num_slices for "
+            f"{job.spec.tpu_policy.accelerator}/{job.spec.tpu_policy.topology} "
+            f"× {job.spec.tpu_policy.num_slices}); the gang could never admit")
+
+
 def podgroup_name(job: TPUJob, task_type: Optional[TaskType] = None) -> str:
     """Job-wide ``{name}-{uid5}`` / per-role ``{name}-{role}-{uid5}``
     (volcano.go name scheme)."""
@@ -95,18 +119,17 @@ class SliceGangScheduler:
             uid=job.metadata.uid, controller=True, block_owner_deletion=True)
 
     def _min_member_for_task(self, job: TPUJob, task_type: TaskType) -> int:
-        """Per-role gang quorum. Worker groups are slice-atomic: quorum is the
-        full slice host complement (hosts_per_slice × num_slices) even if a user
-        MinMembers override asks for less — a partial slice cannot initialize
-        its ICI mesh. Other roles honor user MinMembers (volcano.go:127-131)."""
+        """Per-role gang quorum. Worker groups are slice-atomic: quorum is
+        never below the full slice host complement (hosts_per_slice ×
+        num_slices) even if a user MinMembers override asks for less — a
+        partial slice cannot initialize its ICI mesh. A user override may only
+        raise it. Other roles honor user MinMembers (volcano.go:127-131)."""
         task = job.spec.tasks[task_type]
         policy = self._scheduling_policy(job)
         user_min = policy.min_members.get(task_type)
         if task_type is TaskType.WORKER:
-            tpu = job.spec.tpu_policy
-            slice_hosts = topology.hosts_per_slice(tpu.accelerator, tpu.topology)
-            return max(task.num_tasks, slice_hosts * max(tpu.num_slices, 1)) \
-                if user_min is None else max(user_min, slice_hosts)
+            quorum = slice_quorum(job)
+            return max(user_min if user_min is not None else task.num_tasks, quorum)
         if user_min is not None:
             return min(user_min, task.num_tasks) if task.num_tasks else user_min
         return task.num_tasks
@@ -118,6 +141,11 @@ class SliceGangScheduler:
         policy = self._scheduling_policy(job)
         if self.per_role:
             for task_type, task in job.spec.tasks.items():
+                if task_type is TaskType.AIMASTER:
+                    # AIMaster never binds to a gang (bind_pod exempts it) —
+                    # creating a group for it would orphan a forever-Pending
+                    # podgroup (reference skips it too, volcano.go:116-117).
+                    continue
                 min_member = self._min_member_for_task(job, task_type)
                 # MinResources scaled to min_member (fixes volcano.go:223-227):
                 per_pod = resmath.pod_requests(task.template.spec)
@@ -148,11 +176,9 @@ class SliceGangScheduler:
     def _ensure(self, job: TPUJob, name: str, spec: PodGroupSpec) -> None:
         existing = self.cluster.try_get(PodGroup, job.metadata.namespace, name)
         if existing is not None:
-            if existing.spec.min_member != spec.min_member or \
-               existing.spec.min_resources != spec.min_resources:
+            if existing.spec != spec:
                 def mutate(pg: PodGroup) -> None:
-                    pg.spec.min_member = spec.min_member
-                    pg.spec.min_resources = spec.min_resources
+                    pg.spec = spec
                 try:
                     self.cluster.update_with_retry(
                         PodGroup, job.metadata.namespace, name, mutate)
