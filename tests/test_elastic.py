@@ -1,0 +1,272 @@
+"""Elastic generation/checkpoint protocol tests (SURVEY §3.3).
+
+The multi-actor state machine: preemption creates victims → controller
+requests a checkpoint → (simulated) AIMaster completes it → victims drain,
+the job re-specs to surviving slice-legal capacity (generation bump) → stale
+pods get world-size patch + in-place restart → scale transaction completes.
+"""
+import pytest
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import Container, ObjectMeta, Pod, PodSpec, PodTemplateSpec
+from tpu_on_k8s.api.types import (
+    ElasticPolicy,
+    TaskSpec,
+    TaskType,
+    TPUJob,
+    TPUJobSpec,
+    TPUPolicy,
+)
+from tpu_on_k8s.client import InMemoryCluster, KubeletSim
+from tpu_on_k8s.controller.elastic import ElasticController, apply_host_count
+from tpu_on_k8s.controller.failover import InMemoryRestarter
+from tpu_on_k8s.controller.runtime import Manager
+from tpu_on_k8s.controller.tpujob import setup_tpujob_controller, submit_job
+
+
+def elastic_job(workers=8, topology="4x8", name="ej", min_replicas=2, max_replicas=16):
+    template = PodTemplateSpec(spec=PodSpec(containers=[Container(name="tpu", image="i")]))
+    return TPUJob(
+        metadata=ObjectMeta(
+            name=name,
+            annotations={constants.ANNOTATION_ENABLE_ELASTIC: "true"}),
+        spec=TPUJobSpec(
+            tasks={TaskType.MASTER: TaskSpec(num_tasks=1, template=template),
+                   TaskType.WORKER: TaskSpec(num_tasks=workers, template=template)},
+            elastic_policy=ElasticPolicy(min_replicas=min_replicas,
+                                         max_replicas=max_replicas),
+            tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice", topology=topology),
+        ),
+    )
+
+
+def make_env():
+    cluster = InMemoryCluster()
+    manager = Manager()
+    restarter = InMemoryRestarter()
+    elastic = ElasticController(cluster, restarter=restarter)
+    engine = setup_tpujob_controller(cluster, manager, restarter=restarter,
+                                     elastic_controller=elastic)
+    return cluster, manager, engine, KubeletSim(cluster), elastic
+
+
+def start_running(cluster, manager, sim, name="ej"):
+    submit_job(cluster, elastic_job(name=name))
+    manager.run_until_idle()
+    sim.run_pod("default", f"{name}-master-0")
+    manager.run_until_idle()
+    sim.run_all("default")
+    manager.run_until_idle()
+
+
+class TestApplyHostCount:
+    def job(self, workers=8, topology="4x8", slices=1, lo=1, hi=64):
+        j = elastic_job(workers=workers, topology=topology,
+                        min_replicas=lo, max_replicas=hi)
+        j.spec.tpu_policy.num_slices = slices
+        return j
+
+    def test_single_slice_snaps_down_to_legal_topology(self):
+        j = self.job(workers=8, topology="4x8")
+        assert apply_host_count(j, 5) == 4  # legal v5e host counts: 1,2,4,8,...
+        assert j.spec.tpu_policy.topology == "4x4"
+        assert j.spec.tasks[TaskType.WORKER].num_tasks == 4
+
+    def test_multi_slice_drops_whole_slices(self):
+        j = self.job(workers=16, topology="4x8", slices=2)
+        assert apply_host_count(j, 12) == 8  # 12 hosts = 1.5 slices → 1 slice
+        assert j.spec.tpu_policy.num_slices == 1
+        assert j.spec.tasks[TaskType.WORKER].num_tasks == 8
+
+    def test_scale_up_beyond_slice_adds_slices(self):
+        j = self.job(workers=8, topology="4x8", slices=1)
+        assert apply_host_count(j, 16) == 16
+        assert j.spec.tpu_policy.num_slices == 2
+
+    def test_respects_elastic_min(self):
+        j = self.job(workers=8, topology="4x8", lo=4)
+        assert apply_host_count(j, 1) == 4
+        assert j.spec.tpu_policy.topology == "4x4"
+
+    def test_min_floor_snaps_up_when_not_legal(self):
+        # lo=3 is not a legal v5e host count: snap UP to 4, never below floor.
+        j = self.job(workers=8, topology="4x8", lo=3)
+        assert apply_host_count(j, 1) == 4
+
+    def test_respects_elastic_max(self):
+        j = self.job(workers=8, topology="4x8", hi=8)
+        assert apply_host_count(j, 32) == 8
+
+    def test_multislice_below_one_slice_collapses(self):
+        # 2× 4x8 slices preempted down to 4 survivors: must NOT snap up to a
+        # full 8-host slice — collapse to a single 4x4 slice.
+        j = self.job(workers=16, topology="4x8", slices=2)
+        assert apply_host_count(j, 4) == 4
+        assert j.spec.tpu_policy.num_slices == 1
+        assert j.spec.tpu_policy.topology == "4x4"
+
+    def test_multislice_max_respected_below_slice(self):
+        j = self.job(workers=16, topology="4x8", slices=2, hi=6)
+        assert apply_host_count(j, 12) == 4  # capped at 6 → largest legal ≤ 6
+
+
+class TestPreemptionProtocol:
+    def test_full_checkpoint_rescale_cycle(self):
+        cluster, manager, engine, sim, elastic = make_env()
+        start_running(cluster, manager, sim)
+        pods = cluster.list(Pod, "default", {constants.LABEL_JOB_NAME: "ej"})
+        assert len(pods) == 9
+        workers = sorted((p for p in pods if "worker" in p.metadata.name),
+                         key=lambda p: p.metadata.name)
+        # every elastic pod carries generation label + preempt finalizer
+        for p in workers:
+            assert p.metadata.labels[constants.LABEL_JOB_GENERATION] == "1"
+            assert constants.FINALIZER_PREEMPT_PROTECTOR in p.metadata.finalizers
+
+        # preempt the last 4 workers: delete blocks on the finalizer → victims
+        for p in workers[4:]:
+            cluster.delete(Pod, "default", p.metadata.name)
+        manager.run_until_idle()
+        job = cluster.get(TPUJob, "default", "ej")
+        assert job.metadata.annotations[
+            constants.ANNOTATION_CKPT_REQUESTED_VERSION] == "1"
+        # world is held: victims persist until the checkpoint completes
+        assert len(cluster.list(Pod, "default",
+                                {constants.LABEL_JOB_NAME: "ej"})) == 9
+
+        # AIMaster completes the checkpoint
+        cluster.patch_meta(TPUJob, "default", "ej", annotations={
+            constants.ANNOTATION_CKPT_COMPLETED_VERSION: "1"})
+        manager.run_until_idle()
+
+        job = cluster.get(TPUJob, "default", "ej")
+        # re-spec'd to surviving capacity (4 hosts → 4x4) with generation bump
+        assert job.spec.tasks[TaskType.WORKER].num_tasks == 4
+        assert job.spec.tpu_policy.topology == "4x4"
+        assert job.metadata.generation == 2
+        # the recreated master is Pending again → DAG re-gates workers until
+        # it runs on the new node pool
+        sim.run_pod("default", "ej-master-0")
+        manager.run_until_idle()
+        pods = cluster.list(Pod, "default", {constants.LABEL_JOB_NAME: "ej"})
+        names = {p.metadata.name for p in pods}
+        assert len([n for n in names if "worker" in n]) == 4
+        # the slice SHAPE changed (4x8 → 4x4): in-place restart is impossible
+        # across node pools, so every pod was RECREATED on the new topology
+        for p in pods:
+            assert p.metadata.labels[constants.LABEL_JOB_GENERATION] == "2"
+            assert p.spec.node_selector[
+                constants.NODE_SELECTOR_TPU_TOPOLOGY] == "4x4"
+            env = p.spec.containers[0].env_map()
+            hostnames = env[constants.ENV_TPU_WORKER_HOSTNAMES].split(",")
+            assert len(hostnames) == 5  # master + 4 workers, post-scale world
+            if "worker" in p.metadata.name:
+                assert p.metadata.annotations[constants.ANNOTATION_WORLD_SIZE] == "5"
+        # transaction completed
+        job = cluster.get(TPUJob, "default", "ej")
+        assert job.metadata.annotations.get(
+            constants.ANNOTATION_SCALE_STATE) == constants.SCALE_STATE_DONE
+        assert constants.ANNOTATION_READY_TO_START_WORKER not in job.metadata.annotations
+
+    def test_same_topology_rescale_restarts_in_place_with_fresh_env(self):
+        """Multi-slice drop (2×4x8 → 1×4x8): survivors keep their slice shape,
+        so they restart IN PLACE with refreshed hostnames/world env — and the
+        healthy restarts never count toward the failure backoff limit."""
+        cluster = InMemoryCluster()
+        manager = Manager()
+        restarter = InMemoryRestarter()
+        elastic = ElasticController(cluster, restarter=restarter)
+        engine = setup_tpujob_controller(cluster, manager, restarter=restarter,
+                                         elastic_controller=elastic)
+        sim = KubeletSim(cluster)
+        job = elastic_job(workers=16, name="ms", min_replicas=2, max_replicas=32)
+        job.spec.tpu_policy.num_slices = 2
+        job.spec.run_policy.backoff_limit = 3
+        submit_job(cluster, job)
+        manager.run_until_idle()
+        sim.run_pod("default", "ms-master-0")
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+
+        workers = sorted((p for p in cluster.list(Pod, "default")
+                          if "worker" in p.metadata.name),
+                         key=lambda p: int(p.metadata.labels[constants.LABEL_TASK_INDEX]))
+        assert len(workers) == 16
+        # preempt the second slice's 8 hosts
+        for p in workers[8:]:
+            cluster.delete(Pod, "default", p.metadata.name)
+        manager.run_until_idle()
+        cluster.patch_meta(TPUJob, "default", "ms", annotations={
+            constants.ANNOTATION_CKPT_COMPLETED_VERSION: "1"})
+        manager.run_until_idle()
+
+        job = cluster.get(TPUJob, "default", "ms")
+        assert job.spec.tpu_policy.num_slices == 1
+        assert job.spec.tpu_policy.topology == "4x8"  # unchanged shape
+        assert job.spec.tasks[TaskType.WORKER].num_tasks == 8
+        pods = cluster.list(Pod, "default", {constants.LABEL_JOB_NAME: "ms"})
+        survivors = [p for p in pods if "worker" in p.metadata.name]
+        assert len(survivors) == 8
+        for p in survivors:
+            # in-place: restart_count bumped, elastic-restarts annotation set
+            assert all(cs.restart_count == 1 for cs in p.status.container_statuses)
+            assert p.metadata.annotations[constants.ANNOTATION_ELASTIC_RESTARTS] == "1"
+            env = p.spec.containers[0].env_map()
+            hostnames = env[constants.ENV_TPU_WORKER_HOSTNAMES].split(",")
+            assert len(hostnames) == 9  # master + 8 workers post-scale
+        # healthy restarts excluded from the backoff count → job not failed
+        assert engine.restart_count(job, pods) == 0
+        from tpu_on_k8s.utils import conditions as cond
+        assert not cond.is_failed(cluster.get(TPUJob, "default", "ms").status)
+
+    def test_scale_waits_for_ready_gate_after_checkpoint_round(self):
+        cluster, manager, engine, sim, elastic = make_env()
+        start_running(cluster, manager, sim)
+        # simulate a prior checkpoint round, then a user-driven rescale
+        cluster.patch_meta(TPUJob, "default", "ej", annotations={
+            constants.ANNOTATION_CKPT_REQUESTED_VERSION: "1",
+            constants.ANNOTATION_CKPT_COMPLETED_VERSION: "1"})
+
+        def mutate(j):
+            apply_host_count(j, 4)
+        cluster.update_with_retry(TPUJob, "default", "ej", mutate)
+        manager.run_until_idle()
+        # without ready-to-start-worker, stale pods are NOT restarted
+        pods = cluster.list(Pod, "default", {constants.LABEL_JOB_NAME: "ej"})
+        stale = [p for p in pods
+                 if p.metadata.labels[constants.LABEL_JOB_GENERATION] == "1"]
+        assert stale, "pods must stay stale while the gate is closed"
+
+        cluster.patch_meta(TPUJob, "default", "ej", annotations={
+            constants.ANNOTATION_READY_TO_START_WORKER: "true"})
+        manager.run_until_idle()
+        pods = cluster.list(Pod, "default", {constants.LABEL_JOB_NAME: "ej"})
+        assert all(p.metadata.labels[constants.LABEL_JOB_GENERATION] == "2"
+                   for p in pods)
+
+    def test_user_rescale_without_checkpoint_round_proceeds(self):
+        cluster, manager, engine, sim, elastic = make_env()
+        start_running(cluster, manager, sim)
+
+        def mutate(j):
+            apply_host_count(j, 2)
+        cluster.update_with_retry(TPUJob, "default", "ej", mutate)
+        manager.run_until_idle()
+        sim.run_pod("default", "ej-master-0")  # recreated on the new topology
+        manager.run_until_idle()
+        pods = cluster.list(Pod, "default", {constants.LABEL_JOB_NAME: "ej"})
+        workers = [p for p in pods if "worker" in p.metadata.name]
+        assert len(workers) == 2
+        assert all(p.metadata.labels[constants.LABEL_JOB_GENERATION] == "2"
+                   for p in pods)
+
+    def test_victims_drain_on_job_delete(self):
+        cluster, manager, engine, sim, elastic = make_env()
+        start_running(cluster, manager, sim)
+        workers = [p for p in cluster.list(Pod, "default")
+                   if "worker" in p.metadata.name]
+        cluster.delete(Pod, "default", workers[0].metadata.name)
+        cluster.delete(TPUJob, "default", "ej")
+        manager.run_until_idle()
+        assert cluster.list(Pod, "default") == []

@@ -37,6 +37,10 @@ ANNOTATION_READY_TO_START_WORKER = "distributed.tpu.io/ready-to-start-worker"
 ANNOTATION_IMMEDIATELY_START_WORKER = "distributed.tpu.io/immediately-start-worker"
 ANNOTATION_WORLD_SIZE = "distributed.tpu.io/world-size"
 ANNOTATION_LAST_FAILOVER_TIMESTAMP = "distributed.tpu.io/last-failover-timestamp"
+# Count of healthy in-place restarts performed by elastic scaling on this pod —
+# subtracted from container restart counts so successful rescales never feed
+# the job's failure backoff limit.
+ANNOTATION_ELASTIC_RESTARTS = "distributed.tpu.io/elastic-restarts"
 # gang scheduler podgroup binding (reference: scheduling.k8s.io/group-name,
 # /root/reference/pkg/gangscheduler/volcano/volcano.go:238-287)
 ANNOTATION_GANG_GROUP_NAME = "scheduling.k8s.io/group-name"

@@ -1,0 +1,330 @@
+"""AIMaster-driven elastic scaling: the generation / 2-phase-checkpoint protocol.
+
+Analog of /root/reference/controllers/train/elastic_scale.go (SURVEY §3.3) —
+the multi-actor state machine between the controller, an in-cluster AIMaster,
+and the training processes, driven entirely by annotations:
+
+1. **Victim detection** — a pod with a deletionTimestamp still carrying the
+   ``preempt-protector`` finalizer is being preempted but is held alive
+   (elastic_scale.go:737-740).
+2. **Checkpoint request** — the controller stamps
+   ``ckpt-requested-version = <job generation>``; the AIMaster observes it,
+   checkpoints training state to the model volume, then writes
+   ``ckpt-completed-version`` (elastic_scale.go:469-488).
+3. **Victim cleanup + respec** — on completion the controller drains victim
+   finalizers, deletes them, and re-specs the job to the surviving capacity
+   (elastic_scale.go:491-546). TPU twist: the new worker count must land on a
+   slice-legal host quantum, so the respec rewrites topology/num_slices too
+   (``apply_host_count`` — the reference's free-form replica arithmetic is
+   illegal here, SURVEY §7).
+4. **Scale workflow** — the spec change bumps ``metadata.generation``; pods
+   whose generation label lags are *stale* and get the world-size annotation
+   patch + in-place restart (master first, then workers —
+   elastic_scale.go:210-297, restartPodInKruiseProtocol :342-397); missing
+   indices are created by the engine with the new generation label; the
+   ``ready-to-start-worker`` / ``scale-state`` gates sequence it all.
+
+Unlike the reference there is no stale-service refresh step: services select
+on task labels only (never generation), so DNS stays valid across restarts by
+construction (the refreshStaleService dance at elastic_scale.go:402-424 is
+designed out).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import Pod, PodPhase
+from tpu_on_k8s.api.types import TaskType, TPUJob
+from tpu_on_k8s.client.cluster import InMemoryCluster, NotFoundError
+from tpu_on_k8s.controller import failover
+from tpu_on_k8s.controller.config import JobControllerConfig
+from tpu_on_k8s.controller.runtime import Result
+from tpu_on_k8s.gang import topology
+
+
+def apply_host_count(job: TPUJob, desired_hosts: int) -> int:
+    """Re-spec the job's worker group to ``desired_hosts``, snapped DOWN to a
+    slice-legal quantum, honoring elastic min/max. Mutates spec in place
+    (callers persist via the cluster so generation bumps). Returns the host
+    count actually applied.
+
+    Multi-slice jobs scale by dropping/adding whole slices; single-slice jobs
+    rewrite the topology to the legal shape matching the new host count.
+    """
+    tpu = job.spec.tpu_policy
+    task = job.spec.tasks.get(TaskType.WORKER)
+    if task is None:
+        return 0
+    ep = job.spec.elastic_policy
+    lo = ep.min_replicas if ep is not None else 1
+    hi = ep.max_replicas if ep is not None else desired_hosts
+    desired = max(lo, min(desired_hosts, max(hi, lo)))
+
+    per_slice = topology.hosts_per_slice(tpu.accelerator, tpu.topology)
+    if desired >= per_slice and (tpu.num_slices > 1 or desired > per_slice):
+        # Slice-granular: whole slices over DCN. Floor division snaps DOWN;
+        # the elastic floor may force a snap back up to cover min_replicas.
+        new_slices = max(1, desired // per_slice)
+        if new_slices * per_slice < lo:
+            new_slices = -(-lo // per_slice)  # ceil
+        applied = new_slices * per_slice
+        tpu.num_slices = new_slices
+    else:
+        # At/below one slice (even if currently multi-slice): collapse to a
+        # single slice and rewrite topology to the legal shape ≤ desired —
+        # snapped up to the smallest legal count covering min_replicas when
+        # the floor demands it.
+        legal = topology.legal_host_counts(tpu.accelerator)
+        applied = max((c for c in legal if lo <= c <= desired), default=None)
+        if applied is None:
+            applied = min((c for c in legal if c >= lo), default=legal[-1])
+        tpu.topology = topology.topology_for_hosts(tpu.accelerator, applied)
+        tpu.num_slices = 1
+    task.num_tasks = applied
+    return applied
+
+
+class ElasticController:
+    """The engine's elastic seam (ElasticScaling contract,
+    controllers/common/interface.go:83-97). ``reconcile`` returns a Result to
+    short-circuit the engine (protocol in flight) or None to let the normal
+    pod/service reconciliation proceed."""
+
+    def __init__(
+        self,
+        cluster: InMemoryCluster,
+        restarter: Optional[failover.InPlaceRestarter] = None,
+        config: Optional[JobControllerConfig] = None,
+        hooks=None,  # WorkloadHooks; wired by setup_tpujob_controller
+    ) -> None:
+        self.cluster = cluster
+        self.restarter = restarter
+        self.config = config or JobControllerConfig()
+        self.hooks = hooks
+
+    # --------------------------------------------------------------- utilities
+    @staticmethod
+    def victim_pods(pods: List[Pod]) -> List[Pod]:
+        """filterVictimPods (elastic_scale.go:594-602,737-740)."""
+        return [
+            p for p in pods
+            if p.metadata.deletion_timestamp is not None
+            and constants.FINALIZER_PREEMPT_PROTECTOR in p.metadata.finalizers
+        ]
+
+    @staticmethod
+    def pod_generation(pod: Pod) -> int:
+        try:
+            return int(pod.metadata.labels.get(constants.LABEL_JOB_GENERATION, "0"))
+        except ValueError:
+            return 0
+
+    @staticmethod
+    def _ann_int(job: TPUJob, key: str) -> Optional[int]:
+        raw = job.metadata.annotations.get(key)
+        if raw is None:
+            return None
+        try:
+            return int(raw)
+        except ValueError:
+            return None
+
+    def _patch_job_annotations(self, job: TPUJob, annotations) -> None:
+        try:
+            updated = self.cluster.patch_meta(
+                TPUJob, job.metadata.namespace, job.metadata.name,
+                annotations=annotations)
+            job.metadata.annotations = updated.metadata.annotations
+            job.metadata.resource_version = updated.metadata.resource_version
+        except NotFoundError:
+            pass
+
+    # -------------------------------------------------------------- reconcile
+    def reconcile(self, job: TPUJob, pods: List[Pod]) -> Optional[Result]:
+        victims = self.victim_pods(pods)
+        if victims:
+            return self._handle_preemption(job, pods, victims)
+
+        stale = [p for p in pods if self.pod_generation(p) < job.metadata.generation]
+        if stale:
+            return self._scale(job, pods, stale)
+
+        ann = job.metadata.annotations
+        if ann.get(constants.ANNOTATION_SCALE_STATE) == constants.SCALE_STATE_INFLIGHT:
+            # All pods current → the scale transaction is complete
+            # (elastic_scale.go:280-294).
+            self._patch_job_annotations(job, {
+                constants.ANNOTATION_SCALE_STATE: constants.SCALE_STATE_DONE,
+                constants.ANNOTATION_READY_TO_START_WORKER: None,
+            })
+            self.cluster.record_event(job, "Normal", "ScaleSucceeded",
+                                      f"scale to generation {job.metadata.generation} complete")
+        return None
+
+    # ----------------------------------------------- preemption → checkpoint
+    def _handle_preemption(self, job: TPUJob, pods: List[Pod],
+                           victims: List[Pod]) -> Result:
+        """Steps 2-3 of the protocol (TriggerCheckpointIfNecessary,
+        elastic_scale.go:132-196)."""
+        gen = job.metadata.generation
+        requested = self._ann_int(job, constants.ANNOTATION_CKPT_REQUESTED_VERSION)
+        completed = self._ann_int(job, constants.ANNOTATION_CKPT_COMPLETED_VERSION)
+
+        if requested is None or requested < gen:
+            self._patch_job_annotations(
+                job, {constants.ANNOTATION_CKPT_REQUESTED_VERSION: str(gen)})
+            self.cluster.record_event(
+                job, "Normal", "CheckpointRequested",
+                f"{len(victims)} pod(s) being preempted; requested checkpoint "
+                f"at generation {gen}")
+            return Result(requeue_after=self.config.sync_period_seconds)
+
+        if completed is None or completed < requested:
+            # AIMaster still checkpointing — hold the world steady.
+            return Result(requeue_after=self.config.sync_period_seconds)
+
+        # Checkpoint done: drain victims (cleanupVictimPods :491-515)...
+        for pod in victims:
+            try:
+                self.cluster.patch_meta(
+                    Pod, pod.metadata.namespace, pod.metadata.name,
+                    remove_finalizers=[constants.FINALIZER_PREEMPT_PROTECTOR])
+            except NotFoundError:
+                pass
+        # ...and re-spec to surviving capacity, snapped to a legal quantum
+        # (increaseGenerationAndMarkAsSucceeded :519-546 — here the generation
+        # bump is the honest k8s one: a spec change).
+        victim_names = {p.metadata.name for p in victims}
+        surviving_workers = sum(
+            1 for p in pods
+            if p.metadata.labels.get(constants.LABEL_TASK_TYPE) == TaskType.WORKER.value.lower()
+            and p.metadata.name not in victim_names)
+
+        def mutate(j: TPUJob) -> None:
+            apply_host_count(j, surviving_workers)
+
+        try:
+            self.cluster.update_with_retry(
+                TPUJob, job.metadata.namespace, job.metadata.name, mutate)
+        except NotFoundError:
+            return Result()
+        self._patch_job_annotations(job, {
+            constants.ANNOTATION_READY_TO_START_WORKER: "true",
+        })
+        self.cluster.record_event(job, "Normal", "VictimsCleaned",
+                                  f"cleaned {len(victims)} victim pod(s) after checkpoint")
+        return Result(requeue_after=0.0)
+
+    # ------------------------------------------------------------------ scale
+    def _scale(self, job: TPUJob, pods: List[Pod], stale: List[Pod]) -> Optional[Result]:
+        """Step 4: the scale workflow (scale(), elastic_scale.go:210-297)."""
+        ann = job.metadata.annotations
+        ready = ann.get(constants.ANNOTATION_READY_TO_START_WORKER) == "true"
+        immediate = ann.get(constants.ANNOTATION_IMMEDIATELY_START_WORKER) == "true"
+        ckpt_requested = self._ann_int(job, constants.ANNOTATION_CKPT_REQUESTED_VERSION)
+        if ckpt_requested is not None and not (ready or immediate):
+            # A checkpoint round exists for this job: wait for the AIMaster's
+            # go-ahead before restarting the world (elastic_scale.go:222-225).
+            return Result(requeue_after=self.config.sync_period_seconds)
+
+        self._patch_job_annotations(job, {
+            constants.ANNOTATION_SCALE_STATE: constants.SCALE_STATE_INFLIGHT})
+
+        world = sum(t.num_tasks for tt, t in job.spec.tasks.items()
+                    if tt is not TaskType.AIMASTER)
+
+        def order(pod: Pod) -> int:
+            # Master restarts before workers (elastic_scale.go:242-277).
+            return 0 if pod.metadata.labels.get(
+                constants.LABEL_TASK_TYPE) == TaskType.MASTER.value.lower() else 1
+
+        for pod in sorted(stale, key=order):
+            self._restart_stale_pod(job, pod, world)
+        # Fall through to the engine: it creates missing indices with the new
+        # generation label and prunes out-of-range ones.
+        return None
+
+    def _restart_stale_pod(self, job: TPUJob, pod: Pod, world: int) -> None:
+        """restartStalePod → restartPodInKruiseProtocol
+        (elastic_scale.go:303-397): refresh the pod's cluster spec (world-size
+        annotation via downward API, hostnames/Megascale env, generation
+        label) FIRST, then restart in place.
+
+        TPU twist: if the re-spec changed the pod's slice shape (topology
+        nodeSelector differs), in-place restart is impossible — the pod must
+        land on a different node pool — so it is recreated instead."""
+        if not self._in_range(job, pod):
+            # Out-of-range stale pod (scale-in): delete; engine prunes anyway,
+            # but doing it here keeps ordering master-first.
+            try:
+                self.cluster.patch_meta(
+                    Pod, pod.metadata.namespace, pod.metadata.name,
+                    remove_finalizers=[constants.FINALIZER_PREEMPT_PROTECTOR])
+                self.cluster.delete(Pod, pod.metadata.namespace, pod.metadata.name)
+            except NotFoundError:
+                pass
+            return
+
+        live = self.cluster.try_get(Pod, pod.metadata.namespace, pod.metadata.name)
+        if live is None:
+            return
+        pod_topo = live.spec.node_selector.get(constants.NODE_SELECTOR_TPU_TOPOLOGY)
+        if pod_topo is not None and pod_topo != job.spec.tpu_policy.topology:
+            # Slice shape changed: the node pool is wrong — recreate.
+            failover.failover_recreate(self.cluster, live)
+            return
+
+        task_type, index = self._task_identity(live)
+
+        def mutate(p: Pod) -> None:
+            p.metadata.annotations[constants.ANNOTATION_WORLD_SIZE] = str(world)
+            p.metadata.labels[constants.LABEL_JOB_GENERATION] = str(job.metadata.generation)
+            prev = int(p.metadata.annotations.get(
+                constants.ANNOTATION_ELASTIC_RESTARTS, "0") or 0)
+            p.metadata.annotations[constants.ANNOTATION_ELASTIC_RESTARTS] = str(prev + 1)
+            if self.hooks is not None and task_type is not None:
+                # Recompute the full PJRT/XLA wiring (TPU_WORKER_HOSTNAMES,
+                # Megascale env) for the post-scale world — an in-place
+                # restart with pre-scale hostnames would target DNS names the
+                # respec just deleted.
+                self.hooks.set_cluster_spec(job, p, task_type, index)
+
+        try:
+            self.cluster.update_with_retry(
+                Pod, pod.metadata.namespace, pod.metadata.name, mutate)
+        except NotFoundError:
+            return
+        live = self.cluster.try_get(Pod, pod.metadata.namespace, pod.metadata.name)
+        if live is not None and live.status.phase == PodPhase.RUNNING:
+            if not (self.restarter is not None
+                    and self.restarter.restart(self.cluster, live)):
+                # No in-place executor (or it failed): recreate
+                # (fallback, elastic_scale.go / failover.go:242-247).
+                failover.failover_recreate(self.cluster, live)
+
+    @staticmethod
+    def _task_identity(pod: Pod):
+        try:
+            task_type = TaskType.normalize(
+                pod.metadata.labels.get(constants.LABEL_TASK_TYPE, ""))
+            index = int(pod.metadata.labels.get(constants.LABEL_TASK_INDEX, "-1"))
+        except ValueError:
+            return None, -1
+        return task_type, index
+
+    @staticmethod
+    def _in_range(job: TPUJob, pod: Pod) -> bool:
+        raw_type = pod.metadata.labels.get(constants.LABEL_TASK_TYPE, "")
+        try:
+            task_type = TaskType.normalize(raw_type)
+        except ValueError:
+            return False
+        task = job.spec.tasks.get(task_type)
+        if task is None:
+            return False
+        try:
+            index = int(pod.metadata.labels.get(constants.LABEL_TASK_INDEX, "-1"))
+        except ValueError:
+            return False
+        return 0 <= index < task.num_tasks

@@ -150,12 +150,21 @@ class JobEngine:
             return self._failover_counts[key]
 
     def restart_count(self, job: TPUJob, pods: List[Pod]) -> int:
+        """Failure-attributable restarts feeding the backoff-limit check.
+        Healthy elastic-rescale restarts are excluded (they bump container
+        restart counts too, but a successful scale event must never fail the
+        job as BackoffLimitExceeded)."""
         with self._lock:
             n = self._failover_counts.get(self.job_key(job), 0)
         for pod in pods:
             for cs in pod.status.container_statuses:
                 n += cs.restart_count
-        return n
+            try:
+                n -= int(pod.metadata.annotations.get(
+                    constants.ANNOTATION_ELASTIC_RESTARTS, "0"))
+            except ValueError:
+                pass
+        return max(n, 0)
 
     def forget_job(self, key: str) -> None:
         with self._lock:

@@ -116,8 +116,10 @@ class TPUJobHooks:
 
         if task_type is not TaskType.AIMASTER:
             # GKE TPU scheduling surface: slice nodeSelectors + chip requests.
-            pod.spec.node_selector.setdefault(constants.NODE_SELECTOR_TPU_ACCELERATOR, tpu.accelerator)
-            pod.spec.node_selector.setdefault(constants.NODE_SELECTOR_TPU_TOPOLOGY, tpu.topology)
+            # Overwrite, not setdefault: elastic respec re-applies this to
+            # live pods and the selectors must track the current slice shape.
+            pod.spec.node_selector[constants.NODE_SELECTOR_TPU_ACCELERATOR] = tpu.accelerator
+            pod.spec.node_selector[constants.NODE_SELECTOR_TPU_TOPOLOGY] = tpu.topology
             chips = topology.chips_per_host(tpu.accelerator)
             for c in pod.spec.containers:
                 c.resources.requests.setdefault(constants.RESOURCE_TPU, chips)
@@ -142,12 +144,14 @@ class TPUJobHooks:
                 # World size flows through an annotation + downward API so an
                 # in-place restart picks up the new value without re-creating
                 # the pod (torchjob_controller.go:419-439).
-                pod.metadata.annotations[constants.ANNOTATION_WORLD_SIZE] = str(world)
-                container.env.append(EnvVar(
-                    name=constants.ENV_NUM_PROCESSES,
-                    value_from=EnvVarSource(
-                        field_path=f"metadata.annotations['{constants.ANNOTATION_WORLD_SIZE}']"),
-                ))
+                pod.metadata.annotations.setdefault(
+                    constants.ANNOTATION_WORLD_SIZE, str(world))
+                # set_env (replace-in-place) keeps re-application idempotent —
+                # elastic respec re-runs this on live pods.
+                container.set_env(
+                    constants.ENV_NUM_PROCESSES, "",
+                    EnvVarSource(
+                        field_path=f"metadata.annotations['{constants.ANNOTATION_WORLD_SIZE}']"))
             else:
                 env(constants.ENV_NUM_PROCESSES, str(world))
             if tpu.num_slices > 1:
@@ -295,6 +299,10 @@ def setup_tpujob_controller(
     gates = gates or FeatureGates()
     metrics = metrics or JobMetrics()
     hooks = TPUJobHooks(config, gates, metrics, restarter=restarter)
+    if elastic_controller is not None and getattr(elastic_controller, "hooks", None) is None:
+        # The elastic respec path re-applies the cluster-spec wiring to live
+        # pods before in-place restarts.
+        elastic_controller.hooks = hooks
     engine = JobEngine(
         cluster, hooks, config=config, gang_scheduler=gang_scheduler,
         restarter=restarter, metrics=metrics, gates=gates,
