@@ -1,0 +1,201 @@
+"""Native elastic autoscaler tests (SURVEY §2.5).
+
+The decision loop: observe training metrics from worker-0's log, grow to the
+next slice-legal host count while latency-per-replica improves, revert and
+freeze on regression (ReachMaxMetric), cap at max_replicas, revert when grown
+capacity never materializes.
+"""
+import pytest
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import Container, ObjectMeta, Pod, PodPhase, PodSpec, PodTemplateSpec
+from tpu_on_k8s.api.types import (
+    ElasticPolicy,
+    TaskSpec,
+    TaskType,
+    TPUJob,
+    TPUJobSpec,
+    TPUPolicy,
+)
+from tpu_on_k8s.client import InMemoryCluster, KubeletSim
+from tpu_on_k8s.controller.autoscaler import (
+    ElasticAutoscaler,
+    MetricObservation,
+    is_satisfy_elastic_continue,
+    parse_observation,
+    setup_elastic_autoscaler,
+)
+from tpu_on_k8s.controller.config import JobControllerConfig
+from tpu_on_k8s.controller.elastic import ElasticController
+from tpu_on_k8s.controller.failover import InMemoryRestarter
+from tpu_on_k8s.controller.runtime import Manager
+from tpu_on_k8s.controller.tpujob import setup_tpujob_controller, submit_job
+
+
+def native_job(workers=2, topology="2x4", name="nj", lo=2, hi=8):
+    template = PodTemplateSpec(spec=PodSpec(containers=[Container(name="tpu", image="i")]))
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            tasks={TaskType.WORKER: TaskSpec(num_tasks=workers, template=template)},
+            elastic_policy=ElasticPolicy(min_replicas=lo, max_replicas=hi),
+            tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice", topology=topology),
+        ),
+    )
+
+
+def make_env():
+    cluster = InMemoryCluster()
+    manager = Manager()
+    elastic = ElasticController(cluster, restarter=InMemoryRestarter())
+    setup_tpujob_controller(cluster, manager, elastic_controller=elastic)
+    scaler = setup_elastic_autoscaler(cluster)
+    return cluster, manager, scaler, KubeletSim(cluster)
+
+
+def emit_metrics(sim, name, n, latency, start_batch=0):
+    for i in range(n):
+        sim.log_line("default", f"{name}-worker-0",
+                     f"[elastic-metrics] epoch=1 batch={start_batch + i} "
+                     f"latency={latency} accuracy=0.9")
+
+
+class TestParsing:
+    def test_parse_observation(self):
+        o = parse_observation("[elastic-metrics] epoch=3 batch=120 latency=0.245 accuracy=0.81")
+        assert o == MetricObservation(epoch=3, batch=120, latency=0.245, accuracy=0.81)
+
+    def test_non_metric_lines_ignored(self):
+        assert parse_observation("loss=0.5 step=10") is None
+        assert parse_observation("[elastic-metrics] epoch=1") is None  # no latency
+
+    def test_continue_rule(self):
+        # latency/replica improved: 1.0/2 = 0.5 > 0.6/4 = 0.15 → continue
+        assert is_satisfy_elastic_continue(2, 1.0, 4, 0.6)
+        # regressed: 1.0/2 = 0.5 < 2.4/4 = 0.6 → stop
+        assert not is_satisfy_elastic_continue(2, 1.0, 4, 2.4)
+        assert is_satisfy_elastic_continue(0, 0.0, 2, 1.0)  # first window
+
+
+class TestScalingLoop:
+    def run_world(self, cluster, manager, sim, name="nj"):
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+
+    def test_grows_then_freezes_on_regression(self):
+        cluster, manager, scaler, sim = make_env()
+        submit_job(cluster, native_job(workers=2, hi=8))
+        self.run_world(cluster, manager, sim)
+        assert scaler.registered() == ["default/nj"]
+
+        # window 1 @2 hosts: good latency → grow to next legal (4)
+        emit_metrics(sim, "nj", 5, latency=1.0)
+        scaler.run_once()
+        job = cluster.get(TPUJob, "default", "nj")
+        assert job.spec.tasks[TaskType.WORKER].num_tasks == 4
+        assert job.spec.tpu_policy.topology == "4x4"
+        self.run_world(cluster, manager, sim)
+
+        # window 2 @4 hosts: latency/replica improved (0.6/4 < 1.0/2) → grow to 8
+        emit_metrics(sim, "nj", 5, latency=0.6, start_batch=10)
+        scaler.run_once()
+        job = cluster.get(TPUJob, "default", "nj")
+        assert job.spec.tasks[TaskType.WORKER].num_tasks == 8
+        self.run_world(cluster, manager, sim)
+
+        # window 3 @8 hosts: regression (2.0/8 vs 0.6/4) → revert to 4, freeze
+        emit_metrics(sim, "nj", 5, latency=2.0, start_batch=20)
+        scaler.run_once()
+        job = cluster.get(TPUJob, "default", "nj")
+        assert job.spec.tasks[TaskType.WORKER].num_tasks == 4
+        es = job.status.elastic_statuses[TaskType.WORKER]
+        assert es.message == "ReachMaxMetric"
+        assert es.continue_scaling is False
+        # frozen: further observations change nothing
+        self.run_world(cluster, manager, sim)
+        emit_metrics(sim, "nj", 5, latency=0.1, start_batch=30)
+        scaler.run_once()
+        assert cluster.get(TPUJob, "default", "nj").spec.tasks[
+            TaskType.WORKER].num_tasks == 4
+
+    def test_caps_at_max_replicas(self):
+        cluster, manager, scaler, sim = make_env()
+        submit_job(cluster, native_job(workers=2, hi=4))
+        self.run_world(cluster, manager, sim)
+        emit_metrics(sim, "nj", 5, latency=1.0)
+        scaler.run_once()
+        job = cluster.get(TPUJob, "default", "nj")
+        assert job.spec.tasks[TaskType.WORKER].num_tasks == 4
+        self.run_world(cluster, manager, sim)
+        emit_metrics(sim, "nj", 5, latency=0.5, start_batch=10)
+        scaler.run_once()
+        job = cluster.get(TPUJob, "default", "nj")
+        assert job.spec.tasks[TaskType.WORKER].num_tasks == 4  # capped
+        es = job.status.elastic_statuses[TaskType.WORKER]
+        assert es.message == "ReachMaxReplicas"
+
+    def test_insufficient_observations_hold(self):
+        cluster, manager, scaler, sim = make_env()
+        submit_job(cluster, native_job(workers=2))
+        self.run_world(cluster, manager, sim)
+        emit_metrics(sim, "nj", 3, latency=1.0)  # < metric_count=5
+        scaler.run_once()
+        assert cluster.get(TPUJob, "default", "nj").spec.tasks[
+            TaskType.WORKER].num_tasks == 2
+
+    def test_pending_pods_revert_to_last_good(self):
+        cluster, manager, scaler, sim = make_env()
+        submit_job(cluster, native_job(workers=2, hi=8))
+        self.run_world(cluster, manager, sim)
+        emit_metrics(sim, "nj", 5, latency=1.0)
+        scaler.run_once()
+        manager.run_until_idle()
+        # grown to 4, but the 2 new pods never schedule (stay Pending)
+        job = cluster.get(TPUJob, "default", "nj")
+        assert job.spec.tasks[TaskType.WORKER].num_tasks == 4
+        pending = [p for p in cluster.list(Pod, "default")
+                   if p.status.phase == PodPhase.PENDING]
+        assert pending
+        # grace period: the first tick with Pending pods does NOT revert
+        scaler.run_once()
+        assert cluster.get(TPUJob, "default", "nj").spec.tasks[
+            TaskType.WORKER].num_tasks == 4
+        scaler.run_once()  # second consecutive tick: capacity really absent
+        job = cluster.get(TPUJob, "default", "nj")
+        assert job.spec.tasks[TaskType.WORKER].num_tasks == 2  # reverted
+        es = job.status.elastic_statuses[TaskType.WORKER]
+        assert "revert" in es.message
+
+    def test_stale_observations_never_feed_new_size(self):
+        # After a grow, the old log lines must not fill the new bucket: with
+        # no post-scale metrics the scaler must hold, not race to max.
+        cluster, manager, scaler, sim = make_env()
+        submit_job(cluster, native_job(workers=2, hi=8))
+        self.run_world(cluster, manager, sim)
+        emit_metrics(sim, "nj", 5, latency=1.0)
+        scaler.run_once()
+        assert cluster.get(TPUJob, "default", "nj").spec.tasks[
+            TaskType.WORKER].num_tasks == 4
+        self.run_world(cluster, manager, sim)
+        scaler.run_once()  # zero fresh metrics at 4 hosts
+        assert cluster.get(TPUJob, "default", "nj").spec.tasks[
+            TaskType.WORKER].num_tasks == 4  # held, no phantom grow
+
+    def test_deregister_on_job_delete_and_finish(self):
+        cluster, manager, scaler, sim = make_env()
+        submit_job(cluster, native_job(name="a"))
+        submit_job(cluster, native_job(name="b"))
+        manager.run_until_idle()
+        assert scaler.registered() == ["default/a", "default/b"]
+        cluster.delete(TPUJob, "default", "a")
+        manager.run_until_idle()
+        assert scaler.registered() == ["default/b"]
+
+    def test_non_elastic_jobs_not_registered(self):
+        cluster, manager, scaler, sim = make_env()
+        job = native_job(name="plain")
+        job.spec.elastic_policy = None
+        submit_job(cluster, job)
+        manager.run_until_idle()
+        assert scaler.registered() == []

@@ -78,10 +78,18 @@ class TestApplyHostCount:
         assert j.spec.tpu_policy.num_slices == 1
         assert j.spec.tasks[TaskType.WORKER].num_tasks == 8
 
-    def test_scale_up_beyond_slice_adds_slices(self):
+    def test_scale_up_grows_topology_on_ici_first(self):
+        # Single-slice growth prefers a bigger topology (ICI) over slices (DCN).
         j = self.job(workers=8, topology="4x8", slices=1)
         assert apply_host_count(j, 16) == 16
+        assert j.spec.tpu_policy.num_slices == 1
+        assert j.spec.tpu_policy.topology == "8x8"
+
+    def test_scale_up_beyond_max_topology_adds_slices(self):
+        j = self.job(workers=64, topology="16x16", slices=1, hi=128)  # v5e max slice
+        assert apply_host_count(j, 128) == 128
         assert j.spec.tpu_policy.num_slices == 2
+        assert j.spec.tpu_policy.topology == "16x16"
 
     def test_respects_elastic_min(self):
         j = self.job(workers=8, topology="4x8", lo=4)

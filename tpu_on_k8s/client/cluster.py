@@ -71,6 +71,7 @@ class InMemoryCluster:
         self._uid = itertools.count(1)
         self._watchers: List[Callable[[WatchEvent], None]] = []
         self.events: List[Tuple[str, str, str, str]] = []  # (obj name, type, reason, msg)
+        self._pod_logs: Dict[Tuple[str, str], List[str]] = {}
 
     # ---- watch ----------------------------------------------------------------
     def watch(self, callback: Callable[[WatchEvent], None]) -> None:
@@ -89,6 +90,19 @@ class InMemoryCluster:
         """k8s Event analog (reference record.EventRecorder)."""
         with self._lock:
             self.events.append((f"{obj.metadata.namespace}/{obj.metadata.name}", etype, reason, message))
+
+    # ---- pod logs -------------------------------------------------------------
+    def append_pod_log(self, namespace: str, name: str, line: str) -> None:
+        """Kubelet-side log write (what a training process's stdout becomes)."""
+        with self._lock:
+            self._pod_logs.setdefault((namespace, name), []).append(line)
+
+    def read_pod_log(self, namespace: str, name: str, *, tail: int = 0) -> List[str]:
+        """pods/log subresource analog (the torchelastic metric observer reads
+        one tail line this way — reference observation.go:40-106)."""
+        with self._lock:
+            lines = list(self._pod_logs.get((namespace, name), []))
+        return lines[-tail:] if tail > 0 else lines
 
     # ---- CRUD -----------------------------------------------------------------
     def create(self, obj: Any) -> Any:
@@ -251,6 +265,10 @@ class InMemoryCluster:
             obj = self._store.pop(key, None)
             if obj is None:
                 return
+            if key[0] == "Pod":
+                # A recreated pod must NOT inherit its dead predecessor's log
+                # stream (real pods/log is per-container-instance).
+                self._pod_logs.pop((key[1], key[2]), None)
             uid = obj.metadata.uid
             dependents = [
                 (k, o) for k, o in self._store.items()

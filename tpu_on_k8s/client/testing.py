@@ -88,6 +88,10 @@ class KubeletSim:
     def fail_pod(self, namespace: str, name: str, exit_code: int = 1, reason: str = "Error") -> Pod:
         return self.terminate_pod(namespace, name, exit_code, reason=reason)
 
+    def log_line(self, namespace: str, name: str, line: str) -> None:
+        """Emit a line into the pod's log stream (training stdout analog)."""
+        self.cluster.append_pod_log(namespace, name, line)
+
     def evict_pod(self, namespace: str, name: str) -> Pod:
         """Node-pressure eviction (retryable failure class, failover.go:106-113)."""
         return self.terminate_pod(namespace, name, 137, reason="Evicted", phase=PodPhase.FAILED)

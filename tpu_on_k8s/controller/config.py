@@ -23,6 +23,9 @@ class JobControllerConfig:
     quota_assume_ttl_seconds: float = 60.0         # plugins/quota.go:48
     elastic_loop_period_seconds: float = 30.0      # elastictorchjob_controller.go:60
     elastic_metric_count: int = 5
+    # Consecutive autoscaler ticks tolerating Pending pods at a grown size
+    # before reverting (the reference polls up to 1min, elastic_scale.go:440).
+    elastic_pending_grace_ticks: int = 2
     failover_concurrency: int = 50                 # failover.go semaphore widths
     scale_concurrency: int = 100                   # elastic_scale.go:258
     victim_cleanup_concurrency: int = 10           # elastic_scale.go:492

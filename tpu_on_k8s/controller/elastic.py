@@ -62,7 +62,8 @@ def apply_host_count(job: TPUJob, desired_hosts: int) -> int:
     desired = max(lo, min(desired_hosts, max(hi, lo)))
 
     per_slice = topology.hosts_per_slice(tpu.accelerator, tpu.topology)
-    if desired >= per_slice and (tpu.num_slices > 1 or desired > per_slice):
+    legal = topology.legal_host_counts(tpu.accelerator)
+    if tpu.num_slices > 1 and desired >= per_slice:
         # Slice-granular: whole slices over DCN. Floor division snaps DOWN;
         # the elastic floor may force a snap back up to cover min_replicas.
         new_slices = max(1, desired // per_slice)
@@ -70,12 +71,16 @@ def apply_host_count(job: TPUJob, desired_hosts: int) -> int:
             new_slices = -(-lo // per_slice)  # ceil
         applied = new_slices * per_slice
         tpu.num_slices = new_slices
+    elif desired > max(legal):
+        # Single slice maxed out: go multi-slice on the current shape.
+        new_slices = max(1, desired // per_slice)
+        applied = new_slices * per_slice
+        tpu.num_slices = new_slices
     else:
-        # At/below one slice (even if currently multi-slice): collapse to a
-        # single slice and rewrite topology to the legal shape ≤ desired —
-        # snapped up to the smallest legal count covering min_replicas when
-        # the floor demands it.
-        legal = topology.legal_host_counts(tpu.accelerator)
+        # Within one slice's reach (even if currently multi-slice): prefer a
+        # single slice with the legal topology ≤ desired — all collectives
+        # stay on ICI instead of DCN. Snapped up to the smallest legal count
+        # covering min_replicas when the floor demands it.
         applied = max((c for c in legal if lo <= c <= desired), default=None)
         if applied is None:
             applied = min((c for c in legal if c >= lo), default=legal[-1])
@@ -280,9 +285,6 @@ class ElasticController:
         def mutate(p: Pod) -> None:
             p.metadata.annotations[constants.ANNOTATION_WORLD_SIZE] = str(world)
             p.metadata.labels[constants.LABEL_JOB_GENERATION] = str(job.metadata.generation)
-            prev = int(p.metadata.annotations.get(
-                constants.ANNOTATION_ELASTIC_RESTARTS, "0") or 0)
-            p.metadata.annotations[constants.ANNOTATION_ELASTIC_RESTARTS] = str(prev + 1)
             if self.hooks is not None and task_type is not None:
                 # Recompute the full PJRT/XLA wiring (TPU_WORKER_HOSTNAMES,
                 # Megascale env) for the post-scale world — an in-place
@@ -297,11 +299,18 @@ class ElasticController:
             return
         live = self.cluster.try_get(Pod, pod.metadata.namespace, pod.metadata.name)
         if live is not None and live.status.phase == PodPhase.RUNNING:
-            if not (self.restarter is not None
-                    and self.restarter.restart(self.cluster, live)):
-                # No in-place executor (or it failed): recreate
-                # (fallback, elastic_scale.go / failover.go:242-247).
-                failover.failover_recreate(self.cluster, live)
+            if failover.failover_inplace_restart(self.cluster, live, self.restarter):
+                # Count the healthy restart ONLY once it actually happened —
+                # stamping it earlier would mask a later genuine failure from
+                # the backoff limit.
+                prev = int(live.metadata.annotations.get(
+                    constants.ANNOTATION_ELASTIC_RESTARTS, "0") or 0)
+                try:
+                    self.cluster.patch_meta(
+                        Pod, pod.metadata.namespace, pod.metadata.name,
+                        annotations={constants.ANNOTATION_ELASTIC_RESTARTS: str(prev + 1)})
+                except NotFoundError:
+                    pass
 
     @staticmethod
     def _task_identity(pod: Pod):

@@ -60,7 +60,12 @@ class TPUJobHooks:
         return task_type in (TaskType.MASTER, TaskType.WORKER)
 
     def enable_elastic_scaling(self, job: TPUJob) -> bool:
-        """Annotation-gated (reference elastic_scale.go:81-83)."""
+        """Annotation-gated (reference elastic_scale.go:81-83) — and native
+        elastic jobs (elastic_policy set) get the same machinery: generation
+        labels, preempt protection, and the scale workflow execute their
+        autoscaler-driven spec changes."""
+        if job.spec.elastic_policy is not None:
+            return True
         return (
             job.metadata.annotations.get(constants.ANNOTATION_ENABLE_ELASTIC, "")
             .lower() == "true"
@@ -109,7 +114,7 @@ class TPUJobHooks:
 
     def set_cluster_spec(self, job: TPUJob, pod: Pod, task_type: TaskType, index: int) -> None:
         port = self._port_from_job(job)
-        elastic = self.enable_elastic_scaling(job) or job.spec.elastic_policy is not None
+        elastic = self.enable_elastic_scaling(job)
         world = sum(self._world(job).values())
         rank = self._rank(job, task_type, index)
         tpu = job.spec.tpu_policy
