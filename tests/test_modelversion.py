@@ -1,0 +1,165 @@
+"""Model pipeline tests (SURVEY §2.6): job success → ModelVersion → PV/PVC →
+dockerfile ConfigMap → build pod → phases → Model.latest_version."""
+import pytest
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import ConfigMap, Container, ObjectMeta, Pod, PodSpec, PodTemplateSpec
+from tpu_on_k8s.api.model_types import (
+    GCSStorage,
+    ImageBuildPhase,
+    LocalStorage,
+    Model,
+    ModelVersion,
+    ModelVersionSpec,
+    NFSStorage,
+    Storage,
+)
+from tpu_on_k8s.api.types import TaskSpec, TaskType, TPUJob, TPUJobSpec, TPUPolicy
+from tpu_on_k8s.client import InMemoryCluster, KubeletSim
+from tpu_on_k8s.controller.modelversion import (
+    LABEL_MODEL_VERSION,
+    ModelVersionReconciler,
+    setup_modelversion_controller,
+)
+from tpu_on_k8s.controller.runtime import Manager
+from tpu_on_k8s.controller.tpujob import setup_tpujob_controller, submit_job
+from tpu_on_k8s.storage import PersistentVolume, PersistentVolumeClaim
+
+
+def mv_spec(storage=None, model="m1", repo="reg.example/m1", tag="v1"):
+    return ModelVersionSpec(
+        model_name=model,
+        storage=storage or Storage(nfs=NFSStorage(server="nfs.local", path="/models")),
+        image_repo=repo, image_tag=tag)
+
+
+def make_env():
+    cluster = InMemoryCluster()
+    manager = Manager()
+    setup_modelversion_controller(cluster, manager)
+    return cluster, manager, KubeletSim(cluster)
+
+
+def submit_mv(cluster, name="mv1", spec=None):
+    return cluster.create(ModelVersion(
+        metadata=ObjectMeta(name=name), spec=spec or mv_spec()))
+
+
+class TestPipeline:
+    def test_full_build_cycle(self):
+        cluster, manager, sim = make_env()
+        submit_mv(cluster)
+        manager.run_until_idle()
+        # Model ensured + owns the version
+        model = cluster.get(Model, "default", "m1")
+        mv = cluster.get(ModelVersion, "default", "mv1")
+        assert any(r.uid == model.metadata.uid for r in mv.metadata.owner_references)
+        # storage chain
+        assert cluster.get(PersistentVolume, "", "mv-pv-mv1").spec.nfs_server == "nfs.local"
+        pvc = cluster.get(PersistentVolumeClaim, "default", "mv-pv-mv1")
+        assert pvc.status.phase == "Bound"
+        # dockerfile + build pod
+        cm = cluster.get(ConfigMap, "default", "mv1-dockerfile")
+        assert "COPY build/" in cm.data["dockerfile"]
+        pod = cluster.get(Pod, "default", "mv1-image-build")
+        assert pod.spec.containers[0].image.startswith("gcr.io/kaniko-project")
+        mounts = {m.name: m.mount_path for m in pod.spec.containers[0].volume_mounts}
+        # artifact PVC is the COPY source; dockerfile lands at /workspace/dockerfile
+        assert mounts["artifact"] == "/workspace/build"
+        assert mounts["dockerfile"] == "/workspace"
+        regcred = next(v for v in pod.spec.volumes if v.name == "regcred")
+        assert regcred.items == {".dockerconfigjson": "config.json"}
+        artifact = next(v for v in pod.spec.volumes if v.name == "artifact")
+        assert artifact.pvc_claim_name == "mv-pv-mv1"
+        assert mv.status.image_build_phase == ImageBuildPhase.BUILDING
+
+        sim.succeed_pod("default", "mv1-image-build")
+        manager.run_until_idle()
+        mv = cluster.get(ModelVersion, "default", "mv1")
+        assert mv.status.image_build_phase == ImageBuildPhase.SUCCEEDED
+        assert mv.status.image == "reg.example/m1:v1"
+        assert mv.status.finish_time is not None
+        model = cluster.get(Model, "default", "m1")
+        assert model.status.latest_version_name == "mv1"
+        assert model.status.latest_image == "reg.example/m1:v1"
+
+    def test_build_failure_marks_failed(self):
+        cluster, manager, sim = make_env()
+        submit_mv(cluster)
+        manager.run_until_idle()
+        sim.fail_pod("default", "mv1-image-build", exit_code=1)
+        manager.run_until_idle()
+        mv = cluster.get(ModelVersion, "default", "mv1")
+        assert mv.status.image_build_phase == ImageBuildPhase.FAILED
+        model = cluster.get(Model, "default", "m1")
+        assert model.status.latest_version_name == ""  # not updated on failure
+
+    def test_local_storage_pins_node(self):
+        cluster, manager, sim = make_env()
+        spec = mv_spec(storage=Storage(
+            local_storage=LocalStorage(path="/data/m", node_name="node-7")))
+        submit_mv(cluster, spec=spec)
+        manager.run_until_idle()
+        pv = cluster.get(PersistentVolume, "", "mv-pv-mv1-node-7")
+        assert pv.spec.node_name == "node-7"
+        pod = cluster.get(Pod, "default", "mv1-image-build")
+        assert pod.spec.node_name == "node-7"
+
+    def test_gcs_storage(self):
+        cluster, manager, sim = make_env()
+        spec = mv_spec(storage=Storage(gcs=GCSStorage(bucket="b", prefix="runs/1")))
+        submit_mv(cluster, spec=spec)
+        manager.run_until_idle()
+        pv = cluster.get(PersistentVolume, "", "mv-pv-mv1")
+        assert pv.spec.gcs_bucket == "b"
+
+    def test_no_storage_fails(self):
+        cluster, manager, sim = make_env()
+        submit_mv(cluster, spec=ModelVersionSpec(model_name="m1", storage=Storage()))
+        manager.run_until_idle()
+        mv = cluster.get(ModelVersion, "default", "mv1")
+        assert mv.status.image_build_phase == ImageBuildPhase.FAILED
+
+    def test_deleting_model_cascades_versions(self):
+        cluster, manager, sim = make_env()
+        submit_mv(cluster)
+        manager.run_until_idle()
+        cluster.delete(Model, "default", "m1")
+        assert cluster.try_get(ModelVersion, "default", "mv1") is None
+
+
+class TestJobIntegration:
+    def test_job_success_emits_and_builds_model_version(self):
+        cluster = InMemoryCluster()
+        manager = Manager()
+        setup_tpujob_controller(cluster, manager)
+        setup_modelversion_controller(cluster, manager)
+        sim = KubeletSim(cluster)
+        template = PodTemplateSpec(spec=PodSpec(containers=[Container(name="tpu", image="t")]))
+        job = TPUJob(
+            metadata=ObjectMeta(name="train1"),
+            spec=TPUJobSpec(
+                tasks={TaskType.WORKER: TaskSpec(num_tasks=2, template=template)},
+                tpu_policy=TPUPolicy(topology="2x4"),
+                model_version=mv_spec()))
+        submit_job(cluster, job)
+        manager.run_until_idle()
+        # training pods carry the model volume + path env
+        for p in cluster.list(Pod, "default", {constants.LABEL_JOB_NAME: "train1"}):
+            env = p.spec.containers[0].env_map()
+            assert env[constants.ENV_MODEL_PATH] == constants.DEFAULT_MODEL_PATH
+            assert any(v.name == "model-volume" for v in p.spec.volumes)
+        sim.run_all("default")
+        manager.run_until_idle()
+        for p in cluster.list(Pod, "default", {constants.LABEL_JOB_NAME: "train1"}):
+            sim.succeed_pod("default", p.metadata.name)
+        manager.run_until_idle()
+        job = cluster.get(TPUJob, "default", "train1")
+        mv_name = job.status.model_version_name
+        assert mv_name.startswith("mv-train1-")
+        # build pod appears; finish it
+        sim.succeed_pod("default", f"{mv_name}-image-build")
+        manager.run_until_idle()
+        mv = cluster.get(ModelVersion, "default", mv_name)
+        assert mv.status.image_build_phase == ImageBuildPhase.SUCCEEDED
+        assert cluster.get(Model, "default", "m1").status.latest_version_name == mv_name

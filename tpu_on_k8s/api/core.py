@@ -94,7 +94,9 @@ class VolumeMount:
 
 @dataclass
 class Volume:
-    """Tagged-union volume source: exactly one of the source fields is set."""
+    """Tagged-union volume source: exactly one of the source fields is set.
+    ``items`` maps source keys to file paths under the mount (configmap/secret
+    projections, e.g. ``.dockerconfigjson`` → ``config.json``)."""
 
     name: str = ""
     host_path: Optional[str] = None
@@ -104,6 +106,7 @@ class Volume:
     config_map_name: Optional[str] = None
     secret_name: Optional[str] = None
     empty_dir: bool = False
+    items: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -280,6 +283,17 @@ class ResourceQuota:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ResourceQuotaSpec = field(default_factory=ResourceQuotaSpec)
     status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+
+
+@dataclass
+class ConfigMap:
+    """Plain key→value config object (the model pipeline's dockerfile carrier,
+    reference modelversion_controller.go:286-311)."""
+
+    api_version: str = "v1"
+    kind: str = "ConfigMap"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

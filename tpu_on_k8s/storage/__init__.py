@@ -4,6 +4,10 @@ from tpu_on_k8s.storage.providers import (
     GCSProvider,
     LocalStorageProvider,
     NFSProvider,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimSpec,
+    PersistentVolumeSpec,
     provider_for_storage,
     volume_for_storage,
 )
