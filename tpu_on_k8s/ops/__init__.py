@@ -1,0 +1,1 @@
+"""Pallas TPU kernels for the hot ops of the compute plane."""
