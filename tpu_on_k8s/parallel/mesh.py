@@ -81,13 +81,20 @@ def create_mesh(config: Optional[MeshConfig] = None,
     return Mesh(grid, AXIS_ORDER)
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
+def batch_sharding(mesh: Mesh,
+                   shape: Optional[Tuple[int, ...]] = None) -> NamedSharding:
     """Sharding for a [batch, ...] input: batch split over every
-    data-parallel-ish axis (data, fsdp); seq axis shards dim 1 when present."""
-    if mesh.shape.get(AXIS_SEQ, 1) > 1:
-        spec = PartitionSpec((AXIS_DATA, AXIS_FSDP), AXIS_SEQ)
-    else:
-        spec = PartitionSpec((AXIS_DATA, AXIS_FSDP))
+    data-parallel-ish axis (data, fsdp); seq axis shards dim 1 when present.
+
+    When ``shape`` is given, axes that don't divide the corresponding dim are
+    dropped (e.g. the +1-shifted token batch [B, L+1] stays unsharded on dim 1
+    and resharding happens inside the jitted step after the slice).
+    """
+    seq = mesh.shape.get(AXIS_SEQ, 1)
+    shard_seq = seq > 1 and (shape is None or
+                             (len(shape) > 1 and shape[1] % seq == 0))
+    spec = (PartitionSpec((AXIS_DATA, AXIS_FSDP), AXIS_SEQ) if shard_seq
+            else PartitionSpec((AXIS_DATA, AXIS_FSDP)))
     return NamedSharding(mesh, spec)
 
 

@@ -1,0 +1,135 @@
+"""Ring attention: exact long-context attention over the mesh ``seq`` axis.
+
+Sequence/context parallelism for sequences too long for one chip's HBM: the
+sequence dim is sharded over the ``seq`` mesh axis; each device keeps its Q
+shard resident and the K/V shards rotate around the ring via ``ppermute``
+(which XLA lowers to neighbor ICI transfers), combined with an online softmax
+so the result is *exact* attention, not an approximation. Per-device memory is
+O(L/n · L/n) per step instead of O(L²); comms ride the ICI ring and overlap
+with each step's matmuls.
+
+The reference has no long-context support at all (SURVEY.md §5.7 — its
+operator never sees tensors); this is a first-class capability of the TPU
+compute plane, designed per the blockwise/ring-attention recipe rather than
+ported from anywhere.
+
+Entry point ``ring_attention`` is layout-compatible with ``xla_attention``
+([B, L, H, D], kv pre-repeated to H heads) so it plugs into the flagship
+model via ``attn_impl="ring"``. It wraps itself in ``jax.shard_map`` over the
+``seq`` axis; the mesh comes from an explicit argument or the ambient
+``ring_context`` the Trainer enters at trace time.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_on_k8s.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_SEQ
+
+NEG_INF = -1e30
+
+_ring_mesh: contextvars.ContextVar[Optional[Mesh]] = contextvars.ContextVar(
+    "ring_mesh", default=None)
+
+
+@contextlib.contextmanager
+def ring_context(mesh: Mesh):
+    """Make ``mesh`` the ambient mesh for ring_attention during tracing."""
+    token = _ring_mesh.set(mesh)
+    try:
+        yield
+    finally:
+        _ring_mesh.reset(token)
+
+
+def _resolve_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    return mesh if mesh is not None else _ring_mesh.get()
+
+
+def _local_ring(q, k, v, *, axis_name: str, n: int, causal: bool):
+    """Per-device body under shard_map. q/k/v: [B, Lc, H, D] local shards."""
+    my = jax.lax.axis_index(axis_name)
+    lc = q.shape[1]
+    d = q.shape[-1]
+    scale = d ** -0.5
+    qf = q.astype(jnp.float32) * scale
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, idx):
+        m, l, acc, k_cur, v_cur = carry
+        # chunk currently held originated at device (my - idx) mod n
+        src = jax.lax.rem(my - idx + n, n)
+        s = jnp.einsum("blhd,bmhd->bhlm", qf, k_cur.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = my * lc + jax.lax.broadcasted_iota(jnp.int32, (lc, lc), 0)
+            k_pos = src * lc + jax.lax.broadcasted_iota(jnp.int32, (lc, lc), 1)
+            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))          # [B, H, Lc]
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhlm,bmhd->bhld", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        # rotate K/V to the next device; the final rotation restores origin.
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+
+    b, _, h, _ = q.shape
+    m0 = jnp.full((b, h, lc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lc), jnp.float32)
+    acc0 = jnp.zeros((b, h, lc, d), jnp.float32)
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(n))
+    out = acc / l[..., None]                                  # [B, H, Lc, D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _qkv_spec(mesh: Mesh, axis_name: str, batch: int, heads: int) -> P:
+    """[B, L, H, D]: batch over data-ish axes, L over the ring, heads over
+    model — naming only mesh axes whose size divides the dim (otherwise the
+    dim stays replicated; correctness never depends on these shards)."""
+    batch_axes = []
+    rem = batch
+    for a in (AXIS_DATA, AXIS_FSDP):
+        size = mesh.shape.get(a, 1)
+        if size > 1 and rem % size == 0:
+            batch_axes.append(a)
+            rem //= size
+    model_size = mesh.shape.get(AXIS_MODEL, 1)
+    head_axis = AXIS_MODEL if model_size > 1 and heads % model_size == 0 else None
+    return P(tuple(batch_axes) or None, axis_name, head_axis, None)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   causal: bool = True, axis_name: str = AXIS_SEQ,
+                   mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """Exact attention with the sequence dim sharded over ``axis_name``.
+
+    Falls back to plain attention when no mesh is ambient or the ring has a
+    single member — so ``attn_impl="ring"`` is safe on one chip too.
+    """
+    from tpu_on_k8s.models.transformer import xla_attention
+
+    resolved = _resolve_mesh(mesh)
+    if resolved is None or resolved.shape.get(axis_name, 1) == 1:
+        return xla_attention(q, k, v, causal=causal)
+    n = resolved.shape[axis_name]
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"ring attention needs seq len {q.shape[1]} divisible by "
+            f"{axis_name}={n}")
+    spec = _qkv_spec(resolved, axis_name, q.shape[0], q.shape[2])
+    ring = jax.shard_map(
+        lambda q_, k_, v_: _local_ring(q_, k_, v_, axis_name=axis_name, n=n,
+                                       causal=causal),
+        mesh=resolved, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return ring(q, k, v)

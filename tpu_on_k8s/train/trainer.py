@@ -24,6 +24,7 @@ from jax.sharding import Mesh
 
 from tpu_on_k8s.parallel.mesh import batch_sharding
 from tpu_on_k8s.parallel.partition import PartitionRule, named_sharding
+from tpu_on_k8s.parallel.ring import ring_context
 
 
 @flax.struct.dataclass
@@ -122,10 +123,14 @@ class Trainer:
             self._init_cache[key] = make_sharded_init(
                 self.model, self.optimizer, self.mesh, self.rules,
                 example_tokens)
-        return self._init_cache[key](rng)
+        with ring_context(self.mesh):
+            return self._init_cache[key](rng)
 
     def shard_batch(self, tokens: jnp.ndarray) -> jnp.ndarray:
-        return jax.device_put(tokens, batch_sharding(self.mesh))
+        return jax.device_put(tokens, batch_sharding(self.mesh, tokens.shape))
 
     def train_step(self, state: TrainState, tokens: jnp.ndarray):
-        return self._step(state, tokens)
+        # ring_context makes the mesh ambient while jit traces, so
+        # attn_impl="ring" models can build their seq-axis shard_map.
+        with ring_context(self.mesh):
+            return self._step(state, tokens)
