@@ -1,0 +1,182 @@
+"""Generation-versioned checkpoint/resume for sharded training state.
+
+Two halves, matching the split in the reference design (SURVEY.md §5.4 — the
+operator *coordinates* checkpoints via annotations; an in-cluster AIMaster
+does the actual state I/O):
+
+* ``CheckpointManager`` — the state I/O the reference delegated to user
+  containers, built TPU-first on orbax: saves the full sharded ``TrainState``
+  (each host writes its own shards — no host gather), restores into *any*
+  mesh/sharding via an abstract target, which is exactly what a slice-legal
+  elastic rescale needs (old generation's checkpoint → new generation's mesh).
+  Directory layout is ``<root>/gen_<G>/<step>/``: one generation per elastic
+  rescale, mirroring the job ``metadata.generation`` the controller bumps
+  (reference elastic_scale.go:519-546).
+
+* ``CheckpointAgent`` — the AIMaster side of the controller's 2-phase
+  protocol (reference elastic_scale.go:469-488): poll the job's
+  ``ckpt-requested-version`` annotation, run the save callback at that
+  generation, acknowledge via ``ckpt-completed-version``. The controller side
+  lives in `tpu_on_k8s/controller/elastic.py`; together they close the loop
+  the reference left spread across cluster actors.
+"""
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.types import TPUJob
+
+_GEN_RE = re.compile(r"^gen_(\d{6})$")
+
+
+def _gen_dir(root: Path, generation: int) -> Path:
+    return root / f"gen_{generation:06d}"
+
+
+class CheckpointManager:
+    """Orbax-backed sharded checkpointing, one sub-manager per generation."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.root = Path(directory)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self._managers: Dict[int, ocp.CheckpointManager] = {}
+
+    def _manager(self, generation: int) -> ocp.CheckpointManager:
+        if generation not in self._managers:
+            self._managers[generation] = ocp.CheckpointManager(
+                _gen_dir(self.root, generation).resolve(),
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=self.max_to_keep, create=True),
+            )
+        return self._managers[generation]
+
+    # ------------------------------------------------------------- discovery
+    def generations(self) -> Sequence[int]:
+        out = []
+        for child in self.root.iterdir() if self.root.exists() else []:
+            m = _GEN_RE.match(child.name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest(self) -> Optional[Tuple[int, int]]:
+        """(generation, step) of the newest checkpoint, or None. Newest =
+        highest generation that actually contains a step (an empty gen dir
+        from a crashed save never wins)."""
+        for gen in reversed(self.generations()):
+            step = self._manager(gen).latest_step()
+            if step is not None:
+                return gen, step
+        return None
+
+    # ------------------------------------------------------------------- I/O
+    def save(self, state: Any, *, step: int, generation: int = 0,
+             wait: bool = True) -> None:
+        mgr = self._manager(generation)
+        mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            mgr.wait_until_finished()
+
+    def restore(self, abstract_state: Any, *, generation: Optional[int] = None,
+                step: Optional[int] = None) -> Tuple[Any, int, int]:
+        """Restore into the shardings carried by ``abstract_state`` (a pytree
+        of sharded ShapeDtypeStructs — see ``abstract_train_state``). Defaults
+        to the newest generation/step. Returns (state, generation, step)."""
+        if generation is None:
+            latest = self.latest()
+            if latest is None:
+                raise FileNotFoundError(f"no checkpoints under {self.root}")
+            generation, latest_step = latest
+            step = latest_step if step is None else step
+        mgr = self._manager(generation)
+        if step is None:
+            step = mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no steps in generation {generation} under {self.root}")
+        state = mgr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+        return state, generation, step
+
+    def wait_until_finished(self) -> None:
+        for mgr in self._managers.values():
+            mgr.wait_until_finished()
+
+    def close(self) -> None:
+        for mgr in self._managers.values():
+            mgr.close()
+        self._managers.clear()
+
+
+def abstract_train_state(model: Any, optimizer: Any, mesh: Any,
+                         rules: Sequence[Any], example_tokens: Any) -> Any:
+    """Abstract TrainState (ShapeDtypeStruct + NamedSharding leaves) for
+    restore-with-reshard: build it from the *target* mesh and the partition
+    rules, and orbax lands every shard directly on its new home device."""
+    import jax.numpy as jnp
+    import optax  # noqa: F401 — optimizer is an optax transform
+
+    from tpu_on_k8s.parallel.partition import named_sharding
+    from tpu_on_k8s.train.trainer import TrainState
+
+    def init(rng):
+        params = model.init(rng, example_tokens)["params"]
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=optimizer.init(params))
+
+    abstract = jax.eval_shape(init, jax.random.key(0))
+    shardings = named_sharding(abstract, mesh, rules)
+    return jax.tree.map(
+        lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                              sharding=sh),
+        abstract, shardings)
+
+
+class CheckpointAgent:
+    """AIMaster-side poll step of the controller's 2-phase checkpoint protocol.
+
+    ``save_fn(generation)`` must persist training state (typically via
+    ``CheckpointManager.save(..., generation=generation)``); on return the
+    agent acknowledges by writing ``ckpt-completed-version``, which unblocks
+    the controller's victim cleanup + generation bump
+    (`tpu_on_k8s/controller/elastic.py`).
+    """
+
+    def __init__(self, cluster: Any, namespace: str, job_name: str,
+                 save_fn: Callable[[int], None], job_cls: type = TPUJob):
+        self.cluster = cluster
+        self.namespace = namespace
+        self.job_name = job_name
+        self.save_fn = save_fn
+        self.job_cls = job_cls
+
+    def pending_request(self) -> Optional[int]:
+        job = self.cluster.try_get(self.job_cls, self.namespace, self.job_name)
+        if job is None:
+            return None
+        ann = job.metadata.annotations or {}
+        req = ann.get(constants.ANNOTATION_CKPT_REQUESTED_VERSION)
+        if req is None:
+            return None
+        done = ann.get(constants.ANNOTATION_CKPT_COMPLETED_VERSION)
+        if done is not None and int(done) >= int(req):
+            return None
+        return int(req)
+
+    def poll_once(self) -> Optional[int]:
+        """If a checkpoint is requested and unacknowledged: save + ack.
+        Returns the completed generation, or None if nothing was pending."""
+        gen = self.pending_request()
+        if gen is None:
+            return None
+        self.save_fn(gen)
+        self.cluster.patch_meta(
+            self.job_cls, self.namespace, self.job_name,
+            annotations={constants.ANNOTATION_CKPT_COMPLETED_VERSION: str(gen)})
+        return gen
