@@ -1,0 +1,73 @@
+"""Vision model family: ResNet-50 / MNIST CNN + sharded classifier training."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpu_on_k8s.models.vision import (
+    MnistCNN,
+    ResNet,
+    ResNetConfig,
+    vision_partition_rules,
+)
+from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+from tpu_on_k8s.train.vision import ClassifierTrainer
+
+
+def _param_count(model, example):
+    shapes = jax.eval_shape(lambda: model.init(jax.random.key(0), example))
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes["params"]))
+
+
+def test_resnet50_param_count_matches_published():
+    """ResNet-50 (1000 classes) is ~25.5M params — catches wiring mistakes."""
+    model = ResNet(ResNetConfig.resnet50())
+    count = _param_count(model, jnp.zeros((1, 224, 224, 3), jnp.float32))
+    assert 25.0e6 < count < 26.0e6, count
+
+
+def test_resnet_forward_shapes():
+    model = ResNet(ResNetConfig.resnet18ish(num_classes=10))
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    assert "batch_stats" in variables
+
+
+def test_classifier_trainer_resnet_learns():
+    """Tiny ResNet overfits a fixed random batch on the 8-device mesh —
+    exercises BatchNorm mutation + sharded grads end-to-end."""
+    mesh = create_mesh(MeshConfig(data=2, fsdp=4, model=1, seq=1))
+    model = ResNet(ResNetConfig.resnet18ish(num_classes=4))
+    trainer = ClassifierTrainer(model, vision_partition_rules(), mesh,
+                                optax.adam(1e-3))
+    images = jax.random.normal(jax.random.key(0), (16, 32, 32, 3))
+    labels = jnp.arange(16) % 4
+    images, labels = trainer.shard_batch(images, labels)
+    state = trainer.init_state(jax.random.key(1), images)
+    losses = []
+    for _ in range(5):
+        state, metrics = trainer.train_step(state, images, labels)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+    assert int(state.step) == 5
+
+
+def test_classifier_trainer_mnist_cnn():
+    """No-BatchNorm path (empty batch_stats) through the same trainer."""
+    mesh = create_mesh(MeshConfig(data=8, fsdp=1, model=1, seq=1))
+    trainer = ClassifierTrainer(MnistCNN(), vision_partition_rules(), mesh,
+                                optax.adam(1e-3))
+    images = jax.random.normal(jax.random.key(0), (16, 28, 28, 1))
+    labels = jnp.arange(16) % 10
+    images, labels = trainer.shard_batch(images, labels)
+    state = trainer.init_state(jax.random.key(1), images)
+    for _ in range(3):
+        state, metrics = trainer.train_step(state, images, labels)
+    evals = trainer.eval_step(state, images, labels)
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(evals["loss"]))
+    assert 0.0 <= float(evals["accuracy"]) <= 1.0

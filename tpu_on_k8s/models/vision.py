@@ -1,0 +1,137 @@
+"""Vision models: ResNet-50 (the headline images/sec benchmark) + MNIST CNN.
+
+These are the compute-plane counterparts of the reference's sample jobs
+(BASELINE.json configs: "MNIST CNN, 1-master TorchJob" and "ResNet-50 DDP,
+1 master + 2 workers" — the reference itself ships no model code, its
+training math lived in user containers, SURVEY.md §2.10).
+
+TPU-first choices:
+* NHWC layout — XLA:TPU's native conv layout; convs tile straight onto the MXU.
+* bf16 compute / fp32 params and batch-norm statistics.
+* BatchNorm running stats live in a separate ``batch_stats`` collection,
+  handled by ``ClassifierTrainer`` (`tpu_on_k8s/train/vision.py`); stats are
+  synchronized across data shards with ``axis_name``-free mean (XLA inserts
+  the cross-replica reduction from the sharding, so no explicit pmean).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpu_on_k8s.parallel.mesh import AXIS_FSDP
+from tpu_on_k8s.parallel.partition import PartitionRule
+
+
+class Bottleneck(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck with projection shortcut when shapes change."""
+
+    features: int               # bottleneck width; output is 4x
+    strides: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=self.param_dtype)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=0.9, epsilon=1e-5, dtype=jnp.float32,
+                     param_dtype=jnp.float32)
+        out_feats = self.features * 4
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = nn.relu(bn(name="bn1")(y).astype(self.dtype))
+        y = conv(self.features, (3, 3), strides=(self.strides, self.strides),
+                 padding="SAME", name="conv2")(y)
+        y = nn.relu(bn(name="bn2")(y).astype(self.dtype))
+        y = conv(out_feats, (1, 1), name="conv3")(y)
+        y = bn(name="bn3", scale_init=nn.initializers.zeros)(y).astype(self.dtype)
+        if residual.shape[-1] != out_feats or self.strides > 1:
+            residual = conv(out_feats, (1, 1),
+                            strides=(self.strides, self.strides),
+                            name="proj_conv")(residual)
+            residual = bn(name="proj_bn")(residual).astype(self.dtype)
+        return nn.relu(y + residual)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)   # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @staticmethod
+    def resnet50(num_classes: int = 1000) -> "ResNetConfig":
+        return ResNetConfig(num_classes=num_classes)
+
+    @staticmethod
+    def resnet18ish(num_classes: int = 10) -> "ResNetConfig":
+        """Small test shape (still bottleneck blocks)."""
+        return ResNetConfig(stage_sizes=(1, 1), num_classes=num_classes,
+                            width=16)
+
+
+class ResNet(nn.Module):
+    """ResNet-v1.5 with bottleneck blocks. __call__([B,H,W,C] images, train)
+    → [B, num_classes] fp32 logits."""
+
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        cfg = self.cfg
+        x = x.astype(cfg.dtype)
+        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32,
+                         param_dtype=jnp.float32, name="bn_init")(x)
+        x = nn.relu(x.astype(cfg.dtype))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = Bottleneck(cfg.width * (2 ** stage), strides,
+                               cfg.dtype, cfg.param_dtype,
+                               name=f"stage{stage}_block{block}")(x, train)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))   # global avg pool
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        param_dtype=cfg.param_dtype, name="head")(x)
+
+
+class MnistCNN(nn.Module):
+    """The reference's config/samples MNIST shape: 2 convs + 2 dense."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), dtype=self.dtype, name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3), dtype=self.dtype, name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(128, dtype=self.dtype, name="dense1")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def vision_partition_rules() -> List[PartitionRule]:
+    """Mostly data-parallel: conv kernels shard output channels over fsdp
+    (ZeRO-style weight sharding — all-gathered per layer by XLA), norms and
+    small heads replicate."""
+    return [
+        PartitionRule(r"bn|norm|bias|scale", P()),
+        PartitionRule(r"head/kernel", P(AXIS_FSDP, None)),
+        PartitionRule(r"conv.*/kernel", P(None, None, None, AXIS_FSDP)),
+        PartitionRule(r"dense.*/kernel", P(None, AXIS_FSDP)),
+    ]

@@ -98,5 +98,11 @@ def batch_sharding(mesh: Mesh,
     return NamedSharding(mesh, spec)
 
 
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard only the leading batch dim over (data, fsdp) — for inputs whose
+    non-batch dims carry no sequence semantics (images, labels)."""
+    return NamedSharding(mesh, PartitionSpec((AXIS_DATA, AXIS_FSDP)))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,127 @@
+"""Classifier training: the sharded train/eval step for vision models.
+
+Same shape as the LM `Trainer` (`tpu_on_k8s/train/trainer.py`) but carries a
+``batch_stats`` collection (BatchNorm running statistics) through the step.
+Cross-shard gradient and statistics reductions are inserted by XLA from the
+shardings — nothing here names a collective.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from tpu_on_k8s.parallel.mesh import data_sharding
+from tpu_on_k8s.parallel.partition import PartitionRule, named_sharding
+
+
+@flax.struct.dataclass
+class ClassifierState:
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+
+def softmax_cross_entropy(logits: jnp.ndarray,
+                          labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean CE over integer labels. logits [B, C] fp32; labels [B] int."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+class ClassifierTrainer:
+    """Model + optimizer + mesh + partition rules for image classification."""
+
+    def __init__(self, model: Any, rules: Sequence[PartitionRule], mesh: Mesh,
+                 optimizer: Optional[optax.GradientTransformation] = None):
+        self.model = model
+        self.rules = list(rules)
+        self.mesh = mesh
+        self.optimizer = optimizer or optax.sgd(0.1, momentum=0.9)
+        self._step = self._make_step()
+        self._eval = self._make_eval()
+        self._init_cache = {}
+
+    # ------------------------------------------------------------------ init
+    def _make_init(self, example_images: jnp.ndarray):
+        def init(rng: jax.Array) -> ClassifierState:
+            variables = self.model.init(rng, example_images, train=False)
+            params = variables["params"]
+            return ClassifierState(
+                step=jnp.zeros((), jnp.int32), params=params,
+                batch_stats=variables.get("batch_stats", {}),
+                opt_state=self.optimizer.init(params))
+
+        abstract = jax.eval_shape(init, jax.random.key(0))
+        shardings = named_sharding(abstract, self.mesh, self.rules)
+        return jax.jit(init, out_shardings=shardings)
+
+    def init_state(self, rng: jax.Array,
+                   example_images: jnp.ndarray) -> ClassifierState:
+        key = (example_images.shape, str(example_images.dtype))
+        if key not in self._init_cache:
+            self._init_cache[key] = self._make_init(example_images)
+        return self._init_cache[key](rng)
+
+    # ------------------------------------------------------------------ step
+    def _make_step(self) -> Callable:
+        model, optimizer = self.model, self.optimizer
+
+        def loss_fn(params, batch_stats, images, labels):
+            if batch_stats:
+                logits, updated = model.apply(
+                    {"params": params, "batch_stats": batch_stats}, images,
+                    train=True, mutable=["batch_stats"])
+                new_stats = updated["batch_stats"]
+            else:
+                logits = model.apply({"params": params}, images, train=True)
+                new_stats = batch_stats
+            loss = softmax_cross_entropy(logits, labels)
+            acc = jnp.mean(jnp.argmax(logits, -1) == labels)
+            return loss, (new_stats, acc)
+
+        def step(state: ClassifierState, images: jnp.ndarray,
+                 labels: jnp.ndarray) -> Tuple[ClassifierState, dict]:
+            (loss, (batch_stats, acc)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, state.batch_stats,
+                                       images, labels)
+            updates, opt_state = optimizer.update(grads, state.opt_state,
+                                                  state.params)
+            params = optax.apply_updates(state.params, updates)
+            return (ClassifierState(step=state.step + 1, params=params,
+                                    batch_stats=batch_stats,
+                                    opt_state=opt_state),
+                    {"loss": loss, "accuracy": acc, "step": state.step})
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _make_eval(self) -> Callable:
+        model = self.model
+
+        def evaluate(state: ClassifierState, images: jnp.ndarray,
+                     labels: jnp.ndarray) -> dict:
+            variables = {"params": state.params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+            logits = model.apply(variables, images, train=False)
+            return {"loss": softmax_cross_entropy(logits, labels),
+                    "accuracy": jnp.mean(jnp.argmax(logits, -1) == labels)}
+
+        return jax.jit(evaluate)
+
+    # ------------------------------------------------------------------- API
+    def shard_batch(self, *arrays: jnp.ndarray):
+        sh = data_sharding(self.mesh)
+        out = tuple(jax.device_put(a, sh) for a in arrays)
+        return out if len(out) > 1 else out[0]
+
+    def train_step(self, state, images, labels):
+        return self._step(state, images, labels)
+
+    def eval_step(self, state, images, labels):
+        return self._eval(state, images, labels)
