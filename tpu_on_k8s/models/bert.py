@@ -1,0 +1,150 @@
+"""BERT-family bidirectional encoder with an MLM head, TPU-first.
+
+Covers the "BERT-base pretrain, gang MinMember=4" benchmark config from
+BASELINE.json (the reference shipped no model code — SURVEY.md §2.10). Same
+hardware-driven construction as the flagship decoder
+(`tpu_on_k8s/models/transformer.py`): nn.scan over layers for O(1) compile
+time in depth, bf16 matmuls / fp32 statistics, partition rules external to
+the model, non-causal attention through the same pluggable kernel selection
+(plain XLA or the Pallas flash kernel).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpu_on_k8s.models.transformer import _select_attention
+from tpu_on_k8s.parallel.mesh import AXIS_FSDP, AXIS_MODEL
+from tpu_on_k8s.parallel.partition import PartitionRule
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attn_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny() -> "BertConfig":
+        return BertConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                          d_ff=128, max_seq_len=128)
+
+
+class EncoderBlock(nn.Module):
+    """Post-LN transformer encoder block (the BERT arrangement)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, _=None):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=True, name=name, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02))
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                                       param_dtype=cfg.param_dtype, name=name)
+        b, l = x.shape[0], x.shape[1]
+        q = dense(cfg.d_model, "wq")(x).reshape(b, l, cfg.n_heads, cfg.head_dim)
+        k = dense(cfg.d_model, "wk")(x).reshape(b, l, cfg.n_heads, cfg.head_dim)
+        v = dense(cfg.d_model, "wv")(x).reshape(b, l, cfg.n_heads, cfg.head_dim)
+        attn = _select_attention(cfg.attn_impl)(q, k, v, causal=False)
+        attn = dense(cfg.d_model, "wo")(attn.reshape(b, l, cfg.d_model))
+        x = ln("attn_norm")(x + attn).astype(cfg.dtype)
+        h = dense(cfg.d_ff, "w_fc")(x)
+        h = dense(cfg.d_model, "w_proj")(nn.gelu(h))
+        x = ln("mlp_norm")(x + h).astype(cfg.dtype)
+        return x, None
+
+
+class Bert(nn.Module):
+    """__call__([B, L] token ids, [B, L] type ids?) → [B, L, vocab] MLM logits."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray,
+                 type_ids: jnp.ndarray = None) -> jnp.ndarray:
+        cfg = self.cfg
+        embed = self.param("embed", nn.initializers.normal(0.02),
+                           (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
+        typ = self.param("type_embed", nn.initializers.normal(0.02),
+                         (cfg.type_vocab_size, cfg.d_model), cfg.param_dtype)
+        l = tokens.shape[1]
+        if type_ids is None:
+            type_ids = jnp.zeros_like(tokens)
+        x = (jnp.take(embed, tokens, axis=0) + pos[None, :l]
+             + jnp.take(typ, type_ids, axis=0))
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         param_dtype=cfg.param_dtype, name="embed_norm")(x)
+        x = x.astype(cfg.dtype)
+
+        block_cls = nn.remat(EncoderBlock, prevent_cse=False) if cfg.remat \
+            else EncoderBlock
+        stack = nn.scan(
+            block_cls,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=nn.broadcast,
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(cfg, name="blocks")
+        x, _ = stack(x, None)
+
+        # MLM head: transform + tied-embedding projection (BERT arrangement).
+        x = nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="mlm_transform")(x)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         param_dtype=cfg.param_dtype, name="mlm_norm")(x)
+        bias = self.param("mlm_bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), cfg.param_dtype)
+        logits = jnp.einsum("bld,vd->blv", x.astype(cfg.dtype),
+                            embed.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits + bias[None, None, :]
+
+
+def bert_partition_rules() -> List[PartitionRule]:
+    """Megatron layout over the scan-stacked encoder params."""
+    return [
+        PartitionRule(r"w[qkv]/kernel", P(None, AXIS_FSDP, AXIS_MODEL)),
+        PartitionRule(r"wo/kernel", P(None, AXIS_MODEL, AXIS_FSDP)),
+        PartitionRule(r"w_fc/kernel", P(None, AXIS_FSDP, AXIS_MODEL)),
+        PartitionRule(r"w_proj/kernel", P(None, AXIS_MODEL, AXIS_FSDP)),
+        PartitionRule(r"(^|/)embed$", P(AXIS_MODEL, AXIS_FSDP)),
+        PartitionRule(r"pos_embed|type_embed", P(None, AXIS_FSDP)),
+        PartitionRule(r"mlm_transform/kernel", P(AXIS_FSDP, AXIS_MODEL)),
+        PartitionRule(r"norm|bias", P()),
+    ]
+
+
+def mlm_loss(logits: jnp.ndarray, targets: jnp.ndarray,
+             mask: jnp.ndarray) -> jnp.ndarray:
+    """Masked-LM CE: mean over positions where ``mask`` is 1."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

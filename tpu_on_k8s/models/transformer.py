@@ -49,6 +49,10 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32     # master weights
     remat: bool = True
     attn_impl: str = "xla"             # "xla" | "flash" | "ring"
+    pos_emb: str = "rope"              # "rope" | "learned" (GPT-2 family)
+    norm: str = "rms"                  # "rms" | "ln"
+    activation: str = "swiglu"         # "swiglu" | "gelu"
+    tie_embeddings: bool = False       # lm_head = embed^T (GPT-2/BERT style)
 
     @property
     def head_dim(self) -> int:
@@ -58,6 +62,15 @@ class TransformerConfig:
     @staticmethod
     def llama2_7b() -> "TransformerConfig":
         return TransformerConfig()  # defaults are the 7B shape
+
+    @staticmethod
+    def gpt2_small() -> "TransformerConfig":
+        """The 124M GPT-2 shape (BASELINE.json elastic benchmark config)."""
+        return TransformerConfig(vocab_size=50257, d_model=768, n_layers=12,
+                                 n_heads=12, n_kv_heads=12, d_ff=3072,
+                                 max_seq_len=1024, pos_emb="learned",
+                                 norm="ln", activation="gelu",
+                                 tie_embeddings=True)
 
     @staticmethod
     def llama2_1b() -> "TransformerConfig":
@@ -136,6 +149,13 @@ class RMSNorm(nn.Module):
         return (y * scale.astype(jnp.float32)).astype(self.dtype)
 
 
+def make_norm(cfg: TransformerConfig, name: str) -> nn.Module:
+    if cfg.norm == "ln":
+        return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
+                            param_dtype=cfg.param_dtype, name=name)
+    return RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype, name=name)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -153,8 +173,9 @@ class Attention(nn.Module):
         q = q.reshape(b, l, cfg.n_heads, cfg.head_dim)
         k = k.reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
         v = v.reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
+        if cfg.pos_emb == "rope":
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
         # GQA: repeat kv groups up to n_heads before the kernel; XLA folds the
         # broadcast into the einsum so no HBM copy materialises.
         rep = cfg.n_heads // cfg.n_kv_heads
@@ -175,6 +196,8 @@ class MLP(nn.Module):
             feats, use_bias=False, name=name, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             kernel_init=nn.initializers.normal(0.02))
+        if cfg.activation == "gelu":
+            return dense(cfg.d_model, "w_down")(nn.gelu(dense(cfg.d_ff, "w_up")(x)))
         gate = dense(cfg.d_ff, "w_gate")(x)
         up = dense(cfg.d_ff, "w_up")(x)
         return dense(cfg.d_model, "w_down")(nn.silu(gate) * up)
@@ -189,10 +212,8 @@ class Block(nn.Module):
     def __call__(self, x: jnp.ndarray, positions: jnp.ndarray):
         cfg = self.cfg
         h = x + Attention(cfg, name="attn")(
-            RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype, name="attn_norm")(x),
-            positions)
-        out = h + MLP(cfg, name="mlp")(
-            RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype, name="mlp_norm")(h))
+            make_norm(cfg, "attn_norm")(x), positions)
+        out = h + MLP(cfg, name="mlp")(make_norm(cfg, "mlp_norm")(h))
         return out, None
 
 
@@ -206,7 +227,13 @@ class Transformer(nn.Module):
         cfg = self.cfg
         embed = self.param("embed", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
-        x = jnp.take(embed, tokens, axis=0).astype(cfg.dtype)
+        x = jnp.take(embed, tokens, axis=0)
+        if cfg.pos_emb == "learned":
+            pos_table = self.param("pos_embed", nn.initializers.normal(0.02),
+                                   (cfg.max_seq_len, cfg.d_model),
+                                   cfg.param_dtype)
+            x = x + pos_table[None, :tokens.shape[1]]
+        x = x.astype(cfg.dtype)
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1]), tokens.shape)
 
@@ -223,10 +250,13 @@ class Transformer(nn.Module):
         )(cfg, name="blocks")
         x, _ = stack(x, positions)
 
-        x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype, name="final_norm")(x)
+        x = make_norm(cfg, "final_norm")(x)
+        # fp32 logits: the loss softmax wants full precision.
+        if cfg.tie_embeddings:
+            return jnp.einsum("bld,vd->blv", x, embed.astype(cfg.dtype),
+                              preferred_element_type=jnp.float32)
         head = self.param("lm_head", nn.initializers.normal(0.02),
                           (cfg.d_model, cfg.vocab_size), cfg.param_dtype)
-        # fp32 logits: the loss softmax wants full precision.
         return jnp.einsum("bld,dv->blv", x, head.astype(cfg.dtype),
                           preferred_element_type=jnp.float32)
 
@@ -247,6 +277,7 @@ def flagship_partition_rules() -> List[PartitionRule]:
         PartitionRule(r"mlp/w_down/kernel", P(None, AXIS_MODEL, AXIS_FSDP)),
         # embeddings: vocab-parallel over model, hidden over fsdp
         PartitionRule(r"(^|/)embed$", P(AXIS_MODEL, AXIS_FSDP)),
+        PartitionRule(r"pos_embed", P(None, AXIS_FSDP)),
         PartitionRule(r"lm_head", P(AXIS_FSDP, AXIS_MODEL)),
         # norms and everything else: replicated (default, listed for clarity)
         PartitionRule(r"norm/scale", P()),
