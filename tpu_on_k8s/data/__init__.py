@@ -1,0 +1,8 @@
+"""Host-side data pipeline: native C++ loader + pure-Python fallback."""
+from tpu_on_k8s.data.loader import (  # noqa: F401
+    DataLoader,
+    FixedRecordDataset,
+    feistel_permutation,
+    native_available,
+    write_records,
+)
