@@ -1,0 +1,70 @@
+"""config/samples manifests load into the API types and run end-to-end."""
+from pathlib import Path
+
+import pytest
+import yaml
+
+from tpu_on_k8s.api.core import Pod, PodPhase
+from tpu_on_k8s.api.defaults import set_defaults_tpujob
+from tpu_on_k8s.api.types import TaskType, TPUJob
+from tpu_on_k8s.client import KubeletSim
+from tpu_on_k8s.controller.tpujob import submit_job
+from tpu_on_k8s.gang.scheduler import slice_quorum, validate_gang_feasibility
+from tpu_on_k8s.main import Operator, build_parser
+from tpu_on_k8s.utils.serde import from_dict
+
+SAMPLES = sorted((Path(__file__).parent.parent / "config" / "samples").glob("*.yaml"))
+
+
+def _load(path: Path) -> TPUJob:
+    return from_dict(TPUJob, yaml.safe_load(path.read_text()))
+
+
+@pytest.mark.parametrize("path", SAMPLES, ids=lambda p: p.stem)
+def test_sample_loads_and_defaults(path):
+    job = _load(path)
+    assert job.kind == "TPUJob"
+    assert job.spec.tasks, "sample has no tasks"
+    set_defaults_tpujob(job)
+    validate_gang_feasibility(job)  # host counts are slice-legal
+
+
+def test_resnet_sample_gang_matches_slice():
+    job = _load(Path(__file__).parent.parent / "config" / "samples"
+                / "resnet50_ddp.yaml")
+    set_defaults_tpujob(job)
+    # v5e 4x4 = 16 chips / 4 per host = 4 hosts; 1 master + 3 workers
+    assert slice_quorum(job) == 4
+
+
+def test_resnet_sample_runs_to_success():
+    op = Operator(build_parser().parse_args([]))
+    job = _load(Path(__file__).parent.parent / "config" / "samples"
+                / "resnet50_ddp.yaml")
+    submit_job(op.cluster, job)
+    sim = KubeletSim(op.cluster)
+    for _ in range(10):
+        op.run_once()
+        sim.run_all("default")
+    for _ in range(10):
+        for pod in op.cluster.list(Pod, "default"):
+            if pod.status.phase == PodPhase.RUNNING:
+                sim.succeed_pod("default", pod.metadata.name)
+        op.run_once()
+    got = op.cluster.get(TPUJob, "default", "resnet50-ddp")
+    assert any(c.type == "Succeeded" for c in got.status.conditions)
+
+
+def test_gpt2_sample_is_elastic():
+    job = _load(Path(__file__).parent.parent / "config" / "samples"
+                / "gpt2_elastic.yaml")
+    assert job.spec.elastic_policy.min_replicas == 2
+    assert job.spec.elastic_policy.max_replicas == 8
+    assert TaskType.AIMASTER in job.spec.tasks
+
+
+def test_llama_sample_is_multislice():
+    job = _load(Path(__file__).parent.parent / "config" / "samples"
+                / "llama2_fsdp_multislice.yaml")
+    assert job.spec.tpu_policy.num_slices == 2
+    assert job.spec.run_policy.scheduling_policy.queue == "llama-queue-a"

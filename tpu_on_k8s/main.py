@@ -1,0 +1,147 @@
+"""Manager entry point: flag surface + controller wiring (reference main.go).
+
+Mirrors the reference operator's process wiring (main.go:50-120): parse
+flags and feature gates, construct the cluster client, register the gang
+scheduler, wire every controller (TPUJob, elastic, autoscaler, ModelVersion),
+start the coordinator loop and the metrics server, then run the manager.
+
+The cluster backend is pluggable: the in-process `InMemoryCluster` is the
+default (tests / local driver — the analog of envtest); a real GKE backend
+implements the same create/get/list/update/patch/watch surface against the
+API server. Leader election belongs to that backend (a k8s Lease), not to
+this wiring.
+
+Run: ``python -m tpu_on_k8s.main --help``.
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from typing import Optional, Tuple
+
+import tpu_on_k8s.api  # noqa: F401  — anchor the api→gang→client import cycle
+from tpu_on_k8s.client import InMemoryCluster
+from tpu_on_k8s.controller.autoscaler import setup_elastic_autoscaler
+from tpu_on_k8s.controller.config import JobControllerConfig
+from tpu_on_k8s.controller.elastic import ElasticController
+from tpu_on_k8s.controller.failover import InMemoryRestarter
+from tpu_on_k8s.controller.modelversion import setup_modelversion_controller
+from tpu_on_k8s.controller.runtime import Manager
+from tpu_on_k8s.controller.tpujob import setup_tpujob_controller
+from tpu_on_k8s.coordinator.core import Coordinator
+from tpu_on_k8s.features import features
+from tpu_on_k8s.gang.scheduler import GANG_SCHEDULER_NAME, default_registry
+from tpu_on_k8s.metrics.metrics import JobMetrics, serve
+
+
+def parse_port_range(spec: str) -> Tuple[int, int]:
+    lo, _, hi = spec.partition("-")
+    return int(lo), int(hi)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-on-k8s-manager",
+        description="TPU-native distributed training operator")
+    # the reference's pflag surface (main.go:58-66)
+    p.add_argument("--metrics-port", type=int, default=8443)
+    p.add_argument("--enable-gang-scheduling", default=True,
+                   action=argparse.BooleanOptionalAction)
+    p.add_argument("--max-concurrent-reconciles", type=int, default=1)
+    p.add_argument("--hostnetwork-port-range", default="20000-30000")
+    p.add_argument("--model-image-builder",
+                   default="gcr.io/kaniko-project/executor:latest")
+    p.add_argument("--feature-gates", default="",
+                   help="Comma-separated Name=bool overrides, e.g. "
+                        "GangScheduling=true,JobCoordinator=false")
+    # tunables the reference hard-coded (SURVEY §5.6)
+    p.add_argument("--coordinator-period-seconds", type=float, default=0.1)
+    p.add_argument("--elastic-loop-period-seconds", type=float, default=30.0)
+    p.add_argument("--once", action="store_true",
+                   help="Pump controllers to quiescence and exit (smoke mode)")
+    return p
+
+
+class Operator:
+    """All wired components; ``start``/``stop`` or one-shot ``run_once``."""
+
+    def __init__(self, args: argparse.Namespace,
+                 cluster: Optional[InMemoryCluster] = None):
+        self.cluster = cluster or InMemoryCluster()
+        self.manager = Manager()
+        self.metrics = JobMetrics()
+        self.gates = (features.FeatureGates.parse(args.feature_gates)
+                      if args.feature_gates else features.FeatureGates())
+        self.config = JobControllerConfig(
+            enable_gang_scheduling=args.enable_gang_scheduling,
+            max_concurrent_reconciles=args.max_concurrent_reconciles,
+            hostnetwork_port_range=parse_port_range(args.hostnetwork_port_range),
+            model_image_builder=args.model_image_builder,
+            coordinator_period_seconds=args.coordinator_period_seconds,
+            elastic_loop_period_seconds=args.elastic_loop_period_seconds,
+        )
+
+        gang = None
+        if (self.config.enable_gang_scheduling
+                and self.gates.enabled(features.GANG_SCHEDULING)):
+            registry = default_registry(self.cluster)
+            gang = registry.get(GANG_SCHEDULER_NAME)
+        self.coordinator = None
+        if self.gates.enabled(features.JOB_COORDINATOR):
+            self.coordinator = Coordinator(
+                self.cluster, metrics=self.metrics,
+                period_seconds=self.config.coordinator_period_seconds)
+        restarter = InMemoryRestarter()
+        self.elastic = ElasticController(self.cluster, restarter=restarter)
+        self.engine = setup_tpujob_controller(
+            self.cluster, self.manager, config=self.config, gates=self.gates,
+            gang_scheduler=gang, restarter=restarter, metrics=self.metrics,
+            coordinator=self.coordinator, elastic_controller=self.elastic)
+        self.autoscaler = setup_elastic_autoscaler(self.cluster,
+                                                   config=self.config)
+        self.modelversion = setup_modelversion_controller(
+            self.cluster, self.manager, config=self.config)
+        self._metrics_server = None
+
+    def run_once(self) -> int:
+        """Single quiescence pump (smoke/test mode)."""
+        if self.coordinator is not None:
+            self.coordinator.schedule_once()
+        return self.manager.run_until_idle()
+
+    def start(self, metrics_port: int = 0) -> None:
+        self.manager.start(
+            workers_per_controller=self.config.max_concurrent_reconciles)
+        if self.coordinator is not None:
+            threading.Thread(target=self.coordinator.run, daemon=True).start()
+        threading.Thread(target=self.autoscaler.run, daemon=True).start()
+        if metrics_port:
+            self._metrics_server = serve(self.metrics, metrics_port)
+
+    def stop(self) -> None:
+        if self.coordinator is not None:
+            self.coordinator.stop()
+        self.autoscaler.stop()
+        self.manager.stop()
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    operator = Operator(args)
+    if args.once:
+        processed = operator.run_once()
+        print(f"quiescent after {processed} reconciles")
+        return 0
+    operator.start(metrics_port=args.metrics_port)
+    done = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    operator.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
