@@ -1,0 +1,7 @@
+"""Runnable training entrypoints referenced by config/samples.
+
+Each script is the user-container side of a TPUJob: join the distributed
+runtime from the operator-injected env, build a mesh over all hosts' chips,
+train, and checkpoint to the model volume so the ModelVersion pipeline can
+build an image from it.
+"""

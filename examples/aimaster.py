@@ -1,0 +1,46 @@
+"""AIMaster sidecar: the checkpoint-protocol actor inside an elastic job.
+
+Polls the job's ``ckpt-requested-version`` annotation and acknowledges after
+persisting state (reference: the AIMaster the operator coordinates with via
+annotations, elastic_scale.go:469-488). Against a real cluster the ``cluster``
+handle is the API-server client; this entrypoint wires the same
+`CheckpointAgent` used in tests (tests/test_checkpoint.py).
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def run(cluster, namespace: str, job_name: str, save_fn,
+        period_seconds: float = 5.0, max_polls: int = 0) -> int:
+    """Poll loop; returns number of checkpoints completed. ``max_polls=0``
+    runs forever (in-cluster mode)."""
+    from tpu_on_k8s.train.checkpoint import CheckpointAgent
+
+    agent = CheckpointAgent(cluster, namespace, job_name, save_fn)
+    completed = 0
+    polls = 0
+    while max_polls == 0 or polls < max_polls:
+        if agent.poll_once() is not None:
+            completed += 1
+        polls += 1
+        if max_polls == 0 or polls < max_polls:
+            time.sleep(period_seconds)
+    return completed
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="AIMaster checkpoint agent")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--job-name", required=True)
+    p.add_argument("--period-seconds", type=float, default=5.0)
+    args = p.parse_args(argv)
+    raise SystemExit(
+        "aimaster requires a cluster backend; in-cluster deployments construct "
+        "run(cluster, ...) with the API-server client (see docstring), tests "
+        f"drive it with InMemoryCluster (args: {args.namespace}/{args.job_name})")
+
+
+if __name__ == "__main__":
+    main()

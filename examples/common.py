@@ -1,0 +1,61 @@
+"""Shared plumbing for the example trainers."""
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+from tpu_on_k8s.train.distributed import DistributedContext, initialize
+
+
+def standard_parser(desc: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=desc)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch-per-host", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--fsdp", type=int, default=-1,
+                   help="fsdp axis size (-1 = all chips)")
+    p.add_argument("--model-axis", type=int, default=1)
+    p.add_argument("--seq-axis", type=int, default=1)
+    p.add_argument("--checkpoint-dir", default="")
+    return p
+
+
+def bring_up(args: argparse.Namespace) -> Tuple[DistributedContext, "jax.sharding.Mesh"]:
+    """Join the job runtime and build the standard mesh over every chip."""
+    ctx = initialize()
+    mesh = create_mesh(MeshConfig(data=1, fsdp=args.fsdp,
+                                  model=args.model_axis, seq=args.seq_axis))
+    if ctx.is_coordinator:
+        print(f"[{ctx.process_id}/{ctx.num_processes}] mesh={dict(mesh.shape)} "
+              f"devices={len(jax.devices())}")
+    return ctx, mesh
+
+
+def synthetic_tokens(key: jax.Array, batch: int, seqlen: int,
+                     vocab: int) -> jnp.ndarray:
+    return jax.random.randint(key, (batch, seqlen), 0, vocab, dtype=jnp.int32)
+
+
+class StepTimer:
+    """Prints the observation line the elastic autoscaler scrapes from
+    worker-0 logs (tpu_on_k8s/controller/autoscaler.py parse_observation)."""
+
+    def __init__(self, tokens_per_step: int, ctx: DistributedContext):
+        self.tokens_per_step = tokens_per_step
+        self.ctx = ctx
+        self.t0 = time.perf_counter()
+
+    def report(self, step: int, loss: float, accuracy: Optional[float] = None):
+        dt = time.perf_counter() - self.t0
+        self.t0 = time.perf_counter()
+        if self.ctx.is_coordinator:
+            acc = f" accuracy={accuracy:.4f}" if accuracy is not None else ""
+            print(f"[elastic-metrics] epoch=0 batch={step} latency={dt:.4f}"
+                  f"{acc} loss={loss:.4f} "
+                  f"tok_s={self.tokens_per_step / max(dt, 1e-9):.1f}",
+                  flush=True)

@@ -1,0 +1,62 @@
+"""BERT-base MLM pretraining (BASELINE config 3: gang MinMember=4)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from examples.common import bring_up, standard_parser, synthetic_tokens, StepTimer
+from tpu_on_k8s.models.bert import Bert, BertConfig, bert_partition_rules, mlm_loss
+from tpu_on_k8s.parallel.mesh import batch_sharding
+from tpu_on_k8s.parallel.partition import named_sharding
+
+
+def main(argv=None) -> float:
+    p = standard_parser("BERT-base MLM")
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--tiny", action="store_true")
+    args = p.parse_args(argv)
+    ctx, mesh = bring_up(args)
+
+    cfg = BertConfig.tiny() if args.tiny else BertConfig.base()
+    model = Bert(cfg)
+    optimizer = optax.adamw(optax.warmup_cosine_decay_schedule(
+        0.0, 1e-4, 10, max(args.steps, 11)), weight_decay=0.01)
+
+    global_batch = args.batch_per_host * ctx.num_processes
+    tokens = synthetic_tokens(jax.random.key(args.seed), global_batch,
+                              args.seq_len, cfg.vocab_size)
+    mask = (jax.random.uniform(jax.random.key(args.seed + 1),
+                               tokens.shape) < 0.15).astype(jnp.float32)
+
+    def init(rng):
+        params = model.init(rng, tokens[:1, :8])["params"]
+        return params, optimizer.init(params)
+
+    abstract = jax.eval_shape(init, jax.random.key(0))
+    shardings = named_sharding(abstract, mesh, bert_partition_rules())
+    params, opt_state = jax.jit(init, out_shardings=shardings)(
+        jax.random.key(args.seed + 2))
+
+    @jax.jit
+    def step(params, opt_state, tokens, mask):
+        def loss_fn(p):
+            return mlm_loss(model.apply({"params": p}, tokens), tokens, mask)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    sh = batch_sharding(mesh, tokens.shape)
+    tokens = jax.device_put(tokens, sh)
+    mask = jax.device_put(mask, sh)
+    timer = StepTimer(global_batch * args.seq_len, ctx)
+    loss = float("nan")
+    for i in range(args.steps):
+        params, opt_state, loss_arr = step(params, opt_state, tokens, mask)
+        loss = float(loss_arr)
+        timer.report(i, loss)
+    return loss
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""GPT-2 elastic training (BASELINE config 4: min=2/max=8, rescale on
+preemption). Saves generation-versioned checkpoints so the controller's
+checkpoint-then-scale protocol can rescale without losing progress; on start,
+resumes from the newest generation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from examples.common import bring_up, standard_parser, synthetic_tokens, StepTimer
+from tpu_on_k8s.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    flagship_partition_rules,
+)
+from tpu_on_k8s.train.checkpoint import CheckpointManager, abstract_train_state
+from tpu_on_k8s.train.trainer import Trainer, default_optimizer
+
+
+def main(argv=None) -> float:
+    p = standard_parser("GPT-2 elastic")
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--generation", type=int, default=0,
+                   help="job generation (the controller bumps it per rescale)")
+    p.add_argument("--save-every", type=int, default=100)
+    args = p.parse_args(argv)
+    ctx, mesh = bring_up(args)
+
+    cfg = (TransformerConfig(vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+                             n_kv_heads=4, d_ff=128, max_seq_len=128,
+                             remat=False, pos_emb="learned", norm="ln",
+                             activation="gelu", tie_embeddings=True)
+           if args.tiny else TransformerConfig.gpt2_small())
+    model = Transformer(cfg)
+    opt = default_optimizer(warmup_steps=10, decay_steps=max(args.steps, 11))
+    trainer = Trainer(model, flagship_partition_rules(), mesh, opt)
+
+    global_batch = args.batch_per_host * ctx.num_processes
+    seq = min(args.seq_len, cfg.max_seq_len)
+    tokens = synthetic_tokens(jax.random.key(args.seed), global_batch,
+                              seq + 1, cfg.vocab_size)
+
+    ckpt_dir = args.checkpoint_dir or ctx.model_path
+    manager = CheckpointManager(ckpt_dir) if ckpt_dir else None
+    state = None
+    if manager is not None and manager.latest() is not None:
+        abstract = abstract_train_state(model, opt, mesh,
+                                        flagship_partition_rules(),
+                                        tokens[:, :-1])
+        state, gen, step0 = manager.restore(abstract)
+        if ctx.is_coordinator:
+            print(f"resumed generation={gen} step={step0}")
+    if state is None:
+        state = trainer.init_state(jax.random.key(args.seed + 1), tokens[:, :-1])
+
+    batch = trainer.shard_batch(tokens)
+    timer = StepTimer(global_batch * seq, ctx)
+    loss = float("nan")
+    for i in range(args.steps):
+        state, metrics = trainer.train_step(state, batch)
+        loss = float(metrics["loss"])
+        timer.report(i, loss)
+        if manager is not None and (i + 1) % args.save_every == 0:
+            manager.save(state, step=int(state.step),
+                         generation=args.generation)
+    if manager is not None:
+        manager.save(state, step=int(state.step), generation=args.generation)
+        manager.close()
+    return loss
+
+
+if __name__ == "__main__":
+    main()

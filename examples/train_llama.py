@@ -1,0 +1,65 @@
+"""Llama-2 FSDP training (BASELINE config 5: multi-slice, WRR queues).
+
+Flagship decoder with megatron tensor sharding + fsdp + optional ring
+attention over the ``seq`` axis for long context. ``--config=llama2_7b``
+needs a real slice; ``--config=tiny`` runs anywhere.
+"""
+from __future__ import annotations
+
+import jax
+
+from examples.common import bring_up, standard_parser, synthetic_tokens, StepTimer
+from tpu_on_k8s.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    flagship_partition_rules,
+)
+from tpu_on_k8s.train.checkpoint import CheckpointManager
+from tpu_on_k8s.train.trainer import Trainer, default_optimizer
+
+
+CONFIGS = {
+    "llama2_7b": TransformerConfig.llama2_7b,
+    "llama2_1b": TransformerConfig.llama2_1b,
+    "tiny": TransformerConfig.tiny,
+}
+
+
+def main(argv=None) -> float:
+    p = standard_parser("Llama-2 FSDP")
+    p.add_argument("--config", default="tiny", choices=sorted(CONFIGS))
+    p.add_argument("--seq-len", type=int, default=0, help="0 = model max")
+    p.add_argument("--remat", default="true")
+    p.add_argument("--attn", default="xla", choices=["xla", "flash", "ring"])
+    args = p.parse_args(argv)
+    ctx, mesh = bring_up(args)
+
+    import dataclasses
+    cfg = CONFIGS[args.config]()
+    cfg = dataclasses.replace(cfg, remat=args.remat.lower() == "true",
+                              attn_impl=args.attn)
+    model = Transformer(cfg)
+    opt = default_optimizer(warmup_steps=10, decay_steps=max(args.steps, 11))
+    trainer = Trainer(model, flagship_partition_rules(), mesh, opt)
+
+    global_batch = args.batch_per_host * ctx.num_processes
+    seq = args.seq_len or cfg.max_seq_len
+    tokens = synthetic_tokens(jax.random.key(args.seed), global_batch,
+                              seq + 1, cfg.vocab_size)
+    state = trainer.init_state(jax.random.key(args.seed + 1), tokens[:, :-1])
+    batch = trainer.shard_batch(tokens)
+    timer = StepTimer(global_batch * seq, ctx)
+    loss = float("nan")
+    for i in range(args.steps):
+        state, metrics = trainer.train_step(state, batch)
+        loss = float(metrics["loss"])
+        timer.report(i, loss)
+    if args.checkpoint_dir:
+        manager = CheckpointManager(args.checkpoint_dir)
+        manager.save(state, step=int(state.step))
+        manager.close()
+    return loss
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""ResNet-50 data-parallel training (BASELINE config 2 / north star).
+
+Synthetic ImageNet-shaped batches (HBM-resident; the real input pipeline is
+the native loader fed from a record file of preprocessed images).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from examples.common import bring_up, standard_parser, StepTimer
+from tpu_on_k8s.models.vision import ResNet, ResNetConfig, vision_partition_rules
+from tpu_on_k8s.train.vision import ClassifierTrainer
+
+
+def main(argv=None) -> float:
+    p = standard_parser("ResNet-50")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--tiny", action="store_true", help="test-size model")
+    args = p.parse_args(argv)
+    ctx, mesh = bring_up(args)
+
+    cfg = (ResNetConfig.resnet18ish(args.num_classes) if args.tiny
+           else ResNetConfig.resnet50(args.num_classes))
+    warmup = min(5 * 390, max(args.steps // 10, 1))
+    trainer = ClassifierTrainer(
+        ResNet(cfg), vision_partition_rules(), mesh,
+        optax.sgd(optax.warmup_cosine_decay_schedule(
+            0.0, 0.1, warmup, max(args.steps, warmup + 1)), momentum=0.9,
+            nesterov=True))
+
+    global_batch = args.batch_per_host * ctx.num_processes
+    shape = (global_batch, args.image_size, args.image_size, 3)
+    images = jax.random.normal(jax.random.key(args.seed), shape, jnp.float32)
+    labels = jax.random.randint(jax.random.key(args.seed + 1), (global_batch,),
+                                0, args.num_classes, dtype=jnp.int32)
+    images, labels = trainer.shard_batch(images, labels)
+    state = trainer.init_state(jax.random.key(args.seed + 2), images)
+    timer = StepTimer(global_batch, ctx)
+    loss = float("nan")
+    for step in range(args.steps):
+        state, metrics = trainer.train_step(state, images, labels)
+        loss = float(metrics["loss"])
+        timer.report(step, loss, float(metrics["accuracy"]))
+    return loss
+
+
+if __name__ == "__main__":
+    main()

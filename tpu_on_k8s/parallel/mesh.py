@@ -88,13 +88,24 @@ def batch_sharding(mesh: Mesh,
 
     When ``shape`` is given, axes that don't divide the corresponding dim are
     dropped (e.g. the +1-shifted token batch [B, L+1] stays unsharded on dim 1
-    and resharding happens inside the jitted step after the slice).
+    and resharding happens inside the jitted step after the slice; a batch
+    smaller than data×fsdp sheds the non-dividing axis rather than erroring).
     """
+    batch_axes: List[str] = []
+    if shape is None:
+        batch_axes = [AXIS_DATA, AXIS_FSDP]
+    else:
+        rem = shape[0]
+        for axis in (AXIS_DATA, AXIS_FSDP):
+            size = mesh.shape.get(axis, 1)
+            if size > 1 and rem % size == 0:
+                batch_axes.append(axis)
+                rem //= size
     seq = mesh.shape.get(AXIS_SEQ, 1)
     shard_seq = seq > 1 and (shape is None or
                              (len(shape) > 1 and shape[1] % seq == 0))
-    spec = (PartitionSpec((AXIS_DATA, AXIS_FSDP), AXIS_SEQ) if shard_seq
-            else PartitionSpec((AXIS_DATA, AXIS_FSDP)))
+    spec = (PartitionSpec(tuple(batch_axes) or None, AXIS_SEQ) if shard_seq
+            else PartitionSpec(tuple(batch_axes) or None))
     return NamedSharding(mesh, spec)
 
 

@@ -1,0 +1,86 @@
+"""Worker-side distributed runtime init: the consumer of the operator's env.
+
+The TPUJob controller injects PJRT/XLA env into every task pod
+(`tpu_on_k8s/controller/tpujob.py` — the reference's SetClusterSpec analog,
+torchjob_controller.go:314-449, with MASTER_ADDR/RANK/WORLD_SIZE swapped for
+the TPU runtime's variables). This module is the other half: inside the
+container, parse that env and bring up ``jax.distributed`` so every host
+joins the same multi-controller runtime and ``jax.devices()`` spans the whole
+slice (or, with Megascale env set, all slices over DCN).
+
+Usage in a training script (see examples/):
+
+    from tpu_on_k8s.train.distributed import initialize
+    ctx = initialize()              # no-op off-cluster (single process)
+    mesh = create_mesh(...)         # spans all ctx.num_processes hosts
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Optional
+
+from tpu_on_k8s.api import constants
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedContext:
+    """What the pod env says about this worker's place in the job."""
+
+    coordinator_address: Optional[str] = None
+    process_id: int = 0
+    num_processes: int = 1
+    worker_hostnames: tuple = ()
+    num_slices: int = 1
+    slice_id: int = 0
+    model_path: Optional[str] = None
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1 and self.coordinator_address is not None
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def parse_env(env: Optional[Mapping[str, str]] = None) -> DistributedContext:
+    """Read the operator-injected variables (missing ⇒ single-process)."""
+    env = os.environ if env is None else env
+    hostnames = tuple(
+        h for h in env.get(constants.ENV_TPU_WORKER_HOSTNAMES, "").split(",") if h)
+    return DistributedContext(
+        coordinator_address=env.get(constants.ENV_COORDINATOR_ADDRESS) or None,
+        process_id=int(env.get(constants.ENV_PROCESS_ID, "0")),
+        num_processes=int(env.get(constants.ENV_NUM_PROCESSES, "1")),
+        worker_hostnames=hostnames,
+        num_slices=int(env.get(constants.ENV_MEGASCALE_NUM_SLICES, "1")),
+        slice_id=int(env.get(constants.ENV_MEGASCALE_SLICE_ID, "0")),
+        model_path=env.get(constants.ENV_MODEL_PATH) or None,
+    )
+
+
+def initialize(env: Optional[Mapping[str, str]] = None) -> DistributedContext:
+    """Join the job's multi-controller runtime if the env says there is one.
+
+    Off-cluster (no coordinator env) this is a no-op returning a
+    single-process context, so the same training script runs on a laptop, in
+    tests, and on a slice. Elastic note: after a generation rescale the
+    controller re-injects a fresh TPU_NUM_PROCESSES via in-place restart; the
+    restarted process simply calls this again and re-joins at the new world
+    size (the reference achieved the same with torchrun rdzv re-entry).
+    """
+    ctx = parse_env(env)
+    if ctx.is_distributed:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=ctx.coordinator_address,
+            num_processes=ctx.num_processes,
+            process_id=ctx.process_id,
+        )
+    return ctx
