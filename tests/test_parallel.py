@@ -22,7 +22,7 @@ class TestMeshConfig:
 
     def test_resolve_exact(self):
         cfg = MeshConfig(data=8, fsdp=1, model=1, seq=1).resolve(8)
-        assert cfg.axis_sizes() == (8, 1, 1, 1)
+        assert cfg.axis_sizes() == (8, 1, 1, 1, 1)
 
     def test_resolve_mismatch_raises(self):
         with pytest.raises(ValueError, match="needs 6"):
@@ -46,8 +46,8 @@ class TestCreateMesh:
     def test_axis_order_model_innermost(self):
         mesh = create_mesh(MeshConfig(data=2, fsdp=2, model=2, seq=1))
         assert mesh.axis_names[-1] == AXIS_MODEL
-        assert mesh.shape == {AXIS_DATA: 2, AXIS_FSDP: 2, AXIS_SEQ: 1,
-                              AXIS_MODEL: 2}
+        assert dict(mesh.shape) == {AXIS_DATA: 2, AXIS_FSDP: 2, "expert": 1,
+                                    AXIS_SEQ: 1, AXIS_MODEL: 2}
 
     def test_batch_sharding_splits_batch(self):
         mesh = create_mesh(MeshConfig(data=2, fsdp=4, model=1, seq=1))

@@ -1,0 +1,94 @@
+"""Mixture-of-Experts MLP with expert parallelism over the ``expert`` axis.
+
+Switch/GShard-style top-k routing with per-expert capacity, written as dense
+dispatch/combine einsums: the expert dimension of the weights is sharded over
+the mesh ``expert`` axis (partition rules in
+`tpu_on_k8s/models/transformer.py`), so XLA's SPMD partitioner derives the
+token all-to-all from the shardings — no hand-written collective, per the
+scaling-book recipe. The reference has no model code at all; this is a
+capability extension of the TPU compute plane.
+
+Capacity bookkeeping follows the GShard algorithm: per (group, expert) slots
+are assigned in token order via a cumulative sum; overflowing tokens are
+dropped (their residual path carries them). A load-balance auxiliary loss is
+``sow``n into the ``losses`` collection; the Trainer folds it into the
+objective when ``aux_loss_weight > 0``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for the dense MLP block. x: [B, L, D] → [B, L, D]."""
+
+    cfg: Any  # TransformerConfig with n_experts > 0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        e, k = cfg.n_experts, cfg.experts_top_k
+        b, l, d = x.shape
+        capacity = max(1, int(cfg.expert_capacity_factor * k * l / e))
+
+        router_kernel = self.param("router", nn.initializers.normal(0.02),
+                                   (d, e), jnp.float32)
+        # routing in fp32: small matmul, numerically sensitive
+        logits = jnp.einsum("bld,de->ble", x.astype(jnp.float32),
+                            router_kernel)                   # [B, L, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # top-k dispatch with capacity, GShard-style
+        remaining = probs
+        fill = jnp.zeros((b, e), jnp.int32)                  # slots used so far
+        dispatch = jnp.zeros((b, l, e, capacity), x.dtype)
+        combine = jnp.zeros((b, l, e, capacity), jnp.float32)
+        for _ in range(k):
+            choice = jnp.argmax(remaining, axis=-1)          # [B, L]
+            gate = jnp.take_along_axis(remaining, choice[..., None],
+                                       axis=-1)[..., 0]      # [B, L]
+            onehot_e = jax.nn.one_hot(choice, e, dtype=jnp.int32)
+            # slot index per token: tokens earlier in the sequence win
+            pos = fill[:, None, :] + jnp.cumsum(onehot_e, axis=1) - onehot_e
+            slot = jnp.sum(pos * onehot_e, axis=-1)          # [B, L]
+            keep = slot < capacity
+            onehot_c = jax.nn.one_hot(slot, capacity)        # [B, L, C]
+            mask = (onehot_e.astype(x.dtype)[:, :, :, None]
+                    * onehot_c.astype(x.dtype)[:, :, None, :]
+                    * keep[:, :, None, None].astype(x.dtype))
+            dispatch = dispatch + mask
+            combine = combine + mask.astype(jnp.float32) * gate[:, :, None, None]
+            fill = fill + jnp.sum(onehot_e, axis=1)
+            remaining = remaining * (1.0 - onehot_e.astype(jnp.float32))
+
+        # load-balance loss (Switch eq. 4): E · Σ_e f_e · P_e
+        token_frac = jnp.mean(
+            (jnp.sum(dispatch, axis=-1) > 0).astype(jnp.float32), axis=(0, 1))
+        prob_frac = jnp.mean(probs, axis=(0, 1))
+        self.sow("losses", "load_balance",
+                 e * jnp.sum(token_frac * prob_frac))
+
+        # expert compute; weights stacked [E, D, F] — sharded over the
+        # `expert` axis by the partition rules, which makes XLA turn the
+        # dispatch einsum into an all-to-all over ICI.
+        init = nn.initializers.normal(0.02)
+        w_up = self.param("w_up", init, (e, d, cfg.d_ff), cfg.param_dtype)
+        w_down = self.param("w_down", init, (e, cfg.d_ff, d), cfg.param_dtype)
+        expert_in = jnp.einsum("blec,bld->ebcd", dispatch,
+                               x)                            # [E, B, C, D]
+        if cfg.activation == "gelu":
+            h = nn.gelu(jnp.einsum("ebcd,edf->ebcf", expert_in,
+                                   w_up.astype(cfg.dtype)))
+        else:
+            w_gate = self.param("w_gate", init, (e, d, cfg.d_ff),
+                                cfg.param_dtype)
+            h = nn.silu(jnp.einsum("ebcd,edf->ebcf", expert_in,
+                                   w_gate.astype(cfg.dtype))) * jnp.einsum(
+                "ebcd,edf->ebcf", expert_in, w_up.astype(cfg.dtype))
+        out = jnp.einsum("ebcf,efd->ebcd", h, w_down.astype(cfg.dtype))
+        return jnp.einsum("ebcd,blec->bld", out,
+                          combine.astype(cfg.dtype))

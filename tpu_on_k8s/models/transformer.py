@@ -30,7 +30,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from tpu_on_k8s.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_SEQ
+from tpu_on_k8s.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_SEQ,
+)
 from tpu_on_k8s.parallel.partition import PartitionRule
 
 
@@ -54,6 +60,9 @@ class TransformerConfig:
     norm: str = "rms"                  # "rms" | "ln"
     activation: str = "swiglu"         # "swiglu" | "gelu"
     tie_embeddings: bool = False       # lm_head = embed^T (GPT-2/BERT style)
+    n_experts: int = 0                 # >0: MoE MLP (tpu_on_k8s/models/moe.py)
+    experts_top_k: int = 2
+    expert_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -214,7 +223,12 @@ class Block(nn.Module):
         cfg = self.cfg
         h = x + Attention(cfg, name="attn")(
             make_norm(cfg, "attn_norm")(x), positions)
-        out = h + MLP(cfg, name="mlp")(make_norm(cfg, "mlp_norm")(h))
+        if cfg.n_experts > 0:
+            from tpu_on_k8s.models.moe import MoEMLP
+            mlp = MoEMLP(cfg, name="moe")
+        else:
+            mlp = MLP(cfg, name="mlp")
+        out = h + mlp(make_norm(cfg, "mlp_norm")(h))
         return out, None
 
 
@@ -250,7 +264,7 @@ class Transformer(nn.Module):
         # compile time is O(1) in depth and rules see a leading "layers" dim.
         stack = nn.scan(
             block_cls,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "losses": 0},
             split_rngs={"params": True},
             in_axes=nn.broadcast,
             length=cfg.n_layers,
@@ -283,6 +297,10 @@ def flagship_partition_rules() -> List[PartitionRule]:
         # mlp: gate/up column-parallel, down row-parallel
         PartitionRule(r"mlp/w_(gate|up)/kernel", P(None, AXIS_FSDP, AXIS_MODEL)),
         PartitionRule(r"mlp/w_down/kernel", P(None, AXIS_MODEL, AXIS_FSDP)),
+        # MoE: experts over the expert axis, then megatron within each expert
+        PartitionRule(r"moe/router", P(None, AXIS_FSDP, None)),
+        PartitionRule(r"moe/w_(gate|up)$", P(None, AXIS_EXPERT, AXIS_FSDP, AXIS_MODEL)),
+        PartitionRule(r"moe/w_down$", P(None, AXIS_EXPERT, AXIS_MODEL, AXIS_FSDP)),
         # embeddings: vocab-parallel over model, hidden over fsdp
         PartitionRule(r"(^|/)embed$", P(AXIS_MODEL, AXIS_FSDP)),
         PartitionRule(r"pos_embed", P(None, AXIS_FSDP)),

@@ -24,11 +24,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
+AXIS_EXPERT = "expert"
 AXIS_MODEL = "model"
 AXIS_SEQ = "seq"
 
 #: outermost → innermost; innermost axes map to ICI-nearest chips.
-AXIS_ORDER: Tuple[str, ...] = (AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_MODEL)
+AXIS_ORDER: Tuple[str, ...] = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
 
 
 @dataclass(frozen=True)
@@ -40,11 +41,13 @@ class MeshConfig:
     fsdp: int = -1  # default: all remaining chips do FSDP
     model: int = 1
     seq: int = 1
+    expert: int = 1  # expert parallelism (MoE layers shard experts here)
 
     def resolve(self, n_devices: int) -> "MeshConfig":
         """Replace a single -1 with whatever makes the product n_devices."""
         sizes = {AXIS_DATA: self.data, AXIS_FSDP: self.fsdp,
-                 AXIS_MODEL: self.model, AXIS_SEQ: self.seq}
+                 AXIS_MODEL: self.model, AXIS_SEQ: self.seq,
+                 AXIS_EXPERT: self.expert}
         wild = [k for k, v in sizes.items() if v == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one -1 axis allowed, got {wild}")
@@ -59,11 +62,13 @@ class MeshConfig:
             raise ValueError(
                 f"mesh {sizes} needs {fixed} devices but {n_devices} are available")
         return MeshConfig(data=sizes[AXIS_DATA], fsdp=sizes[AXIS_FSDP],
-                          model=sizes[AXIS_MODEL], seq=sizes[AXIS_SEQ])
+                          model=sizes[AXIS_MODEL], seq=sizes[AXIS_SEQ],
+                          expert=sizes[AXIS_EXPERT])
 
     def axis_sizes(self) -> Tuple[int, ...]:
         by_name = {AXIS_DATA: self.data, AXIS_FSDP: self.fsdp,
-                   AXIS_MODEL: self.model, AXIS_SEQ: self.seq}
+                   AXIS_MODEL: self.model, AXIS_SEQ: self.seq,
+                   AXIS_EXPERT: self.expert}
         return tuple(by_name[a] for a in AXIS_ORDER)
 
 

@@ -76,20 +76,35 @@ def make_sharded_init(model: Any, optimizer: optax.GradientTransformation,
 
 
 def make_train_step(model: Any, optimizer: optax.GradientTransformation,
+                    aux_loss_weight: float = 0.0,
                     ) -> Callable[[TrainState, jnp.ndarray], Tuple[TrainState, dict]]:
     """One language-model train step on a [B, L] token batch (next-token CE,
     internal shift). Donates the state buffers. jit shardings propagate from
-    the inputs, so the same compiled step serves any mesh."""
+    the inputs, so the same compiled step serves any mesh.
 
-    def loss_fn(params: Any, tokens: jnp.ndarray) -> jnp.ndarray:
-        logits = model.apply({"params": params}, tokens[:, :-1])
-        return cross_entropy_loss(logits, tokens[:, 1:])
+    ``aux_loss_weight`` > 0 collects the model's ``losses`` collection (MoE
+    load-balance terms, `tpu_on_k8s/models/moe.py`) into the objective.
+    """
+
+    def loss_fn(params: Any, tokens: jnp.ndarray):
+        if aux_loss_weight:
+            logits, out = model.apply({"params": params}, tokens[:, :-1],
+                                      mutable=["losses"])
+            aux = sum(jnp.sum(leaf)
+                      for leaf in jax.tree.leaves(out.get("losses", {})))
+        else:
+            logits = model.apply({"params": params}, tokens[:, :-1])
+            aux = jnp.zeros((), jnp.float32)
+        ce = cross_entropy_loss(logits, tokens[:, 1:])
+        return ce + aux_loss_weight * aux, aux
 
     def step(state: TrainState, tokens: jnp.ndarray) -> Tuple[TrainState, dict]:
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, tokens)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss,
+                   "aux_loss": aux,
                    "grad_norm": optax.global_norm(grads),
                    "step": state.step}
         return TrainState(step=state.step + 1, params=params,
@@ -109,12 +124,14 @@ class Trainer:
 
     def __init__(self, model: Any, rules: Sequence[PartitionRule],
                  mesh: Mesh,
-                 optimizer: Optional[optax.GradientTransformation] = None):
+                 optimizer: Optional[optax.GradientTransformation] = None,
+                 aux_loss_weight: float = 0.0):
         self.model = model
         self.rules = list(rules)
         self.mesh = mesh
         self.optimizer = optimizer or default_optimizer()
-        self._step = make_train_step(self.model, self.optimizer)
+        self._step = make_train_step(self.model, self.optimizer,
+                                     aux_loss_weight)
         self._init_cache = {}
 
     def init_state(self, rng: jax.Array, example_tokens: jnp.ndarray) -> TrainState:
