@@ -1,0 +1,109 @@
+"""Pipeline parallelism: GPipe-style SPMD pipeline over a ``stage`` mesh axis.
+
+The scan-stacked layer parameters (leading ``layers`` dim, the flagship
+model's layout) are sharded over ``stage``; microbatches flow through the
+stages via ``ppermute`` (neighbor ICI transfers). One `lax.scan` over
+M + S - 1 ticks runs the whole pipeline; because ``ppermute`` is
+differentiable, `jax.grad` through this forward IS the reverse-schedule
+backward — no hand-written backward pipeline.
+
+This axis composes with the others: inside a stage the usual fsdp/model
+shardings apply to each layer's parameters, so a mesh like
+(stage=4, fsdp=2) runs 4-deep pipeline with ZeRO-sharded stages.
+
+The reference has no tensor-level parallelism at all (SURVEY.md §2.10); this
+completes the TPU compute plane's dp/fsdp/tp/sp/ep/pp set.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS_STAGE = "stage"
+
+
+def stage_mesh(n_stages: int, per_stage: int = 1,
+               devices=None) -> Mesh:
+    """A (stage, fsdp) mesh: n_stages × per_stage devices."""
+    import numpy as np
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    devs = devs[: n_stages * per_stage]
+    grid = np.asarray(devs, dtype=object).reshape(n_stages, per_stage)
+    return Mesh(grid, (AXIS_STAGE, "fsdp"))
+
+
+def _spec_for_params(tree: Any) -> Any:
+    """Leading (layers) dim over stage; the rest replicated within a stage
+    (compose with fsdp via the caller's own specs if desired)."""
+    return jax.tree.map(lambda _: P(AXIS_STAGE), tree)
+
+
+def gpipe(layer_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+          stacked_params: Any, x: jnp.ndarray, *, mesh: Mesh,
+          n_micro: int, axis_name: str = AXIS_STAGE) -> jnp.ndarray:
+    """Run ``x`` through all stacked layers, pipelined over ``axis_name``.
+
+    ``layer_fn(one_layer_params, h) -> h`` applies a single layer.
+    ``stacked_params`` leaves have leading dim n_layers (divisible by the
+    stage count). ``x``: [B, ...] with B divisible by ``n_micro``.
+    """
+    s = mesh.shape[axis_name]
+    n_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_layers % s != 0:
+        raise ValueError(f"{n_layers} layers not divisible by {s} stages")
+    b = x.shape[0]
+    if b % n_micro != 0:
+        raise ValueError(f"batch {b} not divisible by {n_micro} microbatches")
+
+    micro = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+    param_specs = _spec_for_params(stacked_params)
+
+    def per_stage(params_local: Any, micro_local: jnp.ndarray) -> jnp.ndarray:
+        # params_local: [n_layers/S, ...]; micro_local: [M, Bm, ...] (replicated)
+        stage = jax.lax.axis_index(axis_name)
+        ticks = n_micro + s - 1
+        perm = [(i, (i + 1) % s) for i in range(s)]
+
+        def apply_local(h):
+            def body(h, one_layer):
+                return layer_fn(one_layer, h), None
+            h, _ = jax.lax.scan(body, h, params_local)
+            return h
+
+        bubble = jnp.zeros_like(micro_local[0])
+        outputs0 = jnp.zeros_like(micro_local)
+
+        def tick(carry, t):
+            recv, outputs = carry
+            feed = micro_local[jnp.minimum(t, n_micro - 1)]
+            inp = jnp.where(stage == 0, feed, recv)
+            out = apply_local(inp)
+            # last stage banks microbatch t-(S-1) once the pipe is full
+            out_idx = jnp.clip(t - (s - 1), 0, n_micro - 1)
+            bank = jnp.logical_and(stage == s - 1, t >= s - 1)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(bank, out,
+                          jax.lax.dynamic_index_in_dim(outputs, out_idx, 0,
+                                                       keepdims=False)),
+                out_idx, 0)
+            recv = jax.lax.ppermute(out, axis_name, perm)
+            return (recv, outputs), None
+
+        (recv, outputs), _ = jax.lax.scan(tick, (bubble, outputs0),
+                                          jnp.arange(ticks))
+        del recv
+        # only the last stage banked anything (others hold zeros), so a psum
+        # replicates its outputs to every stage for the P() out_spec.
+        return jax.lax.psum(outputs, axis_name)
+
+    piped = jax.shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(param_specs, P()), out_specs=P(),
+        check_vma=False)
+    out = piped(stacked_params, micro)
+    return out.reshape(x.shape[:1] + out.shape[2:])
