@@ -1,0 +1,29 @@
+# Developer entry points (reference Makefile analog — test/build/run targets;
+# no codegen: serde is reflective, no generated clientset to regenerate).
+
+.PHONY: test test-fast native bench dryrun manager samples clean
+
+test:
+	python -m pytest tests/ -q
+
+test-fast:  ## skip the slow sharded-compile suites
+	python -m pytest tests/ -q -k "not decode and not ring and not moe"
+
+native:  ## build the C++ data pipeline explicitly (also built lazily on import)
+	g++ -O2 -std=c++17 -shared -fPIC \
+	    -o tpu_on_k8s/data/native/build/libtkdata.so \
+	    tpu_on_k8s/data/native/dataloader.cpp -lpthread
+
+bench:
+	python bench.py
+
+dryrun:  ## the driver's multi-chip compile check on a virtual 8-device mesh
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+manager:
+	python -m tpu_on_k8s.main --once
+
+clean:
+	rm -rf tpu_on_k8s/data/native/build .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

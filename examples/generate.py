@@ -1,0 +1,54 @@
+"""Autoregressive generation from a checkpoint (the serving entrypoint)."""
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+from tpu_on_k8s.models.decode import generate
+from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+from tpu_on_k8s.train.checkpoint import CheckpointManager, abstract_train_state
+from tpu_on_k8s.train.trainer import default_optimizer
+from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+from examples.train_llama import CONFIGS
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="generate from a checkpoint")
+    p.add_argument("--config", default="tiny", choices=sorted(CONFIGS))
+    p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    cfg = CONFIGS[args.config]()
+    model = Transformer(cfg)
+    prompt = jax.random.randint(jax.random.key(args.seed),
+                                (1, args.prompt_len), 0, cfg.vocab_size,
+                                dtype=jnp.int32)
+    if args.checkpoint_dir:
+        mesh = create_mesh(MeshConfig(data=1, fsdp=len(jax.devices()),
+                                      model=1, seq=1))
+        from tpu_on_k8s.models.transformer import flagship_partition_rules
+        abstract = abstract_train_state(
+            model, default_optimizer(), mesh, flagship_partition_rules(),
+            prompt)
+        manager = CheckpointManager(args.checkpoint_dir)
+        state, gen, step = manager.restore(abstract)
+        params = state.params
+        print(f"restored generation={gen} step={step}")
+    else:
+        params = model.init(jax.random.key(1), prompt)["params"]
+    out = generate(cfg, params, prompt, args.max_new_tokens,
+                   temperature=args.temperature,
+                   rng=jax.random.key(args.seed + 1))
+    print("prompt:", prompt[0].tolist())
+    print("continuation:", out[0].tolist())
+    return out
+
+
+if __name__ == "__main__":
+    main()

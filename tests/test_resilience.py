@@ -1,0 +1,120 @@
+"""Resilience: threaded runtime soak, chaos failover, structured logging."""
+import io
+import logging as pylogging
+import time
+
+import pytest
+
+from tpu_on_k8s.api.core import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodTemplateSpec,
+)
+from tpu_on_k8s.api.types import RestartPolicy, TaskSpec, TaskType, TPUJob, TPUJobSpec, TPUPolicy
+from tpu_on_k8s.client import KubeletSim
+from tpu_on_k8s.controller.tpujob import submit_job
+from tpu_on_k8s.main import Operator, build_parser
+from tpu_on_k8s.utils.logging import configure, get_logger, kv
+from tpu_on_k8s.utils.profiling import annotate, trace
+
+
+def _job(name, workers=4, restart=RestartPolicy.ON_EXIT_CODE,
+         topology="4x4"):
+    template = PodTemplateSpec(spec=PodSpec(containers=[Container(name="tpu", image="i")]))
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            tasks={TaskType.MASTER: TaskSpec(num_tasks=1, template=template),
+                   TaskType.WORKER: TaskSpec(num_tasks=workers, template=template,
+                                             restart_policy=restart)},
+            tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice",
+                                 topology=topology),
+        ))
+
+
+def test_chaos_retryable_worker_death_recovers():
+    """A worker killed with a retryable exit code (137/OOM analog) is
+    recreated by failover and the job still succeeds."""
+    op = Operator(build_parser().parse_args([]))
+    submit_job(op.cluster, _job("chaos"))
+    sim = KubeletSim(op.cluster)
+    for _ in range(8):
+        op.run_once()
+        sim.run_all("default")
+
+    sim.fail_pod("default", "chaos-worker-2", exit_code=137, reason="OOMKilled")
+    for _ in range(10):
+        op.run_once()
+        sim.run_all("default")
+    pod = op.cluster.get(Pod, "default", "chaos-worker-2")
+    assert pod.status.phase == PodPhase.RUNNING  # recreated + re-run
+
+    for _ in range(10):
+        for p in op.cluster.list(Pod, "default"):
+            if p.status.phase == PodPhase.RUNNING:
+                sim.succeed_pod("default", p.metadata.name)
+        op.run_once()
+    job = op.cluster.get(TPUJob, "default", "chaos")
+    assert any(c.type == "Succeeded" for c in job.status.conditions)
+
+
+def test_threaded_manager_processes_jobs():
+    """Live mode: controllers on worker threads while kubelet sim races them."""
+    op = Operator(build_parser().parse_args(
+        ["--feature-gates", "JobCoordinator=false"]))
+    op.manager.start(workers_per_controller=2)
+    try:
+        sim = KubeletSim(op.cluster)
+        for i in range(3):
+            submit_job(op.cluster, _job(f"soak-{i}", workers=2,
+                                        topology="2x4"))
+        deadline = time.monotonic() + 20
+        done = set()
+        while time.monotonic() < deadline and len(done) < 3:
+            sim.run_all("default")
+            # a real training process only exits after the whole gang is up:
+            # finish a job's pods only once all 3 (master + 2 workers) run
+            by_job = {}
+            for p in op.cluster.list(Pod, "default"):
+                by_job.setdefault(p.metadata.labels.get(
+                    "tpujob.distributed.tpu.io/job-name", ""), []).append(p)
+            for pods in by_job.values():
+                if len(pods) == 3 and all(
+                        p.status.phase == PodPhase.RUNNING for p in pods):
+                    for p in pods:
+                        sim.succeed_pod("default", p.metadata.name)
+            for i in range(3):
+                job = op.cluster.get(TPUJob, "default", f"soak-{i}")
+                if any(c.type == "Succeeded" for c in job.status.conditions):
+                    done.add(i)
+            time.sleep(0.05)
+        assert done == {0, 1, 2}
+    finally:
+        op.manager.stop()
+
+
+def test_structured_logging_format():
+    stream = io.StringIO()
+    configure(stream=stream)
+    log = get_logger("elastic")
+    kv(log, pylogging.INFO, "scale complete", job="ej", hosts=8)
+    out = stream.getvalue()
+    assert "tpu_on_k8s.elastic" in out
+    assert "scale complete" in out and "job=ej" in out and "hosts=8" in out
+
+
+def test_profiling_annotations_run():
+    import jax.numpy as jnp
+    with annotate("unit-test-region"):
+        assert float(jnp.sum(jnp.ones((4,)))) == 4.0
+
+
+def test_profiler_trace_writes(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    with trace(str(tmp_path)):
+        jax.jit(lambda x: x * 2)(jnp.ones((8,))).block_until_ready()
+    assert any(tmp_path.rglob("*")), "no trace artifacts written"

@@ -161,9 +161,14 @@ class TPUJobHooks:
                 env(constants.ENV_NUM_PROCESSES, str(world))
             if tpu.num_slices > 1:
                 hosts_per = topology.hosts_per_slice(tpu.accelerator, tpu.topology)
+                # Workers tile the slices (the gang quorum is worker-only, so
+                # worker index — not the master-shifted rank — picks the
+                # slice); master/AIMaster coordinate from slice 0.
+                slice_id = (index // max(hosts_per, 1)
+                            if task_type == TaskType.WORKER else 0)
                 env(constants.ENV_MEGASCALE_COORDINATOR, self._coordinator_address(job, port))
                 env(constants.ENV_MEGASCALE_NUM_SLICES, str(tpu.num_slices))
-                env(constants.ENV_MEGASCALE_SLICE_ID, str(rank // max(hosts_per, 1)))
+                env(constants.ENV_MEGASCALE_SLICE_ID, str(slice_id))
 
         ep = job.spec.elastic_policy
         if ep is not None and task_type in (TaskType.MASTER, TaskType.WORKER):

@@ -1,0 +1,45 @@
+"""Host→device prefetch: overlap the next batch's H2D copy with compute.
+
+The native loader (`tpu_on_k8s/data/loader.py`) assembles batches on worker
+threads; this generator keeps ``depth`` batches ahead of the training loop as
+*sharded device arrays*, so the `jax.device_put` (DMA to HBM) of batch N+1
+runs while step N computes. The standard flax prefetch pattern, applied to
+the framework's own loader and shardings.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterable, Iterator, Optional
+
+import jax
+
+
+def device_prefetch(batches: Iterable, sharding, depth: int = 2,
+                    transform: Optional[Callable] = None) -> Iterator:
+    """Yield device-resident batches, keeping ``depth`` in flight.
+
+    ``sharding`` is a NamedSharding (e.g. ``batch_sharding(mesh, shape)``) or
+    a pytree of them matching each batch's structure. ``transform`` runs on
+    host (numpy) before the transfer — e.g. normalize / split image+label.
+    """
+    queue = collections.deque()
+    it = iter(batches)
+
+    def enqueue(n: int) -> None:
+        for _ in range(n):
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            if transform is not None:
+                batch = transform(batch)
+            queue.append(jax.tree.map(
+                lambda leaf: jax.device_put(leaf, sharding), batch)
+                if not isinstance(batch, tuple) else
+                tuple(jax.device_put(leaf, sharding) for leaf in batch))
+
+    enqueue(depth)
+    while queue:
+        out = queue.popleft()
+        enqueue(1)
+        yield out

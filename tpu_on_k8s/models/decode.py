@@ -1,0 +1,85 @@
+"""Autoregressive generation with a KV cache (the serving path).
+
+Prefill runs the whole prompt through the decode-mode model in one call
+(cache fills at positions [0, len)); each generation step then attends over
+the cache with a single-token query — O(L) per token instead of O(L²). The
+step loop is a ``lax.scan`` under jit, so the whole generation is one
+compiled program with static shapes (cache length = ``max_seq_len``),
+exactly what XLA wants on TPU.
+
+The reference operator has no serving path beyond building an OCI image of
+the trained artifact (SURVEY.md §3.5); this gives the framework an actual
+inference entry point for the models it trains.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+
+
+def decode_model(cfg: TransformerConfig) -> Transformer:
+    """The same architecture in KV-cache mode (plain attention; flash/ring
+    are training-shape kernels, pointless for single-token queries)."""
+    return Transformer(dataclasses.replace(
+        cfg, decode=True, remat=False, attn_impl="xla"))
+
+
+def init_cache(model: Transformer, batch: int) -> dict:
+    """Zeroed cache pytree for a given generation batch size."""
+    tokens = jnp.zeros((batch, 1), jnp.int32)
+    variables = model.init(jax.random.key(0), tokens,
+                           jnp.zeros((batch, 1), jnp.int32))
+    return jax.tree.map(jnp.zeros_like, variables["cache"])
+
+
+def generate(cfg: TransformerConfig, params, prompt: jnp.ndarray,
+             max_new_tokens: int, temperature: float = 0.0,
+             rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Greedy (temperature=0) or sampled continuation of ``prompt`` [B, Lp].
+
+    Returns [B, max_new_tokens]. Total length must fit ``cfg.max_seq_len``.
+    """
+    b, lp = prompt.shape
+    if lp + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {lp} + new {max_new_tokens} exceeds max_seq_len "
+            f"{cfg.max_seq_len}")
+    model = decode_model(cfg)
+    cache = init_cache(model, b)
+    rng = rng if rng is not None else jax.random.key(0)
+
+    def pick(logits: jnp.ndarray, step_rng: jax.Array) -> jnp.ndarray:
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            step_rng, logits / temperature, axis=-1).astype(jnp.int32)
+
+    @jax.jit
+    def run(params, prompt, cache, rng):
+        positions = jnp.broadcast_to(jnp.arange(lp), (b, lp))
+        logits, upd = model.apply({"params": params, "cache": cache},
+                                  prompt, positions, mutable=["cache"])
+        first = pick(logits[:, -1], rng)
+
+        def step(carry, step_rng):
+            cache, tok, pos = carry
+            logits, upd = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                pos[:, None], mutable=["cache"])
+            nxt = pick(logits[:, -1], step_rng)
+            return (upd["cache"], nxt, pos + 1), tok
+
+        pos0 = jnp.full((b,), lp, jnp.int32)
+        # each step consumes the previously generated token and emits it;
+        # after max_new_tokens steps the emitted stack IS the continuation.
+        _, toks = jax.lax.scan(
+            step, (upd["cache"], first, pos0),
+            jax.random.split(rng, max_new_tokens))
+        return toks.transpose(1, 0)
+
+    return run(params, prompt, cache, rng)

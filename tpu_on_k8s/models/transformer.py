@@ -63,6 +63,7 @@ class TransformerConfig:
     n_experts: int = 0                 # >0: MoE MLP (tpu_on_k8s/models/moe.py)
     experts_top_k: int = 2
     expert_capacity_factor: float = 1.25
+    decode: bool = False               # KV-cache autoregressive mode
 
     @property
     def head_dim(self) -> int:
@@ -189,11 +190,41 @@ class Attention(nn.Module):
         # GQA: repeat kv groups up to n_heads before the kernel; XLA folds the
         # broadcast into the einsum so no HBM copy materialises.
         rep = cfg.n_heads // cfg.n_kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-        out = _select_attention(cfg.attn_impl)(q, k, v, causal=True)
+        if cfg.decode:
+            out = self._cached_attention(q, k, v, positions, rep)
+        else:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+            out = _select_attention(cfg.attn_impl)(q, k, v, causal=True)
         out = out.reshape(b, l, cfg.n_heads * cfg.head_dim)
         return dense(cfg.d_model, "wo")(out)
+
+    def _cached_attention(self, q, k, v, positions, rep: int) -> jnp.ndarray:
+        """KV-cache attention: append this call's keys/values at the cache
+        cursor, attend over every cached position ≤ the query position.
+        Serves both prefill (L>1) and single-token steps (L=1)."""
+        cfg = self.cfg
+        b, l = q.shape[0], q.shape[1]
+        shape = (b, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim)
+        ck = self.variable("cache", "k", jnp.zeros, shape, k.dtype)
+        cv = self.variable("cache", "v", jnp.zeros, shape, v.dtype)
+        cursor = self.variable("cache", "index",
+                               lambda: jnp.zeros((), jnp.int32))
+        start = cursor.value
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, start, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
+        cursor.value = start + l
+        k_all = jnp.repeat(ck.value, rep, axis=2)    # [B, max, H, Dh]
+        v_all = jnp.repeat(cv.value, rep, axis=2)
+        scale = cfg.head_dim ** -0.5
+        logits = jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32) * scale,
+                            k_all.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+        k_pos = jnp.arange(cfg.max_seq_len)
+        mask = k_pos[None, None, None, :] <= positions[:, None, :, None]
+        probs = jax.nn.softmax(
+            jnp.where(mask, logits, -1e30), axis=-1).astype(q.dtype)
+        return jnp.einsum("bhlm,bmhd->blhd", probs, v_all)
 
 
 class MLP(nn.Module):
@@ -238,8 +269,12 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, tokens: jnp.ndarray,
+                 positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1]), tokens.shape)
         embed = self.param("embed", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
         x = jnp.take(embed, tokens, axis=0)
@@ -247,10 +282,8 @@ class Transformer(nn.Module):
             pos_table = self.param("pos_embed", nn.initializers.normal(0.02),
                                    (cfg.max_seq_len, cfg.d_model),
                                    cfg.param_dtype)
-            x = x + pos_table[None, :tokens.shape[1]]
+            x = x + jnp.take(pos_table, positions, axis=0)
         x = x.astype(cfg.dtype)
-        positions = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1]), tokens.shape)
 
         if cfg.remat:
             # "dots": keep matmul outputs resident, recompute only the cheap
@@ -264,7 +297,7 @@ class Transformer(nn.Module):
         # compile time is O(1) in depth and rules see a leading "layers" dim.
         stack = nn.scan(
             block_cls,
-            variable_axes={"params": 0, "losses": 0},
+            variable_axes={"params": 0, "losses": 0, "cache": 0},
             split_rngs={"params": True},
             in_axes=nn.broadcast,
             length=cfg.n_layers,

@@ -1,0 +1,36 @@
+"""Profiling hooks: XLA trace capture + profiler server (pprof analog).
+
+The reference has no profiling at all (SURVEY.md §5.1). On TPU the tool is
+the XLA profiler: ``trace("/dir")`` around training steps writes a
+TensorBoard-loadable trace (MXU utilization, HBM traffic, collective
+timelines); ``start_server(port)`` lets an external profiler attach live.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+def start_server(port: int):
+    """Start the JAX profiler server (attach with TensorBoard / xprof)."""
+    import jax
+
+    return jax.profiler.start_server(port)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture an XLA trace of the enclosed steps into ``log_dir``."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region that shows up on the trace timeline."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
