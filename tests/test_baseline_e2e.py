@@ -1,0 +1,73 @@
+"""End-to-end closures for the BASELINE benchmark configs' orchestration
+stories: WRR-coordinated multi-queue (Llama config) and the elastic-metrics
+contract between the example trainers and the autoscaler."""
+import io
+import logging
+
+from tpu_on_k8s.api.core import Container, ObjectMeta, Pod, PodPhase, PodSpec, PodTemplateSpec
+from tpu_on_k8s.api.types import (
+    SchedulingPolicy,
+    RunPolicy,
+    TaskSpec,
+    TaskType,
+    TPUJob,
+    TPUJobSpec,
+    TPUPolicy,
+)
+from tpu_on_k8s.client import KubeletSim
+from tpu_on_k8s.controller.autoscaler import parse_observation
+from tpu_on_k8s.controller.tpujob import submit_job
+from tpu_on_k8s.main import Operator, build_parser
+
+
+def _queued_job(name, queue):
+    template = PodTemplateSpec(spec=PodSpec(containers=[Container(name="tpu", image="i")]))
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            tasks={TaskType.MASTER: TaskSpec(num_tasks=1, template=template),
+                   TaskType.WORKER: TaskSpec(num_tasks=2, template=template)},
+            run_policy=RunPolicy(scheduling_policy=SchedulingPolicy(queue=queue)),
+            tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice",
+                                 topology="2x4"),
+        ))
+
+
+def test_two_wrr_queues_both_drain_to_success():
+    """BASELINE config 5's orchestration half: two jobs in two tenant queues,
+    WRR-coordinated, both gang-admitted and trained to success."""
+    op = Operator(build_parser().parse_args([]))
+    assert op.coordinator is not None
+    submit_job(op.cluster, _queued_job("llama-a", "llama-queue-a"))
+    submit_job(op.cluster, _queued_job("llama-b", "llama-queue-b"))
+    sim = KubeletSim(op.cluster)
+    for _ in range(12):
+        op.run_once()   # includes a coordinator schedule pass
+        sim.run_all("default")
+    for _ in range(12):
+        for p in op.cluster.list(Pod, "default"):
+            if p.status.phase == PodPhase.RUNNING:
+                sim.succeed_pod("default", p.metadata.name)
+        op.run_once()
+    for name in ("llama-a", "llama-b"):
+        job = op.cluster.get(TPUJob, "default", name)
+        assert any(c.type == "Succeeded" for c in job.status.conditions), name
+
+
+def test_steptimer_line_parses_as_observation(capsys):
+    """The contract between examples/common.StepTimer and the autoscaler's
+    log scraper: the emitted line must round-trip through parse_observation."""
+    from examples.common import StepTimer
+    from tpu_on_k8s.train.distributed import DistributedContext
+
+    import time
+
+    timer = StepTimer(tokens_per_step=4096, ctx=DistributedContext())
+    time.sleep(0.02)
+    timer.report(step=7, loss=2.5, accuracy=0.75)
+    line = capsys.readouterr().out.strip()
+    obs = parse_observation(line)
+    assert obs is not None
+    assert obs.batch == 7
+    assert obs.latency > 0
+    assert obs.accuracy == 0.75
