@@ -300,3 +300,38 @@ class TestLifecycle:
         manager.run_until_idle()
         assert cluster.try_get(TPUJob, "default", "j1") is None
         assert pods_of(cluster) == []
+
+
+class TestSpotAndDeadline:
+    def test_spot_task_spec_applies_to_trailing_replicas(self):
+        from tpu_on_k8s.api.types import SpotTaskSpec
+
+        cluster, manager, engine, sim = make_env()
+        spec = job_spec(workers=4, master=False)
+        spec.spec.tasks[TaskType.WORKER].spot_task_spec = SpotTaskSpec(
+            num_spot_tasks=2, priority_class_name="spot-priority",
+            labels={"capacity-type": "spot"})
+        submit_job(cluster, spec)
+        manager.run_until_idle()
+        pods = pods_of(cluster)
+        assert len(pods) == 4
+        spot = [p for p in pods if p.spec.priority_class_name == "spot-priority"]
+        on_demand = [p for p in pods if not p.spec.priority_class_name]
+        # trailing 2 replicas run at spot priority (reference pod.go:592-603)
+        assert sorted(p.metadata.name for p in spot) == ["j1-worker-2", "j1-worker-3"]
+        assert len(on_demand) == 2
+        for p in spot:
+            assert p.metadata.labels.get("capacity-type") == "spot"
+
+    def test_active_deadline_fails_running_job(self):
+        cluster, manager, engine, sim = make_env()
+        spec = job_spec(workers=1, master=False)
+        spec.spec.run_policy.active_deadline_seconds = 0
+        submit_job(cluster, spec)
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        job = cluster.get(TPUJob, "default", "j1")
+        assert conditions.is_failed(job.status)
+        failed = conditions.get_condition(job.status, JobConditionType.FAILED)
+        assert "deadline" in (failed.reason + failed.message).lower()
