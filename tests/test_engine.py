@@ -335,3 +335,75 @@ class TestSpotAndDeadline:
         assert conditions.is_failed(job.status)
         failed = conditions.get_condition(job.status, JobConditionType.FAILED)
         assert "deadline" in (failed.reason + failed.message).lower()
+
+
+def make_restart_env():
+    from tpu_on_k8s.controller.failover import InMemoryRestarter
+
+    cluster = InMemoryCluster()
+    manager = Manager()
+    engine = setup_tpujob_controller(cluster, manager,
+                                     restarter=InMemoryRestarter())
+    return cluster, manager, engine, KubeletSim(cluster)
+
+
+class TestSliceAtomicFailover:
+    def test_siblings_restart_with_failed_host(self):
+        """2x4 topology = 2 hosts/slice: failing worker-0 in-place restarts
+        worker-1 (its slice sibling) so both re-enter rendezvous together."""
+        from tpu_on_k8s.api.types import RestartPolicy
+
+        cluster, manager, engine, sim = make_restart_env()
+        spec = job_spec(workers=2, master=False)
+        spec.spec.tasks[TaskType.WORKER].restart_policy = RestartPolicy.ON_EXIT_CODE
+        submit_job(cluster, spec)
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        sim.fail_pod("default", "j1-worker-0", exit_code=137, reason="OOMKilled")
+        manager.run_until_idle()
+        sibling = cluster.get(Pod, "default", "j1-worker-1")
+        assert sibling.status.phase == "Running"
+        assert sum(cs.restart_count for cs in sibling.status.container_statuses) == 1
+
+    def test_other_slice_untouched(self):
+        """num_slices=2 (4 workers, 2 per slice): a slice-0 failure leaves
+        slice 1's workers alone."""
+        cluster, manager, engine, sim = make_restart_env()
+        spec = job_spec(workers=4, master=False, num_slices=2)
+        from tpu_on_k8s.api.types import RestartPolicy
+        spec.spec.tasks[TaskType.WORKER].restart_policy = RestartPolicy.ON_EXIT_CODE
+        submit_job(cluster, spec)
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        sim.fail_pod("default", "j1-worker-1", exit_code=137, reason="OOMKilled")
+        manager.run_until_idle()
+        w0 = cluster.get(Pod, "default", "j1-worker-0")
+        assert sum(cs.restart_count for cs in w0.status.container_statuses) == 1
+        for name in ("j1-worker-2", "j1-worker-3"):
+            w = cluster.get(Pod, "default", name)
+            assert sum(cs.restart_count for cs in w.status.container_statuses) == 0
+
+    def test_disabled_by_config(self):
+        from tpu_on_k8s.controller.config import JobControllerConfig
+        from tpu_on_k8s.controller.runtime import Manager
+        from tpu_on_k8s.api.types import RestartPolicy
+
+        cluster = InMemoryCluster()
+        manager = Manager()
+        from tpu_on_k8s.controller.failover import InMemoryRestarter
+        engine = setup_tpujob_controller(
+            cluster, manager, restarter=InMemoryRestarter(),
+            config=JobControllerConfig(slice_atomic_failover=False))
+        sim = KubeletSim(cluster)
+        spec = job_spec(workers=2, master=False)
+        spec.spec.tasks[TaskType.WORKER].restart_policy = RestartPolicy.ON_EXIT_CODE
+        submit_job(cluster, spec)
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        sim.fail_pod("default", "j1-worker-0", exit_code=137, reason="OOMKilled")
+        manager.run_until_idle()
+        sibling = cluster.get(Pod, "default", "j1-worker-1")
+        assert sum(cs.restart_count for cs in sibling.status.container_statuses) == 0

@@ -27,6 +27,9 @@ class JobControllerConfig:
     # before reverting (the reference polls up to 1min, elastic_scale.go:440).
     elastic_pending_grace_ticks: int = 2
     failover_concurrency: int = 50                 # failover.go semaphore widths
+    # TPU-first: one dead host kills its slice's SPMD program — restart the
+    # slice's surviving workers together (SURVEY §5.3 TPU note).
+    slice_atomic_failover: bool = True
     scale_concurrency: int = 100                   # elastic_scale.go:258
     victim_cleanup_concurrency: int = 10           # elastic_scale.go:492
     expectation_ttl_seconds: float = 300.0

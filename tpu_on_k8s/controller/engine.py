@@ -424,6 +424,7 @@ class JobEngine:
                 # In-place restarts surface in container restart_count, which
                 # restart_count() already sums — recording a failover too would
                 # double-count toward the backoff limit.
+                self._failover_slice_siblings(job, task_type, pod)
                 return
             self.record_failover(job)
         else:
@@ -433,6 +434,60 @@ class JobEngine:
                 # Pod vanished under us: drain the expectation we just raised
                 # or the job wedges until the expectation TTL.
                 self.expectations.deletion_observed(exp_key)
+        self._failover_slice_siblings(job, task_type, pod)
+
+    def _failover_slice_siblings(self, job: TPUJob, task_type: TaskType,
+                                 failed: Pod) -> None:
+        """Slice-atomic failover (SURVEY §5.3 TPU note): a TPU slice runs one
+        SPMD program, so one dead host kills every host's step loop in that
+        slice — in-place-restart the slice's surviving workers so they
+        re-enter rendezvous together instead of hanging on a dead collective.
+        The reference restarts only the failed pod (its DDP ranks were
+        independent processes); on TPU the slice is the failure domain."""
+        from tpu_on_k8s.gang import topology as tpu_topology
+
+        if not self.config.slice_atomic_failover:
+            return
+        if task_type is not TaskType.WORKER:
+            return
+        tpu = job.spec.tpu_policy
+        try:
+            hosts_per = tpu_topology.hosts_per_slice(tpu.accelerator, tpu.topology)
+        except (KeyError, ValueError):
+            return
+        if hosts_per <= 1:
+            return
+        try:
+            failed_idx = int(failed.metadata.labels.get(
+                constants.LABEL_TASK_INDEX, "-1"))
+        except ValueError:
+            return
+        if failed_idx < 0:
+            return
+        slice_id = failed_idx // hosts_per
+        selector = {constants.LABEL_JOB_NAME: job.metadata.name,
+                    constants.LABEL_TASK_TYPE: TaskType.WORKER.value.lower()}
+        restarted = 0
+        for sibling in self.cluster.list(Pod, job.metadata.namespace, selector):
+            if sibling.metadata.name == failed.metadata.name:
+                continue
+            try:
+                idx = int(sibling.metadata.labels.get(
+                    constants.LABEL_TASK_INDEX, "-1"))
+            except ValueError:
+                continue
+            if idx // hosts_per != slice_id:
+                continue
+            if sibling.status.phase != PodPhase.RUNNING:
+                continue
+            if failover.failover_inplace_restart(self.cluster, sibling,
+                                                 self.restarter):
+                restarted += 1
+        if restarted:
+            self.cluster.record_event(
+                job, "Normal", "SliceFailover",
+                f"slice {slice_id}: restarted {restarted} surviving host(s) "
+                f"after {failed.metadata.name} failed")
 
     def reconcile_services(
         self,
