@@ -1,0 +1,123 @@
+"""Int8 quantization Pallas kernels (stochastic rounding on the TPU PRNG).
+
+Row-wise symmetric int8: each row gets a scale = max|x| / 127 and values are
+rounded stochastically using the per-core PRNG — unbiased in expectation, so
+quantization noise averages out across steps/elements instead of biasing
+norms. Use cases: checkpoint/optimizer-state compression (4× smaller than
+fp32) and int8 weight shipping for serving.
+
+Runs in interpret mode on CPU (same code path, test-covered without TPU).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret():
+    # the TPU-flavored interpreter implements pltpu.prng_* on CPU; plain
+    # interpret=True does not
+    return pltpu.InterpretParams() if jax.default_backend() == "cpu" else False
+
+
+def _quant_kernel(x_ref, seed_ref, values_ref, scales_ref):
+    pltpu.prng_seed(seed_ref[0])
+    x = x_ref[...].astype(jnp.float32)                  # [rows, cols]
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-30) / 127.0
+    scaled = x / scale
+    # stochastic rounding from raw PRNG bits (VPU ops — identical semantics
+    # compiled and interpreted): round down + bernoulli(frac) carry
+    bits = pltpu.bitcast(pltpu.prng_random_bits(scaled.shape), jnp.uint32)
+    uniform = (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))  # [0, 1)
+    lo = jnp.floor(scaled)
+    rounded = lo + (uniform < (scaled - lo)).astype(jnp.float32)
+    values_ref[...] = jnp.clip(rounded, -127.0, 127.0).astype(jnp.int8)
+    scales_ref[...] = scale
+
+
+def _dequant_kernel(values_ref, scales_ref, out_ref, *, dtype):
+    out_ref[...] = (values_ref[...].astype(jnp.float32)
+                    * scales_ref[...]).astype(dtype)
+
+
+def quantize_int8(x: jnp.ndarray, seed: int = 0,
+                  block_rows: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[R, C] float → (int8 values [R, C], fp32 scales [R, 1]), row-wise."""
+    r, c = x.shape
+    br = min(block_rows, r)
+    if r % br != 0:
+        br = r  # fall back to a single block for ragged row counts
+    grid = (r // br,)
+    seed_arr = jnp.array([seed], jnp.int32)
+    return pl.pallas_call(
+        _quant_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((r, c), jnp.int8),
+            jax.ShapeDtypeStruct((r, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x, seed_arr)
+
+
+def dequantize_int8(values: jnp.ndarray, scales: jnp.ndarray,
+                    dtype=jnp.float32, block_rows: int = 256) -> jnp.ndarray:
+    """Inverse of ``quantize_int8``."""
+    r, c = values.shape
+    br = min(block_rows, r)
+    if r % br != 0:
+        br = r
+    grid = (r // br,)
+    return pl.pallas_call(
+        functools.partial(_dequant_kernel, dtype=dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, c), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((r, c), dtype),
+        interpret=_interpret(),
+    )(values, scales)
+
+
+def quantize_pytree(tree, seed: int = 0):
+    """Row-quantize every ≥2D leaf (1D/scalars stay fp32); returns a pytree
+    of (values, scales) pairs mirrored by ``dequantize_pytree``."""
+    def q(leaf):
+        arr = jnp.asarray(leaf)
+        if arr.ndim < 2 or not jnp.issubdtype(arr.dtype, jnp.floating):
+            return ("raw", arr)
+        flat = arr.reshape(-1, arr.shape[-1])
+        values, scales = quantize_int8(flat, seed=seed)
+        return ("q8", (values, scales, arr.shape, str(arr.dtype)))
+
+    return jax.tree.map(q, tree, is_leaf=lambda x: isinstance(x, jnp.ndarray))
+
+
+def dequantize_pytree(tree):
+    def dq(entry):
+        kind, payload = entry
+        if kind == "raw":
+            return payload
+        values, scales, shape, dtype = payload
+        return dequantize_int8(values, scales,
+                               dtype=jnp.dtype(dtype)).reshape(shape)
+
+    return jax.tree.map(dq, tree,
+                        is_leaf=lambda x: isinstance(x, tuple) and len(x) == 2
+                        and x[0] in ("raw", "q8"))
