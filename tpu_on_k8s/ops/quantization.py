@@ -34,7 +34,9 @@ def _quant_kernel(x_ref, seed_ref, values_ref, scales_ref):
     # stochastic rounding from raw PRNG bits (VPU ops — identical semantics
     # compiled and interpreted): round down + bernoulli(frac) carry
     bits = pltpu.bitcast(pltpu.prng_random_bits(scaled.shape), jnp.uint32)
-    uniform = (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))  # [0, 1)
+    # Mosaic has no uint32→f32 cast; >>8 keeps 24 bits, safe through int32.
+    bits24 = pltpu.bitcast(bits >> 8, jnp.int32)
+    uniform = bits24.astype(jnp.float32) * (1.0 / (1 << 24))       # [0, 1)
     lo = jnp.floor(scaled)
     rounded = lo + (uniform < (scaled - lo)).astype(jnp.float32)
     values_ref[...] = jnp.clip(rounded, -127.0, 127.0).astype(jnp.int8)
