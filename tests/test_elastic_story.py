@@ -1,0 +1,83 @@
+"""The full elastic-rescale story across both planes (SURVEY §3.3 + §5.4):
+
+preemption → controller requests checkpoint (annotation) → AIMaster-side
+agent saves REAL sharded state via orbax → controller observes completion,
+cleans victims, bumps generation and re-specs hosts → the compute plane
+restores the checkpoint onto the new (smaller) mesh and keeps training.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tests.test_elastic import elastic_job, make_env, start_running
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.types import TPUJob
+from tpu_on_k8s.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    flagship_partition_rules,
+)
+from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+from tpu_on_k8s.train.checkpoint import (
+    CheckpointAgent,
+    CheckpointManager,
+    abstract_train_state,
+)
+from tpu_on_k8s.train.trainer import Trainer, default_optimizer
+
+
+def test_preemption_checkpoint_rescale_resume(tmp_path):
+    cluster, manager, engine, sim, elastic = make_env()
+    start_running(cluster, manager, sim, name="story")
+
+    # ---- compute plane at generation 0: 8-way fsdp mesh, train 2 steps
+    cfg = TransformerConfig.tiny()
+    model = Transformer(cfg)
+    opt = default_optimizer(warmup_steps=1, decay_steps=10)
+    mesh8 = create_mesh(MeshConfig(data=1, fsdp=8, model=1, seq=1))
+    trainer = Trainer(model, flagship_partition_rules(), mesh8, opt)
+    tokens = jax.random.randint(jax.random.key(0), (8, 65), 0,
+                                cfg.vocab_size, jnp.int32)
+    state = trainer.init_state(jax.random.key(1), tokens[:, :-1])
+    for _ in range(2):
+        state, _ = trainer.train_step(state, trainer.shard_batch(tokens))
+
+    # the AIMaster-side agent persists on controller request
+    mgr = CheckpointManager(str(tmp_path))
+    agent = CheckpointAgent(
+        cluster, "default", "story",
+        lambda gen: mgr.save(state, step=int(state.step), generation=gen))
+
+    # ---- preempt two workers → controller requests a checkpoint
+    from tpu_on_k8s.api.core import Pod
+    for name in ("story-worker-6", "story-worker-7"):
+        pod = cluster.get(Pod, "default", name)
+        assert constants.FINALIZER_PREEMPT_PROTECTOR in pod.metadata.finalizers
+        cluster.delete(Pod, "default", name)  # blocked by finalizer → victim
+    manager.run_until_idle()
+    job = cluster.get(TPUJob, "default", "story")
+    requested = job.metadata.annotations.get(
+        constants.ANNOTATION_CKPT_REQUESTED_VERSION)
+    assert requested is not None
+
+    # ---- agent saves + acks; controller cleans victims and bumps generation
+    assert agent.poll_once() == int(requested)
+    manager.run_until_idle()
+    job = cluster.get(TPUJob, "default", "story")
+    assert job.metadata.generation > int(requested)
+    assert mgr.latest() is not None
+
+    # ---- compute plane at the new generation: restore onto a 4-way mesh
+    mesh4 = create_mesh(MeshConfig(data=1, fsdp=4, model=1, seq=1),
+                        jax.devices()[:4])
+    abstract = abstract_train_state(model, opt, mesh4,
+                                    flagship_partition_rules(),
+                                    tokens[:, :-1])
+    restored, gen, step = mgr.restore(abstract)
+    assert step == int(state.step)
+    trainer4 = Trainer(model, flagship_partition_rules(), mesh4, opt)
+    restored, metrics = trainer4.train_step(restored,
+                                            trainer4.shard_batch(tokens))
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(restored.step) == step + 1
+    mgr.close()
