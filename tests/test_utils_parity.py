@@ -1,0 +1,78 @@
+"""§2.9 utility parity: semaphore/bounded map, kubeconfig resolution."""
+import threading
+import time
+
+import pytest
+
+from tpu_on_k8s.client.kubeconfig import ClusterConfig, resolve
+from tpu_on_k8s.utils.concurrent import Semaphore, bounded_map
+
+
+def test_bounded_map_respects_width_and_order():
+    active = []
+    peak = [0]
+    lock = threading.Lock()
+
+    def work(i):
+        with lock:
+            active.append(i)
+            peak[0] = max(peak[0], len(active))
+        time.sleep(0.01)
+        with lock:
+            active.remove(i)
+        return i * 2
+
+    out = bounded_map(work, range(20), width=5)
+    assert [r for r, e in out] == [i * 2 for i in range(20)]
+    assert all(e is None for _, e in out)
+    assert peak[0] <= 5
+
+
+def test_bounded_map_collects_errors():
+    def work(i):
+        if i == 3:
+            raise RuntimeError("boom")
+        return i
+
+    out = bounded_map(work, range(5), width=2)
+    assert out[3][0] is None and isinstance(out[3][1], RuntimeError)
+    assert [r for r, _ in out if r is not None] == [0, 1, 2, 4]
+
+
+def test_semaphore_wait_blocks_until_released():
+    sem = Semaphore(2)
+    sem.acquire()
+    sem.acquire()
+    done = []
+
+    def finish():
+        time.sleep(0.02)
+        sem.release()
+        sem.release()
+        done.append(True)
+
+    t = threading.Thread(target=finish)
+    t.start()
+    sem.wait()
+    t.join()
+    assert done == [True]
+
+
+def test_kubeconfig_explicit_env(tmp_path):
+    cfg_file = tmp_path / "kc"
+    cfg_file.write_text("apiVersion: v1")
+    got = resolve({"KUBECONFIG": str(cfg_file), "HOME": str(tmp_path)})
+    assert got.mode == "kubeconfig"
+    assert got.kubeconfig_path == str(cfg_file)
+
+
+def test_kubeconfig_default_home(tmp_path):
+    (tmp_path / ".kube").mkdir()
+    (tmp_path / ".kube" / "config").write_text("apiVersion: v1")
+    got = resolve({"HOME": str(tmp_path)})
+    assert got.mode == "kubeconfig"
+
+
+def test_kubeconfig_none(tmp_path):
+    got = resolve({"HOME": str(tmp_path)})
+    assert got == ClusterConfig(mode="none")

@@ -1,0 +1,43 @@
+"""Cluster connection config resolution (reference pkg/utils/kubeconfig).
+
+The in-memory backend needs nothing; a live GKE backend resolves its API
+server + credentials the standard way: ``$KUBECONFIG`` (or ``~/.kube/config``)
+when running off-cluster, the mounted service-account when in-cluster
+(reference kubeconfig.go:33-56). This module does the resolution without
+importing any kubernetes client — the backend consumes the returned paths.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+IN_CLUSTER_TOKEN = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+IN_CLUSTER_CA = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+
+
+@dataclass(frozen=True)
+class ClusterConfig:
+    mode: str                       # "in-cluster" | "kubeconfig" | "none"
+    kubeconfig_path: Optional[str] = None
+    api_host: Optional[str] = None
+    token_path: Optional[str] = None
+    ca_path: Optional[str] = None
+
+
+def resolve(env: Optional[dict] = None) -> ClusterConfig:
+    """Kubeconfig env var → default path → in-cluster mount → none."""
+    env = os.environ if env is None else env
+    explicit = env.get("KUBECONFIG")
+    if explicit and Path(explicit).exists():
+        return ClusterConfig(mode="kubeconfig", kubeconfig_path=explicit)
+    default = Path(env.get("HOME", "/root")) / ".kube" / "config"
+    if default.exists():
+        return ClusterConfig(mode="kubeconfig", kubeconfig_path=str(default))
+    host = env.get("KUBERNETES_SERVICE_HOST")
+    if host and Path(IN_CLUSTER_TOKEN).exists():
+        port = env.get("KUBERNETES_SERVICE_PORT", "443")
+        return ClusterConfig(mode="in-cluster", api_host=f"https://{host}:{port}",
+                             token_path=IN_CLUSTER_TOKEN, ca_path=IN_CLUSTER_CA)
+    return ClusterConfig(mode="none")
