@@ -13,6 +13,10 @@ a small hand-written client API (`ClusterClient`) with two backends:
   the k8s REST API; the controllers never know the difference.
 """
 
+import tpu_on_k8s.api  # noqa: F401  — anchors the api→defaults→gang→client
+                       # import cycle so `import tpu_on_k8s.client.*` works
+                       # as the first framework import
+
 from tpu_on_k8s.client.cluster import (
     ApiError,
     ConflictError,
