@@ -55,7 +55,7 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32     # master weights
     remat: bool = True
     remat_policy: str = "full"         # "full" | "dots" (save MXU outputs)
-    attn_impl: str = "xla"             # "xla" | "flash" | "ring"
+    attn_impl: str = "xla"             # "xla" | "flash" | "ring" | "ulysses"
     pos_emb: str = "rope"              # "rope" | "learned" (GPT-2 family)
     norm: str = "rms"                  # "rms" | "ln"
     activation: str = "swiglu"         # "swiglu" | "gelu"
@@ -142,6 +142,13 @@ def _select_attention(impl: str) -> Callable[..., jnp.ndarray]:
             raise NotImplementedError(
                 "attn_impl='ring' requires tpu_on_k8s.parallel.ring") from e
         return ring_attention
+    if impl == "ulysses":
+        try:
+            from tpu_on_k8s.parallel.ulysses import ulysses_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                "attn_impl='ulysses' requires tpu_on_k8s.parallel.ulysses") from e
+        return ulysses_attention
     raise ValueError(f"unknown attn_impl {impl!r}")
 
 

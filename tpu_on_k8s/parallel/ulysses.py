@@ -1,0 +1,65 @@
+"""Ulysses-style sequence parallelism: all-to-all head redistribution.
+
+The other classic long-context layout (besides the ``ppermute`` ring in
+`tpu_on_k8s/parallel/ring.py`): inputs arrive sharded over the ``seq`` axis;
+an all-to-all swaps the sharded dim from *sequence* to *heads*, every device
+then runs ordinary full-sequence attention on heads/n heads, and a second
+all-to-all swaps back. Two collectives per layer instead of n ring steps —
+cheaper when n_heads ≥ seq-axis size and the full sequence fits one chip's
+HBM; ring wins when the sequence itself must stay sharded. Both are exact.
+
+Layout-compatible with ``xla_attention`` ([B, L, H, D], kv pre-repeated), and
+selected via ``attn_impl="ulysses"`` on the flagship model; the mesh comes
+from an explicit argument or the same ambient ``ring_context`` the Trainer
+enters at trace time.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from tpu_on_k8s.parallel.mesh import AXIS_SEQ
+from tpu_on_k8s.parallel.ring import _qkv_spec, _resolve_mesh
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      causal: bool = True, axis_name: str = AXIS_SEQ,
+                      mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """Exact attention with seq→head all-to-all resharding over ``axis_name``.
+
+    Requires n_heads divisible by the axis size. Falls back to plain
+    attention when no mesh is ambient or the axis has a single member.
+    """
+    from tpu_on_k8s.models.transformer import xla_attention
+
+    resolved = _resolve_mesh(mesh)
+    if resolved is None or resolved.shape.get(axis_name, 1) == 1:
+        return xla_attention(q, k, v, causal=causal)
+    n = resolved.shape[axis_name]
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"ulysses needs seq len {q.shape[1]} divisible by {axis_name}={n}")
+    if q.shape[2] % n != 0:
+        raise ValueError(
+            f"ulysses needs n_heads {q.shape[2]} divisible by {axis_name}={n}")
+    spec = _qkv_spec(resolved, axis_name, q.shape[0], q.shape[2])
+
+    def local(q_, k_, v_):
+        # [B, L/n, H, D] local → all-to-all → [B, L, H/n, D]
+        def seq_to_heads(x):
+            return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                      concat_axis=1, tiled=True)
+
+        def heads_to_seq(x):
+            return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        out = xla_attention(seq_to_heads(q_), seq_to_heads(k_),
+                            seq_to_heads(v_), causal=causal)
+        return heads_to_seq(out)
+
+    return jax.shard_map(local, mesh=resolved, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
