@@ -30,7 +30,8 @@ def main(argv=None) -> float:
     p.add_argument("--config", default="tiny", choices=sorted(CONFIGS))
     p.add_argument("--seq-len", type=int, default=0, help="0 = model max")
     p.add_argument("--remat", default="true")
-    p.add_argument("--attn", default="xla", choices=["xla", "flash", "ring"])
+    p.add_argument("--attn", default="xla",
+                   choices=["xla", "flash", "ring", "ulysses"])
     args = p.parse_args(argv)
     ctx, mesh = bring_up(args)
 
