@@ -1,0 +1,142 @@
+"""Lease-based leader election (reference main.go:77-83 — controller-runtime
+leader election "torch-on-k8s-election").
+
+A coordination Lease object in the cluster: candidates try to acquire it,
+the holder renews every ``renew_seconds``, and anyone observing a lease older
+than ``lease_seconds`` may take over. Conflict-safe through the cluster's
+resource-version semantics — a lost update means someone else renewed first.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from tpu_on_k8s.api.core import ObjectMeta, utcnow
+from tpu_on_k8s.client.cluster import AlreadyExistsError, ConflictError, InMemoryCluster
+
+LEASE_NAME = "tpu-on-k8s-election"
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease analog."""
+
+    api_version: str = "coordination.k8s.io/v1"
+    kind: str = "Lease"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder: str = ""
+    renew_time: Optional[_dt.datetime] = None
+    lease_seconds: float = 15.0
+
+
+class LeaderElector:
+    """Acquire/renew loop; ``is_leader`` gates the manager's controllers."""
+
+    def __init__(self, cluster: InMemoryCluster, identity: str,
+                 namespace: str = "tpu-on-k8s-system",
+                 lease_seconds: float = 15.0, renew_seconds: float = 5.0,
+                 clock: Callable[[], _dt.datetime] = utcnow,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None):
+        self.cluster = cluster
+        self.identity = identity
+        self.namespace = namespace
+        self.lease_seconds = lease_seconds
+        self.renew_seconds = renew_seconds
+        self.clock = clock
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader
+
+    # ------------------------------------------------------------------ core
+    def _expired(self, lease: Lease) -> bool:
+        if lease.renew_time is None:
+            return True
+        age = (self.clock() - lease.renew_time).total_seconds()
+        return age > lease.lease_seconds
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election round; returns whether we hold the lease after it."""
+        now = self.clock()
+        existing = self.cluster.try_get(Lease, self.namespace, LEASE_NAME)
+        if existing is None:
+            lease = Lease(metadata=ObjectMeta(name=LEASE_NAME,
+                                              namespace=self.namespace),
+                          holder=self.identity, renew_time=now,
+                          lease_seconds=self.lease_seconds)
+            try:
+                self.cluster.create(lease)
+            except (AlreadyExistsError, ConflictError):
+                return self._transition(False)
+            return self._transition(True)
+        if existing.holder != self.identity and not self._expired(existing):
+            return self._transition(False)
+
+        def mutate(lease: Lease) -> None:
+            # re-checked under the update's conflict retry: only renew what
+            # is still ours or still expired
+            if lease.holder != self.identity and not self._expired(lease):
+                raise _LostRace()
+            lease.holder = self.identity
+            lease.renew_time = self.clock()
+            lease.lease_seconds = self.lease_seconds
+
+        try:
+            self.cluster.update_with_retry(Lease, self.namespace, LEASE_NAME,
+                                           mutate)
+        except _LostRace:
+            return self._transition(False)
+        return self._transition(True)
+
+    def _transition(self, leading: bool) -> bool:
+        if leading and not self._leader:
+            self._leader = True
+            if self.on_started_leading:
+                self.on_started_leading()
+        elif not leading and self._leader:
+            self._leader = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+        return leading
+
+    # --------------------------------------------------------------- run loop
+    def run(self) -> None:  # pragma: no cover - timing loop; logic is above
+        self.try_acquire_or_renew()  # immediate first round, then renew cycle
+        while not self._stop.wait(self.renew_seconds):
+            self.try_acquire_or_renew()
+
+    def start(self) -> None:  # pragma: no cover
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._leader:
+            self._release()
+
+    def _release(self) -> None:
+        def mutate(lease: Lease) -> None:
+            if lease.holder == self.identity:
+                lease.holder = ""
+                lease.renew_time = None
+
+        try:
+            self.cluster.update_with_retry(Lease, self.namespace, LEASE_NAME,
+                                           mutate)
+        except Exception:
+            pass
+        self._transition(False)
+
+
+class _LostRace(Exception):
+    pass

@@ -61,6 +61,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--elastic-loop-period-seconds", type=float, default=30.0)
     p.add_argument("--once", action="store_true",
                    help="Pump controllers to quiescence and exit (smoke mode)")
+    p.add_argument("--leader-elect", default=False,
+                   action=argparse.BooleanOptionalAction,
+                   help="Run controllers only while holding the election "
+                        "lease (reference main.go:77-83)")
+    p.add_argument("--leader-identity", default="",
+                   help="Election identity (default: hostname-pid)")
     return p
 
 
@@ -103,6 +109,15 @@ class Operator:
                                                    config=self.config)
         self.modelversion = setup_modelversion_controller(
             self.cluster, self.manager, config=self.config)
+        self.elector = None
+        if getattr(args, "leader_elect", False):
+            import os
+            import socket
+
+            from tpu_on_k8s.controller.leaderelection import LeaderElector
+            identity = (getattr(args, "leader_identity", "")
+                        or f"{socket.gethostname()}-{os.getpid()}")
+            self.elector = LeaderElector(self.cluster, identity)
         self._metrics_server = None
 
     def run_once(self) -> int:
@@ -111,16 +126,28 @@ class Operator:
             self.coordinator.schedule_once()
         return self.manager.run_until_idle()
 
-    def start(self, metrics_port: int = 0) -> None:
+    def _start_workers(self) -> None:
         self.manager.start(
             workers_per_controller=self.config.max_concurrent_reconciles)
         if self.coordinator is not None:
             threading.Thread(target=self.coordinator.run, daemon=True).start()
         threading.Thread(target=self.autoscaler.run, daemon=True).start()
+
+    def start(self, metrics_port: int = 0) -> None:
+        if self.elector is not None:
+            # controllers run only while we hold the lease; losing it stops
+            # them so a split brain cannot double-reconcile
+            self.elector.on_started_leading = self._start_workers
+            self.elector.on_stopped_leading = self.manager.stop
+            self.elector.start()
+        else:
+            self._start_workers()
         if metrics_port:
             self._metrics_server = serve(self.metrics, metrics_port)
 
     def stop(self) -> None:
+        if self.elector is not None:
+            self.elector.stop()
         if self.coordinator is not None:
             self.coordinator.stop()
         self.autoscaler.stop()
