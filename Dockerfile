@@ -1,0 +1,31 @@
+# Manager image (reference Dockerfile:1 — distroless Go manager; here the
+# operator is the Python control plane, so the runtime stage is a slim
+# python base). The compute plane (jax/pallas) ships in the *user* training
+# images, exactly as torch does in the reference's — this image is only the
+# controller manager, so it stays small and jax-free.
+#
+# Build:  docker build -t tpu-on-k8s/manager:latest .
+# Deploy: kubectl apply -k config/default   (see Makefile `deploy`)
+
+FROM python:3.12-slim AS builder
+WORKDIR /build
+# native data-pipeline lib: built here so in-cluster AIMaster sidecars that
+# reuse this image get it without a compiler in the runtime layer
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+COPY tpu_on_k8s/data/native/dataloader.cpp tpu_on_k8s/data/native/
+RUN mkdir -p tpu_on_k8s/data/native/build \
+    && g++ -O2 -std=c++17 -shared -fPIC \
+       -o tpu_on_k8s/data/native/build/libtkdata.so \
+       tpu_on_k8s/data/native/dataloader.cpp -lpthread
+
+FROM python:3.12-slim
+RUN pip install --no-cache-dir prometheus_client pyyaml \
+    && useradd --uid 65532 --no-create-home nonroot
+WORKDIR /app
+COPY tpu_on_k8s/ tpu_on_k8s/
+COPY examples/aimaster.py examples/aimaster.py
+COPY --from=builder /build/tpu_on_k8s/data/native/build/libtkdata.so \
+     tpu_on_k8s/data/native/build/libtkdata.so
+USER 65532:65532
+ENTRYPOINT ["python", "-m", "tpu_on_k8s.main"]

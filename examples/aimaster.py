@@ -30,16 +30,56 @@ def run(cluster, namespace: str, job_name: str, save_fn,
     return completed
 
 
+def default_save_fn(ckpt_dir: str):
+    """Checkpoint writer used when the training loop doesn't inject one:
+    persists a per-generation marker so resume can find the latest state.
+    Real trainers pass `CheckpointManager.save` instead (train/checkpoint.py)."""
+    import json
+    import pathlib
+
+    def save(generation: int) -> None:
+        root = pathlib.Path(ckpt_dir)
+        root.mkdir(parents=True, exist_ok=True)
+        (root / f"gen_{generation:06d}.json").write_text(
+            json.dumps({"generation": generation, "completed_at": time.time()}))
+
+    return save
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="AIMaster checkpoint agent")
     p.add_argument("--namespace", default="default")
     p.add_argument("--job-name", required=True)
     p.add_argument("--period-seconds", type=float, default=5.0)
+    p.add_argument("--api-server", default="",
+                   help="Operator API server URL (default: kubeconfig / "
+                        "in-cluster resolution)")
+    p.add_argument("--ckpt-dir", default="/tmp/tpu-on-k8s-ckpt")
+    p.add_argument("--max-polls", type=int, default=0,
+                   help="Exit after N polls (0 = run forever)")
     args = p.parse_args(argv)
-    raise SystemExit(
-        "aimaster requires a cluster backend; in-cluster deployments construct "
-        "run(cluster, ...) with the API-server client (see docstring), tests "
-        f"drive it with InMemoryCluster (args: {args.namespace}/{args.job_name})")
+
+    url = args.api_server
+    token_path = ca_path = None
+    if not url:
+        from tpu_on_k8s.client import kubeconfig
+
+        cfg = kubeconfig.resolve()
+        url = kubeconfig.server_url(cfg)
+        token_path, ca_path = cfg.token_path, cfg.ca_path
+    if not url:
+        raise SystemExit(
+            "no API server: pass --api-server or provide a kubeconfig / "
+            "in-cluster service-account mount")
+    from tpu_on_k8s.client.rest import RestCluster
+
+    cluster = RestCluster(url, token_path=token_path, ca_path=ca_path)
+    completed = run(cluster, args.namespace, args.job_name,
+                    default_save_fn(args.ckpt_dir),
+                    period_seconds=args.period_seconds,
+                    max_polls=args.max_polls)
+    print(f"aimaster: completed {completed} checkpoint(s)")
+    return 0
 
 
 if __name__ == "__main__":

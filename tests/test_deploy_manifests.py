@@ -1,0 +1,108 @@
+"""Deploy-story coherence (VERDICT round 1 #4): the kustomize graph under
+config/ must apply cleanly end-to-end — every referenced file exists and
+parses, every binding's roleRef resolves, the manager Deployment's service
+account and image line up with what the repo ships (Dockerfile), and the
+CRDs carry kubectl printer columns (reference torchjob_types.go:320-324).
+
+No kubectl/kustomize binary in this image, so the graph is walked in Python
+with the same resolution rules (`resources:` entries are files or
+directories containing kustomization.yaml).
+"""
+from pathlib import Path
+
+import yaml
+
+REPO = Path(__file__).resolve().parent.parent
+CONFIG = REPO / "config"
+
+
+def _load_kustomize_tree(entry: Path):
+    """Resolve a kustomization directory into its list of object documents."""
+    kfile = entry / "kustomization.yaml"
+    assert kfile.exists(), f"missing {kfile}"
+    spec = yaml.safe_load(kfile.read_text())
+    docs = []
+    for res in spec.get("resources", []):
+        target = (entry / res).resolve()
+        if target.is_dir():
+            docs.extend(_load_kustomize_tree(target))
+        else:
+            assert target.exists(), f"{kfile} references missing {res}"
+            for doc in yaml.safe_load_all(target.read_text()):
+                if doc:
+                    docs.append(doc)
+    return docs
+
+
+def test_default_kustomization_resolves_and_parses():
+    docs = _load_kustomize_tree(CONFIG / "default")
+    kinds = [d["kind"] for d in docs]
+    assert kinds.count("CustomResourceDefinition") == 3
+    assert "Deployment" in kinds and "ServiceAccount" in kinds
+    assert "Role" in kinds and "RoleBinding" in kinds  # leader election
+    # reference's 16-file RBAC surface: aggregated editor/viewer per CRD
+    names = {d["metadata"]["name"] for d in docs}
+    for crd in ("tpujob", "model", "modelversion"):
+        assert f"tpu-on-k8s-{crd}-editor-role" in names
+        assert f"tpu-on-k8s-{crd}-viewer-role" in names
+    assert "tpu-on-k8s-metrics-reader" in names
+
+
+def test_role_bindings_resolve_and_sa_matches():
+    docs = _load_kustomize_tree(CONFIG / "default")
+    by_kind = {}
+    for d in docs:
+        by_kind.setdefault(d["kind"], {})[d["metadata"]["name"]] = d
+    sas = by_kind.get("ServiceAccount", {})
+    for kind in ("ClusterRoleBinding", "RoleBinding"):
+        for name, binding in by_kind.get(kind, {}).items():
+            ref = binding["roleRef"]
+            assert ref["name"] in by_kind.get(ref["kind"], {}), (
+                f"{kind} {name} references undefined {ref['kind']} {ref['name']}")
+            for subj in binding["subjects"]:
+                if subj["kind"] == "ServiceAccount":
+                    assert subj["name"] in sas, (
+                        f"{kind} {name} binds undefined SA {subj['name']}")
+    deployment = next(iter(by_kind["Deployment"].values()))
+    pod_spec = deployment["spec"]["template"]["spec"]
+    assert pod_spec["serviceAccountName"] in sas
+
+
+def test_manager_image_is_buildable():
+    """The round-1 gap: manager.yaml referenced an image nothing could
+    build. The Dockerfile now exists, builds this package, and the image
+    tag matches the Makefile's IMG default."""
+    dockerfile = (REPO / "Dockerfile").read_text()
+    assert "tpu_on_k8s" in dockerfile
+    assert "tpu_on_k8s.main" in dockerfile  # entrypoint is the manager
+    docs = _load_kustomize_tree(CONFIG / "default")
+    deployment = next(d for d in docs if d["kind"] == "Deployment")
+    image = deployment["spec"]["template"]["spec"]["containers"][0]["image"]
+    assert image in (REPO / "Makefile").read_text()
+
+
+def test_crds_have_printer_columns_and_status_subresource():
+    for crd_file in sorted((CONFIG / "crd" / "bases").glob("*.yaml")):
+        crd = yaml.safe_load(crd_file.read_text())
+        for version in crd["spec"]["versions"]:
+            cols = version.get("additionalPrinterColumns", [])
+            assert cols, f"{crd_file.name} {version['name']}: no printer columns"
+            assert any(c["type"] == "date" for c in cols)  # Age column
+            assert "status" in version.get("subresources", {}), (
+                f"{crd_file.name}: status subresource missing")
+
+
+def test_rbac_covers_every_resource_the_controllers_touch():
+    """The manager ClusterRole must grant what the code actually calls:
+    every registered REST resource type (client/resources.py) appears in
+    some rule of the manager role."""
+    role = yaml.safe_load((CONFIG / "rbac" / "role.yaml").read_text())
+    granted = set()
+    for rule in role["rules"]:
+        for res in rule.get("resources", []):
+            granted.add(res.split("/")[0])
+    from tpu_on_k8s.client import resources as reg
+
+    for rt in reg.all_types():
+        assert rt.plural in granted, (
+            f"manager role missing grant for {rt.plural}")

@@ -1,0 +1,220 @@
+"""Control-loop hardening: race stress, port allocation, error surfacing.
+
+VERDICT round 1 #7: (a) no silent exception path in any run() loop — a
+crashing decision loop must show up in the log and the errors_total counter;
+(b) hostnetwork port allocation tracks in-use ports instead of drawing blind
+(the reference's collision bug, hostnetwork.go:29-43 + pod.go:534-535);
+(c) a race-stress run: concurrent reconcile workers + a watch storm on one
+job must neither error nor wedge.
+"""
+import logging as pylogging
+import random
+import threading
+import time
+
+import pytest
+
+from tpu_on_k8s.api.core import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodTemplateSpec,
+)
+from tpu_on_k8s.api.types import (
+    RestartPolicy,
+    TaskSpec,
+    TaskType,
+    TPUJob,
+    TPUJobSpec,
+    TPUPolicy,
+)
+from tpu_on_k8s.client import KubeletSim
+from tpu_on_k8s.client.cluster import NotFoundError
+from tpu_on_k8s.controller.hostnetwork import PortAllocator
+from tpu_on_k8s.controller.tpujob import submit_job
+from tpu_on_k8s.main import Operator, build_parser
+
+
+def _job(name, workers=4, topology="4x4"):
+    template = PodTemplateSpec(
+        spec=PodSpec(containers=[Container(name="tpu", image="i")]))
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            tasks={TaskType.MASTER: TaskSpec(num_tasks=1, template=template),
+                   TaskType.WORKER: TaskSpec(
+                       num_tasks=workers, template=template,
+                       restart_policy=RestartPolicy.ON_EXIT_CODE)},
+            tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice",
+                                 topology=topology),
+        ))
+
+
+class _Capture(pylogging.Handler):
+    def __init__(self):
+        super().__init__(level=pylogging.ERROR)
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+# --------------------------------------------------------------- PortAllocator
+
+def test_port_allocator_no_collisions_and_reuse():
+    alloc = PortAllocator((20000, 20016), rng=random.Random(7))
+    ports = {f"ns/p{i}": alloc.allocate(f"ns/p{i}") for i in range(16)}
+    assert len(set(ports.values())) == 16  # full range, zero collisions
+    with pytest.raises(RuntimeError):
+        alloc.allocate("ns/p-overflow")
+    # idempotent per key
+    assert alloc.allocate("ns/p3") == ports["ns/p3"]
+    # release returns the port to the pool
+    alloc.release("ns/p3")
+    assert alloc.allocate("ns/p-new") == ports["ns/p3"]
+
+
+def test_port_allocator_reserve_adopts_existing():
+    alloc = PortAllocator((25000, 25010))
+    alloc.reserve("ns/old", 25004)
+    taken = {alloc.allocate(f"ns/n{i}") for i in range(9)}
+    assert 25004 not in taken
+
+
+def test_engine_releases_port_on_pod_deleted():
+    op = Operator(build_parser().parse_args(
+        ["--feature-gates", "JobCoordinator=false",
+         "--hostnetwork-port-range", "21000-21004"]))
+    job = _job("hostnet", workers=2, topology="2x4")
+    job.metadata.annotations["distributed.tpu.io/network-mode"] = "host"
+    submit_job(op.cluster, job)
+    sim = KubeletSim(op.cluster)
+    for _ in range(6):
+        op.run_once()
+        sim.run_all("default")  # DAG gate: workers follow a Running master
+    assert op.engine.port_allocator.in_use_count() == 3  # master + 2 workers
+    # job deletion cascades to pods; DELETED events release every port
+    op.cluster.delete(TPUJob, "default", "hostnet")
+    for _ in range(4):
+        op.run_once()
+    assert op.engine.port_allocator.in_use_count() == 0
+
+
+# ---------------------------------------------------------- error surfacing
+
+def test_autoscaler_tick_error_is_logged_and_counted():
+    op = Operator(build_parser().parse_args(
+        ["--feature-gates", "JobCoordinator=false",
+         "--elastic-loop-period-seconds", "0.01"]))
+    op.autoscaler.run_once = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    cap = _Capture()
+    pylogging.getLogger("tpu_on_k8s.autoscaler").addHandler(cap)
+    try:
+        op.autoscaler.run()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not cap.records:
+            time.sleep(0.01)
+    finally:
+        op.autoscaler.stop()
+        pylogging.getLogger("tpu_on_k8s.autoscaler").removeHandler(cap)
+    assert cap.records, "autoscaler crash vanished without a log line"
+    assert op.metrics.counters["errors"] >= 1
+
+
+# ------------------------------------------------------------- race stress
+
+def test_race_stress_concurrent_reconciles_and_watch_storm():
+    """4 reconcile workers + annotation storm + kubelet racing + two pod
+    deaths on one job: no reconcile may error out, and the job must still
+    converge to Succeeded afterwards (no wedged expectations/locks)."""
+    cap = _Capture()
+    root = pylogging.getLogger("tpu_on_k8s")
+    root.addHandler(cap)
+    op = Operator(build_parser().parse_args(
+        ["--feature-gates", "JobCoordinator=false"]))
+    op.manager.start(workers_per_controller=4)
+    sim = KubeletSim(op.cluster)
+    submit_job(op.cluster, _job("storm", workers=4))
+    stop = threading.Event()
+
+    def annotation_storm():
+        i = 0
+        while not stop.is_set():
+            try:
+                op.cluster.patch_meta(
+                    TPUJob, "default", "storm",
+                    annotations={"stress.tpu.io/tick": str(i)})
+            except NotFoundError:
+                pass
+            i += 1
+
+    def kubelet_loop():
+        while not stop.is_set():
+            try:
+                sim.run_all("default")
+            except NotFoundError:
+                pass
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=annotation_storm, daemon=True),
+               threading.Thread(target=annotation_storm, daemon=True),
+               threading.Thread(target=kubelet_loop, daemon=True)]
+    for t in threads:
+        t.start()
+    try:
+        # two retryable worker deaths mid-storm exercise failover concurrently
+        for _ in range(2):
+            time.sleep(0.3)
+            try:
+                sim.fail_pod("default", "storm-worker-1", exit_code=137,
+                             reason="OOMKilled")
+            except NotFoundError:
+                pass
+        time.sleep(0.5)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+    # convergence after the storm
+    deadline = time.monotonic() + 20
+    succeeded = False
+    while time.monotonic() < deadline and not succeeded:
+        sim.run_all("default")
+        pods = op.cluster.list(Pod, "default")
+        if len(pods) == 5 and all(
+                p.status.phase == PodPhase.RUNNING for p in pods):
+            for p in pods:
+                sim.succeed_pod("default", p.metadata.name)
+        job = op.cluster.try_get(TPUJob, "default", "storm")
+        succeeded = job is not None and any(
+            c.type == "Succeeded" for c in job.status.conditions)
+        time.sleep(0.02)
+    op.manager.stop()
+    root.removeHandler(cap)
+    errors = [r for r in cap.records if r.levelno >= pylogging.ERROR]
+    assert not errors, [r.getMessage() for r in errors]
+    assert succeeded, "job did not converge to Succeeded after the storm"
+
+
+def test_operator_worker_lifecycle_guard():
+    """Losing and re-acquiring leadership must not stack duplicate worker
+    threads, and losing it must stop the coordinator/autoscaler too
+    (ADVICE round 1, medium)."""
+    op = Operator(build_parser().parse_args([]))
+    op._start_workers()
+    scaler_thread = op.autoscaler._thread
+    coord_thread = op.coordinator._thread
+    assert scaler_thread is not None and coord_thread is not None
+    op._start_workers()  # double-start is a no-op
+    assert op.autoscaler._thread is scaler_thread
+    assert op.coordinator._thread is coord_thread
+    op._stop_workers()
+    assert not scaler_thread.is_alive() and not coord_thread.is_alive()
+    assert op.autoscaler._thread is None and op.coordinator._thread is None
+    assert not op.manager._threads
+    op._start_workers()  # re-acquire after loss: a fresh, single set
+    assert op._workers_running and op.autoscaler._thread is not None
+    op.stop()

@@ -1,0 +1,370 @@
+"""HTTP API server: Kubernetes REST semantics over the in-memory registry.
+
+The envtest analog the reference's Makefile models (Makefile:106-109 spins a
+real etcd+kube-apiserver for `go test`): a threaded HTTP server exposing the
+API-machinery surface the controllers depend on —
+
+* group/version/namespace REST routing (`/api/v1/...`, `/apis/{g}/{v}/...`)
+  with typed Status errors (NotFound / AlreadyExists / Conflict);
+* optimistic concurrency via resourceVersion on PUT (409 Conflict);
+* the status subresource (`PUT .../{name}/status`);
+* strategic metadata PATCH with finalizer add/remove (the reference's patch
+  DSL, pkg/utils/patch/patch.go:66-96, incl. `$deleteFromPrimitiveList`);
+* graceful delete: finalizers pin the object with deletionTimestamp, drain
+  completes the delete, ownerReference cascade GC follows;
+* streaming watch (`?watch=true`, chunked JSON lines, k8s wire format
+  `{"type": ..., "object": ...}`) with an initial BOOKMARK so clients can
+  block until the stream is live (no missed-event gap);
+* pods/log subresource (GET with `tailLines`; POST is the kubelet-side
+  injection seam tests use, the one non-k8s extension);
+* core/v1 Events (POST + GET).
+
+Storage delegates to `InMemoryCluster` — the same finalizer/cascade/conflict
+logic the controllers were developed against — so this file is purely the
+wire protocol. `RestCluster` (client/rest.py) is the typed client speaking
+this protocol; reference analog: client/clientset/versioned/clientset.go.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from tpu_on_k8s.client import resources
+from tpu_on_k8s.client.cluster import (
+    AlreadyExistsError,
+    ConflictError,
+    InMemoryCluster,
+    NotFoundError,
+    WatchEvent,
+)
+from tpu_on_k8s.utils import serde
+from tpu_on_k8s.utils.logging import get_logger
+
+_log = get_logger("apiserver")
+
+
+def _status_body(code: int, reason: str, message: str) -> bytes:
+    return json.dumps({"kind": "Status", "apiVersion": "v1",
+                       "status": "Failure", "reason": reason,
+                       "message": message, "code": code}).encode()
+
+
+def encode_obj(obj: Any) -> Dict[str, Any]:
+    return serde.to_dict(obj, drop_none=False)
+
+
+def decode_obj(rt: resources.ResourceType, data: Dict[str, Any]) -> Any:
+    return serde.from_dict(rt.cls, data)
+
+
+def parse_label_selector(raw: str) -> Optional[Dict[str, str]]:
+    """`a=b,c=d` — the equality subset the controllers use."""
+    if not raw:
+        return None
+    out: Dict[str, str] = {}
+    for part in raw.split(","):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        out[k] = v
+    return out
+
+
+class _WatchHub:
+    """Fans cluster watch events out to per-connection queues."""
+
+    _CLOSE = object()
+
+    def __init__(self, cluster: InMemoryCluster) -> None:
+        self._lock = threading.Lock()
+        self._subs: List[Tuple[str, "queue.Queue"]] = []  # (kind, q)
+        cluster.watch(self._on_event)
+
+    def _on_event(self, event: WatchEvent) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for kind, q in subs:
+            if kind == event.kind:
+                q.put(event)
+
+    def subscribe(self, kind: str) -> "queue.Queue":
+        q: "queue.Queue" = queue.Queue()
+        with self._lock:
+            self._subs.append((kind, q))
+        return q
+
+    def unsubscribe(self, q: "queue.Queue") -> None:
+        with self._lock:
+            self._subs = [(k, s) for k, s in self._subs if s is not q]
+
+    def close(self) -> None:
+        with self._lock:
+            subs = list(self._subs)
+            self._subs = []
+        for _, q in subs:
+            q.put(self._CLOSE)
+
+
+class _Route:
+    """Parsed request path."""
+
+    def __init__(self, rt: resources.ResourceType, namespace: Optional[str],
+                 name: Optional[str], subresource: Optional[str]):
+        self.rt = rt
+        self.namespace = namespace
+        self.name = name
+        self.subresource = subresource
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "tpu-on-k8s-apiserver"
+
+    # set by ApiServer via type(); silence the type checker
+    cluster: InMemoryCluster
+    hub: _WatchHub
+    stopping: threading.Event
+
+    def log_message(self, fmt, *args):  # route through the framework logger
+        _log.debug("%s %s", self.address_string(), fmt % args)
+
+    # ------------------------------------------------------------------ routing
+    def _parse(self) -> Tuple[Optional[_Route], Dict[str, List[str]]]:
+        parsed = urlparse(self.path)
+        qs = parse_qs(parsed.query)
+        parts = [p for p in parsed.path.split("/") if p]
+        # /api/v1/... vs /apis/{group}/{version}/...
+        if len(parts) >= 2 and parts[0] == "api" and parts[1] == "v1":
+            group, rest = "", parts[2:]
+        elif len(parts) >= 3 and parts[0] == "apis":
+            group, rest = parts[1], parts[3:]
+        else:
+            return None, qs
+        namespace: Optional[str] = None
+        if len(rest) >= 2 and rest[0] == "namespaces":
+            namespace, rest = rest[1], rest[2:]
+        if not rest:
+            return None, qs
+        plural, rest = rest[0], rest[1:]
+        if group == "" and plural == "events":
+            # core/v1 Events have no dataclass kind; handled specially
+            return _Route(None, namespace, rest[0] if rest else None, None), qs  # type: ignore[arg-type]
+        rt = resources.by_route(group, plural)
+        if rt is None:
+            return None, qs
+        name = rest[0] if rest else None
+        sub = rest[1] if len(rest) > 1 else None
+        return _Route(rt, namespace, name, sub), qs
+
+    # ---------------------------------------------------------------- responses
+    def _send_json(self, code: int, payload: Any) -> None:
+        body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_status(self, exc: Exception) -> None:
+        if isinstance(exc, NotFoundError):
+            self._send_json(404, _status_body(404, "NotFound", str(exc)))
+        elif isinstance(exc, AlreadyExistsError):
+            self._send_json(409, _status_body(409, "AlreadyExists", str(exc)))
+        elif isinstance(exc, ConflictError):
+            self._send_json(409, _status_body(409, "Conflict", str(exc)))
+        else:
+            _log.exception("apiserver internal error")
+            self._send_json(500, _status_body(500, "InternalError", str(exc)))
+
+    def _read_body(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b"{}"
+        return json.loads(raw or b"{}")
+
+    # ------------------------------------------------------------------- verbs
+    def do_GET(self) -> None:
+        route, qs = self._parse()
+        if route is None:
+            self._send_json(404, _status_body(404, "NotFound", self.path))
+            return
+        try:
+            if route.rt is None:  # events
+                self._send_json(200, {"items": [list(e) for e in self.cluster.events]})
+                return
+            if route.name is None:
+                if qs.get("watch", ["false"])[0] == "true":
+                    self._stream_watch(route)
+                    return
+                selector = parse_label_selector(
+                    qs.get("labelSelector", [""])[0])
+                items = self.cluster.list(route.rt.cls, route.namespace,
+                                          selector)
+                self._send_json(200, {"kind": f"{route.rt.kind}List",
+                                      "items": [encode_obj(o) for o in items]})
+                return
+            if route.subresource == "log":
+                tail = int(qs.get("tailLines", ["0"])[0])
+                lines = self.cluster.read_pod_log(route.namespace, route.name,
+                                                  tail=tail)
+                body = ("\n".join(lines)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            obj = self.cluster.get(route.rt.cls, route.namespace, route.name)
+            self._send_json(200, encode_obj(obj))
+        except Exception as exc:  # noqa: BLE001 — mapped to Status codes
+            self._send_error_status(exc)
+
+    def do_POST(self) -> None:
+        route, _ = self._parse()
+        if route is None:
+            self._send_json(404, _status_body(404, "NotFound", self.path))
+            return
+        try:
+            body = self._read_body()
+            if route.rt is None:  # POST core/v1 events
+                inv = body.get("involvedObject", {})
+                self.cluster.events.append(
+                    (f"{inv.get('namespace', route.namespace)}/{inv.get('name', '')}",
+                     body.get("type", "Normal"), body.get("reason", ""),
+                     body.get("message", "")))
+                self._send_json(201, {"status": "ok"})
+                return
+            if route.subresource == "log":
+                # kubelet-side log injection (test seam; not real k8s REST)
+                self.cluster.append_pod_log(route.namespace, route.name,
+                                            body.get("line", ""))
+                self._send_json(200, {"status": "ok"})
+                return
+            obj = decode_obj(route.rt, body)
+            obj.metadata.namespace = route.namespace or obj.metadata.namespace
+            created = self.cluster.create(obj)
+            self._send_json(201, encode_obj(created))
+        except Exception as exc:  # noqa: BLE001
+            self._send_error_status(exc)
+
+    def do_PUT(self) -> None:
+        route, _ = self._parse()
+        if route is None or route.rt is None or route.name is None:
+            self._send_json(404, _status_body(404, "NotFound", self.path))
+            return
+        try:
+            obj = decode_obj(route.rt, self._read_body())
+            sub = "status" if route.subresource == "status" else ""
+            updated = self.cluster.update(obj, subresource=sub)
+            self._send_json(200, encode_obj(updated))
+        except Exception as exc:  # noqa: BLE001
+            self._send_error_status(exc)
+
+    def do_PATCH(self) -> None:
+        route, _ = self._parse()
+        if route is None or route.rt is None or route.name is None:
+            self._send_json(404, _status_body(404, "NotFound", self.path))
+            return
+        try:
+            body = self._read_body()
+            meta = body.get("metadata", {})
+            patched = self.cluster.patch_meta(
+                route.rt.cls, route.namespace, route.name,
+                labels=meta.get("labels"),
+                annotations=meta.get("annotations"),
+                add_finalizers=meta.get("$addFinalizers", ()),
+                remove_finalizers=meta.get("$removeFinalizers", ()))
+            self._send_json(200, encode_obj(patched))
+        except Exception as exc:  # noqa: BLE001
+            self._send_error_status(exc)
+
+    def do_DELETE(self) -> None:
+        route, _ = self._parse()
+        if route is None or route.rt is None or route.name is None:
+            self._send_json(404, _status_body(404, "NotFound", self.path))
+            return
+        try:
+            self.cluster.delete(route.rt.cls, route.namespace, route.name)
+            self._send_json(200, {"kind": "Status", "status": "Success"})
+        except Exception as exc:  # noqa: BLE001
+            self._send_error_status(exc)
+
+    # -------------------------------------------------------------------- watch
+    def _write_chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _stream_watch(self, route: _Route) -> None:
+        q = self.hub.subscribe(route.rt.kind)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            # Initial bookmark: the client blocks on this to guarantee the
+            # subscription is live before it returns from watch() — no gap
+            # between "watch registered" and "events delivered".
+            self._write_chunk(json.dumps({"type": "BOOKMARK"}).encode() + b"\n")
+            while not self.stopping.is_set():
+                try:
+                    event = q.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                if event is _WatchHub._CLOSE:
+                    break
+                if (route.namespace is not None
+                        and event.obj.metadata.namespace != route.namespace):
+                    continue
+                line = json.dumps({"type": event.type,
+                                   "object": encode_obj(event.obj)}).encode()
+                self._write_chunk(line + b"\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away
+        finally:
+            self.hub.unsubscribe(q)
+            try:
+                self._write_chunk(b"")  # terminating chunk
+            except OSError:
+                pass
+
+
+class ApiServer:
+    """Lifecycle wrapper: `start()` serves on a background thread pool,
+    `stop()` drains watch streams and shuts down."""
+
+    def __init__(self, cluster: Optional[InMemoryCluster] = None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.cluster = cluster or InMemoryCluster()
+        self.hub = _WatchHub(self.cluster)
+        self._stopping = threading.Event()
+        handler = type("BoundHandler", (_Handler,), {
+            "cluster": self.cluster, "hub": self.hub,
+            "stopping": self._stopping})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        kwargs={"poll_interval": 0.05},
+                                        daemon=True, name="apiserver")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        self.hub.close()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

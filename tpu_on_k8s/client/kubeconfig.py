@@ -26,6 +26,35 @@ class ClusterConfig:
     ca_path: Optional[str] = None
 
 
+def server_url(cfg: ClusterConfig) -> Optional[str]:
+    """Extract the API server URL a REST backend should dial.
+
+    kubeconfig mode reads `clusters[0].cluster.server` (the current-context
+    resolution the reference gets from clientcmd, kubeconfig.go:33-56);
+    in-cluster mode uses the service-host env already captured in `cfg`.
+    """
+    if cfg.mode == "in-cluster":
+        return cfg.api_host
+    if cfg.mode == "kubeconfig" and cfg.kubeconfig_path:
+        import yaml
+
+        try:
+            with open(cfg.kubeconfig_path) as f:
+                doc = yaml.safe_load(f) or {}
+        except OSError:
+            return None
+        current = doc.get("current-context")
+        cluster_name = None
+        for ctx in doc.get("contexts", []):
+            if ctx.get("name") == current:
+                cluster_name = ctx.get("context", {}).get("cluster")
+                break
+        for c in doc.get("clusters", []):
+            if cluster_name is None or c.get("name") == cluster_name:
+                return c.get("cluster", {}).get("server")
+    return None
+
+
 def resolve(env: Optional[dict] = None) -> ClusterConfig:
     """Kubeconfig env var → default path → in-cluster mount → none."""
     env = os.environ if env is None else env

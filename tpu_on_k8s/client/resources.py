@@ -1,0 +1,121 @@
+"""REST resource registry: kind ↔ (group, version, plural) ↔ Python class.
+
+The reference gets this mapping from apimachinery scheme registration
+(/root/reference/apis/add_types.go:25-37) plus the generated clientset's
+per-resource REST paths (client/clientset/versioned/typed/train/v1alpha1/
+torchjob.go). Here one explicit table serves both the API server's router
+and the typed REST client.
+
+Divergence note: PriorityClass and PersistentVolume are cluster-scoped in
+real Kubernetes; this API surface keeps every resource namespaced (the
+object model carries a namespace on all kinds) — an envtest-analog
+simplification, not a semantic the controllers depend on.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import (
+    ConfigMap,
+    Pod,
+    PriorityClass,
+    ResourceQuota,
+    Service,
+)
+from tpu_on_k8s.api.model_types import Model, ModelVersion
+from tpu_on_k8s.api.types import TPUJob
+
+
+@dataclass(frozen=True)
+class ResourceType:
+    kind: str
+    cls: type
+    group: str          # "" = core ("/api/v1")
+    version: str
+    plural: str
+
+    @property
+    def prefix(self) -> str:
+        if not self.group:
+            return f"/api/{self.version}"
+        return f"/apis/{self.group}/{self.version}"
+
+    def collection_path(self, namespace: str) -> str:
+        return f"{self.prefix}/namespaces/{namespace}/{self.plural}"
+
+    def item_path(self, namespace: str, name: str) -> str:
+        return f"{self.collection_path(namespace)}/{name}"
+
+    def all_namespaces_path(self) -> str:
+        return f"{self.prefix}/{self.plural}"
+
+
+def _build() -> Tuple[Dict[str, ResourceType], Dict[Tuple[str, str], ResourceType]]:
+    # Imported lazily where needed to respect the api→gang→client cycle
+    # anchored in main.py; these two live outside tpu_on_k8s.api.
+    from tpu_on_k8s.controller.leaderelection import Lease
+    from tpu_on_k8s.gang.scheduler import PodGroup
+    from tpu_on_k8s.storage.providers import (
+        PersistentVolume,
+        PersistentVolumeClaim,
+    )
+
+    tpu_group = constants.API_GROUP
+    tpu_ver = constants.API_VERSION
+    rows = [
+        ResourceType("Pod", Pod, "", "v1", "pods"),
+        ResourceType("Service", Service, "", "v1", "services"),
+        ResourceType("ConfigMap", ConfigMap, "", "v1", "configmaps"),
+        ResourceType("ResourceQuota", ResourceQuota, "", "v1", "resourcequotas"),
+        ResourceType("PersistentVolume", PersistentVolume, "", "v1",
+                     "persistentvolumes"),
+        ResourceType("PersistentVolumeClaim", PersistentVolumeClaim, "", "v1",
+                     "persistentvolumeclaims"),
+        ResourceType("PriorityClass", PriorityClass, "scheduling.k8s.io", "v1",
+                     "priorityclasses"),
+        ResourceType("Lease", Lease, "coordination.k8s.io", "v1", "leases"),
+        ResourceType("PodGroup", PodGroup, "scheduling.distributed.tpu.io",
+                     "v1beta1", "podgroups"),
+        ResourceType(constants.KIND_TPUJOB, TPUJob, tpu_group, tpu_ver,
+                     "tpujobs"),
+        ResourceType(constants.KIND_MODEL, Model, tpu_group, tpu_ver, "models"),
+        ResourceType(constants.KIND_MODELVERSION, ModelVersion, tpu_group,
+                     tpu_ver, "modelversions"),
+    ]
+    return ({r.kind: r for r in rows},
+            {(r.group, r.plural): r for r in rows})
+
+
+_BY_KIND: Optional[Dict[str, ResourceType]] = None
+_BY_ROUTE: Optional[Dict[Tuple[str, str], ResourceType]] = None
+
+
+def _ensure() -> None:
+    global _BY_KIND, _BY_ROUTE
+    if _BY_KIND is None:
+        _BY_KIND, _BY_ROUTE = _build()
+
+
+def by_kind(kind: str) -> ResourceType:
+    _ensure()
+    rt = _BY_KIND.get(kind)
+    if rt is None:
+        raise KeyError(f"unregistered kind {kind!r}")
+    return rt
+
+
+def by_class(cls: type) -> ResourceType:
+    kind = cls.__dataclass_fields__["kind"].default  # type: ignore[attr-defined]
+    return by_kind(kind)
+
+
+def by_route(group: str, plural: str) -> Optional[ResourceType]:
+    _ensure()
+    return _BY_ROUTE.get((group, plural))
+
+
+def all_types() -> list:
+    _ensure()
+    return list(_BY_KIND.values())

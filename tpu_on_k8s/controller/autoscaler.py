@@ -40,7 +40,11 @@ from tpu_on_k8s.client.cluster import InMemoryCluster, NotFoundError
 from tpu_on_k8s.controller.config import JobControllerConfig
 from tpu_on_k8s.controller.elastic import ElasticController, apply_host_count
 from tpu_on_k8s.gang import topology
+from tpu_on_k8s.metrics.metrics import JobMetrics
 from tpu_on_k8s.utils import conditions
+from tpu_on_k8s.utils.logging import get_logger
+
+_log = get_logger("autoscaler")
 
 METRICS_TAG = "[elastic-metrics]"
 _KV_RE = re.compile(r"(\w+)=([-+.\deE]+)")
@@ -102,9 +106,11 @@ class ElasticAutoscaler:
     reference's 30s cadence."""
 
     def __init__(self, cluster: InMemoryCluster,
-                 config: Optional[JobControllerConfig] = None) -> None:
+                 config: Optional[JobControllerConfig] = None,
+                 metrics: Optional[JobMetrics] = None) -> None:
         self.cluster = cluster
         self.config = config or JobControllerConfig()
+        self.metrics = metrics
         self._lock = threading.Lock()
         self._jobs: Dict[str, _JobState] = {}  # "ns/name" → state
         self._stop = threading.Event()
@@ -320,23 +326,31 @@ class ElasticAutoscaler:
                 try:
                     self.run_once()
                 except Exception:
-                    pass
+                    # a crashing decision loop must never disappear silently:
+                    # surface it in the log AND the errors_total counter
+                    _log.exception("elastic autoscaler tick failed")
+                    if self.metrics is not None:
+                        self.metrics.error()
                 self._stop.wait(self.config.elastic_loop_period_seconds)
 
-        self._thread = threading.Thread(target=loop, daemon=True, name="elastic-autoscaler")
-        self._thread.start()
+        # start before publishing: stop() must never observe (and join) a
+        # created-but-unstarted thread
+        t = threading.Thread(target=loop, daemon=True, name="elastic-autoscaler")
+        t.start()
+        self._thread = t
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2)
-            self._thread = None
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2)
 
 
 def setup_elastic_autoscaler(cluster: InMemoryCluster,
-                             config: Optional[JobControllerConfig] = None) -> ElasticAutoscaler:
+                             config: Optional[JobControllerConfig] = None,
+                             metrics: Optional[JobMetrics] = None) -> ElasticAutoscaler:
     """Wire the autoscaler's job registry to the cluster watch (reference
     SetupWithManager, torchelastic/elastictorchjob_controller.go:128-148)."""
-    scaler = ElasticAutoscaler(cluster, config=config)
+    scaler = ElasticAutoscaler(cluster, config=config, metrics=metrics)
     cluster.watch(scaler.observe_event)
     return scaler

@@ -114,6 +114,8 @@ class JobEngine:
         # container restart counts, job.go:385-419).
         self._failover_counts: Dict[str, int] = {}
         self._launch_meters: Dict[str, _LaunchMeter] = {}
+        self.port_allocator = hostnetwork.PortAllocator(
+            self.config.hostnetwork_port_range)
 
     # ------------------------------------------------------------------ helpers
     @staticmethod
@@ -361,7 +363,8 @@ class JobEngine:
 
         if hostnetwork.enabled(job.metadata.annotations):
             ports: hostnetwork.PortMap = ctx.setdefault(constants.CONTEXT_HOSTNETWORK_PORTS, {})  # type: ignore[assignment]
-            port = hostnetwork.allocate_port(self.config.hostnetwork_port_range)
+            port = self.port_allocator.allocate(
+                f"{job.metadata.namespace}/{name}")
             ports[name] = port
             hostnetwork.setup_pod_hostnetwork(pod, port)
 
@@ -814,4 +817,14 @@ class JobEngine:
             self.expectations.creation_observed(key)
         elif event.type == "DELETED":
             self.expectations.deletion_observed(key)
+            if obj.kind == "Pod":
+                # Release only when no live pod holds the name: under an async
+                # (REST) watch, a failover recreate can land before the old
+                # pod's DELETED event arrives, and the replacement inherits
+                # the allocation (allocate() is idempotent per key) — freeing
+                # it here would hand its port to a neighbor.
+                pod_key = f"{obj.metadata.namespace}/{obj.metadata.name}"
+                if self.cluster.try_get(Pod, obj.metadata.namespace,
+                                        obj.metadata.name) is None:
+                    self.port_allocator.release(pod_key)
         controller_enqueue(obj.metadata.namespace, owner_name)

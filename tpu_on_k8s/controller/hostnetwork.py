@@ -12,7 +12,8 @@ fallback to index 0.
 from __future__ import annotations
 
 import random
-from typing import Dict, Tuple
+import threading
+from typing import Dict, Optional, Tuple
 
 from tpu_on_k8s.api import constants
 from tpu_on_k8s.api.core import Pod
@@ -27,6 +28,60 @@ def enabled(annotations: Dict[str, str]) -> bool:
 def allocate_port(port_range: Tuple[int, int], rng: random.Random | None = None) -> int:
     lo, hi = port_range
     return (rng or random).randint(lo, hi - 1)
+
+
+class PortAllocator:
+    """In-use-aware host-port allocation.
+
+    The reference draws blind from the range (hostnetwork.go:29-43 via
+    pod.go:534-535) so two pods on one node can collide; here a port stays
+    reserved from allocation until the pod's DELETED watch event releases it.
+    Allocation is idempotent per pod key (re-reconciles of the same pod get
+    the same port). Random probing keeps allocation O(1) while the range is
+    mostly free; a linear sweep guarantees progress near exhaustion.
+    """
+
+    def __init__(self, port_range: Tuple[int, int],
+                 rng: Optional[random.Random] = None) -> None:
+        self._lo, self._hi = port_range
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        self._by_key: Dict[str, int] = {}  # "ns/pod-name" -> port
+        self._in_use: set[int] = set()
+
+    def allocate(self, key: str) -> int:
+        with self._lock:
+            if key in self._by_key:
+                return self._by_key[key]
+            if len(self._in_use) >= self._hi - self._lo:
+                raise RuntimeError(
+                    f"hostnetwork port range {self._lo}-{self._hi} exhausted")
+            for _ in range(64):
+                port = self._rng.randint(self._lo, self._hi - 1)
+                if port not in self._in_use:
+                    break
+            else:
+                port = next(p for p in range(self._lo, self._hi)
+                            if p not in self._in_use)
+            self._in_use.add(port)
+            self._by_key[key] = port
+            return port
+
+    def reserve(self, key: str, port: int) -> None:
+        """Adopt an existing pod's port (controller restart re-sync)."""
+        with self._lock:
+            self._by_key[key] = port
+            self._in_use.add(port)
+
+    def release(self, key: str) -> None:
+        with self._lock:
+            port = self._by_key.pop(key, None)
+            if port is not None and port not in self._by_key.values():
+                self._in_use.discard(port)
+
+    def in_use_count(self) -> int:
+        with self._lock:
+            return len(self._in_use)
 
 
 def setup_pod_hostnetwork(pod: Pod, port: int) -> None:

@@ -15,6 +15,9 @@ from typing import Any, Callable, Optional
 
 from tpu_on_k8s.api.core import ObjectMeta, utcnow
 from tpu_on_k8s.client.cluster import AlreadyExistsError, ConflictError, InMemoryCluster
+from tpu_on_k8s.utils.logging import get_logger
+
+_log = get_logger("leaderelection")
 
 LEASE_NAME = "tpu-on-k8s-election"
 
@@ -134,7 +137,10 @@ class LeaderElector:
             self.cluster.update_with_retry(Lease, self.namespace, LEASE_NAME,
                                            mutate)
         except Exception:
-            pass
+            # best-effort: the lease expires on its own if the release write
+            # loses a race or the server is gone — but say so
+            _log.warning("lease release failed; relying on expiry",
+                         exc_info=True)
         self._transition(False)
 
 

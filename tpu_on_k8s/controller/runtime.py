@@ -13,6 +13,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, NamedTuple, Optional, Set, Tuple
 
+from tpu_on_k8s.utils.logging import get_logger
+
+_log = get_logger("runtime")
+
 
 class Request(NamedTuple):
     namespace: str
@@ -213,7 +217,8 @@ class Manager:
                     due = c.queue.next_due_in()
                     self._stop.wait(min(due, 0.05) if due is not None else 0.05)
             except Exception:  # reconcile errors are retried via backoff
-                pass
+                _log.exception("reconcile failed (will retry with backoff)",
+                               extra={"kv": {"controller": c.name}})
 
     def stop(self) -> None:
         self._stop.set()

@@ -33,6 +33,9 @@ from tpu_on_k8s.coordinator.queue import Queue
 from tpu_on_k8s.coordinator.types import Code, QueueUnit, Status
 from tpu_on_k8s.metrics import JobMetrics
 from tpu_on_k8s.utils import conditions
+from tpu_on_k8s.utils.logging import get_logger
+
+_log = get_logger("coordinator")
 
 DEFAULT_SCHEDULING_PERIOD_SECONDS = 0.1  # plugins/registry.go:27
 
@@ -264,14 +267,19 @@ class Coordinator:
                 try:
                     self.schedule_once()
                 except Exception:  # cycle errors must not kill the loop
-                    pass
+                    _log.exception("coordinator schedule cycle failed")
+                    if self.metrics is not None:
+                        self.metrics.error()
                 self._stop.wait(self.period)
 
-        self._thread = threading.Thread(target=loop, daemon=True, name="coordinator")
-        self._thread.start()
+        # start before publishing: stop() must never observe (and join) a
+        # created-but-unstarted thread
+        t = threading.Thread(target=loop, daemon=True, name="coordinator")
+        t.start()
+        self._thread = t
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2)
-            self._thread = None
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2)

@@ -50,6 +50,7 @@ def _build_lib() -> Optional[ctypes.CDLL]:
         [ctypes.c_int32] * 3
     lib.tk_batches_per_epoch.restype = ctypes.c_int64
     lib.tk_batches_per_epoch.argtypes = [ctypes.c_void_p]
+    lib.tk_next.restype = ctypes.c_int32
     lib.tk_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.tk_loader_stop.argtypes = [ctypes.c_void_p]
     return lib
@@ -204,8 +205,12 @@ class DataLoader:
         if self._native is not None:
             out = np.empty((self.batch_size,) + self.ds.record_shape,
                            self.ds.dtype)
-            self._native.tk_next(
+            ok = self._native.tk_next(
                 self._loader, out.ctypes.data_as(ctypes.c_char_p))
+            if not ok:
+                # loader stopped (concurrent close()) — the buffer was never
+                # written; surfacing it as a batch would be garbage data
+                raise StopIteration
         else:
             out = self._next_python()
         self._ticket += 1

@@ -209,8 +209,10 @@ int64_t tk_batches_per_epoch(void* loader) {
 }
 
 // Blocks until the next in-order batch is ready, copies it into `out`
-// (batch_size * record_bytes bytes).
-void tk_next(void* loader, char* out) {
+// (batch_size * record_bytes bytes). Returns 1 when a batch was written,
+// 0 when the loader is stopping and `out` was left untouched — the caller
+// must not treat the buffer as a batch in that case.
+int32_t tk_next(void* loader, char* out) {
   auto* ld = static_cast<Loader*>(loader);
   const size_t cap = ld->slots.size();
   std::unique_lock<std::mutex> lock(ld->mu);
@@ -218,11 +220,12 @@ void tk_next(void* loader, char* out) {
   ld->cv_consumer.wait(lock, [&] {
     return ld->stopping || slot.ticket == ld->consumer_pos;
   });
-  if (ld->stopping) return;
+  if (ld->stopping) return 0;
   std::memcpy(out, slot.buf.data(), slot.buf.size());
   slot.ticket = -1;
   ld->consumer_pos++;
   ld->cv_producer.notify_all();
+  return 1;
 }
 
 void tk_loader_stop(void* loader) {

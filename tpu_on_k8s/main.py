@@ -67,7 +67,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "lease (reference main.go:77-83)")
     p.add_argument("--leader-identity", default="",
                    help="Election identity (default: hostname-pid)")
+    p.add_argument("--cluster-backend", default="auto",
+                   choices=["auto", "memory", "rest"],
+                   help="auto: REST when a kubeconfig/in-cluster config "
+                        "resolves, else in-memory (tests/smoke)")
+    p.add_argument("--api-server", default="",
+                   help="API server URL for the REST backend (overrides "
+                        "kubeconfig resolution)")
     return p
+
+
+def build_cluster(args: argparse.Namespace):
+    """Select the cluster backend (reference main.go:77-83 — the manager
+    always dials a real API server; here `memory` keeps the envtest-style
+    in-process mode as an explicit choice)."""
+    backend = getattr(args, "cluster_backend", "auto")
+    url = getattr(args, "api_server", "")
+    token_path = ca_path = None
+    if backend in ("auto", "rest") and not url:
+        from tpu_on_k8s.client import kubeconfig
+
+        cfg = kubeconfig.resolve()
+        url = kubeconfig.server_url(cfg) or ""
+        token_path, ca_path = cfg.token_path, cfg.ca_path
+    if backend == "rest" or (backend == "auto" and url):
+        if not url:
+            raise SystemExit(
+                "--cluster-backend rest requires --api-server or a "
+                "resolvable kubeconfig/in-cluster config")
+        from tpu_on_k8s.client.rest import RestCluster
+
+        return RestCluster(url, token_path=token_path, ca_path=ca_path)
+    return InMemoryCluster()
 
 
 class Operator:
@@ -75,7 +106,7 @@ class Operator:
 
     def __init__(self, args: argparse.Namespace,
                  cluster: Optional[InMemoryCluster] = None):
-        self.cluster = cluster or InMemoryCluster()
+        self.cluster = cluster if cluster is not None else build_cluster(args)
         self.manager = Manager()
         self.metrics = JobMetrics()
         self.gates = (features.FeatureGates.parse(args.feature_gates)
@@ -105,8 +136,8 @@ class Operator:
             self.cluster, self.manager, config=self.config, gates=self.gates,
             gang_scheduler=gang, restarter=restarter, metrics=self.metrics,
             coordinator=self.coordinator, elastic_controller=self.elastic)
-        self.autoscaler = setup_elastic_autoscaler(self.cluster,
-                                                   config=self.config)
+        self.autoscaler = setup_elastic_autoscaler(
+            self.cluster, config=self.config, metrics=self.metrics)
         self.modelversion = setup_modelversion_controller(
             self.cluster, self.manager, config=self.config)
         self.elector = None
@@ -119,6 +150,8 @@ class Operator:
                         or f"{socket.gethostname()}-{os.getpid()}")
             self.elector = LeaderElector(self.cluster, identity)
         self._metrics_server = None
+        self._workers_lock = threading.Lock()
+        self._workers_running = False
 
     def run_once(self) -> int:
         """Single quiescence pump (smoke/test mode)."""
@@ -127,18 +160,38 @@ class Operator:
         return self.manager.run_until_idle()
 
     def _start_workers(self) -> None:
-        self.manager.start(
-            workers_per_controller=self.config.max_concurrent_reconciles)
-        if self.coordinator is not None:
-            threading.Thread(target=self.coordinator.run, daemon=True).start()
-        threading.Thread(target=self.autoscaler.run, daemon=True).start()
+        # re-acquiring leadership must not stack a second set of threads on
+        # top of a still-running first set (double-reconcile in-process);
+        # coordinator.run()/autoscaler.run() manage their own threads
+        with self._workers_lock:
+            if self._workers_running:
+                return
+            self._workers_running = True
+            self.manager.start(
+                workers_per_controller=self.config.max_concurrent_reconciles)
+            if self.coordinator is not None:
+                self.coordinator.run()
+            self.autoscaler.run()
+
+    def _stop_workers(self) -> None:
+        """Mirror of _start_workers: losing the lease must stop *every*
+        reconciling thread, not just the manager — a coordinator or
+        autoscaler that keeps running on a non-leader is a split brain."""
+        with self._workers_lock:
+            if not self._workers_running:
+                return
+            self._workers_running = False
+            if self.coordinator is not None:
+                self.coordinator.stop()
+            self.autoscaler.stop()
+            self.manager.stop()
 
     def start(self, metrics_port: int = 0) -> None:
         if self.elector is not None:
             # controllers run only while we hold the lease; losing it stops
             # them so a split brain cannot double-reconcile
             self.elector.on_started_leading = self._start_workers
-            self.elector.on_stopped_leading = self.manager.stop
+            self.elector.on_stopped_leading = self._stop_workers
             self.elector.start()
         else:
             self._start_workers()
@@ -148,10 +201,7 @@ class Operator:
     def stop(self) -> None:
         if self.elector is not None:
             self.elector.stop()
-        if self.coordinator is not None:
-            self.coordinator.stop()
-        self.autoscaler.stop()
-        self.manager.stop()
+        self._stop_workers()
 
 
 def main(argv=None) -> int:

@@ -40,6 +40,9 @@ class JobMetrics:
                 self._prom_counters[name] = _prom.Counter(
                     f"{ns}_jobs_{name}", f"Jobs {name} for kind {kind}",
                     registry=registry)
+            self._prom_counters["errors"] = _prom.Counter(
+                f"{ns}_controller_errors_total",
+                "Exceptions caught in controller run loops", registry=registry)
             for name in ("first_pod_launch_delay_seconds", "all_pods_launch_delay_seconds"):
                 self._prom_hists[name] = _prom.Histogram(
                     f"{ns}_jobs_{name}", f"Job {name}", buckets=_BUCKETS,
@@ -89,6 +92,9 @@ class JobMetrics:
 
     def restarted(self) -> None:
         self.inc("restarted")
+
+    def error(self) -> None:
+        self.inc("errors")
 
     def first_pod_launch_delay(self, seconds: float) -> None:
         self.observe("first_pod_launch_delay_seconds", seconds)

@@ -64,7 +64,10 @@ def generate(cfg: TransformerConfig, params, prompt: jnp.ndarray,
         positions = jnp.broadcast_to(jnp.arange(lp), (b, lp))
         logits, upd = model.apply({"params": params, "cache": cache},
                                   prompt, positions, mutable=["cache"])
-        first = pick(logits[:, -1], rng)
+        # split once up front: reusing `rng` for both the prefill sample and
+        # the scan keys would correlate the first token with later ones
+        first_key, step_key = jax.random.split(rng)
+        first = pick(logits[:, -1], first_key)
 
         def step(carry, step_rng):
             cache, tok, pos = carry
@@ -79,7 +82,7 @@ def generate(cfg: TransformerConfig, params, prompt: jnp.ndarray,
         # after max_new_tokens steps the emitted stack IS the continuation.
         _, toks = jax.lax.scan(
             step, (upd["cache"], first, pos0),
-            jax.random.split(rng, max_new_tokens))
+            jax.random.split(step_key, max_new_tokens))
         return toks.transpose(1, 0)
 
     return run(params, prompt, cache, rng)

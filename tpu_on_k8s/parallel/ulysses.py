@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from tpu_on_k8s.parallel.mesh import AXIS_SEQ
+from tpu_on_k8s.parallel.mesh import AXIS_MODEL, AXIS_SEQ
 from tpu_on_k8s.parallel.ring import _qkv_spec, _resolve_mesh
 
 
@@ -42,10 +42,19 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if q.shape[1] % n != 0:
         raise ValueError(
             f"ulysses needs seq len {q.shape[1]} divisible by {axis_name}={n}")
-    if q.shape[2] % n != 0:
+    # _qkv_spec may also shard heads over the model axis; the all-to-all then
+    # splits the *per-device* head count, so divisibility must be checked
+    # against H/model, not the global H, under the same sharding condition.
+    model_size = resolved.shape.get(AXIS_MODEL, 1)
+    heads = q.shape[2]
+    local_heads = (heads // model_size
+                   if model_size > 1 and heads % model_size == 0 else heads)
+    if local_heads % n != 0:
         raise ValueError(
-            f"ulysses needs n_heads {q.shape[2]} divisible by {axis_name}={n}")
-    spec = _qkv_spec(resolved, axis_name, q.shape[0], q.shape[2])
+            f"ulysses needs per-device head count {local_heads} "
+            f"(n_heads {heads} over model={model_size}) divisible by "
+            f"{axis_name}={n}")
+    spec = _qkv_spec(resolved, axis_name, q.shape[0], heads)
 
     def local(q_, k_, v_):
         # [B, L/n, H, D] local → all-to-all → [B, L, H/n, D]

@@ -32,7 +32,9 @@ import orbax.checkpoint as ocp
 from tpu_on_k8s.api import constants
 from tpu_on_k8s.api.types import TPUJob
 
-_GEN_RE = re.compile(r"^gen_(\d{6})$")
+# accept any width: _gen_dir zero-pads to 6 digits but generations >= 1e6
+# grow wider, and discovery must still see them on restore
+_GEN_RE = re.compile(r"^gen_(\d+)$")
 
 
 def _gen_dir(root: Path, generation: int) -> Path:
