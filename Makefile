@@ -1,7 +1,13 @@
 # Developer entry points (reference Makefile analog — test/build/run targets;
 # no codegen: serde is reflective, no generated clientset to regenerate).
 
-.PHONY: test test-fast native bench dryrun manager samples clean
+# Image URL for the manager container (reference Makefile:3 `IMG ?= ...`);
+# matches config/manager/manager.yaml so the kustomize graph deploys what
+# docker-build produces.
+IMG ?= tpu-on-k8s/manager:latest
+
+.PHONY: test test-fast native bench dryrun manager samples clean \
+        docker-build docker-push deploy undeploy
 
 test:
 	python -m pytest tests/ -q
@@ -23,6 +29,18 @@ dryrun:  ## the driver's multi-chip compile check on a virtual 8-device mesh
 
 manager:
 	python -m tpu_on_k8s.main --once
+
+docker-build:  ## build the manager image (reference Makefile:72-75)
+	docker build -t $(IMG) .
+
+docker-push:  ## push the manager image (reference Makefile:77-79)
+	docker push $(IMG)
+
+deploy:  ## install CRDs + RBAC + manager via the kustomize graph
+	kubectl apply -k config/default
+
+undeploy:
+	kubectl delete -k config/default
 
 clean:
 	rm -rf tpu_on_k8s/data/native/build .pytest_cache
