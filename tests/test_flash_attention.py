@@ -24,21 +24,62 @@ def _qkv(b=2, l=256, h=2, d=64, dtype=jnp.float32, seed=0):
 @pytest.mark.parametrize("causal", [True, False])
 def test_forward_matches_xla(causal):
     q, k, v = _qkv()
-    got = flash_attention(q, k, v, causal=causal, block=128)
+    got = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
     want = xla_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
 
 
 def test_forward_block_smaller_than_seq():
     q, k, v = _qkv(l=512)
-    got = flash_attention(q, k, v, causal=True, block=128)
+    got = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
     want = xla_attention(q, k, v, causal=True)
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
 
 
 def test_block_clamps_to_short_seq():
     q, k, v = _qkv(l=64)
-    got = flash_attention(q, k, v, causal=True, block=128)
+    got = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    want = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_asymmetric_blocks_match_xla():
+    """block_q != block_k exercises the generalized causal loop bounds."""
+    q, k, v = _qkv(l=512)
+    for bq, bk in ((256, 128), (128, 256), (512, 128)):
+        got = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+        want = xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5,
+                                   err_msg=f"bq={bq} bk={bk}")
+
+
+def test_asymmetric_block_gradients_match_xla():
+    q, k, v = _qkv(b=1, l=256, h=2, d=32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=128, block_k=64) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(xla_attention(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_flash, g_xla, "qkv"):
+        np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_auto_block_handles_non_512_divisible_seq():
+    """Default (auto) blocks must serve any 128-multiple length — 768 is not
+    divisible by 512 and picks 384."""
+    from tpu_on_k8s.ops.flash_attention import auto_block
+
+    assert auto_block(768) == 384
+    assert auto_block(1024) == 512
+    assert auto_block(192) == 192      # short seq: one block
+    q, k, v = _qkv(b=1, l=768, h=2)
+    got = flash_attention(q, k, v, causal=True)   # auto blocks
     want = xla_attention(q, k, v, causal=True)
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
 
@@ -46,7 +87,7 @@ def test_block_clamps_to_short_seq():
 def test_indivisible_seq_raises():
     q, k, v = _qkv(l=192)
     with pytest.raises(ValueError, match="divisible"):
-        flash_attention(q, k, v, block=128)
+        flash_attention(q, k, v, block_q=128, block_k=128)
 
 
 @pytest.mark.parametrize("causal", [True, False])
@@ -54,7 +95,7 @@ def test_gradients_match_xla(causal):
     q, k, v = _qkv(b=1, l=256, h=2, d=32)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=causal, block=128) ** 2)
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=128, block_k=128) ** 2)
 
     def loss_xla(q, k, v):
         return jnp.sum(xla_attention(q, k, v, causal=causal) ** 2)
@@ -68,7 +109,7 @@ def test_gradients_match_xla(causal):
 
 def test_bf16_inputs():
     q, k, v = _qkv(dtype=jnp.bfloat16)
-    got = flash_attention(q, k, v, causal=True, block=128)
+    got = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
     want = xla_attention(q, k, v, causal=True)
     assert got.dtype == jnp.bfloat16
     np.testing.assert_allclose(got.astype(np.float32), want.astype(np.float32),

@@ -1,0 +1,59 @@
+"""Microbenchmark: attention impls in isolation at the headline shape.
+
+python tools/attn_micro.py [B] [L] [H] [D]
+"""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from tpu_on_k8s.models.transformer import xla_attention
+from tpu_on_k8s.ops.flash_attention import flash_attention
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 12
+L = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+H = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+D = int(sys.argv[4]) if len(sys.argv) > 4 else 64
+
+q = jax.random.normal(jax.random.key(0), (B, L, H, D), jnp.bfloat16)
+k = jax.random.normal(jax.random.key(1), (B, L, H, D), jnp.bfloat16)
+v = jax.random.normal(jax.random.key(2), (B, L, H, D), jnp.bfloat16)
+
+
+def timeit(name, fn, *args, steps=30):
+    fn_j = jax.jit(fn)
+    out = fn_j(*args)
+    jax.tree.map(lambda x: x.addressable_data(0), out)
+    _ = float(jnp.sum(jax.tree.leaves(out)[0]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn_j(*args)
+    _ = float(jnp.sum(jax.tree.leaves(out)[0]))
+    dt = (time.perf_counter() - t0) / steps
+    # causal attention flops: QK^T + PV = 2 * 2 * B*H*L*L*D / 2 (causal half)
+    flops = 2 * 2 * B * H * L * L * D / 2
+    print(f"{name:30s} {dt * 1e3:8.2f} ms  ({flops / dt / 1e12:6.2f} TF/s)",
+          flush=True)
+    return dt
+
+
+def grad_wrap(attn):
+    def loss(q, k, v):
+        return jnp.sum(attn(q, k, v, causal=True).astype(jnp.float32) ** 2)
+    return jax.grad(loss, argnums=(0, 1, 2))
+
+
+timeit("xla fwd", lambda a, b_, c: xla_attention(a, b_, c, causal=True), q, k, v)
+timeit("xla fwd+bwd", grad_wrap(xla_attention), q, k, v)
+for blk in (128, 256, 512):
+    fa = functools.partial(flash_attention, block_q=blk, block_k=blk)
+    timeit(f"flash[{blk}] fwd", lambda a, b_, c, f=fa: f(a, b_, c, causal=True),
+           q, k, v)
+    timeit(f"flash[{blk}] fwd+bwd", grad_wrap(fa), q, k, v)

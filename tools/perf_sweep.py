@@ -1,0 +1,93 @@
+"""Perf sweep for the headline 350M bench: times train-step variants on the
+real chip so tuning decisions are measured, not guessed.
+
+Usage: python tools/perf_sweep.py [variant ...]
+Each variant is name=value pairs joined by commas, e.g.:
+    python tools/perf_sweep.py attn=flash,batch=16 attn=xla,batch=24
+
+Prints one line per variant: name, step ms, tok/s, MFU (same formula as
+bench.py). Variants that OOM or fail print the error and continue.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from tpu_on_k8s.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    flagship_partition_rules,
+)
+from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+from tpu_on_k8s.train.trainer import Trainer, default_optimizer
+
+from bench import bench_config, n_params, _PEAK_FLOPS, _DEFAULT_PEAK
+
+
+def run_variant(spec: str) -> None:
+    opts = dict(kv.split("=", 1) for kv in spec.split(",") if kv)
+    batch = int(opts.pop("batch", 12))
+    attn = opts.pop("attn", "xla")
+    remat = opts.pop("remat", "dots")        # full | dots | dots_kernels | mlp | off
+    block = int(opts.pop("block", 0))        # 0 = auto
+    steps = int(opts.pop("steps", 20))
+    mu = opts.pop("mu", "bf16")              # bf16 | fp32
+    chunks = int(opts.pop("chunks", 0))
+    if opts:
+        raise ValueError(f"unknown keys {list(opts)}")
+
+    base = bench_config()
+    cfg = TransformerConfig(
+        **{**{f.name: getattr(base, f.name)
+              for f in base.__dataclass_fields__.values()},
+           "attn_impl": attn,
+           "attn_block_q": block,
+           "attn_block_k": block,
+           "remat": remat != "off",
+           "remat_policy": remat if remat != "off" else "full"})
+    devices = jax.devices()
+    mesh = create_mesh(MeshConfig(data=1, fsdp=len(devices), model=1, seq=1))
+    model = Transformer(cfg)
+    trainer = Trainer(model, flagship_partition_rules(), mesh,
+                      default_optimizer(
+                          warmup_steps=10, decay_steps=1000,
+                          mu_dtype=jnp.bfloat16 if mu == "bf16" else None),
+                      loss_chunks=chunks)
+    seqlen = cfg.max_seq_len
+    tokens = jax.random.randint(jax.random.key(1), (batch, seqlen + 1), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    t_compile = time.perf_counter()
+    state = trainer.init_state(jax.random.key(0), tokens[:, :-1])
+    sharded = trainer.shard_batch(tokens)
+    for _ in range(3):
+        state, metrics = trainer.train_step(state, sharded)
+    float(metrics["loss"])
+    compile_s = time.perf_counter() - t_compile
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.train_step(state, sharded)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tok_s = steps * batch * seqlen / dt
+    kind = getattr(devices[0], "device_kind", "").lower()
+    peak = next((v for k, v in _PEAK_FLOPS.items() if k in kind),
+                _DEFAULT_PEAK) * len(devices)
+    mfu = tok_s * 6 * n_params(cfg) / peak
+    print(f"{spec:45s} step={dt / steps * 1e3:7.1f}ms tok/s={tok_s:9.1f} "
+          f"MFU={mfu:.4f} (compile+warmup {compile_s:.0f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    for spec in sys.argv[1:] or ["attn=xla,batch=12"]:
+        try:
+            run_variant(spec)
+        except Exception as e:  # keep sweeping past OOMs
+            print(f"{spec:45s} FAILED: {type(e).__name__}: {e}", flush=True)

@@ -55,7 +55,14 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32     # master weights
     remat: bool = True
     remat_policy: str = "full"         # "full" | "dots" (save MXU outputs)
+                                       # | "dots_kernels" (dots + pallas-call
+                                       #   outputs: flash o/lse stay resident,
+                                       #   so the bwd pass never re-runs the
+                                       #   attention forward kernel)
+                                       # | "mlp" (remat only the MLP)
     attn_impl: str = "xla"             # "xla" | "flash" | "ring" | "ulysses"
+    attn_block_q: int = 0              # flash kernel q-block; 0 = auto (512)
+    attn_block_k: int = 0              # flash kernel k-block; 0 = auto (512)
     pos_emb: str = "rope"              # "rope" | "learned" (GPT-2 family)
     norm: str = "rms"                  # "rms" | "ln"
     activation: str = "swiglu"         # "swiglu" | "gelu"
@@ -96,6 +103,16 @@ class TransformerConfig:
                                  max_seq_len=128, remat=False)
 
 
+def _dots_and_kernels_saveable(prim, *args, **params) -> bool:
+    """Checkpoint policy: no-batch-dim dots + Pallas kernel outputs saveable."""
+    if prim is None:
+        return False
+    if getattr(prim, "name", "") == "pallas_call":
+        return True
+    return jax.checkpoint_policies.dots_with_no_batch_dims_saveable(
+        prim, *args, **params)
+
+
 def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
     """Rotary position embedding. x: [B, L, H, Dh]; positions: [B, L]."""
     half = x.shape[-1] // 2
@@ -123,6 +140,20 @@ def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+
+def xla_attention_bhld(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                       causal: bool = True) -> jnp.ndarray:
+    """``xla_attention`` for heads-leading [B, H, L, Dh] tensors."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhld,bhmd->bhlm", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        l, m = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((l, m), dtype=bool))
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhlm,bhmd->bhld", probs, v)
 
 
 def _select_attention(impl: str) -> Callable[..., jnp.ndarray]:
@@ -174,6 +205,61 @@ def make_norm(cfg: TransformerConfig, name: str) -> nn.Module:
     return RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype, name=name)
 
 
+def rope_bhld(x: jnp.ndarray, positions: jnp.ndarray,
+              theta: float) -> jnp.ndarray:
+    """Rotary embedding for heads-leading x: [B, H, L, Dh]; positions [B, L]."""
+    half = x.shape[-1] // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, L, half]
+    cos = jnp.cos(angles)[:, None, :, :]                       # [B, 1, L, half]
+    sin = jnp.sin(angles)[:, None, :, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class _HeadProj(nn.Module):
+    """QKV projection emitting heads-leading [B, H, L, Dh] straight from the
+    matmul (``bld,dhf->bhlf``) — no transpose op between projection and
+    attention kernel. The param is the identical 2-D ``kernel`` an
+    ``nn.Dense`` would own (reshaped on the fly, a free relayout), keeping
+    checkpoints and partition rules layout-agnostic."""
+
+    heads: int
+    head_dim: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        d_in = x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.normal(0.02),
+                            (d_in, self.heads * self.head_dim),
+                            self.param_dtype)
+        k3 = kernel.reshape(d_in, self.heads, self.head_dim).astype(self.dtype)
+        return jnp.einsum("bld,dhf->bhlf", x, k3)
+
+
+class _OutProj(nn.Module):
+    """Output projection consuming heads-leading [B, H, L, Dh]
+    (``bhlf,hfd->bld``); param identical to the ``nn.Dense`` wo kernel."""
+
+    d_model: int
+    heads: int
+    head_dim: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, o: jnp.ndarray) -> jnp.ndarray:
+        kernel = self.param("kernel", nn.initializers.normal(0.02),
+                            (self.heads * self.head_dim, self.d_model),
+                            self.param_dtype)
+        k3 = kernel.reshape(self.heads, self.head_dim,
+                            self.d_model).astype(self.dtype)
+        return jnp.einsum("bhlf,hfd->bld", o, k3)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -184,6 +270,8 @@ class Attention(nn.Module):
             feats, use_bias=False, name=name, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             kernel_init=nn.initializers.normal(0.02))
+        if cfg.attn_impl in ("xla", "flash") and not cfg.decode:
+            return self._attention_bhld(x, positions)
         q = dense(cfg.n_heads * cfg.head_dim, "wq")(x)
         k = dense(cfg.n_kv_heads * cfg.head_dim, "wk")(x)
         v = dense(cfg.n_kv_heads * cfg.head_dim, "wv")(x)
@@ -205,6 +293,34 @@ class Attention(nn.Module):
             out = _select_attention(cfg.attn_impl)(q, k, v, causal=True)
         out = out.reshape(b, l, cfg.n_heads * cfg.head_dim)
         return dense(cfg.d_model, "wo")(out)
+
+    def _attention_bhld(self, x: jnp.ndarray,
+                        positions: jnp.ndarray) -> jnp.ndarray:
+        """Heads-leading fast path for the single-device attention impls
+        (measured ~35% faster per layer than project→reshape→transpose at
+        the 350M bench shape; see `_HeadProj`)."""
+        cfg = self.cfg
+        hp = lambda heads, name: _HeadProj(heads, cfg.head_dim, cfg.dtype,
+                                           cfg.param_dtype, name=name)
+        q = hp(cfg.n_heads, "wq")(x)          # [B, H, L, Dh]
+        k = hp(cfg.n_kv_heads, "wk")(x)       # [B, Hkv, L, Dh]
+        v = hp(cfg.n_kv_heads, "wv")(x)
+        if cfg.pos_emb == "rope":
+            q = rope_bhld(q, positions, cfg.rope_theta)
+            k = rope_bhld(k, positions, cfg.rope_theta)
+        rep = cfg.n_heads // cfg.n_kv_heads
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+        if cfg.attn_impl == "flash":
+            from tpu_on_k8s.ops.flash_attention import _flash, auto_block
+            l = q.shape[2]
+            out = _flash(q, k, v, True,
+                         cfg.attn_block_q or auto_block(l),
+                         cfg.attn_block_k or auto_block(l))
+        else:
+            out = xla_attention_bhld(q, k, v, causal=True)
+        return _OutProj(cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.dtype,
+                        cfg.param_dtype, name="wo")(out)
 
     def _cached_attention(self, q, k, v, positions, rep: int) -> jnp.ndarray:
         """KV-cache attention: append this call's keys/values at the cache
@@ -263,7 +379,22 @@ class Block(nn.Module):
             make_norm(cfg, "attn_norm")(x), positions)
         if cfg.n_experts > 0:
             from tpu_on_k8s.models.moe import MoEMLP
-            mlp = MoEMLP(cfg, name="moe")
+            if cfg.remat and cfg.remat_policy == "mlp":
+                mlp = nn.remat(
+                    MoEMLP, prevent_cse=False,
+                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                )(cfg, name="moe")
+            else:
+                mlp = MoEMLP(cfg, name="moe")
+        elif cfg.remat and cfg.remat_policy == "mlp":
+            # MLP-only remat: the d_ff activations (the big buffers) are
+            # recomputed, while attention residuals (q/k/v/o/lse — small once
+            # flash attention removes the L² scores) stay resident so the
+            # backward pass never re-runs the attention forward kernel.
+            mlp = nn.remat(
+                MLP, prevent_cse=False,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )(cfg, name="mlp")
         else:
             mlp = MLP(cfg, name="mlp")
         out = h + mlp(make_norm(cfg, "mlp_norm")(h))
@@ -271,13 +402,32 @@ class Block(nn.Module):
 
 
 class Transformer(nn.Module):
-    """Decoder-only LM. __call__([B, L] int tokens) → [B, L, vocab] logits."""
+    """Decoder-only LM. __call__([B, L] int tokens) → [B, L, vocab] logits.
+
+    ``apply(..., method="features")`` returns the final-norm hidden states
+    [B, L, D] plus the output-projection matrix [D, V] instead of logits, so
+    a chunked loss (`tpu_on_k8s/train/trainer.py::chunked_cross_entropy`) can
+    fold the head matmul into per-chunk loss computation and never
+    materialise the [B, L, V] fp32 logits in HBM.
+    """
 
     cfg: TransformerConfig
 
-    @nn.compact
+    def features(self, tokens: jnp.ndarray,
+                 positions: Optional[jnp.ndarray] = None):
+        x, head = self._trunk(tokens, positions)
+        return x, head
+
     def __call__(self, tokens: jnp.ndarray,
                  positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        x, head = self._trunk(tokens, positions)
+        # fp32 logits: the loss softmax wants full precision.
+        return jnp.einsum("bld,dv->blv", x, head,
+                          preferred_element_type=jnp.float32)
+
+    @nn.compact
+    def _trunk(self, tokens: jnp.ndarray,
+               positions: Optional[jnp.ndarray] = None):
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(
@@ -292,13 +442,22 @@ class Transformer(nn.Module):
             x = x + jnp.take(pos_table, positions, axis=0)
         x = x.astype(cfg.dtype)
 
-        if cfg.remat:
+        if cfg.remat and cfg.remat_policy != "mlp":
             # "dots": keep matmul outputs resident, recompute only the cheap
             # elementwise tail — less recompute on the MXU for a modest HBM cost.
-            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                      if cfg.remat_policy == "dots" else None)
+            # "dots_kernels" additionally saves Pallas kernel outputs (flash
+            # attention o/lse, ~25MB/layer at the headline shape) so backward
+            # reuses them instead of re-running the forward kernel (~19ms/step
+            # at the 350M bench config).
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            elif cfg.remat_policy == "dots_kernels":
+                policy = _dots_and_kernels_saveable
+            else:
+                policy = None
             block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
         else:
+            # remat off, or "mlp" policy (Block handles the inner remat)
             block_cls = Block
         # One traced block body for the whole stack; params stack on axis 0 —
         # compile time is O(1) in depth and rules see a leading "layers" dim.
@@ -313,14 +472,11 @@ class Transformer(nn.Module):
         x, _ = stack(x, positions)
 
         x = make_norm(cfg, "final_norm")(x)
-        # fp32 logits: the loss softmax wants full precision.
         if cfg.tie_embeddings:
-            return jnp.einsum("bld,vd->blv", x, embed.astype(cfg.dtype),
-                              preferred_element_type=jnp.float32)
+            return x, embed.astype(cfg.dtype).T
         head = self.param("lm_head", nn.initializers.normal(0.02),
                           (cfg.d_model, cfg.vocab_size), cfg.param_dtype)
-        return jnp.einsum("bld,dv->blv", x, head.astype(cfg.dtype),
-                          preferred_element_type=jnp.float32)
+        return x, head.astype(cfg.dtype)
 
 
 def flagship_partition_rules() -> List[PartitionRule]:

@@ -12,10 +12,19 @@ The reference operator has no kernels at all (training math lived in user
 containers — SURVEY.md §2.10); this is the TPU-native compute path that
 replaces what the reference delegated to torch/CUDA user images.
 
+Performance notes (measured on v5e at B=12, H=16, L=1024, D=64):
+* dots take bf16 inputs with fp32 accumulation (``preferred_element_type``);
+  casting inputs to fp32 first silently runs the MXU in its slow fp32 mode.
+* block sizes dominate: 512 beats 128 by ~1.8x end-to-end — the grid shrinks
+  4x, so Mosaic's per-cell overheads amortise over real work. Defaults are
+  the measured optimum for the headline config; at these sizes this kernel
+  beats both plain XLA attention (1.8x) and the jax.experimental reference
+  flash kernel (3x) at seq 1024.
+
 Layout contract (matches ``xla_attention`` in `tpu_on_k8s/models/transformer.py`):
 q, k, v are [B, L, H, D] with kv already repeated to H heads (GQA is the
-caller's concern). Sequence length must be divisible by the block size after
-clamping (block is clamped to L); head_dim is padded to the 128-lane tile by
+caller's concern). Sequence length must be divisible by the block sizes after
+clamping (blocks are clamped to L); head_dim is padded to the 128-lane tile by
 Mosaic automatically.
 
 On CPU backends the kernel runs in Pallas interpret mode so the full test
@@ -32,6 +41,25 @@ from jax.experimental import pallas as pl
 
 NEG_INF = -1e30  # large-but-finite: keeps exp(masked - m) an exact underflow
 
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+
+
+def auto_block(length: int, target: int = DEFAULT_BLOCK_Q) -> int:
+    """Largest measured-good block size ≤ ``target`` that divides ``length``.
+
+    512 is the v5e optimum at the bench shapes; shorter sequences use one
+    block, and lengths not divisible by 512 fall back to the largest
+    divisible candidate so any 128-multiple sequence length works."""
+    if length <= target:
+        return length
+    for b in (512, 384, 256, 128, 64):
+        if b <= target and length % b == 0:
+            return b
+    raise ValueError(
+        f"flash attention: no block size in (512, 384, 256, 128, 64) divides "
+        f"seq len {length}; pad the sequence to a multiple of 128")
+
 
 def _interpret() -> bool:
     return jax.default_backend() == "cpu"
@@ -46,34 +74,46 @@ def _block(block: int, length: int) -> int:
     return b
 
 
+def _causal_steps(i, bq: int, bk: int, nk: int, causal: bool):
+    """Number of leading K blocks a Q block attends into (ceil div)."""
+    if not causal:
+        return nk
+    return ((i + 1) * bq + bk - 1) // bk
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
-                block: int, causal: bool):
+                block_q: int, block_k: int, causal: bool):
     i = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * scale            # [bq, D]
+    # Dots take bf16 inputs with fp32 accumulation (preferred_element_type):
+    # casting inputs to fp32 first would run the MXU in its slow fp32 mode.
+    q = q_ref[0, 0]                                        # [bq, D] bf16
     bq, d = q.shape
-    nk = k_ref.shape[2] // block
-    steps = (i + 1) if causal else nk
+    nk = k_ref.shape[2] // block_k
+    steps = _causal_steps(i, bq, block_k, nk, causal)
 
     def body(j, carry):
         acc, m, l = carry
-        k_blk = k_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [bq, bk]
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk] fp32
         if causal:
-            q_pos = i * block + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 0)
-            k_pos = j * block + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 1)
+            q_pos = i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))        # [bq]
-        p = jnp.exp(s - m_new[:, None])                    # [bq, bk]
+        p = jnp.exp(s - m_new[:, None])                    # [bq, bk] fp32
         correction = jnp.exp(m - m_new)                    # [bq]
         l_new = l * correction + jnp.sum(p, axis=-1)
         acc_new = acc * correction[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
@@ -86,13 +126,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
 
 
 def _fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool,
-         block: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+         block_q: int, block_k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """q/k/v: [B, H, L, D] → (out [B, H, L, D], lse [B, H, L])."""
     b, h, l, d = q.shape
-    bq = _block(block, l)
+    bq = _block(block_q, l)
+    bk = _block(block_k, l)
     grid = (b, h, l // bq)
-    kernel = functools.partial(_fwd_kernel, scale=d ** -0.5, block=bq,
-                               causal=causal)
+    kernel = functools.partial(_fwd_kernel, scale=d ** -0.5, block_q=bq,
+                               block_k=bk, causal=causal)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -120,29 +161,31 @@ def _fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool,
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale: float, block: int, causal: bool):
+               scale: float, block_q: int, block_k: int, causal: bool):
     i = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)                    # [bq, D]
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, 0]                                 # [bq]
+    q = q_ref[0, 0]                                        # [bq, D] bf16
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0, 0]                                 # [bq] fp32
     delta = delta_ref[0, 0, 0]
     bq, d = q.shape
-    nk = k_ref.shape[2] // block
-    steps = (i + 1) if causal else nk
+    nk = k_ref.shape[2] // block_k
+    steps = _causal_steps(i, bq, block_k, nk, causal)
 
     def body(j, dq):
-        k_blk = k_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
         s = scale * jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                         preferred_element_type=jnp.float32)
         if causal:
-            q_pos = i * block + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 0)
-            k_pos = j * block + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 1)
+            q_pos = i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                      # [bq, bk]
+        p = jnp.exp(s - lse[:, None])                      # [bq, bk] fp32
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(k_blk.dtype)
         return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
@@ -151,32 +194,37 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale: float, block: int, causal: bool):
+                dk_ref, dv_ref, *, scale: float, block_q: int, block_k: int,
+                causal: bool):
     j = pl.program_id(2)
-    k_blk = k_ref[0, 0].astype(jnp.float32)                # [bk, D]
-    v_blk = v_ref[0, 0].astype(jnp.float32)
+    k_blk = k_ref[0, 0]                                    # [bk, D] bf16
+    v_blk = v_ref[0, 0]
     bk, d = k_blk.shape
-    nq = q_ref.shape[2] // block
-    start = j if causal else 0
+    nq = q_ref.shape[2] // block_q
+    # first Q block that attends into this K block: floor(j*bk / bq)
+    start = (j * bk) // block_q if causal else 0
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * block, block), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(i * block, block), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, 0, pl.ds(i * block, block)]
-        delta = delta_ref[0, 0, 0, pl.ds(i * block, block)]
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, 0, 0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, 0, 0, pl.ds(i * block_q, block_q)]
         s = scale * jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                         preferred_element_type=jnp.float32)
         if causal:
-            q_pos = i * block + jax.lax.broadcasted_iota(jnp.int32, (block, bk), 0)
-            k_pos = j * block + jax.lax.broadcasted_iota(jnp.int32, (block, bk), 1)
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                      # [bq, bk]
-        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        p = jnp.exp(s - lse[:, None])                      # [bq, bk] fp32
+        dv_new = dv + jax.lax.dot_general(p.astype(do.dtype), do,
+                                          (((0,), (0,)), ((), ())),
                                           preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                           preferred_element_type=jnp.float32)
         return dk_new, dv_new
@@ -187,33 +235,36 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal: bool, block: int):
+def _bwd(q, k, v, o, lse, do, causal: bool, block_q: int, block_k: int):
     b, h, l, d = q.shape
-    bq = _block(block, l)
-    grid = (b, h, l // bq)
+    bq = _block(block_q, l)
+    bk = _block(block_k, l)
     # per-row sum(dO ⊙ O): cheap elementwise reduce, XLA fuses it.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, :, None, :]                # [B, H, 1, L]
 
-    blk = lambda: pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0))
+    qblk = lambda: pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0))
+    kblk = lambda: pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i: (b_, h_, i, 0))
     full = lambda: pl.BlockSpec((1, 1, l, d), lambda b_, h_, i: (b_, h_, 0, 0))
-    row_blk = lambda: pl.BlockSpec((1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i))
+    row_qblk = lambda: pl.BlockSpec((1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i))
     row_full = lambda: pl.BlockSpec((1, 1, 1, l), lambda b_, h_, i: (b_, h_, 0, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=d ** -0.5, block=bq, causal=causal),
-        grid=grid,
-        in_specs=[blk(), full(), full(), blk(), row_blk(), row_blk()],
-        out_specs=blk(),
+        functools.partial(_dq_kernel, scale=d ** -0.5, block_q=bq,
+                          block_k=bk, causal=causal),
+        grid=(b, h, l // bq),
+        in_specs=[qblk(), full(), full(), qblk(), row_qblk(), row_qblk()],
+        out_specs=qblk(),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=d ** -0.5, block=bq, causal=causal),
-        grid=grid,
-        in_specs=[full(), blk(), blk(), full(), row_full(), row_full()],
-        out_specs=[blk(), blk()],
+        functools.partial(_dkv_kernel, scale=d ** -0.5, block_q=bq,
+                          block_k=bk, causal=causal),
+        grid=(b, h, l // bk),
+        in_specs=[full(), kblk(), kblk(), full(), row_full(), row_full()],
+        out_specs=[kblk(), kblk()],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         interpret=_interpret(),
@@ -225,35 +276,41 @@ def _bwd(q, k, v, o, lse, do, causal: bool, block: int):
 # public API with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal: bool, block: int):
-    out, _ = _fwd(q, k, v, causal, block)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal: bool, block_q: int, block_k: int):
+    out, _ = _fwd(q, k, v, causal, block_q, block_k)
     return out
 
 
-def _flash_fwd(q, k, v, causal, block):
-    out, lse = _fwd(q, k, v, causal, block)
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, causal, block_q, block_k)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block, residuals, g):
+def _flash_bwd(causal, block_q, block_k, residuals, g):
     q, k, v, o, lse = residuals
-    return _bwd(q, k, v, o, lse, g, causal, block)
+    return _bwd(q, k, v, o, lse, g, causal, block_q, block_k)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                    causal: bool = True, block: int = 128) -> jnp.ndarray:
+                    causal: bool = True,
+                    block_q: int = 0,
+                    block_k: int = 0) -> jnp.ndarray:
     """Flash attention on [B, L, H, D] tensors (kv pre-repeated to H heads).
 
     Drop-in for ``xla_attention`` — same layout, same semantics, O(L·D) HBM
-    traffic instead of O(L²).
+    traffic instead of O(L²). ``block_q``/``block_k`` of 0 pick
+    ``auto_block`` (512 when the sequence length allows it).
     """
+    l = q.shape[1]
+    block_q = block_q or auto_block(l)
+    block_k = block_k or auto_block(l)
     # kernels run in [B, H, L, D]; the transpose stays on-chip (layout change).
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash(qt, kt, vt, causal, block)
+    out = _flash(qt, kt, vt, causal, block_q, block_k)
     return out.transpose(0, 2, 1, 3)

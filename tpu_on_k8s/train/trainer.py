@@ -36,12 +36,51 @@ class TrainState:
 
 def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray,
                        mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """Mean next-token CE. logits [B, L, V] fp32; targets [B, L] int."""
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    """Mean next-token CE. logits [B, L, V] fp32; targets [B, L] int.
+
+    Formulated as ``logsumexp - gold`` rather than ``-log_softmax[target]``:
+    identical math, but avoids materialising a second [B, L, V] fp32 tensor
+    (the log-probabilities) in HBM — the lse reduction and the gold-logit
+    gather both read the logits once.
+    """
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = lse - gold
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.mean(nll)
+
+
+def chunked_cross_entropy(feats: jnp.ndarray, head: jnp.ndarray,
+                          targets: jnp.ndarray, n_chunks: int = 8) -> jnp.ndarray:
+    """Mean next-token CE without ever materialising [B, L, V] logits.
+
+    feats [B, L, D] (post-final-norm hidden states, from
+    ``Transformer.apply(..., method="features")``), head [D, V], targets
+    [B, L]. Tokens are processed in ``n_chunks`` sequence chunks under
+    ``jax.lax.scan`` + ``jax.checkpoint``: each chunk computes its logits,
+    reduces to (lse - gold), and discards them; backward recomputes per
+    chunk. Peak HBM for the loss drops from O(B·L·V) to O(B·L·V / n_chunks)
+    at the cost of one extra head matmul in backward.
+    """
+    b, l, d = feats.shape
+    n = b * l
+    if n % n_chunks != 0:
+        raise ValueError(f"B*L={n} not divisible by n_chunks={n_chunks}")
+    chunk = n // n_chunks
+    fl = feats.reshape(n_chunks, chunk, d)
+    tg = targets.reshape(n_chunks, chunk)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        f, t = xs
+        logits = jnp.dot(f, head, preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, t[:, None], axis=-1)[:, 0]
+        return carry + jnp.sum(lse - gold), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (fl, tg))
+    return total / n
 
 
 def default_optimizer(learning_rate: float = 3e-4,
@@ -81,7 +120,7 @@ def make_sharded_init(model: Any, optimizer: optax.GradientTransformation,
 
 
 def make_train_step(model: Any, optimizer: optax.GradientTransformation,
-                    aux_loss_weight: float = 0.0,
+                    aux_loss_weight: float = 0.0, loss_chunks: int = 0,
                     ) -> Callable[[TrainState, jnp.ndarray], Tuple[TrainState, dict]]:
     """One language-model train step on a [B, L] token batch (next-token CE,
     internal shift). Donates the state buffers. jit shardings propagate from
@@ -89,18 +128,26 @@ def make_train_step(model: Any, optimizer: optax.GradientTransformation,
 
     ``aux_loss_weight`` > 0 collects the model's ``losses`` collection (MoE
     load-balance terms, `tpu_on_k8s/models/moe.py`) into the objective.
+    ``loss_chunks`` > 0 uses the chunked head+CE path (requires the model to
+    expose ``features``; see ``chunked_cross_entropy``).
     """
 
     def loss_fn(params: Any, tokens: jnp.ndarray):
-        if aux_loss_weight:
-            logits, out = model.apply({"params": params}, tokens[:, :-1],
-                                      mutable=["losses"])
-            aux = sum(jnp.sum(leaf)
-                      for leaf in jax.tree.leaves(out.get("losses", {})))
+        mutable = ["losses"] if aux_loss_weight else False
+        if loss_chunks:
+            out = model.apply({"params": params}, tokens[:, :-1],
+                              method="features", mutable=mutable)
+            (feats, head), losses = out if aux_loss_weight else (out, {})
+            ce = chunked_cross_entropy(feats, head, tokens[:, 1:],
+                                       loss_chunks)
         else:
-            logits = model.apply({"params": params}, tokens[:, :-1])
-            aux = jnp.zeros((), jnp.float32)
-        ce = cross_entropy_loss(logits, tokens[:, 1:])
+            out = model.apply({"params": params}, tokens[:, :-1],
+                              mutable=mutable)
+            logits, losses = out if aux_loss_weight else (out, {})
+            ce = cross_entropy_loss(logits, tokens[:, 1:])
+        aux = (sum(jnp.sum(leaf)
+                   for leaf in jax.tree.leaves(dict(losses).get("losses", {})))
+               if aux_loss_weight else jnp.zeros((), jnp.float32))
         return ce + aux_loss_weight * aux, aux
 
     def step(state: TrainState, tokens: jnp.ndarray) -> Tuple[TrainState, dict]:
@@ -130,13 +177,13 @@ class Trainer:
     def __init__(self, model: Any, rules: Sequence[PartitionRule],
                  mesh: Mesh,
                  optimizer: Optional[optax.GradientTransformation] = None,
-                 aux_loss_weight: float = 0.0):
+                 aux_loss_weight: float = 0.0, loss_chunks: int = 0):
         self.model = model
         self.rules = list(rules)
         self.mesh = mesh
         self.optimizer = optimizer or default_optimizer()
         self._step = make_train_step(self.model, self.optimizer,
-                                     aux_loss_weight)
+                                     aux_loss_weight, loss_chunks)
         self._init_cache = {}
 
     def init_state(self, rng: jax.Array, example_tokens: jnp.ndarray) -> TrainState:
