@@ -307,5 +307,35 @@ class PriorityClass:
     value: int = 0
 
 
+@dataclass
+class ObjectReference:
+    """core/v1 ObjectReference (the involvedObject of an Event)."""
+
+    api_version: str = ""
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class Event:
+    """Real core/v1 Event object (reference record.EventRecorder emits these;
+    round 2 stored ad-hoc tuples — a conformant apiserver only accepts this
+    shape)."""
+
+    api_version: str = "v1"
+    kind: str = "Event"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    type: str = "Normal"          # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+    first_timestamp: Optional[_dt.datetime] = None
+    last_timestamp: Optional[_dt.datetime] = None
+    reporting_component: str = "tpu-on-k8s-manager"
+
+
 def deep_copy(obj):
     return serde.deep_copy(obj)

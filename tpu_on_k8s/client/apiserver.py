@@ -5,19 +5,36 @@ real etcd+kube-apiserver for `go test`): a threaded HTTP server exposing the
 API-machinery surface the controllers depend on —
 
 * group/version/namespace REST routing (`/api/v1/...`, `/apis/{g}/{v}/...`)
-  with typed Status errors (NotFound / AlreadyExists / Conflict);
+  with real scoping (PersistentVolume / PriorityClass are cluster-scoped) and
+  typed Status errors (NotFound / AlreadyExists / Conflict / Expired);
+* camelCase wire JSON (``serde.to_dict(wire=True)``), snake_case storage;
 * optimistic concurrency via resourceVersion on PUT (409 Conflict);
 * the status subresource (`PUT .../{name}/status`);
-* strategic metadata PATCH with finalizer add/remove (the reference's patch
-  DSL, pkg/utils/patch/patch.go:66-96, incl. `$deleteFromPrimitiveList`);
+* RFC 7386 JSON merge-patch (`Content-Type: application/merge-patch+json`)
+  with resourceVersion preconditions — the same payloads the reference builds
+  via pkg/utils/patch/patch.go:66-96, but in the patch dialect a conformant
+  apiserver accepts for CRDs (strategic merge is built-ins-only in real k8s);
 * graceful delete: finalizers pin the object with deletionTimestamp, drain
   completes the delete, ownerReference cascade GC follows;
+* list responses carry ``metadata.resourceVersion`` (the global revision) so
+  clients can list-then-watch without an event gap;
 * streaming watch (`?watch=true`, chunked JSON lines, k8s wire format
-  `{"type": ..., "object": ...}`) with an initial BOOKMARK so clients can
-  block until the stream is live (no missed-event gap);
-* pods/log subresource (GET with `tailLines`; POST is the kubelet-side
-  injection seam tests use, the one non-k8s extension);
-* core/v1 Events (POST + GET).
+  `{"type": ..., "object": ...}`) supporting ``resourceVersion=N`` resume
+  from a bounded history window, ``410 Expired`` ERROR events when the
+  window is exceeded (client must re-list), and optional BOOKMARK frames
+  (``allowWatchBookmarks=true``) carrying the current revision;
+* core/v1 Event objects through the ordinary CRUD routes;
+* pods/log subresource (GET with `tailLines`).
+
+Deliberate divergences from a conformant kube-apiserver (each is a test seam
+or a scope cut, not a semantic the controllers depend on):
+
+| Divergence | Why |
+|---|---|
+| `POST .../pods/{name}/log` injects a log line | kubelet stand-in: tests feed the stream the autoscaler's observer reads |
+| label selectors support `k=v` equality only | the only form the controllers emit |
+| no apiVersion conversion/validation webhooks | single-version API surface |
+| chunked JSON watch framing without client certs | auth is Bearer-token/TLS at the client; this server is the test/envtest seam |
 
 Storage delegates to `InMemoryCluster` — the same finalizer/cascade/conflict
 logic the controllers were developed against — so this file is purely the
@@ -31,30 +48,34 @@ import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
 
 from tpu_on_k8s.client import resources
 from tpu_on_k8s.client.cluster import (
     AlreadyExistsError,
     ConflictError,
+    ExpiredError,
     InMemoryCluster,
     NotFoundError,
     WatchEvent,
 )
 from tpu_on_k8s.utils import serde
 from tpu_on_k8s.utils.logging import get_logger
+from urllib.parse import parse_qs, urlparse
 
 _log = get_logger("apiserver")
 
 
 def _status_body(code: int, reason: str, message: str) -> bytes:
-    return json.dumps({"kind": "Status", "apiVersion": "v1",
-                       "status": "Failure", "reason": reason,
-                       "message": message, "code": code}).encode()
+    return json.dumps(_status_dict(code, reason, message)).encode()
+
+
+def _status_dict(code: int, reason: str, message: str) -> Dict[str, Any]:
+    return {"kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "reason": reason, "message": message, "code": code}
 
 
 def encode_obj(obj: Any) -> Dict[str, Any]:
-    return serde.to_dict(obj, drop_none=False)
+    return serde.to_dict(obj, drop_none=False, wire=True)
 
 
 def decode_obj(rt: resources.ResourceType, data: Dict[str, Any]) -> Any:
@@ -74,39 +95,65 @@ def parse_label_selector(raw: str) -> Optional[Dict[str, str]]:
     return out
 
 
+class _Sub:
+    """One watch subscriber: a bounded queue plus an overflow latch. A stalled
+    consumer overflows, the stream closes, and the client re-lists — the
+    honest semantics for an envtest analog (a real apiserver drops laggards
+    the same way)."""
+
+    MAXSIZE = 1024
+
+    def __init__(self, kind: str) -> None:
+        self.kind = kind
+        self.q: "queue.Queue" = queue.Queue(maxsize=self.MAXSIZE)
+        self.overflowed = threading.Event()
+
+
 class _WatchHub:
-    """Fans cluster watch events out to per-connection queues."""
+    """Fans cluster watch events out to per-connection bounded queues."""
 
     _CLOSE = object()
 
     def __init__(self, cluster: InMemoryCluster) -> None:
         self._lock = threading.Lock()
-        self._subs: List[Tuple[str, "queue.Queue"]] = []  # (kind, q)
-        cluster.watch(self._on_event)
+        self._subs: List[_Sub] = []
+        # Ordered subscription: fanout happens atomically with rv assignment,
+        # so per-stream queues are rv-sorted and the monotonic stream filter
+        # never drops a reordered event.
+        cluster.subscribe_ordered(self._on_event)
 
     def _on_event(self, event: WatchEvent) -> None:
         with self._lock:
             subs = list(self._subs)
-        for kind, q in subs:
-            if kind == event.kind:
-                q.put(event)
+        for sub in subs:
+            if sub.kind != event.kind:
+                continue
+            try:
+                sub.q.put_nowait(event)
+            except queue.Full:
+                sub.overflowed.set()
+                self.unsubscribe(sub)
 
-    def subscribe(self, kind: str) -> "queue.Queue":
-        q: "queue.Queue" = queue.Queue()
+    def subscribe(self, kind: str) -> _Sub:
+        sub = _Sub(kind)
         with self._lock:
-            self._subs.append((kind, q))
-        return q
+            self._subs.append(sub)
+        return sub
 
-    def unsubscribe(self, q: "queue.Queue") -> None:
+    def unsubscribe(self, sub: _Sub) -> None:
         with self._lock:
-            self._subs = [(k, s) for k, s in self._subs if s is not q]
+            if sub in self._subs:
+                self._subs.remove(sub)
 
     def close(self) -> None:
         with self._lock:
             subs = list(self._subs)
             self._subs = []
-        for _, q in subs:
-            q.put(self._CLOSE)
+        for sub in subs:
+            try:
+                sub.q.put_nowait(self._CLOSE)
+            except queue.Full:
+                sub.overflowed.set()
 
 
 class _Route:
@@ -118,6 +165,13 @@ class _Route:
         self.namespace = namespace
         self.name = name
         self.subresource = subresource
+
+    @property
+    def store_namespace(self) -> str:
+        """Namespace key for storage: cluster-scoped kinds live under ""."""
+        if not self.rt.namespaced:
+            return ""
+        return self.namespace if self.namespace is not None else ""
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -150,9 +204,6 @@ class _Handler(BaseHTTPRequestHandler):
         if not rest:
             return None, qs
         plural, rest = rest[0], rest[1:]
-        if group == "" and plural == "events":
-            # core/v1 Events have no dataclass kind; handled specially
-            return _Route(None, namespace, rest[0] if rest else None, None), qs  # type: ignore[arg-type]
         rt = resources.by_route(group, plural)
         if rt is None:
             return None, qs
@@ -176,6 +227,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(409, _status_body(409, "AlreadyExists", str(exc)))
         elif isinstance(exc, ConflictError):
             self._send_json(409, _status_body(409, "Conflict", str(exc)))
+        elif isinstance(exc, ExpiredError):
+            self._send_json(410, _status_body(410, "Expired", str(exc)))
         else:
             _log.exception("apiserver internal error")
             self._send_json(500, _status_body(500, "InternalError", str(exc)))
@@ -192,24 +245,31 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(404, _status_body(404, "NotFound", self.path))
             return
         try:
-            if route.rt is None:  # events
-                self._send_json(200, {"items": [list(e) for e in self.cluster.events]})
-                return
             if route.name is None:
                 if qs.get("watch", ["false"])[0] == "true":
-                    self._stream_watch(route)
+                    self._stream_watch(route, qs)
                     return
                 selector = parse_label_selector(
                     qs.get("labelSelector", [""])[0])
-                items = self.cluster.list(route.rt.cls, route.namespace,
-                                          selector)
-                self._send_json(200, {"kind": f"{route.rt.kind}List",
-                                      "items": [encode_obj(o) for o in items]})
+                # Revision first, list second: an event landing in between is
+                # replayed by a watch from this revision — duplicates are safe
+                # for level-triggered consumers; gaps are not.
+                rv = self.cluster.current_rv
+                ns = (route.store_namespace if (route.namespace is not None
+                                                or not route.rt.namespaced)
+                      else None)
+                items = self.cluster.list(route.rt.cls, ns, selector)
+                self._send_json(200, {
+                    "kind": f"{route.rt.kind}List",
+                    "apiVersion": (f"{route.rt.group}/{route.rt.version}"
+                                   if route.rt.group else route.rt.version),
+                    "metadata": {"resourceVersion": str(rv)},
+                    "items": [encode_obj(o) for o in items]})
                 return
             if route.subresource == "log":
                 tail = int(qs.get("tailLines", ["0"])[0])
-                lines = self.cluster.read_pod_log(route.namespace, route.name,
-                                                  tail=tail)
+                lines = self.cluster.read_pod_log(route.store_namespace,
+                                                  route.name, tail=tail)
                 body = ("\n".join(lines)).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain")
@@ -217,7 +277,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
-            obj = self.cluster.get(route.rt.cls, route.namespace, route.name)
+            obj = self.cluster.get(route.rt.cls, route.store_namespace,
+                                   route.name)
             self._send_json(200, encode_obj(obj))
         except Exception as exc:  # noqa: BLE001 — mapped to Status codes
             self._send_error_status(exc)
@@ -229,22 +290,18 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             body = self._read_body()
-            if route.rt is None:  # POST core/v1 events
-                inv = body.get("involvedObject", {})
-                self.cluster.events.append(
-                    (f"{inv.get('namespace', route.namespace)}/{inv.get('name', '')}",
-                     body.get("type", "Normal"), body.get("reason", ""),
-                     body.get("message", "")))
-                self._send_json(201, {"status": "ok"})
-                return
             if route.subresource == "log":
-                # kubelet-side log injection (test seam; not real k8s REST)
-                self.cluster.append_pod_log(route.namespace, route.name,
+                # kubelet-side log injection (divergence table: test seam)
+                self.cluster.append_pod_log(route.store_namespace, route.name,
                                             body.get("line", ""))
                 self._send_json(200, {"status": "ok"})
                 return
             obj = decode_obj(route.rt, body)
-            obj.metadata.namespace = route.namespace or obj.metadata.namespace
+            if route.rt.namespaced:
+                obj.metadata.namespace = (route.namespace
+                                          or obj.metadata.namespace)
+            else:
+                obj.metadata.namespace = ""
             created = self.cluster.create(obj)
             self._send_json(201, encode_obj(created))
         except Exception as exc:  # noqa: BLE001
@@ -252,11 +309,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_PUT(self) -> None:
         route, _ = self._parse()
-        if route is None or route.rt is None or route.name is None:
+        if route is None or route.name is None:
             self._send_json(404, _status_body(404, "NotFound", self.path))
             return
         try:
             obj = decode_obj(route.rt, self._read_body())
+            if not route.rt.namespaced:
+                obj.metadata.namespace = ""
             sub = "status" if route.subresource == "status" else ""
             updated = self.cluster.update(obj, subresource=sub)
             self._send_json(200, encode_obj(updated))
@@ -265,29 +324,33 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_PATCH(self) -> None:
         route, _ = self._parse()
-        if route is None or route.rt is None or route.name is None:
+        if route is None or route.name is None:
             self._send_json(404, _status_body(404, "NotFound", self.path))
             return
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype not in ("application/merge-patch+json",
+                        "application/json", ""):
+            self._send_json(415, _status_body(
+                415, "UnsupportedMediaType",
+                f"patch content type {ctype!r} not supported "
+                f"(use application/merge-patch+json)"))
+            return
         try:
-            body = self._read_body()
-            meta = body.get("metadata", {})
-            patched = self.cluster.patch_meta(
-                route.rt.cls, route.namespace, route.name,
-                labels=meta.get("labels"),
-                annotations=meta.get("annotations"),
-                add_finalizers=meta.get("$addFinalizers", ()),
-                remove_finalizers=meta.get("$removeFinalizers", ()))
+            patched = self.cluster.merge_patch(
+                route.rt.cls, route.store_namespace, route.name,
+                self._read_body())
             self._send_json(200, encode_obj(patched))
         except Exception as exc:  # noqa: BLE001
             self._send_error_status(exc)
 
     def do_DELETE(self) -> None:
         route, _ = self._parse()
-        if route is None or route.rt is None or route.name is None:
+        if route is None or route.name is None:
             self._send_json(404, _status_body(404, "NotFound", self.path))
             return
         try:
-            self.cluster.delete(route.rt.cls, route.namespace, route.name)
+            self.cluster.delete(route.rt.cls, route.store_namespace,
+                                route.name)
             self._send_json(200, {"kind": "Status", "status": "Success"})
         except Exception as exc:  # noqa: BLE001
             self._send_error_status(exc)
@@ -297,34 +360,79 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
         self.wfile.flush()
 
-    def _stream_watch(self, route: _Route) -> None:
-        q = self.hub.subscribe(route.rt.kind)
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Transfer-Encoding", "chunked")
-        self.end_headers()
+    def _watch_frame(self, etype: str, payload: Dict[str, Any]) -> bytes:
+        return json.dumps({"type": etype, "object": payload}).encode() + b"\n"
+
+    def _bookmark(self, route: _Route, rv: int) -> bytes:
+        api_version = (f"{route.rt.group}/{route.rt.version}"
+                       if route.rt.group else route.rt.version)
+        return self._watch_frame("BOOKMARK", {
+            "kind": route.rt.kind, "apiVersion": api_version,
+            "metadata": {"resourceVersion": str(rv)}})
+
+    def _stream_watch(self, route: _Route, qs: Dict[str, List[str]]) -> None:
+        since: Optional[int] = None
+        raw_rv = qs.get("resourceVersion", [""])[0]
+        if raw_rv:
+            since = int(raw_rv)
+        bookmarks = qs.get("allowWatchBookmarks", ["false"])[0] == "true"
+
+        sub = self.hub.subscribe(route.rt.kind)
         try:
-            # Initial bookmark: the client blocks on this to guarantee the
-            # subscription is live before it returns from watch() — no gap
-            # between "watch registered" and "events delivered".
-            self._write_chunk(json.dumps({"type": "BOOKMARK"}).encode() + b"\n")
-            while not self.stopping.is_set():
+            replay: List[WatchEvent] = []
+            if since is not None:
                 try:
-                    event = q.get(timeout=0.5)
+                    replay = [e for e in self.cluster.events_since(since)
+                              if e.kind == route.rt.kind]
+                except ExpiredError as exc:
+                    self._send_json(410, _status_body(410, "Expired", str(exc)))
+                    return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            last_rv = since if since is not None else self.cluster.current_rv
+            if bookmarks:
+                self._write_chunk(self._bookmark(route, last_rv))
+
+            def deliver(event: WatchEvent) -> None:
+                nonlocal last_rv
+                rv = event.obj.metadata.resource_version
+                if rv <= last_rv:
+                    return  # replay/live overlap — already sent
+                if (route.namespace is not None
+                        and event.obj.metadata.namespace != route.namespace):
+                    last_rv = rv
+                    return
+                self._write_chunk(self._watch_frame(event.type,
+                                                    encode_obj(event.obj)))
+                last_rv = rv
+
+            for event in replay:
+                deliver(event)
+            idle = 0
+            while not self.stopping.is_set():
+                if sub.overflowed.is_set():
+                    break  # close: client re-lists (bounded-queue semantics)
+                try:
+                    event = sub.q.get(timeout=0.5)
+                    idle = 0
                 except queue.Empty:
+                    idle += 1
+                    if bookmarks and idle % 10 == 0:
+                        # Bookmark the last revision actually DELIVERED on
+                        # this stream — advertising cluster.current_rv could
+                        # skip events still queued here if the client resumes
+                        # from the bookmark after a drop.
+                        self._write_chunk(self._bookmark(route, last_rv))
                     continue
                 if event is _WatchHub._CLOSE:
                     break
-                if (route.namespace is not None
-                        and event.obj.metadata.namespace != route.namespace):
-                    continue
-                line = json.dumps({"type": event.type,
-                                   "object": encode_obj(event.obj)}).encode()
-                self._write_chunk(line + b"\n")
+                deliver(event)
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away
         finally:
-            self.hub.unsubscribe(q)
+            self.hub.unsubscribe(sub)
             try:
                 self._write_chunk(b"")  # terminating chunk
             except OSError:

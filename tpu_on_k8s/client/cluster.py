@@ -20,10 +20,11 @@ from __future__ import annotations
 
 import itertools
 import threading
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, Iterable, List, Optional, Tuple
 
-from tpu_on_k8s.api.core import ObjectMeta, utcnow
+from tpu_on_k8s.api.core import Event, ObjectMeta, ObjectReference, utcnow
 from tpu_on_k8s.utils import serde
 
 
@@ -41,6 +42,11 @@ class AlreadyExistsError(ApiError):
 
 class ConflictError(ApiError):
     """resourceVersion mismatch — caller must re-read and retry."""
+
+
+class ExpiredError(ApiError):
+    """Requested watch resourceVersion fell off the history window (the
+    apiserver's 410 Gone) — the client must re-list and re-watch."""
 
 
 @dataclass
@@ -64,32 +70,111 @@ class InMemoryCluster:
     store — exactly the informer-cache discipline the reference's controllers
     must respect)."""
 
+    #: how many trailing watch events stay replayable for ?resourceVersion=N
+    #: reconnects before the server answers 410 Gone.
+    WATCH_HISTORY = 4096
+
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._store: Dict[Key, Any] = {}
-        self._rv = itertools.count(1)
+        self._rv_counter = 0
         self._uid = itertools.count(1)
         self._watchers: List[Callable[[WatchEvent], None]] = []
-        self.events: List[Tuple[str, str, str, str]] = []  # (obj name, type, reason, msg)
+        self._ordered_watchers: List[Callable[[WatchEvent], None]] = []
+        self._history: Deque[Tuple[int, WatchEvent]] = deque(
+            maxlen=self.WATCH_HISTORY)
         self._pod_logs: Dict[Tuple[str, str], List[str]] = {}
 
     # ---- watch ----------------------------------------------------------------
     def watch(self, callback: Callable[[WatchEvent], None]) -> None:
         self._watchers.append(callback)
 
+    def subscribe_ordered(self, callback: Callable[[WatchEvent], None]) -> None:
+        """Register a callback invoked INSIDE the mutation lock, in strict
+        resourceVersion order (the apiserver's watch hub needs this: rv
+        assignment and publication must be atomic or concurrent writers can
+        publish out of order and a monotonic stream filter drops events).
+        Callbacks must be fast and must not call back into the cluster."""
+        self._ordered_watchers.append(callback)
+
+    def _record(self, event: WatchEvent) -> None:
+        """Publish under the mutation lock (caller holds ``self._lock``):
+        history append + ordered fanout happen atomically with the rv
+        assignment, so history and hub queues are rv-sorted."""
+        self._history.append((event.obj.metadata.resource_version, event))
+        for cb in list(self._ordered_watchers):
+            cb(event)
+
     def _emit(self, event: WatchEvent) -> None:
+        """Plain-callback fanout (outside the lock where possible, may
+        re-enter the API — the in-process controller wiring)."""
         for cb in list(self._watchers):
             cb(event)
 
+    @property
+    def current_rv(self) -> int:
+        """The cluster-wide revision (what a conformant list's
+        ``metadata.resourceVersion`` reports — etcd-revision semantics)."""
+        with self._lock:
+            return self._rv_counter
+
+    def events_since(self, rv: int) -> List[WatchEvent]:
+        """Replay buffered watch events with revision > rv, for
+        ``?watch=true&resourceVersion=N``. Raises ExpiredError (→ 410 Gone)
+        when rv is older than the history window."""
+        with self._lock:
+            if rv > self._rv_counter:
+                # A future revision is unservable (etcd semantics) — happens
+                # when the server restarted with fresh storage; the client
+                # must re-list rather than wait for revisions that will
+                # arrive with unrelated numbering.
+                raise ExpiredError(
+                    f"resourceVersion {rv} is ahead of the server "
+                    f"({self._rv_counter})")
+            if rv == self._rv_counter:
+                return []
+            if not self._history or self._history[0][0] > rv + 1:
+                raise ExpiredError(
+                    f"resourceVersion {rv} is too old "
+                    f"(history starts at "
+                    f"{self._history[0][0] if self._history else 'empty'})")
+            return [e for r, e in self._history if r > rv]
+
     # ---- helpers --------------------------------------------------------------
+    def _next_rv(self) -> int:
+        with self._lock:
+            self._rv_counter += 1
+            return self._rv_counter
+
     @staticmethod
     def _key_of(obj: Any) -> Key:
         return (obj.kind, obj.metadata.namespace, obj.metadata.name)
 
     def record_event(self, obj: Any, etype: str, reason: str, message: str) -> None:
-        """k8s Event analog (reference record.EventRecorder)."""
+        """k8s Event recorder (reference record.EventRecorder): stores a real
+        core/v1 Event object, named `{involved}.{seq}` like kubelet/clients do."""
+        now = utcnow()
+        ev = Event(
+            metadata=ObjectMeta(
+                name=f"{obj.metadata.name}.{next(self._uid):x}",
+                namespace=obj.metadata.namespace or "default"),
+            involved_object=ObjectReference(
+                api_version=getattr(obj, "api_version", ""), kind=obj.kind,
+                namespace=obj.metadata.namespace, name=obj.metadata.name,
+                uid=obj.metadata.uid),
+            type=etype, reason=reason, message=message,
+            first_timestamp=now, last_timestamp=now)
+        self.create(ev)
+
+    @property
+    def events(self) -> List[Tuple[str, str, str, str]]:
+        """Stored Events as (namespace/name, type, reason, message) tuples in
+        arrival order — the assertion surface tests use."""
         with self._lock:
-            self.events.append((f"{obj.metadata.namespace}/{obj.metadata.name}", etype, reason, message))
+            evs = [o for (k, _, _), o in self._store.items() if k == "Event"]
+        evs.sort(key=lambda e: e.metadata.resource_version)
+        return [(f"{e.involved_object.namespace}/{e.involved_object.name}",
+                 e.type, e.reason, e.message) for e in evs]
 
     # ---- pod logs -------------------------------------------------------------
     def append_pod_log(self, namespace: str, name: str, line: str) -> None:
@@ -114,11 +199,13 @@ class InMemoryCluster:
             meta = stored.metadata
             meta.uid = meta.uid or f"uid-{next(self._uid)}"
             meta.creation_timestamp = meta.creation_timestamp or utcnow()
-            meta.resource_version = next(self._rv)
+            meta.resource_version = self._next_rv()
             meta.generation = max(meta.generation, 1)
             self._store[key] = stored
             out = serde.deep_copy(stored)
-        self._emit(WatchEvent("ADDED", obj.kind, out))
+            event = WatchEvent("ADDED", obj.kind, out)
+            self._record(event)
+        self._emit(event)
         return out
 
     def get(self, cls: type, namespace: str, name: str) -> Any:
@@ -186,10 +273,12 @@ class InMemoryCluster:
             stored.metadata.uid = current.metadata.uid
             stored.metadata.creation_timestamp = current.metadata.creation_timestamp
             stored.metadata.deletion_timestamp = current.metadata.deletion_timestamp
-            stored.metadata.resource_version = next(self._rv)
+            stored.metadata.resource_version = self._next_rv()
             self._store[key] = stored
             out = serde.deep_copy(stored)
-        self._emit(WatchEvent("MODIFIED", obj.kind, out, old))
+            event = WatchEvent("MODIFIED", obj.kind, out, old)
+            self._record(event)
+        self._emit(event)
         # A finalizer drain on a deleting object may complete the delete.
         if out.metadata.deletion_timestamp is not None and not out.metadata.finalizers:
             self._finalize_delete(self._key_of(out))
@@ -229,9 +318,69 @@ class InMemoryCluster:
             for f in remove_finalizers:
                 if f in current.metadata.finalizers:
                     current.metadata.finalizers.remove(f)
-            current.metadata.resource_version = next(self._rv)
+            current.metadata.resource_version = self._next_rv()
             out = serde.deep_copy(current)
-        self._emit(WatchEvent("MODIFIED", kind, out, old))
+            event = WatchEvent("MODIFIED", kind, out, old)
+            self._record(event)
+        self._emit(event)
+        if out.metadata.deletion_timestamp is not None and not out.metadata.finalizers:
+            self._finalize_delete((kind, namespace, name))
+        return out
+
+    def merge_patch(self, cls: type, namespace: str, name: str,
+                    patch: Dict[str, Any]) -> Any:
+        """RFC 7386 JSON merge-patch — what a conformant apiserver executes
+        for ``Content-Type: application/merge-patch+json`` (the verb
+        RestCluster emits; reference builds the analogous merge payloads in
+        pkg/utils/patch/patch.go:66-96). ``metadata.resourceVersion`` in the
+        patch is an optimistic-concurrency precondition (409 on mismatch);
+        null values delete keys; lists are replaced wholesale."""
+        kind = cls.__dataclass_fields__["kind"].default  # type: ignore[attr-defined]
+
+        def merge(target: Any, delta: Any) -> Any:
+            if not isinstance(delta, dict) or not isinstance(target, dict):
+                return delta
+            out = dict(target)
+            for k, v in delta.items():
+                if v is None:
+                    out.pop(k, None)
+                elif isinstance(v, dict) and isinstance(out.get(k), dict):
+                    out[k] = merge(out[k], v)
+                else:
+                    out[k] = v
+            return out
+
+        with self._lock:
+            current = self._store.get((kind, namespace, name))
+            if current is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            pre_rv = (patch.get("metadata") or {}).get("resourceVersion")
+            if pre_rv is not None and int(pre_rv) != current.metadata.resource_version:
+                raise ConflictError(
+                    f"{kind} {namespace}/{name}: patch precondition "
+                    f"resourceVersion {pre_rv} != "
+                    f"{current.metadata.resource_version}")
+            old = serde.deep_copy(current)
+            merged = merge(serde.to_dict(current, drop_none=False, wire=True),
+                           patch)
+            stored = serde.from_dict(cls, merged)
+            # Server-side immutable fields win over whatever the patch said.
+            stored.metadata.uid = current.metadata.uid
+            stored.metadata.creation_timestamp = current.metadata.creation_timestamp
+            stored.metadata.deletion_timestamp = current.metadata.deletion_timestamp
+            stored.metadata.namespace = current.metadata.namespace
+            stored.metadata.name = current.metadata.name
+            if hasattr(current, "spec"):
+                old_spec = serde.to_dict(current.spec, drop_none=False)
+                new_spec = serde.to_dict(stored.spec, drop_none=False)
+                stored.metadata.generation = (
+                    current.metadata.generation + (old_spec != new_spec))
+            stored.metadata.resource_version = self._next_rv()
+            self._store[(kind, namespace, name)] = stored
+            out = serde.deep_copy(stored)
+            event = WatchEvent("MODIFIED", kind, out, old)
+            self._record(event)
+        self._emit(event)
         if out.metadata.deletion_timestamp is not None and not out.metadata.finalizers:
             self._finalize_delete((kind, namespace, name))
         return out
@@ -249,14 +398,16 @@ class InMemoryCluster:
             if current.metadata.finalizers:
                 if current.metadata.deletion_timestamp is None:
                     current.metadata.deletion_timestamp = utcnow()
-                    current.metadata.resource_version = next(self._rv)
+                    current.metadata.resource_version = self._next_rv()
                     out = serde.deep_copy(current)
+                    event = WatchEvent("MODIFIED", kind, out)
+                    self._record(event)
                 else:
                     return  # already deleting
             else:
                 out = None
         if out is not None:
-            self._emit(WatchEvent("MODIFIED", kind, out))
+            self._emit(event)
             return
         self._finalize_delete(key)
 
@@ -269,12 +420,17 @@ class InMemoryCluster:
                 # A recreated pod must NOT inherit its dead predecessor's log
                 # stream (real pods/log is per-container-instance).
                 self._pod_logs.pop((key[1], key[2]), None)
+            # The deletion itself is a revision (etcd semantics): the DELETED
+            # event carries a fresh rv so watch replay stays dense/ordered.
+            obj.metadata.resource_version = self._next_rv()
             uid = obj.metadata.uid
             dependents = [
                 (k, o) for k, o in self._store.items()
                 if any(ref.uid == uid for ref in o.metadata.owner_references)
             ]
-        self._emit(WatchEvent("DELETED", key[0], serde.deep_copy(obj)))
+            event = WatchEvent("DELETED", key[0], serde.deep_copy(obj))
+            self._record(event)
+        self._emit(event)
         for (dkind, dns, dname), dobj in dependents:
             # Cascade GC (background propagation): finalizers still honored.
             try:

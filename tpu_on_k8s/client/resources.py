@@ -6,10 +6,9 @@ per-resource REST paths (client/clientset/versioned/typed/train/v1alpha1/
 torchjob.go). Here one explicit table serves both the API server's router
 and the typed REST client.
 
-Divergence note: PriorityClass and PersistentVolume are cluster-scoped in
-real Kubernetes; this API surface keeps every resource namespaced (the
-object model carries a namespace on all kinds) — an envtest-analog
-simplification, not a semantic the controllers depend on.
+Scoping matches real Kubernetes: PersistentVolume and PriorityClass are
+cluster-scoped (no ``namespaces/{ns}`` path segment); everything else is
+namespaced.
 """
 from __future__ import annotations
 
@@ -19,6 +18,7 @@ from typing import Dict, Optional, Tuple
 from tpu_on_k8s.api import constants
 from tpu_on_k8s.api.core import (
     ConfigMap,
+    Event,
     Pod,
     PriorityClass,
     ResourceQuota,
@@ -35,6 +35,7 @@ class ResourceType:
     group: str          # "" = core ("/api/v1")
     version: str
     plural: str
+    namespaced: bool = True
 
     @property
     def prefix(self) -> str:
@@ -43,6 +44,8 @@ class ResourceType:
         return f"/apis/{self.group}/{self.version}"
 
     def collection_path(self, namespace: str) -> str:
+        if not self.namespaced:
+            return f"{self.prefix}/{self.plural}"
         return f"{self.prefix}/namespaces/{namespace}/{self.plural}"
 
     def item_path(self, namespace: str, name: str) -> str:
@@ -69,12 +72,13 @@ def _build() -> Tuple[Dict[str, ResourceType], Dict[Tuple[str, str], ResourceTyp
         ResourceType("Service", Service, "", "v1", "services"),
         ResourceType("ConfigMap", ConfigMap, "", "v1", "configmaps"),
         ResourceType("ResourceQuota", ResourceQuota, "", "v1", "resourcequotas"),
+        ResourceType("Event", Event, "", "v1", "events"),
         ResourceType("PersistentVolume", PersistentVolume, "", "v1",
-                     "persistentvolumes"),
+                     "persistentvolumes", namespaced=False),
         ResourceType("PersistentVolumeClaim", PersistentVolumeClaim, "", "v1",
                      "persistentvolumeclaims"),
         ResourceType("PriorityClass", PriorityClass, "scheduling.k8s.io", "v1",
-                     "priorityclasses"),
+                     "priorityclasses", namespaced=False),
         ResourceType("Lease", Lease, "coordination.k8s.io", "v1", "leases"),
         ResourceType("PodGroup", PodGroup, "scheduling.distributed.tpu.io",
                      "v1beta1", "podgroups"),

@@ -4,15 +4,25 @@ The analog of the reference's generated typed clientset
 (/root/reference/client/clientset/versioned/clientset.go) plus the informer
 layer (client/informers/externalversions/factory.go): every InMemoryCluster
 method (create/get/list/update/patch_meta/delete/watch/status-subresource/
-pod-log/events) is implemented by speaking the k8s-style REST protocol of
-`client/apiserver.py` over plain HTTP. Controllers are backend-agnostic —
-`main.py --cluster-backend rest --api-server URL` swaps this in with no
-controller changes (VERDICT round 1, missing #1).
+pod-log/events) is implemented by speaking conformant Kubernetes REST —
+camelCase JSON, real resource scoping, RFC 7386 merge-patch with
+resourceVersion preconditions for metadata/finalizer changes (the patch
+dialect a real apiserver accepts for CRDs; the reference builds the same
+payloads via pkg/utils/patch/patch.go:66-96), and core/v1 Event objects.
+Controllers are backend-agnostic — `main.py --cluster-backend rest
+--api-server URL` swaps this in with no controller changes.
 
-Watch design: one streaming GET per registered kind (the informer-per-type
-model, not a fictional all-resource watch). `watch(callback)` blocks until
-every stream has delivered its initial BOOKMARK, so events emitted after it
-returns are guaranteed to be observed. Errors map from typed Status bodies:
+Watch design (the real informer contract, reference main.go:77-83):
+one list-then-watch loop per registered kind. Each loop LISTs the collection
+(capturing the list's ``metadata.resourceVersion``), delivers every item as a
+synthetic ADDED event (initial sync / re-list replay — level-triggered
+consumers treat duplicates as no-ops), then opens
+``?watch=true&resourceVersion=N&allowWatchBookmarks=true`` and follows the
+stream. A dropped stream reconnects from the last observed revision with
+backoff; ``410 Gone``/``Expired`` ERROR frames trigger a full re-list.
+BOOKMARK frames are consumed when present but never required.
+`watch(callback)` blocks until every kind's initial list has been delivered,
+so no pre-existing object is missed. Errors map from typed Status bodies:
 404→NotFoundError, 409 AlreadyExists/Conflict→the matching exception — the
 same failure modes the controllers face in-memory.
 """
@@ -21,15 +31,18 @@ from __future__ import annotations
 import json
 import ssl
 import threading
+import time
 from http.client import HTTPConnection, HTTPSConnection
-from typing import Any, Callable, Dict, Iterable, List, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 from urllib.parse import quote, urlparse
 
+from tpu_on_k8s.api.core import Event, ObjectReference, utcnow
 from tpu_on_k8s.client import resources
 from tpu_on_k8s.client.cluster import (
     AlreadyExistsError,
     ApiError,
     ConflictError,
+    ExpiredError,
     NotFoundError,
     WatchEvent,
 )
@@ -52,11 +65,21 @@ def _raise_for_status(code: int, body: bytes) -> None:
         raise AlreadyExistsError(message)
     if code == 409 or reason == "Conflict":
         raise ConflictError(message)
+    if code == 410 or reason == "Expired":
+        raise ExpiredError(message)
     raise ApiError(f"HTTP {code}: {message}")
+
+
+def _wire(obj: Any) -> Dict[str, Any]:
+    return serde.to_dict(obj, drop_none=False, wire=True)
 
 
 class RestCluster:
     """k8s REST client with the InMemoryCluster surface (duck-typed)."""
+
+    #: reconnect backoff bounds for dropped watch streams
+    WATCH_BACKOFF_INITIAL = 0.2
+    WATCH_BACKOFF_MAX = 5.0
 
     def __init__(self, base_url: str, timeout: float = 10.0,
                  token_path: Optional[str] = None,
@@ -78,6 +101,10 @@ class RestCluster:
         self._watch_callbacks: List[Callable[[WatchEvent], None]] = []
         self._watch_threads: List[threading.Thread] = []
         self._watch_stop = threading.Event()
+        # informer cache: kind → {(ns, name): obj}. Source of truth for
+        # synthetic DELETED on re-list and for initial-sync replay to
+        # callbacks registered after the loops started.
+        self._known: Dict[str, Dict[Tuple[str, str], Any]] = {}
 
     # ------------------------------------------------------------------ plumbing
     def _new_conn(self, timeout: Optional[float]) -> HTTPConnection:
@@ -93,8 +120,8 @@ class RestCluster:
             self._local.conn = conn
         return conn
 
-    def _headers(self, has_payload: bool) -> Dict[str, str]:
-        headers = {"Content-Type": "application/json"} if has_payload else {}
+    def _headers(self, content_type: Optional[str]) -> Dict[str, str]:
+        headers = {"Content-Type": content_type} if content_type else {}
         if self._token_path:
             try:
                 with open(self._token_path) as f:
@@ -103,10 +130,10 @@ class RestCluster:
                 pass
         return headers
 
-    def _request(self, method: str, path: str,
-                 body: Optional[dict] = None) -> Any:
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 content_type: str = "application/json") -> Any:
         payload = json.dumps(body).encode() if body is not None else None
-        headers = self._headers(payload is not None)
+        headers = self._headers(content_type if payload is not None else None)
         for attempt in (0, 1):  # one retry on a stale keep-alive connection
             conn = self._conn()
             try:
@@ -129,8 +156,7 @@ class RestCluster:
     def create(self, obj: Any) -> Any:
         rt = resources.by_class(type(obj))
         ns = obj.metadata.namespace or "default"
-        data = self._request("POST", rt.collection_path(ns),
-                             serde.to_dict(obj, drop_none=False))
+        data = self._request("POST", rt.collection_path(ns), _wire(obj))
         return serde.from_dict(rt.cls, data)
 
     def get(self, cls: type, namespace: str, name: str) -> Any:
@@ -146,21 +172,33 @@ class RestCluster:
 
     def list(self, cls: type, namespace: Optional[str] = None,
              label_selector: Optional[Dict[str, str]] = None) -> List[Any]:
-        rt = resources.by_class(cls)
-        path = (rt.collection_path(namespace) if namespace is not None
+        items, _ = self._list_with_rv(resources.by_class(cls), namespace,
+                                      label_selector)
+        return items
+
+    def _list_with_rv(self, rt: resources.ResourceType,
+                      namespace: Optional[str] = None,
+                      label_selector: Optional[Dict[str, str]] = None,
+                      ) -> Tuple[List[Any], int]:
+        """List + the collection's ``metadata.resourceVersion`` — the revision
+        a subsequent watch resumes from (list-then-watch, no event gap)."""
+        path = (rt.collection_path(namespace)
+                if namespace is not None and rt.namespaced
                 else rt.all_namespaces_path())
         if label_selector:
             sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
             path += f"?labelSelector={quote(sel)}"
         data = self._request("GET", path)
-        return [serde.from_dict(rt.cls, item) for item in data.get("items", [])]
+        rv = int(data.get("metadata", {}).get("resourceVersion", 0) or 0)
+        return ([serde.from_dict(rt.cls, item)
+                 for item in data.get("items", [])], rv)
 
     def update(self, obj: Any, *, subresource: str = "") -> Any:
         rt = resources.by_class(type(obj))
         path = rt.item_path(obj.metadata.namespace, quote(obj.metadata.name))
         if subresource:
             path += f"/{subresource}"
-        data = self._request("PUT", path, serde.to_dict(obj, drop_none=False))
+        data = self._request("PUT", path, _wire(obj))
         return serde.from_dict(rt.cls, data)
 
     def patch_meta(self, cls: type, namespace: str, name: str, *,
@@ -168,19 +206,44 @@ class RestCluster:
                    annotations: Optional[Dict[str, Optional[str]]] = None,
                    add_finalizers: Iterable[str] = (),
                    remove_finalizers: Iterable[str] = ()) -> Any:
+        """Metadata patch via standard JSON merge-patch (RFC 7386).
+
+        Labels/annotations merge directly (null deletes a key). Finalizers
+        are a list — merge-patch replaces lists wholesale — so finalizer
+        edits do read-modify-write with a ``metadata.resourceVersion``
+        precondition and retry on conflict, exactly how conformant
+        controllers edit finalizers on CRDs.
+        """
         rt = resources.by_class(cls)
+        add_f, remove_f = list(add_finalizers), list(remove_finalizers)
         meta: Dict[str, Any] = {}
         if labels:
             meta["labels"] = labels
         if annotations:
             meta["annotations"] = annotations
-        if add_finalizers:
-            meta["$addFinalizers"] = list(add_finalizers)
-        if remove_finalizers:
-            meta["$removeFinalizers"] = list(remove_finalizers)
-        data = self._request("PATCH", rt.item_path(namespace, quote(name)),
-                             {"metadata": meta})
-        return serde.from_dict(rt.cls, data)
+        if not add_f and not remove_f:
+            data = self._request(
+                "PATCH", rt.item_path(namespace, quote(name)),
+                {"metadata": meta},
+                content_type="application/merge-patch+json")
+            return serde.from_dict(rt.cls, data)
+        last: Optional[Exception] = None
+        for _ in range(5):
+            cur = self.get(cls, namespace, name)
+            fins = [f for f in cur.metadata.finalizers if f not in remove_f]
+            fins += [f for f in add_f if f not in fins]
+            patch_meta = dict(meta)
+            patch_meta["finalizers"] = fins
+            patch_meta["resourceVersion"] = cur.metadata.resource_version
+            try:
+                data = self._request(
+                    "PATCH", rt.item_path(namespace, quote(name)),
+                    {"metadata": patch_meta},
+                    content_type="application/merge-patch+json")
+                return serde.from_dict(rt.cls, data)
+            except ConflictError as e:
+                last = e
+        raise last  # type: ignore[misc]
 
     def delete(self, cls: type, namespace: str, name: str) -> None:
         rt = resources.by_class(cls)
@@ -202,14 +265,26 @@ class RestCluster:
     # ----------------------------------------------------------- events & logs
     def record_event(self, obj: Any, etype: str, reason: str,
                      message: str) -> None:
+        """POST a real core/v1 Event (what record.EventRecorder emits)."""
         ns = obj.metadata.namespace or "default"
-        self._request("POST", f"/api/v1/namespaces/{ns}/events", {
-            "involvedObject": {"namespace": ns, "name": obj.metadata.name},
-            "type": etype, "reason": reason, "message": message})
+        now = utcnow()
+        ev = Event(
+            involved_object=ObjectReference(
+                api_version=getattr(obj, "api_version", ""), kind=obj.kind,
+                namespace=ns, name=obj.metadata.name, uid=obj.metadata.uid),
+            type=etype, reason=reason, message=message,
+            first_timestamp=now, last_timestamp=now)
+        ev.metadata.namespace = ns
+        ev.metadata.name = f"{obj.metadata.name}.{time.monotonic_ns():x}"
+        self.create(ev)
 
-    def list_events(self, namespace: str = "default") -> List[tuple]:
-        data = self._request("GET", f"/api/v1/namespaces/{namespace}/events")
-        return [tuple(e) for e in data.get("items", [])]
+    def list_events(self, namespace: Optional[str] = None) -> List[tuple]:
+        """Events as tuples; ``namespace=None`` spans all namespaces (the
+        InMemoryCluster.events parity surface is cluster-wide)."""
+        evs = self.list(Event, namespace)
+        evs.sort(key=lambda e: e.metadata.resource_version)
+        return [(f"{e.involved_object.namespace}/{e.involved_object.name}",
+                 e.type, e.reason, e.message) for e in evs]
 
     @property
     def events(self) -> List[tuple]:
@@ -231,58 +306,145 @@ class RestCluster:
 
     # -------------------------------------------------------------------- watch
     def watch(self, callback: Callable[[WatchEvent], None]) -> None:
-        """Register a callback for all kinds. First registration opens one
-        streaming watch per registered resource type and BLOCKS until every
-        stream is live (initial BOOKMARK observed)."""
+        """Register a callback for all kinds. First registration starts one
+        list-then-watch informer loop per registered resource type and BLOCKS
+        until every loop has delivered its initial list. Later registrations
+        replay the informer cache to the new callback as synthetic ADDED
+        events (informer AddEventHandler semantics), so every controller —
+        not just the first — observes pre-existing objects."""
         with self._watch_lock:
+            first = not self._watch_threads
+            snapshot = [obj for cache in self._known.values()
+                        for obj in cache.values()]
             self._watch_callbacks.append(callback)
-            if self._watch_threads:
-                return
             ready: List[threading.Event] = []
-            for rt in resources.all_types():
-                ev = threading.Event()
-                ready.append(ev)
-                t = threading.Thread(target=self._watch_loop, args=(rt, ev),
-                                     daemon=True, name=f"watch-{rt.plural}")
-                t.start()
-                self._watch_threads.append(t)
+            if first:
+                for rt in resources.all_types():
+                    ev = threading.Event()
+                    ready.append(ev)
+                    t = threading.Thread(target=self._watch_loop,
+                                         args=(rt, ev), daemon=True,
+                                         name=f"watch-{rt.plural}")
+                    t.start()
+                    self._watch_threads.append(t)
+        if not first:
+            # Replay the informer cache to the newcomer, outside the lock
+            # (callbacks may re-enter the client). A concurrent live event
+            # may duplicate — level-triggered consumers treat duplicates as
+            # no-ops.
+            for obj in snapshot:
+                try:
+                    callback(WatchEvent("ADDED", obj.kind, obj))
+                except Exception:
+                    _log.exception("watch callback failed on sync replay")
+            return
         for ev in ready:
-            if not ev.wait(timeout=10):
+            if not ev.wait(timeout=30):
                 raise ApiError("watch stream failed to establish")
+
+    def _dispatch(self, event: WatchEvent) -> None:
+        key = (event.obj.metadata.namespace, event.obj.metadata.name)
+        with self._watch_lock:
+            cache = self._known.setdefault(event.kind, {})
+            if event.type == "DELETED":
+                cache.pop(key, None)
+            else:
+                cache[key] = event.obj
+            callbacks = list(self._watch_callbacks)
+        for cb in callbacks:
+            try:
+                cb(event)
+            except Exception:
+                _log.exception("watch callback failed",
+                               extra={"kv": {"kind": event.kind}})
+
+    def _sync(self, rt: resources.ResourceType) -> int:
+        """Initial list (or re-list): deliver every current object as ADDED,
+        synthesize DELETED for cached objects that vanished during the outage
+        (the informer's DeletedFinalStateUnknown replay — without it a job
+        deleted while the stream was down would leak controller bookkeeping
+        forever), and return the list revision."""
+        items, rv = self._list_with_rv(rt)
+        listed = {(o.metadata.namespace, o.metadata.name) for o in items}
+        with self._watch_lock:
+            gone = [obj for key, obj in self._known.get(rt.kind, {}).items()
+                    if key not in listed]
+        for obj in gone:
+            self._dispatch(WatchEvent("DELETED", rt.kind, obj))
+        for obj in items:
+            self._dispatch(WatchEvent("ADDED", rt.kind, obj))
+        return rv
 
     def _watch_loop(self, rt: resources.ResourceType,
                     ready: threading.Event) -> None:
-        conn = self._new_conn(None)  # no timeout: long-lived stream
-        try:
-            conn.request("GET", rt.all_namespaces_path() + "?watch=true",
-                         headers=self._headers(False))
-            resp = conn.getresponse()
-            while not self._watch_stop.is_set():
-                line = resp.readline()
-                if not line:
-                    break  # server closed the stream
-                line = line.strip()
-                if not line:
-                    continue
-                msg = json.loads(line)
-                if msg.get("type") == "BOOKMARK":
+        """List-then-watch with resume and recovery (informer semantics):
+        dropped stream → reconnect from the last seen revision with backoff;
+        410 Expired → full re-list. Never goes silently deaf."""
+        rv: Optional[int] = None
+        backoff = self.WATCH_BACKOFF_INITIAL
+        while not self._watch_stop.is_set():
+            conn = None
+            try:
+                if rv is None:
+                    rv = self._sync(rt)
                     ready.set()
+                conn = self._new_conn(None)  # no timeout: long-lived stream
+                path = (rt.all_namespaces_path()
+                        + f"?watch=true&resourceVersion={rv}"
+                        + "&allowWatchBookmarks=true")
+                conn.request("GET", path, headers=self._headers(None))
+                resp = conn.getresponse()
+                if resp.status == 410:
+                    _log.warning("watch expired; re-listing",
+                                 extra={"kv": {"kind": rt.kind, "rv": rv}})
+                    rv = None
                     continue
-                obj = serde.from_dict(rt.cls, msg["object"])
-                event = WatchEvent(msg["type"], rt.kind, obj)
-                with self._watch_lock:
-                    callbacks = list(self._watch_callbacks)
-                for cb in callbacks:
-                    try:
-                        cb(event)
-                    except Exception:
-                        _log.exception("watch callback failed",
-                                       extra={"kv": {"kind": rt.kind}})
-        except (ConnectionError, OSError):
-            pass
-        finally:
-            ready.set()  # never leave watch() blocked on a dead stream
-            conn.close()
+                if resp.status >= 400:
+                    _raise_for_status(resp.status, resp.read())
+                while not self._watch_stop.is_set():
+                    line = resp.readline()
+                    if not line:
+                        break  # server closed the stream → reconnect from rv
+                    line = line.strip()
+                    if not line:
+                        continue
+                    msg = json.loads(line)
+                    mtype = msg.get("type")
+                    if mtype == "BOOKMARK":
+                        # optional: only advances the resume revision
+                        raw = (msg.get("object", {}).get("metadata", {})
+                               .get("resourceVersion"))
+                        if raw is not None:
+                            rv = int(raw)
+                        continue
+                    if mtype == "ERROR":
+                        code = msg.get("object", {}).get("code")
+                        if code == 410:
+                            rv = None  # window lost → re-list
+                        break
+                    obj = serde.from_dict(rt.cls, msg["object"])
+                    rv = obj.metadata.resource_version
+                    self._dispatch(WatchEvent(mtype, rt.kind, obj))
+                    backoff = self.WATCH_BACKOFF_INITIAL
+                # Clean close: back off too — a server that closes streams on
+                # arrival (overflow, shutdown races) must not induce a hot
+                # list/watch spin; delivered events above reset the backoff.
+                self._watch_stop.wait(backoff)
+                backoff = min(backoff * 2, self.WATCH_BACKOFF_MAX)
+            except (ConnectionError, OSError, ApiError,
+                    json.JSONDecodeError) as exc:
+                if self._watch_stop.is_set():
+                    break
+                _log.warning(
+                    "watch stream died; reconnecting",
+                    extra={"kv": {"kind": rt.kind, "rv": rv,
+                                  "error": repr(exc),
+                                  "backoff_s": round(backoff, 2)}})
+                self._watch_stop.wait(backoff)
+                backoff = min(backoff * 2, self.WATCH_BACKOFF_MAX)
+            finally:
+                if conn is not None:
+                    conn.close()
 
     def close(self) -> None:
         self._watch_stop.set()

@@ -202,6 +202,11 @@ class Operator:
         if self.elector is not None:
             self.elector.stop()
         self._stop_workers()
+        # REST backends run informer threads; stop their reconnect loops so a
+        # stopped manager doesn't keep dialing the apiserver.
+        close = getattr(self.cluster, "close", None)
+        if callable(close):
+            close()
 
 
 def main(argv=None) -> int:

@@ -27,28 +27,43 @@ def _type_hints(cls: type) -> dict[str, Any]:
     return hints
 
 
-def to_dict(obj: Any, *, drop_none: bool = True) -> Any:
-    """Recursively convert dataclasses/enums/datetimes into plain JSON-able data."""
+def _camel(name: str) -> str:
+    """snake_case → camelCase for the k8s wire (api_version → apiVersion)."""
+    head, _, rest = name.partition("_")
+    if not rest:
+        return name
+    return head + "".join(p[:1].upper() + p[1:] for p in rest.split("_"))
+
+
+def to_dict(obj: Any, *, drop_none: bool = True, wire: bool = False) -> Any:
+    """Recursively convert dataclasses/enums/datetimes into plain JSON-able data.
+
+    ``wire=True`` emits camelCase keys for dataclass *fields* (the real
+    Kubernetes JSON convention) while leaving plain-dict keys (labels,
+    annotations, nodeSelector, resource names) untouched.
+    """
     if obj is None:
         return None
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         out = {}
         for f in dataclasses.fields(obj):
-            v = to_dict(getattr(obj, f.name), drop_none=drop_none)
+            v = to_dict(getattr(obj, f.name), drop_none=drop_none, wire=wire)
             if drop_none and (v is None or v == {} or v == []):
                 continue
-            out[f.name] = v
+            out[_camel(f.name) if wire else f.name] = v
         return out
     if isinstance(obj, enum.Enum):
         return obj.value
     if isinstance(obj, _dt.datetime):
         return obj.isoformat()
     if isinstance(obj, dict):
-        # Keys go through conversion too: task maps are keyed by TaskType enums.
-        return {to_dict(k, drop_none=drop_none): to_dict(v, drop_none=drop_none)
+        # Keys go through conversion too: task maps are keyed by TaskType
+        # enums. Plain string keys are data, never renamed.
+        return {to_dict(k, drop_none=drop_none, wire=wire):
+                to_dict(v, drop_none=drop_none, wire=wire)
                 for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
-        return [to_dict(v, drop_none=drop_none) for v in obj]
+        return [to_dict(v, drop_none=drop_none, wire=wire) for v in obj]
     return obj
 
 
@@ -109,8 +124,10 @@ def from_dict(cls: Type[T], data: Optional[dict]) -> T:
     hints = _type_hints(cls)
     kwargs = {}
     for f in dataclasses.fields(cls):
-        if f.name in data:
-            kwargs[f.name] = _construct(hints[f.name], data[f.name])
+        # Accept both snake_case (internal) and camelCase (k8s wire) keys.
+        key = f.name if f.name in data else _camel(f.name)
+        if key in data:
+            kwargs[f.name] = _construct(hints[f.name], data[key])
     return cls(**kwargs)
 
 
