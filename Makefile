@@ -20,8 +20,9 @@ native:  ## build the C++ data pipeline explicitly (also built lazily on import)
 	    -o tpu_on_k8s/data/native/build/libtkdata.so \
 	    tpu_on_k8s/data/native/dataloader.cpp -lpthread
 
-bench:
+bench:  ## headline line + the two BASELINE.json driver metrics
 	python bench.py
+	python tools/driver_bench.py --write
 
 dryrun:  ## the driver's multi-chip compile check on a virtual 8-device mesh
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1,0 +1,180 @@
+"""Driver-target benchmarks: the two metrics BASELINE.json names.
+
+1. **ResNet-50 images/sec/chip** — the compute-plane number (models/vision.py
+   ResNet-50, bf16 inputs, 224x224x3, real train steps on the local chip).
+2. **job-submit→first-step p50** — the orchestration-plane number: N sample
+   jobs submitted through the full manager running over the REST backend
+   (apiserver + informers + reconcilers + kubelet sim on separate
+   connections), p50 of the `first_pod_launch_delay_seconds` histogram
+   (the analog of reference pkg/metrics/metrics.go:58-61).
+
+`python tools/driver_bench.py --write` updates BASELINE.json's "published"
+section in place; without --write it just prints. Run via `make bench`.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bench_resnet50(batch: int = 256, steps: int = 20) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_on_k8s.models.vision import ResNet, ResNetConfig, vision_partition_rules
+    from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+    from tpu_on_k8s.train.vision import ClassifierTrainer
+
+    devices = jax.devices()
+    mesh = create_mesh(MeshConfig(data=len(devices), fsdp=1, model=1, seq=1))
+    model = ResNet(ResNetConfig.resnet50())
+    trainer = ClassifierTrainer(model, vision_partition_rules(), mesh,
+                                optax.sgd(0.1, momentum=0.9))
+    images = jax.random.normal(jax.random.key(0), (batch, 224, 224, 3),
+                               jnp.bfloat16)
+    labels = jax.random.randint(jax.random.key(1), (batch,), 0, 1000,
+                                jnp.int32)
+    state = trainer.init_state(jax.random.key(2), images)
+    images, labels = trainer.shard_batch(images, labels)
+    for _ in range(3):
+        state, metrics = trainer.train_step(state, images, labels)
+    float(metrics["loss"])  # host sync (block_until_ready lies on this relay)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.train_step(state, images, labels)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    img_s = steps * batch / dt
+    return {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_s / len(devices), 1),
+        "unit": "images/s/chip",
+        "batch": batch,
+        "device_kind": getattr(devices[0], "device_kind", "unknown"),
+    }
+
+
+def bench_submit_to_first_step(n_jobs: int = 20) -> dict:
+    import threading
+
+    from tpu_on_k8s.api.core import Pod, PodPhase
+    from tpu_on_k8s.api.types import TPUJob
+    from tpu_on_k8s.client import KubeletSim
+    from tpu_on_k8s.client.apiserver import ApiServer
+    from tpu_on_k8s.client.rest import RestCluster
+    from tpu_on_k8s.controller.tpujob import submit_job
+    from tpu_on_k8s.main import Operator, build_parser
+    from tpu_on_k8s.utils import serde
+    import yaml
+
+    srv = ApiServer().start()
+    args = build_parser().parse_args(["--cluster-backend", "rest",
+                                      "--api-server", srv.url,
+                                      "--no-leader-elect"])
+    op = Operator(args, cluster=RestCluster(srv.url))
+    op.start()
+    kubelet_client = RestCluster(srv.url)
+    kubelet = KubeletSim(kubelet_client)
+    stop = threading.Event()
+
+    def kubelet_loop() -> None:
+        """Run every pending pod as soon as it appears (an idle cluster —
+        the delay measured is pure controller latency, like envtest)."""
+        ran = set()
+        while not stop.is_set():
+            for p in kubelet_client.list(Pod):
+                if (p.metadata.name not in ran
+                        and p.status.phase == PodPhase.PENDING
+                        and p.metadata.deletion_timestamp is None):
+                    try:
+                        kubelet.run_pod(p.metadata.namespace, p.metadata.name)
+                        ran.add(p.metadata.name)
+                    except Exception:
+                        pass
+            stop.wait(0.02)
+
+    kt = threading.Thread(target=kubelet_loop, daemon=True)
+    kt.start()
+
+    with open(os.path.join(REPO, "config/samples/mnist_cnn.yaml")) as f:
+        sample = yaml.safe_load(f)
+    user = RestCluster(srv.url)
+    try:
+        for i in range(n_jobs):
+            job = serde.from_dict(TPUJob, sample)
+            job.metadata.name = f"bench-job-{i}"
+            submit_job(user, job)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                j = user.try_get(TPUJob, job.metadata.namespace or "default",
+                                 job.metadata.name)
+                if j and any(c.type == "Running" for c in j.status.conditions):
+                    break
+                time.sleep(0.01)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            delays = op.metrics.histograms.get(
+                "first_pod_launch_delay_seconds", [])
+            if len(delays) >= n_jobs:
+                break
+            time.sleep(0.1)
+        delays = list(op.metrics.histograms.get(
+            "first_pod_launch_delay_seconds", []))
+    finally:
+        stop.set()
+        kt.join(timeout=2)
+        op.stop()
+        user.close()
+        kubelet_client.close()
+        srv.stop()
+    if not delays:
+        raise RuntimeError("no launch delays observed")
+    return {
+        "metric": "job_submit_to_first_pod_ready_p50_seconds",
+        "value": round(statistics.median(delays), 3),
+        "unit": "s",
+        "p90": round(statistics.quantiles(delays, n=10)[-1], 3)
+                if len(delays) >= 10 else None,
+        "samples": len(delays),
+        "backend": "rest (apiserver + informers + kubelet sim)",
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--write", action="store_true",
+                        help="update BASELINE.json 'published' in place")
+    parser.add_argument("--skip-resnet", action="store_true")
+    parser.add_argument("--skip-submit", action="store_true")
+    args = parser.parse_args()
+
+    published = {}
+    if not args.skip_submit:
+        published["job_submit_to_first_pod_ready_p50"] = bench_submit_to_first_step()
+        print(json.dumps(published["job_submit_to_first_pod_ready_p50"]))
+    if not args.skip_resnet:
+        published["resnet50_images_per_sec_per_chip"] = bench_resnet50()
+        print(json.dumps(published["resnet50_images_per_sec_per_chip"]))
+
+    if args.write:
+        path = os.path.join(REPO, "BASELINE.json")
+        with open(path) as f:
+            baseline = json.load(f)
+        baseline.setdefault("published", {}).update(published)
+        with open(path, "w") as f:
+            json.dump(baseline, f, indent=2)
+            f.write("\n")
+        print(f"wrote {path} published: {sorted(baseline['published'])}")
+
+
+if __name__ == "__main__":
+    main()
