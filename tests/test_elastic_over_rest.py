@@ -1,0 +1,137 @@
+"""The elastic checkpoint→rescale generation cycle over the wire (VERDICT
+round 2 next-round #5b): the most intricate multi-actor protocol — controller,
+checkpoint agent (AIMaster), kubelet — each on its OWN RestCluster connection
+through the ApiServer. In-memory coverage lives in test_elastic_story.py;
+this pins the wire layer: annotation patches, merge-patch finalizer removal
+on victim cleanup, conflict-retried status updates, and watch-driven
+reconciliation all through HTTP.
+
+Reference protocol: controllers/train/elastic_scale.go:132-196 (checkpoint
+request/completion annotations), :210-297 (generation bump + respec).
+"""
+import threading
+import time
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import Pod, PodPhase
+from tpu_on_k8s.api.types import TaskType, TPUJob
+from tpu_on_k8s.client import KubeletSim
+from tpu_on_k8s.client.apiserver import ApiServer
+from tpu_on_k8s.client.rest import RestCluster
+from tpu_on_k8s.controller.tpujob import submit_job
+from tpu_on_k8s.main import Operator, build_parser
+from tpu_on_k8s.train.checkpoint import CheckpointAgent
+
+from tests.test_elastic import elastic_job
+
+
+def test_preemption_checkpoint_rescale_over_rest():
+    srv = ApiServer().start()
+    op = Operator(
+        build_parser().parse_args(
+            ["--cluster-backend", "rest", "--api-server", srv.url,
+             "--no-leader-elect"]),
+        cluster=RestCluster(srv.url))
+    op.start()
+
+    kubelet_client = RestCluster(srv.url)
+    kubelet = KubeletSim(kubelet_client)
+    stop = threading.Event()
+
+    def kubelet_loop():
+        ran = set()
+        while not stop.is_set():
+            for p in kubelet_client.list(Pod):
+                # key on uid: a recreated pod reuses its name and must be
+                # run again (real kubelets key on pod uid the same way)
+                if ((p.metadata.name, p.metadata.uid) not in ran
+                        and p.status.phase == PodPhase.PENDING
+                        and p.metadata.deletion_timestamp is None):
+                    try:
+                        kubelet.run_pod(p.metadata.namespace, p.metadata.name)
+                        ran.add((p.metadata.name, p.metadata.uid))
+                    except Exception:
+                        pass
+            stop.wait(0.02)
+
+    kt = threading.Thread(target=kubelet_loop, daemon=True)
+    kt.start()
+
+    # AIMaster-side checkpoint agent on its own connection
+    agent_client = RestCluster(srv.url)
+    saved = []
+    agent = CheckpointAgent(agent_client, "default", "story",
+                            lambda gen: saved.append(gen))
+
+    user = RestCluster(srv.url)
+    try:
+        submit_job(user, elastic_job(name="story"))  # 8 workers, 4x8
+
+        def wait(pred, what, timeout=30):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if pred():
+                    return
+                time.sleep(0.1)
+            raise AssertionError(f"timed out waiting for {what}")
+
+        def workers():
+            return [p for p in user.list(Pod)
+                    if p.metadata.labels.get(constants.LABEL_TASK_TYPE)
+                    == "worker"]
+
+        wait(lambda: len([p for p in workers()
+                          if p.status.phase == PodPhase.RUNNING]) == 8,
+             "8 running workers")
+        gen0 = user.get(TPUJob, "default", "story").metadata.generation
+
+        # ---- preempt two workers: deletes blocked by the preempt finalizer
+        for name in ("story-worker-6", "story-worker-7"):
+            pod = user.get(Pod, "default", name)
+            assert constants.FINALIZER_PREEMPT_PROTECTOR in pod.metadata.finalizers
+            user.delete(Pod, "default", name)
+
+        # ---- controller must request a checkpoint via annotation
+        def requested():
+            job = user.get(TPUJob, "default", "story")
+            return job.metadata.annotations.get(
+                constants.ANNOTATION_CKPT_REQUESTED_VERSION)
+
+        wait(lambda: requested() is not None, "checkpoint request annotation")
+        req_gen = int(requested())
+
+        # ---- agent observes the request over its own connection and acks
+        wait(lambda: agent.poll_once() is not None, "agent ack", timeout=10)
+        assert saved == [req_gen]
+
+        # ---- victims cleaned (finalizer removed over merge-patch → pods
+        # actually go away) and generation bumps; workers respec to a legal
+        # smaller host count (6 survivors snap down to 4 = topology 4x4)
+        wait(lambda: user.try_get(Pod, "default", "story-worker-7") is None,
+             "victim cleanup")
+        wait(lambda: user.get(TPUJob, "default", "story").metadata.generation
+             > req_gen, "generation bump")
+        wait(lambda: user.get(TPUJob, "default", "story")
+             .spec.tasks[TaskType.WORKER].num_tasks == 4, "respec to 4")
+        job = user.get(TPUJob, "default", "story")
+        assert job.spec.tpu_policy.topology == "4x4"
+        assert job.metadata.generation > gen0
+
+        # ---- the surviving gang converges to 4 running workers at the new
+        # generation label
+        def new_gen_running():
+            ws = [p for p in workers()
+                  if p.status.phase == PodPhase.RUNNING
+                  and p.metadata.deletion_timestamp is None]
+            gens = {p.metadata.labels.get(constants.LABEL_JOB_GENERATION)
+                    for p in ws}
+            return len(ws) == 4 and gens == {str(job.metadata.generation)}
+
+        wait(new_gen_running, "4 workers at the new generation")
+    finally:
+        stop.set()
+        kt.join(timeout=2)
+        op.stop()
+        for c in (user, agent_client, kubelet_client):
+            c.close()
+        srv.stop()

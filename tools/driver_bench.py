@@ -92,12 +92,14 @@ def bench_submit_to_first_step(n_jobs: int = 20) -> dict:
         ran = set()
         while not stop.is_set():
             for p in kubelet_client.list(Pod):
-                if (p.metadata.name not in ran
+                # key on uid: a recreated pod reuses its name and must be
+                # run again (real kubelets key on pod uid the same way)
+                if ((p.metadata.name, p.metadata.uid) not in ran
                         and p.status.phase == PodPhase.PENDING
                         and p.metadata.deletion_timestamp is None):
                     try:
                         kubelet.run_pod(p.metadata.namespace, p.metadata.name)
-                        ran.add(p.metadata.name)
+                        ran.add((p.metadata.name, p.metadata.uid))
                     except Exception:
                         pass
             stop.wait(0.02)

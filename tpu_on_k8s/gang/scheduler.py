@@ -253,12 +253,20 @@ class NodePool:
     ``accelerator``/``topology``, each slice being ``hosts_per_slice``
     accelerator/topology-labeled nodes. The finite inventory the Volcano
     analog allocates from (VERDICT round 1 #6 — admission was previously an
-    unconstrained ``node-N`` string generator)."""
+    unconstrained ``node-N`` string generator).
+
+    ``cpu_per_host`` / ``memory_per_host`` bound the non-TPU resources of
+    each host (0 = unconstrained): admission compares the gang's per-pod
+    ``min_resources`` share against them (the reference delegates the same
+    check to Volcano's cluster-capacity filter, volcano.go:175-230), so a
+    gang can fit by slice count yet still wait on CPU/memory."""
 
     name: str
     accelerator: str
     topology: str
     num_slices: int
+    cpu_per_host: float = 0.0
+    memory_per_host: float = 0.0
 
     @property
     def hosts_per_slice(self) -> int:
@@ -269,6 +277,68 @@ class NodePool:
 
     def matches(self, accelerator: str, topo: str) -> bool:
         return self.accelerator == accelerator and self.topology == topo
+
+    def fits_per_pod(self, per_pod: Dict[str, float]) -> bool:
+        """One worker pod per TPU host (the GKE TPU model): the pod's CPU and
+        memory share must fit a single host's capacity."""
+        if self.cpu_per_host and per_pod.get("cpu", 0.0) > self.cpu_per_host:
+            return False
+        if (self.memory_per_host
+                and per_pod.get("memory", 0.0) > self.memory_per_host):
+            return False
+        return True
+
+
+def parse_node_pools(spec: str) -> List[NodePool]:
+    """Parse the ``--node-pools`` flag: comma-separated
+    ``name=accelerator:topology:num_slices[:cpu=C][:mem=M]`` entries, e.g.
+    ``poolA=tpu-v5-lite-podslice:4x4:2:cpu=96:mem=384e9``."""
+    pools: List[NodePool] = []
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        name, _, rest = entry.partition("=")
+        if not rest:
+            raise ValueError(f"node pool {entry!r}: expected name=acc:topo:n")
+        parts = rest.split(":")
+        if len(parts) < 3:
+            raise ValueError(f"node pool {entry!r}: expected acc:topo:n")
+        acc, topo, n = parts[0], parts[1], int(parts[2])
+        cpu = mem = 0.0
+        for extra in parts[3:]:
+            k, _, v = extra.partition("=")
+            if k == "cpu":
+                cpu = float(v)
+            elif k == "mem":
+                mem = float(v)
+            else:
+                raise ValueError(f"node pool {entry!r}: unknown option {k!r}")
+        topology.validate_slice(acc, topo)  # fail loudly at flag-parse time
+        pools.append(NodePool(name=name, accelerator=acc, topology=topo,
+                              num_slices=n, cpu_per_host=cpu,
+                              memory_per_host=mem))
+    return pools
+
+
+def load_node_pools_file(path: str) -> List[NodePool]:
+    """Load pools from YAML: a list of {name, accelerator, topology,
+    numSlices, cpuPerHost?, memoryPerHost?} (the ConfigMap the scheduler
+    Deployment mounts, config/scheduler/)."""
+    import yaml
+
+    with open(path) as f:
+        raw = yaml.safe_load(f) or []
+    pools = []
+    for row in raw:
+        acc = row["accelerator"]
+        topo = row["topology"]
+        topology.validate_slice(acc, topo)
+        pools.append(NodePool(
+            name=row["name"], accelerator=acc, topology=topo,
+            num_slices=int(row.get("numSlices", row.get("num_slices", 1))),
+            cpu_per_host=float(row.get("cpuPerHost",
+                                       row.get("cpu_per_host", 0)) or 0),
+            memory_per_host=float(row.get("memoryPerHost",
+                                          row.get("memory_per_host", 0)) or 0)))
+    return pools
 
 
 class SliceGangAdmission:
@@ -290,6 +360,12 @@ class SliceGangAdmission:
                  pools: Optional[List[NodePool]] = None) -> None:
         self.cluster = cluster
         self.pools = pools or []
+        names = [p.name for p in self.pools]
+        if len(set(names)) != len(names):
+            # name-keyed inventory: a silent last-wins overwrite would hand
+            # out slices from the wrong pool — refuse at construction
+            dupes = sorted({n for n in names if names.count(n) > 1})
+            raise ValueError(f"duplicate node pool names: {dupes}")
         self._lock = threading.Lock()
         self.admitted_groups: List[str] = []
         # "ns/group" -> [(pool_name, slice_idx), ...]
@@ -297,6 +373,37 @@ class SliceGangAdmission:
         self._free: Dict[str, List[int]] = {
             p.name: list(range(p.num_slices)) for p in (pools or [])}
         self._pool_by_name = {p.name: p for p in (pools or [])}
+        self._recovered = not self.pools  # nothing to recover without pools
+
+    def _recover_allocations(self) -> None:
+        """Rebuild slice ownership after a scheduler restart: a Running
+        slice-gang podgroup's pods carry pool-encoded node names
+        (``{pool}-s{idx}-h{h}``) — without this, a restarted scheduler would
+        re-offer held slices and double-book hosts."""
+        for pg in self.cluster.list(PodGroup, None):
+            if (pg.status.phase != "Running"
+                    or pg.metadata.labels.get(LABEL_SLICE_GANG) != "true"):
+                continue
+            key = f"{pg.metadata.namespace}/{pg.metadata.name}"
+            held: List[tuple] = []
+            for pod in self._group_pods(pg):
+                node = pod.spec.node_name or ""
+                for pool in self.pools:
+                    prefix = f"{pool.name}-s"
+                    if node.startswith(prefix):
+                        idx_str = node[len(prefix):].partition("-h")[0]
+                        try:
+                            alloc = (pool.name, int(idx_str))
+                        except ValueError:
+                            continue
+                        if alloc not in held:
+                            held.append(alloc)
+            with self._lock:
+                if held and key not in self._allocations:
+                    self._allocations[key] = held
+                    for pool_name, idx in held:
+                        if idx in self._free.get(pool_name, []):
+                            self._free[pool_name].remove(idx)
 
     # ----------------------------------------------------------- slice capacity
     def free_slices(self, pool_name: str) -> int:
@@ -313,15 +420,33 @@ class SliceGangAdmission:
                 for pool_name, idx in self._allocations.pop(key):
                     self._free[pool_name].append(idx)
 
-    def _try_allocate(self, key: str, job: TPUJob) -> Optional[List[tuple]]:
-        """All-or-nothing slice allocation for the job's tpu_policy."""
+    def _try_allocate(self, key: str, job: TPUJob,
+                      pg: PodGroup) -> Optional[List[tuple]]:
+        """All-or-nothing slice allocation for the job's tpu_policy. A pool
+        must match the accelerator/topology, hold enough free slices, AND fit
+        the gang's per-pod CPU/memory share on each host (resource-aware
+        admission — a gang can fit by slice count yet wait on resources)."""
         tpu = job.spec.tpu_policy
         need = max(tpu.num_slices, 1)
+        # Per-pod fit uses the WORKER task's own requests (+ the chips
+        # SetClusterSpec injects), not min_resources/min_member — a job-wide
+        # group averages master+worker requests, which could admit a gang
+        # whose worker pods individually exceed a host.
+        worker = job.spec.tasks.get(TaskType.WORKER)
+        if worker is not None:
+            per_pod = dict(resmath.pod_requests(worker.template.spec))
+            per_pod.setdefault(constants.RESOURCE_TPU,
+                               topology.chips_per_host(tpu.accelerator))
+        else:
+            per_pod = {k: v / max(pg.spec.min_member, 1)
+                       for k, v in pg.spec.min_resources.items()}
         with self._lock:
             if key in self._allocations:  # already holding (re-sync)
                 return self._allocations[key]
             for pool in self.pools:
                 if not pool.matches(tpu.accelerator, tpu.topology):
+                    continue
+                if not pool.fits_per_pod(per_pod):
                     continue
                 free = self._free[pool.name]
                 if len(free) >= need:
@@ -342,6 +467,9 @@ class SliceGangAdmission:
         """Admit every gang-complete podgroup (in creation order — the order
         the coordinator dequeued their jobs); returns names admitted this
         pass. Deterministic and pull-based so tests control timing."""
+        if not self._recovered:
+            self._recover_allocations()
+            self._recovered = True
         if self.pools:
             self._release_stale(namespace)
         admitted = []
@@ -359,7 +487,7 @@ class SliceGangAdmission:
                 if job is None:
                     continue
                 key = f"{pg.metadata.namespace}/{pg.metadata.name}"
-                taken = self._try_allocate(key, job)
+                taken = self._try_allocate(key, job, pg)
                 if taken is None:
                     continue  # pool exhausted: gang waits, slices stay atomic
                 nodes = [self._pool_by_name[pn].node_name(idx, h)
@@ -405,6 +533,47 @@ class SliceGangAdmission:
                 Pod, pod.metadata.namespace, pod.metadata.name, mutate)
         except NotFoundError:
             pass
+
+
+class SliceSchedulerLoop:
+    """The deployable admission actor: runs ``SliceGangAdmission.sync()`` on
+    a period against any cluster backend (in-memory or REST). This is the
+    process that plays Volcano's role in a deployment — the reference
+    delegates admission to the external Volcano binary
+    (volcano/volcano.go:238-287); here the slice scheduler is our own
+    deliverable, started by ``main.py --enable-slice-scheduler`` (in-process
+    with the manager) or ``--scheduler-only`` (its own Deployment,
+    config/scheduler/)."""
+
+    def __init__(self, admission: SliceGangAdmission,
+                 period_seconds: float = 0.1) -> None:
+        self.admission = admission
+        self.period_seconds = period_seconds
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="slice-scheduler")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.admission.sync()
+            except Exception:  # noqa: BLE001 — the loop must survive blips
+                from tpu_on_k8s.utils.logging import get_logger
+                get_logger("slicescheduler").exception("admission sync failed")
+            self._stop.wait(self.period_seconds)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
 
 
 class GangRegistry:

@@ -74,7 +74,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--api-server", default="",
                    help="API server URL for the REST backend (overrides "
                         "kubeconfig resolution)")
+    # the slice gang-admission actor (our Volcano-role deliverable)
+    p.add_argument("--enable-slice-scheduler", default=False,
+                   action=argparse.BooleanOptionalAction,
+                   help="Run the TPU slice gang-admission loop in-process "
+                        "with the manager (single-binary deployments)")
+    p.add_argument("--scheduler-only", action="store_true",
+                   help="Run ONLY the slice gang-admission loop (the "
+                        "dedicated scheduler Deployment, config/scheduler/)")
+    p.add_argument("--node-pools", default="",
+                   help="Comma-separated finite slice inventory: "
+                        "name=accelerator:topology:num_slices[:cpu=C][:mem=M]")
+    p.add_argument("--node-pools-file", default="",
+                   help="YAML list of node pools (the mounted ConfigMap form)")
+    p.add_argument("--scheduler-period-seconds", type=float, default=0.1)
     return p
+
+
+def build_node_pools(args: argparse.Namespace):
+    from tpu_on_k8s.gang.scheduler import load_node_pools_file, parse_node_pools
+
+    pools = []
+    if getattr(args, "node_pools", ""):
+        pools.extend(parse_node_pools(args.node_pools))
+    if getattr(args, "node_pools_file", ""):
+        pools.extend(load_node_pools_file(args.node_pools_file))
+    return pools
 
 
 def build_cluster(args: argparse.Namespace):
@@ -140,6 +165,15 @@ class Operator:
             self.cluster, config=self.config, metrics=self.metrics)
         self.modelversion = setup_modelversion_controller(
             self.cluster, self.manager, config=self.config)
+        self.scheduler_loop = None
+        if getattr(args, "enable_slice_scheduler", False):
+            from tpu_on_k8s.gang.scheduler import (
+                SliceGangAdmission,
+                SliceSchedulerLoop,
+            )
+            self.scheduler_loop = SliceSchedulerLoop(
+                SliceGangAdmission(self.cluster, pools=build_node_pools(args)),
+                period_seconds=getattr(args, "scheduler_period_seconds", 0.1))
         self.elector = None
         if getattr(args, "leader_elect", False):
             import os
@@ -172,6 +206,8 @@ class Operator:
             if self.coordinator is not None:
                 self.coordinator.run()
             self.autoscaler.run()
+            if self.scheduler_loop is not None:
+                self.scheduler_loop.run()
 
     def _stop_workers(self) -> None:
         """Mirror of _start_workers: losing the lease must stop *every*
@@ -184,6 +220,8 @@ class Operator:
             if self.coordinator is not None:
                 self.coordinator.stop()
             self.autoscaler.stop()
+            if self.scheduler_loop is not None:
+                self.scheduler_loop.stop()
             self.manager.stop()
 
     def start(self, metrics_port: int = 0) -> None:
@@ -211,6 +249,35 @@ class Operator:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.scheduler_only:
+        # Dedicated admission actor (its own Deployment): no controllers,
+        # just the slice scheduler loop against the cluster backend.
+        from tpu_on_k8s.gang.scheduler import (
+            SliceGangAdmission,
+            SliceSchedulerLoop,
+        )
+        pools = build_node_pools(args)
+        if not pools:
+            # The dedicated admission actor without inventory would fall into
+            # the unconstrained test-only path and stamp fabricated node
+            # names onto real pods — refuse loudly instead.
+            raise SystemExit(
+                "--scheduler-only requires a non-empty slice inventory "
+                "(--node-pools or --node-pools-file)")
+        cluster = build_cluster(args)
+        loop = SliceSchedulerLoop(
+            SliceGangAdmission(cluster, pools=pools),
+            period_seconds=args.scheduler_period_seconds)
+        loop.run()
+        done = threading.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: done.set())
+        done.wait()
+        loop.stop()
+        close = getattr(cluster, "close", None)
+        if callable(close):
+            close()
+        return 0
     operator = Operator(args)
     if args.once:
         processed = operator.run_once()
