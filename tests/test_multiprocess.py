@@ -37,22 +37,22 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_cluster_psum(tmp_path):
+def _launch_workers(tmp_path, script_body, n=2, timeout=180):
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     port = _free_port()
     procs = []
-    for pid in range(2):
+    for pid in range(n):
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)
         # the exact variables the TPUJob controller injects
         env.update({
             "XLA_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
             "TPU_PROCESS_ID": str(pid),
-            "TPU_NUM_PROCESSES": "2",
+            "TPU_NUM_PROCESSES": str(n),
             "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
         })
         script = tmp_path / f"worker{pid}.py"
-        script.write_text(_WORKER)
+        script.write_text(script_body)
         procs.append(subprocess.Popen(
             [sys.executable, str(script)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -60,7 +60,7 @@ def test_two_process_cluster_psum(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=90)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -68,6 +68,94 @@ def test_two_process_cluster_psum(tmp_path):
         outs.append(out)
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
+    return outs
+
+
+def test_two_process_cluster_psum(tmp_path):
+    outs = _launch_workers(tmp_path, _WORKER, timeout=90)
     joined = "".join(outs)
     assert "proc 0 ok total=4.0" in joined
     assert "proc 1 ok total=4.0" in joined
+
+
+_SHARDED_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from tpu_on_k8s.train.distributed import initialize
+
+    ctx = initialize()  # operator-injected env -> jax.distributed
+    assert jax.process_count() == 2 and len(jax.devices()) == 4
+
+    import jax.numpy as jnp
+    from tpu_on_k8s.models.transformer import (
+        Transformer, TransformerConfig, flagship_partition_rules)
+    from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+    from tpu_on_k8s.train.trainer import Trainer, default_optimizer
+
+    cfg = TransformerConfig.tiny()
+    mesh = create_mesh(MeshConfig(data=1, fsdp=4, model=1, seq=1))
+    trainer = Trainer(Transformer(cfg), flagship_partition_rules(), mesh,
+                      default_optimizer(warmup_steps=1, decay_steps=10))
+    tokens = jax.random.randint(jax.random.key(0), (8, 65), 0,
+                                cfg.vocab_size, jnp.int32)
+    state = trainer.init_state(jax.random.key(1), tokens[:, :-1])
+    batch = trainer.shard_batch(tokens)
+    for _ in range(2):
+        state, metrics = trainer.train_step(state, batch)
+        print(f"proc {ctx.process_id} "
+              f"step={int(metrics['step'])} loss={float(metrics['loss']):.6f}",
+              flush=True)
+""")
+
+
+def test_two_process_sharded_flagship_train_step(tmp_path):
+    """Round-1 task #5 / round-2 #6: the flagship SHARDED trainer (fsdp=4
+    over a 2-process jax.distributed mesh, not a pmap psum) runs real steps,
+    and the loss matches a single-process run of the identical configuration
+    on the same seeds — the strongest multi-chip correctness evidence
+    available without hardware."""
+    outs = _launch_workers(tmp_path, _SHARDED_WORKER, timeout=240)
+    joined = "".join(outs)
+
+    # both processes observed the same (replicated) global losses
+    import re
+    losses = {}
+    for proc, step, loss in re.findall(
+            r"proc (\d) step=(\d) loss=([0-9.]+)", joined):
+        losses.setdefault(step, {})[proc] = float(loss)
+    assert set(losses) == {"0", "1"}, joined
+    for step, by_proc in losses.items():
+        assert set(by_proc) == {"0", "1"}, joined
+        assert by_proc["0"] == by_proc["1"], joined
+
+    # single-process reference: same config/seeds on a 4-device mesh
+    # (the test process runs the 8-device CPU conftest platform)
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_on_k8s.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        flagship_partition_rules,
+    )
+    from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+    from tpu_on_k8s.train.trainer import Trainer, default_optimizer
+
+    cfg = TransformerConfig.tiny()
+    mesh = create_mesh(MeshConfig(data=1, fsdp=4, model=1, seq=1),
+                       jax.devices()[:4])
+    trainer = Trainer(Transformer(cfg), flagship_partition_rules(), mesh,
+                      default_optimizer(warmup_steps=1, decay_steps=10))
+    tokens = jax.random.randint(jax.random.key(0), (8, 65), 0,
+                                cfg.vocab_size, jnp.int32)
+    state = trainer.init_state(jax.random.key(1), tokens[:, :-1])
+    batch = trainer.shard_batch(tokens)
+    for step in ("0", "1"):
+        state, metrics = trainer.train_step(state, batch)
+        ref = float(metrics["loss"])
+        got = losses[step]["0"]
+        assert abs(got - ref) < 5e-4, (
+            f"step {step}: multi-process loss {got} != single-process {ref}")

@@ -86,6 +86,22 @@ def create_mesh(config: Optional[MeshConfig] = None,
     return Mesh(grid, AXIS_ORDER)
 
 
+def put_global(x, sharding: NamedSharding):
+    """Place a host-replicated array onto a (possibly multi-process) mesh.
+
+    Single-process: a plain device_put. Multi-process (operator-launched
+    slice hosts, `tpu_on_k8s/train/distributed.py`): every process holds the
+    same full array (deterministic host-side pipeline) and contributes just
+    its addressable shards — the standard jax.make_array_from_callback
+    recipe; no host ever needs the whole batch on device.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
 def batch_sharding(mesh: Mesh,
                    shape: Optional[Tuple[int, ...]] = None) -> NamedSharding:
     """Sharding for a [batch, ...] input: batch split over every

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh
 
-from tpu_on_k8s.parallel.mesh import batch_sharding
+from tpu_on_k8s.parallel.mesh import batch_sharding, put_global
 from tpu_on_k8s.parallel.partition import PartitionRule, named_sharding
 from tpu_on_k8s.parallel.ring import ring_context
 
@@ -196,7 +196,9 @@ class Trainer:
             return self._init_cache[key](rng)
 
     def shard_batch(self, tokens: jnp.ndarray) -> jnp.ndarray:
-        return jax.device_put(tokens, batch_sharding(self.mesh, tokens.shape))
+        # put_global handles multi-process meshes (each slice host
+        # contributes its addressable shards)
+        return put_global(tokens, batch_sharding(self.mesh, tokens.shape))
 
     def train_step(self, state: TrainState, tokens: jnp.ndarray):
         # ring_context makes the mesh ambient while jit traces, so

@@ -116,8 +116,10 @@ class ClassifierTrainer:
 
     # ------------------------------------------------------------------- API
     def shard_batch(self, *arrays: jnp.ndarray):
+        from tpu_on_k8s.parallel.mesh import put_global
+
         sh = data_sharding(self.mesh)
-        out = tuple(jax.device_put(a, sh) for a in arrays)
+        out = tuple(put_global(a, sh) for a in arrays)
         return out if len(out) > 1 else out[0]
 
     def train_step(self, state, images, labels):
