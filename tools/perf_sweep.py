@@ -36,9 +36,12 @@ def run_variant(spec: str) -> None:
     attn = opts.pop("attn", "xla")
     remat = opts.pop("remat", "dots")        # full | dots | dots_kernels | mlp | off
     block = int(opts.pop("block", 0))        # 0 = auto
+    bq = int(opts.pop("bq", 0)) or block
+    bk = int(opts.pop("bk", 0)) or block
     steps = int(opts.pop("steps", 20))
     mu = opts.pop("mu", "bf16")              # bf16 | fp32
     chunks = int(opts.pop("chunks", 0))
+    unroll = int(opts.pop("unroll", 1))
     if opts:
         raise ValueError(f"unknown keys {list(opts)}")
 
@@ -47,8 +50,9 @@ def run_variant(spec: str) -> None:
         **{**{f.name: getattr(base, f.name)
               for f in base.__dataclass_fields__.values()},
            "attn_impl": attn,
-           "attn_block_q": block,
-           "attn_block_k": block,
+           "attn_block_q": bq,
+           "attn_block_k": bk,
+           "scan_unroll": unroll,
            "remat": remat != "off",
            "remat_policy": remat if remat != "off" else "full"})
     devices = jax.devices()

@@ -63,6 +63,9 @@ class TransformerConfig:
     attn_impl: str = "xla"             # "xla" | "flash" | "ring" | "ulysses"
     attn_block_q: int = 0              # flash kernel q-block; 0 = auto (512)
     attn_block_k: int = 0              # flash kernel k-block; 0 = auto (512)
+    scan_unroll: int = 1               # layers unrolled per scan iteration
+                                       # (trades compile time/HLO size for
+                                       # less loop bookkeeping per step)
     pos_emb: str = "rope"              # "rope" | "learned" (GPT-2 family)
     norm: str = "rms"                  # "rms" | "ln"
     activation: str = "swiglu"         # "swiglu" | "gelu"
@@ -467,6 +470,7 @@ class Transformer(nn.Module):
             split_rngs={"params": True},
             in_axes=nn.broadcast,
             length=cfg.n_layers,
+            unroll=cfg.scan_unroll,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )(cfg, name="blocks")
         x, _ = stack(x, positions)
