@@ -1,0 +1,120 @@
+"""The model-artifact pipeline over the wire: job success → ModelVersion →
+cluster-scoped PV + namespaced PVC → dockerfile ConfigMap → Kaniko-analog
+build pod → Model.latest_version — all through the ApiServer, with the
+operator and the kubelet sim on separate REST connections.
+
+This closes the last flagship subsystem that was proven only against
+InMemoryCluster directly (reference: modelversion_controller.go:90-276); it
+also exercises the cluster-scoped PersistentVolume routes end-to-end.
+"""
+import threading
+import time
+
+from tpu_on_k8s.api.core import Pod, PodPhase
+from tpu_on_k8s.api.model_types import (
+    ImageBuildPhase,
+    Model,
+    ModelVersion,
+    ModelVersionSpec,
+    NFSStorage,
+    Storage,
+)
+from tpu_on_k8s.api.types import TPUJob
+from tpu_on_k8s.client import KubeletSim
+from tpu_on_k8s.client.apiserver import ApiServer
+from tpu_on_k8s.client.rest import RestCluster
+from tpu_on_k8s.controller.tpujob import submit_job
+from tpu_on_k8s.main import Operator, build_parser
+from tpu_on_k8s.storage import PersistentVolume, PersistentVolumeClaim
+
+from tests.test_elastic import elastic_job
+
+
+def test_job_success_builds_model_image_over_rest():
+    srv = ApiServer().start()
+    op = Operator(
+        build_parser().parse_args(
+            ["--cluster-backend", "rest", "--api-server", srv.url,
+             "--no-leader-elect"]),
+        cluster=RestCluster(srv.url))
+    op.start()
+
+    kubelet_client = RestCluster(srv.url)
+    kubelet = KubeletSim(kubelet_client)
+    stop = threading.Event()
+    succeed_all = threading.Event()
+
+    def kubelet_loop():
+        ran = set()
+        while not stop.is_set():
+            for p in kubelet_client.list(Pod):
+                key = (p.metadata.name, p.metadata.uid)
+                if (key not in ran and p.status.phase == PodPhase.PENDING
+                        and p.metadata.deletion_timestamp is None):
+                    try:
+                        kubelet.run_pod(p.metadata.namespace, p.metadata.name)
+                        ran.add(key)
+                    except Exception:
+                        pass
+                elif (succeed_all.is_set()
+                      and p.status.phase == PodPhase.RUNNING
+                      and p.metadata.deletion_timestamp is None):
+                    try:
+                        kubelet.succeed_pod(p.metadata.namespace,
+                                            p.metadata.name)
+                    except Exception:
+                        pass
+            stop.wait(0.02)
+
+    kt = threading.Thread(target=kubelet_loop, daemon=True)
+    kt.start()
+
+    user = RestCluster(srv.url)
+    try:
+        job = elastic_job(name="trainjob", workers=2, topology="2x4")
+        job.metadata.annotations.clear()  # plain non-elastic run
+        job.spec.model_version = ModelVersionSpec(
+            model_name="m1",
+            storage=Storage(nfs=NFSStorage(server="nfs.local",
+                                           path="/models")),
+            image_repo="reg.example/m1", image_tag="v1")
+        submit_job(user, job)
+
+        def wait(pred, what, timeout=40):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if pred():
+                    return
+                time.sleep(0.1)
+            raise AssertionError(f"timed out waiting for {what}")
+
+        wait(lambda: len([p for p in user.list(Pod)
+                          if p.status.phase == PodPhase.RUNNING]) >= 3,
+             "job pods running")
+        succeed_all.set()  # everything that runs from now on completes
+
+        # job succeeds → ModelVersion emitted → PV (cluster-scoped) + PVC +
+        # build pod run through the same kubelet → image build succeeds
+        def mv():
+            mvs = user.list(ModelVersion)
+            return mvs[0] if mvs else None
+
+        wait(lambda: mv() is not None, "ModelVersion emitted")
+        name = mv().metadata.name
+        wait(lambda: user.try_get(PersistentVolume, "", f"mv-pv-{name}")
+             is not None, "cluster-scoped PV")
+        wait(lambda: user.try_get(PersistentVolumeClaim, "default",
+                                  f"mv-pv-{name}") is not None, "PVC")
+        wait(lambda: mv().status.image_build_phase
+             == ImageBuildPhase.SUCCEEDED, "image build succeeded")
+        wait(lambda: user.get(Model, "default", "m1")
+             .status.latest_version_name == name, "Model.latest_version_name")
+        assert (user.get(Model, "default", "m1").status.latest_image
+                == "reg.example/m1:v1")
+    finally:
+        stop.set()
+        kt.join(timeout=2)
+        op.stop()
+        for c in (user, kubelet_client):
+            c.close()
+        srv.stop()
