@@ -257,6 +257,57 @@ def test_restarted_scheduler_recovers_held_slices():
     assert podgroup_name(rival, TaskType.WORKER) in second.sync()
 
 
+def test_rescale_reallocates_slices_and_readmits_new_pods():
+    """Elastic rescale under gang+pools: the podgroup stays Running while its
+    pods are recreated (possibly at a DIFFERENT topology). The scheduler must
+    re-admit node-less pods and swap the held slice set for one matching the
+    new shape — stale 4x4 hosts can never serve a 2x4 gang."""
+    cluster = InMemoryCluster()
+    gs = SliceGangScheduler(cluster, per_role=True)
+    pools = [NodePool("big", "tpu-v5-lite-podslice", "4x4", num_slices=1),
+             NodePool("small", "tpu-v5-lite-podslice", "2x4", num_slices=1)]
+    admission = SliceGangAdmission(cluster, pools=pools)
+
+    job = _job("resc", workers=4, topology="4x4")
+    job = cluster.create(job)
+    gs.create_podgroups(job)
+    for i in range(4):
+        pod = Pod(metadata=ObjectMeta(name=f"resc-worker-{i}"),
+                  spec=PodSpec(containers=[Container(name="c", image="i")]))
+        gs.bind_pod(job, pod, TaskType.WORKER)
+        cluster.create(pod)
+    wname = podgroup_name(job, TaskType.WORKER)
+    assert wname in admission.sync()
+    assert admission.free_slices("big") == 0
+
+    # elastic rescale to 2x4: respec the job, shrink the (still-Running)
+    # podgroup, recreate the worker pods node-less
+    def respec(j):
+        j.spec.tpu_policy.topology = "2x4"
+        j.spec.tasks[TaskType.WORKER].num_tasks = 2
+    cluster.update_with_retry(TPUJob, "default", "resc", respec)
+    job = cluster.get(TPUJob, "default", "resc")
+
+    def shrink(pg):
+        pg.spec.min_member = 2
+    cluster.update_with_retry(PodGroup, "default", wname, shrink)
+    for i in range(4):
+        cluster.delete(Pod, "default", f"resc-worker-{i}")
+    for i in range(2):
+        pod = Pod(metadata=ObjectMeta(name=f"resc-worker-{i}"),
+                  spec=PodSpec(containers=[Container(name="c", image="i")]))
+        gs.bind_pod(job, pod, TaskType.WORKER)
+        cluster.create(pod)
+
+    assert wname in admission.sync()  # re-admitted
+    nodes = sorted(cluster.get(Pod, "default", f"resc-worker-{i}")
+                   .spec.node_name for i in range(2))
+    assert nodes == ["small-s0-h0", "small-s0-h1"], nodes
+    # the 4x4 slice returned to its pool
+    assert admission.free_slices("big") == 1
+    assert admission.free_slices("small") == 0
+
+
 # --------------------------------------------------- the wire: contention e2e
 
 @pytest.fixture()

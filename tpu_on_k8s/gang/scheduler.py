@@ -441,8 +441,20 @@ class SliceGangAdmission:
             per_pod = {k: v / max(pg.spec.min_member, 1)
                        for k, v in pg.spec.min_resources.items()}
         with self._lock:
-            if key in self._allocations:  # already holding (re-sync)
-                return self._allocations[key]
+            held = self._allocations.get(key)
+            if held is not None:
+                # An elastic rescale can change topology/num_slices under a
+                # held allocation; slices of the wrong shape can never serve
+                # the new gang — release and reallocate instead of handing
+                # back stale hosts.
+                shape_ok = (len(held) == need and all(
+                    self._pool_by_name[pn].matches(tpu.accelerator,
+                                                   tpu.topology)
+                    for pn, _ in held))
+                if shape_ok:
+                    return held
+                for pn, idx in self._allocations.pop(key):
+                    self._free[pn].append(idx)
             for pool in self.pools:
                 if not pool.matches(tpu.accelerator, tpu.topology):
                     continue
@@ -466,7 +478,12 @@ class SliceGangAdmission:
     def sync(self, namespace: Optional[str] = None) -> List[str]:
         """Admit every gang-complete podgroup (in creation order — the order
         the coordinator dequeued their jobs); returns names admitted this
-        pass. Deterministic and pull-based so tests control timing."""
+        pass. Deterministic and pull-based so tests control timing.
+
+        Running groups are revisited when any of their pods lack a node —
+        an elastic rescale recreates pods under the same (still-Running)
+        group, possibly with a different topology; those pods need nodes
+        from a (possibly re-)allocated slice set."""
         if not self._recovered:
             self._recover_allocations()
             self._recovered = True
@@ -474,9 +491,10 @@ class SliceGangAdmission:
             self._release_stale(namespace)
         admitted = []
         for pg in self.cluster.list(PodGroup, namespace):
-            if pg.status.phase == "Running":
-                continue
             pods = self._group_pods(pg)
+            if (pg.status.phase == "Running"
+                    and all(p.spec.node_name for p in pods)):
+                continue
             if len(pods) < pg.spec.min_member:
                 continue
             nodes: Optional[List[str]] = None
