@@ -70,6 +70,36 @@ def test_asymmetric_block_gradients_match_xla():
                                    err_msg=f"d{name} mismatch")
 
 
+def test_native_gqa_matches_repeated_kv():
+    """k/v with Hkv < H heads (native GQA index maps, no HBM repeat) must
+    match the pre-repeated form, forward and backward — including the dkv
+    kernel's rep-innermost accumulation grid."""
+    q, _, _ = _qkv(b=2, l=256, h=4, d=32, seed=1)
+    _, k, v = _qkv(b=2, l=256, h=2, d=32, seed=2)  # 2 kv heads, rep=2
+    k_rep = jnp.repeat(k, 2, axis=2)
+    v_rep = jnp.repeat(v, 2, axis=2)
+
+    got = flash_attention(q, k, v, causal=True)
+    want = flash_attention(q, k_rep, v_rep, causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+    want_xla = xla_attention(q, k_rep, v_rep, causal=True)
+    np.testing.assert_allclose(got, want_xla, atol=2e-5, rtol=2e-5)
+
+    def loss_gqa(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def loss_rep(q, k, v):
+        return jnp.sum(flash_attention(
+            q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2),
+            causal=True) ** 2)
+
+    g_gqa = jax.grad(loss_gqa, argnums=(0, 1, 2))(q, k, v)
+    g_rep = jax.grad(loss_rep, argnums=(0, 1, 2))(q, k, v)
+    for got_g, want_g, name in zip(g_gqa, g_rep, "qkv"):
+        np.testing.assert_allclose(got_g, want_g, atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
 def test_auto_block_handles_non_512_divisible_seq():
     """Default (auto) blocks must serve any 128-multiple length — 768 is not
     divisible by 512 and picks 384."""

@@ -127,8 +127,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
 
 def _fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool,
          block_q: int, block_k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """q/k/v: [B, H, L, D] → (out [B, H, L, D], lse [B, H, L])."""
+    """q: [B, H, L, D]; k/v: [B, Hkv, L, D] with H % Hkv == 0 (GQA is native:
+    the index maps route q-head h to kv-head h // rep — no repeated K/V ever
+    materialises in HBM) → (out [B, H, L, D], lse [B, H, L])."""
     b, h, l, d = q.shape
+    rep = h // k.shape[1]
     bq = _block(block_q, l)
     bk = _block(block_k, l)
     grid = (b, h, l // bq)
@@ -139,8 +142,10 @@ def _fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, l, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, l, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, l, d),
+                         lambda b_, h_, i: (b_, h_ // rep, 0, 0)),
+            pl.BlockSpec((1, 1, l, d),
+                         lambda b_, h_, i: (b_, h_ // rep, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
@@ -196,7 +201,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, *, scale: float, block_q: int, block_k: int,
                 causal: bool):
+    """Grid (B, Hkv, L/bk, rep): the innermost ``rep`` dim iterates the
+    q-heads sharing this kv-head while the dk/dv output block stays resident
+    (consecutive revisits — the Pallas-legal accumulation pattern), so GQA
+    gradients sum in-kernel and no repeated K/V ever exists in HBM."""
     j = pl.program_id(2)
+    r = pl.program_id(3)
     k_blk = k_ref[0, 0]                                    # [bk, D] bf16
     v_blk = v_ref[0, 0]
     bk, d = k_blk.shape
@@ -231,12 +241,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     zeros = jnp.zeros((bk, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(start, nq, body, (zeros, zeros))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(r == 0)
+    def _init():
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(r != 0)
+    def _accumulate():
+        dk_ref[0, 0] += dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] += dv.astype(dv_ref.dtype)
 
 
 def _bwd(q, k, v, o, lse, do, causal: bool, block_q: int, block_k: int):
     b, h, l, d = q.shape
+    hkv = k.shape[1]
+    rep = h // hkv
     bq = _block(block_q, l)
     bk = _block(block_k, l)
     # per-row sum(dO ⊙ O): cheap elementwise reduce, XLA fuses it.
@@ -244,27 +264,37 @@ def _bwd(q, k, v, o, lse, do, causal: bool, block_q: int, block_k: int):
                     axis=-1)[:, :, None, :]                # [B, H, 1, L]
 
     qblk = lambda: pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0))
-    kblk = lambda: pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i: (b_, h_, i, 0))
-    full = lambda: pl.BlockSpec((1, 1, l, d), lambda b_, h_, i: (b_, h_, 0, 0))
+    kv_full = lambda: pl.BlockSpec(
+        (1, 1, l, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0))
     row_qblk = lambda: pl.BlockSpec((1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i))
-    row_full = lambda: pl.BlockSpec((1, 1, 1, l), lambda b_, h_, i: (b_, h_, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=d ** -0.5, block_q=bq,
                           block_k=bk, causal=causal),
         grid=(b, h, l // bq),
-        in_specs=[qblk(), full(), full(), qblk(), row_qblk(), row_qblk()],
+        in_specs=[qblk(), kv_full(), kv_full(), qblk(), row_qblk(),
+                  row_qblk()],
         out_specs=qblk(),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
+    # dkv grid: (B, Hkv, k-blocks, rep) — rep innermost so the dk/dv output
+    # block is revisited consecutively and accumulates across the q-heads of
+    # each kv group.
+    head = lambda: pl.BlockSpec(
+        (1, 1, l, d), lambda b_, hk, j, r_: (b_, hk * rep + r_, 0, 0))
+    row_head = lambda: pl.BlockSpec(
+        (1, 1, 1, l), lambda b_, hk, j, r_: (b_, hk * rep + r_, 0, 0))
+    kvblk = lambda: pl.BlockSpec(
+        (1, 1, bk, d), lambda b_, hk, j, r_: (b_, hk, j, 0))
+
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=d ** -0.5, block_q=bq,
                           block_k=bk, causal=causal),
-        grid=(b, h, l // bk),
-        in_specs=[full(), kblk(), kblk(), full(), row_full(), row_full()],
-        out_specs=[kblk(), kblk()],
+        grid=(b, hkv, l // bk, rep),
+        in_specs=[head(), kvblk(), kvblk(), head(), row_head(), row_head()],
+        out_specs=[kvblk(), kvblk()],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         interpret=_interpret(),
@@ -299,7 +329,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True,
                     block_q: int = 0,
                     block_k: int = 0) -> jnp.ndarray:
-    """Flash attention on [B, L, H, D] tensors (kv pre-repeated to H heads).
+    """Flash attention on [B, L, H, D] q; k/v may carry fewer (grouped) heads
+    [B, L, Hkv, D] with H % Hkv == 0 — GQA is handled natively by the kernel
+    index maps, so no repeated K/V is ever materialised (pre-repeated k/v
+    still works: that is the Hkv == H case).
 
     Drop-in for ``xla_attention`` — same layout, same semantics, O(L·D) HBM
     traffic instead of O(L²). ``block_q``/``block_k`` of 0 pick
