@@ -52,35 +52,59 @@ def _resolve_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
 
 
 def _local_ring(q, k, v, *, axis_name: str, n: int, causal: bool):
-    """Per-device body under shard_map. q/k/v: [B, Lc, H, D] local shards."""
+    """Per-device body under shard_map. q/k/v: [B, Lc, H, D] local shards.
+
+    Dots take the input dtype (bf16 on TPU) with fp32 accumulation via
+    ``preferred_element_type`` — casting inputs to fp32 first would run the
+    MXU in its slow fp32 mode (the same pitfall measured in the flash
+    kernel). Under ``causal``, ring steps whose K/V chunk is entirely in the
+    future (src > my) are skipped via ``lax.cond`` — half the ring is masked
+    on average, so this halves the attention FLOPs rather than computing
+    and discarding them.
+    """
     my = jax.lax.axis_index(axis_name)
     lc = q.shape[1]
     d = q.shape[-1]
     scale = d ** -0.5
-    qf = q.astype(jnp.float32) * scale
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, idx):
-        m, l, acc, k_cur, v_cur = carry
-        # chunk currently held originated at device (my - idx) mod n
-        src = jax.lax.rem(my - idx + n, n)
-        s = jnp.einsum("blhd,bmhd->bhlm", qf, k_cur.astype(jnp.float32),
-                       preferred_element_type=jnp.float32)
+    def compute(m, l, acc, k_cur, v_cur, src):
+        s = scale * jnp.einsum("blhd,bmhd->bhlm", q, k_cur,
+                               preferred_element_type=jnp.float32)
         if causal:
-            q_pos = my * lc + jax.lax.broadcasted_iota(jnp.int32, (lc, lc), 0)
-            k_pos = src * lc + jax.lax.broadcasted_iota(jnp.int32, (lc, lc), 1)
-            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+            # compute() only ever sees src <= my: the diagonal chunk
+            # (src == my) needs the triangular mask, past chunks are
+            # entirely visible
+            tri = jax.lax.broadcasted_iota(jnp.int32, (lc, lc), 0) >= \
+                jax.lax.broadcasted_iota(jnp.int32, (lc, lc), 1)
+            mask = jnp.where(src == my, tri[None, None], jnp.bool_(True))
+            s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))          # [B, H, Lc]
         p = jnp.exp(s - m_new[..., None])
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
         acc_new = acc * corr[..., None] + jnp.einsum(
-            "bhlm,bmhd->bhld", p, v_cur.astype(jnp.float32),
+            "bhlm,bmhd->bhld", p.astype(v_cur.dtype), v_cur,
             preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    def step(carry, idx):
+        m, l, acc, k_cur, v_cur = carry
+        # chunk currently held originated at device (my - idx) mod n
+        src = jax.lax.rem(my - idx + n, n)
+        if causal:
+            m, l, acc = jax.lax.cond(
+                src > my,
+                lambda m_, l_, acc_, *_: (m_, l_, acc_),
+                lambda m_, l_, acc_, k_, v_: compute(m_, l_, acc_, k_, v_,
+                                                     src),
+                m, l, acc, k_cur, v_cur)
+        else:
+            m, l, acc = compute(m, l, acc, k_cur, v_cur, src)
         # rotate K/V to the next device; the final rotation restores origin.
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+        return (m, l, acc, k_nxt, v_nxt), None
 
     b, _, h, _ = q.shape
     m0 = jnp.full((b, h, lc), NEG_INF, jnp.float32)
@@ -88,7 +112,7 @@ def _local_ring(q, k, v, *, axis_name: str, n: int, causal: bool):
     acc0 = jnp.zeros((b, h, lc, d), jnp.float32)
     (m, l, acc, _, _), _ = jax.lax.scan(
         step, (m0, l0, acc0, k, v), jnp.arange(n))
-    out = acc / l[..., None]                                  # [B, H, Lc, D]
+    out = acc / jnp.maximum(l, 1e-30)[..., None]              # [B, H, Lc, D]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
