@@ -70,6 +70,36 @@ def test_asymmetric_block_gradients_match_xla():
                                    err_msg=f"d{name} mismatch")
 
 
+def test_flash_with_lse_gradients_including_lse_cotangent():
+    """flash_with_lse must be differentiable in BOTH outputs — a loss that
+    consumes the logsumexp directly (as the ring merge does) must match the
+    same loss built on plain XLA ops."""
+    from tpu_on_k8s.ops.flash_attention import flash_with_lse
+
+    q, k, v = _qkv(b=1, l=256, h=2, d=32)
+
+    def loss_flash(q, k, v):
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        out, lse = flash_with_lse(qt, kt, vt, True, 128, 128)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    def loss_ref(q, k, v):
+        scale = q.shape[-1] ** -0.5
+        s = jnp.einsum("blhd,bmhd->bhlm", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        mask = jnp.tril(jnp.ones((256, 256), dtype=bool))
+        s = jnp.where(mask, s, -1e30)
+        out = jnp.einsum("bhlm,bmhd->bhld", jax.nn.softmax(s, axis=-1), v)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
 def test_native_gqa_matches_repeated_kv():
     """k/v with Hkv < H heads (native GQA index maps, no HBM repeat) must
     match the pre-repeated form, forward and backward — including the dkv

@@ -253,7 +253,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] += dv.astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal: bool, block_q: int, block_k: int):
+def _bwd(q, k, v, o, lse, do, causal: bool, block_q: int, block_k: int,
+         g_lse=None):
     b, h, l, d = q.shape
     hkv = k.shape[1]
     rep = h // hkv
@@ -262,6 +263,10 @@ def _bwd(q, k, v, o, lse, do, causal: bool, block_q: int, block_k: int):
     # per-row sum(dO ⊙ O): cheap elementwise reduce, XLA fuses it.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, :, None, :]                # [B, H, 1, L]
+    if g_lse is not None:
+        # lse cotangent folds into delta: ∂lse_r/∂s_rj = p_rj, so
+        # ds = p ∘ (dp − (delta − ḡ_lse)) — the kernels are unchanged.
+        delta = delta - g_lse.astype(jnp.float32)
 
     qblk = lambda: pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0))
     kv_full = lambda: pl.BlockSpec(
@@ -323,6 +328,30 @@ def _flash_bwd(causal, block_q, block_k, residuals, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_with_lse(q, k, v, causal: bool, block_q: int, block_k: int):
+    """Flash attention that also returns the per-row logsumexp ([B, H, 1, L])
+    — the combination primitive for blockwise/ring attention: chunk results
+    merge exactly via ``s' = logaddexp(s, lse_i)``. Differentiable in BOTH
+    outputs (the lse cotangent folds into the kernels' delta term)."""
+    return _fwd(q, k, v, causal, block_q, block_k)
+
+
+def _fwl_fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, causal, block_q, block_k)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _fwl_bwd(causal, block_q, block_k, residuals, g):
+    g_out, g_lse = g
+    q, k, v, o, lse = residuals
+    return _bwd(q, k, v, o, lse, g_out, causal, block_q, block_k,
+                g_lse=g_lse)
+
+
+flash_with_lse.defvjp(_fwl_fwd, _fwl_bwd)
 
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

@@ -3,10 +3,10 @@
 Sequence/context parallelism for sequences too long for one chip's HBM: the
 sequence dim is sharded over the ``seq`` mesh axis; each device keeps its Q
 shard resident and the K/V shards rotate around the ring via ``ppermute``
-(which XLA lowers to neighbor ICI transfers), combined with an online softmax
-so the result is *exact* attention, not an approximation. Per-device memory is
-O(L/n · L/n) per step instead of O(L²); comms ride the ICI ring and overlap
-with each step's matmuls.
+(which XLA lowers to neighbor ICI transfers); each step runs the Pallas flash
+kernel on its chunk and results merge exactly via logsumexp — so per-device
+memory per step is O(L/n · D) plus one kernel block (never an [Lc, Lc] score
+matrix), and comms ride the ICI ring overlapping each step's matmuls.
 
 The reference has no long-context support at all (SURVEY.md §5.7 — its
 operator never sees tensors); this is a first-class capability of the TPU
@@ -54,66 +54,68 @@ def _resolve_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
 def _local_ring(q, k, v, *, axis_name: str, n: int, causal: bool):
     """Per-device body under shard_map. q/k/v: [B, Lc, H, D] local shards.
 
-    Dots take the input dtype (bf16 on TPU) with fp32 accumulation via
-    ``preferred_element_type`` — casting inputs to fp32 first would run the
-    MXU in its slow fp32 mode (the same pitfall measured in the flash
-    kernel). Under ``causal``, ring steps whose K/V chunk is entirely in the
-    future (src > my) are skipped via ``lax.cond`` — half the ring is masked
-    on average, so this halves the attention FLOPs rather than computing
-    and discarding them.
-    """
-    my = jax.lax.axis_index(axis_name)
-    lc = q.shape[1]
-    d = q.shape[-1]
-    scale = d ** -0.5
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    Each ring step runs the Pallas flash kernel on the resident Q shard
+    against the rotating K/V chunk (never materialising a [Lc, Lc] score
+    matrix — at ring scale Lc is itself thousands of tokens), then merges
+    chunk results via their logsumexp:
 
-    def compute(m, l, acc, k_cur, v_cur, src):
-        s = scale * jnp.einsum("blhd,bmhd->bhlm", q, k_cur,
-                               preferred_element_type=jnp.float32)
-        if causal:
-            # compute() only ever sees src <= my: the diagonal chunk
-            # (src == my) needs the triangular mask, past chunks are
-            # entirely visible
-            tri = jax.lax.broadcasted_iota(jnp.int32, (lc, lc), 0) >= \
-                jax.lax.broadcasted_iota(jnp.int32, (lc, lc), 1)
-            mask = jnp.where(src == my, tri[None, None], jnp.bool_(True))
-            s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))          # [B, H, Lc]
-        p = jnp.exp(s - m_new[..., None])
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[..., None] + jnp.einsum(
-            "bhlm,bmhd->bhld", p.astype(v_cur.dtype), v_cur,
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        s' = logaddexp(s, lse_i);  out' = e^{s-s'}·out + e^{lse_i-s'}·o_i
+
+    Under ``causal``, steps whose chunk is entirely in the future
+    (src > my) are skipped via ``lax.cond`` (half the ring on average), the
+    diagonal chunk runs the causal kernel, and past chunks run the
+    non-causal kernel — no masked-out FLOPs are ever computed.
+    """
+    from tpu_on_k8s.ops.flash_attention import auto_block, flash_with_lse
+
+    my = jax.lax.axis_index(axis_name)
+    b, lc, h, d = q.shape
+    try:
+        blk = auto_block(lc)
+    except ValueError as e:
+        raise ValueError(
+            f"ring attention: per-device shard length {lc} (global seq "
+            f"{lc * n} over {axis_name}={n}) has no usable flash block; pad "
+            f"the sequence so L/{n} is a multiple of 128") from e
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    qt = q.transpose(0, 2, 1, 3)                              # [B, H, Lc, D]
+
+    def merge(out, s_run, k_cur, v_cur, *, diag: bool):
+        o_i, lse_i = flash_with_lse(qt, k_cur.transpose(0, 2, 1, 3),
+                                    v_cur.transpose(0, 2, 1, 3),
+                                    diag, blk, blk)
+        lse_i = lse_i[:, :, 0, :]                             # [B, H, Lc]
+        s_new = jnp.logaddexp(s_run, lse_i)
+        out_new = (out * jnp.exp(s_run - s_new)[..., None]
+                   + o_i.astype(jnp.float32)
+                   * jnp.exp(lse_i - s_new)[..., None])
+        return out_new, s_new
 
     def step(carry, idx):
-        m, l, acc, k_cur, v_cur = carry
+        out, s_run, k_cur, v_cur = carry
         # chunk currently held originated at device (my - idx) mod n
         src = jax.lax.rem(my - idx + n, n)
         if causal:
-            m, l, acc = jax.lax.cond(
+            out, s_run = jax.lax.cond(
                 src > my,
-                lambda m_, l_, acc_, *_: (m_, l_, acc_),
-                lambda m_, l_, acc_, k_, v_: compute(m_, l_, acc_, k_, v_,
-                                                     src),
-                m, l, acc, k_cur, v_cur)
+                lambda o, s, *_: (o, s),                     # future: skip
+                lambda o, s, k_, v_: jax.lax.cond(
+                    src == my,
+                    lambda o2, s2, k2, v2: merge(o2, s2, k2, v2, diag=True),
+                    lambda o2, s2, k2, v2: merge(o2, s2, k2, v2, diag=False),
+                    o, s, k_, v_),
+                out, s_run, k_cur, v_cur)
         else:
-            m, l, acc = compute(m, l, acc, k_cur, v_cur, src)
+            out, s_run = merge(out, s_run, k_cur, v_cur, diag=False)
         # rotate K/V to the next device; the final rotation restores origin.
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (m, l, acc, k_nxt, v_nxt), None
+        return (out, s_run, k_nxt, v_nxt), None
 
-    b, _, h, _ = q.shape
-    m0 = jnp.full((b, h, lc), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, lc), jnp.float32)
-    acc0 = jnp.zeros((b, h, lc, d), jnp.float32)
-    (m, l, acc, _, _), _ = jax.lax.scan(
-        step, (m0, l0, acc0, k, v), jnp.arange(n))
-    out = acc / jnp.maximum(l, 1e-30)[..., None]              # [B, H, Lc, D]
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+    out0 = jnp.zeros((b, h, lc, d), jnp.float32)
+    s0 = jnp.full((b, h, lc), NEG_INF, jnp.float32)
+    (out, _, _, _), _ = jax.lax.scan(step, (out0, s0, k, v), jnp.arange(n))
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)          # [B, Lc, H, D]
 
 
 def _qkv_spec(mesh: Mesh, axis_name: str, batch: int, heads: int) -> P:

@@ -70,11 +70,18 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         # kernel: at the long-context lengths ulysses exists for, plain
         # attention's [L, L] fp32 scores would defeat the point (measured
         # on one v5e: XLA attention stops compiling at seq 8192 while the
-        # kernel holds ~93% of its seq-1024 rate).
-        from tpu_on_k8s.ops.flash_attention import flash_attention
+        # kernel holds ~93% of its seq-1024 rate). Lengths no flash block
+        # divides (not a multiple of 128 beyond 512) keep the old XLA path
+        # rather than failing.
+        from tpu_on_k8s.ops.flash_attention import auto_block, flash_attention
 
-        out = flash_attention(seq_to_heads(q_), seq_to_heads(k_),
-                              seq_to_heads(v_), causal=causal)
+        try:
+            auto_block(q_.shape[1] * n)
+            attn = flash_attention
+        except ValueError:
+            attn = xla_attention
+        out = attn(seq_to_heads(q_), seq_to_heads(k_), seq_to_heads(v_),
+                   causal=causal)
         return heads_to_seq(out)
 
     return jax.shard_map(local, mesh=resolved, in_specs=(spec, spec, spec),
