@@ -9,7 +9,7 @@ import time
 
 from tpu_on_k8s.api.core import Pod, PodPhase
 from tpu_on_k8s.api.types import TaskType, TPUJob
-from tpu_on_k8s.client import KubeletSim
+from tpu_on_k8s.client import KubeletLoop
 from tpu_on_k8s.client.apiserver import ApiServer
 from tpu_on_k8s.client.rest import RestCluster
 from tpu_on_k8s.controller.tpujob import submit_job
@@ -28,25 +28,7 @@ def test_autoscaler_grows_via_log_scrape_over_rest():
     op.start()
 
     kubelet_client = RestCluster(srv.url)
-    kubelet = KubeletSim(kubelet_client)
-    stop = threading.Event()
-
-    def kubelet_loop():
-        ran = set()
-        while not stop.is_set():
-            for p in kubelet_client.list(Pod):
-                key = (p.metadata.name, p.metadata.uid)
-                if (key not in ran and p.status.phase == PodPhase.PENDING
-                        and p.metadata.deletion_timestamp is None):
-                    try:
-                        kubelet.run_pod(p.metadata.namespace, p.metadata.name)
-                        ran.add(key)
-                    except Exception:
-                        pass
-            stop.wait(0.02)
-
-    kt = threading.Thread(target=kubelet_loop, daemon=True)
-    kt.start()
+    kubelet = KubeletLoop(kubelet_client).start()
 
     user = RestCluster(srv.url)
     try:
@@ -68,14 +50,28 @@ def test_autoscaler_grows_via_log_scrape_over_rest():
                           if p.status.phase == PodPhase.RUNNING]) == 2,
              "2 running workers")
 
+        batch_counter = iter(range(10_000))
+
+        def log_until(latency, target_workers, what):
+            """Emit metric lines at a training-like cadence until the scaler
+            reacts — the observer samples the log tail on its own period, so
+            a burst of lines appended at once can be sampled as a single
+            observation (exactly how a real trainer's steady log behaves)."""
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                user.append_pod_log(
+                    "default", "nj-worker-0",
+                    f"[elastic-metrics] epoch=1 batch={next(batch_counter)} "
+                    f"latency={latency} accuracy=0.9")
+                if num_workers() == target_workers:
+                    return
+                time.sleep(0.15)
+            raise AssertionError(f"timed out waiting for {what}")
+
         # window 1 @2 hosts: the training process logs metric lines; the
         # scaling loop scrapes them via GET pods/log and grows to the next
         # slice-legal host count
-        for i in range(5):
-            user.append_pod_log(
-                "default", "nj-worker-0",
-                f"[elastic-metrics] epoch=1 batch={i} latency=1.0 accuracy=0.9")
-        wait(lambda: num_workers() == 4, "growth to 4 hosts")
+        log_until(1.0, 4, "growth to 4 hosts")
         assert (user.get(TPUJob, "default", "nj").spec.tpu_policy.topology
                 == "4x4")
 
@@ -83,15 +79,9 @@ def test_autoscaler_grows_via_log_scrape_over_rest():
         wait(lambda: len([p for p in user.list(Pod)
                           if p.status.phase == PodPhase.RUNNING]) == 4,
              "4 running workers")
-        for i in range(5):
-            user.append_pod_log(
-                "default", "nj-worker-0",
-                f"[elastic-metrics] epoch=1 batch={10 + i} latency=0.6 "
-                f"accuracy=0.9")
-        wait(lambda: num_workers() == 8, "growth to 8 hosts")
+        log_until(0.6, 8, "growth to 8 hosts")
     finally:
-        stop.set()
-        kt.join(timeout=2)
+        kubelet.stop()
         op.stop()
         for c in (user, kubelet_client):
             c.close()

@@ -15,7 +15,7 @@ import time
 from tpu_on_k8s.api import constants
 from tpu_on_k8s.api.core import Pod, PodPhase
 from tpu_on_k8s.api.types import TaskType, TPUJob
-from tpu_on_k8s.client import KubeletSim
+from tpu_on_k8s.client import KubeletLoop
 from tpu_on_k8s.client.apiserver import ApiServer
 from tpu_on_k8s.client.rest import RestCluster
 from tpu_on_k8s.controller.tpujob import submit_job
@@ -35,27 +35,7 @@ def test_preemption_checkpoint_rescale_over_rest():
     op.start()
 
     kubelet_client = RestCluster(srv.url)
-    kubelet = KubeletSim(kubelet_client)
-    stop = threading.Event()
-
-    def kubelet_loop():
-        ran = set()
-        while not stop.is_set():
-            for p in kubelet_client.list(Pod):
-                # key on uid: a recreated pod reuses its name and must be
-                # run again (real kubelets key on pod uid the same way)
-                if ((p.metadata.name, p.metadata.uid) not in ran
-                        and p.status.phase == PodPhase.PENDING
-                        and p.metadata.deletion_timestamp is None):
-                    try:
-                        kubelet.run_pod(p.metadata.namespace, p.metadata.name)
-                        ran.add((p.metadata.name, p.metadata.uid))
-                    except Exception:
-                        pass
-            stop.wait(0.02)
-
-    kt = threading.Thread(target=kubelet_loop, daemon=True)
-    kt.start()
+    kubelet = KubeletLoop(kubelet_client).start()
 
     # AIMaster-side checkpoint agent on its own connection
     agent_client = RestCluster(srv.url)
@@ -129,8 +109,7 @@ def test_preemption_checkpoint_rescale_over_rest():
 
         wait(new_gen_running, "4 workers at the new generation")
     finally:
-        stop.set()
-        kt.join(timeout=2)
+        kubelet.stop()
         op.stop()
         for c in (user, agent_client, kubelet_client):
             c.close()

@@ -20,7 +20,7 @@ from tpu_on_k8s.api.model_types import (
     Storage,
 )
 from tpu_on_k8s.api.types import TPUJob
-from tpu_on_k8s.client import KubeletSim
+from tpu_on_k8s.client import KubeletLoop
 from tpu_on_k8s.client.apiserver import ApiServer
 from tpu_on_k8s.client.rest import RestCluster
 from tpu_on_k8s.controller.tpujob import submit_job
@@ -40,34 +40,7 @@ def test_job_success_builds_model_image_over_rest():
     op.start()
 
     kubelet_client = RestCluster(srv.url)
-    kubelet = KubeletSim(kubelet_client)
-    stop = threading.Event()
-    succeed_all = threading.Event()
-
-    def kubelet_loop():
-        ran = set()
-        while not stop.is_set():
-            for p in kubelet_client.list(Pod):
-                key = (p.metadata.name, p.metadata.uid)
-                if (key not in ran and p.status.phase == PodPhase.PENDING
-                        and p.metadata.deletion_timestamp is None):
-                    try:
-                        kubelet.run_pod(p.metadata.namespace, p.metadata.name)
-                        ran.add(key)
-                    except Exception:
-                        pass
-                elif (succeed_all.is_set()
-                      and p.status.phase == PodPhase.RUNNING
-                      and p.metadata.deletion_timestamp is None):
-                    try:
-                        kubelet.succeed_pod(p.metadata.namespace,
-                                            p.metadata.name)
-                    except Exception:
-                        pass
-            stop.wait(0.02)
-
-    kt = threading.Thread(target=kubelet_loop, daemon=True)
-    kt.start()
+    kubelet = KubeletLoop(kubelet_client).start()
 
     user = RestCluster(srv.url)
     try:
@@ -91,7 +64,7 @@ def test_job_success_builds_model_image_over_rest():
         wait(lambda: len([p for p in user.list(Pod)
                           if p.status.phase == PodPhase.RUNNING]) >= 3,
              "job pods running")
-        succeed_all.set()  # everything that runs from now on completes
+        kubelet.auto_succeed = True  # everything that runs now completes
 
         # job succeeds → ModelVersion emitted → PV (cluster-scoped) + PVC +
         # build pod run through the same kubelet → image build succeeds
@@ -112,8 +85,7 @@ def test_job_success_builds_model_image_over_rest():
         assert (user.get(Model, "default", "m1").status.latest_image
                 == "reg.example/m1:v1")
     finally:
-        stop.set()
-        kt.join(timeout=2)
+        kubelet.stop()
         op.stop()
         for c in (user, kubelet_client):
             c.close()

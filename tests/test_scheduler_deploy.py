@@ -38,7 +38,7 @@ from tpu_on_k8s.api.types import (
     TPUJobSpec,
     TPUPolicy,
 )
-from tpu_on_k8s.client import KubeletSim
+from tpu_on_k8s.client import KubeletLoop
 from tpu_on_k8s.client.apiserver import ApiServer
 from tpu_on_k8s.client.cluster import InMemoryCluster
 from tpu_on_k8s.client.rest import RestCluster
@@ -345,28 +345,9 @@ def test_gang_contention_over_rest_admission_assigns_nodes(server):
     sched.run()
 
     kubelet_client = RestCluster(server.url)
-    kubelet = KubeletSim(kubelet_client)
-    stop = threading.Event()
-
-    def kubelet_loop():
-        ran = set()
-        while not stop.is_set():
-            for p in kubelet_client.list(Pod):
-                # a kubelet only runs pods BOUND to a node by the scheduler
-                if (p.spec.node_name
-                        and (p.metadata.name, p.metadata.uid) not in ran
-                        and p.status.phase == PodPhase.PENDING
-                        and p.metadata.deletion_timestamp is None):
-                    try:
-                        kubelet.run_pod(p.metadata.namespace, p.metadata.name,
-                                        node=p.spec.node_name)
-                        ran.add((p.metadata.name, p.metadata.uid))
-                    except Exception:
-                        pass
-            stop.wait(0.02)
-
-    kt = threading.Thread(target=kubelet_loop, daemon=True)
-    kt.start()
+    # a kubelet only runs pods BOUND to a node by the scheduler
+    kubelet_loop = KubeletLoop(kubelet_client, scheduled_only=True).start()
+    kubelet = kubelet_loop.sim
 
     user = RestCluster(server.url)
     try:
@@ -417,8 +398,7 @@ def test_gang_contention_over_rest_admission_assigns_nodes(server):
             time.sleep(0.1)
         assert b_nodes == ["v5e8-s0-h0", "v5e8-s0-h1"], b_nodes
     finally:
-        stop.set()
-        kt.join(timeout=2)
+        kubelet_loop.stop()
         sched.stop()
         op.stop()
         for c in (user, sched_client, kubelet_client):

@@ -68,7 +68,7 @@ def bench_submit_to_first_step(n_jobs: int = 20) -> dict:
 
     from tpu_on_k8s.api.core import Pod, PodPhase
     from tpu_on_k8s.api.types import TPUJob
-    from tpu_on_k8s.client import KubeletSim
+    from tpu_on_k8s.client import KubeletLoop
     from tpu_on_k8s.client.apiserver import ApiServer
     from tpu_on_k8s.client.rest import RestCluster
     from tpu_on_k8s.controller.tpujob import submit_job
@@ -83,29 +83,9 @@ def bench_submit_to_first_step(n_jobs: int = 20) -> dict:
     op = Operator(args, cluster=RestCluster(srv.url))
     op.start()
     kubelet_client = RestCluster(srv.url)
-    kubelet = KubeletSim(kubelet_client)
-    stop = threading.Event()
-
-    def kubelet_loop() -> None:
-        """Run every pending pod as soon as it appears (an idle cluster —
-        the delay measured is pure controller latency, like envtest)."""
-        ran = set()
-        while not stop.is_set():
-            for p in kubelet_client.list(Pod):
-                # key on uid: a recreated pod reuses its name and must be
-                # run again (real kubelets key on pod uid the same way)
-                if ((p.metadata.name, p.metadata.uid) not in ran
-                        and p.status.phase == PodPhase.PENDING
-                        and p.metadata.deletion_timestamp is None):
-                    try:
-                        kubelet.run_pod(p.metadata.namespace, p.metadata.name)
-                        ran.add((p.metadata.name, p.metadata.uid))
-                    except Exception:
-                        pass
-            stop.wait(0.02)
-
-    kt = threading.Thread(target=kubelet_loop, daemon=True)
-    kt.start()
+    # run every pending pod as soon as it appears (an idle cluster — the
+    # delay measured is pure controller latency, like envtest)
+    kubelet = KubeletLoop(kubelet_client).start()
 
     with open(os.path.join(REPO, "config/samples/mnist_cnn.yaml")) as f:
         sample = yaml.safe_load(f)
@@ -132,8 +112,7 @@ def bench_submit_to_first_step(n_jobs: int = 20) -> dict:
         delays = list(op.metrics.histograms.get(
             "first_pod_launch_delay_seconds", []))
     finally:
-        stop.set()
-        kt.join(timeout=2)
+        kubelet.stop()
         op.stop()
         user.close()
         kubelet_client.close()

@@ -42,6 +42,7 @@ def run_variant(spec: str) -> None:
     mu = opts.pop("mu", "bf16")              # bf16 | fp32
     chunks = int(opts.pop("chunks", 0))
     unroll = int(opts.pop("unroll", 1))
+    gqa = opts.pop("gqa", "0") == "1"
     if opts:
         raise ValueError(f"unknown keys {list(opts)}")
 
@@ -53,6 +54,7 @@ def run_variant(spec: str) -> None:
            "attn_block_q": bq,
            "attn_block_k": bk,
            "scan_unroll": unroll,
+           "attn_native_gqa": gqa,
            "remat": remat != "off",
            "remat_policy": remat if remat != "off" else "full"})
     devices = jax.devices()

@@ -24,4 +24,4 @@ from tpu_on_k8s.client.cluster import (
     NotFoundError,
     WatchEvent,
 )
-from tpu_on_k8s.client.testing import KubeletSim
+from tpu_on_k8s.client.testing import KubeletLoop, KubeletSim

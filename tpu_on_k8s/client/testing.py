@@ -95,3 +95,64 @@ class KubeletSim:
     def evict_pod(self, namespace: str, name: str) -> Pod:
         """Node-pressure eviction (retryable failure class, failover.go:106-113)."""
         return self.terminate_pod(namespace, name, 137, reason="Evicted", phase=PodPhase.FAILED)
+
+
+class KubeletLoop:
+    """Background kubelet: polls for Pending pods and runs them, keyed on pod
+    uid so a recreated pod (same name, new uid) runs again — real kubelets key
+    on uid the same way. ``scheduled_only=True`` models a kubelet that only
+    runs pods a scheduler has bound to a node (the gang-admission tests);
+    ``auto_succeed=True`` completes pods as soon as they run (build-pod /
+    batch-job sims). Works against any cluster backend (in-memory or REST).
+    """
+
+    def __init__(self, cluster, *, scheduled_only: bool = False,
+                 auto_succeed: bool = False, poll_seconds: float = 0.02):
+        import threading
+
+        self.sim = KubeletSim(cluster)
+        self.cluster = cluster
+        self.scheduled_only = scheduled_only
+        self.auto_succeed = auto_succeed
+        self.poll_seconds = poll_seconds
+        self._stop = threading.Event()
+        self._thread: Optional[object] = None
+
+    def start(self) -> "KubeletLoop":
+        import threading
+
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="kubelet-loop")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        ran = set()
+        while not self._stop.is_set():
+            for p in self.cluster.list(Pod):
+                if p.metadata.deletion_timestamp is not None:
+                    continue
+                key = (p.metadata.name, p.metadata.uid)
+                if (key not in ran and p.status.phase == PodPhase.PENDING
+                        and (p.spec.node_name or not self.scheduled_only)):
+                    try:
+                        self.sim.run_pod(p.metadata.namespace,
+                                        p.metadata.name,
+                                        node=p.spec.node_name or "node-0")
+                        ran.add(key)
+                    except Exception:  # noqa: BLE001 — races with reconciles
+                        pass
+                elif (self.auto_succeed
+                      and p.status.phase == PodPhase.RUNNING):
+                    try:
+                        self.sim.succeed_pod(p.metadata.namespace,
+                                             p.metadata.name)
+                    except Exception:  # noqa: BLE001
+                        pass
+            self._stop.wait(self.poll_seconds)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

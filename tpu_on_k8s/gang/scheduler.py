@@ -490,8 +490,12 @@ class SliceGangAdmission:
         if self.pools:
             self._release_stale(namespace)
         admitted = []
+        # one pod list per pass (not per group): over the REST backend each
+        # list is an HTTP round-trip and sync runs on a 100ms period
+        by_group = self._pods_by_group(namespace)
         for pg in self.cluster.list(PodGroup, namespace):
-            pods = self._group_pods(pg)
+            pods = by_group.get(
+                (pg.metadata.namespace, pg.metadata.name), [])
             if (pg.status.phase == "Running"
                     and all(p.spec.node_name for p in pods)):
                 continue
@@ -530,14 +534,22 @@ class SliceGangAdmission:
                 self._assign_node(pod, node)
         return admitted
 
-    def _group_pods(self, pg: PodGroup) -> List[Pod]:
-        out = []
-        for pod in self.cluster.list(Pod, pg.metadata.namespace):
-            if pod.metadata.annotations.get(
-                    constants.ANNOTATION_GANG_GROUP_NAME) == pg.metadata.name:
-                out.append(pod)
-        out.sort(key=lambda p: p.metadata.name)
+    def _pods_by_group(self, namespace: Optional[str]) -> Dict[tuple, List[Pod]]:
+        """All gang-annotated pods, grouped by (namespace, group), each group
+        sorted by pod name."""
+        out: Dict[tuple, List[Pod]] = {}
+        for pod in self.cluster.list(Pod, namespace):
+            group = pod.metadata.annotations.get(
+                constants.ANNOTATION_GANG_GROUP_NAME)
+            if group:
+                out.setdefault((pod.metadata.namespace, group), []).append(pod)
+        for pods in out.values():
+            pods.sort(key=lambda p: p.metadata.name)
         return out
+
+    def _group_pods(self, pg: PodGroup) -> List[Pod]:
+        return self._pods_by_group(pg.metadata.namespace).get(
+            (pg.metadata.namespace, pg.metadata.name), [])
 
     def _assign_node(self, pod: Pod, node: str) -> None:
         if pod.spec.node_name:

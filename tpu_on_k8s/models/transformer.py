@@ -124,16 +124,25 @@ def _dots_and_kernels_saveable(prim, *args, **params) -> bool:
         prim, *args, **params)
 
 
-def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
-    """Rotary position embedding. x: [B, L, H, Dh]; positions: [B, L]."""
-    half = x.shape[-1] // 2
+def _rope_tables(positions: jnp.ndarray, half: int, theta: float):
+    """cos/sin tables [B, L, half] shared by both rope layouts."""
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, L, half]
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rope_rotate(x: jnp.ndarray, cos: jnp.ndarray,
+                 sin: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotary position embedding. x: [B, L, H, Dh]; positions: [B, L]."""
+    cos, sin = _rope_tables(positions, x.shape[-1] // 2, theta)
+    return _rope_rotate(x, cos[:, :, None, :], sin[:, :, None, :])
 
 
 def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -219,14 +228,8 @@ def make_norm(cfg: TransformerConfig, name: str) -> nn.Module:
 def rope_bhld(x: jnp.ndarray, positions: jnp.ndarray,
               theta: float) -> jnp.ndarray:
     """Rotary embedding for heads-leading x: [B, H, L, Dh]; positions [B, L]."""
-    half = x.shape[-1] // 2
-    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, L, half]
-    cos = jnp.cos(angles)[:, None, :, :]                       # [B, 1, L, half]
-    sin = jnp.sin(angles)[:, None, :, :]
-    x1, x2 = x[..., :half], x[..., half:]
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(x.dtype)
+    cos, sin = _rope_tables(positions, x.shape[-1] // 2, theta)
+    return _rope_rotate(x, cos[:, None, :, :], sin[:, None, :, :])
 
 
 class _HeadProj(nn.Module):
