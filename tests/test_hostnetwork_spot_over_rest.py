@@ -1,0 +1,149 @@
+"""Hostnetwork and spot-task flavors over the wire.
+
+Wire-sensitive behaviors the in-memory tests can't pin:
+* hostnetwork port release is driven by the DELETED watch event — over REST
+  that means the informer stream, and a port must return to the allocator
+  (no collisions, no leaks) only after the event arrives;
+* rich spec types (SpotTaskSpec, ports, priority classes) must survive the
+  camelCase JSON round-trip through the ApiServer.
+"""
+import time
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodTemplateSpec,
+)
+from tpu_on_k8s.api.types import (
+    RunPolicy,
+    SpotTaskSpec,
+    TaskSpec,
+    TaskType,
+    TPUJob,
+    TPUJobSpec,
+    TPUPolicy,
+)
+from tpu_on_k8s.client import KubeletLoop
+from tpu_on_k8s.client.apiserver import ApiServer
+from tpu_on_k8s.client.rest import RestCluster
+from tpu_on_k8s.controller.tpujob import submit_job
+from tpu_on_k8s.main import Operator, build_parser
+
+
+def _wait(pred, what, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _hostnet_job(name):
+    template = PodTemplateSpec(spec=PodSpec(containers=[
+        Container(name="tpu", image="i")]))
+    return TPUJob(
+        metadata=ObjectMeta(
+            name=name,
+            annotations={constants.ANNOTATION_NETWORK_MODE: "host"}),
+        spec=TPUJobSpec(
+            tasks={TaskType.WORKER: TaskSpec(num_tasks=1, template=template)},
+            run_policy=RunPolicy(),
+            tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice",
+                                 topology="1x1"),
+        ))
+
+
+def test_hostnetwork_ports_allocate_and_release_over_rest():
+    srv = ApiServer().start()
+    op = Operator(
+        build_parser().parse_args(
+            ["--cluster-backend", "rest", "--api-server", srv.url,
+             "--no-leader-elect"]),
+        cluster=RestCluster(srv.url))
+    op.start()
+    kubelet = KubeletLoop(RestCluster(srv.url)).start()
+    user = RestCluster(srv.url)
+    try:
+        submit_job(user, _hostnet_job("hn-a"))
+        _wait(lambda: user.try_get(Pod, "default", "hn-a-worker-0")
+              is not None, "hn-a pod")
+        pod_a = user.get(Pod, "default", "hn-a-worker-0")
+        assert pod_a.spec.host_network
+        port_a = pod_a.spec.containers[0].ports[0].container_port
+        assert 20000 <= port_a < 30000
+
+        # a second job must draw a different port while the first lives
+        submit_job(user, _hostnet_job("hn-b"))
+        _wait(lambda: user.try_get(Pod, "default", "hn-b-worker-0")
+              is not None, "hn-b pod")
+        port_b = (user.get(Pod, "default", "hn-b-worker-0")
+                  .spec.containers[0].ports[0].container_port)
+        assert port_b != port_a
+
+        # deleting the first job must release its port via the DELETED watch
+        # event (the informer path) — observable as the allocator no longer
+        # holding it
+        user.delete(TPUJob, "default", "hn-a")
+        _wait(lambda: user.try_get(Pod, "default", "hn-a-worker-0") is None,
+              "hn-a pod gone")
+        _wait(lambda: op.engine.port_allocator.in_use_count() == 1,
+              "port released on DELETED event")
+    finally:
+        kubelet.stop()
+        op.stop()
+        user.close()
+        srv.stop()
+
+
+def test_spot_task_spec_round_trips_and_applies_over_rest():
+    srv = ApiServer().start()
+    op = Operator(
+        build_parser().parse_args(
+            ["--cluster-backend", "rest", "--api-server", srv.url,
+             "--no-leader-elect"]),
+        cluster=RestCluster(srv.url))
+    op.start()
+    user = RestCluster(srv.url)
+    try:
+        template = PodTemplateSpec(spec=PodSpec(containers=[
+            Container(name="tpu", image="i")]))
+        job = TPUJob(
+            metadata=ObjectMeta(name="spotty"),
+            spec=TPUJobSpec(
+                tasks={TaskType.WORKER: TaskSpec(
+                    num_tasks=4, template=template,
+                    spot_task_spec=SpotTaskSpec(
+                        num_spot_tasks=2,
+                        priority_class_name="spot-priority",
+                        labels={"capacity-type": "spot"}))},
+                run_policy=RunPolicy(),
+                tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice",
+                                     topology="2x4"),
+            ))
+        submit_job(user, job)
+        # the spec survived the camelCase wire round-trip
+        got = user.get(TPUJob, "default", "spotty")
+        spot = got.spec.tasks[TaskType.WORKER].spot_task_spec
+        assert spot.num_spot_tasks == 2
+        assert spot.priority_class_name == "spot-priority"
+
+        def pods():
+            return [p for p in user.list(Pod)
+                    if p.metadata.labels.get(constants.LABEL_JOB_NAME)
+                    == "spotty"]
+
+        _wait(lambda: len(pods()) == 4, "4 worker pods")
+        spot_pods = sorted(p.metadata.name for p in pods()
+                           if p.spec.priority_class_name == "spot-priority")
+        assert spot_pods == ["spotty-worker-2", "spotty-worker-3"]
+        for p in pods():
+            if p.metadata.name in spot_pods:
+                assert p.metadata.labels.get("capacity-type") == "spot"
+    finally:
+        op.stop()
+        user.close()
+        srv.stop()
