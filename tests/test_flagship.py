@@ -10,7 +10,7 @@ from tpu_on_k8s.models.transformer import (
 )
 from tpu_on_k8s.parallel.mesh import AXIS_FSDP, AXIS_MODEL, MeshConfig, create_mesh
 from tpu_on_k8s.train.trainer import (
-    Trainer, cross_entropy_loss, default_optimizer,
+    Trainer, chunked_cross_entropy, cross_entropy_loss, default_optimizer,
 )
 
 
@@ -78,6 +78,28 @@ class TestModelMath:
         mask = jnp.array([[1.0, 0.0]])
         assert jnp.allclose(cross_entropy_loss(logits, targets, mask),
                             jnp.log(4.0), atol=1e-5)
+
+    def test_chunked_cross_entropy_matches_dense(self):
+        key = jax.random.key(3)
+        feats = jax.random.normal(key, (2, 8, 16))
+        head = jax.random.normal(jax.random.key(4), (16, 32))
+        targets = jax.random.randint(jax.random.key(5), (2, 8), 0, 32)
+        logits = feats @ head
+        dense = cross_entropy_loss(logits, targets)
+        chunked = chunked_cross_entropy(feats, head, targets, n_chunks=4)
+        assert jnp.allclose(dense, chunked, atol=1e-5)
+
+    def test_chunked_cross_entropy_mask_matches_dense(self):
+        """ADVICE r3: loss_chunks must not foreclose masked-token training."""
+        feats = jax.random.normal(jax.random.key(6), (2, 8, 16))
+        head = jax.random.normal(jax.random.key(7), (16, 32))
+        targets = jax.random.randint(jax.random.key(8), (2, 8), 0, 32)
+        mask = (jax.random.uniform(jax.random.key(9), (2, 8)) > 0.4)
+        dense = cross_entropy_loss(feats @ head, targets,
+                                   mask.astype(jnp.float32))
+        chunked = chunked_cross_entropy(feats, head, targets, n_chunks=4,
+                                        mask=mask)
+        assert jnp.allclose(dense, chunked, atol=1e-5)
 
 
 class TestShardedTraining:

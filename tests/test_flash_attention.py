@@ -150,6 +150,15 @@ def test_indivisible_seq_raises():
         flash_attention(q, k, v, block_q=128, block_k=128)
 
 
+def test_gqa_head_mismatch_raises():
+    """ADVICE r3: H % Hkv != 0 must be a loud error, not silent
+    floor-division index-map misrouting."""
+    q, _, _ = _qkv(b=1, l=128, h=4, d=32, seed=1)
+    _, k, v = _qkv(b=1, l=128, h=3, d=32, seed=2)
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_attention(q, k, v, causal=True)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_gradients_match_xla(causal):
     q, k, v = _qkv(b=1, l=256, h=2, d=32)

@@ -238,8 +238,10 @@ def test_restarted_scheduler_recovers_held_slices():
     assert podgroup_name(job, TaskType.WORKER) in first.sync()
     assert first.free_slices("v5e8") == 0
 
-    # scheduler restart: fresh process, same cluster state
+    # scheduler restart: fresh process, same cluster state. Recovery is
+    # eager — free_slices/metrics must be correct BEFORE any sync() runs
     second = SliceGangAdmission(cluster, pools=[pool])
+    assert second.free_slices("v5e8") == 0
     # a competing gang arrives and must NOT get the held slice
     rival = _job("rival")
     rival = cluster.create(rival)
@@ -255,6 +257,40 @@ def test_restarted_scheduler_recovers_held_slices():
     # when the holder's podgroups go away, the slice frees and rival admits
     gs.delete_podgroups(job)
     assert podgroup_name(rival, TaskType.WORKER) in second.sync()
+
+
+def test_recovery_pool_name_prefix_is_not_confused():
+    """Node-name recovery must match the exact per-pool pattern. A pool named
+    ``a`` must not claim node ``a-s1-h1-s0-h0`` (which belongs to the
+    pathological-but-legal pool ``a-s1-h1``): the old prefix+int parse read it
+    as slice 1 of pool ``a`` and double-deducted."""
+    cluster = InMemoryCluster()
+    gs = SliceGangScheduler(cluster, per_role=True)
+    pools = [NodePool("a", "tpu-v5-lite-podslice", "2x4", num_slices=2),
+             NodePool("a-s1-h1", "tpu-v5-lite-podslice", "2x4", num_slices=1)]
+
+    job = _job("held")
+    job = cluster.create(job)
+    gs.create_podgroups(job)
+    for i in range(2):
+        pod = Pod(metadata=ObjectMeta(name=f"held-worker-{i}"),
+                  spec=PodSpec(containers=[Container(name="c", image="i")]))
+        gs.bind_pod(job, pod, TaskType.WORKER)
+        cluster.create(pod)
+    wpg = podgroup_name(job, TaskType.WORKER)
+
+    def mark_running(pg):
+        pg.status.phase = "Running"
+    cluster.update_with_retry(PodGroup, "default", wpg, mark_running,
+                              subresource="status")
+    for p in cluster.list(Pod, None):  # bind onto the pathological pool
+        def set_node(pod, node=f"{pools[1].name}-s0-h{p.metadata.name[-1]}"):
+            pod.spec.node_name = node
+        cluster.update_with_retry(Pod, "default", p.metadata.name, set_node)
+
+    restarted = SliceGangAdmission(cluster, pools=pools)
+    assert restarted.free_slices("a-s1-h1") == 0   # the true holder
+    assert restarted.free_slices("a") == 2         # must NOT be charged
 
 
 def test_rescale_reallocates_slices_and_readmits_new_pods():

@@ -32,6 +32,7 @@ import json
 import ssl
 import threading
 import time
+import uuid
 from http.client import HTTPConnection, HTTPSConnection
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 from urllib.parse import quote, urlparse
@@ -275,8 +276,18 @@ class RestCluster:
             type=etype, reason=reason, message=message,
             first_timestamp=now, last_timestamp=now)
         ev.metadata.namespace = ns
-        ev.metadata.name = f"{obj.metadata.name}.{time.monotonic_ns():x}"
-        self.create(ev)
+        # monotonic_ns is process-local (manager and scheduler can collide)
+        # and may be coarse — salt with randomness and retry the residual race
+        for attempt in range(3):
+            ev.metadata.name = (f"{obj.metadata.name}."
+                                f"{time.monotonic_ns():x}."
+                                f"{uuid.uuid4().hex[:6]}")
+            try:
+                self.create(ev)
+                return
+            except AlreadyExistsError:
+                if attempt == 2:  # never drop an event silently
+                    raise
 
     def list_events(self, namespace: Optional[str] = None) -> List[tuple]:
         """Events as tuples; ``namespace=None`` spans all namespaces (the

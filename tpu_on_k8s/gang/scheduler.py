@@ -21,6 +21,7 @@ complete, which is what tests and the local driver observe.
 """
 from __future__ import annotations
 
+import re
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -374,30 +375,51 @@ class SliceGangAdmission:
             p.name: list(range(p.num_slices)) for p in (pools or [])}
         self._pool_by_name = {p.name: p for p in (pools or [])}
         self._recovered = not self.pools  # nothing to recover without pools
+        # recover eagerly: free_slices()/metrics must never observe a
+        # fully-free inventory while Running gangs still hold slices. A
+        # transient API error here must not crash the process — the
+        # scheduler loop's sync() retries on its next tick.
+        if self.pools:
+            try:
+                self._ensure_recovered()
+            except Exception:
+                from tpu_on_k8s.utils.logging import get_logger
+                get_logger("slicescheduler").warning(
+                    "allocation recovery failed at startup; retrying in "
+                    "sync()", exc_info=True)
+
+    def _ensure_recovered(self) -> None:
+        if not self._recovered:
+            self._recover_allocations()
+            self._recovered = True
 
     def _recover_allocations(self) -> None:
         """Rebuild slice ownership after a scheduler restart: a Running
         slice-gang podgroup's pods carry pool-encoded node names
         (``{pool}-s{idx}-h{h}``) — without this, a restarted scheduler would
         re-offer held slices and double-book hosts."""
+        # one pod list for the whole pass (not per group): over the REST
+        # backend each list is an HTTP round-trip
+        by_group = self._pods_by_group(None)
         for pg in self.cluster.list(PodGroup, None):
             if (pg.status.phase != "Running"
                     or pg.metadata.labels.get(LABEL_SLICE_GANG) != "true"):
                 continue
             key = f"{pg.metadata.namespace}/{pg.metadata.name}"
             held: List[tuple] = []
-            for pod in self._group_pods(pg):
+            for pod in by_group.get(
+                    (pg.metadata.namespace, pg.metadata.name), []):
                 node = pod.spec.node_name or ""
                 for pool in self.pools:
-                    prefix = f"{pool.name}-s"
-                    if node.startswith(prefix):
-                        idx_str = node[len(prefix):].partition("-h")[0]
-                        try:
-                            alloc = (pool.name, int(idx_str))
-                        except ValueError:
-                            continue
+                    # exact per-pool pattern: a prefix match would let pool
+                    # "tpu" claim nodes of pool "tpu-v5e"
+                    m = re.fullmatch(
+                        rf"{re.escape(pool.name)}-s(\d+)-h\d+", node)
+                    if m:
+                        alloc = (pool.name, int(m.group(1)))
                         if alloc not in held:
                             held.append(alloc)
+                        break
             with self._lock:
                 if held and key not in self._allocations:
                     self._allocations[key] = held
@@ -407,6 +429,7 @@ class SliceGangAdmission:
 
     # ----------------------------------------------------------- slice capacity
     def free_slices(self, pool_name: str) -> int:
+        self._ensure_recovered()  # loud, never a wrong fully-free answer
         with self._lock:
             return len(self._free.get(pool_name, []))
 
@@ -484,9 +507,7 @@ class SliceGangAdmission:
         an elastic rescale recreates pods under the same (still-Running)
         group, possibly with a different topology; those pods need nodes
         from a (possibly re-)allocated slice set."""
-        if not self._recovered:
-            self._recover_allocations()
-            self._recovered = True
+        self._ensure_recovered()  # retries a failed startup recovery
         if self.pools:
             self._release_stale(namespace)
         admitted = []

@@ -131,6 +131,10 @@ def _fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool,
     the index maps route q-head h to kv-head h // rep — no repeated K/V ever
     materialises in HBM) → (out [B, H, L, D], lse [B, H, L])."""
     b, h, l, d = q.shape
+    if h % k.shape[1]:
+        raise ValueError(
+            f"GQA head mismatch: {h} q heads not divisible by "
+            f"{k.shape[1]} kv heads")
     rep = h // k.shape[1]
     bq = _block(block_q, l)
     bk = _block(block_k, l)
@@ -257,6 +261,9 @@ def _bwd(q, k, v, o, lse, do, causal: bool, block_q: int, block_k: int,
          g_lse=None):
     b, h, l, d = q.shape
     hkv = k.shape[1]
+    if h % hkv:
+        raise ValueError(
+            f"GQA head mismatch: {h} q heads not divisible by {hkv} kv heads")
     rep = h // hkv
     bq = _block(block_q, l)
     bk = _block(block_k, l)

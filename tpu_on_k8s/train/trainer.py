@@ -52,16 +52,18 @@ def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray,
 
 
 def chunked_cross_entropy(feats: jnp.ndarray, head: jnp.ndarray,
-                          targets: jnp.ndarray, n_chunks: int = 8) -> jnp.ndarray:
+                          targets: jnp.ndarray, n_chunks: int = 8,
+                          mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Mean next-token CE without ever materialising [B, L, V] logits.
 
     feats [B, L, D] (post-final-norm hidden states, from
     ``Transformer.apply(..., method="features")``), head [D, V], targets
-    [B, L]. Tokens are processed in ``n_chunks`` sequence chunks under
-    ``jax.lax.scan`` + ``jax.checkpoint``: each chunk computes its logits,
-    reduces to (lse - gold), and discards them; backward recomputes per
-    chunk. Peak HBM for the loss drops from O(B·L·V) to O(B·L·V / n_chunks)
-    at the cost of one extra head matmul in backward.
+    [B, L], optional mask [B, L] (1 = count the token — same semantics as
+    ``cross_entropy_loss``). Tokens are processed in ``n_chunks`` sequence
+    chunks under ``jax.lax.scan`` + ``jax.checkpoint``: each chunk computes
+    its logits, reduces to (lse - gold), and discards them; backward
+    recomputes per chunk. Peak HBM for the loss drops from O(B·L·V) to
+    O(B·L·V / n_chunks) at the cost of one extra head matmul in backward.
     """
     b, l, d = feats.shape
     n = b * l
@@ -70,17 +72,20 @@ def chunked_cross_entropy(feats: jnp.ndarray, head: jnp.ndarray,
     chunk = n // n_chunks
     fl = feats.reshape(n_chunks, chunk, d)
     tg = targets.reshape(n_chunks, chunk)
+    mk = (jnp.ones((n_chunks, chunk), jnp.float32) if mask is None
+          else mask.reshape(n_chunks, chunk).astype(jnp.float32))
 
     @jax.checkpoint
     def body(carry, xs):
-        f, t = xs
+        f, t, m = xs
         logits = jnp.dot(f, head, preferred_element_type=jnp.float32)
         lse = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, t[:, None], axis=-1)[:, 0]
-        return carry + jnp.sum(lse - gold), None
+        return carry + jnp.sum((lse - gold) * m), None
 
-    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (fl, tg))
-    return total / n
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (fl, tg, mk))
+    denom = n if mask is None else jnp.maximum(jnp.sum(mk), 1.0)
+    return total / denom
 
 
 def default_optimizer(learning_rate: float = 3e-4,
