@@ -37,7 +37,9 @@ def _load_kustomize_tree(entry: Path):
 def test_default_kustomization_resolves_and_parses():
     docs = _load_kustomize_tree(CONFIG / "default")
     kinds = [d["kind"] for d in docs]
-    assert kinds.count("CustomResourceDefinition") == 3
+    # TPUJob, Model, ModelVersion + the kruise-analog ContainerRecreateRequest
+    assert kinds.count("CustomResourceDefinition") == 4
+    assert "DaemonSet" in kinds  # the CRR node agent (config/nodeagent/)
     assert "Deployment" in kinds and "ServiceAccount" in kinds
     assert "Role" in kinds and "RoleBinding" in kinds  # leader election
     # reference's 16-file RBAC surface: aggregated editor/viewer per CRD

@@ -1,0 +1,142 @@
+"""The CRR node agent — the OpenKruise-daemon role as a deployable actor.
+
+``NodeAgentLoop`` watches ``ContainerRecreateRequest`` objects over its own
+cluster connection and executes them against the node's container runtime —
+which, from the API server's point of view, is the pod-status surface the
+kubelet owns. With it running, the operator's ``CRRRestarter``
+(`tpu_on_k8s/controller/failover.py`) never forges pod status; that
+separation is what the reference buys by delegating in-place restarts to
+kruise's node daemon (controllers/common/failover.go:210-307).
+
+Deployed per node by ``config/nodeagent/daemonset.yaml`` (entrypoint:
+``python -m tpu_on_k8s.main --node-agent-only --node-name $(NODE_NAME)``)
+under its own ServiceAccount — the ONLY role RBAC grants ``pods/status``
+writes to. The container runtime is an injectable seam: the default is the
+``KubeletSim`` status-write surface (tests / local driver / simulated
+clusters); a real-CRI shim implements the same ``recreate_containers``
+signature.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from tpu_on_k8s.api import crr as crr_api
+from tpu_on_k8s.api.core import Pod, utcnow
+from tpu_on_k8s.api.crr import ContainerRecreateRequest
+from tpu_on_k8s.client.cluster import ConflictError, NotFoundError
+from tpu_on_k8s.client.testing import KubeletSim
+
+
+class NodeAgentLoop:
+    """Honors ``ContainerRecreateRequest`` objects (the kruise-daemon side
+    of reference failover.go:210-307):
+
+    * a Pending CRR whose pod exists (and, for a node-scoped agent, is bound
+      to this node) transitions ``Recreating`` → container restart →
+      ``Succeeded`` + completion_time;
+    * a CRR naming a missing pod — or one whose pod uid no longer matches
+      the CRR's pod-uid label — is marked ``Failed`` (the operator falls
+      back to delete+recreate on seeing it); the uid is ALSO re-verified
+      inside the restart write itself, so a pod replaced mid-flight can
+      never be forged to Running;
+    * finished CRRs the operator never collected are reaped after
+      ``ttl_seconds_after_finished`` (kruise's TTL reaper).
+
+    ``node_name=None`` serves every node — one agent standing in for the
+    whole DaemonSet, which is what single-process tests and the local
+    driver run.
+    """
+
+    def __init__(self, cluster, *, node_name: Optional[str] = None,
+                 poll_seconds: float = 0.02, runtime=None):
+        self.cluster = cluster
+        self.runtime = runtime if runtime is not None else KubeletSim(cluster)
+        self.node_name = node_name
+        self.poll_seconds = poll_seconds
+        self.executed = 0  # restarts this agent performed (observability)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "NodeAgentLoop":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="node-agent")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    # ------------------------------------------------------------------ engine
+    def _set_phase(self, req: ContainerRecreateRequest, phase: str,
+                   message: str = "") -> bool:
+        def mutate(r: ContainerRecreateRequest) -> None:
+            r.status.phase = phase
+            r.status.message = message
+            if phase in (crr_api.PHASE_SUCCEEDED, crr_api.PHASE_FAILED):
+                r.status.completion_time = utcnow()
+
+        try:
+            self.cluster.update_with_retry(
+                ContainerRecreateRequest, req.metadata.namespace,
+                req.metadata.name, mutate, subresource="status")
+            return True
+        except NotFoundError:
+            return False  # operator collected/cancelled it mid-flight
+
+    def _handle(self, req: ContainerRecreateRequest) -> None:
+        ns = req.metadata.namespace
+        if crr_api.finished(req):
+            ttl = req.spec.ttl_seconds_after_finished
+            done = req.status.completion_time
+            if (ttl is not None and done is not None
+                    and (utcnow() - done).total_seconds() >= ttl):
+                try:
+                    self.cluster.delete(ContainerRecreateRequest, ns,
+                                        req.metadata.name)
+                except NotFoundError:
+                    pass
+            return
+        pod = self.cluster.try_get(Pod, ns, req.spec.pod_name)
+        want_uid = req.metadata.labels.get(crr_api.LABEL_CRR_POD_UID)
+        if pod is None or (want_uid and pod.metadata.uid != want_uid):
+            self._set_phase(req, crr_api.PHASE_FAILED,
+                            "target pod missing or replaced")
+            return
+        if self.node_name is not None and pod.spec.node_name != self.node_name:
+            return  # another node's daemon owns this one
+        if req.status.phase != crr_api.PHASE_RECREATING:
+            if not self._set_phase(req, crr_api.PHASE_RECREATING):
+                return
+        try:
+            # expect_uid re-verifies the incarnation INSIDE the retried
+            # write: a pod deleted+recreated between the check above and
+            # this call raises NotFound instead of forging the new pod
+            self.runtime.recreate_containers(
+                ns, req.spec.pod_name, req.spec.containers,
+                expect_uid=want_uid or pod.metadata.uid)
+        except NotFoundError:
+            self._set_phase(req, crr_api.PHASE_FAILED,
+                            "pod deleted or replaced mid-restart")
+            return
+        self.executed += 1
+        self._set_phase(req, crr_api.PHASE_SUCCEEDED)
+
+    def sync_once(self) -> None:
+        """One pull-based pass (tests drive this directly for determinism)."""
+        for req in self.cluster.list(ContainerRecreateRequest):
+            try:
+                self._handle(req)
+            except (ConflictError, NotFoundError):
+                pass  # racing the operator's collect/cancel — next pass settles
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sync_once()
+            except Exception:  # noqa: BLE001 — the daemon must survive blips
+                pass
+            self._stop.wait(self.poll_seconds)

@@ -24,6 +24,7 @@ from tpu_on_k8s.api.core import (
     ResourceQuota,
     Service,
 )
+from tpu_on_k8s.api.crr import ContainerRecreateRequest
 from tpu_on_k8s.api.model_types import Model, ModelVersion
 from tpu_on_k8s.api.types import TPUJob
 
@@ -82,6 +83,9 @@ def _build() -> Tuple[Dict[str, ResourceType], Dict[Tuple[str, str], ResourceTyp
         ResourceType("Lease", Lease, "coordination.k8s.io", "v1", "leases"),
         ResourceType("PodGroup", PodGroup, "scheduling.distributed.tpu.io",
                      "v1beta1", "podgroups"),
+        ResourceType("ContainerRecreateRequest", ContainerRecreateRequest,
+                     "apps.distributed.tpu.io", "v1alpha1",
+                     "containerrecreaterequests"),
         ResourceType(constants.KIND_TPUJOB, TPUJob, tpu_group, tpu_ver,
                      "tpujobs"),
         ResourceType(constants.KIND_MODEL, Model, tpu_group, tpu_ver, "models"),

@@ -1,9 +1,12 @@
-"""Kubelet/scheduler simulation for tests and local runs.
+"""Kubelet simulation for tests and local runs.
 
 The reference's intended envtest strategy runs a real API server but no kubelet,
 so controllers are driven by manipulating pod status (SURVEY §4). ``KubeletSim``
 packages those manipulations: admit pods to nodes, run/succeed/fail containers
-with exit codes, simulate preemption/eviction.
+with exit codes, simulate preemption/eviction. It is also the injectable
+container runtime behind the deployable CRR node agent
+(``tpu_on_k8s.client.nodeagent.NodeAgentLoop``) — the restart surface a real
+CRI shim would implement.
 """
 from __future__ import annotations
 
@@ -17,7 +20,7 @@ from tpu_on_k8s.api.core import (
     PodPhase,
     utcnow,
 )
-from tpu_on_k8s.client.cluster import InMemoryCluster
+from tpu_on_k8s.client.cluster import InMemoryCluster, NotFoundError
 
 
 class KubeletSim:
@@ -95,6 +98,41 @@ class KubeletSim:
     def evict_pod(self, namespace: str, name: str) -> Pod:
         """Node-pressure eviction (retryable failure class, failover.go:106-113)."""
         return self.terminate_pod(namespace, name, 137, reason="Evicted", phase=PodPhase.FAILED)
+
+    def recreate_containers(self, namespace: str, name: str,
+                            containers: Optional[list] = None,
+                            expect_uid: Optional[str] = None) -> Pod:
+        """What a CRI container restart looks like from the API server: the
+        named containers (all, if empty) come back ready with restart_count
+        bumped, and the pod returns to Running.
+
+        ``expect_uid`` pins the pod incarnation: the check runs INSIDE the
+        retried mutate (under the update's resourceVersion precondition), so
+        a pod recreated under the same name between the caller's read and
+        this write can never be forged to Running — it raises NotFound, the
+        same outcome as the pod vanishing."""
+        wanted = set(containers or [])
+
+        def mutate(pod: Pod) -> None:
+            if expect_uid is not None and pod.metadata.uid != expect_uid:
+                raise NotFoundError(
+                    f"pod {namespace}/{name} incarnation changed "
+                    f"(uid {pod.metadata.uid} != {expect_uid})")
+            pod.status.phase = PodPhase.RUNNING
+            pod.status.reason = ""
+            pod.status.conditions = [Condition(
+                type="Ready", status="True", last_transition_time=utcnow())]
+            if not pod.status.container_statuses:
+                pod.status.container_statuses = [
+                    ContainerStatus(name=c.name) for c in pod.spec.containers]
+            for cs in pod.status.container_statuses:
+                if wanted and cs.name not in wanted:
+                    continue
+                cs.ready = True
+                cs.restart_count += 1
+                cs.terminated = None
+
+        return self._set(namespace, name, mutate)
 
 
 class KubeletLoop:

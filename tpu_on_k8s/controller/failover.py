@@ -15,12 +15,18 @@ slice's task group, not just the single pod.
 from __future__ import annotations
 
 import enum
+import time
 from typing import Optional, Protocol
 
-from tpu_on_k8s.api import constants
-from tpu_on_k8s.api.core import Pod, PodPhase, utcnow
+from tpu_on_k8s.api import constants, crr as crr_api
+from tpu_on_k8s.api.core import ObjectMeta, OwnerReference, Pod, PodPhase, utcnow
+from tpu_on_k8s.api.crr import ContainerRecreateRequest
 from tpu_on_k8s.api.types import RestartPolicy
-from tpu_on_k8s.client.cluster import InMemoryCluster, NotFoundError
+from tpu_on_k8s.client.cluster import (
+    AlreadyExistsError,
+    InMemoryCluster,
+    NotFoundError,
+)
 
 # Exit-code taxonomy (failover.go:64-99).
 PERMANENT_EXIT_CODES = {1, 2, 126, 127, 128, 139}
@@ -90,7 +96,9 @@ class InPlaceRestarter(Protocol):
 class InMemoryRestarter:
     """Test/local executor: resets the pod to Running in place and bumps
     restart counts — what the kruise daemon's CRI restart looks like from the
-    API server's perspective."""
+    API server's perspective. Only legitimate against the in-memory backend,
+    where no kubelet owns pod status; ``main.build_restarter`` selects
+    ``CRRRestarter`` for any real (REST) cluster."""
 
     def restart(self, cluster: InMemoryCluster, pod: Pod) -> bool:
         def mutate(p: Pod) -> None:
@@ -108,6 +116,89 @@ class InMemoryRestarter:
             return True
         except NotFoundError:
             return False
+
+
+class CRRRestarter:
+    """Kruise-protocol executor (failover.go:210-307): post a
+    ``ContainerRecreateRequest`` and let the NODE AGENT restart the
+    containers — the operator never writes kubelet-owned pod status.
+
+    The reference's protocol is level-triggered across reconcile passes;
+    this repo's ``InPlaceRestarter`` seam is a synchronous bool, so the
+    state machine is driven here with a bounded poll instead of across
+    reconciles — same states, same transitions:
+
+    * CRR named after the pod, labeled with the pod uid; a stale-uid CRR
+      (older incarnation) is deleted and re-posted (failover.go:231-237);
+    * ``Failed`` ⇒ delete the CRR, return False — the caller falls back to
+      delete+recreate (failover.go:242-247);
+    * ``Succeeded`` ⇒ delete the CRR (restarts are repeatable; the name
+      must free up, failover.go:258-262), return True;
+    * deadline (no node agent alive / node dead) ⇒ best-effort delete,
+      return False — recreate is the safe degraded path: on a real cluster
+      a dead kruise daemon usually means a dead node.
+    """
+
+    def __init__(self, cluster: InMemoryCluster, *,
+                 wait_seconds: float = 5.0, poll_seconds: float = 0.05):
+        self.cluster = cluster
+        self.wait_seconds = wait_seconds
+        self.poll_seconds = poll_seconds
+
+    def _post(self, pod: Pod) -> None:
+        req = ContainerRecreateRequest(
+            metadata=ObjectMeta(
+                name=pod.metadata.name,
+                namespace=pod.metadata.namespace,
+                labels={crr_api.LABEL_CRR_POD_UID: pod.metadata.uid},
+                owner_references=[OwnerReference(
+                    api_version="v1", kind="Pod", name=pod.metadata.name,
+                    uid=pod.metadata.uid, controller=False,
+                    block_owner_deletion=True)],
+            ),
+            spec=crr_api.ContainerRecreateRequestSpec(
+                pod_name=pod.metadata.name,
+                containers=[c.name for c in pod.spec.containers],
+                ttl_seconds_after_finished=300.0,
+            ),
+        )
+        try:
+            self.cluster.create(req)
+        except AlreadyExistsError:
+            pass  # another reconcile won the race; adopt theirs
+
+    def _delete(self, namespace: str, name: str) -> None:
+        try:
+            self.cluster.delete(ContainerRecreateRequest, namespace, name)
+        except NotFoundError:
+            pass
+
+    def restart(self, cluster: InMemoryCluster, pod: Pod) -> bool:
+        del cluster  # protocol seam passes it; this executor owns its client
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        deadline = time.monotonic() + self.wait_seconds
+        posted = False
+        while True:
+            req = self.cluster.try_get(ContainerRecreateRequest, ns, name)
+            if req is None:
+                if posted and self.cluster.try_get(Pod, ns, name) is None:
+                    return False  # pod vanished; nothing to restart
+                self._post(pod)
+                posted = True
+            elif (req.metadata.labels.get(crr_api.LABEL_CRR_POD_UID)
+                  != pod.metadata.uid):
+                self._delete(ns, name)  # stale incarnation's CRR
+            elif req.status.phase == crr_api.PHASE_FAILED:
+                self._delete(ns, name)
+                return False
+            elif req.status.phase == crr_api.PHASE_SUCCEEDED:
+                self._delete(ns, name)
+                return True
+            if time.monotonic() >= deadline:
+                # leave no orphan that could fire after our recreate fallback
+                self._delete(ns, name)
+                return False
+            time.sleep(self.poll_seconds)
 
 
 def failover_recreate(cluster: InMemoryCluster, pod: Pod) -> bool:
