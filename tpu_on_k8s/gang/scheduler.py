@@ -393,6 +393,18 @@ class SliceGangAdmission:
             self._recover_allocations()
             self._recovered = True
 
+    def resync(self) -> None:
+        """Drop the in-memory inventory and rebuild it from cluster state.
+        Required on leadership takeover: allocations moved while this
+        candidate was not leading, and admitting from a stale inventory is
+        exactly the double-booking hazard leader election exists to stop."""
+        with self._lock:
+            self._allocations.clear()
+            self._free = {p.name: list(range(p.num_slices))
+                          for p in self.pools}
+            self._recovered = not self.pools
+        self._ensure_recovered()
+
     def _recover_allocations(self) -> None:
         """Rebuild slice ownership after a scheduler restart: a Running
         slice-gang podgroup's pods carry pool-encoded node names
