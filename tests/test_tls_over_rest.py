@@ -1,0 +1,138 @@
+"""HTTPS + bearer auth over the wire (VERDICT round 3 next-round #7).
+
+The client's TLS/auth code (`rest.py`: https scheme, ``ca_path``,
+``token_path``) was previously dead in tests — the ApiServer was plain
+HTTP. Here the server serves TLS with a self-signed CA and enforces a
+Bearer token (the GKE ServiceAccount shape,
+reference pkg/utils/kubeconfig/kubeconfig.go:33-56), and one full lifecycle
+runs through the encrypted, authenticated channel — including the
+list-then-watch informer path.
+"""
+import subprocess
+import time
+
+import pytest
+
+from tpu_on_k8s.api.core import Container, ObjectMeta, Pod, PodPhase, PodSpec
+from tpu_on_k8s.client.apiserver import ApiServer
+from tpu_on_k8s.client.cluster import ApiError, WatchEvent
+from tpu_on_k8s.client.rest import RestCluster
+from tpu_on_k8s.client.testing import KubeletSim
+
+
+@pytest.fixture(scope="module")
+def ca(tmp_path_factory):
+    """Self-signed cert/key with SAN IP:127.0.0.1 — the cert is its own CA,
+    exactly what a test kubeconfig's certificate-authority entry carries."""
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = d / "cert.pem", d / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+@pytest.fixture()
+def tls_server(ca, tmp_path):
+    cert, key = ca
+    token_file = tmp_path / "token"
+    token_file.write_text("sa-token-123\n")
+    srv = ApiServer(tls_cert_path=str(cert), tls_key_path=str(key),
+                    require_token="sa-token-123").start()
+    yield srv, str(cert), str(token_file)
+    srv.stop()
+
+
+def _pod(name):
+    return Pod(metadata=ObjectMeta(name=name),
+               spec=PodSpec(containers=[Container(name="c", image="i")]))
+
+
+def test_lifecycle_over_tls_with_bearer_token(tls_server):
+    srv, ca_path, token_path = tls_server
+    assert srv.url.startswith("https://")
+    client = RestCluster(srv.url, token_path=token_path, ca_path=ca_path)
+    try:
+        # create / get / list
+        client.create(_pod("w0"))
+        assert client.get(Pod, "default", "w0").metadata.uid
+        assert [p.metadata.name for p in client.list(Pod)] == ["w0"]
+
+        # status subresource + conflict-retried update (PUT)
+        KubeletSim(client).run_pod("default", "w0")
+        assert client.get(Pod, "default", "w0").status.phase == PodPhase.RUNNING
+
+        # merge-patch (PATCH) with annotations
+        client.patch_meta(Pod, "default", "w0", annotations={"k": "v"})
+        assert client.get(Pod, "default", "w0").metadata.annotations["k"] == "v"
+
+        # list-then-watch informer delivery through the TLS stream
+        events = []
+        client.watch(lambda e: events.append(e) if e.obj.kind == "Pod" else None)
+        client.create(_pod("w1"))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if any(e.type == "ADDED" and e.obj.metadata.name == "w1"
+                   for e in events if isinstance(e, WatchEvent)):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(f"watch never delivered w1: {events}")
+
+        # delete
+        client.delete(Pod, "default", "w1")
+        assert client.try_get(Pod, "default", "w1") is None
+    finally:
+        client.close()
+
+
+def test_missing_or_wrong_token_is_unauthorized(tls_server, tmp_path):
+    srv, ca_path, _ = tls_server
+    anon = RestCluster(srv.url, ca_path=ca_path)  # no token at all
+    try:
+        with pytest.raises(ApiError, match="401|[Uu]nauthorized"):
+            anon.list(Pod)
+    finally:
+        anon.close()
+    bad_file = tmp_path / "bad-token"
+    bad_file.write_text("wrong")
+    bad = RestCluster(srv.url, ca_path=ca_path, token_path=str(bad_file))
+    try:
+        with pytest.raises(ApiError, match="401|[Uu]nauthorized"):
+            bad.get(Pod, "default", "w0")
+    finally:
+        bad.close()
+
+
+def test_untrusted_ca_is_rejected(tls_server):
+    """A client without the CA must refuse the connection — encryption
+    without server verification would be silently spoofable."""
+    srv, _, token_path = tls_server
+    import ssl
+
+    untrusting = RestCluster(srv.url, token_path=token_path)  # no ca_path
+    try:
+        with pytest.raises((ssl.SSLError, OSError)):
+            untrusting.list(Pod)
+    finally:
+        untrusting.close()
+
+
+def test_token_rotation_reread_per_request(tls_server, tmp_path):
+    """ServiceAccount tokens rotate on disk; the client must re-read the
+    file per request rather than caching the first value."""
+    srv, ca_path, _ = tls_server
+    token_file = tmp_path / "rotating"
+    token_file.write_text("wrong-at-first")
+    client = RestCluster(srv.url, ca_path=ca_path,
+                         token_path=str(token_file))
+    try:
+        with pytest.raises(ApiError):
+            client.list(Pod)
+        token_file.write_text("sa-token-123")  # kubelet rotated it
+        assert isinstance(client.list(Pod), list)
+    finally:
+        client.close()

@@ -34,7 +34,7 @@ or a scope cut, not a semantic the controllers depend on):
 | `POST .../pods/{name}/log` injects a log line | kubelet stand-in: tests feed the stream the autoscaler's observer reads |
 | label selectors support `k=v` equality only | the only form the controllers emit |
 | no apiVersion conversion/validation webhooks | single-version API surface |
-| chunked JSON watch framing without client certs | auth is Bearer-token/TLS at the client; this server is the test/envtest seam |
+| no client-certificate authn | serves TLS + enforces Bearer tokens (the GKE ServiceAccount path, exercised by test_tls_over_rest.py); mTLS client certs are out of scope |
 
 Storage delegates to `InMemoryCluster` — the same finalizer/cascade/conflict
 logic the controllers were developed against — so this file is purely the
@@ -182,9 +182,23 @@ class _Handler(BaseHTTPRequestHandler):
     cluster: InMemoryCluster
     hub: _WatchHub
     stopping: threading.Event
+    require_token: Optional[str] = None
 
     def log_message(self, fmt, *args):  # route through the framework logger
         _log.debug("%s %s", self.address_string(), fmt % args)
+
+    def _authorized(self) -> bool:
+        """Bearer-token check (what a real apiserver's authn layer does for
+        ServiceAccount tokens). Enforced only when the server was started
+        with a required token — the TLS tests pin the client's auth path."""
+        if self.require_token is None:
+            return True
+        header = self.headers.get("Authorization", "")
+        if header == f"Bearer {self.require_token}":
+            return True
+        self._send_json(401, _status_body(401, "Unauthorized",
+                                          "bearer token missing or invalid"))
+        return False
 
     # ------------------------------------------------------------------ routing
     def _parse(self) -> Tuple[Optional[_Route], Dict[str, List[str]]]:
@@ -240,6 +254,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------------- verbs
     def do_GET(self) -> None:
+        if not self._authorized():
+            return
         route, qs = self._parse()
         if route is None:
             self._send_json(404, _status_body(404, "NotFound", self.path))
@@ -284,6 +300,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_status(exc)
 
     def do_POST(self) -> None:
+        if not self._authorized():
+            return
         route, _ = self._parse()
         if route is None:
             self._send_json(404, _status_body(404, "NotFound", self.path))
@@ -308,6 +326,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_status(exc)
 
     def do_PUT(self) -> None:
+        if not self._authorized():
+            return
         route, _ = self._parse()
         if route is None or route.name is None:
             self._send_json(404, _status_body(404, "NotFound", self.path))
@@ -323,6 +343,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_status(exc)
 
     def do_PATCH(self) -> None:
+        if not self._authorized():
+            return
         route, _ = self._parse()
         if route is None or route.name is None:
             self._send_json(404, _status_body(404, "NotFound", self.path))
@@ -344,6 +366,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_status(exc)
 
     def do_DELETE(self) -> None:
+        if not self._authorized():
+            return
         route, _ = self._parse()
         if route is None or route.name is None:
             self._send_json(404, _status_body(404, "NotFound", self.path))
@@ -444,22 +468,38 @@ class ApiServer:
     `stop()` drains watch streams and shuts down."""
 
     def __init__(self, cluster: Optional[InMemoryCluster] = None,
-                 host: str = "127.0.0.1", port: int = 0) -> None:
+                 host: str = "127.0.0.1", port: int = 0,
+                 tls_cert_path: Optional[str] = None,
+                 tls_key_path: Optional[str] = None,
+                 require_token: Optional[str] = None) -> None:
+        """``tls_cert_path``/``tls_key_path`` serve HTTPS (what a real
+        apiserver always does); ``require_token`` additionally enforces
+        Bearer auth on every verb — together they exercise the client's
+        ca_path/token_path path instead of leaving it dead in tests."""
         self.cluster = cluster or InMemoryCluster()
         self.hub = _WatchHub(self.cluster)
         self._stopping = threading.Event()
         handler = type("BoundHandler", (_Handler,), {
             "cluster": self.cluster, "hub": self.hub,
-            "stopping": self._stopping})
+            "stopping": self._stopping, "require_token": require_token})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
+        self.tls = bool(tls_cert_path)
+        if self.tls:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert_path, tls_key_path)
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                                 server_side=True)
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
     def start(self) -> "ApiServer":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
