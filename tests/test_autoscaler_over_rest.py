@@ -12,6 +12,7 @@ from tpu_on_k8s.api.types import TaskType, TPUJob
 from tpu_on_k8s.client import KubeletLoop
 from tpu_on_k8s.client.apiserver import ApiServer
 from tpu_on_k8s.client.rest import RestCluster
+from tpu_on_k8s.client.testing import append_pod_log
 from tpu_on_k8s.controller.tpujob import submit_job
 from tpu_on_k8s.main import Operator, build_parser
 
@@ -59,8 +60,8 @@ def test_autoscaler_grows_via_log_scrape_over_rest():
             observation (exactly how a real trainer's steady log behaves)."""
             deadline = time.time() + 30
             while time.time() < deadline:
-                user.append_pod_log(
-                    "default", "nj-worker-0",
+                append_pod_log(
+                    user, "default", "nj-worker-0",
                     f"[elastic-metrics] epoch=1 batch={next(batch_counter)} "
                     f"latency={latency} accuracy=0.9")
                 if num_workers() == target_workers:

@@ -33,6 +33,7 @@ from tpu_on_k8s.client.cluster import (
     NotFoundError,
 )
 from tpu_on_k8s.client.rest import RestCluster
+from tpu_on_k8s.client.testing import append_pod_log
 from tpu_on_k8s.controller.leaderelection import LeaderElector
 from tpu_on_k8s.controller.tpujob import submit_job
 from tpu_on_k8s.main import Operator, build_cluster, build_parser
@@ -171,8 +172,8 @@ def test_rest_watch_delivers_after_registration(rest):
 
 def test_rest_pod_log_and_events(rest):
     rest.create(Pod(metadata=ObjectMeta(name="logged", namespace="default")))
-    rest.append_pod_log("default", "logged", "[elastic-metrics] latency=0.5")
-    rest.append_pod_log("default", "logged", "[elastic-metrics] latency=0.4")
+    append_pod_log(rest, "default", "logged", "[elastic-metrics] latency=0.5")
+    append_pod_log(rest, "default", "logged", "[elastic-metrics] latency=0.4")
     assert rest.read_pod_log("default", "logged", tail=1) == [
         "[elastic-metrics] latency=0.4"]
     job = rest.get if False else None  # noqa: F841 — keep linters quiet

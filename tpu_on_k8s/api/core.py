@@ -54,9 +54,25 @@ class EnvVarSource:
     """Downward-API field reference. The reference uses
     ``fieldRef: metadata.annotations['distributed.io/world-size']`` so an in-place
     restarted container observes the *new* world size
-    (/root/reference/controllers/train/torchjob_controller.go:419-439)."""
+    (/root/reference/controllers/train/torchjob_controller.go:419-439).
+
+    Wire shape is core/v1's ``valueFrom: {fieldRef: {fieldPath: ...}}``
+    nesting (the flat form is internal only)."""
 
     field_path: str = ""
+
+    @staticmethod
+    def __wire_out__(d: Dict[str, object]) -> Dict[str, object]:
+        fp = d.pop("fieldPath", None)
+        return {"fieldRef": {"fieldPath": fp}} if fp else d
+
+    @staticmethod
+    def __wire_in__(d: Dict[str, object]) -> Dict[str, object]:
+        fr = d.get("fieldRef")
+        if isinstance(fr, dict) and "fieldPath" in fr:
+            d = dict(d)
+            d["field_path"] = fr["fieldPath"]
+        return d
 
 
 @dataclass
@@ -107,6 +123,68 @@ class Volume:
     secret_name: Optional[str] = None
     empty_dir: bool = False
     items: Dict[str, str] = field(default_factory=dict)
+
+    @staticmethod
+    def __wire_out__(d: Dict[str, object]) -> Dict[str, object]:
+        """Emit core/v1's nested volume sources (``hostPath: {path}``,
+        ``nfs: {server, path}``, ``persistentVolumeClaim: {claimName}``, …) —
+        a real apiserver rejects the internal flat-string form."""
+        items = d.pop("items", None) or {}
+        wire_items = [{"key": k, "path": p} for k, p in items.items()]
+        out: Dict[str, object] = {"name": d.get("name", "")}
+        if d.get("hostPath"):
+            out["hostPath"] = {"path": d["hostPath"]}
+        if d.get("nfsServer"):
+            out["nfs"] = {"server": d["nfsServer"],
+                          "path": d.get("nfsPath") or ""}
+        if d.get("pvcClaimName"):
+            out["persistentVolumeClaim"] = {"claimName": d["pvcClaimName"]}
+        if d.get("configMapName"):
+            cm: Dict[str, object] = {"name": d["configMapName"]}
+            if wire_items:
+                cm["items"] = wire_items
+            out["configMap"] = cm
+        if d.get("secretName"):
+            sec: Dict[str, object] = {"secretName": d["secretName"]}
+            if wire_items:
+                sec["items"] = wire_items
+            out["secret"] = sec
+        if d.get("emptyDir"):
+            out["emptyDir"] = {}
+        return out
+
+    @staticmethod
+    def __wire_in__(d: Dict[str, object]) -> Dict[str, object]:
+        sources = ("hostPath", "nfs", "persistentVolumeClaim", "configMap",
+                   "secret", "emptyDir")
+        if not any(k in d for k in sources):
+            return d  # internal snake_case / legacy flat form
+        out: Dict[str, object] = {"name": d.get("name", "")}
+        hp = d.get("hostPath")
+        out["host_path"] = hp.get("path") if isinstance(hp, dict) else hp
+        nfs = d.get("nfs")
+        if isinstance(nfs, dict):
+            out["nfs_server"] = nfs.get("server")
+            out["nfs_path"] = nfs.get("path")
+        pvc = d.get("persistentVolumeClaim")
+        if isinstance(pvc, dict):
+            out["pvc_claim_name"] = pvc.get("claimName")
+        items = None
+        cm = d.get("configMap")
+        if isinstance(cm, dict):
+            out["config_map_name"] = cm.get("name")
+            items = cm.get("items")
+        sec = d.get("secret")
+        if isinstance(sec, dict):
+            out["secret_name"] = sec.get("secretName")
+            items = items or sec.get("items")
+        ed = d.get("emptyDir")
+        out["empty_dir"] = True if isinstance(ed, dict) else bool(ed)
+        if isinstance(items, list):
+            out["items"] = {e["key"]: e["path"] for e in items}
+        elif isinstance(d.get("items"), dict):
+            out["items"] = d["items"]
+        return out
 
 
 @dataclass
@@ -190,6 +268,24 @@ class ContainerStatus:
     ready: bool = False
     restart_count: int = 0
     terminated: Optional[ContainerStateTerminated] = None
+
+    @staticmethod
+    def __wire_out__(d: Dict[str, object]) -> Dict[str, object]:
+        """core/v1 nests termination under ``state: {terminated: {...}}``;
+        the flat ``terminated`` is internal only."""
+        t = d.pop("terminated", None)
+        if t is not None:
+            d["state"] = {"terminated": t}
+        return d
+
+    @staticmethod
+    def __wire_in__(d: Dict[str, object]) -> Dict[str, object]:
+        st = d.get("state")
+        if (isinstance(st, dict) and "terminated" not in d
+                and st.get("terminated") is not None):
+            d = dict(d)
+            d["terminated"] = st["terminated"]
+        return d
 
 
 @dataclass

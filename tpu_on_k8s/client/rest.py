@@ -235,7 +235,8 @@ class RestCluster:
             fins += [f for f in add_f if f not in fins]
             patch_meta = dict(meta)
             patch_meta["finalizers"] = fins
-            patch_meta["resourceVersion"] = cur.metadata.resource_version
+            # opaque string on the wire, like every k8s resourceVersion
+            patch_meta["resourceVersion"] = str(cur.metadata.resource_version)
             try:
                 data = self._request(
                     "PATCH", rt.item_path(namespace, quote(name)),
@@ -301,11 +302,6 @@ class RestCluster:
     def events(self) -> List[tuple]:
         """Parity with InMemoryCluster.events for assertions/tests."""
         return self.list_events()
-
-    def append_pod_log(self, namespace: str, name: str, line: str) -> None:
-        self._request("POST",
-                      f"/api/v1/namespaces/{namespace}/pods/{quote(name)}/log",
-                      {"line": line})
 
     def read_pod_log(self, namespace: str, name: str, *,
                      tail: int = 0) -> List[str]:

@@ -23,6 +23,25 @@ from tpu_on_k8s.api.core import (
 from tpu_on_k8s.client.cluster import InMemoryCluster, NotFoundError
 
 
+def append_pod_log(cluster, namespace: str, name: str, line: str) -> None:
+    """Kubelet-side log injection — the TEST SEAM for pod logs.
+
+    A real training container's stdout reaches ``pods/{name}/log`` via the
+    kubelet, not via any client verb, so the REST client deliberately has no
+    log-append method (``POST .../pods/{name}/log`` is not a Kubernetes verb;
+    see the divergence table in `tpu_on_k8s/client/apiserver.py`). Tests and
+    the kubelet sim inject log lines here: directly into the in-memory store,
+    or over the test apiserver's private log endpoint for REST backends.
+    """
+    if hasattr(cluster, "append_pod_log"):       # InMemoryCluster store
+        cluster.append_pod_log(namespace, name, line)
+        return
+    from urllib.parse import quote
+    cluster._request(                            # test-only seam into ApiServer
+        "POST", f"/api/v1/namespaces/{namespace}/pods/{quote(name)}/log",
+        {"line": line})
+
+
 class KubeletSim:
     def __init__(self, cluster: InMemoryCluster) -> None:
         self.cluster = cluster
@@ -93,7 +112,7 @@ class KubeletSim:
 
     def log_line(self, namespace: str, name: str, line: str) -> None:
         """Emit a line into the pod's log stream (training stdout analog)."""
-        self.cluster.append_pod_log(namespace, name, line)
+        append_pod_log(self.cluster, namespace, name, line)
 
     def evict_pod(self, namespace: str, name: str) -> Pod:
         """Node-pressure eviction (retryable failure class, failover.go:106-113)."""

@@ -27,8 +27,17 @@ def _type_hints(cls: type) -> dict[str, Any]:
     return hints
 
 
+# k8s JSON tags that break the mechanical snake→camel rule (Go keeps
+# initialisms upper-case: PodIP, HostIP, ClusterIP — k8s API conventions).
+_CAMEL_OVERRIDES = {"pod_ip": "podIP", "host_ip": "hostIP",
+                    "cluster_ip": "clusterIP"}
+
+
 def _camel(name: str) -> str:
     """snake_case → camelCase for the k8s wire (api_version → apiVersion)."""
+    override = _CAMEL_OVERRIDES.get(name)
+    if override is not None:
+        return override
     head, _, rest = name.partition("_")
     if not rest:
         return name
@@ -40,22 +49,43 @@ def to_dict(obj: Any, *, drop_none: bool = True, wire: bool = False) -> Any:
 
     ``wire=True`` emits camelCase keys for dataclass *fields* (the real
     Kubernetes JSON convention) while leaving plain-dict keys (labels,
-    annotations, nodeSelector, resource names) untouched.
+    annotations, nodeSelector, resource names) untouched. Wire mode also
+    applies the Kubernetes dialect rules a real apiserver enforces (pinned
+    by the golden fixtures in tests/test_golden_wire.py):
+
+    * ``metadata.resourceVersion`` is an opaque *string* on the wire, and is
+      absent (never ``"0"``) on fresh objects;
+    * timestamps serialize RFC 3339 with a ``Z`` suffix (metav1.Time);
+    * classes may define ``__wire_out__(dict) -> dict`` /
+      ``__wire_in__(dict) -> dict`` staticmethod hooks for shape adaptations
+      the generic field walk can't express (e.g. core/v1's
+      ``containerStatuses[].state.terminated`` nesting and tagged-union
+      volume sources).
     """
     if obj is None:
         return None
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         out = {}
         for f in dataclasses.fields(obj):
-            v = to_dict(getattr(obj, f.name), drop_none=drop_none, wire=wire)
+            raw = getattr(obj, f.name)
+            if wire and f.name == "resource_version":
+                if raw:
+                    out["resourceVersion"] = str(raw)
+                continue
+            v = to_dict(raw, drop_none=drop_none, wire=wire)
             if drop_none and (v is None or v == {} or v == []):
                 continue
             out[_camel(f.name) if wire else f.name] = v
+        if wire:
+            hook = getattr(type(obj), "__wire_out__", None)
+            if hook is not None:
+                out = hook(out)
         return out
     if isinstance(obj, enum.Enum):
         return obj.value
     if isinstance(obj, _dt.datetime):
-        return obj.isoformat()
+        s = obj.isoformat()
+        return s.replace("+00:00", "Z") if wire else s
     if isinstance(obj, dict):
         # Keys go through conversion too: task maps are keyed by TaskType
         # enums. Plain string keys are data, never renamed.
@@ -102,9 +132,20 @@ def _construct(tp: Any, data: Any) -> Any:
                             return member
                 raise
         if tp is _dt.datetime and isinstance(data, str):
+            # accept both RFC 3339 "Z" (what a real apiserver emits) and
+            # "+00:00" (python isoformat)
+            if data.endswith("Z"):
+                data = data[:-1] + "+00:00"
             return _dt.datetime.fromisoformat(data)
         if tp is float and isinstance(data, (int, float)):
             return float(data)
+        if tp is int and isinstance(data, str):
+            # k8s serializes resourceVersion (and quantity-ish ints) as
+            # opaque strings; accept numeric strings for int fields.
+            s = data.strip()
+            if s and s.lstrip("-").isdigit():
+                return int(s)
+            raise TypeError(f"expected int got non-numeric str {data!r}")
         if tp in (int, str, bool) and not isinstance(data, tp):
             raise TypeError(f"expected {tp} got {type(data)}")
     return data
@@ -121,6 +162,9 @@ def from_dict(cls: Type[T], data: Optional[dict]) -> T:
         raise TypeError(f"{cls} is not a dataclass")
     if not isinstance(data, dict):
         raise TypeError(f"cannot decode {cls.__name__} from {type(data).__name__} {data!r}")
+    hook = getattr(cls, "__wire_in__", None)
+    if hook is not None:
+        data = hook(data)
     hints = _type_hints(cls)
     kwargs = {}
     for f in dataclasses.fields(cls):
