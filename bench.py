@@ -49,12 +49,24 @@ def bench_config() -> TransformerConfig:
       "dots_kernels" by 2-9%.
     * heads-leading projections (`_HeadProj`) — no transpose between
       projection matmuls and the kernel.
+
+    Round-4 tuning (measured deltas in ARCHITECTURE.md's lever table):
+    * mlp_int8: SwitchBack int8-forward MLP matmuls (+2.1%); backward stays
+      exact bf16 (`tpu_on_k8s/ops/int8_matmul.py`).
+    * mlp_fused_gateup: one [D, 2·d_ff] matmul for SwiGLU gate+up — the
+      activation is read/quantized once, the MXU tile doubles (+2.2% on top
+      of int8).
+    * bf16 Adam second moment (+0.5%), fp32-accumulated
+      (`trainer._scale_by_adam_lp`).
+    * Measured losers left opt-in: fused_qkv (−3.7%), loss_chunks (−2.8% at
+      seq 1024), batch 16 (−6%/token), dots_kernels remat (−9%).
     """
     return TransformerConfig(vocab_size=32768, d_model=1024, n_layers=16,
                              n_heads=16, n_kv_heads=8, d_ff=4096,
                              max_seq_len=1024, remat=True,
                              remat_policy="mlp", scan_unroll=16,
-                             attn_impl="flash")
+                             attn_impl="flash", mlp_int8=True,
+                             mlp_fused_gateup=True)
 
 
 def n_params(cfg: TransformerConfig) -> int:
@@ -71,7 +83,8 @@ def main() -> None:
     model = Transformer(cfg)
     trainer = Trainer(model, flagship_partition_rules(), mesh,
                       default_optimizer(warmup_steps=10, decay_steps=1000,
-                                        mu_dtype=jnp.bfloat16))
+                                        mu_dtype=jnp.bfloat16,
+                                        nu_dtype=jnp.bfloat16))
 
     # batch 12 is the measured v5e sweet spot at full unroll (12 > 16 > 8).
     batch, seqlen = 12, cfg.max_seq_len

@@ -94,3 +94,18 @@ def test_overflow_raises(setup):
     cfg, model, params, tokens = setup
     with pytest.raises(ValueError, match="exceeds max_seq_len"):
         generate(cfg, params, tokens, max_new_tokens=1000)
+
+
+def test_generate_with_fused_qkv_checkpoint():
+    """A fused_qkv-trained param tree serves through generate(): the decode
+    path builds the same attn/wqkv param instead of wq/wk/wv."""
+    import dataclasses
+    cfg = dataclasses.replace(TransformerConfig.tiny(), fused_qkv=True)
+    model = Transformer(dataclasses.replace(cfg, attn_impl="flash"))
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab_size,
+                                jnp.int32)
+    params = model.init(jax.random.key(0), prompt)["params"]
+    assert "wqkv" in params["blocks"]["attn"], "trained tree is fused"
+    out = generate(cfg, params, prompt, 4)
+    assert out.shape == (2, 4)
+    assert bool((out >= 0).all())

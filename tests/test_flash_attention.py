@@ -201,3 +201,27 @@ def test_transformer_with_flash_impl():
     out_x = Transformer(cfg_xla).apply({"params": params}, tokens)
     np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_x),
                                atol=2e-2, rtol=2e-2)
+
+
+@pytest.mark.parametrize("seq", [100, 600])
+def test_transformer_flash_fallback_unaligned_seq(seq):
+    """Lengths with no legal flash block fall back to XLA attention inside
+    the model instead of erroring: 100 is below one block but not an
+    8-multiple (Mosaic tile alignment); 600 has no 64..512 divisor."""
+    from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+    from tpu_on_k8s.ops.flash_attention import auto_block
+
+    with pytest.raises(ValueError):
+        auto_block(seq)  # the condition the model fallback guards
+
+    cfg = TransformerConfig(vocab_size=128, d_model=64, n_layers=1,
+                            n_heads=4, n_kv_heads=2, d_ff=128,
+                            max_seq_len=seq, remat=False, attn_impl="flash")
+    tokens = jax.random.randint(jax.random.key(0), (2, seq), 0, 128, jnp.int32)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(1), tokens)["params"]
+    out = model.apply({"params": params}, tokens)
+    cfg_xla = TransformerConfig(**{**cfg.__dict__, "attn_impl": "xla"})
+    want = Transformer(cfg_xla).apply({"params": params}, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=2e-2, rtol=2e-2)

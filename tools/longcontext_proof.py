@@ -1,0 +1,221 @@
+"""Long-context evidence (VERDICT r3 #6): prove the long-context machinery at
+long context, with memory numbers showing the score matrix never materializes.
+
+Two parts, selected by the active JAX backend:
+
+* **CPU (8 virtual devices)** — `python tools/longcontext_proof.py` under
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu``:
+  1. ring-attention training step at seq **32768** on an 8-way ``seq`` mesh
+     (tiny model, real Trainer step): loss finite, and the compiled step's
+     per-device temp memory is orders of magnitude below the
+     [L, L] score matrix a naive attention would allocate;
+  2. parity: ring loss at seq 4096 vs the same params through single-device
+     XLA attention (exactness of the logsumexp merge at scale).
+* **TPU (one real chip)** — same script under the TPU backend: single-chip
+  flash attention fwd+bwd at seq 4096 with remat (the bench remat policy),
+  timed, plus compiled temp-memory evidence, plus the non-128-multiple
+  fallback behavior at seq 4000 (flash has no legal block → model falls back
+  to XLA attention and still steps).
+
+Results merge into LONGCONTEXT_r04.json (committed with the round).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "LONGCONTEXT_r04.json")
+
+
+def _merge(update: dict) -> None:
+    data = {}
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            data = json.load(f)
+    data.update(update)
+    with open(OUT, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    print(json.dumps(update))
+
+
+def _tiny_cfg(seq: int, attn: str):
+    from tpu_on_k8s.models.transformer import TransformerConfig
+    return TransformerConfig(
+        vocab_size=256, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq_len=seq, remat=False, attn_impl=attn)
+
+
+def _loss_fn(cfg, mesh, tokens, rules):
+    """One real (jitted, sharded) loss+grad step; returns loss and the
+    compiled step's memory analysis."""
+    from tpu_on_k8s.models.transformer import Transformer
+    from tpu_on_k8s.parallel.ring import ring_context
+    from tpu_on_k8s.train.trainer import Trainer, default_optimizer
+
+    model = Transformer(cfg)
+    trainer = Trainer(model, rules, mesh,
+                      default_optimizer(warmup_steps=1, decay_steps=10))
+    state = trainer.init_state(jax.random.key(0), tokens[:, :-1])
+    sharded = trainer.shard_batch(tokens)
+    state, metrics = trainer.train_step(state, sharded)
+    loss = float(metrics["loss"])
+    try:
+        with ring_context(mesh):
+            lowered = trainer._step.lower(state, sharded)
+            mem = lowered.compile().memory_analysis()
+    except Exception as exc:  # noqa: BLE001 — memory stats are best-effort
+        print(f"memory_analysis unavailable: {exc!r}", file=sys.stderr)
+        mem = None
+    return loss, mem
+
+
+def cpu_part() -> None:
+    from tpu_on_k8s.models.transformer import (
+        Transformer,
+        flagship_partition_rules,
+    )
+    from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+
+    devs = jax.devices()
+    assert len(devs) >= 8, "run with xla_force_host_platform_device_count=8"
+    rules = flagship_partition_rules()
+
+    # --- 32k ring step -----------------------------------------------------
+    seq = 32768
+    mesh = create_mesh(MeshConfig(data=1, fsdp=1, model=1, seq=8), devs[:8])
+    cfg = _tiny_cfg(seq, "ring")
+    tokens = jax.random.randint(jax.random.key(1), (1, seq + 1), 0,
+                                cfg.vocab_size, jnp.int32)
+    t0 = time.perf_counter()
+    loss, mem = _loss_fn(cfg, mesh, tokens, rules)
+    wall = time.perf_counter() - t0
+    naive_scores = cfg.n_heads * seq * seq * 4  # fp32 [H, L, L] per device
+    temp = getattr(mem, "temp_size_in_bytes", None)
+    record = {
+        "seq": seq, "devices": 8, "mesh": "seq=8",
+        "loss": loss, "loss_finite": bool(jnp.isfinite(loss)),
+        "wall_s_cpu": round(wall, 1),
+        "per_device_temp_bytes": temp,
+        "naive_score_matrix_bytes": naive_scores,
+        "temp_vs_naive": (round(temp / naive_scores, 4)
+                          if isinstance(temp, int) and temp else None),
+    }
+    assert record["loss_finite"], f"ring 32k loss not finite: {loss}"
+    if isinstance(temp, int) and temp:
+        assert temp < naive_scores / 10, (
+            f"temp {temp} suspiciously close to naive {naive_scores}")
+    _merge({"ring_32k_dryrun": record})
+
+    # --- parity at 4096: ring vs single-device XLA on identical params -----
+    seq = 4096
+    cfg_r = _tiny_cfg(seq, "ring")
+    cfg_x = _tiny_cfg(seq, "xla")
+    tokens = jax.random.randint(jax.random.key(2), (1, seq + 1), 0,
+                                cfg_r.vocab_size, jnp.int32)
+    mesh = create_mesh(MeshConfig(data=1, fsdp=1, model=1, seq=8), devs[:8])
+    from tpu_on_k8s.parallel.ring import ring_context
+    from tpu_on_k8s.train.trainer import cross_entropy_loss
+
+    params = Transformer(cfg_x).init(jax.random.key(3),
+                                     tokens[:, :-1])["params"]
+
+    def loss_of(cfg, params, in_mesh):
+        model = Transformer(cfg)
+
+        def f(p, t):
+            logits = model.apply({"params": p}, t[:, :-1])
+            return cross_entropy_loss(logits, t[:, 1:])
+        if in_mesh:
+            with ring_context(in_mesh):
+                return float(jax.jit(f)(params, tokens))
+        return float(jax.jit(f)(params, tokens))
+
+    ring_loss = loss_of(cfg_r, params, mesh)
+    xla_loss = loss_of(cfg_x, params, None)
+    diff = abs(ring_loss - xla_loss)
+    record = {"seq": seq, "ring_loss": ring_loss, "xla_loss": xla_loss,
+              "abs_diff": diff}
+    assert diff < 5e-3, f"ring/xla diverge: {record}"
+    _merge({"ring_parity_4096": record})
+
+
+def tpu_part() -> None:
+    from tpu_on_k8s.models.transformer import flagship_partition_rules
+    from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+
+    devs = jax.devices()
+    mesh = create_mesh(MeshConfig(data=1, fsdp=len(devs), model=1, seq=1))
+    rules = flagship_partition_rules()
+    kind = getattr(devs[0], "device_kind", "unknown")
+
+    from tpu_on_k8s.models.transformer import TransformerConfig
+    for seq, label in ((4096, "flash_4096"), (4000, "flash_4000_fallback")):
+        cfg = TransformerConfig(
+            vocab_size=32768, d_model=1024, n_layers=4, n_heads=16,
+            n_kv_heads=8, d_ff=4096, max_seq_len=seq, remat=True,
+            remat_policy="mlp", scan_unroll=4, attn_impl="flash")
+        batch = 2
+        tokens = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0,
+                                    cfg.vocab_size, jnp.int32)
+        t0 = time.perf_counter()
+        loss, mem = _loss_fn(cfg, mesh, tokens, rules)
+        compile_wall = time.perf_counter() - t0
+
+        from tpu_on_k8s.models.transformer import Transformer
+        from tpu_on_k8s.train.trainer import Trainer, default_optimizer
+        trainer = Trainer(Transformer(cfg), rules, mesh,
+                          default_optimizer(warmup_steps=1, decay_steps=10,
+                                            mu_dtype=jnp.bfloat16))
+        state = trainer.init_state(jax.random.key(0), tokens[:, :-1])
+        sharded = trainer.shard_batch(tokens)
+        for _ in range(2):
+            state, metrics = trainer.train_step(state, sharded)
+        float(metrics["loss"])
+        steps = 10
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = trainer.train_step(state, sharded)
+        float(metrics["loss"])
+        dt = (time.perf_counter() - t0) / steps
+        naive_scores = batch * cfg.n_heads * seq * seq * 4
+        temp = getattr(mem, "temp_size_in_bytes", None)
+        record = {
+            "seq": seq, "batch": batch, "layers": cfg.n_layers,
+            "device_kind": kind, "loss": loss,
+            "loss_finite": bool(jnp.isfinite(loss)),
+            "step_ms": round(dt * 1e3, 1),
+            "tokens_per_sec": round(batch * seq / dt, 1),
+            "compile_s": round(compile_wall, 1),
+            "temp_bytes": temp,
+            "naive_score_matrix_bytes": naive_scores,
+            "attn_path": ("flash (512-block pallas)" if seq % 128 == 0
+                          else "xla fallback (no legal flash block)"),
+        }
+        assert record["loss_finite"], f"{label} loss not finite"
+        _merge({label: record})
+
+
+def main() -> None:
+    # The image pins the TPU platform via sitecustomize (it imports jax before
+    # env vars can win), so --cpu flips the backend the way tests/conftest.py
+    # does: jax.config is still honored pre-backend-init.
+    if "--cpu" in sys.argv:
+        jax.config.update("jax_platforms", "cpu")
+    if jax.default_backend() == "cpu":
+        cpu_part()
+    else:
+        tpu_part()
+
+
+if __name__ == "__main__":
+    main()

@@ -40,9 +40,13 @@ def run_variant(spec: str) -> None:
     bk = int(opts.pop("bk", 0)) or block
     steps = int(opts.pop("steps", 20))
     mu = opts.pop("mu", "bf16")              # bf16 | fp32
+    nu = opts.pop("nu", "fp32")              # bf16 | fp32 (adam 2nd moment)
     chunks = int(opts.pop("chunks", 0))
     unroll = int(opts.pop("unroll", 1))
     gqa = opts.pop("gqa", "0") == "1"
+    fused = opts.pop("fused", "0") == "1"    # fused qkv projection
+    int8 = opts.pop("int8", "0") == "1"      # int8-forward MLP matmuls
+    gateup = opts.pop("gateup", "0") == "1"  # fused gate+up MLP matmul
     if opts:
         raise ValueError(f"unknown keys {list(opts)}")
 
@@ -55,6 +59,9 @@ def run_variant(spec: str) -> None:
            "attn_block_k": bk,
            "scan_unroll": unroll,
            "attn_native_gqa": gqa,
+           "fused_qkv": fused,
+           "mlp_int8": int8,
+           "mlp_fused_gateup": gateup,
            "remat": remat != "off",
            "remat_policy": remat if remat != "off" else "full"})
     devices = jax.devices()
@@ -63,7 +70,8 @@ def run_variant(spec: str) -> None:
     trainer = Trainer(model, flagship_partition_rules(), mesh,
                       default_optimizer(
                           warmup_steps=10, decay_steps=1000,
-                          mu_dtype=jnp.bfloat16 if mu == "bf16" else None),
+                          mu_dtype=jnp.bfloat16 if mu == "bf16" else None,
+                          nu_dtype=jnp.bfloat16 if nu == "bf16" else None),
                       loss_chunks=chunks)
     seqlen = cfg.max_seq_len
     tokens = jax.random.randint(jax.random.key(1), (batch, seqlen + 1), 0,

@@ -1,0 +1,89 @@
+"""Where does the step time go? fwd / fwd+bwd / optimizer at the bench config.
+
+python tools/step_breakdown.py [int8=1] [nu=bf16] ...same keys as perf_sweep
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from bench import bench_config, n_params
+from tpu_on_k8s.models.transformer import Transformer, flagship_partition_rules
+from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+from tpu_on_k8s.train.trainer import (
+    Trainer,
+    cross_entropy_loss,
+    default_optimizer,
+)
+import dataclasses
+
+
+def timeit(name, fn, *args, steps=20):
+    out = fn(*args)
+    jax.tree.map(lambda x: x, out)
+    _ = float(jax.tree.leaves(out)[0].reshape(-1)[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    _ = float(jax.tree.leaves(out)[0].reshape(-1)[0])
+    dt = (time.perf_counter() - t0) / steps
+    print(f"{name:28s} {dt * 1e3:8.1f} ms", flush=True)
+    return dt
+
+
+def main():
+    opts = dict(kv.split("=", 1) for a in sys.argv[1:] for kv in [a])
+    cfg = dataclasses.replace(
+        bench_config(),
+        mlp_int8=opts.get("int8", "0") == "1")
+    nu = jnp.bfloat16 if opts.get("nu", "bf16") == "bf16" else None
+    batch = int(opts.get("batch", "12"))
+    mesh = create_mesh(MeshConfig(data=1, fsdp=len(jax.devices()), model=1,
+                                  seq=1))
+    model = Transformer(cfg)
+    opt = default_optimizer(warmup_steps=10, decay_steps=1000,
+                            mu_dtype=jnp.bfloat16, nu_dtype=nu)
+    trainer = Trainer(model, flagship_partition_rules(), mesh, opt)
+    tokens = jax.random.randint(jax.random.key(1), (batch, cfg.max_seq_len + 1),
+                                0, cfg.vocab_size, jnp.int32)
+    state = trainer.init_state(jax.random.key(0), tokens[:, :-1])
+    sharded = trainer.shard_batch(tokens)
+
+    def loss_fn(params, toks):
+        logits = model.apply({"params": params}, toks[:, :-1])
+        return cross_entropy_loss(logits, toks[:, 1:])
+
+    fwd = jax.jit(loss_fn)
+    vgrad = jax.jit(lambda p, t: jax.value_and_grad(loss_fn)(p, t))
+
+    @jax.jit
+    def opt_only(state, grads):
+        updates, opt_state = opt.update(grads, state.opt_state, state.params)
+        import optax
+        params = optax.apply_updates(state.params, updates)
+        return params, opt_state
+
+    t_fwd = timeit("fwd (loss only)", fwd, state.params, sharded)
+    t_vg = timeit("fwd+bwd (value_and_grad)", vgrad, state.params, sharded)
+    _, grads = vgrad(state.params, sharded)
+    t_opt = timeit("optimizer update", opt_only, state, grads)
+    t_step = timeit("full train_step",
+                    lambda s, t: trainer.train_step(s, t)[0].params, state,
+                    sharded)
+    peak = 197e12
+    toks = batch * cfg.max_seq_len
+    print(f"\nfwd ideal {2 * n_params(cfg) * toks / peak * 1e3:.1f} ms, "
+          f"bwd ideal {4 * n_params(cfg) * toks / peak * 1e3:.1f} ms")
+    print(f"breakdown: fwd {t_fwd*1e3:.1f} | bwd {(t_vg - t_fwd)*1e3:.1f} | "
+          f"opt {t_opt*1e3:.1f} | step {t_step*1e3:.1f} "
+          f"(sum parts {(t_vg + t_opt)*1e3:.1f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,7 @@ inference entry point for the models it trains.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -30,28 +31,22 @@ def decode_model(cfg: TransformerConfig) -> Transformer:
 
 
 def init_cache(model: Transformer, batch: int) -> dict:
-    """Zeroed cache pytree for a given generation batch size."""
+    """Zeroed cache pytree for a given generation batch size (shapes via
+    ``eval_shape`` — no parameter initialization or tracing work)."""
     tokens = jnp.zeros((batch, 1), jnp.int32)
-    variables = model.init(jax.random.key(0), tokens,
-                           jnp.zeros((batch, 1), jnp.int32))
-    return jax.tree.map(jnp.zeros_like, variables["cache"])
+    shapes = jax.eval_shape(model.init, jax.random.key(0), tokens,
+                            jnp.zeros((batch, 1), jnp.int32))
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                        shapes["cache"])
 
 
-def generate(cfg: TransformerConfig, params, prompt: jnp.ndarray,
-             max_new_tokens: int, temperature: float = 0.0,
-             rng: Optional[jax.Array] = None) -> jnp.ndarray:
-    """Greedy (temperature=0) or sampled continuation of ``prompt`` [B, Lp].
-
-    Returns [B, max_new_tokens]. Total length must fit ``cfg.max_seq_len``.
-    """
-    b, lp = prompt.shape
-    if lp + max_new_tokens > cfg.max_seq_len:
-        raise ValueError(
-            f"prompt {lp} + new {max_new_tokens} exceeds max_seq_len "
-            f"{cfg.max_seq_len}")
+@functools.lru_cache(maxsize=32)
+def _compiled_generate(cfg: TransformerConfig, b: int, lp: int,
+                       max_new_tokens: int, temperature: float):
+    """One compiled generation program per (config, shape) — repeated
+    ``generate()`` calls (a serving loop) reuse it instead of re-tracing.
+    The config is a frozen dataclass, so it keys the cache directly."""
     model = decode_model(cfg)
-    cache = init_cache(model, b)
-    rng = rng if rng is not None else jax.random.key(0)
 
     def pick(logits: jnp.ndarray, step_rng: jax.Array) -> jnp.ndarray:
         if temperature <= 0.0:
@@ -85,4 +80,22 @@ def generate(cfg: TransformerConfig, params, prompt: jnp.ndarray,
             jax.random.split(step_key, max_new_tokens))
         return toks.transpose(1, 0)
 
+    return model, run
+
+
+def generate(cfg: TransformerConfig, params, prompt: jnp.ndarray,
+             max_new_tokens: int, temperature: float = 0.0,
+             rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Greedy (temperature=0) or sampled continuation of ``prompt`` [B, Lp].
+
+    Returns [B, max_new_tokens]. Total length must fit ``cfg.max_seq_len``.
+    """
+    b, lp = prompt.shape
+    if lp + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {lp} + new {max_new_tokens} exceeds max_seq_len "
+            f"{cfg.max_seq_len}")
+    model, run = _compiled_generate(cfg, b, lp, max_new_tokens, temperature)
+    cache = init_cache(model, b)
+    rng = rng if rng is not None else jax.random.key(0)
     return run(params, prompt, cache, rng)

@@ -74,6 +74,21 @@ class TransformerConfig:
                                        # more than the repeats saved) but
                                        # wins when K/V memory dominates
                                        # (long context / tight HBM).
+    fused_qkv: bool = False            # one [D, (H+2Hkv)·Dh] projection
+                                       # matmul instead of three — a larger
+                                       # MXU tile and one pass over x
+                                       # (heads-leading path only; param
+                                       # lives at attn/wqkv/kernel)
+    mlp_int8: bool = False             # int8-forward MLP matmuls (SwitchBack
+                                       # scheme, `tpu_on_k8s/ops/int8_matmul`):
+                                       # s8×s8→s32 on the MXU at 2× the bf16
+                                       # rate, bf16 backward. Opt-in: trades
+                                       # forward quantization noise for
+                                       # throughput.
+    mlp_fused_gateup: bool = False     # one [D, 2·d_ff] matmul for SwiGLU's
+                                       # gate+up (param mlp/w_gateup/kernel):
+                                       # the activation is read/quantized
+                                       # once and the MXU tile doubles.
     pos_emb: str = "rope"              # "rope" | "learned" (GPT-2 family)
     norm: str = "rms"                  # "rms" | "ln"
     activation: str = "swiglu"         # "swiglu" | "gelu"
@@ -254,6 +269,30 @@ class _HeadProj(nn.Module):
         return jnp.einsum("bld,dhf->bhlf", x, k3)
 
 
+class _FusedQKVProj(nn.Module):
+    """Single QKV projection: one ``[D, (H+2·Hkv)·Dh]`` kernel, one matmul,
+    sliced into heads-leading q/k/v on the (cheap) head axis. Feeds the MXU
+    a 2× wider tile than three separate projections and reads the activation
+    from HBM once instead of three times."""
+
+    heads: int
+    kv_heads: int
+    head_dim: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray):
+        d_in = x.shape[-1]
+        total = self.heads + 2 * self.kv_heads
+        kernel = self.param("kernel", nn.initializers.normal(0.02),
+                            (d_in, total * self.head_dim), self.param_dtype)
+        k3 = kernel.reshape(d_in, total, self.head_dim).astype(self.dtype)
+        qkv = jnp.einsum("bld,dhf->bhlf", x, k3)       # [B, H+2Hkv, L, Dh]
+        h, hk = self.heads, self.kv_heads
+        return qkv[:, :h], qkv[:, h:h + hk], qkv[:, h + hk:]
+
+
 class _OutProj(nn.Module):
     """Output projection consuming heads-leading [B, H, L, Dh]
     (``bhlf,hfd->bld``); param identical to the ``nn.Dense`` wo kernel."""
@@ -286,13 +325,21 @@ class Attention(nn.Module):
             kernel_init=nn.initializers.normal(0.02))
         if cfg.attn_impl in ("xla", "flash") and not cfg.decode:
             return self._attention_bhld(x, positions)
-        q = dense(cfg.n_heads * cfg.head_dim, "wq")(x)
-        k = dense(cfg.n_kv_heads * cfg.head_dim, "wk")(x)
-        v = dense(cfg.n_kv_heads * cfg.head_dim, "wv")(x)
         b, l = x.shape[0], x.shape[1]
-        q = q.reshape(b, l, cfg.n_heads, cfg.head_dim)
-        k = k.reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
-        v = v.reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
+        if cfg.fused_qkv:
+            # same wqkv param as the heads-leading path, so fused-qkv
+            # checkpoints serve (decode) and ring/ulysses-train unchanged
+            qh, kh, vh = _FusedQKVProj(cfg.n_heads, cfg.n_kv_heads,
+                                       cfg.head_dim, cfg.dtype,
+                                       cfg.param_dtype, name="wqkv")(x)
+            q, k, v = (t.transpose(0, 2, 1, 3) for t in (qh, kh, vh))
+        else:
+            q = dense(cfg.n_heads * cfg.head_dim, "wq")(x)
+            k = dense(cfg.n_kv_heads * cfg.head_dim, "wk")(x)
+            v = dense(cfg.n_kv_heads * cfg.head_dim, "wv")(x)
+            q = q.reshape(b, l, cfg.n_heads, cfg.head_dim)
+            k = k.reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
+            v = v.reshape(b, l, cfg.n_kv_heads, cfg.head_dim)
         if cfg.pos_emb == "rope":
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)
@@ -314,25 +361,41 @@ class Attention(nn.Module):
         (measured ~35% faster per layer than project→reshape→transpose at
         the 350M bench shape; see `_HeadProj`)."""
         cfg = self.cfg
-        hp = lambda heads, name: _HeadProj(heads, cfg.head_dim, cfg.dtype,
-                                           cfg.param_dtype, name=name)
-        q = hp(cfg.n_heads, "wq")(x)          # [B, H, L, Dh]
-        k = hp(cfg.n_kv_heads, "wk")(x)       # [B, Hkv, L, Dh]
-        v = hp(cfg.n_kv_heads, "wv")(x)
+        if cfg.fused_qkv:
+            q, k, v = _FusedQKVProj(cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
+                                    cfg.dtype, cfg.param_dtype, name="wqkv")(x)
+        else:
+            hp = lambda heads, name: _HeadProj(heads, cfg.head_dim, cfg.dtype,
+                                               cfg.param_dtype, name=name)
+            q = hp(cfg.n_heads, "wq")(x)          # [B, H, L, Dh]
+            k = hp(cfg.n_kv_heads, "wk")(x)       # [B, Hkv, L, Dh]
+            v = hp(cfg.n_kv_heads, "wv")(x)
         if cfg.pos_emb == "rope":
             q = rope_bhld(q, positions, cfg.rope_theta)
             k = rope_bhld(k, positions, cfg.rope_theta)
         if cfg.attn_impl == "flash":
             from tpu_on_k8s.ops.flash_attention import _flash, auto_block
-            if not cfg.attn_native_gqa:
-                rep = cfg.n_heads // cfg.n_kv_heads
-                k = jnp.repeat(k, rep, axis=1)
-                v = jnp.repeat(v, rep, axis=1)
-            # else: the kernel's index maps route q-head → kv group natively
             l = q.shape[2]
-            out = _flash(q, k, v, True,
-                         cfg.attn_block_q or auto_block(l),
-                         cfg.attn_block_k or auto_block(l))
+            try:
+                bq = cfg.attn_block_q or auto_block(l)
+                bk = cfg.attn_block_k or auto_block(l)
+            except ValueError:
+                # length has no 64..512 divisor (not a 128-multiple): fall
+                # back to XLA attention rather than failing the train step —
+                # correctness at any length, speed at aligned lengths.
+                bq = bk = 0
+            if bq:
+                if not cfg.attn_native_gqa:
+                    rep = cfg.n_heads // cfg.n_kv_heads
+                    k = jnp.repeat(k, rep, axis=1)
+                    v = jnp.repeat(v, rep, axis=1)
+                # else: the kernel's index maps route q-head → kv group natively
+                out = _flash(q, k, v, True, bq, bk)
+            else:
+                rep = cfg.n_heads // cfg.n_kv_heads
+                out = xla_attention_bhld(q, jnp.repeat(k, rep, axis=1),
+                                         jnp.repeat(v, rep, axis=1),
+                                         causal=True)
         else:
             rep = cfg.n_heads // cfg.n_kv_heads
             k = jnp.repeat(k, rep, axis=1)
@@ -369,20 +432,45 @@ class Attention(nn.Module):
         return jnp.einsum("bhlm,bmhd->blhd", probs, v_all)
 
 
+class _Int8Dense(nn.Module):
+    """``nn.Dense`` twin whose matmul runs the int8-forward path. The param
+    is the identical 2-D ``kernel`` (same name/shape/partition rules), so
+    ``mlp_int8`` can be flipped on a checkpoint without conversion."""
+
+    features: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        from tpu_on_k8s.ops.int8_matmul import int8_matmul
+        kernel = self.param("kernel", nn.initializers.normal(0.02),
+                            (x.shape[-1], self.features), self.param_dtype)
+        return int8_matmul(x, kernel.astype(self.dtype))
+
+
 class MLP(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         cfg = self.cfg
-        dense = lambda feats, name: nn.Dense(
-            feats, use_bias=False, name=name, dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
-            kernel_init=nn.initializers.normal(0.02))
+        if cfg.mlp_int8:
+            dense = lambda feats, name: _Int8Dense(
+                feats, name=name, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        else:
+            dense = lambda feats, name: nn.Dense(
+                feats, use_bias=False, name=name, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.initializers.normal(0.02))
         if cfg.activation == "gelu":
             return dense(cfg.d_model, "w_down")(nn.gelu(dense(cfg.d_ff, "w_up")(x)))
-        gate = dense(cfg.d_ff, "w_gate")(x)
-        up = dense(cfg.d_ff, "w_up")(x)
+        if cfg.mlp_fused_gateup:
+            gu = dense(2 * cfg.d_ff, "w_gateup")(x)
+            gate, up = gu[..., :cfg.d_ff], gu[..., cfg.d_ff:]
+        else:
+            gate = dense(cfg.d_ff, "w_gate")(x)
+            up = dense(cfg.d_ff, "w_up")(x)
         return dense(cfg.d_model, "w_down")(nn.silu(gate) * up)
 
 
@@ -509,9 +597,10 @@ def flagship_partition_rules() -> List[PartitionRule]:
     return [
         # attention: qkv column-parallel, output row-parallel
         PartitionRule(r"attn/w[qkv]/kernel", P(None, AXIS_FSDP, AXIS_MODEL)),
+        PartitionRule(r"attn/wqkv/kernel", P(None, AXIS_FSDP, AXIS_MODEL)),
         PartitionRule(r"attn/wo/kernel", P(None, AXIS_MODEL, AXIS_FSDP)),
         # mlp: gate/up column-parallel, down row-parallel
-        PartitionRule(r"mlp/w_(gate|up)/kernel", P(None, AXIS_FSDP, AXIS_MODEL)),
+        PartitionRule(r"mlp/w_(gate|up|gateup)/kernel", P(None, AXIS_FSDP, AXIS_MODEL)),
         PartitionRule(r"mlp/w_down/kernel", P(None, AXIS_MODEL, AXIS_FSDP)),
         # MoE: experts over the expert axis, then megatron within each expert
         PartitionRule(r"moe/router", P(None, AXIS_FSDP, None)),

@@ -52,6 +52,12 @@ def auto_block(length: int, target: int = DEFAULT_BLOCK_Q) -> int:
     block, and lengths not divisible by 512 fall back to the largest
     divisible candidate so any 128-multiple sequence length works."""
     if length <= target:
+        if length % 8:
+            # Mosaic tiles are 8-row multiples; a misaligned single block
+            # would rely on implicit padding. Callers fall back to XLA
+            # attention (models/transformer.py) for such lengths.
+            raise ValueError(
+                f"flash attention: seq len {length} is not an 8-multiple")
         return length
     for b in (512, 384, 256, 128, 64):
         if b <= target and length % b == 0:

@@ -1,0 +1,77 @@
+"""Int8 forward matmul for training (SwitchBack-style), TPU-first.
+
+The v5e MXU runs s8×s8→s32 at twice its bf16 rate, so for
+bandwidth-resident models the big MLP matmuls can take the int8 path in the
+*forward* pass while the backward stays bf16 (full-precision gradients —
+the scheme popularized as SwitchBack; PAPERS.md int8-training entry):
+
+* activations quantize row-wise (one scale per token row),
+* weights quantize column-wise (one scale per output feature),
+* ``y = (xq @ wq) · sx · sw`` accumulates in int32 on the MXU,
+* backward computes ``dx = g·wᵀ`` and ``dw = xᵀ·g`` in bf16 from the saved
+  *unquantized* tensors, so optimizer updates see exact gradients of the
+  quantized forward's straight-through surrogate.
+
+Quantization here is XLA-native (jnp round) so it fuses into the
+surrounding elementwise work; the Pallas stochastic-rounding kernels in
+`tpu_on_k8s/ops/quantization.py` remain the storage/compression path (their
+per-launch cost is wasted inside a hot matmul, measured on v5e).
+
+The reference delegates all tensor math to user containers (SURVEY §2.10);
+this is compute-plane work with no reference analog.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _quant_rows(x: jnp.ndarray):
+    """[..., K] → int8 values + fp32 scale per row (last dim reduced)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _quant_cols(w: jnp.ndarray):
+    """[K, N] → int8 values + fp32 scale per output column."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _fwd_impl(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    xq, sx = _quant_rows(x)
+    wq, sw = _quant_cols(w)
+    y = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (y.astype(jnp.float32) * sx * sw).astype(x.dtype)
+
+
+@jax.custom_vjp
+def int8_matmul(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """``x @ w`` with int8-quantized forward, bf16 backward.
+
+    x: [..., K] activation (bf16), w: [K, N] weight (bf16/fp32 compute
+    copy). Returns [..., N] in x.dtype.
+    """
+    return _fwd_impl(x, w)
+
+
+def _fwd(x, w):
+    return _fwd_impl(x, w), (x, w)
+
+
+def _bwd(res, g):
+    x, w = res
+    dx = jnp.einsum("...n,kn->...k", g, w).astype(x.dtype)
+    dw = jnp.einsum("...k,...n->kn", x, g).astype(w.dtype)
+    return dx, dw
+
+
+int8_matmul.defvjp(_fwd, _bwd)

@@ -88,22 +88,72 @@ def chunked_cross_entropy(feats: jnp.ndarray, head: jnp.ndarray,
     return total / denom
 
 
+def _scale_by_adam_lp(b1: float, b2: float, eps: float,
+                      mu_dtype, nu_dtype) -> optax.GradientTransformation:
+    """``optax.scale_by_adam`` with independently reducible moment dtypes.
+
+    optax exposes ``mu_dtype`` only; this adds ``nu_dtype``. Both moments
+    are *accumulated* in fp32 (cast up, EMA, cast back down) so the only
+    loss is storage precision — bf16 keeps ~2.4 significant digits, plenty
+    for a variance that only feeds an rsqrt. Halving nu cuts 2·|params|
+    bytes of optimizer-state HBM traffic per step."""
+
+    def init(params):
+        mu = jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params)
+        nu = jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=nu_dtype or p.dtype), params)
+        return optax.ScaleByAdamState(count=jnp.zeros([], jnp.int32),
+                                      mu=mu, nu=nu)
+
+    def update(updates, state, params=None):
+        del params
+        f32 = jnp.float32
+        mu = jax.tree.map(
+            lambda m, g: (b1 * m.astype(f32)
+                          + (1 - b1) * g.astype(f32)).astype(mu_dtype or g.dtype),
+            state.mu, updates)
+        nu = jax.tree.map(
+            lambda n, g: (b2 * n.astype(f32)
+                          + (1 - b2) * jnp.square(g.astype(f32))
+                          ).astype(nu_dtype or g.dtype),
+            state.nu, updates)
+        count = state.count + 1
+        bc1 = 1 - b1 ** count.astype(f32)
+        bc2 = 1 - b2 ** count.astype(f32)
+        out = jax.tree.map(
+            lambda m, n, g: ((m.astype(f32) / bc1)
+                             / (jnp.sqrt(n.astype(f32) / bc2) + eps)
+                             ).astype(g.dtype),
+            mu, nu, updates)
+        return out, optax.ScaleByAdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)
+
+
 def default_optimizer(learning_rate: float = 3e-4,
                       weight_decay: float = 0.1,
                       warmup_steps: int = 100,
                       decay_steps: int = 10000,
                       max_grad_norm: float = 1.0,
-                      mu_dtype=None) -> optax.GradientTransformation:
+                      mu_dtype=None, nu_dtype=None) -> optax.GradientTransformation:
     """AdamW + clip + warmup-cosine. ``mu_dtype=jnp.bfloat16`` halves the
     first-moment HBM footprint/traffic (~+1% step rate at 350M on v5e); the
-    variance stays fp32 for stability."""
+    variance stays fp32 for stability unless ``nu_dtype`` is also lowered
+    (bf16 nu is accumulated in fp32 and stored bf16 — see
+    ``_scale_by_adam_lp``)."""
     sched = optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, warmup_steps, max(decay_steps, warmup_steps + 1))
-    return optax.chain(
-        optax.clip_by_global_norm(max_grad_norm),
-        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay,
-                    mu_dtype=mu_dtype),
-    )
+    if nu_dtype is not None:
+        adam = optax.chain(
+            _scale_by_adam_lp(0.9, 0.95, 1e-8, mu_dtype, nu_dtype),
+            optax.add_decayed_weights(weight_decay),
+            optax.scale_by_learning_rate(sched),
+        )
+    else:
+        adam = optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay,
+                           mu_dtype=mu_dtype)
+    return optax.chain(optax.clip_by_global_norm(max_grad_norm), adam)
 
 
 def make_sharded_init(model: Any, optimizer: optax.GradientTransformation,
