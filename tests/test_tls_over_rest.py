@@ -136,3 +136,104 @@ def test_token_rotation_reread_per_request(tls_server, tmp_path):
         assert isinstance(client.list(Pod), list)
     finally:
         client.close()
+
+
+@pytest.fixture(scope="module")
+def client_ca(tmp_path_factory):
+    """A CA plus a client cert it signed — the kubeconfig client-certificate
+    auth mode."""
+    d = tmp_path_factory.mktemp("mtls")
+    ca_key, ca_crt = d / "ca.key", d / "ca.crt"
+    c_key, c_csr, c_crt = d / "client.key", d / "client.csr", d / "client.crt"
+    subprocess.run(["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                    "-nodes", "-keyout", str(ca_key), "-out", str(ca_crt),
+                    "-days", "1", "-subj", "/CN=test-ca"],
+                   check=True, capture_output=True)
+    subprocess.run(["openssl", "req", "-newkey", "rsa:2048", "-nodes",
+                    "-keyout", str(c_key), "-out", str(c_csr),
+                    "-subj", "/CN=operator"], check=True, capture_output=True)
+    subprocess.run(["openssl", "x509", "-req", "-in", str(c_csr),
+                    "-CA", str(ca_crt), "-CAkey", str(ca_key),
+                    "-CAcreateserial", "-out", str(c_crt), "-days", "1"],
+                   check=True, capture_output=True)
+    return str(ca_crt), str(c_crt), str(c_key)
+
+
+def test_mutual_tls_client_certificate(ca, client_ca):
+    """Server demands a client certificate; a cert-bearing client works, a
+    certless client is rejected at the handshake."""
+    cert, key = ca
+    ca_crt, client_crt, client_key = client_ca
+    srv = ApiServer(tls_cert_path=str(cert), tls_key_path=str(key),
+                    client_ca_path=ca_crt).start()
+    try:
+        good = RestCluster(srv.url, ca_path=str(cert),
+                           client_cert_path=client_crt,
+                           client_key_path=client_key)
+        good.create(_pod("mtls-ok"))
+        assert good.get(Pod, "default", "mtls-ok").metadata.uid
+        good.close()
+
+        bad = RestCluster(srv.url, ca_path=str(cert))
+        with pytest.raises((ApiError, OSError)):
+            bad.create(_pod("mtls-denied"))
+        bad.close()
+    finally:
+        srv.stop()
+
+
+def test_kubeconfig_credentials_resolution(tmp_path, ca, client_ca):
+    """kubeconfig user creds (token + client cert, incl. inline *-data)
+    resolve into a RestCluster that authenticates (VERDICT r3 #7 tail: the
+    real-GKE kubeconfig path)."""
+    import base64
+
+    from tpu_on_k8s.client import kubeconfig
+
+    cert, key = ca
+    ca_crt, client_crt, client_key = client_ca
+    kc = tmp_path / "kubeconfig"
+    inline_key = base64.b64encode(
+        open(client_key, "rb").read()).decode()
+    kc.write_text(f"""
+apiVersion: v1
+kind: Config
+current-context: gke
+contexts:
+- name: gke
+  context: {{cluster: c1, user: u1}}
+clusters:
+- name: c1
+  cluster:
+    server: https://127.0.0.1:6443
+    certificate-authority: {cert}
+users:
+- name: u1
+  user:
+    token: sa-token-123
+    client-certificate: {client_crt}
+    client-key-data: {inline_key}
+""")
+    cfg = kubeconfig.resolve(env={"KUBECONFIG": str(kc)})
+    assert cfg.mode == "kubeconfig"
+    assert kubeconfig.server_url(cfg) == "https://127.0.0.1:6443"
+    creds = kubeconfig.credentials(cfg, tmpdir=str(tmp_path))
+    assert creds.token == "sa-token-123"
+    assert creds.ca_path == str(cert)
+    assert creds.client_cert_path == client_crt
+    assert open(creds.client_key_path).read() == open(client_key).read()
+
+    # the resolved credentials drive a real mTLS + bearer-auth'd server
+    srv = ApiServer(tls_cert_path=str(cert), tls_key_path=str(key),
+                    require_token="sa-token-123",
+                    client_ca_path=ca_crt).start()
+    try:
+        client = RestCluster(srv.url, ca_path=creds.ca_path,
+                             token=creds.token,
+                             client_cert_path=creds.client_cert_path,
+                             client_key_path=creds.client_key_path)
+        client.create(_pod("kc-ok"))
+        assert client.get(Pod, "default", "kc-ok").metadata.uid
+        client.close()
+    finally:
+        srv.stop()

@@ -47,6 +47,8 @@ def run_variant(spec: str) -> None:
     fused = opts.pop("fused", "0") == "1"    # fused qkv projection
     int8 = opts.pop("int8", "0") == "1"      # int8-forward MLP matmuls
     gateup = opts.pop("gateup", "0") == "1"  # fused gate+up MLP matmul
+    hint8 = opts.pop("hint8", "0") == "1"    # int8-forward lm_head
+    aint8 = opts.pop("aint8", "0") == "1"    # int8-forward attn projections
     if opts:
         raise ValueError(f"unknown keys {list(opts)}")
 
@@ -62,6 +64,8 @@ def run_variant(spec: str) -> None:
            "fused_qkv": fused,
            "mlp_int8": int8,
            "mlp_fused_gateup": gateup,
+           "head_int8": hint8,
+           "attn_int8": aint8,
            "remat": remat != "off",
            "remat_policy": remat if remat != "off" else "full"})
     devices = jax.devices()

@@ -42,7 +42,7 @@ def main():
     cfg = dataclasses.replace(
         bench_config(),
         mlp_int8=opts.get("int8", "0") == "1")
-    nu = jnp.bfloat16 if opts.get("nu", "bf16") == "bf16" else None
+    nu = jnp.bfloat16 if opts.get("nu", "fp32") == "bf16" else None
     batch = int(opts.get("batch", "12"))
     mesh = create_mesh(MeshConfig(data=1, fsdp=len(jax.devices()), model=1,
                                   seq=1))

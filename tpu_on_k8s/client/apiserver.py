@@ -34,7 +34,7 @@ or a scope cut, not a semantic the controllers depend on):
 | `POST .../pods/{name}/log` injects a log line | kubelet stand-in: tests feed the stream the autoscaler's observer reads |
 | label selectors support `k=v` equality only | the only form the controllers emit |
 | no apiVersion conversion/validation webhooks | single-version API surface |
-| no client-certificate authn | serves TLS + enforces Bearer tokens (the GKE ServiceAccount path, exercised by test_tls_over_rest.py); mTLS client certs are out of scope |
+| client-cert authn is verify-only | TLS + Bearer tokens (the GKE ServiceAccount path) and optional mTLS via ``client_ca_path`` (CERT_REQUIRED against a CA, exercised by test_tls_over_rest.py); no username extraction from the cert subject — there is no RBAC layer to feed it to |
 
 Storage delegates to `InMemoryCluster` — the same finalizer/cascade/conflict
 logic the controllers were developed against — so this file is purely the
@@ -471,11 +471,14 @@ class ApiServer:
                  host: str = "127.0.0.1", port: int = 0,
                  tls_cert_path: Optional[str] = None,
                  tls_key_path: Optional[str] = None,
-                 require_token: Optional[str] = None) -> None:
+                 require_token: Optional[str] = None,
+                 client_ca_path: Optional[str] = None) -> None:
         """``tls_cert_path``/``tls_key_path`` serve HTTPS (what a real
         apiserver always does); ``require_token`` additionally enforces
         Bearer auth on every verb — together they exercise the client's
-        ca_path/token_path path instead of leaving it dead in tests."""
+        ca_path/token_path path instead of leaving it dead in tests.
+        ``client_ca_path`` demands a client certificate signed by that CA
+        (mutual TLS — the kubeconfig client-certificate auth mode)."""
         self.cluster = cluster or InMemoryCluster()
         self.hub = _WatchHub(self.cluster)
         self._stopping = threading.Event()
@@ -490,6 +493,9 @@ class ApiServer:
 
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(tls_cert_path, tls_key_path)
+            if client_ca_path:
+                ctx.verify_mode = ssl.CERT_REQUIRED
+                ctx.load_verify_locations(cafile=client_ca_path)
             self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
                                                  server_side=True)
         self.host = host

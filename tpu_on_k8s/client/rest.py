@@ -84,7 +84,15 @@ class RestCluster:
 
     def __init__(self, base_url: str, timeout: float = 10.0,
                  token_path: Optional[str] = None,
-                 ca_path: Optional[str] = None) -> None:
+                 ca_path: Optional[str] = None,
+                 token: Optional[str] = None,
+                 client_cert_path: Optional[str] = None,
+                 client_key_path: Optional[str] = None) -> None:
+        """``token_path`` (re-read per request — SA tokens rotate) or inline
+        ``token`` for bearer auth; ``client_cert_path``/``client_key_path``
+        for mTLS client-certificate auth (the kubeconfig
+        ``client-certificate``/``client-key`` user entries,
+        reference pkg/utils/kubeconfig/kubeconfig.go:33-56)."""
         parsed = urlparse(base_url)
         if parsed.scheme not in ("http", "https", ""):
             raise ValueError(f"unsupported scheme {parsed.scheme!r}")
@@ -93,10 +101,14 @@ class RestCluster:
         self.port = parsed.port or (443 if self.tls else 80)
         self.timeout = timeout
         self._token_path = token_path  # re-read per request: SA tokens rotate
+        self._token = token
         self._ssl_ctx: Optional[ssl.SSLContext] = None
         if self.tls:
             self._ssl_ctx = (ssl.create_default_context(cafile=ca_path)
                              if ca_path else ssl.create_default_context())
+            if client_cert_path:
+                self._ssl_ctx.load_cert_chain(client_cert_path,
+                                              client_key_path)
         self._local = threading.local()
         self._watch_lock = threading.Lock()
         self._watch_callbacks: List[Callable[[WatchEvent], None]] = []
@@ -123,12 +135,19 @@ class RestCluster:
 
     def _headers(self, content_type: Optional[str]) -> Dict[str, str]:
         headers = {"Content-Type": content_type} if content_type else {}
+        # client-go precedence: an inline token wins over tokenFile; the
+        # file is re-read per request (SA tokens rotate) and an unreadable
+        # file degrades to the inline token rather than to no auth at all.
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
         if self._token_path:
             try:
                 with open(self._token_path) as f:
-                    headers["Authorization"] = f"Bearer {f.read().strip()}"
+                    file_token = f.read().strip()
             except OSError:
-                pass
+                file_token = None
+            if file_token and not self._token:
+                headers["Authorization"] = f"Bearer {file_token}"
         return headers
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,

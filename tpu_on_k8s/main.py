@@ -148,13 +148,15 @@ def build_cluster(args: argparse.Namespace):
     in-process mode as an explicit choice)."""
     backend = getattr(args, "cluster_backend", "auto")
     url = getattr(args, "api_server", "")
-    token_path = ca_path = None
+    creds = None
     if backend in ("auto", "rest") and not url:
         from tpu_on_k8s.client import kubeconfig
 
         cfg = kubeconfig.resolve()
         url = kubeconfig.server_url(cfg) or ""
-        token_path, ca_path = cfg.token_path, cfg.ca_path
+        # inline kubeconfig credentials materialize into a private tempdir
+        # that credentials() creates lazily and removes at exit
+        creds = kubeconfig.credentials(cfg)
     if backend == "rest" or (backend == "auto" and url):
         if not url:
             raise SystemExit(
@@ -162,7 +164,12 @@ def build_cluster(args: argparse.Namespace):
                 "resolvable kubeconfig/in-cluster config")
         from tpu_on_k8s.client.rest import RestCluster
 
-        return RestCluster(url, token_path=token_path, ca_path=ca_path)
+        if creds is None:
+            return RestCluster(url)
+        return RestCluster(url, token_path=creds.token_path,
+                           ca_path=creds.ca_path, token=creds.token,
+                           client_cert_path=creds.client_cert_path,
+                           client_key_path=creds.client_key_path)
     return InMemoryCluster()
 
 

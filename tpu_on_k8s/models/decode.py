@@ -47,6 +47,8 @@ def _compiled_generate(cfg: TransformerConfig, b: int, lp: int,
     ``generate()`` calls (a serving loop) reuse it instead of re-tracing.
     The config is a frozen dataclass, so it keys the cache directly."""
     model = decode_model(cfg)
+    cache0 = init_cache(model, b)   # built once per cache entry; run() does
+                                    # not donate it, so reuse is safe
 
     def pick(logits: jnp.ndarray, step_rng: jax.Array) -> jnp.ndarray:
         if temperature <= 0.0:
@@ -80,7 +82,7 @@ def _compiled_generate(cfg: TransformerConfig, b: int, lp: int,
             jax.random.split(step_key, max_new_tokens))
         return toks.transpose(1, 0)
 
-    return model, run
+    return run, cache0
 
 
 def generate(cfg: TransformerConfig, params, prompt: jnp.ndarray,
@@ -95,7 +97,6 @@ def generate(cfg: TransformerConfig, params, prompt: jnp.ndarray,
         raise ValueError(
             f"prompt {lp} + new {max_new_tokens} exceeds max_seq_len "
             f"{cfg.max_seq_len}")
-    model, run = _compiled_generate(cfg, b, lp, max_new_tokens, temperature)
-    cache = init_cache(model, b)
+    run, cache = _compiled_generate(cfg, b, lp, max_new_tokens, temperature)
     rng = rng if rng is not None else jax.random.key(0)
     return run(params, prompt, cache, rng)

@@ -89,6 +89,18 @@ class TransformerConfig:
                                        # gate+up (param mlp/w_gateup/kernel):
                                        # the activation is read/quantized
                                        # once and the MXU tile doubles.
+    head_int8: bool = False            # int8-forward lm_head matmul (fp32
+                                       # logits out; adds ~0.8% relative
+                                       # quantization noise to logits)
+    attn_int8: bool = False            # int8-forward attention projections
+                                       # (qkv/out); costs one layout
+                                       # transpose per tensor vs the
+                                       # heads-leading bf16 einsum.
+                                       # Heads-leading path only (xla/flash
+                                       # train): decode and ring/ulysses
+                                       # keep bf16 projections by design
+                                       # (serving precision; skinny decode
+                                       # matmuls gain nothing from int8)
     pos_emb: str = "rope"              # "rope" | "learned" (GPT-2 family)
     norm: str = "rms"                  # "rms" | "ln"
     activation: str = "swiglu"         # "swiglu" | "gelu"
@@ -252,12 +264,17 @@ class _HeadProj(nn.Module):
     matmul (``bld,dhf->bhlf``) — no transpose op between projection and
     attention kernel. The param is the identical 2-D ``kernel`` an
     ``nn.Dense`` would own (reshaped on the fly, a free relayout), keeping
-    checkpoints and partition rules layout-agnostic."""
+    checkpoints and partition rules layout-agnostic.
+
+    ``int8=True`` runs the int8-forward path as a 2-D matmul plus an
+    explicit [B,L,H,Dh]→[B,H,L,Dh] transpose (the einsum's implicit
+    relayout can't fold into the quantized dot)."""
 
     heads: int
     head_dim: int
     dtype: Any
     param_dtype: Any
+    int8: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -265,6 +282,12 @@ class _HeadProj(nn.Module):
         kernel = self.param("kernel", nn.initializers.normal(0.02),
                             (d_in, self.heads * self.head_dim),
                             self.param_dtype)
+        if self.int8:
+            from tpu_on_k8s.ops.int8_matmul import int8_matmul
+            b, l = x.shape[0], x.shape[1]
+            y = int8_matmul(x, kernel.astype(self.dtype))
+            return y.reshape(b, l, self.heads,
+                             self.head_dim).transpose(0, 2, 1, 3)
         k3 = kernel.reshape(d_in, self.heads, self.head_dim).astype(self.dtype)
         return jnp.einsum("bld,dhf->bhlf", x, k3)
 
@@ -280,6 +303,7 @@ class _FusedQKVProj(nn.Module):
     head_dim: int
     dtype: Any
     param_dtype: Any
+    int8: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray):
@@ -287,8 +311,15 @@ class _FusedQKVProj(nn.Module):
         total = self.heads + 2 * self.kv_heads
         kernel = self.param("kernel", nn.initializers.normal(0.02),
                             (d_in, total * self.head_dim), self.param_dtype)
-        k3 = kernel.reshape(d_in, total, self.head_dim).astype(self.dtype)
-        qkv = jnp.einsum("bld,dhf->bhlf", x, k3)       # [B, H+2Hkv, L, Dh]
+        if self.int8:
+            from tpu_on_k8s.ops.int8_matmul import int8_matmul
+            b, l = x.shape[0], x.shape[1]
+            y = int8_matmul(x, kernel.astype(self.dtype))
+            qkv = y.reshape(b, l, total, self.head_dim).transpose(0, 2, 1, 3)
+        else:
+            k3 = kernel.reshape(d_in, total,
+                                self.head_dim).astype(self.dtype)
+            qkv = jnp.einsum("bld,dhf->bhlf", x, k3)   # [B, H+2Hkv, L, Dh]
         h, hk = self.heads, self.kv_heads
         return qkv[:, :h], qkv[:, h:h + hk], qkv[:, h + hk:]
 
@@ -302,12 +333,18 @@ class _OutProj(nn.Module):
     head_dim: int
     dtype: Any
     param_dtype: Any
+    int8: bool = False
 
     @nn.compact
     def __call__(self, o: jnp.ndarray) -> jnp.ndarray:
         kernel = self.param("kernel", nn.initializers.normal(0.02),
                             (self.heads * self.head_dim, self.d_model),
                             self.param_dtype)
+        if self.int8:
+            from tpu_on_k8s.ops.int8_matmul import int8_matmul
+            b, h, l, f = o.shape
+            flat = o.transpose(0, 2, 1, 3).reshape(b, l, h * f)
+            return int8_matmul(flat, kernel.astype(self.dtype))
         k3 = kernel.reshape(self.heads, self.head_dim,
                             self.d_model).astype(self.dtype)
         return jnp.einsum("bhlf,hfd->bld", o, k3)
@@ -363,10 +400,12 @@ class Attention(nn.Module):
         cfg = self.cfg
         if cfg.fused_qkv:
             q, k, v = _FusedQKVProj(cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
-                                    cfg.dtype, cfg.param_dtype, name="wqkv")(x)
+                                    cfg.dtype, cfg.param_dtype,
+                                    int8=cfg.attn_int8, name="wqkv")(x)
         else:
             hp = lambda heads, name: _HeadProj(heads, cfg.head_dim, cfg.dtype,
-                                               cfg.param_dtype, name=name)
+                                               cfg.param_dtype,
+                                               int8=cfg.attn_int8, name=name)
             q = hp(cfg.n_heads, "wq")(x)          # [B, H, L, Dh]
             k = hp(cfg.n_kv_heads, "wk")(x)       # [B, Hkv, L, Dh]
             v = hp(cfg.n_kv_heads, "wv")(x)
@@ -402,7 +441,7 @@ class Attention(nn.Module):
             v = jnp.repeat(v, rep, axis=1)
             out = xla_attention_bhld(q, k, v, causal=True)
         return _OutProj(cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.dtype,
-                        cfg.param_dtype, name="wo")(out)
+                        cfg.param_dtype, int8=cfg.attn_int8, name="wo")(out)
 
     def _cached_attention(self, q, k, v, positions, rep: int) -> jnp.ndarray:
         """KV-cache attention: append this call's keys/values at the cache
@@ -528,6 +567,9 @@ class Transformer(nn.Module):
     def __call__(self, tokens: jnp.ndarray,
                  positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         x, head = self._trunk(tokens, positions)
+        if self.cfg.head_int8:
+            from tpu_on_k8s.ops.int8_matmul import int8_matmul
+            return int8_matmul(x, head, out_dtype=jnp.float32)
         # fp32 logits: the loss softmax wants full precision.
         return jnp.einsum("bld,dv->blv", x, head,
                           preferred_element_type=jnp.float32)

@@ -22,6 +22,8 @@ this is compute-plane work with no reference analog.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -44,34 +46,40 @@ def _quant_cols(w: jnp.ndarray):
     return q, scale
 
 
-def _fwd_impl(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+def _fwd_impl(x: jnp.ndarray, w: jnp.ndarray, out_dtype) -> jnp.ndarray:
     xq, sx = _quant_rows(x)
     wq, sw = _quant_cols(w)
     y = jax.lax.dot_general(
         xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
-    return (y.astype(jnp.float32) * sx * sw).astype(x.dtype)
+    return (y.astype(jnp.float32) * sx * sw).astype(out_dtype or x.dtype)
 
 
-@jax.custom_vjp
-def int8_matmul(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
-    """``x @ w`` with int8-quantized forward, bf16 backward.
-
-    x: [..., K] activation (bf16), w: [K, N] weight (bf16/fp32 compute
-    copy). Returns [..., N] in x.dtype.
-    """
-    return _fwd_impl(x, w)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _int8_matmul(x: jnp.ndarray, w: jnp.ndarray, out_dtype) -> jnp.ndarray:
+    return _fwd_impl(x, w, out_dtype)
 
 
-def _fwd(x, w):
-    return _fwd_impl(x, w), (x, w)
+def _fwd(x, w, out_dtype):
+    return _fwd_impl(x, w, out_dtype), (x, w)
 
 
-def _bwd(res, g):
+def _bwd(out_dtype, res, g):
     x, w = res
     dx = jnp.einsum("...n,kn->...k", g, w).astype(x.dtype)
     dw = jnp.einsum("...k,...n->kn", x, g).astype(w.dtype)
     return dx, dw
 
 
-int8_matmul.defvjp(_fwd, _bwd)
+_int8_matmul.defvjp(_fwd, _bwd)
+
+
+def int8_matmul(x: jnp.ndarray, w: jnp.ndarray,
+                out_dtype=None) -> jnp.ndarray:
+    """``x @ w`` with int8-quantized forward, bf16 backward.
+
+    x: [..., K] activation (bf16), w: [K, N] weight (bf16/fp32 compute
+    copy). Returns [..., N] in ``out_dtype`` (default: x.dtype). fp32 out
+    skips a downcast when the consumer wants full precision (the lm head's
+    logits feeding the loss softmax)."""
+    return _int8_matmul(x, w, out_dtype)
