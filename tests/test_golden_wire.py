@@ -50,12 +50,17 @@ class _Script:
     def __init__(self):
         self.requests = []          # (method, path, content_type, body|None)
         self.responses = {}         # (method, bare_path) -> (status, dict)
+        self.sequences = {}         # (method, bare_path) -> [(status, dict)]
         self.watch_frames = {}      # bare_path -> [frame dicts] (first stream)
         self._served_watch = set()
         self.lock = threading.Lock()
 
     def canned(self, method: str, path: str, status: int, body: dict) -> None:
         self.responses[(method, path)] = (status, body)
+
+    def canned_seq(self, method: str, path: str, *bodies: dict) -> None:
+        """Serve these bodies in order (last one repeats)."""
+        self.sequences[(method, path)] = [(200, b) for b in bodies]
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -102,7 +107,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
             self.wfile.write(b"0\r\n\r\n")
             return
-        resp = self.script.responses.get((self.command, bare))
+        with self.script.lock:
+            seq = self.script.sequences.get((self.command, bare))
+            resp = (seq.pop(0) if seq and len(seq) > 1 else
+                    (seq[0] if seq else None))
+        if resp is None:
+            resp = self.script.responses.get((self.command, bare))
         if resp is None and self.command == "GET":
             # default: an empty conformant list for any collection GET
             kind = bare.rsplit("/", 1)[-1]
@@ -342,3 +352,38 @@ def test_error_frame_is_a_real_status():
     err = fixture("watch_frames.json")["error_frame"]
     assert err["object"]["code"] == 410
     assert err["object"]["reason"] == "Expired"
+
+
+def test_watch_410_error_frame_triggers_relist(server):
+    """A 410 ERROR Status frame (exactly as a real apiserver emits it) makes
+    the informer re-list and resume — the client must not go deaf or spin on
+    the dead revision."""
+    script, url = server
+    lst = fixture("pod_list_response.json")["body"]
+    lst2 = json.loads(json.dumps(lst))
+    lst2["metadata"]["resourceVersion"] = "48400"  # post-outage revision
+    err = fixture("watch_frames.json")["error_frame"]
+    script.canned_seq("GET", "/api/v1/pods", lst, lst2)
+    script.watch_frames["/api/v1/pods"] = [err]
+
+    cluster = RestCluster(url)
+    cluster.watch(lambda e: None)
+    # the informer must re-list after the 410 frame and resume the next
+    # watch from the *new* list revision (48400), not the expired 48300 or
+    # anything from the ERROR message
+    deadline = time.time() + 10
+    resumed = []
+    while time.time() < deadline:
+        with script.lock:
+            resumed = [p for _, p, _, _ in script.requests
+                       if "watch=true" in p
+                       and p.startswith("/api/v1/pods")
+                       and "resourceVersion=48400" in p]
+        if resumed:
+            break
+        time.sleep(0.05)
+    cluster.close()
+    assert resumed, "watch never resumed from the re-listed revision 48400"
+    first = [p for _, p, _, _ in script.requests if "watch=true" in p
+             and p.startswith("/api/v1/pods")][0]
+    assert "resourceVersion=48300" in first
