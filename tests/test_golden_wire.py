@@ -387,3 +387,35 @@ def test_watch_410_error_frame_triggers_relist(server):
     first = [p for _, p, _, _ in script.requests if "watch=true" in p
              and p.startswith("/api/v1/pods")][0]
     assert "resourceVersion=48300" in first
+
+
+def test_lease_wire_is_coordination_v1(server):
+    """Leader-election leases speak real coordination.k8s.io/v1: spec-nested
+    holderIdentity / integer leaseDurationSeconds / MicroTime renewTime. A
+    real apiserver prunes unknown flat fields, which would read back as an
+    unheld lease — split-brain."""
+    from tpu_on_k8s.controller.leaderelection import Lease
+    from tpu_on_k8s.api.core import ObjectMeta
+
+    script, url = server
+    fx = fixture("lease_update_request.json")
+    script.canned("PUT", fx["path"], 200, fx["body"])
+    cluster = RestCluster(url)
+    lease = serde.from_dict(Lease, fx["body"])
+    assert lease.holder == "manager-a"
+    assert lease.lease_seconds == 15.0
+    assert lease.renew_time.microsecond == 123456
+    cluster.update(lease)
+    method, path, ctype, body = script.requests[0]
+    assert (method, path, ctype) == (fx["method"], fx["path"],
+                                     fx["contentType"])
+    assert body == fx["body"]
+
+    # MicroTime with zero microseconds still carries the 6-digit fraction
+    whole = Lease(metadata=ObjectMeta(name="l", namespace="default"),
+                  holder="x",
+                  renew_time=dt.datetime(2026, 7, 30, 11, 0, 5,
+                                         tzinfo=dt.timezone.utc))
+    wire = serde.to_dict(whole, drop_none=True, wire=True)
+    assert wire["spec"]["renewTime"] == "2026-07-30T11:00:05.000000Z"
+    assert wire["spec"]["leaseDurationSeconds"] == 15

@@ -374,7 +374,7 @@ def test_ha_scheduler_failover_never_double_books(server):
 
         elector = LeaderElector(
             conn, ident, lease_name="tpu-on-k8s-scheduler-election",
-            lease_seconds=0.5, renew_seconds=0.1,
+            lease_seconds=1.0, renew_seconds=0.1,
             on_started_leading=lead, on_stopped_leading=loop.stop)
         return conn, admission, loop, elector
 

@@ -24,7 +24,17 @@ LEASE_NAME = "tpu-on-k8s-election"
 
 @dataclass
 class Lease:
-    """coordination.k8s.io/v1 Lease analog."""
+    """coordination.k8s.io/v1 Lease analog.
+
+    Internal fields are flat; the wire hooks emit/accept the real
+    coordination.k8s.io shape — ``spec.holderIdentity``,
+    ``spec.leaseDurationSeconds`` (integer), ``spec.renewTime`` (MicroTime:
+    RFC 3339 with a *mandatory* 6-digit fraction — a real apiserver's strict
+    layout parse rejects a bare seconds timestamp). Without this mapping a
+    real cluster would prune the unknown flat fields and every candidate
+    would see an unheld lease: split-brain. Pinned by the golden fixture in
+    tests/fixtures/wire/lease_update_request.json.
+    """
 
     api_version: str = "coordination.k8s.io/v1"
     kind: str = "Lease"
@@ -32,6 +42,45 @@ class Lease:
     holder: str = ""
     renew_time: Optional[_dt.datetime] = None
     lease_seconds: float = 15.0
+
+    @staticmethod
+    def __wire_out__(d):
+        spec: dict = {}
+        holder = d.pop("holder", None)
+        if holder:
+            spec["holderIdentity"] = holder
+        rt = d.pop("renewTime", None)
+        if rt:
+            if "." not in rt:  # MicroTime: fraction is not optional
+                # insert before any offset suffix (Z, +hh:mm, -hh:mm after
+                # the date part) so non-UTC/naive clocks stay parseable too
+                for i, ch in enumerate(rt[11:], start=11):
+                    if ch in "Z+-":
+                        rt = rt[:i] + ".000000" + rt[i:]
+                        break
+                else:
+                    rt += ".000000"
+            spec["renewTime"] = rt
+        ls = d.pop("leaseSeconds", None)
+        if ls is not None:
+            # integer ≥ 1 on the wire (the apiserver's validation floor);
+            # sub-second test leases round up rather than expiring instantly
+            spec["leaseDurationSeconds"] = max(1, int(round(ls)))
+        d["spec"] = spec
+        return d
+
+    @staticmethod
+    def __wire_in__(d):
+        spec = d.get("spec")
+        if isinstance(spec, dict):
+            d = dict(d)
+            if "holderIdentity" in spec:
+                d["holder"] = spec["holderIdentity"] or ""
+            if spec.get("renewTime"):
+                d["renew_time"] = spec["renewTime"]
+            if spec.get("leaseDurationSeconds") is not None:
+                d["lease_seconds"] = float(spec["leaseDurationSeconds"])
+        return d
 
 
 class LeaderElector:
