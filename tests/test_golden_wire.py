@@ -419,3 +419,53 @@ def test_lease_wire_is_coordination_v1(server):
     wire = serde.to_dict(whole, drop_none=True, wire=True)
     assert wire["spec"]["renewTime"] == "2026-07-30T11:00:05.000000Z"
     assert wire["spec"]["leaseDurationSeconds"] == 15
+
+
+def test_pv_wire_is_core_v1(server):
+    """ModelVersion-pipeline PVs speak real core/v1: quantity capacity,
+    nested hostPath, structured claimRef, hostname nodeAffinity."""
+    from tpu_on_k8s.storage.providers import (
+        PersistentVolume,
+        PersistentVolumeSpec,
+    )
+    from tpu_on_k8s.api.core import ObjectMeta
+
+    script, url = server
+    fx = fixture("pv_create_request.json")
+    script.canned("POST", fx["path"], 201, fx["body"])
+    cluster = RestCluster(url)
+    pv = PersistentVolume(
+        metadata=ObjectMeta(name="mv-pv-llama-node-7"),
+        spec=PersistentVolumeSpec(capacity_gi=20, host_path="/data/models",
+                                  node_name="node-7",
+                                  claim_ref="default/mv-pvc-llama"))
+    made = cluster.create(pv)
+    method, path, ctype, body = script.requests[0]
+    assert (method, path, ctype) == (fx["method"], fx["path"],
+                                     fx["contentType"])
+    assert body == fx["body"]
+    # and the apiserver-shaped response decodes losslessly
+    assert made.spec.capacity_gi == 20
+    assert made.spec.host_path == "/data/models"
+    assert made.spec.node_name == "node-7"
+    assert made.spec.claim_ref == "default/mv-pvc-llama"
+
+
+def test_quantity_strings_decode():
+    """Real apiservers serialize quantities as strings; float-typed maps
+    accept them ('500m' cpu, '20Gi' storage, plain '8' chips)."""
+    from tpu_on_k8s.api.core import ResourceQuota
+
+    body = {
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": "team-a", "namespace": "default",
+                     "resourceVersion": "9"},
+        "spec": {"hard": {"google.com/tpu": "8", "cpu": "500m",
+                          "memory": "20Gi"}},
+        "status": {"used": {"google.com/tpu": 4}},
+    }
+    rq = serde.from_dict(ResourceQuota, body)
+    assert rq.spec.hard["google.com/tpu"] == 8.0
+    assert rq.spec.hard["cpu"] == 0.5
+    assert rq.spec.hard["memory"] == 20 * 2**30
+    assert rq.status.used["google.com/tpu"] == 4.0

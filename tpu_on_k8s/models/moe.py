@@ -74,21 +74,28 @@ class MoEMLP(nn.Module):
 
         # expert compute; weights stacked [E, D, F] — sharded over the
         # `expert` axis by the partition rules, which makes XLA turn the
-        # dispatch einsum into an all-to-all over ICI.
+        # dispatch einsum into an all-to-all over ICI. ``mlp_int8`` routes
+        # the expert matmuls through the batched SwitchBack path (expert dim
+        # stays a dot batch dim, so the sharding story is unchanged).
         init = nn.initializers.normal(0.02)
+        if getattr(cfg, "mlp_int8", False):
+            from tpu_on_k8s.ops.int8_matmul import int8_matmul_batched
+            emm = int8_matmul_batched
+        else:
+            # contract x's last dim with w's dim 1, expert dim batched —
+            # covers both the up ([E,D,F]) and down ([E,F,D]) orientations
+            emm = lambda a, w: jnp.einsum("ebcx,exy->ebcy", a, w)
         w_up = self.param("w_up", init, (e, d, cfg.d_ff), cfg.param_dtype)
         w_down = self.param("w_down", init, (e, cfg.d_ff, d), cfg.param_dtype)
         expert_in = jnp.einsum("blec,bld->ebcd", dispatch,
                                x)                            # [E, B, C, D]
         if cfg.activation == "gelu":
-            h = nn.gelu(jnp.einsum("ebcd,edf->ebcf", expert_in,
-                                   w_up.astype(cfg.dtype)))
+            h = nn.gelu(emm(expert_in, w_up.astype(cfg.dtype)))
         else:
             w_gate = self.param("w_gate", init, (e, d, cfg.d_ff),
                                 cfg.param_dtype)
-            h = nn.silu(jnp.einsum("ebcd,edf->ebcf", expert_in,
-                                   w_gate.astype(cfg.dtype))) * jnp.einsum(
-                "ebcd,edf->ebcf", expert_in, w_up.astype(cfg.dtype))
-        out = jnp.einsum("ebcf,efd->ebcd", h, w_down.astype(cfg.dtype))
+            h = nn.silu(emm(expert_in, w_gate.astype(cfg.dtype))) * emm(
+                expert_in, w_up.astype(cfg.dtype))
+        out = emm(h, w_down.astype(cfg.dtype))
         return jnp.einsum("ebcd,blec->bld", out,
                           combine.astype(cfg.dtype))

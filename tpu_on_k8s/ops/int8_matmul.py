@@ -38,8 +38,10 @@ def _quant_rows(x: jnp.ndarray):
 
 
 def _quant_cols(w: jnp.ndarray):
-    """[K, N] → int8 values + fp32 scale per output column."""
-    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0, keepdims=True)
+    """[..., K, N] → int8 values + fp32 scale per output column (the K
+    contraction axis is reduced; leading dims — e.g. the MoE expert dim —
+    are preserved)."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
     scale = jnp.maximum(absmax, 1e-30) / 127.0
     q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
                  -127, 127).astype(jnp.int8)
@@ -83,3 +85,40 @@ def int8_matmul(x: jnp.ndarray, w: jnp.ndarray,
     skips a downcast when the consumer wants full precision (the lm head's
     logits feeding the loss softmax)."""
     return _int8_matmul(x, w, out_dtype)
+
+
+# ------------------------------------------------------------ batched (MoE)
+def _fwd_impl_batched(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    xq, sx = _quant_rows(x)                                 # [E, ..., K]
+    wq, sw = _quant_cols(w)                                 # [E, K, N]
+    y = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32)                   # [E, ..., N]
+    sw_b = sw.reshape((w.shape[0],) + (1,) * (x.ndim - 2) + (w.shape[2],))
+    return (y.astype(jnp.float32) * sx * sw_b).astype(x.dtype)
+
+
+@jax.custom_vjp
+def int8_matmul_batched(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Per-expert ``x[e] @ w[e]`` with int8 forward, bf16 backward.
+
+    x: [E, ..., K] dispatched expert inputs, w: [E, K, N] stacked expert
+    weights (the MoE layout, `tpu_on_k8s/models/moe.py`). Same SwitchBack
+    scheme as ``int8_matmul``, batched over the leading expert dim so the
+    expert axis stays a dot batch dim (sharding over the mesh ``expert``
+    axis passes through unchanged)."""
+    return _fwd_impl_batched(x, w)
+
+
+def _fwd_b(x, w):
+    return _fwd_impl_batched(x, w), (x, w)
+
+
+def _bwd_b(res, g):
+    x, w = res
+    dx = jnp.einsum("e...n,ekn->e...k", g, w).astype(x.dtype)
+    dw = jnp.einsum("e...k,e...n->ekn", x, g).astype(w.dtype)
+    return dx, dw
+
+
+int8_matmul_batched.defvjp(_fwd_b, _bwd_b)

@@ -18,6 +18,14 @@ from tpu_on_k8s.api.model_types import ModelVersion, Storage
 
 @dataclass
 class PersistentVolumeSpec:
+    """Flat internal fields; the wire hooks speak real core/v1
+    PersistentVolumeSpec — ``capacity: {storage: "NGi"}``, nested
+    ``hostPath``/``nfs`` sources, ``claimRef: {namespace, name}``,
+    ``nodeAffinity`` for the local pin, and the GCS flavor as the GKE
+    GCS-FUSE CSI source (``csi.driver: gcsfuse.csi.storage.gke.io``) — so a
+    real apiserver accepts the ModelVersion pipeline's PVs instead of
+    pruning them to empty specs."""
+
     capacity_gi: int = 10
     access_modes: list = field(default_factory=lambda: ["ReadWriteOnce"])
     host_path: Optional[str] = None
@@ -26,7 +34,91 @@ class PersistentVolumeSpec:
     nfs_path: Optional[str] = None
     gcs_bucket: Optional[str] = None
     gcs_prefix: Optional[str] = None
-    claim_ref: str = ""
+    claim_ref: str = ""              # "namespace/name" of the bound claim
+
+    _GCS_DRIVER = "gcsfuse.csi.storage.gke.io"
+
+    @staticmethod
+    def __wire_out__(d):
+        out: dict = {"capacity": {"storage": f"{d.pop('capacityGi', 10)}Gi"}}
+        if d.get("accessModes"):
+            out["accessModes"] = d["accessModes"]
+        if d.get("hostPath"):
+            out["hostPath"] = {"path": d["hostPath"]}
+        if d.get("nfsServer"):
+            out["nfs"] = {"server": d["nfsServer"],
+                          "path": d.get("nfsPath") or ""}
+        if d.get("gcsBucket"):
+            attrs = {}
+            if d.get("gcsPrefix"):
+                attrs["mountOptions"] = f"only-dir={d['gcsPrefix']}"
+            out["csi"] = {"driver": PersistentVolumeSpec._GCS_DRIVER,
+                          "volumeHandle": d["gcsBucket"],
+                          **({"volumeAttributes": attrs} if attrs else {})}
+        if d.get("claimRef"):
+            ns, _, name = d["claimRef"].partition("/")
+            out["claimRef"] = {"namespace": ns, "name": name,
+                              "kind": "PersistentVolumeClaim",
+                              "apiVersion": "v1"}
+        if d.get("nodeName"):
+            out["nodeAffinity"] = {"required": {"nodeSelectorTerms": [
+                {"matchExpressions": [{"key": "kubernetes.io/hostname",
+                                       "operator": "In",
+                                       "values": [d["nodeName"]]}]}]}}
+        return out
+
+    @staticmethod
+    def __wire_in__(d):
+        if "capacity" not in d and "claimRef" not in d and \
+                "nodeAffinity" not in d and not any(
+                    isinstance(d.get(k), dict) for k in ("hostPath", "nfs",
+                                                         "csi")):
+            return d  # internal snake_case form
+        out: dict = {}
+        cap = d.get("capacity")
+        if isinstance(cap, dict) and cap.get("storage"):
+            out["capacity_gi"] = _parse_gi(cap["storage"])
+        if d.get("accessModes"):
+            out["access_modes"] = d["accessModes"]
+        hp = d.get("hostPath")
+        if isinstance(hp, dict):
+            out["host_path"] = hp.get("path")
+        nfs = d.get("nfs")
+        if isinstance(nfs, dict):
+            out["nfs_server"] = nfs.get("server")
+            out["nfs_path"] = nfs.get("path")
+        csi = d.get("csi")
+        if isinstance(csi, dict) and \
+                csi.get("driver") == PersistentVolumeSpec._GCS_DRIVER:
+            out["gcs_bucket"] = csi.get("volumeHandle")
+            mo = (csi.get("volumeAttributes") or {}).get("mountOptions", "")
+            if mo.startswith("only-dir="):
+                out["gcs_prefix"] = mo[len("only-dir="):]
+        cr = d.get("claimRef")
+        if isinstance(cr, dict):
+            out["claim_ref"] = f"{cr.get('namespace', '')}/{cr.get('name', '')}"
+        na = d.get("nodeAffinity")
+        if isinstance(na, dict):
+            try:
+                expr = na["required"]["nodeSelectorTerms"][0][
+                    "matchExpressions"][0]
+                if expr.get("key") == "kubernetes.io/hostname":
+                    out["node_name"] = expr["values"][0]
+            except (KeyError, IndexError):
+                pass
+        return out
+
+
+def _parse_gi(quantity) -> int:
+    """Any k8s quantity → whole Gi ('10Gi'→10, '500Mi'→1, '1Ti'→1024).
+
+    Delegates to serde's general quantity parser; floors at 1Gi since the
+    internal fields are whole-Gi sizes."""
+    from tpu_on_k8s.utils.serde import _parse_quantity
+
+    if isinstance(quantity, (int, float)):
+        return max(1, round(float(quantity) / 2**30))
+    return max(1, round(_parse_quantity(str(quantity)) / 2**30))
 
 
 @dataclass
@@ -44,8 +136,37 @@ class PersistentVolumeClaimStatus:
 
 @dataclass
 class PersistentVolumeClaimSpec:
+    """Wire hooks emit the conformant core/v1 shape: ``resources.requests.
+    storage`` as a quantity and ``accessModes`` (required by real apiserver
+    validation — a claim without them is rejected)."""
+
     volume_name: str = ""
     storage_gi: int = 10
+    access_modes: list = field(default_factory=lambda: ["ReadWriteOnce"])
+
+    @staticmethod
+    def __wire_out__(d):
+        out: dict = {
+            "accessModes": d.get("accessModes") or ["ReadWriteOnce"],
+            "resources": {"requests": {
+                "storage": f"{d.get('storageGi', 10)}Gi"}},
+        }
+        if d.get("volumeName"):
+            out["volumeName"] = d["volumeName"]
+        return out
+
+    @staticmethod
+    def __wire_in__(d):
+        res = d.get("resources")
+        if not isinstance(res, dict):
+            return d  # internal snake_case form
+        out: dict = {"volume_name": d.get("volumeName") or ""}
+        if d.get("accessModes"):
+            out["access_modes"] = d["accessModes"]
+        storage = (res.get("requests") or {}).get("storage")
+        if storage is not None:
+            out["storage_gi"] = _parse_gi(storage)
+        return out
 
 
 @dataclass

@@ -97,6 +97,24 @@ def to_dict(obj: Any, *, drop_none: bool = True, wire: bool = False) -> Any:
     return obj
 
 
+_QUANTITY_SUFFIX = {"m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12,
+                    "P": 1e15, "Ki": 2**10, "Mi": 2**20, "Gi": 2**30,
+                    "Ti": 2**40, "Pi": 2**50}
+
+
+def _parse_quantity(s: str) -> float:
+    """k8s resource.Quantity string → float ("4"→4, "500m"→0.5, "20Gi"→…).
+
+    Real apiservers serialize quantities (ResourceQuota hard/used, resource
+    requests) as strings; internal maps are plain floats, so float-typed
+    fields accept the wire form here."""
+    s = s.strip()
+    for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "m", "k", "M", "G", "T", "P"):
+        if s.endswith(suf):
+            return float(s[:-len(suf)]) * _QUANTITY_SUFFIX[suf]
+    return float(s)  # raises ValueError on junk, like any wire type error
+
+
 def _construct(tp: Any, data: Any) -> Any:
     if data is None:
         return None
@@ -139,6 +157,8 @@ def _construct(tp: Any, data: Any) -> Any:
             return _dt.datetime.fromisoformat(data)
         if tp is float and isinstance(data, (int, float)):
             return float(data)
+        if tp is float and isinstance(data, str):
+            return _parse_quantity(data)
         if tp is int and isinstance(data, str):
             # k8s serializes resourceVersion (and quantity-ish ints) as
             # opaque strings; accept numeric strings for int fields.
