@@ -10,7 +10,8 @@ the scheme popularized as SwitchBack; PAPERS.md int8-training entry):
 * ``y = (xq @ wq) · sx · sw`` accumulates in int32 on the MXU,
 * backward computes ``dx = g·wᵀ`` and ``dw = xᵀ·g`` in bf16 from the saved
   *unquantized* tensors, so optimizer updates see exact gradients of the
-  quantized forward's straight-through surrogate.
+  quantized forward's straight-through surrogate (the public
+  "SwitchBack" int8-forward linear-layer recipe).
 
 Quantization here is XLA-native (jnp round) so it fuses into the
 surrounding elementwise work; the Pallas stochastic-rounding kernels in
