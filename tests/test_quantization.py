@@ -172,3 +172,24 @@ def test_int8_moe_trains():
         first = first if first is not None else float(m["loss"])
     assert float(m["loss"]) < first
     assert bool(jnp.isfinite(m["aux_loss"]))
+
+
+def test_int8_matmul_pallas_matches_xla_path():
+    from tpu_on_k8s.ops.int8_matmul import int8_matmul, int8_matmul_pallas
+    k1, k2 = jax.random.split(jax.random.key(7))
+    x = jax.random.normal(k1, (4, 64, 128), jnp.bfloat16)
+    w = jax.random.normal(k2, (128, 256), jnp.bfloat16) * 0.1
+    a = int8_matmul(x, w)
+    b = int8_matmul_pallas(x, w, None, 64, 128, 64)
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32), atol=1e-2, rtol=1e-2)
+    ga = jax.grad(lambda x, w: jnp.sum(
+        int8_matmul(x, w).astype(jnp.float32)), (0, 1))(x, w)
+    gb = jax.grad(lambda x, w: jnp.sum(
+        int8_matmul_pallas(x, w, None, 64, 128, 64).astype(jnp.float32)),
+        (0, 1))(x, w)
+    for p, q in zip(ga, gb):
+        np.testing.assert_allclose(np.asarray(p, np.float32),
+                                   np.asarray(q, np.float32), atol=1e-2)
+    # non-tileable shape falls back to the XLA path instead of failing
+    assert int8_matmul_pallas(x[:, :33], w).shape == (4, 33, 256)

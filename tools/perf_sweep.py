@@ -49,6 +49,7 @@ def run_variant(spec: str) -> None:
     gateup = opts.pop("gateup", "0") == "1"  # fused gate+up MLP matmul
     hint8 = opts.pop("hint8", "0") == "1"    # int8-forward lm_head
     aint8 = opts.pop("aint8", "0") == "1"    # int8-forward attn projections
+    i8impl = opts.pop("i8impl", "xla")       # xla | pallas int8 matmul
     if opts:
         raise ValueError(f"unknown keys {list(opts)}")
 
@@ -66,6 +67,7 @@ def run_variant(spec: str) -> None:
            "mlp_fused_gateup": gateup,
            "head_int8": hint8,
            "attn_int8": aint8,
+           "int8_impl": i8impl,
            "remat": remat != "off",
            "remat_policy": remat if remat != "off" else "full"})
     devices = jax.devices()

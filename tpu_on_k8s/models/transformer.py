@@ -85,6 +85,10 @@ class TransformerConfig:
                                        # rate, bf16 backward. Opt-in: trades
                                        # forward quantization noise for
                                        # throughput.
+    int8_impl: str = "xla"             # "xla" (dot_general + fused-by-XLA
+                                       # dequant) | "pallas" (one kernel:
+                                       # int32 tile accumulator rescaled in
+                                       # VMEM, no HBM round trip)
     mlp_fused_gateup: bool = False     # one [D, 2·d_ff] matmul for SwiGLU's
                                        # gate+up (param mlp/w_gateup/kernel):
                                        # the activation is read/quantized
@@ -139,6 +143,18 @@ class TransformerConfig:
         return TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
                                  n_heads=4, n_kv_heads=2, d_ff=128,
                                  max_seq_len=128, remat=False)
+
+
+def _int8_mm(impl: str):
+    """The int8-forward matmul for ``cfg.int8_impl`` — shared by every int8
+    call site (MLP, attention projections, lm head). The batched MoE path
+    stays XLA (no batched Pallas kernel)."""
+    from tpu_on_k8s.ops.int8_matmul import int8_matmul, int8_matmul_pallas
+    if impl == "pallas":
+        return int8_matmul_pallas
+    if impl != "xla":
+        raise ValueError(f"unknown int8_impl {impl!r} (use 'xla'|'pallas')")
+    return int8_matmul
 
 
 def _dots_and_kernels_saveable(prim, *args, **params) -> bool:
@@ -275,6 +291,7 @@ class _HeadProj(nn.Module):
     dtype: Any
     param_dtype: Any
     int8: bool = False
+    int8_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -283,9 +300,8 @@ class _HeadProj(nn.Module):
                             (d_in, self.heads * self.head_dim),
                             self.param_dtype)
         if self.int8:
-            from tpu_on_k8s.ops.int8_matmul import int8_matmul
             b, l = x.shape[0], x.shape[1]
-            y = int8_matmul(x, kernel.astype(self.dtype))
+            y = _int8_mm(self.int8_impl)(x, kernel.astype(self.dtype))
             return y.reshape(b, l, self.heads,
                              self.head_dim).transpose(0, 2, 1, 3)
         k3 = kernel.reshape(d_in, self.heads, self.head_dim).astype(self.dtype)
@@ -304,6 +320,7 @@ class _FusedQKVProj(nn.Module):
     dtype: Any
     param_dtype: Any
     int8: bool = False
+    int8_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray):
@@ -312,9 +329,8 @@ class _FusedQKVProj(nn.Module):
         kernel = self.param("kernel", nn.initializers.normal(0.02),
                             (d_in, total * self.head_dim), self.param_dtype)
         if self.int8:
-            from tpu_on_k8s.ops.int8_matmul import int8_matmul
             b, l = x.shape[0], x.shape[1]
-            y = int8_matmul(x, kernel.astype(self.dtype))
+            y = _int8_mm(self.int8_impl)(x, kernel.astype(self.dtype))
             qkv = y.reshape(b, l, total, self.head_dim).transpose(0, 2, 1, 3)
         else:
             k3 = kernel.reshape(d_in, total,
@@ -334,6 +350,7 @@ class _OutProj(nn.Module):
     dtype: Any
     param_dtype: Any
     int8: bool = False
+    int8_impl: str = "xla"
 
     @nn.compact
     def __call__(self, o: jnp.ndarray) -> jnp.ndarray:
@@ -341,10 +358,9 @@ class _OutProj(nn.Module):
                             (self.heads * self.head_dim, self.d_model),
                             self.param_dtype)
         if self.int8:
-            from tpu_on_k8s.ops.int8_matmul import int8_matmul
             b, h, l, f = o.shape
             flat = o.transpose(0, 2, 1, 3).reshape(b, l, h * f)
-            return int8_matmul(flat, kernel.astype(self.dtype))
+            return _int8_mm(self.int8_impl)(flat, kernel.astype(self.dtype))
         k3 = kernel.reshape(self.heads, self.head_dim,
                             self.d_model).astype(self.dtype)
         return jnp.einsum("bhlf,hfd->bld", o, k3)
@@ -401,11 +417,14 @@ class Attention(nn.Module):
         if cfg.fused_qkv:
             q, k, v = _FusedQKVProj(cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
                                     cfg.dtype, cfg.param_dtype,
-                                    int8=cfg.attn_int8, name="wqkv")(x)
+                                    int8=cfg.attn_int8,
+                                    int8_impl=cfg.int8_impl, name="wqkv")(x)
         else:
             hp = lambda heads, name: _HeadProj(heads, cfg.head_dim, cfg.dtype,
                                                cfg.param_dtype,
-                                               int8=cfg.attn_int8, name=name)
+                                               int8=cfg.attn_int8,
+                                               int8_impl=cfg.int8_impl,
+                                               name=name)
             q = hp(cfg.n_heads, "wq")(x)          # [B, H, L, Dh]
             k = hp(cfg.n_kv_heads, "wk")(x)       # [B, Hkv, L, Dh]
             v = hp(cfg.n_kv_heads, "wv")(x)
@@ -441,7 +460,8 @@ class Attention(nn.Module):
             v = jnp.repeat(v, rep, axis=1)
             out = xla_attention_bhld(q, k, v, causal=True)
         return _OutProj(cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.dtype,
-                        cfg.param_dtype, int8=cfg.attn_int8, name="wo")(out)
+                        cfg.param_dtype, int8=cfg.attn_int8,
+                        int8_impl=cfg.int8_impl, name="wo")(out)
 
     def _cached_attention(self, q, k, v, positions, rep: int) -> jnp.ndarray:
         """KV-cache attention: append this call's keys/values at the cache
@@ -479,13 +499,13 @@ class _Int8Dense(nn.Module):
     features: int
     dtype: Any
     param_dtype: Any
+    impl: str = "xla"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        from tpu_on_k8s.ops.int8_matmul import int8_matmul
         kernel = self.param("kernel", nn.initializers.normal(0.02),
                             (x.shape[-1], self.features), self.param_dtype)
-        return int8_matmul(x, kernel.astype(self.dtype))
+        return _int8_mm(self.impl)(x, kernel.astype(self.dtype))
 
 
 class MLP(nn.Module):
@@ -496,7 +516,8 @@ class MLP(nn.Module):
         cfg = self.cfg
         if cfg.mlp_int8:
             dense = lambda feats, name: _Int8Dense(
-                feats, name=name, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+                feats, name=name, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                impl=cfg.int8_impl)
         else:
             dense = lambda feats, name: nn.Dense(
                 feats, use_bias=False, name=name, dtype=cfg.dtype,
@@ -568,8 +589,8 @@ class Transformer(nn.Module):
                  positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         x, head = self._trunk(tokens, positions)
         if self.cfg.head_int8:
-            from tpu_on_k8s.ops.int8_matmul import int8_matmul
-            return int8_matmul(x, head, out_dtype=jnp.float32)
+            return _int8_mm(self.cfg.int8_impl)(x, head,
+                                                out_dtype=jnp.float32)
         # fp32 logits: the loss softmax wants full precision.
         return jnp.einsum("bld,dv->blv", x, head,
                           preferred_element_type=jnp.float32)

@@ -2,8 +2,7 @@
 
 The v5e MXU runs s8×s8→s32 at twice its bf16 rate, so for
 bandwidth-resident models the big MLP matmuls can take the int8 path in the
-*forward* pass while the backward stays bf16 (full-precision gradients —
-the scheme popularized as SwitchBack; PAPERS.md int8-training entry):
+*forward* pass while the backward stays bf16 (full-precision gradients):
 
 * activations quantize row-wise (one scale per token row),
 * weights quantize column-wise (one scale per output feature),
@@ -27,6 +26,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
 def _quant_rows(x: jnp.ndarray):
@@ -123,3 +124,96 @@ def _bwd_b(res, g):
 
 
 int8_matmul_batched.defvjp(_fwd_b, _bwd_b)
+
+
+# ------------------------------------------------- Pallas fused-dequant path
+def _mm_kernel(xq_ref, sx_ref, wq_ref, sw_ref, o_ref, acc_ref, *, nk):
+    """One (bm, bn) output tile: int8×int8→int32 accumulation over the K
+    grid axis, dequant epilogue fused on the last K step — the int32
+    accumulator never touches HBM (the XLA path materializes it)."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        xq_ref[...], wq_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(k == nk - 1)
+    def _epilogue():
+        o_ref[...] = (acc_ref[...].astype(jnp.float32)
+                      * sx_ref[...] * sw_ref[...]).astype(o_ref.dtype)
+
+
+def _fwd_impl_pallas(x: jnp.ndarray, w: jnp.ndarray, out_dtype,
+                     bm: int, bn: int, bk: int) -> jnp.ndarray:
+    lead = x.shape[:-1]
+    m = 1
+    for s in lead:
+        m *= s
+    k_dim, n = w.shape
+    bm, bn, bk = min(bm, m), min(bn, n), min(bk, k_dim)
+    # The int8 Mosaic tile is (32, 128): bm/bk/bn must respect it and divide
+    # their dims, else fall back to the XLA path BEFORE any quantization
+    # work (also covers empty dims: min(...) == 0 → fallback).
+    tileable = (bm > 0 and bn > 0 and bk > 0
+                and m % bm == 0 and n % bn == 0 and k_dim % bk == 0
+                and bm % 32 == 0 and bk % 128 == 0 and bn % 128 == 0)
+    if not tileable:
+        return _fwd_impl(x, w, out_dtype)
+    x2 = x.reshape(m, k_dim)
+    xq, sx = _quant_rows(x2)
+    wq, sw = _quant_cols(w)
+    nk = k_dim // bk
+    out = pl.pallas_call(
+        functools.partial(_mm_kernel, nk=nk),
+        grid=(m // bm, n // bn, nk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bm, 1), lambda i, j, k: (i, 0)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype or x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(xq, sx, wq, sw)
+    return out.reshape(*lead, n)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _int8_matmul_pallas(x, w, out_dtype, bm, bn, bk):
+    return _fwd_impl_pallas(x, w, out_dtype, bm, bn, bk)
+
+
+def _fwd_p(x, w, out_dtype, bm, bn, bk):
+    return _fwd_impl_pallas(x, w, out_dtype, bm, bn, bk), (x, w)
+
+
+def _bwd_p(out_dtype, bm, bn, bk, res, g):
+    return _bwd(out_dtype, res, g)
+
+
+_int8_matmul_pallas.defvjp(_fwd_p, _bwd_p)
+
+
+def int8_matmul_pallas(x: jnp.ndarray, w: jnp.ndarray, out_dtype=None,
+                       bm: int = 512, bn: int = 1024,
+                       bk: int = 512) -> jnp.ndarray:
+    """``int8_matmul`` with the matmul+dequant as one Pallas kernel.
+
+    Same quantization and exact-bf16 backward as ``int8_matmul``; the
+    difference is the epilogue: the int32 tile accumulator is rescaled in
+    VMEM and written once as bf16, instead of round-tripping an int32
+    [M, N] product through HBM. Falls back to the XLA path for shapes the
+    (bm, bn, bk) tiling can't cover."""
+    return _int8_matmul_pallas(x, w, out_dtype, bm, bn, bk)
