@@ -180,13 +180,15 @@ def test_int8_matmul_pallas_matches_xla_path():
     x = jax.random.normal(k1, (4, 64, 128), jnp.bfloat16)
     w = jax.random.normal(k2, (128, 256), jnp.bfloat16) * 0.1
     a = int8_matmul(x, w)
-    b = int8_matmul_pallas(x, w, None, 64, 128, 64)
+    # blocks chosen to satisfy the int8 Mosaic tile guard (bm%32, bk%128,
+    # bn%128) so the Pallas kernel itself runs, not the fallback
+    b = int8_matmul_pallas(x, w, None, 64, 128, 128)
     np.testing.assert_allclose(np.asarray(a, np.float32),
                                np.asarray(b, np.float32), atol=1e-2, rtol=1e-2)
     ga = jax.grad(lambda x, w: jnp.sum(
         int8_matmul(x, w).astype(jnp.float32)), (0, 1))(x, w)
     gb = jax.grad(lambda x, w: jnp.sum(
-        int8_matmul_pallas(x, w, None, 64, 128, 64).astype(jnp.float32)),
+        int8_matmul_pallas(x, w, None, 64, 128, 128).astype(jnp.float32)),
         (0, 1))(x, w)
     for p, q in zip(ga, gb):
         np.testing.assert_allclose(np.asarray(p, np.float32),
