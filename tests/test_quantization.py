@@ -174,22 +174,35 @@ def test_int8_moe_trains():
     assert bool(jnp.isfinite(m["aux_loss"]))
 
 
-def test_int8_matmul_pallas_matches_xla_path():
+def test_int8_matmul_pallas_matches_xla_path(monkeypatch):
+    import tpu_on_k8s.ops.int8_matmul as int8_mod
     from tpu_on_k8s.ops.int8_matmul import int8_matmul, int8_matmul_pallas
+
+    # the kernel, not the fallback, must run for the parity blocks below —
+    # if the tileability guard ever tightens past them, fail loudly instead
+    # of comparing the XLA path with itself
+    fallback = int8_mod._fwd_impl
+
+    def guarded(*a):
+        raise AssertionError("pallas parity test fell back to the XLA path")
     k1, k2 = jax.random.split(jax.random.key(7))
     x = jax.random.normal(k1, (4, 64, 128), jnp.bfloat16)
     w = jax.random.normal(k2, (128, 256), jnp.bfloat16) * 0.1
     a = int8_matmul(x, w)
     # blocks chosen to satisfy the int8 Mosaic tile guard (bm%32, bk%128,
     # bn%128) so the Pallas kernel itself runs, not the fallback
+    monkeypatch.setattr(int8_mod, "_fwd_impl", guarded)
     b = int8_matmul_pallas(x, w, None, 64, 128, 128)
+    gb = jax.grad(lambda x, w: jnp.sum(
+        int8_matmul_pallas(x, w, None, 64, 128, 128).astype(jnp.float32)),
+        (0, 1))(x, w)
+    # restore before the XLA-path calls below (they legitimately use
+    # _fwd_impl)
+    monkeypatch.setattr(int8_mod, "_fwd_impl", fallback)
     np.testing.assert_allclose(np.asarray(a, np.float32),
                                np.asarray(b, np.float32), atol=1e-2, rtol=1e-2)
     ga = jax.grad(lambda x, w: jnp.sum(
         int8_matmul(x, w).astype(jnp.float32)), (0, 1))(x, w)
-    gb = jax.grad(lambda x, w: jnp.sum(
-        int8_matmul_pallas(x, w, None, 64, 128, 128).astype(jnp.float32)),
-        (0, 1))(x, w)
     for p, q in zip(ga, gb):
         np.testing.assert_allclose(np.asarray(p, np.float32),
                                    np.asarray(q, np.float32), atol=1e-2)

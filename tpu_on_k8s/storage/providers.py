@@ -56,10 +56,13 @@ class PersistentVolumeSpec:
                           "volumeHandle": d["gcsBucket"],
                           **({"volumeAttributes": attrs} if attrs else {})}
         if d.get("claimRef"):
-            ns, _, name = d["claimRef"].partition("/")
-            out["claimRef"] = {"namespace": ns, "name": name,
-                              "kind": "PersistentVolumeClaim",
-                              "apiVersion": "v1"}
+            ns, sep, name = d["claimRef"].partition("/")
+            if not sep:                      # bare claim name, no namespace
+                ns, name = "", ns
+            out["claimRef"] = {**({"namespace": ns} if ns else {}),
+                               "name": name,
+                               "kind": "PersistentVolumeClaim",
+                               "apiVersion": "v1"}
         if d.get("nodeName"):
             out["nodeAffinity"] = {"required": {"nodeSelectorTerms": [
                 {"matchExpressions": [{"key": "kubernetes.io/hostname",
@@ -96,7 +99,8 @@ class PersistentVolumeSpec:
                 out["gcs_prefix"] = mo[len("only-dir="):]
         cr = d.get("claimRef")
         if isinstance(cr, dict):
-            out["claim_ref"] = f"{cr.get('namespace', '')}/{cr.get('name', '')}"
+            ns, name = cr.get("namespace", ""), cr.get("name", "")
+            out["claim_ref"] = f"{ns}/{name}" if ns else name
         na = d.get("nodeAffinity")
         if isinstance(na, dict):
             try:
