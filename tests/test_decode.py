@@ -109,3 +109,27 @@ def test_generate_with_fused_qkv_checkpoint():
     out = generate(cfg, params, prompt, 4)
     assert out.shape == (2, 4)
     assert bool((out >= 0).all())
+
+
+def test_chunked_prefill_into_nonempty_cache_is_exact():
+    """Multi-token appends at a nonzero cursor (chunked prefill) must match
+    the full forward pass — the fast among-prompt path only fires on an
+    empty cache (lax.cond on the cursor)."""
+    cfg = TransformerConfig.tiny()
+    full = Transformer(cfg)
+    tok = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size,
+                             jnp.int32)
+    params = full.init(jax.random.key(0), tok)["params"]
+    ref = full.apply({"params": params}, tok)
+
+    dm = decode_model(cfg)
+    cache = init_cache(dm, 2)
+    pos = jnp.broadcast_to(jnp.arange(16), (2, 16))
+    l1, upd = dm.apply({"params": params, "cache": cache}, tok[:, :8],
+                       pos[:, :8], mutable=["cache"])
+    l2, upd = dm.apply({"params": params, "cache": upd["cache"]}, tok[:, 8:],
+                       pos[:, 8:], mutable=["cache"])
+    got = jnp.concatenate([l1, l2], axis=1)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)

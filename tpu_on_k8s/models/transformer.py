@@ -466,7 +466,14 @@ class Attention(nn.Module):
     def _cached_attention(self, q, k, v, positions, rep: int) -> jnp.ndarray:
         """KV-cache attention: append this call's keys/values at the cache
         cursor, attend over every cached position ≤ the query position.
-        Serves both prefill (L>1) and single-token steps (L=1)."""
+        Serves both prefill (L>1) and single-token steps (L=1).
+
+        Prefill (a multi-token call into an empty cache — how ``generate``
+        always starts) attends only among the L prompt tokens instead of
+        over the full ``max_seq_len`` cache: O(L²/2) masked work instead of
+        O(L·max), via the flash kernel when L has a legal block. The cache
+        still fills so the scan steps that follow see every prompt
+        position."""
         cfg = self.cfg
         b, l = q.shape[0], q.shape[1]
         shape = (b, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim)
@@ -478,17 +485,46 @@ class Attention(nn.Module):
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, start, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
         cursor.value = start + l
-        k_all = jnp.repeat(ck.value, rep, axis=2)    # [B, max, H, Dh]
-        v_all = jnp.repeat(cv.value, rep, axis=2)
-        scale = cfg.head_dim ** -0.5
-        logits = jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32) * scale,
-                            k_all.astype(jnp.float32),
-                            preferred_element_type=jnp.float32)
-        k_pos = jnp.arange(cfg.max_seq_len)
-        mask = k_pos[None, None, None, :] <= positions[:, None, :, None]
-        probs = jax.nn.softmax(
-            jnp.where(mask, logits, -1e30), axis=-1).astype(q.dtype)
-        return jnp.einsum("bhlm,bmhd->blhd", probs, v_all)
+
+        def over_cache(_):
+            """Attend over the whole cache, masked to ≤ query position —
+            correct for any cursor (chunked prefill, single-token steps)."""
+            k_all = jnp.repeat(ck.value, rep, axis=2)    # [B, max, H, Dh]
+            v_all = jnp.repeat(cv.value, rep, axis=2)
+            scale = cfg.head_dim ** -0.5
+            logits = jnp.einsum("blhd,bmhd->bhlm",
+                                q.astype(jnp.float32) * scale,
+                                k_all.astype(jnp.float32),
+                                preferred_element_type=jnp.float32)
+            k_pos = jnp.arange(cfg.max_seq_len)
+            mask = k_pos[None, None, None, :] <= positions[:, None, :, None]
+            probs = jax.nn.softmax(
+                jnp.where(mask, logits, -1e30), axis=-1).astype(q.dtype)
+            return jnp.einsum("bhlm,bmhd->blhd", probs, v_all)
+
+        if l == 1:
+            return over_cache(None)
+
+        def among_prompt(_):
+            """Empty-cache prefill (how ``generate`` always starts): attend
+            causally among the L prompt tokens only — O(L²/2) instead of
+            O(L·max), flash-kernelled when L has a legal block (the kernel
+            takes the Hkv-head k/v natively, no repeat materialized)."""
+            try:
+                from tpu_on_k8s.ops.flash_attention import (
+                    auto_block,
+                    flash_attention,
+                )
+                auto_block(l)
+            except (ImportError, ValueError):
+                return xla_attention(q, jnp.repeat(k, rep, axis=2),
+                                     jnp.repeat(v, rep, axis=2), causal=True)
+            return flash_attention(q, k, v, causal=True)
+
+        # Both branches compile; the cursor picks at run time, so chunked
+        # appends into a non-empty cache stay exact while the common
+        # fresh-prefill takes the fast path.
+        return jax.lax.cond(start == 0, among_prompt, over_cache, None)
 
 
 class _Int8Dense(nn.Module):
