@@ -116,6 +116,33 @@ def cpu_part() -> None:
             f"temp {temp} suspiciously close to naive {naive_scores}")
     _merge({"ring_32k_dryrun": record})
 
+    # --- 16k Ulysses step: the all-to-all flavor of seq parallelism --------
+    seq = 16384
+    from tpu_on_k8s.models.transformer import TransformerConfig
+    ucfg = TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=1, n_heads=8, n_kv_heads=8,
+        d_ff=64, max_seq_len=seq, remat=False, attn_impl="ulysses")
+    tokens = jax.random.randint(jax.random.key(3), (1, seq + 1), 0,
+                                ucfg.vocab_size, jnp.int32)
+    t0 = time.perf_counter()
+    loss, mem = _loss_fn(ucfg, mesh, tokens, rules)
+    naive = ucfg.n_heads * seq * seq * 4
+    temp = getattr(mem, "temp_size_in_bytes", None)
+    record = {
+        "seq": seq, "devices": 8, "mesh": "seq=8 (heads after all-to-all)",
+        "loss": loss, "loss_finite": bool(jnp.isfinite(loss)),
+        "wall_s_cpu": round(time.perf_counter() - t0, 1),
+        "per_device_temp_bytes": temp,
+        "naive_score_matrix_bytes": naive,
+        "temp_vs_naive": (round(temp / naive, 4)
+                          if isinstance(temp, int) and temp else None),
+    }
+    assert record["loss_finite"], f"ulysses 16k loss not finite: {loss}"
+    if isinstance(temp, int) and temp:
+        assert temp < naive / 10, (
+            f"ulysses temp {temp} suspiciously close to naive {naive}")
+    _merge({"ulysses_16k_dryrun": record})
+
     # --- parity at 4096: ring vs single-device XLA on identical params -----
     seq = 4096
     cfg_r = _tiny_cfg(seq, "ring")

@@ -508,23 +508,33 @@ class Attention(nn.Module):
         def among_prompt(_):
             """Empty-cache prefill (how ``generate`` always starts): attend
             causally among the L prompt tokens only — O(L²/2) instead of
-            O(L·max), flash-kernelled when L has a legal block (the kernel
-            takes the Hkv-head k/v natively, no repeat materialized)."""
-            try:
-                from tpu_on_k8s.ops.flash_attention import (
-                    auto_block,
-                    flash_attention,
-                )
-                auto_block(l)
-            except (ImportError, ValueError):
-                return xla_attention(q, jnp.repeat(k, rep, axis=2),
-                                     jnp.repeat(v, rep, axis=2), causal=True)
-            return flash_attention(q, k, v, causal=True)
+            O(L·max). On an accelerator backend the flash kernel serves it
+            when L has a legal block (Hkv-head k/v fed natively, no repeat
+            materialized); on CPU the XLA einsum stays faster than Pallas
+            interpret mode."""
+            use_flash = jax.default_backend() != "cpu"
+            if use_flash:
+                try:
+                    from tpu_on_k8s.ops.flash_attention import (
+                        auto_block,
+                        flash_attention,
+                    )
+                    auto_block(l)
+                except (ImportError, ValueError):
+                    use_flash = False
+            if use_flash:
+                return flash_attention(q, k, v, causal=True)
+            return xla_attention(q, jnp.repeat(k, rep, axis=2),
+                                 jnp.repeat(v, rep, axis=2), causal=True)
 
-        # Both branches compile; the cursor picks at run time, so chunked
-        # appends into a non-empty cache stay exact while the common
-        # fresh-prefill takes the fast path.
-        return jax.lax.cond(start == 0, among_prompt, over_cache, None)
+        # Both branches compile; the condition picks at run time, so chunked
+        # appends into a non-empty cache — or a fresh prefill whose
+        # positions are NOT the plain arange the causal mask assumes (e.g.
+        # clamped pad positions) — stay on the exact over-cache semantics.
+        fresh = jnp.logical_and(
+            start == 0,
+            jnp.all(positions == jnp.arange(l, dtype=positions.dtype)[None]))
+        return jax.lax.cond(fresh, among_prompt, over_cache, None)
 
 
 class _Int8Dense(nn.Module):
