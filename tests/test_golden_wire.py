@@ -478,3 +478,39 @@ def test_quantity_strings_decode():
     assert rq.spec.hard["cpu"] == 0.5
     assert rq.spec.hard["memory"] == 20 * 2**30
     assert rq.status.used["google.com/tpu"] == 4.0
+
+
+def test_headless_service_wire(server):
+    """The engine's per-task headless Service speaks real core/v1:
+    clusterIP (capitalized IP) 'None', selector, named port."""
+    from tpu_on_k8s.api.core import (
+        ObjectMeta,
+        OwnerReference,
+        Service,
+        ServicePort,
+        ServiceSpec,
+    )
+
+    script, url = server
+    fx = fixture("service_create_request.json")
+    script.canned("POST", fx["path"], 201, fx["body"])
+    labels = {"distributed.tpu.io/job-name": "mnist",
+              "distributed.tpu.io/task-type": "Worker",
+              "distributed.tpu.io/task-index": "0"}
+    svc = Service(
+        metadata=ObjectMeta(
+            name="mnist-worker-0", namespace="default", labels=dict(labels),
+            owner_references=[OwnerReference(
+                api_version="distributed.tpu.io/v1alpha1", kind="TPUJob",
+                name="mnist", uid="7f9a9d2e-0000-4a7b-9d2f-0123456789ab",
+                controller=True, block_owner_deletion=True)]),
+        spec=ServiceSpec(cluster_ip="None", selector=dict(labels),
+                         ports=[ServicePort(name="coordinator", port=8471,
+                                            target_port=8471)]))
+    made = RestCluster(url).create(svc)
+    method, path, ctype, body = script.requests[0]
+    assert (method, path, ctype) == (fx["method"], fx["path"],
+                                     fx["contentType"])
+    assert body == fx["body"]
+    assert made.spec.cluster_ip == "None"
+    assert made.spec.ports[0].target_port == 8471
