@@ -40,9 +40,15 @@ def main(argv=None) -> float:
                    help="layers per scan step; 0 = fully unrolled "
                         "(~60s compile, +6% steps/s at the bench shape)")
     p.add_argument("--int8", action="store_true",
-                   help="int8-forward MLP matmuls + fused gate+up (the "
-                        "measured bench recipe, +4% on v5e; exact bf16 "
-                        "backward — see ops/int8_matmul.py)")
+                   help="int8-forward MLP matmuls + fused gate+up (+4% on "
+                        "v5e; exact bf16 backward — see ops/int8_matmul.py). "
+                        "Combine with --bf16-moments for the full measured "
+                        "bench recipe")
+    p.add_argument("--bf16-moments", action="store_true",
+                   help="store Adam moments in bfloat16 (the measured bench "
+                        "recipe); off = fp32 moments, the historical "
+                        "default, so optimizer numerics never change "
+                        "implicitly")
     args = p.parse_args(argv)
     ctx, mesh = bring_up(args)
 
@@ -55,9 +61,9 @@ def main(argv=None) -> float:
                               scan_unroll=args.unroll or cfg.n_layers,
                               mlp_int8=args.int8, mlp_fused_gateup=args.int8)
     model = Transformer(cfg)
+    moment_dtype = jnp.bfloat16 if args.bf16_moments else None
     opt = default_optimizer(warmup_steps=10, decay_steps=max(args.steps, 11),
-                            mu_dtype=jnp.bfloat16,
-                            nu_dtype=jnp.bfloat16 if args.int8 else None)
+                            mu_dtype=moment_dtype, nu_dtype=moment_dtype)
     trainer = Trainer(model, flagship_partition_rules(), mesh, opt)
 
     global_batch = args.batch_per_host * ctx.num_processes

@@ -135,18 +135,19 @@ class RestCluster:
 
     def _headers(self, content_type: Optional[str]) -> Dict[str, str]:
         headers = {"Content-Type": content_type} if content_type else {}
-        # client-go precedence: an inline token wins over tokenFile; the
-        # file is re-read per request (SA tokens rotate) and an unreadable
-        # file degrades to the inline token rather than to no auth at all.
+        # client-go precedence: an inline token wins over tokenFile (the
+        # file is not even read then); with only a tokenFile it is re-read
+        # per request (SA tokens rotate), and an unreadable file degrades
+        # to no auth — the server's 401 is the actionable signal.
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
-        if self._token_path:
+        elif self._token_path:
             try:
                 with open(self._token_path) as f:
                     file_token = f.read().strip()
             except OSError:
                 file_token = None
-            if file_token and not self._token:
+            if file_token:
                 headers["Authorization"] = f"Bearer {file_token}"
         return headers
 

@@ -30,14 +30,19 @@ def decode_model(cfg: TransformerConfig) -> Transformer:
         cfg, decode=True, remat=False, attn_impl="xla"))
 
 
-def init_cache(model: Transformer, batch: int) -> dict:
-    """Zeroed cache pytree for a given generation batch size (shapes via
+def cache_shapes(model: Transformer, batch: int) -> dict:
+    """Abstract cache pytree shapes for a generation batch size (via
     ``eval_shape`` — no parameter initialization or tracing work)."""
     tokens = jnp.zeros((batch, 1), jnp.int32)
     shapes = jax.eval_shape(model.init, jax.random.key(0), tokens,
                             jnp.zeros((batch, 1), jnp.int32))
+    return shapes["cache"]
+
+
+def init_cache(model: Transformer, batch: int) -> dict:
+    """Zeroed cache pytree for a given generation batch size."""
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
-                        shapes["cache"])
+                        cache_shapes(model, batch))
 
 
 @functools.lru_cache(maxsize=32)
@@ -47,8 +52,11 @@ def _compiled_generate(cfg: TransformerConfig, b: int, lp: int,
     ``generate()`` calls (a serving loop) reuse it instead of re-tracing.
     The config is a frozen dataclass, so it keys the cache directly."""
     model = decode_model(cfg)
-    cache0 = init_cache(model, b)   # built once per cache entry; run() does
-                                    # not donate it, so reuse is safe
+    # Abstract shapes only — the zeroed cache is materialized *inside* the
+    # jitted program below, so an lru entry pins no device memory (a cached
+    # full-size cache pytree per (lp, temperature) key would otherwise hold
+    # ~hundreds of MB each across entries).
+    shapes = cache_shapes(model, b)
 
     def pick(logits: jnp.ndarray, step_rng: jax.Array) -> jnp.ndarray:
         if temperature <= 0.0:
@@ -57,7 +65,8 @@ def _compiled_generate(cfg: TransformerConfig, b: int, lp: int,
             step_rng, logits / temperature, axis=-1).astype(jnp.int32)
 
     @jax.jit
-    def run(params, prompt, cache, rng):
+    def run(params, prompt, rng):
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
         positions = jnp.broadcast_to(jnp.arange(lp), (b, lp))
         logits, upd = model.apply({"params": params, "cache": cache},
                                   prompt, positions, mutable=["cache"])
@@ -82,7 +91,7 @@ def _compiled_generate(cfg: TransformerConfig, b: int, lp: int,
             jax.random.split(step_key, max_new_tokens))
         return toks.transpose(1, 0)
 
-    return run, cache0
+    return run
 
 
 def generate(cfg: TransformerConfig, params, prompt: jnp.ndarray,
@@ -97,6 +106,6 @@ def generate(cfg: TransformerConfig, params, prompt: jnp.ndarray,
         raise ValueError(
             f"prompt {lp} + new {max_new_tokens} exceeds max_seq_len "
             f"{cfg.max_seq_len}")
-    run, cache = _compiled_generate(cfg, b, lp, max_new_tokens, temperature)
+    run = _compiled_generate(cfg, b, lp, max_new_tokens, temperature)
     rng = rng if rng is not None else jax.random.key(0)
-    return run(params, prompt, cache, rng)
+    return run(params, prompt, rng)

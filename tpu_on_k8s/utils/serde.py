@@ -84,8 +84,14 @@ def to_dict(obj: Any, *, drop_none: bool = True, wire: bool = False) -> Any:
     if isinstance(obj, enum.Enum):
         return obj.value
     if isinstance(obj, _dt.datetime):
-        s = obj.isoformat()
-        return s.replace("+00:00", "Z") if wire else s
+        if wire:
+            # RFC 3339 requires an offset; a real apiserver's strict parse
+            # rejects offset-less timestamps, so naive datetimes are treated
+            # as UTC on the wire.
+            if obj.tzinfo is None:
+                obj = obj.replace(tzinfo=_dt.timezone.utc)
+            return obj.isoformat().replace("+00:00", "Z")
+        return obj.isoformat()
     if isinstance(obj, dict):
         # Keys go through conversion too: task maps are keyed by TaskType
         # enums. Plain string keys are data, never renamed.
@@ -98,8 +104,8 @@ def to_dict(obj: Any, *, drop_none: bool = True, wire: bool = False) -> Any:
 
 
 _QUANTITY_SUFFIX = {"m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12,
-                    "P": 1e15, "Ki": 2**10, "Mi": 2**20, "Gi": 2**30,
-                    "Ti": 2**40, "Pi": 2**50}
+                    "P": 1e15, "E": 1e18, "Ki": 2**10, "Mi": 2**20,
+                    "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60}
 
 
 def _parse_quantity(s: str) -> float:
@@ -109,7 +115,8 @@ def _parse_quantity(s: str) -> float:
     requests) as strings; internal maps are plain floats, so float-typed
     fields accept the wire form here."""
     s = s.strip()
-    for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "m", "k", "M", "G", "T", "P"):
+    for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "Ei",
+                "m", "k", "M", "G", "T", "P", "E"):
         if s.endswith(suf):
             return float(s[:-len(suf)]) * _QUANTITY_SUFFIX[suf]
     return float(s)  # raises ValueError on junk, like any wire type error
