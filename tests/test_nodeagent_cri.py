@@ -1,0 +1,328 @@
+"""The node agent's REAL runtime: CRI restarts with pod status never written.
+
+Round-4 verdict: the deployed DaemonSet's runtime seam instantiated
+``KubeletSim`` — on a real node the agent would *simulate* a restart by
+writing pod status through the apiserver, the exact forgery the CRR
+protocol forbids, moved one actor over. ``CriRuntime``
+(`tpu_on_k8s/client/cri.py`) is the last mile the reference delegates to
+kruise-daemon's CRI executor (controllers/common/failover.go:267-307): stop
+the containers through the node's runtime socket, wait READ-ONLY for the
+kubelet to recreate them.
+
+These tests drive the agent against ``FakeCri`` — a recording crictl-shaped
+double with a kubelet simulator — and a pod-status write spy proving the
+apiserver's pod-status surface is untouched on the CRI path.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpu_on_k8s.api import crr as crr_api
+from tpu_on_k8s.api.core import Container, ObjectMeta, Pod, PodSpec
+from tpu_on_k8s.api.crr import ContainerRecreateRequest
+from tpu_on_k8s.client.cluster import InMemoryCluster
+from tpu_on_k8s.client.cri import CriError, CriRuntime, DEFAULT_ENDPOINT
+from tpu_on_k8s.client.nodeagent import NodeAgentLoop
+from tpu_on_k8s.client.testing import KubeletSim
+
+
+class FakeCri:
+    """crictl-shaped recording double backed by a tiny node state machine.
+
+    ``kubelet_recreates`` simulates the node's kubelet: a stopped container
+    gets a fresh replacement (new id, attempt+1, RUNNING) that becomes
+    visible ``recreate_latency`` ``ps`` calls after the stop — so the
+    runtime's read-only wait loop is actually exercised.
+    """
+
+    def __init__(self, *, kubelet_recreates=True, recreate_latency=2):
+        self.kubelet_recreates = kubelet_recreates
+        self.recreate_latency = recreate_latency
+        self.commands = []          # every argv crictl would have received
+        self.sandboxes = {}         # id -> {name, namespace, uid}
+        self.containers = {}        # id -> {name, sandbox, state, attempt}
+        self._pending = []          # (visible_after_ps_count, container)
+        self._ps_calls = 0
+        self._seq = 0
+
+    # ------------------------------------------------------------- node state
+    def add_pod(self, namespace, name, uid, containers=("tpu",)):
+        self._seq += 1
+        sid = f"sandbox-{self._seq}"
+        self.sandboxes[sid] = {"name": name, "namespace": namespace,
+                               "uid": uid}
+        for cname in containers:
+            self._seq += 1
+            self.containers[f"c-{self._seq}"] = {
+                "name": cname, "sandbox": sid,
+                "state": "CONTAINER_RUNNING", "attempt": 0}
+        return sid
+
+    def running(self, sandbox_id):
+        return sorted(c["name"] for c in self.containers.values()
+                      if c["sandbox"] == sandbox_id
+                      and c["state"] == "CONTAINER_RUNNING")
+
+    # ---------------------------------------------------------------- crictl
+    def __call__(self, argv, timeout):
+        assert argv[0] == "crictl" and argv[1] == "--runtime-endpoint"
+        self.commands.append(argv[3:])
+        cmd, args = argv[3], argv[4:]
+        if cmd == "pods":
+            opts = dict(zip(args[::2], args[1::2]))
+            items = [
+                {"id": sid, "metadata": {"name": sb["name"],
+                                         "namespace": sb["namespace"],
+                                         "uid": sb["uid"], "attempt": 0},
+                 "state": "SANDBOX_READY"}
+                for sid, sb in self.sandboxes.items()
+                # crictl's --name filter is a substring match; the runtime
+                # must re-verify exactly, so the fake filters loosely too
+                if opts.get("--name", "") in sb["name"]
+                and opts.get("--namespace", sb["namespace"]) == sb["namespace"]
+            ]
+            return json.dumps({"items": items})
+        if cmd == "ps":
+            self._ps_calls += 1
+            for visible_after, cont in list(self._pending):
+                if self._ps_calls >= visible_after:
+                    self._seq += 1
+                    self.containers[f"c-{self._seq}"] = cont
+                    self._pending.remove((visible_after, cont))
+            opts = dict(zip(args[::2], args[1::2]))
+            pod = opts.get("--pod")
+            conts = [
+                {"id": cid, "metadata": {"name": c["name"],
+                                         "attempt": c["attempt"]},
+                 "state": c["state"]}
+                for cid, c in self.containers.items()
+                if pod is None or c["sandbox"] == pod
+            ]
+            return json.dumps({"containers": conts})
+        if cmd == "stop":
+            cid = args[-1]
+            if cid not in self.containers:
+                raise CriError(f"stop {cid}: container not found")
+            c = self.containers[cid]
+            c["state"] = "CONTAINER_EXITED"
+            if self.kubelet_recreates:
+                self._pending.append((
+                    self._ps_calls + self.recreate_latency,
+                    {"name": c["name"], "sandbox": c["sandbox"],
+                     "state": "CONTAINER_RUNNING",
+                     "attempt": c["attempt"] + 1}))
+            return ""
+        raise AssertionError(f"fake crictl got unexpected command {argv}")
+
+
+def _cri(fake, **kw):
+    kw.setdefault("wait_seconds", 5.0)
+    kw.setdefault("poll_seconds", 0.0)
+    return CriRuntime(runner=fake, **kw)
+
+
+def _pod_with_crr(cluster, name="w0", containers=None):
+    pod = Pod(metadata=ObjectMeta(name=name),
+              spec=PodSpec(containers=[Container(name="tpu", image="i"),
+                                       Container(name="sidecar", image="i")]))
+    pod = cluster.create(pod)
+    KubeletSim(cluster).run_pod("default", name)
+    pod = cluster.get(Pod, "default", name)
+    req = ContainerRecreateRequest(
+        metadata=ObjectMeta(
+            name=name,
+            labels={crr_api.LABEL_CRR_POD_UID: pod.metadata.uid}),
+        spec=crr_api.ContainerRecreateRequestSpec(
+            pod_name=name,
+            containers=containers if containers is not None
+            else [c.name for c in pod.spec.containers]))
+    cluster.create(req)
+    return pod
+
+
+def _spy_pod_status_writes(cluster):
+    writes = []
+    orig = cluster.update
+
+    def update(obj, subresource=None):
+        if getattr(obj, "kind", "") == "Pod":
+            writes.append((obj.metadata.name, subresource))
+        return orig(obj, subresource=subresource)
+
+    cluster.update = update
+    return writes
+
+
+def test_cri_restart_succeeds_and_never_writes_pod_status():
+    cluster = InMemoryCluster()
+    pod = _pod_with_crr(cluster)
+    fake = FakeCri()
+    sid = fake.add_pod("default", "w0", pod.metadata.uid,
+                       containers=("tpu", "sidecar"))
+    writes = _spy_pod_status_writes(cluster)
+
+    agent = NodeAgentLoop(cluster, runtime=_cri(fake))
+    agent.sync_once()
+
+    req = cluster.get(ContainerRecreateRequest, "default", "w0")
+    assert req.status.phase == crr_api.PHASE_SUCCEEDED
+    assert agent.executed == 1
+    # the kubelet recreated both containers; replacements are running
+    assert fake.running(sid) == ["sidecar", "tpu"]
+    assert sum(c["state"] == "CONTAINER_EXITED"
+               for c in fake.containers.values()) == 2
+    # the CRI path's defining property: the apiserver pod-status surface
+    # was NEVER written (neither spec nor status) by the agent
+    assert writes == []
+    # and the runtime actually drove crictl: sandbox lookup, list, 2 stops
+    cmds = [c[0] for c in fake.commands]
+    assert cmds.count("stop") == 2 and "pods" in cmds and "ps" in cmds
+
+
+def test_cri_stops_only_the_named_containers():
+    cluster = InMemoryCluster()
+    pod = _pod_with_crr(cluster, containers=["tpu"])
+    fake = FakeCri()
+    fake.add_pod("default", "w0", pod.metadata.uid,
+                 containers=("tpu", "sidecar"))
+    NodeAgentLoop(cluster, runtime=_cri(fake)).sync_once()
+
+    assert (cluster.get(ContainerRecreateRequest, "default", "w0")
+            .status.phase == crr_api.PHASE_SUCCEEDED)
+    stopped = [c for c in fake.containers.values()
+               if c["state"] == "CONTAINER_EXITED"]
+    assert [c["name"] for c in stopped] == ["tpu"]
+
+
+def test_uid_mismatch_fails_crr_without_stopping_anything():
+    """A same-name pod recreated on the node (new sandbox uid) must never be
+    restarted against a CRR naming the old incarnation."""
+    cluster = InMemoryCluster()
+    _pod_with_crr(cluster)
+    fake = FakeCri()
+    fake.add_pod("default", "w0", "different-uid")
+    NodeAgentLoop(cluster, runtime=_cri(fake)).sync_once()
+
+    req = cluster.get(ContainerRecreateRequest, "default", "w0")
+    assert req.status.phase == crr_api.PHASE_FAILED
+    assert not any(c[0] == "stop" for c in fake.commands)
+
+
+def test_kubelet_not_recreating_times_out_to_failed():
+    """Dead kubelet (containers stopped, nothing comes back): the CRR goes
+    Failed so the operator takes the recreate fallback — no wedged CRR."""
+    cluster = InMemoryCluster()
+    pod = _pod_with_crr(cluster, containers=["tpu"])
+    fake = FakeCri(kubelet_recreates=False)
+    fake.add_pod("default", "w0", pod.metadata.uid, containers=("tpu",))
+    agent = NodeAgentLoop(cluster, runtime=_cri(fake, wait_seconds=0.05))
+    agent.sync_once()
+
+    req = cluster.get(ContainerRecreateRequest, "default", "w0")
+    assert req.status.phase == crr_api.PHASE_FAILED
+    assert "did not recreate" in req.status.message
+    assert agent.executed == 0
+
+
+def test_dead_runtime_socket_fails_crr():
+    cluster = InMemoryCluster()
+    _pod_with_crr(cluster)
+
+    def dead_runner(argv, timeout):
+        raise CriError("crictl: connection refused")
+
+    NodeAgentLoop(cluster, runtime=CriRuntime(runner=dead_runner)).sync_once()
+    req = cluster.get(ContainerRecreateRequest, "default", "w0")
+    assert req.status.phase == crr_api.PHASE_FAILED
+    assert "runtime restart failed" in req.status.message
+
+
+def test_sandbox_gone_is_not_found():
+    cluster = InMemoryCluster()
+    _pod_with_crr(cluster)
+    fake = FakeCri()  # node has no sandbox for the pod at all
+    NodeAgentLoop(cluster, runtime=_cri(fake)).sync_once()
+    req = cluster.get(ContainerRecreateRequest, "default", "w0")
+    assert req.status.phase == crr_api.PHASE_FAILED
+
+
+def test_build_runtime_selection(tmp_path):
+    """--runtime wiring: cri/sim explicit; auto picks cri iff the CRI socket
+    exists on the node (main.build_runtime)."""
+    import argparse
+
+    from tpu_on_k8s.main import build_runtime
+
+    def args(**kw):
+        kw.setdefault("cri_endpoint", DEFAULT_ENDPOINT)
+        return argparse.Namespace(**kw)
+
+    cluster = InMemoryCluster()
+    assert isinstance(build_runtime(args(runtime="sim"), cluster), KubeletSim)
+    rt = build_runtime(args(runtime="cri", crictl_path="/usr/bin/crictl",
+                            cri_wait_seconds=7.0), cluster)
+    assert isinstance(rt, CriRuntime)
+    assert rt.crictl == "/usr/bin/crictl" and rt.wait_seconds == 7.0
+
+    sock = tmp_path / "containerd.sock"
+    sock.write_text("")
+    auto_cri = build_runtime(
+        args(runtime="auto", cri_endpoint=f"unix://{sock}"), cluster)
+    assert isinstance(auto_cri, CriRuntime)
+    auto_sim = build_runtime(
+        args(runtime="auto", cri_endpoint="unix:///nonexistent.sock"),
+        cluster)
+    assert isinstance(auto_sim, KubeletSim)
+
+
+def test_daemonset_manifest_names_the_real_runtime():
+    """The deployed manifest must select --runtime=cri and mount the CRI
+    socket — the round-4 gap was exactly a DaemonSet that defaulted to the
+    status-forging sim on real nodes."""
+    import pathlib
+
+    import yaml
+
+    ds = yaml.safe_load((pathlib.Path(__file__).parent.parent / "config" /
+                         "nodeagent" / "daemonset.yaml").read_text())
+    spec = ds["spec"]["template"]["spec"]
+    agent_args = spec["containers"][0]["args"]
+    assert "--runtime=cri" in agent_args
+    hostpaths = [v["hostPath"]["path"] for v in spec.get("volumes", [])
+                 if "hostPath" in v]
+    assert "/run/containerd/containerd.sock" in hostpaths
+    # and the node agent's RBAC no longer grants pods/status writes at all
+    rbac_docs = list(yaml.safe_load_all(
+        (pathlib.Path(__file__).parent.parent / "config" / "nodeagent" /
+         "rbac.yaml").read_text()))
+    role = next(d for d in rbac_docs if d["kind"] == "ClusterRole")
+    for rule in role["rules"]:
+        assert "pods/status" not in rule["resources"]
+
+
+def test_cri_stops_latest_attempt_not_a_stale_exited_one():
+    """`ps -a` also returns exited earlier attempts of the same container;
+    the runtime must stop the LATEST (running) attempt — letting a stale
+    exited id shadow it would make stop a no-op and bless the still-running
+    container as its own replacement (a forged restart)."""
+    cluster = InMemoryCluster()
+    pod = _pod_with_crr(cluster, containers=["tpu"])
+    fake = FakeCri()
+    sid = fake.add_pod("default", "w0", pod.metadata.uid, containers=("tpu",))
+    live_id = next(iter(fake.containers))
+    # a stale exited attempt of the same container, listed AFTER the live one
+    fake.containers["c-stale"] = {"name": "tpu", "sandbox": sid,
+                                  "state": "CONTAINER_EXITED", "attempt": 0}
+    fake.containers[live_id]["attempt"] = 1
+
+    agent = NodeAgentLoop(cluster, runtime=_cri(fake))
+    agent.sync_once()
+    req = cluster.get(ContainerRecreateRequest, "default", "w0")
+    assert req.status.phase == crr_api.PHASE_SUCCEEDED
+    # the LIVE attempt was stopped (not the stale one left untouched)
+    assert fake.containers[live_id]["state"] == "CONTAINER_EXITED"
+    stops = [c for c in fake.commands if c[0] == "stop"]
+    assert stops == [("stop", "--timeout", "30", live_id)] or \
+        [tuple(c) for c in stops] == [("stop", "--timeout", "30", live_id)]

@@ -86,7 +86,19 @@ class InMemoryCluster:
         self._pod_logs: Dict[Tuple[str, str], List[str]] = {}
 
     # ---- watch ----------------------------------------------------------------
-    def watch(self, callback: Callable[[WatchEvent], None]) -> None:
+    def watch(self, callback: Callable[[WatchEvent], None],
+              kinds: Optional[Iterable[str]] = None) -> None:
+        """Register a live-event callback. ``kinds`` narrows delivery to the
+        named kinds (the REST backend additionally narrows which informer
+        streams it runs; here it is a dispatch filter)."""
+        if kinds is not None:
+            wanted = frozenset(kinds)
+            original = callback
+
+            def callback(event, _cb=original, _kinds=wanted):
+                if event.kind in _kinds:
+                    _cb(event)
+
         self._watchers.append(callback)
 
     def subscribe_ordered(self, callback: Callable[[WatchEvent], None]) -> None:

@@ -9,12 +9,15 @@ separation is what the reference buys by delegating in-place restarts to
 kruise's node daemon (controllers/common/failover.go:210-307).
 
 Deployed per node by ``config/nodeagent/daemonset.yaml`` (entrypoint:
-``python -m tpu_on_k8s.main --node-agent-only --node-name $(NODE_NAME)``)
-under its own ServiceAccount — the ONLY role RBAC grants ``pods/status``
-writes to. The container runtime is an injectable seam: the default is the
-``KubeletSim`` status-write surface (tests / local driver / simulated
-clusters); a real-CRI shim implements the same ``recreate_containers``
-signature.
+``python -m tpu_on_k8s.main --node-agent-only --runtime cri``) under its own
+ServiceAccount. On the deployed ``--runtime cri`` path the agent NEVER
+writes pod status — it stops containers through the node's CRI socket
+(`tpu_on_k8s/client/cri.py`) and the kubelet owns the status surface, so
+the node-agent RBAC grants no ``pods/status`` verbs at all. The runtime is
+an injectable seam: ``KubeletSim`` (``--runtime sim``) is the status-write
+surface for tests / local drivers / simulated clusters where no kubelet
+owns pod status — it needs ``pods/status`` re-granted and is never legal on
+a real node.
 """
 from __future__ import annotations
 
@@ -25,6 +28,7 @@ from tpu_on_k8s.api import crr as crr_api
 from tpu_on_k8s.api.core import Pod, utcnow
 from tpu_on_k8s.api.crr import ContainerRecreateRequest
 from tpu_on_k8s.client.cluster import ConflictError, NotFoundError
+from tpu_on_k8s.client.cri import CriError
 from tpu_on_k8s.client.testing import KubeletSim
 
 
@@ -46,19 +50,37 @@ class NodeAgentLoop:
     ``node_name=None`` serves every node — one agent standing in for the
     whole DaemonSet, which is what single-process tests and the local
     driver run.
+
+    EVENT-DRIVEN, not a poll loop: ``start()`` subscribes a watch on the
+    CRR kind only (one informer stream per node, not one per resource
+    type) and a worker drains a deduplicating key queue. The steady state
+    issues NO full-collection LISTs — the round-4 agent LISTed every CRR
+    in the cluster every 100 ms from every node, the exact hot loop
+    informers exist to kill. A slow resync (``resync_seconds``, default
+    5 min) is the belt-and-braces pass for a missed event; TTL reaping of
+    finished CRRs is scheduled per object at its expiry instead of being
+    rediscovered by polling.
     """
 
+    WATCH_KINDS = frozenset({"ContainerRecreateRequest"})
+
     def __init__(self, cluster, *, node_name: Optional[str] = None,
-                 poll_seconds: float = 0.02, runtime=None):
+                 poll_seconds: float = 0.02, runtime=None,
+                 resync_seconds: float = 300.0):
+        del poll_seconds  # legacy poll-loop cadence; kept for call compat
         self.cluster = cluster
         self.runtime = runtime if runtime is not None else KubeletSim(cluster)
         self.node_name = node_name
-        self.poll_seconds = poll_seconds
+        self.resync_seconds = resync_seconds
         self.executed = 0  # restarts this agent performed (observability)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._cond = threading.Condition()
+        self._queue: set = set()          # pending (namespace, name) keys
+        self._timers: list = []           # TTL-reap timers (cancelled on stop)
 
     def start(self) -> "NodeAgentLoop":
+        self.cluster.watch(self._on_event, kinds=self.WATCH_KINDS)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="node-agent")
         self._thread.start()
@@ -66,9 +88,33 @@ class NodeAgentLoop:
 
     def stop(self) -> None:
         self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        for t in self._timers:
+            t.cancel()
+        self._timers.clear()
         if self._thread is not None:
             self._thread.join(timeout=2)
             self._thread = None
+
+    # ---------------------------------------------------------------- wiring
+    def _on_event(self, event) -> None:
+        if event.kind != "ContainerRecreateRequest" or event.type == "DELETED":
+            return
+        self._enqueue((event.obj.metadata.namespace, event.obj.metadata.name))
+
+    def _enqueue(self, key) -> None:
+        with self._cond:
+            self._queue.add(key)
+            self._cond.notify()
+
+    def _schedule_reap(self, key, delay: float) -> None:
+        if self._thread is None:  # pull-mode (sync_once) drives its own TTL
+            return
+        timer = threading.Timer(delay, self._enqueue, args=(key,))
+        timer.daemon = True
+        timer.start()
+        self._timers = [t for t in self._timers if t.is_alive()] + [timer]
 
     # ------------------------------------------------------------------ engine
     def _set_phase(self, req: ContainerRecreateRequest, phase: str,
@@ -92,13 +138,19 @@ class NodeAgentLoop:
         if crr_api.finished(req):
             ttl = req.spec.ttl_seconds_after_finished
             done = req.status.completion_time
-            if (ttl is not None and done is not None
-                    and (utcnow() - done).total_seconds() >= ttl):
-                try:
-                    self.cluster.delete(ContainerRecreateRequest, ns,
-                                        req.metadata.name)
-                except NotFoundError:
-                    pass
+            if ttl is not None and done is not None:
+                remaining = ttl - (utcnow() - done).total_seconds()
+                if remaining <= 0:
+                    try:
+                        self.cluster.delete(ContainerRecreateRequest, ns,
+                                            req.metadata.name)
+                    except NotFoundError:
+                        pass
+                else:
+                    # event-driven TTL: revisit this object at its expiry
+                    # instead of rediscovering it by polling the collection
+                    self._schedule_reap((ns, req.metadata.name),
+                                        remaining + 0.05)
             return
         pod = self.cluster.try_get(Pod, ns, req.spec.pod_name)
         want_uid = req.metadata.labels.get(crr_api.LABEL_CRR_POD_UID)
@@ -122,6 +174,12 @@ class NodeAgentLoop:
             self._set_phase(req, crr_api.PHASE_FAILED,
                             "pod deleted or replaced mid-restart")
             return
+        except (TimeoutError, CriError) as e:
+            # runtime-level failure (dead containerd, kubelet not recreating):
+            # Failed tells the operator to take the recreate fallback
+            self._set_phase(req, crr_api.PHASE_FAILED,
+                            f"runtime restart failed: {e}")
+            return
         self.executed += 1
         self._set_phase(req, crr_api.PHASE_SUCCEEDED)
 
@@ -134,9 +192,34 @@ class NodeAgentLoop:
                 pass  # racing the operator's collect/cancel — next pass settles
 
     def _loop(self) -> None:
+        # One initial pass: the in-memory backend's watch delivers no cache
+        # replay, and CRRs posted before start() must not wait for a resync.
+        try:
+            self.sync_once()
+        except Exception:  # noqa: BLE001 — the daemon must survive blips
+            pass
         while not self._stop.is_set():
-            try:
-                self.sync_once()
-            except Exception:  # noqa: BLE001 — the daemon must survive blips
-                pass
-            self._stop.wait(self.poll_seconds)
+            with self._cond:
+                if not self._queue:
+                    self._cond.wait(timeout=self.resync_seconds)
+                keys = list(self._queue)
+                self._queue.clear()
+            if self._stop.is_set():
+                return
+            if not keys:
+                # resync heartbeat (5-minute default): catches a missed
+                # event; NOT the steady-state path
+                try:
+                    self.sync_once()
+                except Exception:  # noqa: BLE001
+                    pass
+                continue
+            for key in keys:
+                try:
+                    req = self.cluster.try_get(ContainerRecreateRequest, *key)
+                    if req is not None:
+                        self._handle(req)
+                except (ConflictError, NotFoundError):
+                    pass  # racing the operator's collect — resync settles it
+                except Exception:  # noqa: BLE001 — the daemon must survive
+                    pass

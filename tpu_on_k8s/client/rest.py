@@ -113,6 +113,7 @@ class RestCluster:
         self._watch_lock = threading.Lock()
         self._watch_callbacks: List[Callable[[WatchEvent], None]] = []
         self._watch_threads: List[threading.Thread] = []
+        self._watch_running: set = set()  # kinds with a live informer loop
         self._watch_stop = threading.Event()
         # informer cache: kind → {(ns, name): obj}. Source of truth for
         # synthetic DELETED on re-list and for initial-sync replay to
@@ -332,29 +333,38 @@ class RestCluster:
         return text.split("\n") if text else []
 
     # -------------------------------------------------------------------- watch
-    def watch(self, callback: Callable[[WatchEvent], None]) -> None:
-        """Register a callback for all kinds. First registration starts one
-        list-then-watch informer loop per registered resource type and BLOCKS
-        until every loop has delivered its initial list. Later registrations
-        replay the informer cache to the new callback as synthetic ADDED
-        events (informer AddEventHandler semantics), so every controller —
-        not just the first — observes pre-existing objects."""
+    def watch(self, callback: Callable[[WatchEvent], None],
+              kinds: Optional[Iterable[str]] = None) -> None:
+        """Register a callback and ensure a list-then-watch informer loop is
+        running for each requested kind (all registered kinds when ``kinds``
+        is None) — a node-scoped actor that only cares about one kind (the
+        CRR node agent) runs ONE stream, not one per resource type. BLOCKS
+        until every newly started loop has delivered its initial list. If
+        loops for the requested kinds already run, the informer cache is
+        replayed to the new callback as synthetic ADDED events (informer
+        AddEventHandler semantics), so every controller — not just the
+        first — observes pre-existing objects. Callbacks receive events for
+        every kind any registration requested; filter by ``event.kind``."""
+        wanted = [rt for rt in resources.all_types()
+                  if kinds is None or rt.kind in set(kinds)]
         with self._watch_lock:
-            first = not self._watch_threads
             snapshot = [obj for cache in self._known.values()
                         for obj in cache.values()]
+            already_running = bool(self._watch_running)
             self._watch_callbacks.append(callback)
             ready: List[threading.Event] = []
-            if first:
-                for rt in resources.all_types():
-                    ev = threading.Event()
-                    ready.append(ev)
-                    t = threading.Thread(target=self._watch_loop,
-                                         args=(rt, ev), daemon=True,
-                                         name=f"watch-{rt.plural}")
-                    t.start()
-                    self._watch_threads.append(t)
-        if not first:
+            for rt in wanted:
+                if rt.kind in self._watch_running:
+                    continue
+                self._watch_running.add(rt.kind)
+                ev = threading.Event()
+                ready.append(ev)
+                t = threading.Thread(target=self._watch_loop,
+                                     args=(rt, ev), daemon=True,
+                                     name=f"watch-{rt.plural}")
+                t.start()
+                self._watch_threads.append(t)
+        if already_running:
             # Replay the informer cache to the newcomer, outside the lock
             # (callbacks may re-enter the client). A concurrent live event
             # may duplicate — level-triggered consumers treat duplicates as
@@ -364,7 +374,6 @@ class RestCluster:
                     callback(WatchEvent("ADDED", obj.kind, obj))
                 except Exception:
                     _log.exception("watch callback failed on sync replay")
-            return
         for ev in ready:
             if not ev.wait(timeout=30):
                 raise ApiError("watch stream failed to establish")
