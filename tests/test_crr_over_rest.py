@@ -131,16 +131,17 @@ def test_node_agent_honors_crr_and_reports_phase():
     KubeletSim(cluster).run_pod("default", "w0")
 
     agent = NodeAgentLoop(cluster)
-    restarter = CRRRestarter(cluster, wait_seconds=2.0, poll_seconds=0.01)
-    import threading
-    done = []
-    t = threading.Thread(
-        target=lambda: done.append(restarter.restart(cluster, cluster.get(Pod, "default", "w0"))))
-    t.start()
-    _wait(lambda: cluster.list(ContainerRecreateRequest), "CRR posted", 5)
+    restarter = CRRRestarter(cluster, wait_seconds=2.0)
+    from tpu_on_k8s.controller.failover import RestartOutcome
+
+    # level-triggered: first observation posts the CRR and returns PENDING —
+    # it never blocks the caller on the node agent
+    pod = cluster.get(Pod, "default", "w0")
+    assert restarter.restart(cluster, pod) is RestartOutcome.PENDING
+    assert cluster.list(ContainerRecreateRequest)
     agent.sync_once()
-    t.join(timeout=5)
-    assert done == [True]
+    out = restarter.restart(cluster, pod)
+    assert out is RestartOutcome.RESTARTED and bool(out)
     live = cluster.get(Pod, "default", "w0")
     assert live.status.phase == PodPhase.RUNNING
     assert [cs.restart_count for cs in live.status.container_statuses] == [1]
@@ -249,8 +250,10 @@ def test_node_agent_ttl_reaps_uncollected_crrs():
 
 
 def test_restarter_falls_back_on_failed_crr():
-    """Failed phase ⇒ restart() returns False; the engine's caller recreates
-    (failover.go:242-247)."""
+    """Failed phase ⇒ restart() returns FAILED (falsy); the engine's caller
+    recreates (failover.go:242-247)."""
+    from tpu_on_k8s.controller.failover import RestartOutcome
+
     cluster = InMemoryCluster()
     pod = Pod(metadata=ObjectMeta(name="w0"),
               spec=PodSpec(containers=[Container(name="tpu", image="i")]))
@@ -258,33 +261,36 @@ def test_restarter_falls_back_on_failed_crr():
     KubeletSim(cluster).run_pod("default", "w0")
     live = cluster.get(Pod, "default", "w0")
 
-    # agent that always fails (no pod uid match): pre-post a Failed CRR
-    restarter = CRRRestarter(cluster, wait_seconds=1.0, poll_seconds=0.01)
-    import threading
-    done = []
-    t = threading.Thread(target=lambda: done.append(restarter.restart(cluster, live)))
-    t.start()
-    _wait(lambda: cluster.list(ContainerRecreateRequest), "CRR posted", 5)
+    restarter = CRRRestarter(cluster, wait_seconds=1.0)
+    assert restarter.restart(cluster, live) is RestartOutcome.PENDING
 
     def fail(r):
         r.status.phase = PHASE_FAILED
         r.status.message = "CRI said no"
     cluster.update_with_retry(ContainerRecreateRequest, "default", "w0", fail,
                               subresource="status")
-    t.join(timeout=5)
-    assert done == [False]
+    out = restarter.restart(cluster, live)
+    assert out is RestartOutcome.FAILED and not out
     assert cluster.list(ContainerRecreateRequest) == []
 
 
 def test_restarter_times_out_without_agent():
-    """No node agent alive ⇒ bounded wait, False, no orphan CRR left behind."""
+    """No node agent alive ⇒ the CRR ages past the deadline ACROSS calls
+    (never an in-call wait), FAILED, no orphan CRR left behind."""
+    from tpu_on_k8s.controller.failover import RestartOutcome
+
     cluster = InMemoryCluster()
     pod = Pod(metadata=ObjectMeta(name="w0"),
               spec=PodSpec(containers=[Container(name="tpu", image="i")]))
     cluster.create(pod)
     KubeletSim(cluster).run_pod("default", "w0")
-    restarter = CRRRestarter(cluster, wait_seconds=0.2, poll_seconds=0.02)
-    assert restarter.restart(cluster, cluster.get(Pod, "default", "w0")) is False
+    restarter = CRRRestarter(cluster, wait_seconds=0.2)
+    live = cluster.get(Pod, "default", "w0")
+    t0 = time.monotonic()
+    assert restarter.restart(cluster, live) is RestartOutcome.PENDING
+    assert time.monotonic() - t0 < 0.2, "restart() must never block"
+    time.sleep(0.25)
+    assert restarter.restart(cluster, live) is RestartOutcome.FAILED
     assert cluster.list(ContainerRecreateRequest) == []
 
 
@@ -462,3 +468,128 @@ def test_elastic_rescale_via_crr_over_rest():
         for c in (user, agent_client, kubelet_client):
             c.close()
         srv.stop()
+
+
+# ------------------------------------------------ scale: non-blocking passes
+
+def test_whole_slice_failure_reconciles_in_one_roundtrip():
+    """VERDICT r4 #4: a whole failing slice must cost the reconcile pass
+    O(one CRR round-trip), not O(n_pods × crr-wait). With no node agent
+    alive and a 5 s CRR deadline, the old blocking executor stalled
+    ~4×5 s; the level-triggered protocol posts all CRRs and returns in
+    milliseconds, then completes once an agent appears."""
+    from tpu_on_k8s.client.cluster import InMemoryCluster as IMC
+    from tpu_on_k8s.controller.runtime import Manager
+    from tpu_on_k8s.controller.tpujob import setup_tpujob_controller
+
+    cluster = IMC()
+    manager = Manager()
+    restarter = CRRRestarter(cluster, wait_seconds=5.0)
+    setup_tpujob_controller(cluster, manager, restarter=restarter)
+    sim = KubeletSim(cluster)
+
+    submit_job(cluster, _elastic_job("slice", workers=4))
+    manager.run_until_idle()
+    sim.run_all("default")
+    manager.run_until_idle()
+    running = [p for p in cluster.list(Pod) if p.status.phase == PodPhase.RUNNING]
+    assert len(running) == 4
+
+    for i in range(4):
+        sim.fail_pod("default", f"slice-worker-{i}", exit_code=137,
+                     reason="OOMKilled")
+    t0 = time.monotonic()
+    manager.run_until_idle()
+    elapsed = time.monotonic() - t0
+    # all four failovers initiated in ONE pass, none of them blocked on the
+    # (absent) node agent: far under even a single 5 s CRR deadline
+    assert elapsed < 2.0, f"reconcile stalled {elapsed:.1f}s on CRR waits"
+    crrs = cluster.list(ContainerRecreateRequest)
+    assert len(crrs) == 4 and all(r.status.phase == "Pending" for r in crrs)
+
+    # an agent appears: the protocol completes level-triggered
+    agent = NodeAgentLoop(cluster)
+    agent.sync_once()
+    manager.run_until_idle()
+    pods = [p for p in cluster.list(Pod)
+            if p.metadata.labels.get(constants.LABEL_TASK_TYPE) == "worker"]
+    assert all(p.status.phase == PodPhase.RUNNING for p in pods)
+    assert all(sum(cs.restart_count for cs in p.status.container_statuses) >= 1
+               for p in pods)
+    # every CRR collected — names free for the next incident
+    assert cluster.list(ContainerRecreateRequest) == []
+
+
+def test_node_agent_steady_state_issues_no_lists():
+    """VERDICT r4 #4: the agent is watch-driven — after the one initial
+    sync, CRRs are handled from events (gets, no collection LISTs), and an
+    idle steady state issues no LISTs at all until the slow resync."""
+    cluster = InMemoryCluster()
+    lists = []
+    orig_list = cluster.list
+
+    def spy_list(cls, *a, **kw):
+        lists.append(getattr(cls, "__name__", str(cls)))
+        return orig_list(cls, *a, **kw)
+
+    cluster.list = spy_list
+    pod = Pod(metadata=ObjectMeta(name="w0"),
+              spec=PodSpec(containers=[Container(name="tpu", image="i")]))
+    cluster.create(pod)
+    KubeletSim(cluster).run_pod("default", "w0")
+    pod = cluster.get(Pod, "default", "w0")
+
+    agent = NodeAgentLoop(cluster).start()
+    try:
+        _wait(lambda: len(lists) >= 1, "initial sync", 5)
+        baseline = len(lists)
+
+        req = ContainerRecreateRequest()
+        req.metadata.name = "w0"
+        req.metadata.namespace = "default"
+        req.metadata.labels = {LABEL_CRR_POD_UID: pod.metadata.uid}
+        req.spec.pod_name = "w0"
+        cluster.create(req)
+        _wait(lambda: cluster.get(ContainerRecreateRequest, "default", "w0")
+              .status.phase == PHASE_SUCCEEDED, "event-driven restart", 5)
+        time.sleep(0.5)  # idle steady state
+        assert len(lists) == baseline, (
+            f"agent LISTed in steady state: {lists[baseline:]}")
+        assert agent.executed == 1
+    finally:
+        agent.stop()
+
+
+def test_failed_sibling_crr_falls_back_to_recreate():
+    """A slice sibling whose fire-and-forget CRR settles FAILED (dead
+    runtime / no agent) must be RECREATED, not left running against a
+    re-rendezvoused slice — the collection sweep owns that fallback."""
+    from tpu_on_k8s.client.cluster import InMemoryCluster as IMC
+    from tpu_on_k8s.controller.runtime import Manager
+    from tpu_on_k8s.controller.tpujob import setup_tpujob_controller
+
+    cluster = IMC()
+    manager = Manager()
+    restarter = CRRRestarter(cluster, wait_seconds=30.0)
+    setup_tpujob_controller(cluster, manager, restarter=restarter)
+    sim = KubeletSim(cluster)
+    submit_job(cluster, _elastic_job("sib", workers=2))
+    manager.run_until_idle()
+    sim.run_all("default")
+    manager.run_until_idle()
+
+    sim.fail_pod("default", "sib-worker-0", exit_code=137, reason="OOMKilled")
+    manager.run_until_idle()  # posts w0's CRR + sibling w1's CRR
+    w1_uid = cluster.get(Pod, "default", "sib-worker-1").metadata.uid
+    assert cluster.try_get(ContainerRecreateRequest, "default",
+                           "sib-worker-1") is not None
+
+    def fail(r):
+        r.status.phase = PHASE_FAILED
+        r.status.message = "containerd unreachable"
+    cluster.update_with_retry(ContainerRecreateRequest, "default",
+                              "sib-worker-1", fail, subresource="status")
+    manager.run_until_idle()
+    # the sibling was recreated (new uid) instead of silently kept running
+    w1 = cluster.try_get(Pod, "default", "sib-worker-1")
+    assert w1 is None or w1.metadata.uid != w1_uid

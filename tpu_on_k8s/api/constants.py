@@ -41,6 +41,16 @@ ANNOTATION_LAST_FAILOVER_TIMESTAMP = "distributed.tpu.io/last-failover-timestamp
 # subtracted from container restart counts so successful rescales never feed
 # the job's failure backoff limit.
 ANNOTATION_ELASTIC_RESTARTS = "distributed.tpu.io/elastic-restarts"
+# The failed-pod incarnation (uid) a surviving slice sibling was last
+# restarted for — makes slice-atomic failover idempotent across the
+# level-triggered reconcile passes that drive a pending CRR protocol.
+ANNOTATION_SLICE_RESTART_FOR = "distributed.tpu.io/slice-restart-for"
+# The job generation a pod's cluster spec (world size, hostnames, Megascale
+# env) was last refreshed for during elastic rescale. The pod's generation
+# LABEL only advances once its in-place restart completes, so staleness
+# keeps re-driving a pending restart; this annotation stops the respec
+# write itself from repeating on every pass in between.
+ANNOTATION_RESPEC_GENERATION = "distributed.tpu.io/respec-generation"
 # gang scheduler podgroup binding (reference: scheduling.k8s.io/group-name,
 # /root/reference/pkg/gangscheduler/volcano/volcano.go:238-287)
 ANNOTATION_GANG_GROUP_NAME = "scheduling.k8s.io/group-name"

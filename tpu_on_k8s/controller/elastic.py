@@ -244,17 +244,33 @@ class ElasticController:
             return 0 if pod.metadata.labels.get(
                 constants.LABEL_TASK_TYPE) == TaskType.MASTER.value.lower() else 1
 
-        for pod in sorted(stale, key=order):
-            self._restart_stale_pod(job, pod, world)
+        ordered = sorted(stale, key=order)
+        masters = [p for p in ordered if order(p) == 0]
+        workers = [p for p in ordered if order(p) == 1]
+        # Master-first barrier (elastic_scale.go:242-277): workers only
+        # restart once every master's restart has SETTLED. With the
+        # level-triggered CRR protocol a restart may be pending across
+        # passes, so the barrier is a requeue, not an in-pass wait — the
+        # reconcile never blocks on a node agent.
+        settled = [self._restart_stale_pod(job, p, world) for p in masters]
+        if not all(settled):
+            return Result(requeue_after=self.config.sync_period_seconds)
+        pending = sum(not self._restart_stale_pod(job, p, world)
+                      for p in workers)
+        if pending:
+            return Result(requeue_after=self.config.sync_period_seconds)
         # Fall through to the engine: it creates missing indices with the new
         # generation label and prunes out-of-range ones.
         return None
 
-    def _restart_stale_pod(self, job: TPUJob, pod: Pod, world: int) -> None:
+    def _restart_stale_pod(self, job: TPUJob, pod: Pod, world: int) -> bool:
         """restartStalePod → restartPodInKruiseProtocol
         (elastic_scale.go:303-397): refresh the pod's cluster spec (world-size
-        annotation via downward API, hostnames/Megascale env, generation
-        label) FIRST, then restart in place.
+        annotation via downward API, hostnames/Megascale env) FIRST, then
+        restart in place. Returns True when the pod has SETTLED (restarted,
+        recreated, or vanished) and False while a CRR is still in flight —
+        the pod's generation label only advances on settle, so staleness
+        itself re-drives the protocol next pass.
 
         TPU twist: if the re-spec changed the pod's slice shape (topology
         nodeSelector differs), in-place restart is impossible — the pod must
@@ -269,22 +285,23 @@ class ElasticController:
                 self.cluster.delete(Pod, pod.metadata.namespace, pod.metadata.name)
             except NotFoundError:
                 pass
-            return
+            return True
 
         live = self.cluster.try_get(Pod, pod.metadata.namespace, pod.metadata.name)
         if live is None:
-            return
+            return True
         pod_topo = live.spec.node_selector.get(constants.NODE_SELECTOR_TPU_TOPOLOGY)
         if pod_topo is not None and pod_topo != job.spec.tpu_policy.topology:
             # Slice shape changed: the node pool is wrong — recreate.
             failover.failover_recreate(self.cluster, live)
-            return
+            return True
 
         task_type, index = self._task_identity(live)
+        gen = str(job.metadata.generation)
 
         def mutate(p: Pod) -> None:
             p.metadata.annotations[constants.ANNOTATION_WORLD_SIZE] = str(world)
-            p.metadata.labels[constants.LABEL_JOB_GENERATION] = str(job.metadata.generation)
+            p.metadata.annotations[constants.ANNOTATION_RESPEC_GENERATION] = gen
             if self.hooks is not None and task_type is not None:
                 # Recompute the full PJRT/XLA wiring (TPU_WORKER_HOSTNAMES,
                 # Megascale env) for the post-scale world — an in-place
@@ -292,25 +309,49 @@ class ElasticController:
                 # respec just deleted.
                 self.hooks.set_cluster_spec(job, p, task_type, index)
 
+        if live.metadata.annotations.get(
+                constants.ANNOTATION_RESPEC_GENERATION) != gen:
+            try:
+                self.cluster.update_with_retry(
+                    Pod, pod.metadata.namespace, pod.metadata.name, mutate)
+            except NotFoundError:
+                return True
+            live = self.cluster.try_get(
+                Pod, pod.metadata.namespace, pod.metadata.name)
+            if live is None:
+                return True
+        if live.status.phase != PodPhase.RUNNING:
+            # Not running ⇒ nothing to restart in place: the refreshed spec
+            # takes effect when the pod (re)starts. Mark it current.
+            self._mark_current(pod, gen)
+            return True
+        outcome = failover.failover_inplace_restart(
+            self.cluster, live, self.restarter)
+        if outcome is failover.RestartOutcome.PENDING:
+            return False
+        if outcome is failover.RestartOutcome.RESTARTED:
+            # Count the healthy restart ONLY once it actually happened —
+            # stamping it earlier would mask a later genuine failure from
+            # the backoff limit. The generation label advances with it: the
+            # pod is only "current" once it runs the post-scale world.
+            prev = int(live.metadata.annotations.get(
+                constants.ANNOTATION_ELASTIC_RESTARTS, "0") or 0)
+            self._mark_current(
+                pod, gen,
+                annotations={
+                    constants.ANNOTATION_ELASTIC_RESTARTS: str(prev + 1)})
+        # FAILED ⇒ the fallback recreate already deleted the pod; the engine
+        # recreates it with the new generation label.
+        return True
+
+    def _mark_current(self, pod: Pod, gen: str, annotations=None) -> None:
         try:
-            self.cluster.update_with_retry(
-                Pod, pod.metadata.namespace, pod.metadata.name, mutate)
+            self.cluster.patch_meta(
+                Pod, pod.metadata.namespace, pod.metadata.name,
+                labels={constants.LABEL_JOB_GENERATION: gen},
+                annotations=annotations)
         except NotFoundError:
-            return
-        live = self.cluster.try_get(Pod, pod.metadata.namespace, pod.metadata.name)
-        if live is not None and live.status.phase == PodPhase.RUNNING:
-            if failover.failover_inplace_restart(self.cluster, live, self.restarter):
-                # Count the healthy restart ONLY once it actually happened —
-                # stamping it earlier would mask a later genuine failure from
-                # the backoff limit.
-                prev = int(live.metadata.annotations.get(
-                    constants.ANNOTATION_ELASTIC_RESTARTS, "0") or 0)
-                try:
-                    self.cluster.patch_meta(
-                        Pod, pod.metadata.namespace, pod.metadata.name,
-                        annotations={constants.ANNOTATION_ELASTIC_RESTARTS: str(prev + 1)})
-                except NotFoundError:
-                    pass
+            pass
 
     @staticmethod
     def _task_identity(pod: Pod):

@@ -16,7 +16,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Protocol
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 from tpu_on_k8s.api import constants
 from tpu_on_k8s.api.core import (
@@ -114,6 +114,12 @@ class JobEngine:
         # container restart counts, job.go:385-419).
         self._failover_counts: Dict[str, int] = {}
         self._launch_meters: Dict[str, _LaunchMeter] = {}
+        # In-flight level-triggered CRR restarts: (ns, pod) → job_key. Keys
+        # are re-driven by _collect_slice_restarts each pass until the CRR
+        # settles — O(active restarts) GETs, never a collection LIST. Lost
+        # on operator restart, like the reference's in-memory expectations;
+        # the node agent's TTL reaper then clears any orphaned CRR.
+        self._inflight_inplace: Dict[Tuple[str, str], str] = {}
         self.port_allocator = hostnetwork.PortAllocator(
             self.config.hostnetwork_port_range)
 
@@ -324,6 +330,7 @@ class JobEngine:
         for pod in existing:
             by_index.setdefault(self.pod_index(pod), []).append(pod)
 
+        self._collect_slice_restarts(job)
         exp_key = expectation_key(self.job_key(job), task_type.value, "pods")
         to_create = [i for i in range(task.num_tasks) if not by_index.get(i)]
         if to_create:
@@ -417,26 +424,47 @@ class JobEngine:
         policy = task.restart_policy or RestartPolicy.NEVER
         if not failover.should_pod_failover(pod, policy):
             return
-        self.metrics.restarted()
-        conditions.update_job_conditions(
-            job.status, JobConditionType.RESTARTING, "PodFailover",
-            f"pod {pod.metadata.name} failed (exit {failover.pod_exit_code(pod)}, "
-            f"reason {pod.status.reason or 'n/a'}); restarting")
+        if not conditions.has_condition(job.status, JobConditionType.RESTARTING):
+            # Stamp once per failover episode: re-stamping each pod/pass
+            # would churn the condition's message+timestamp and turn the
+            # level-triggered pending protocol into a status-write busy loop.
+            conditions.update_job_conditions(
+                job.status, JobConditionType.RESTARTING, "PodFailover",
+                f"pod {pod.metadata.name} failed (exit {failover.pod_exit_code(pod)}, "
+                f"reason {pod.status.reason or 'n/a'}); restarting")
         if self.hooks.failover_action(job, pod) == "inplace":
-            if failover.failover_inplace_restart(self.cluster, pod, self.restarter):
+            outcome = failover.failover_inplace_restart(
+                self.cluster, pod, self.restarter)
+            # The slice restarts TOGETHER: siblings' CRRs are posted on the
+            # same pass as the failed pod's (not after it recovers), so the
+            # whole slice re-enters rendezvous at once. Re-driven every pass
+            # while pending — a no-op once each sibling settled.
+            self._failover_slice_siblings(job, task_type, pod)
+            key = (pod.metadata.namespace, pod.metadata.name)
+            if outcome is failover.RestartOutcome.PENDING:
+                # CRR in flight; the protocol advances level-triggered across
+                # reconciles (reference failover.go is level-triggered the
+                # same way) — the pass NEVER blocks on a node agent. Track it
+                # so _collect_slice_restarts settles the CRR even if the pod
+                # recovers before this path sees the Succeeded phase.
+                self._inflight_inplace[key] = self.job_key(job)
+                return
+            self._inflight_inplace.pop(key, None)
+            self.metrics.restarted()
+            if outcome is failover.RestartOutcome.RESTARTED:
                 # In-place restarts surface in container restart_count, which
                 # restart_count() already sums — recording a failover too would
                 # double-count toward the backoff limit.
-                self._failover_slice_siblings(job, task_type, pod)
                 return
-            self.record_failover(job)
-        else:
-            self.record_failover(job)
-            self.expectations.expect_deletions(exp_key, 1)
-            if not failover.failover_recreate(self.cluster, pod):
-                # Pod vanished under us: drain the expectation we just raised
-                # or the job wedges until the expectation TTL.
-                self.expectations.deletion_observed(exp_key)
+            self.record_failover(job)  # fell back to delete+recreate
+            return
+        self.metrics.restarted()
+        self.record_failover(job)
+        self.expectations.expect_deletions(exp_key, 1)
+        if not failover.failover_recreate(self.cluster, pod):
+            # Pod vanished under us: drain the expectation we just raised
+            # or the job wedges until the expectation TTL.
+            self.expectations.deletion_observed(exp_key)
         self._failover_slice_siblings(job, task_type, pod)
 
     def _failover_slice_siblings(self, job: TPUJob, task_type: TaskType,
@@ -470,7 +498,17 @@ class JobEngine:
         slice_id = failed_idx // hosts_per
         selector = {constants.LABEL_JOB_NAME: job.metadata.name,
                     constants.LABEL_TASK_TYPE: TaskType.WORKER.value.lower()}
-        restarted = 0
+        # (uid, restart epoch) identifies the failover incident — uid alone
+        # would miss a second failure of the same in-place-restarted pod.
+        # Each sibling is restarted AT MOST ONCE per incident: the annotation
+        # marker (stable across passes — the failed pod's status is frozen
+        # while it stays Failed) records the incident a sibling was last
+        # restarted for, and in-flight sibling CRRs are only COLLECTED by
+        # ``_collect_slice_restarts`` on later passes — never re-posted,
+        # which would loop restarts while the primary is pending.
+        epoch = sum(cs.restart_count for cs in failed.status.container_statuses)
+        incident = f"{failed.metadata.uid}:{epoch}"
+        initiated = 0
         for sibling in self.cluster.list(Pod, job.metadata.namespace, selector):
             if sibling.metadata.name == failed.metadata.name:
                 continue
@@ -483,14 +521,72 @@ class JobEngine:
                 continue
             if sibling.status.phase != PodPhase.RUNNING:
                 continue
-            if failover.failover_inplace_restart(self.cluster, sibling,
-                                                 self.restarter):
-                restarted += 1
-        if restarted:
+            if sibling.metadata.annotations.get(
+                    constants.ANNOTATION_SLICE_RESTART_FOR) == incident:
+                continue  # already restarted (or restarting) for this one
+            initiated += 1
+            out = failover.failover_inplace_restart(self.cluster, sibling,
+                                                    self.restarter)
+            skey = (sibling.metadata.namespace, sibling.metadata.name)
+            if out is failover.RestartOutcome.PENDING:
+                self._inflight_inplace[skey] = self.job_key(job)
+            elif out is failover.RestartOutcome.RESTARTED:
+                self.metrics.restarted()
+            else:
+                self.record_failover(job)  # recreated by the fallback
+            try:
+                # Stamp AFTER initiating, so a crash in between re-initiates
+                # (restart() adopts the already-posted CRR — no duplicate)
+                # instead of leaving a never-restarted sibling behind.
+                self.cluster.patch_meta(
+                    Pod, sibling.metadata.namespace, sibling.metadata.name,
+                    annotations={
+                        constants.ANNOTATION_SLICE_RESTART_FOR: incident})
+            except NotFoundError:
+                pass
+        if initiated:
             self.cluster.record_event(
                 job, "Normal", "SliceFailover",
-                f"slice {slice_id}: restarted {restarted} surviving host(s) "
+                f"slice {slice_id}: restarting {initiated} surviving host(s) "
                 f"after {failed.metadata.name} failed")
+
+    def _collect_slice_restarts(self, job: TPUJob) -> None:
+        """Settle the job's in-flight CRRs: both fire-and-forget slice-
+        sibling restarts and a primary pod whose in-place restart completed
+        after the engine's last look at it (the pod is Running, so the
+        failed-pod path no longer drives its protocol). Iterates the TRACKED
+        keys only — O(active restarts) GETs per pass, never a collection
+        LIST. Observe-only (never posts); a restart that settled FAILED
+        falls back to recreate so a dead-runtime sibling can't keep running
+        against a re-rendezvoused slice."""
+        collect = getattr(self.restarter, "collect", None)
+        if collect is None:
+            return
+        jkey = self.job_key(job)
+        for key, owner in list(self._inflight_inplace.items()):
+            if owner != jkey:
+                continue
+            pod = self.cluster.try_get(Pod, key[0], key[1])
+            if pod is None:
+                self._inflight_inplace.pop(key, None)
+                continue
+            if pod.status.phase == PodPhase.FAILED:
+                # The failed-pod reconcile path owns it again (a fresh
+                # failover episode); that path re-tracks on PENDING.
+                self._inflight_inplace.pop(key, None)
+                continue
+            out = collect(pod)  # uid-checked inside; deletes when settled
+            if out is failover.RestartOutcome.PENDING:
+                continue
+            self._inflight_inplace.pop(key, None)
+            if out is failover.RestartOutcome.RESTARTED:
+                self.metrics.restarted()
+            elif out is failover.RestartOutcome.FAILED:
+                # Runtime failure / deadline after the pod left the failed
+                # path (slice sibling, or a recovered-then-wedged primary):
+                # recreate so the slice re-enters rendezvous together.
+                self.record_failover(job)
+                failover.failover_recreate(self.cluster, pod)
 
     def reconcile_services(
         self,

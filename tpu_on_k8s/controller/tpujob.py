@@ -349,6 +349,15 @@ def setup_tpujob_controller(
                 metrics.deleted()
         elif event.kind in ("Pod", "Service"):
             engine.observe_event(controller.enqueue, event)
+        elif event.kind == "ContainerRecreateRequest":
+            # The node agent's phase updates advance the level-triggered
+            # in-place-restart protocol: requeue the owning job (the
+            # restarter stamps the job label when posting) so settlement is
+            # event-driven, not resync-bound.
+            job_name = event.obj.metadata.labels.get(
+                constants.LABEL_JOB_NAME, "")
+            if job_name:
+                controller.enqueue(event.obj.metadata.namespace, job_name)
 
     cluster.watch(on_event)
     return engine
