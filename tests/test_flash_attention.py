@@ -204,15 +204,18 @@ def test_transformer_with_flash_impl():
 
 
 @pytest.mark.parametrize("seq", [100, 600])
-def test_transformer_flash_fallback_unaligned_seq(seq):
-    """Lengths with no legal flash block fall back to XLA attention inside
-    the model instead of erroring: 100 is below one block but not an
-    8-multiple (Mosaic tile alignment); 600 has no 64..512 divisor."""
+def test_transformer_flash_stays_on_pallas_for_unaligned_seq(seq):
+    """Ragged lengths STAY on the flash path via pad-and-mask (VERDICT r4
+    #5 — the old fallback to XLA attention was a 2.5× step-time cliff at
+    seq 4000): 100 is below one block but not an 8-multiple (Mosaic tile
+    alignment); 600 has no 64..512 divisor. Output must be exact vs the
+    XLA oracle."""
     from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
-    from tpu_on_k8s.ops.flash_attention import auto_block
+    from tpu_on_k8s.ops.flash_attention import auto_block, padded_len
 
     with pytest.raises(ValueError):
-        auto_block(seq)  # the condition the model fallback guards
+        auto_block(seq)  # the condition that triggers pad-and-mask
+    assert padded_len(seq) % 8 == 0 and padded_len(seq) >= seq
 
     cfg = TransformerConfig(vocab_size=128, d_model=64, n_layers=1,
                             n_heads=4, n_kv_heads=2, d_ff=128,
@@ -225,3 +228,49 @@ def test_transformer_flash_fallback_unaligned_seq(seq):
     want = Transformer(cfg_xla).apply({"params": params}, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                atol=2e-2, rtol=2e-2)
+
+
+# ------------------------------------------------------- ragged pad-and-mask
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("seq", [100, 600, 1000])
+def test_ragged_forward_matches_xla(causal, seq):
+    """flash_attention pads ragged lengths and masks the tail keys in-kernel:
+    exact vs the XLA oracle at lengths with no legal block (the non-causal
+    case exercises the key-validity mask — causal alone would already hide
+    end-padding from real queries)."""
+    q, k, v = _qkv(b=1, l=seq, h=2, d=32)
+    got = flash_attention(q, k, v, causal=causal)
+    want = xla_attention(q, k, v, causal=causal)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ragged_gradients_match_xla(causal):
+    """Backward through the padded kernels: padded key columns and sliced-off
+    query rows must contribute exactly zero gradient."""
+    q, k, v = _qkv(b=1, l=200, h=2, d=32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(xla_attention(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_flash, g_xla, "qkv"):
+        np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_ragged_gqa_matches_repeated_kv():
+    from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+
+    q, _, _ = _qkv(b=1, l=300, h=4, d=32, seed=1)
+    _, k, v = _qkv(b=1, l=300, h=2, d=32, seed=2)
+    got = flash_attention(q, k, v, causal=True)  # native GQA, ragged length
+    want = xla_attention(q, jnp.repeat(k, 2, axis=2),
+                         jnp.repeat(v, 2, axis=2), causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)

@@ -432,28 +432,33 @@ class Attention(nn.Module):
             q = rope_bhld(q, positions, cfg.rope_theta)
             k = rope_bhld(k, positions, cfg.rope_theta)
         if cfg.attn_impl == "flash":
-            from tpu_on_k8s.ops.flash_attention import _flash, auto_block
+            from tpu_on_k8s.ops.flash_attention import (
+                _flash,
+                auto_block,
+                padded_len,
+            )
             l = q.shape[2]
-            try:
-                bq = cfg.attn_block_q or auto_block(l)
-                bk = cfg.attn_block_k or auto_block(l)
-            except ValueError:
-                # length has no 64..512 divisor (not a 128-multiple): fall
-                # back to XLA attention rather than failing the train step —
-                # correctness at any length, speed at aligned lengths.
-                bq = bk = 0
-            if bq:
-                if not cfg.attn_native_gqa:
-                    rep = cfg.n_heads // cfg.n_kv_heads
-                    k = jnp.repeat(k, rep, axis=1)
-                    v = jnp.repeat(v, rep, axis=1)
-                # else: the kernel's index maps route q-head → kv group natively
-                out = _flash(q, k, v, True, bq, bk)
-            else:
+            # Ragged lengths (no legal 128-block) stay on the Pallas path:
+            # zero-pad the tail, mask the padded keys in-kernel, slice the
+            # padded query rows off — exact at any length, and ~(lp/l−1)
+            # extra FLOPs instead of the XLA-attention fallback cliff
+            # (round 4 measured seq 4000 at 2.5× the 4096 step time).
+            lp = padded_len(l)
+            if lp != l:
+                pad = [(0, 0), (0, 0), (0, lp - l), (0, 0)]
+                q = jnp.pad(q, pad)
+                k = jnp.pad(k, pad)
+                v = jnp.pad(v, pad)
+            bq = cfg.attn_block_q or auto_block(lp)
+            bk = cfg.attn_block_k or auto_block(lp)
+            if not cfg.attn_native_gqa:
                 rep = cfg.n_heads // cfg.n_kv_heads
-                out = xla_attention_bhld(q, jnp.repeat(k, rep, axis=1),
-                                         jnp.repeat(v, rep, axis=1),
-                                         causal=True)
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
+            # else: the kernel's index maps route q-head → kv group natively
+            out = _flash(q, k, v, True, bq, bk, l if lp != l else 0)
+            if lp != l:
+                out = out[:, :, :l]
         else:
             rep = cfg.n_heads // cfg.n_kv_heads
             k = jnp.repeat(k, rep, axis=1)
@@ -515,14 +520,12 @@ class Attention(nn.Module):
             use_flash = jax.default_backend() != "cpu"
             if use_flash:
                 try:
-                    from tpu_on_k8s.ops.flash_attention import (
-                        auto_block,
-                        flash_attention,
-                    )
-                    auto_block(l)
-                except (ImportError, ValueError):
+                    from tpu_on_k8s.ops.flash_attention import flash_attention
+                except ImportError:
                     use_flash = False
             if use_flash:
+                # any length is legal: flash_attention pads-and-masks ragged
+                # prompt lengths internally
                 return flash_attention(q, k, v, causal=True)
             return xla_attention(q, jnp.repeat(k, rep, axis=2),
                                  jnp.repeat(v, rep, axis=2), causal=True)
