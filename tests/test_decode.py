@@ -133,3 +133,48 @@ def test_chunked_prefill_into_nonempty_cache_is_exact():
     np.testing.assert_allclose(np.asarray(got, np.float32),
                                np.asarray(ref, np.float32),
                                atol=3e-2, rtol=3e-2)
+
+
+def test_bucketed_cache_matches_full_length_cache():
+    """The serving cache is sized to the request (128-multiple bucket), not
+    the model max — must be bit-identical to a full-length cache (RoPE
+    positions are absolute) while allocating a fraction of the HBM."""
+    import dataclasses
+
+    from tpu_on_k8s.models.decode import _bucket_len
+
+    cfg = dataclasses.replace(TransformerConfig.tiny(), max_seq_len=512)
+    assert _bucket_len(16, 512) == 128
+    assert _bucket_len(200, 512) == 256
+    assert _bucket_len(600, 512) == 512  # capped at the model max
+
+    model = Transformer(dataclasses.replace(cfg, decode=True, remat=False,
+                                            attn_impl="xla"))
+    tokens = jnp.arange(10, dtype=jnp.int32)[None, :].repeat(2, axis=0)
+    params = model.init(jax.random.key(0), tokens,
+                        jnp.broadcast_to(jnp.arange(10), (2, 10)))["params"]
+    # bucketed (max 512 → cache 128 for 10+6) vs full-length (max small
+    # enough that no bucketing applies)
+    got = generate(cfg, params, tokens, max_new_tokens=6)
+    full_cfg = dataclasses.replace(cfg, max_seq_len=16)  # == lp+new: no slack
+    want = generate(full_cfg, params, tokens, max_new_tokens=6)
+    assert (got == want).all(), (got.tolist(), want.tolist())
+
+    # learned positional embeddings must NOT be re-bucketed (the pos_embed
+    # param is sized by max_seq_len) — run the path for real: generation
+    # with full-table params must match a tight-cache config bit-exactly
+    lcfg = dataclasses.replace(cfg, pos_emb="learned")
+    lmodel = Transformer(dataclasses.replace(lcfg, decode=True, remat=False,
+                                             attn_impl="xla"))
+    lparams = lmodel.init(jax.random.key(2), tokens,
+                          jnp.broadcast_to(jnp.arange(10), (2, 10)))["params"]
+    assert lparams["pos_embed"].shape[0] == 512  # full-length table
+    # if bucketing were (wrongly) applied here, flax would reject the
+    # (512, d) table against a (128, d) module — this call succeeding IS
+    # the guard's test; parity against a sliced-table tight config pins
+    # the numerics too
+    lgot = generate(lcfg, lparams, tokens, max_new_tokens=6)
+    tight = {**lparams, "pos_embed": lparams["pos_embed"][:16]}
+    lwant = generate(dataclasses.replace(lcfg, max_seq_len=16),
+                     tight, tokens, max_new_tokens=6)
+    assert (lgot == lwant).all()

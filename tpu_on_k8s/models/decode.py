@@ -45,12 +45,32 @@ def init_cache(model: Transformer, batch: int) -> dict:
                         cache_shapes(model, batch))
 
 
+def _bucket_len(total: int, max_seq_len: int) -> int:
+    """Smallest 128-multiple cache length covering ``total`` positions,
+    capped at the model's max. Decode is HBM-bandwidth-bound on cache
+    reads, and every step attends over the WHOLE static cache — so a
+    256-token request on a 1024-max model pays 4× the attention traffic it
+    needs unless the cache is sized to the request."""
+    return min(max_seq_len, max(128, -(-total // 128) * 128))
+
+
 @functools.lru_cache(maxsize=32)
 def _compiled_generate(cfg: TransformerConfig, b: int, lp: int,
                        max_new_tokens: int, temperature: float):
     """One compiled generation program per (config, shape) — repeated
     ``generate()`` calls (a serving loop) reuse it instead of re-tracing.
-    The config is a frozen dataclass, so it keys the cache directly."""
+    The config is a frozen dataclass, so it keys the cache directly.
+
+    The KV cache is allocated at the request's bucketed length, not the
+    model's ``max_seq_len`` (RoPE positions are absolute, so a shorter
+    cache changes nothing but the attention span — exactness is pinned by
+    a parity test against the full-length cache). Learned positional
+    embeddings size a parameter by ``max_seq_len``, so those models keep
+    the full-length cache."""
+    if cfg.pos_emb == "rope":
+        cfg = dataclasses.replace(
+            cfg, max_seq_len=_bucket_len(lp + max_new_tokens,
+                                         cfg.max_seq_len))
     model = decode_model(cfg)
     # Abstract shapes only — the zeroed cache is materialized *inside* the
     # jitted program below, so an lru entry pins no device memory (a cached
