@@ -22,6 +22,17 @@ def main(argv=None):
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--draft-config", default="", choices=["", *sorted(CONFIGS)],
+                   help="enable greedy speculative decoding with this config "
+                        "as the draft model (same vocab; k proposals per "
+                        "target forward)")
+    p.add_argument("--draft-checkpoint-dir", default="",
+                   help="restore the draft model's params from here; "
+                        "without it the draft is RANDOM — acceptance "
+                        "collapses and speculation is slower than plain "
+                        "generate (mechanism demo only)")
+    p.add_argument("--k", type=int, default=4,
+                   help="speculation window (draft proposals per round)")
     args = p.parse_args(argv)
 
     cfg = CONFIGS[args.config]()
@@ -42,9 +53,38 @@ def main(argv=None):
         print(f"restored generation={gen} step={step}")
     else:
         params = model.init(jax.random.key(1), prompt)["params"]
-    out = generate(cfg, params, prompt, args.max_new_tokens,
-                   temperature=args.temperature,
-                   rng=jax.random.key(args.seed + 1))
+    if args.draft_config:
+        from tpu_on_k8s.models.decode import speculative_generate
+
+        if args.temperature:
+            raise SystemExit("speculative decoding is greedy-only")
+        draft_cfg = CONFIGS[args.draft_config]()
+        if args.draft_checkpoint_dir:
+            from tpu_on_k8s.models.transformer import (
+                flagship_partition_rules,
+            )
+            mesh = create_mesh(MeshConfig(data=1, fsdp=len(jax.devices()),
+                                          model=1, seq=1))
+            abstract = abstract_train_state(
+                Transformer(draft_cfg), default_optimizer(), mesh,
+                flagship_partition_rules(), prompt)
+            dstate, dgen, dstep = CheckpointManager(
+                args.draft_checkpoint_dir).restore(abstract)
+            draft_params = dstate.params
+            print(f"restored draft generation={dgen} step={dstep}")
+        else:
+            print("NOTE: untrained random draft — acceptance will be ~0; "
+                  "pass --draft-checkpoint-dir for a real speedup")
+            draft_params = Transformer(draft_cfg).init(
+                jax.random.key(2), prompt)["params"]
+        out, stats = speculative_generate(
+            cfg, params, draft_cfg, draft_params, prompt,
+            args.max_new_tokens, k=args.k)
+        print("speculative stats:", stats)
+    else:
+        out = generate(cfg, params, prompt, args.max_new_tokens,
+                       temperature=args.temperature,
+                       rng=jax.random.key(args.seed + 1))
     print("prompt:", prompt[0].tolist())
     print("continuation:", out[0].tolist())
     return out

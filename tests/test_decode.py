@@ -227,3 +227,59 @@ def test_int8_kv_cache_close_to_fp_and_halves_cache_bytes():
     out = generate(dataclasses.replace(cfg, cache_int8=True), params,
                    tokens, max_new_tokens=4)
     assert out.shape == (2, 4)
+
+
+class TestSpeculative:
+    """Greedy speculative decoding: draft proposes, target verifies in one
+    forward — output must match plain greedy generate()."""
+
+    @staticmethod
+    def _models(seed=0):
+        cfg = dataclasses.replace(
+            TransformerConfig.tiny(), dtype=jnp.float32, max_seq_len=128)
+        draft_cfg = dataclasses.replace(cfg, n_layers=1, d_model=32, d_ff=64)
+        tok = jax.random.randint(jax.random.key(seed), (1, 8), 0,
+                                 cfg.vocab_size, jnp.int32)
+        params = Transformer(cfg).init(jax.random.key(1), tok)["params"]
+        dparams = Transformer(draft_cfg).init(jax.random.key(2),
+                                              tok)["params"]
+        return cfg, params, draft_cfg, dparams, tok
+
+    def test_matches_plain_greedy(self):
+        from tpu_on_k8s.models.decode import speculative_generate
+
+        cfg, params, draft_cfg, dparams, tok = self._models()
+        want = generate(cfg, params, tok, max_new_tokens=16)
+        got, stats = speculative_generate(cfg, params, draft_cfg, dparams,
+                                          tok, max_new_tokens=16, k=4)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert stats["rounds"] >= 1
+        assert 0.0 <= stats["acceptance_rate"] <= 1.0
+
+    def test_self_draft_accepts_everything(self):
+        """Draft == target: every proposal is accepted, so each round emits
+        k+1 tokens and the loop takes ceil(new/(k+1)) rounds — the
+        mechanism's upper bound, independent of draft quality."""
+        from tpu_on_k8s.models.decode import speculative_generate
+
+        cfg, params, _, _, tok = self._models()
+        got, stats = speculative_generate(cfg, params, cfg, params, tok,
+                                          max_new_tokens=15, k=4)
+        want = generate(cfg, params, tok, max_new_tokens=15)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert stats["acceptance_rate"] == 1.0
+        assert stats["rounds"] == 3  # ceil((15-1)/5): prefill emits token 1
+        assert stats["tokens_per_target_forward"] > 3
+
+    def test_rejects_batch_and_vocab_mismatch(self):
+        from tpu_on_k8s.models.decode import speculative_generate
+
+        cfg, params, draft_cfg, dparams, tok = self._models()
+        with pytest.raises(ValueError, match="batch-1"):
+            speculative_generate(cfg, params, draft_cfg, dparams,
+                                 jnp.tile(tok, (2, 1)), 4)
+        bad = dataclasses.replace(draft_cfg, vocab_size=cfg.vocab_size * 2)
+        with pytest.raises(ValueError, match="vocabulary"):
+            speculative_generate(cfg, params, bad, dparams, tok, 4)
+        with pytest.raises(ValueError, match="exceeds"):
+            speculative_generate(cfg, params, draft_cfg, dparams, tok, 1000)

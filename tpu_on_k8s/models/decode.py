@@ -19,6 +19,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
 
@@ -112,6 +113,153 @@ def _compiled_generate(cfg: TransformerConfig, b: int, lp: int,
         return toks.transpose(1, 0)
 
     return run
+
+
+def _set_cursor(cache: dict, value) -> dict:
+    """Rebuild a cache pytree with every layer's append cursor set to
+    ``value``. Rolling the cursor BACK is how speculative decoding rejects
+    draft tokens: stale K/V beyond the cursor is harmless because a query
+    only attends to ``k_pos <= position`` and the very next append
+    overwrites the first stale slot before attending."""
+    def rec(d):
+        return {key: (jnp.full_like(v, value) if key == "index"
+                      else rec(v) if isinstance(v, dict) else v)
+                for key, v in d.items()}
+    return rec(cache)
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_speculative(cfg: TransformerConfig,
+                          draft_cfg: TransformerConfig, lp: int, k: int,
+                          max_total: int):
+    """Three jitted programs for the speculative loop (batch 1): prefill
+    both models, draft k greedy proposals, verify a k+1 chunk with the
+    target. Caches are bucketed to ``max_total`` like ``generate``'s."""
+    def bucketed(c):
+        if c.pos_emb == "rope":
+            c = dataclasses.replace(
+                c, max_seq_len=_bucket_len(max_total, c.max_seq_len))
+        return c
+
+    target = decode_model(bucketed(cfg))
+    draft = decode_model(bucketed(draft_cfg))
+    t_shapes = cache_shapes(target, 1)
+    d_shapes = cache_shapes(draft, 1)
+
+    @jax.jit
+    def prefill(params, draft_params, prompt):
+        zeros = lambda s: jax.tree.map(
+            lambda a: jnp.zeros(a.shape, a.dtype), s)
+        positions = jnp.broadcast_to(jnp.arange(lp), (1, lp))
+        t_logits, t_upd = target.apply(
+            {"params": params, "cache": zeros(t_shapes)}, prompt, positions,
+            mutable=["cache"])
+        _, d_upd = draft.apply(
+            {"params": draft_params, "cache": zeros(d_shapes)}, prompt,
+            positions, mutable=["cache"])
+        t0 = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+        return t_upd["cache"], d_upd["cache"], t0
+
+    @jax.jit
+    def draft_k(draft_params, d_cache, t_last, p):
+        # k+1 feeds (t_last, d_1..d_k) so the draft cache also holds d_k —
+        # on full acceptance the next round appends right after it. The
+        # cursor rollback (rejecting last round's unaccepted draft K/V)
+        # happens HERE, under jit — one fused full_like per layer instead
+        # of a host-side pytree rebuild per round.
+        d_cache = _set_cursor(d_cache, p)
+
+        def step(carry, _):
+            cache, tok, pos = carry
+            logits, upd = draft.apply(
+                {"params": draft_params, "cache": cache}, tok[:, None],
+                pos[:, None], mutable=["cache"])
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return (upd["cache"], nxt, pos + 1), nxt
+
+        (d_cache, _, _), toks = jax.lax.scan(
+            step, (d_cache, t_last, jnp.full((1,), p, jnp.int32)), None,
+            length=k + 1)
+        return d_cache, toks[:k, 0]           # d_1..d_k (the k+1-th feed
+                                              # exists only to cache d_k)
+
+    @jax.jit
+    def verify(params, t_cache, chunk, p):
+        # chunk = [t_last, d_1..d_k] at positions p..p+k; greedy[i] is the
+        # target's next token after chunk[:i+1]. Cursor rollback in-jit,
+        # as in draft_k.
+        t_cache = _set_cursor(t_cache, p)
+        positions = p + jnp.arange(k + 1)[None, :]
+        logits, upd = target.apply(
+            {"params": params, "cache": t_cache}, chunk, positions,
+            mutable=["cache"])
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return upd["cache"], greedy[0]        # [k+1]
+
+    return prefill, draft_k, verify
+
+
+def speculative_generate(cfg: TransformerConfig, params,
+                         draft_cfg: TransformerConfig, draft_params,
+                         prompt: jnp.ndarray, max_new_tokens: int,
+                         k: int = 4) -> Tuple[jnp.ndarray, dict]:
+    """Greedy speculative decoding (batch 1): a cheap draft model proposes
+    ``k`` tokens per round, the target verifies them in ONE forward, and
+    the longest agreeing prefix plus the target's correction token are
+    emitted — matching ``generate(cfg, ...)``'s greedy output (parity
+    test; exact up to fp reduction order in the batched verify forward),
+    at up to (k+1)× fewer target forwards when the draft agrees. Returns
+    ``(tokens [1, max_new_tokens], stats)`` where stats reports rounds
+    and acceptance.
+
+    The draft shares the target's tokenizer/vocab; both caches live at
+    request-bucketed length. Cursor rollback rejects draft K/V — see
+    ``_set_cursor``.
+    """
+    b, lp = prompt.shape
+    if b != 1:
+        raise ValueError("speculative_generate is batch-1 (per-row accept "
+                         "counts diverge); batch requests use generate()")
+    if k < 1:
+        raise ValueError(f"speculation window k must be >= 1, got {k}")
+    if cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    max_total = lp + max_new_tokens + k + 1
+    if max_total > cfg.max_seq_len or max_total > draft_cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {lp} + new {max_new_tokens} + speculation window "
+            f"{k + 1} exceeds max_seq_len")
+    prefill, draft_k, verify = _compiled_speculative(
+        cfg, draft_cfg, lp, k, max_total)
+    t_cache, d_cache, t_last = prefill(params, draft_params, prompt)
+    emitted = [int(t_last[0])]
+    p = lp                     # position of t_last (emitted, not yet fed)
+    rounds = accepted_total = 0
+    while len(emitted) < max_new_tokens:
+        d_cache, proposals = draft_k(draft_params, d_cache, t_last, p)
+        chunk = jnp.concatenate([t_last[None, :], proposals[None, :]],
+                                axis=1)                      # [1, k+1]
+        t_cache, greedy = verify(params, t_cache, chunk, p)
+        props = np.asarray(proposals).tolist()       # one transfer each,
+        target_toks = np.asarray(greedy).tolist()    # not 2k+1 int() syncs
+        j = 0
+        while j < k and props[j] == target_toks[j]:
+            j += 1
+        emitted.extend(props[:j])
+        emitted.append(target_toks[j])        # correction (or bonus at j=k)
+        rounds += 1
+        accepted_total += j
+        p = p + j + 1                         # position of the new t_last
+        t_last = greedy[j:j + 1]
+    tokens = jnp.asarray(emitted[:max_new_tokens], jnp.int32)[None, :]
+    stats = {"rounds": rounds, "proposed": rounds * k,
+             "accepted": accepted_total,
+             "acceptance_rate": (accepted_total / (rounds * k)
+                                 if rounds else 0.0),
+             "target_forwards": rounds + 1,
+             "tokens_per_target_forward": (
+                 len(emitted[:max_new_tokens]) / (rounds + 1))}
+    return tokens, stats
 
 
 def generate(cfg: TransformerConfig, params, prompt: jnp.ndarray,
