@@ -1,0 +1,270 @@
+"""Continuous batching: requests join and leave a running decode batch.
+
+The reference operator's serving story ends at baking the trained artifact
+into an OCI image (`/root/reference/controllers/modelversion` — SURVEY.md
+§3.5); the compute plane itself is this framework's own. ``generate()``
+(`tpu_on_k8s/models/decode.py`) serves one batch of same-length requests;
+real serving traffic is ragged and asynchronous — requests arrive while
+others are mid-generation, and a static-batch server pays head-of-line
+blocking (the batch runs until its LONGEST member finishes).
+
+TPU-first design — every shape is static so there is exactly ONE compiled
+step program for the engine's lifetime:
+
+* The batch dimension is a fixed pool of ``n_slots`` **slots**, each either
+  serving one request or free. The cache is one ``[n_slots, max_len, ...]``
+  pytree in ``decode_multislot`` mode (`models/transformer.py`): no shared
+  cursor; each row appends at its OWN position, and free slots pass the
+  out-of-bounds sentinel position so their append drops.
+* Admission = one **prefill** program (compiled per 128-bucketed prompt
+  length — the same bucketing `decode._bucket_len` uses) run at batch 1
+  on the ordinary cursor-mode decode model, then one **admit** program
+  that masks the first ``lp`` cache rows into the slot. Prompts pad to the
+  bucket; padded positions are masked out of the admitted cache, so a
+  handful of prefill programs serve every prompt length.
+* The **step** program advances all slots one token — active or not —
+  per-row positions select each slot's attention span. Retiring a request
+  is a host-side bookkeeping change; the next step simply runs without it
+  (its row computes garbage that nobody reads — on TPU that is cheaper
+  than a shape change, which would recompile).
+
+The host loop (``step()``) is plain Python: admit from the queue into free
+slots, run one device step, collect finished requests. One H2D transfer of
+two ``[n_slots]`` int vectors per step; the cache lives on device.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_on_k8s.models.decode import _bucket_len, cache_shapes, init_cache
+from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+
+
+def _pick(logits: jnp.ndarray, key: jax.Array,
+          temperature: float) -> jnp.ndarray:
+    """Greedy (temperature<=0) or sampled next token — the ONE sampling
+    rule for both the prefill's first token and every step token."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits / temperature, axis=-1).astype(jnp.int32)
+
+
+@dataclasses.dataclass
+class _Slot:
+    request_id: int
+    pos: int                      # position of the NEXT append (== tokens
+                                  # cached so far)
+    last_token: int               # emitted but not yet fed back
+    emitted: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int]
+
+
+@dataclasses.dataclass
+class _Pending:
+    request_id: int
+    prompt: np.ndarray            # [lp] int32
+    max_new_tokens: int
+    eos_id: Optional[int]
+
+
+def _strip_index(cache: Any) -> Any:
+    """Drop the cursor leaves from an ordinary decode cache so its structure
+    matches the multislot cache (which has none)."""
+    if isinstance(cache, dict):
+        return {k: _strip_index(v) for k, v in cache.items() if k != "index"}
+    return cache
+
+
+class ContinuousBatchingEngine:
+    """Slot-pool continuous batching over one model + parameter set.
+
+    ``submit()`` enqueues a request; ``step()`` advances the world by one
+    decode step (admitting queued requests into free slots first) and
+    returns the requests that finished on that step; ``run()`` drains
+    everything. Greedy by default; ``temperature > 0`` samples.
+    """
+
+    def __init__(self, cfg: TransformerConfig, params, n_slots: int = 8,
+                 max_len: Optional[int] = None, temperature: float = 0.0,
+                 rng: Optional[jax.Array] = None):
+        max_len = max_len or cfg.max_seq_len
+        if max_len > cfg.max_seq_len and cfg.pos_emb != "rope":
+            raise ValueError("max_len beyond the trained table needs rope")
+        if cfg.pos_emb != "rope":
+            # learned positional tables are sized by max_seq_len; shrinking
+            # it would reshape the param, so serve at the trained length
+            max_len = cfg.max_seq_len
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.temperature = temperature
+        self._params = params
+        self._rng = rng if rng is not None else jax.random.key(0)
+
+        base = dataclasses.replace(cfg, decode=True, remat=False,
+                                   attn_impl="xla", max_seq_len=max_len)
+        self._step_model = Transformer(
+            dataclasses.replace(base, decode_multislot=True))
+        self._prefill_model = Transformer(base)
+
+        self._cache = init_cache(self._step_model, n_slots)
+
+        temp = temperature
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step(params, cache, toks, pos, key):
+            logits, upd = self._step_model.apply(
+                {"params": params, "cache": cache}, toks[:, None],
+                pos[:, None], mutable=["cache"])
+            return upd["cache"], _pick(logits[:, -1], key, temp)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def admit(cache, pre_cache, slot, lp):
+            """Mask the prefill cache's first ``lp`` positions into row
+            ``slot`` of the pool. Positions >= lp (pad garbage) keep the
+            slot's old bytes — never attended, same invariant as appends."""
+            def write(shared, pre):
+                # cache leaves are layer-stacked by the block scan
+                # (variable_axes {"cache": 0}): [L, B, max_len, ...]
+                keep = jnp.arange(shared.shape[2]) < lp        # positions
+                keep = keep.reshape((1, -1) + (1,) * (pre.ndim - 3))
+                return shared.at[:, slot].set(
+                    jnp.where(keep, pre[:, 0], shared[:, slot]))
+            return jax.tree.map(write, cache, _strip_index(pre_cache))
+
+        self._step = step
+        self._admit = admit
+        self._prefill_cache: Dict[int, Any] = {}
+
+        self._slots: List[Optional[_Slot]] = [None] * n_slots
+        self._queue: deque[_Pending] = deque()
+        self._next_id = 0
+        self._finished: Dict[int, np.ndarray] = {}
+        self.stats = {"steps": 0, "emitted": 0, "admitted": 0}
+
+    # ---- request lifecycle -------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int,
+               eos_id: Optional[int] = None) -> int:
+        """Enqueue a request; returns its id. ``prompt`` is a 1-D token
+        sequence; admission happens on a later ``step()``."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {prompt.size} + new {max_new_tokens} exceeds the "
+                f"engine's max_len {self.max_len}")
+        rid = self._next_id
+        self._next_id += 1
+        self._queue.append(_Pending(rid, prompt, max_new_tokens, eos_id))
+        return rid
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_cache.get(bucket)
+        if fn is None:
+            model = self._prefill_model
+            shapes = cache_shapes(model, 1)   # length set by max_len, not lp
+            temp = self.temperature
+
+            @jax.jit
+            def prefill(params, prompt, lp, key):
+                cache = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+                positions = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+                logits, upd = model.apply(
+                    {"params": params, "cache": cache}, prompt, positions,
+                    mutable=["cache"])
+                return upd["cache"], _pick(logits[0, lp - 1], key, temp)
+
+            fn = self._prefill_cache[bucket] = prefill
+        return fn
+
+    def _admit_pending(self) -> None:
+        for i in range(self.n_slots):
+            if not self._queue:
+                return
+            if self._slots[i] is not None:
+                continue
+            req = self._queue.popleft()
+            lp = int(req.prompt.size)
+            bucket = _bucket_len(lp, self.max_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :lp] = req.prompt
+            self._rng, key = jax.random.split(self._rng)
+            pre_cache, first = self._prefill_fn(bucket)(
+                self._params, jnp.asarray(padded), lp, key)
+            self._cache = self._admit(self._cache, pre_cache,
+                                      jnp.int32(i), jnp.int32(lp))
+            first = int(first)
+            self._slots[i] = _Slot(req.request_id, lp, first, [first],
+                                   req.max_new_tokens, req.eos_id)
+            self.stats["admitted"] += 1
+            self.stats["emitted"] += 1
+            self._retire_if_done(i)
+
+    def _retire_if_done(self, i: int) -> bool:
+        slot = self._slots[i]
+        done = (len(slot.emitted) >= slot.max_new_tokens
+                or (slot.eos_id is not None
+                    and slot.emitted[-1] == slot.eos_id))
+        if done:
+            self._finished[slot.request_id] = np.asarray(slot.emitted,
+                                                         np.int32)
+            self._slots[i] = None
+        return done
+
+    # ---- the engine loop ---------------------------------------------------
+    def step(self) -> List[int]:
+        """Admit queued requests, advance every active slot one token, and
+        return the ids of requests that finished this step."""
+        self._admit_pending()
+        before = set(self._finished)
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if active:
+            toks = np.zeros(self.n_slots, np.int32)
+            pos = np.full(self.n_slots, self.max_len, np.int32)  # sentinel
+            for i in active:
+                toks[i] = self._slots[i].last_token
+                pos[i] = self._slots[i].pos
+            self._rng, key = jax.random.split(self._rng)
+            self._cache, nxt = self._step(self._params, self._cache,
+                                          jnp.asarray(toks),
+                                          jnp.asarray(pos), key)
+            nxt = np.asarray(nxt)
+            self.stats["steps"] += 1
+            for i in active:
+                slot = self._slots[i]
+                slot.pos += 1
+                slot.last_token = int(nxt[i])
+                slot.emitted.append(slot.last_token)
+                self.stats["emitted"] += 1
+                self._retire_if_done(i)
+        return sorted(set(self._finished) - before)
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drain the queue and every active slot; returns {id: tokens}."""
+        while self._queue or any(s is not None for s in self._slots):
+            self.step()
+        out, self._finished = self._finished, {}
+        return out
+
+    def result(self, request_id: int) -> Optional[np.ndarray]:
+        """The finished continuation for ``request_id`` (None if still in
+        flight); pops it from the engine."""
+        return self._finished.pop(request_id, None)
+
+    @property
+    def free_slots(self) -> int:
+        return sum(s is None for s in self._slots)

@@ -120,6 +120,15 @@ class TransformerConfig:
     experts_top_k: int = 2
     expert_capacity_factor: float = 1.25
     decode: bool = False               # KV-cache autoregressive mode
+    decode_multislot: bool = False     # continuous-batching serving: the
+                                       # cache batch dim is a SLOT pool with
+                                       # per-row positions (no shared
+                                       # cursor); appends scatter at each
+                                       # row's position and out-of-bounds
+                                       # positions (the free-slot sentinel)
+                                       # are dropped. Requests at different
+                                       # progress share one compiled step
+                                       # (`tpu_on_k8s/models/serving.py`).
 
     @property
     def head_dim(self) -> int:
@@ -504,32 +513,54 @@ class Attention(nn.Module):
         else:
             ck = self.variable("cache", "k", jnp.zeros, shape, k.dtype)
             cv = self.variable("cache", "v", jnp.zeros, shape, v.dtype)
-        cursor = self.variable("cache", "index",
-                               lambda: jnp.zeros((), jnp.int32))
-        start = cursor.value
-        if cfg.cache_int8:
-            def quantize(x):
-                s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
-                safe = jnp.maximum(s, 1e-9)
-                q8 = jnp.round(x.astype(jnp.float32) / safe[..., None])
-                return q8.astype(jnp.int8), s.astype(jnp.float32)
+        def quantize(x):
+            s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+            safe = jnp.maximum(s, 1e-9)
+            q8 = jnp.round(x.astype(jnp.float32) / safe[..., None])
+            return q8.astype(jnp.int8), s.astype(jnp.float32)
 
-            k8, ks = quantize(k)
-            v8, vs = quantize(v)
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k8,
-                                                    (0, start, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v8,
-                                                    (0, start, 0, 0))
-            cks.value = jax.lax.dynamic_update_slice(cks.value, ks,
-                                                     (0, start, 0))
-            cvs.value = jax.lax.dynamic_update_slice(cvs.value, vs,
-                                                     (0, start, 0))
+        if cfg.decode_multislot:
+            # Continuous batching: every row is an independent slot at its
+            # own position, so appends scatter at `positions` per row
+            # instead of a shared cursor. mode="drop" makes the free-slot
+            # sentinel (position == max_seq_len, out of bounds) a no-op
+            # write; stale K/V beyond a slot's position is never attended
+            # (queries mask to k_pos <= position) and is overwritten before
+            # the position ever reaches it.
+            rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+            if cfg.cache_int8:
+                k8, ks = quantize(k)
+                v8, vs = quantize(v)
+                ck.value = ck.value.at[rows, positions].set(k8, mode="drop")
+                cv.value = cv.value.at[rows, positions].set(v8, mode="drop")
+                cks.value = cks.value.at[rows, positions].set(ks,
+                                                             mode="drop")
+                cvs.value = cvs.value.at[rows, positions].set(vs,
+                                                              mode="drop")
+            else:
+                ck.value = ck.value.at[rows, positions].set(k, mode="drop")
+                cv.value = cv.value.at[rows, positions].set(v, mode="drop")
         else:
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k,
-                                                    (0, start, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v,
-                                                    (0, start, 0, 0))
-        cursor.value = start + l
+            cursor = self.variable("cache", "index",
+                                   lambda: jnp.zeros((), jnp.int32))
+            start = cursor.value
+            if cfg.cache_int8:
+                k8, ks = quantize(k)
+                v8, vs = quantize(v)
+                ck.value = jax.lax.dynamic_update_slice(ck.value, k8,
+                                                        (0, start, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, v8,
+                                                        (0, start, 0, 0))
+                cks.value = jax.lax.dynamic_update_slice(cks.value, ks,
+                                                         (0, start, 0))
+                cvs.value = jax.lax.dynamic_update_slice(cvs.value, vs,
+                                                         (0, start, 0))
+            else:
+                ck.value = jax.lax.dynamic_update_slice(ck.value, k,
+                                                        (0, start, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, v,
+                                                        (0, start, 0, 0))
+            cursor.value = start + l
 
         def cached_kv():
             if cfg.cache_int8:
@@ -555,7 +586,9 @@ class Attention(nn.Module):
                 jnp.where(mask, logits, -1e30), axis=-1).astype(q.dtype)
             return jnp.einsum("bhlm,bmhd->blhd", probs, v_all)
 
-        if l == 1:
+        if l == 1 or cfg.decode_multislot:
+            # multislot rows sit at unrelated positions — the among-prompt
+            # fast path's shared causal mask can never apply
             return over_cache(None)
 
         def among_prompt(_):
