@@ -24,7 +24,7 @@ try:
 except Exception:
     sys.exit(1)
 keys = ["headline", "decode", "sweep_stage_a", "sweep_stage_b",
-        "longcontext", "resnet50", "bench_data"]
+        "longcontext", "resnet50", "bench_data", "continuous"]
 ok = all(k in d and not (isinstance(d[k], dict) and ("error" in d[k] or d[k].get("rc"))) for k in keys)
 sys.exit(0 if ok else 1)
 EOF

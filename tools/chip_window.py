@@ -200,6 +200,16 @@ def stage_bench_data(timeout):
     return proc.returncode == 0
 
 
+def stage_continuous(timeout):
+    proc = _run([sys.executable, "tools/driver_bench.py", "--write",
+                 "--skip-resnet", "--skip-submit", "--continuous"], timeout)
+    line = next((ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("{")), None)
+    _save("continuous", json.loads(line) if line else
+          {"rc": proc.returncode, "error": proc.stderr[-1500:]})
+    return proc.returncode == 0
+
+
 # (primary key, fn, timeout, extra result keys the stage also records —
 # a stage only counts as done when primary AND extras are error-free)
 STAGES = [
@@ -209,6 +219,7 @@ STAGES = [
     ("longcontext", stage_longcontext, 1800, ()),
     ("resnet50", stage_resnet, 1200, ()),
     ("bench_data", stage_bench_data, 900, ()),
+    ("continuous", stage_continuous, 1200, ()),
 ]
 
 

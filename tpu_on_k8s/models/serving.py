@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from tpu_on_k8s.models.decode import _bucket_len, cache_shapes, init_cache
 from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
@@ -95,7 +96,7 @@ class ContinuousBatchingEngine:
 
     def __init__(self, cfg: TransformerConfig, params, n_slots: int = 8,
                  max_len: Optional[int] = None, temperature: float = 0.0,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None, mesh=None, rules=None):
         max_len = max_len or cfg.max_seq_len
         if max_len > cfg.max_seq_len and cfg.pos_emb != "rope":
             raise ValueError("max_len beyond the trained table needs rope")
@@ -107,7 +108,6 @@ class ContinuousBatchingEngine:
         self.n_slots = n_slots
         self.max_len = max_len
         self.temperature = temperature
-        self._params = params
         self._rng = rng if rng is not None else jax.random.key(0)
 
         base = dataclasses.replace(cfg, decode=True, remat=False,
@@ -117,17 +117,56 @@ class ContinuousBatchingEngine:
         self._prefill_model = Transformer(base)
 
         self._cache = init_cache(self._step_model, n_slots)
+        cache_shardings = token_shardings = None
+        if mesh is not None:
+            # Tensor-parallel serving: params shard by the training rules
+            # (Megatron layout — per-layer all-gather/reduce-scatter over
+            # the `model` axis ride ICI), the KV cache shards its kv-head
+            # dim on `model` so each chip holds only its heads' cache, and
+            # the per-slot token/position vectors replicate. Same compiled
+            # programs, just sharded — XLA inserts the collectives.
+            from tpu_on_k8s.parallel.mesh import AXIS_MODEL, put_global, \
+                replicated
+            from tpu_on_k8s.parallel.partition import named_sharding
+            if rules is None:
+                from tpu_on_k8s.models.transformer import (
+                    flagship_partition_rules,
+                )
+                rules = flagship_partition_rules()
+            params = jax.tree.map(
+                put_global, params, named_sharding(params, mesh, rules))
+            n_model = mesh.shape.get(AXIS_MODEL, 1)
+
+            def cache_spec(leaf):
+                # k/v leaves [L, S, max_len, Hkv, Dh]; scales [L, S,
+                # max_len, Hkv] — shard Hkv on `model` when it divides
+                shard = leaf.ndim >= 4 and leaf.shape[3] % n_model == 0
+                spec = (PartitionSpec(None, None, None, AXIS_MODEL)
+                        if shard else PartitionSpec())
+                return NamedSharding(mesh, spec)
+
+            cache_shardings = jax.tree.map(cache_spec, self._cache)
+            self._cache = jax.tree.map(jax.device_put, self._cache,
+                                       cache_shardings)
+            token_shardings = replicated(mesh)
+        self.mesh = mesh
+        self._params = params
 
         temp = temperature
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
+        @functools.partial(
+            jax.jit, donate_argnums=(1,),
+            out_shardings=((cache_shardings, token_shardings)
+                           if mesh is not None else None))
         def step(params, cache, toks, pos, key):
             logits, upd = self._step_model.apply(
                 {"params": params, "cache": cache}, toks[:, None],
                 pos[:, None], mutable=["cache"])
             return upd["cache"], _pick(logits[:, -1], key, temp)
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(
+            jax.jit, donate_argnums=(0,),
+            out_shardings=cache_shardings if mesh is not None else None)
         def admit(cache, pre_cache, slot, lp):
             """Mask the prefill cache's first ``lp`` positions into row
             ``slot`` of the pool. Positions >= lp (pad garbage) keep the
