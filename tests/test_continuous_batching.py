@@ -138,6 +138,37 @@ def test_validation(setup):
         eng.submit(np.arange(60), 10)
 
 
+def test_step_horizon_matches_single_step(setup):
+    """step_horizon=4 (4 decode steps scanned per compiled call) must emit
+    the same greedy continuations — including requests whose length is NOT
+    a horizon multiple (surplus tokens discarded) and an eos that fires
+    mid-horizon."""
+    cfg, params = setup
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(0, cfg.vocab_size, size=n).astype(np.int32)
+               for n in (5, 9, 3)]
+    news = [10, 7, 13]              # none a multiple of 4
+
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2, step_horizon=4)
+    ids = [eng.submit(p, n) for p, n in zip(prompts, news)]
+    eng.step()
+    out = eng.run()
+    for rid, p, n in zip(ids, prompts, news):
+        np.testing.assert_array_equal(out[rid], _want(cfg, params, p, n),
+                                      err_msg=f"request {rid}")
+
+    # eos mid-horizon: the slot retires at the eos token, not the boundary
+    full = _want(cfg, params, prompts[0], 12)
+    eos = int(full[5])              # fires at token 6 = mid-horizon-2
+    r = eng.submit(prompts[0], 12, eos_id=eos)
+    got = eng.run()[r]
+    stop = int(np.argmax(full == eos)) + 1
+    np.testing.assert_array_equal(got, full[:stop])
+
+    with pytest.raises(ValueError, match="step_horizon"):
+        ContinuousBatchingEngine(cfg, params, step_horizon=0)
+
+
 def test_sharded_engine_matches_unsharded(setup):
     """Tensor-parallel serving: the engine over a (fsdp=4, model=2) mesh —
     params by the training partition rules, KV cache kv-head-sharded on

@@ -130,15 +130,17 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
 
 
 def bench_continuous(n_slots: int = 8, n_requests: int = 32,
-                     new_tokens: int = 128,
-                     cache_int8: bool = False) -> dict:
+                     new_tokens: int = 128, cache_int8: bool = False,
+                     step_horizon: int = 1) -> dict:
     """Continuous-batching serving throughput on the 350M flagship
     (`tpu_on_k8s/models/serving.py`): ragged prompts (64-256 tokens)
     streaming through a fixed slot pool, greedy, bf16 weights. Unlike
     ``bench_decode`` (one static batch, whole generation in one compiled
-    scan) this pays a host round-trip per decode step — the price of
-    admitting/retiring requests mid-flight — so its tokens/s is the honest
-    mixed-traffic number, not the batch-peak one."""
+    scan) this pays a host round-trip per ``step_horizon`` decode steps —
+    the price of admitting/retiring requests mid-flight (horizon 1 = every
+    step; higher horizons amortize the round-trip but delay admission) —
+    so its tokens/s is the honest mixed-traffic number, not the
+    batch-peak one."""
     import dataclasses
 
     import jax
@@ -160,7 +162,7 @@ def bench_continuous(n_slots: int = 8, n_requests: int = 32,
 
     rng = np.random.default_rng(0)
     eng = ContinuousBatchingEngine(cfg, params, n_slots=n_slots,
-                                   max_len=512)
+                                   max_len=512, step_horizon=step_horizon)
     # warmup compiles: the step program, the admit program, and one
     # prefill program per 128-bucket the traffic below can hit
     for lp in (100, 200):
@@ -187,6 +189,7 @@ def bench_continuous(n_slots: int = 8, n_requests: int = 32,
         "n_requests": n_requests,
         "prompt_lens": "uniform[64,256]",
         "new_tokens": new_tokens,
+        "step_horizon": step_horizon,
         "decode_steps": eng.stats["steps"],
         # prefill emits each request's first token outside the step loop,
         # so utilization counts only step-emitted tokens
@@ -281,7 +284,14 @@ def main() -> None:
                         help="measure continuous-batching serving "
                              "throughput (mixed ragged traffic through the "
                              "slot pool) instead of the static decode batch")
+    parser.add_argument("--horizon", type=int, default=1,
+                        help="continuous engine step horizon: decode steps "
+                             "scanned per compiled call (amortizes the "
+                             "per-step host round-trip)")
     args = parser.parse_args()
+    if args.horizon > 1 and not args.continuous:
+        parser.error("--horizon only applies to --continuous (the static "
+                     "decode bench has no step horizon)")
 
     published = {}
     if not args.skip_submit:
@@ -295,7 +305,10 @@ def main() -> None:
             key = ("continuous_batching_tokens_per_sec_cache_int8"
                    if args.cache_int8
                    else "continuous_batching_tokens_per_sec")
-            published[key] = bench_continuous(cache_int8=args.cache_int8)
+            if args.horizon > 1:
+                key += f"_h{args.horizon}"
+            published[key] = bench_continuous(cache_int8=args.cache_int8,
+                                              step_horizon=args.horizon)
             print(json.dumps(published[key]))
         else:
             key = ("decode_tokens_per_sec_cache_int8" if args.cache_int8

@@ -27,10 +27,17 @@ step program for the engine's lifetime:
   is a host-side bookkeeping change; the next step simply runs without it
   (its row computes garbage that nobody reads — on TPU that is cheaper
   than a shape change, which would recompile).
+* ``step_horizon > 1`` scans that many decode steps inside ONE compiled
+  program (`lax.scan`), amortizing the per-step host round-trip — the
+  dominant cost when the host↔device link is slow. The trade: admission
+  and retirement only happen at horizon boundaries, so a slot that
+  finishes mid-horizon wastes the remaining iterations (its surplus
+  tokens are discarded host-side; greedy output is unchanged) and a
+  queued request waits up to ``horizon`` steps for admission.
 
 The host loop (``step()``) is plain Python: admit from the queue into free
-slots, run one device step, collect finished requests. One H2D transfer of
-two ``[n_slots]`` int vectors per step; the cache lives on device.
+slots, run one device horizon, collect finished requests. One H2D transfer
+of two ``[n_slots]`` int vectors per horizon; the cache lives on device.
 """
 from __future__ import annotations
 
@@ -96,7 +103,10 @@ class ContinuousBatchingEngine:
 
     def __init__(self, cfg: TransformerConfig, params, n_slots: int = 8,
                  max_len: Optional[int] = None, temperature: float = 0.0,
-                 rng: Optional[jax.Array] = None, mesh=None, rules=None):
+                 rng: Optional[jax.Array] = None, mesh=None, rules=None,
+                 step_horizon: int = 1):
+        if step_horizon < 1:
+            raise ValueError(f"step_horizon must be >= 1, got {step_horizon}")
         max_len = max_len or cfg.max_seq_len
         if max_len > cfg.max_seq_len and cfg.pos_emb != "rope":
             raise ValueError("max_len beyond the trained table needs rope")
@@ -153,16 +163,27 @@ class ContinuousBatchingEngine:
         self._params = params
 
         temp = temperature
+        self.step_horizon = horizon = step_horizon
 
         @functools.partial(
             jax.jit, donate_argnums=(1,),
             out_shardings=((cache_shardings, token_shardings)
                            if mesh is not None else None))
         def step(params, cache, toks, pos, key):
-            logits, upd = self._step_model.apply(
-                {"params": params, "cache": cache}, toks[:, None],
-                pos[:, None], mutable=["cache"])
-            return upd["cache"], _pick(logits[:, -1], key, temp)
+            """``horizon`` decode steps in one program; returns the cache
+            and the [horizon, n_slots] token stack (retired rows' surplus
+            is discarded by the host)."""
+            def body(carry, step_key):
+                cache, tok, p = carry
+                logits, upd = self._step_model.apply(
+                    {"params": params, "cache": cache}, tok[:, None],
+                    p[:, None], mutable=["cache"])
+                nxt = _pick(logits[:, -1], step_key, temp)
+                return (upd["cache"], nxt, p + 1), nxt
+
+            (cache, _, _), toks_out = jax.lax.scan(
+                body, (cache, toks, pos), jax.random.split(key, horizon))
+            return cache, toks_out
 
         @functools.partial(
             jax.jit, donate_argnums=(0,),
@@ -266,8 +287,9 @@ class ContinuousBatchingEngine:
 
     # ---- the engine loop ---------------------------------------------------
     def step(self) -> List[int]:
-        """Admit queued requests, advance every active slot one token, and
-        return the ids of requests that finished this step."""
+        """Admit queued requests, advance every active slot by one horizon
+        (``step_horizon`` tokens in one compiled program), and return the
+        ids of requests that finished."""
         self._admit_pending()
         before = set(self._finished)
         active = [i for i, s in enumerate(self._slots) if s is not None]
@@ -278,18 +300,20 @@ class ContinuousBatchingEngine:
                 toks[i] = self._slots[i].last_token
                 pos[i] = self._slots[i].pos
             self._rng, key = jax.random.split(self._rng)
-            self._cache, nxt = self._step(self._params, self._cache,
+            self._cache, out = self._step(self._params, self._cache,
                                           jnp.asarray(toks),
                                           jnp.asarray(pos), key)
-            nxt = np.asarray(nxt)
-            self.stats["steps"] += 1
+            out = np.asarray(out)               # [horizon, n_slots]
+            self.stats["steps"] += self.step_horizon
             for i in active:
-                slot = self._slots[i]
-                slot.pos += 1
-                slot.last_token = int(nxt[i])
-                slot.emitted.append(slot.last_token)
-                self.stats["emitted"] += 1
-                self._retire_if_done(i)
+                for j in range(self.step_horizon):
+                    slot = self._slots[i]
+                    slot.pos += 1
+                    slot.last_token = int(out[j, i])
+                    slot.emitted.append(slot.last_token)
+                    self.stats["emitted"] += 1
+                    if self._retire_if_done(i):
+                        break  # surplus horizon tokens are discarded
         return sorted(set(self._finished) - before)
 
     def run(self) -> Dict[int, np.ndarray]:
