@@ -70,6 +70,22 @@ def test_train_llama_tiny_ring():
     assert np.isfinite(loss)
 
 
+def test_serve_continuous_tiny():
+    """The serving example drains mixed traffic end-to-end — plain and
+    tensor-parallel with a step horizon."""
+    from examples.serve import main
+    out = main(["--config", "tiny", "--n-requests", "4", "--n-slots", "2",
+                "--max-new-tokens", "6", "--arrival", "2"])
+    assert len(out) == 4
+    assert all(len(v) == 6 for v in out.values())
+
+    out_tp = main(["--config", "tiny", "--n-requests", "3", "--n-slots", "2",
+                   "--max-new-tokens", "5", "--model-axis", "2",
+                   "--horizon", "4"])
+    assert len(out_tp) == 3
+    assert all(len(v) == 5 for v in out_tp.values())
+
+
 def test_aimaster_run_loop():
     from examples.aimaster import run
     from tpu_on_k8s.api import constants
