@@ -14,6 +14,7 @@ loop, exactly as this file does.
 from __future__ import annotations
 
 import argparse
+import statistics
 import time
 
 import jax
@@ -51,6 +52,9 @@ def main(argv=None):
     p.add_argument("--prompt-max", type=int, default=24)
     p.add_argument("--max-new-tokens", type=int, default=16)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help=">0 scrapes serving metrics at /metrics "
+                        "(prometheus), like the operator's metrics server")
     args = p.parse_args(argv)
 
     cfg = CONFIGS[args.config]()
@@ -82,11 +86,17 @@ def main(argv=None):
         rules = flagship_partition_rules()
         print(f"serving tensor-parallel over mesh {dict(mesh.shape)}")
 
+    from tpu_on_k8s.metrics.metrics import ServingMetrics, serve as serve_metrics
+    metrics = ServingMetrics()
+    if args.metrics_port:
+        serve_metrics(metrics, args.metrics_port)
+        print(f"metrics at :{args.metrics_port}/metrics")
+
     eng = ContinuousBatchingEngine(
         cfg, params, n_slots=args.n_slots,
         max_len=args.max_len or None, temperature=args.temperature,
         rng=jax.random.key(args.seed + 1), mesh=mesh, rules=rules,
-        step_horizon=args.horizon)
+        step_horizon=args.horizon, metrics=metrics)
 
     rng = np.random.default_rng(args.seed)
     submitted = 0
@@ -109,8 +119,14 @@ def main(argv=None):
             print(f"← r{rid} done: {finished[rid].tolist()}")
     dt = time.perf_counter() - t0
     total = sum(len(v) for v in finished.values())
-    print(f"served {len(finished)} requests, {total} tokens in {dt:.2f}s "
-          f"({total / dt:.1f} tok/s) — stats {eng.stats}")
+    line = (f"served {len(finished)} requests, {total} tokens in {dt:.2f}s "
+            f"({total / dt:.1f} tok/s) — stats {eng.stats}")
+    lat = metrics.histograms["request_latency_seconds"]
+    ttft = metrics.histograms["time_to_first_token_seconds"]
+    if lat and ttft:
+        line += (f"; p50 latency {statistics.median(lat) * 1e3:.0f}ms, "
+                 f"p50 TTFT {statistics.median(ttft) * 1e3:.0f}ms")
+    print(line)
     return finished
 
 

@@ -196,6 +196,37 @@ def test_sharded_engine_matches_unsharded(setup):
                                       err_msg=f"request {rid}")
 
 
+def test_serving_metrics(setup):
+    """The engine reports through the framework's metrics plane: counters,
+    TTFT/queue-wait/latency histograms, slot/queue gauges."""
+    from tpu_on_k8s.metrics.metrics import ServingMetrics
+
+    cfg, params = setup
+    m = ServingMetrics()
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=1, metrics=m)
+    rng = np.random.default_rng(13)
+    ids = [eng.submit(rng.integers(0, cfg.vocab_size,
+                                   size=4 + i).astype(np.int32), 3)
+           for i in range(3)]
+    assert m.counters["requests_submitted"] == 3
+    assert m.gauges["queue_depth"] == 3     # nothing admitted yet
+    out = eng.run()
+    assert set(out) == set(ids)
+    assert m.counters["requests_finished"] == 3
+    assert m.counters["tokens_emitted"] == 9   # 3 requests x 3 tokens
+    assert len(m.histograms["time_to_first_token_seconds"]) == 3
+    assert len(m.histograms["queue_wait_seconds"]) == 3
+    assert len(m.histograms["request_latency_seconds"]) == 3
+    # single slot: the 2nd/3rd requests queued strictly longer than the 1st
+    waits = m.histograms["queue_wait_seconds"]
+    assert waits[0] <= waits[1] <= waits[2]
+    # latency covers queue + generation, so it dominates TTFT per request
+    for ttft, lat in zip(m.histograms["time_to_first_token_seconds"],
+                         m.histograms["request_latency_seconds"]):
+        assert lat >= ttft
+    assert m.gauges["slots_active"] == 0 and m.gauges["queue_depth"] == 0
+
+
 def test_sampled_engine_bounds(setup):
     """temperature > 0: output tokens are in-vocab and the run drains."""
     cfg, params = setup

@@ -20,18 +20,42 @@ except ImportError:  # pragma: no cover
 _BUCKETS = (0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
 
 
-class JobMetrics:
-    """One instance per controller manager (kind-labelled like the reference)."""
+class _MetricsBase:
+    """Shared mirror scaffolding: a lock, plain-dict counters/histograms
+    (always readable without a scrape), and the optional prometheus
+    twins populated by subclasses."""
 
-    def __init__(self, kind: str = "TPUJob", registry=None) -> None:
-        self.kind = kind
+    def __init__(self) -> None:
         self._lock = threading.Lock()
         self.counters: Dict[str, int] = defaultdict(int)
         self.histograms: Dict[str, List[float]] = defaultdict(list)
-        self.gauges: Dict[Tuple[str, str], float] = {}
         self._prom_counters = {}
         self._prom_hists = {}
         self._prom_gauges = {}
+        self.registry = None
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] += n
+        c = self._prom_counters.get(name)
+        if c is not None:
+            c.inc(n)
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self.histograms[name].append(seconds)
+        h = self._prom_hists.get(name)
+        if h is not None:
+            h.observe(seconds)
+
+
+class JobMetrics(_MetricsBase):
+    """One instance per controller manager (kind-labelled like the reference)."""
+
+    def __init__(self, kind: str = "TPUJob", registry=None) -> None:
+        super().__init__()
+        self.kind = kind
+        self.gauges: Dict[Tuple[str, str], float] = {}
         if _prom is not None:
             registry = registry or _prom.CollectorRegistry()
             self.registry = registry
@@ -53,22 +77,6 @@ class JobMetrics:
             self._prom_gauges["queue_pending"] = _prom.Gauge(
                 f"{ns}_tenant_queue_jobs_pending_count", "Pending jobs per tenant queue",
                 ["queue"], registry=registry)
-        else:  # pragma: no cover
-            self.registry = None
-
-    def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self.counters[name] += n
-        c = self._prom_counters.get(name)
-        if c is not None:
-            c.inc(n)
-
-    def observe(self, name: str, seconds: float) -> None:
-        with self._lock:
-            self.histograms[name].append(seconds)
-        h = self._prom_hists.get(name)
-        if h is not None:
-            h.observe(seconds)
 
     def set_gauge(self, name: str, value: float, label: str = "") -> None:
         with self._lock:
@@ -103,8 +111,48 @@ class JobMetrics:
         self.observe("all_pods_launch_delay_seconds", seconds)
 
 
-def serve(metrics: JobMetrics, port: int = 8443):  # pragma: no cover - live mode
-    """Expose /metrics (reference pkg/metrics/server.go:29-37)."""
+_SERVING_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1,
+                    2.5, 5, 10, 30)
+
+
+class ServingMetrics(_MetricsBase):
+    """Continuous-batching serving observability (the compute plane's analog
+    of ``JobMetrics`` — same prometheus + plain-dict mirror pattern, same
+    ``serve()`` scrape path): request counters, time-to-first-token /
+    queue-wait / request-latency histograms, slot/queue gauges. The
+    reference has no serving plane; the bucket layout follows its metrics
+    conventions (/root/reference/pkg/metrics/metrics.go:33-124)."""
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        self.gauges: Dict[str, float] = {}
+        if _prom is not None:
+            registry = registry or _prom.CollectorRegistry()
+            self.registry = registry
+            ns = "tpu_on_k8s_serving"
+            for name in ("requests_submitted", "requests_finished",
+                         "tokens_emitted"):
+                self._prom_counters[name] = _prom.Counter(
+                    f"{ns}_{name}", f"Serving {name}", registry=registry)
+            for name in ("time_to_first_token_seconds",
+                         "queue_wait_seconds", "request_latency_seconds"):
+                self._prom_hists[name] = _prom.Histogram(
+                    f"{ns}_{name}", f"Serving {name}",
+                    buckets=_SERVING_BUCKETS, registry=registry)
+            for name in ("slots_active", "queue_depth"):
+                self._prom_gauges[name] = _prom.Gauge(
+                    f"{ns}_{name}", f"Serving {name}", registry=registry)
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = value
+        g = self._prom_gauges.get(name)
+        if g is not None:
+            g.set(value)
+
+
+def serve(metrics, port: int = 8443):  # pragma: no cover - live mode
+    """Expose /metrics (reference pkg/metrics/server.go:29-37) for a
+    ``JobMetrics`` or ``ServingMetrics`` instance."""
     if _prom is None or metrics.registry is None:
         raise RuntimeError("prometheus_client unavailable")
     return _prom.start_http_server(port, registry=metrics.registry)

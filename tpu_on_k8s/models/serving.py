@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
@@ -74,6 +75,7 @@ class _Slot:
     emitted: List[int]
     max_new_tokens: int
     eos_id: Optional[int]
+    submitted_at: float = 0.0     # monotonic submit time (metrics)
 
 
 @dataclasses.dataclass
@@ -82,6 +84,7 @@ class _Pending:
     prompt: np.ndarray            # [lp] int32
     max_new_tokens: int
     eos_id: Optional[int]
+    submitted_at: float = 0.0
 
 
 def _strip_index(cache: Any) -> Any:
@@ -104,9 +107,13 @@ class ContinuousBatchingEngine:
     def __init__(self, cfg: TransformerConfig, params, n_slots: int = 8,
                  max_len: Optional[int] = None, temperature: float = 0.0,
                  rng: Optional[jax.Array] = None, mesh=None, rules=None,
-                 step_horizon: int = 1):
+                 step_horizon: int = 1, metrics=None):
         if step_horizon < 1:
             raise ValueError(f"step_horizon must be >= 1, got {step_horizon}")
+        #: Optional ``tpu_on_k8s.metrics.metrics.ServingMetrics`` — request
+        #: counters, TTFT/queue-wait/latency histograms, slot/queue gauges,
+        #: scrapeable via the same metrics.serve() path the operator uses.
+        self.metrics = metrics
         max_len = max_len or cfg.max_seq_len
         if max_len > cfg.max_seq_len and cfg.pos_emb != "rope":
             raise ValueError("max_len beyond the trained table needs rope")
@@ -228,7 +235,11 @@ class ContinuousBatchingEngine:
                 f"engine's max_len {self.max_len}")
         rid = self._next_id
         self._next_id += 1
-        self._queue.append(_Pending(rid, prompt, max_new_tokens, eos_id))
+        self._queue.append(_Pending(rid, prompt, max_new_tokens, eos_id,
+                                    time.monotonic()))
+        if self.metrics is not None:
+            self.metrics.inc("requests_submitted")
+            self.metrics.set_gauge("queue_depth", len(self._queue))
         return rid
 
     def _prefill_fn(self, bucket: int):
@@ -258,6 +269,8 @@ class ContinuousBatchingEngine:
             if self._slots[i] is not None:
                 continue
             req = self._queue.popleft()
+            dequeued_at = time.monotonic()   # queue wait ends HERE — the
+                                             # prefill that follows is TTFT
             lp = int(req.prompt.size)
             bucket = _bucket_len(lp, self.max_len)
             padded = np.zeros((1, bucket), np.int32)
@@ -267,11 +280,19 @@ class ContinuousBatchingEngine:
                 self._params, jnp.asarray(padded), lp, key)
             self._cache = self._admit(self._cache, pre_cache,
                                       jnp.int32(i), jnp.int32(lp))
-            first = int(first)
+            first = int(first)   # host sync: the first token IS emitted now
             self._slots[i] = _Slot(req.request_id, lp, first, [first],
-                                   req.max_new_tokens, req.eos_id)
+                                   req.max_new_tokens, req.eos_id,
+                                   req.submitted_at)
             self.stats["admitted"] += 1
             self.stats["emitted"] += 1
+            if self.metrics is not None:
+                self.metrics.observe("queue_wait_seconds",
+                                     dequeued_at - req.submitted_at)
+                self.metrics.observe("time_to_first_token_seconds",
+                                     time.monotonic() - req.submitted_at)
+                self.metrics.inc("tokens_emitted")
+                self.metrics.set_gauge("queue_depth", len(self._queue))
             self._retire_if_done(i)
 
     def _retire_if_done(self, i: int) -> bool:
@@ -283,6 +304,10 @@ class ContinuousBatchingEngine:
             self._finished[slot.request_id] = np.asarray(slot.emitted,
                                                          np.int32)
             self._slots[i] = None
+            if self.metrics is not None:
+                self.metrics.inc("requests_finished")
+                self.metrics.observe("request_latency_seconds",
+                                     time.monotonic() - slot.submitted_at)
         return done
 
     # ---- the engine loop ---------------------------------------------------
@@ -305,6 +330,7 @@ class ContinuousBatchingEngine:
                                           jnp.asarray(pos), key)
             out = np.asarray(out)               # [horizon, n_slots]
             self.stats["steps"] += self.step_horizon
+            emitted_now = 0
             for i in active:
                 for j in range(self.step_horizon):
                     slot = self._slots[i]
@@ -312,8 +338,15 @@ class ContinuousBatchingEngine:
                     slot.last_token = int(out[j, i])
                     slot.emitted.append(slot.last_token)
                     self.stats["emitted"] += 1
+                    emitted_now += 1
                     if self._retire_if_done(i):
                         break  # surplus horizon tokens are discarded
+            if self.metrics is not None:
+                self.metrics.inc("tokens_emitted", emitted_now)
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "slots_active",
+                sum(s is not None for s in self._slots))
         return sorted(set(self._finished) - before)
 
     def run(self) -> Dict[int, np.ndarray]:
