@@ -196,6 +196,50 @@ def test_sharded_engine_matches_unsharded(setup):
                                       err_msg=f"request {rid}")
 
 
+def test_prefix_caching_matches_full_prompt(setup):
+    """A registered prefix (system prompt) is prefilled once; requests
+    carrying it must continue exactly as if the full prefix+suffix prompt
+    had been submitted — across multiple requests and mixed traffic."""
+    cfg, params = setup
+    rng = np.random.default_rng(21)
+    prefix = rng.integers(0, cfg.vocab_size, size=11).astype(np.int32)
+    suffixes = [rng.integers(0, cfg.vocab_size, size=n).astype(np.int32)
+                for n in (4, 9, 2)]
+    news = [8, 5, 10]
+
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2)
+    pid = eng.register_prefix(prefix)
+    ids = [eng.submit(s, n, prefix_id=pid)
+           for s, n in zip(suffixes, news)]
+    plain = eng.submit(rng.integers(0, cfg.vocab_size,
+                                    size=6).astype(np.int32), 7)
+    out = eng.run()
+
+    for rid, s, n in zip(ids, suffixes, news):
+        full = np.concatenate([prefix, s])
+        np.testing.assert_array_equal(out[rid], _want(cfg, params, full, n),
+                                      err_msg=f"prefix request {rid}")
+    # the interleaved non-prefix request is untouched by prefix traffic
+    assert out[plain].shape == (7,)
+
+    # one suffix-prefill program per suffix bucket, not per request
+    assert len(eng._suffix_prefill_cache) == 1
+
+
+def test_prefix_caching_validation(setup):
+    cfg, params = setup
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=1)
+    with pytest.raises(ValueError, match="unknown prefix_id"):
+        eng.submit(np.arange(4), 2, prefix_id=99)
+    with pytest.raises(ValueError, match="empty prefix"):
+        eng.register_prefix(np.zeros(0, np.int32))
+    with pytest.raises(ValueError, match="no room"):
+        eng.register_prefix(np.arange(64))
+    pid = eng.register_prefix(np.arange(40))
+    with pytest.raises(ValueError, match="exceeds"):
+        eng.submit(np.arange(10), 20, prefix_id=pid)   # 40+10+20 > 64
+
+
 def test_serving_metrics(setup):
     """The engine reports through the framework's metrics plane: counters,
     TTFT/queue-wait/latency histograms, slot/queue gauges."""

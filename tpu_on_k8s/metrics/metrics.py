@@ -9,7 +9,7 @@ plain dicts so tests and the local driver can read without a scrape.
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Dict, List, Optional, Tuple
 
 try:  # prometheus_client ships in the image; degrade gracefully anyway
@@ -23,12 +23,20 @@ _BUCKETS = (0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
 class _MetricsBase:
     """Shared mirror scaffolding: a lock, plain-dict counters/histograms
     (always readable without a scrape), and the optional prometheus
-    twins populated by subclasses."""
+    twins populated by subclasses. The histogram mirror is a bounded
+    deque — the serving plane observes per REQUEST, so an unbounded list
+    would leak host RAM on a long-lived server; prometheus keeps the
+    full-precision aggregates."""
+
+    #: raw observations retained per histogram (newest win)
+    MIRROR_CAP = 10_000
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.counters: Dict[str, int] = defaultdict(int)
-        self.histograms: Dict[str, List[float]] = defaultdict(list)
+        cap = self.MIRROR_CAP
+        self.histograms: Dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=cap))
         self._prom_counters = {}
         self._prom_hists = {}
         self._prom_gauges = {}

@@ -85,6 +85,7 @@ class _Pending:
     max_new_tokens: int
     eos_id: Optional[int]
     submitted_at: float = 0.0
+    prefix_id: Optional[int] = None
 
 
 def _strip_index(cache: Any) -> Any:
@@ -211,6 +212,9 @@ class ContinuousBatchingEngine:
         self._step = step
         self._admit = admit
         self._prefill_cache: Dict[int, Any] = {}
+        self._suffix_prefill_cache: Dict[int, Any] = {}
+        self._prefixes: Dict[int, Any] = {}   # id → (cache pytree, length)
+        self._next_prefix_id = 0
 
         self._slots: List[Optional[_Slot]] = [None] * n_slots
         self._queue: deque[_Pending] = deque()
@@ -219,24 +223,58 @@ class ContinuousBatchingEngine:
         self.stats = {"steps": 0, "emitted": 0, "admitted": 0}
 
     # ---- request lifecycle -------------------------------------------------
+    def register_prefix(self, tokens) -> int:
+        """Prefill a shared prefix (a system prompt) ONCE and keep its KV
+        device-resident; requests submitted with the returned ``prefix_id``
+        attend to it without recomputing — each admission prefills only its
+        own suffix. RoPE positions are absolute, so the prefix KV (always
+        at positions [0, len)) is valid under every continuation. Costs one
+        full-length single-request cache pytree of HBM per registered
+        prefix, held for the engine's lifetime."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size == 0:
+            raise ValueError("empty prefix")
+        if tokens.size >= self.max_len:
+            raise ValueError(f"prefix {tokens.size} leaves no room under "
+                             f"max_len {self.max_len}")
+        lp = int(tokens.size)
+        bucket = _bucket_len(lp, self.max_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :lp] = tokens
+        self._rng, key = jax.random.split(self._rng)
+        cache, _ = self._prefill_fn(bucket)(self._params,
+                                            jnp.asarray(padded), lp, key)
+        pid = self._next_prefix_id
+        self._next_prefix_id += 1
+        self._prefixes[pid] = (cache, lp)
+        return pid
+
     def submit(self, prompt, max_new_tokens: int,
-               eos_id: Optional[int] = None) -> int:
+               eos_id: Optional[int] = None,
+               prefix_id: Optional[int] = None) -> int:
         """Enqueue a request; returns its id. ``prompt`` is a 1-D token
-        sequence; admission happens on a later ``step()``."""
+        sequence (with ``prefix_id``: the tokens AFTER the registered
+        prefix); admission happens on a later ``step()``."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got "
                              f"{max_new_tokens}")
-        if prompt.size + max_new_tokens > self.max_len:
+        plen = 0
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise ValueError(f"unknown prefix_id {prefix_id}")
+            plen = self._prefixes[prefix_id][1]
+        if plen + prompt.size + max_new_tokens > self.max_len:
             raise ValueError(
-                f"prompt {prompt.size} + new {max_new_tokens} exceeds the "
-                f"engine's max_len {self.max_len}")
+                f"prefix {plen} + prompt {prompt.size} + new "
+                f"{max_new_tokens} exceeds the engine's max_len "
+                f"{self.max_len}")
         rid = self._next_id
         self._next_id += 1
         self._queue.append(_Pending(rid, prompt, max_new_tokens, eos_id,
-                                    time.monotonic()))
+                                    time.monotonic(), prefix_id))
         if self.metrics is not None:
             self.metrics.inc("requests_submitted")
             self.metrics.set_gauge("queue_depth", len(self._queue))
@@ -262,6 +300,30 @@ class ContinuousBatchingEngine:
             fn = self._prefill_cache[bucket] = prefill
         return fn
 
+    def _suffix_prefill_fn(self, bucket: int):
+        """Chunked prefill of a request's suffix into a prefix-seeded cache
+        (cursor set to the prefix length, so the append lands after the
+        prefix and the exact over-cache attention path serves every suffix
+        query — it attends the prefix KV without recomputing it)."""
+        fn = self._suffix_prefill_cache.get(bucket)
+        if fn is None:
+            from tpu_on_k8s.models.decode import _set_cursor
+            model = self._prefill_model
+            temp = self.temperature
+
+            @jax.jit
+            def prefill(params, pre_cache, suffix, plen, slen, key):
+                cache = _set_cursor(pre_cache, plen)
+                positions = plen + jnp.arange(bucket,
+                                              dtype=jnp.int32)[None, :]
+                logits, upd = model.apply(
+                    {"params": params, "cache": cache}, suffix, positions,
+                    mutable=["cache"])
+                return upd["cache"], _pick(logits[0, slen - 1], key, temp)
+
+            fn = self._suffix_prefill_cache[bucket] = prefill
+        return fn
+
     def _admit_pending(self) -> None:
         for i in range(self.n_slots):
             if not self._queue:
@@ -271,13 +333,24 @@ class ContinuousBatchingEngine:
             req = self._queue.popleft()
             dequeued_at = time.monotonic()   # queue wait ends HERE — the
                                              # prefill that follows is TTFT
-            lp = int(req.prompt.size)
-            bucket = _bucket_len(lp, self.max_len)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :lp] = req.prompt
+            slen = int(req.prompt.size)
             self._rng, key = jax.random.split(self._rng)
-            pre_cache, first = self._prefill_fn(bucket)(
-                self._params, jnp.asarray(padded), lp, key)
+            prefix_cache, plen = ((None, 0) if req.prefix_id is None
+                                  else self._prefixes[req.prefix_id])
+            # the (suffix) bucket may not spill past max_len: appends land
+            # at plen..plen+bucket-1 (dynamic_update_slice would clamp a
+            # spilling start and corrupt earlier rows)
+            bucket = _bucket_len(slen, self.max_len - plen)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :slen] = req.prompt
+            if prefix_cache is not None:
+                pre_cache, first = self._suffix_prefill_fn(bucket)(
+                    self._params, prefix_cache, jnp.asarray(padded),
+                    jnp.int32(plen), jnp.int32(slen), key)
+            else:
+                pre_cache, first = self._prefill_fn(bucket)(
+                    self._params, jnp.asarray(padded), slen, key)
+            lp = plen + slen
             self._cache = self._admit(self._cache, pre_cache,
                                       jnp.int32(i), jnp.int32(lp))
             first = int(first)   # host sync: the first token IS emitted now
