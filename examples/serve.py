@@ -55,6 +55,10 @@ def main(argv=None):
     p.add_argument("--metrics-port", type=int, default=0,
                    help=">0 scrapes serving metrics at /metrics "
                         "(prometheus), like the operator's metrics server")
+    p.add_argument("--system-prompt-len", type=int, default=0,
+                   help=">0 registers a shared prefix of this length once "
+                        "(prefix caching); every request then prefills "
+                        "only its own suffix")
     args = p.parse_args(argv)
 
     cfg = CONFIGS[args.config]()
@@ -98,7 +102,20 @@ def main(argv=None):
         rng=jax.random.key(args.seed + 1), mesh=mesh, rules=rules,
         step_horizon=args.horizon, metrics=metrics)
 
+    worst = (args.system_prompt_len + args.prompt_max
+             + args.max_new_tokens)
+    if worst > eng.max_len:
+        raise SystemExit(
+            f"system prompt {args.system_prompt_len} + prompt-max "
+            f"{args.prompt_max} + max-new-tokens {args.max_new_tokens} = "
+            f"{worst} exceeds the engine's max_len {eng.max_len}")
     rng = np.random.default_rng(args.seed)
+    prefix_id = None
+    if args.system_prompt_len:
+        prefix_id = eng.register_prefix(rng.integers(
+            0, cfg.vocab_size, size=args.system_prompt_len).astype(np.int32))
+        print(f"registered a {args.system_prompt_len}-token shared prefix "
+              f"(id {prefix_id})")
     submitted = 0
     t0 = time.perf_counter()
     finished = {}
@@ -111,7 +128,8 @@ def main(argv=None):
                 lp = int(rng.integers(args.prompt_min, args.prompt_max + 1))
                 prompt = rng.integers(0, cfg.vocab_size,
                                       size=lp).astype(np.int32)
-                rid = eng.submit(prompt, args.max_new_tokens)
+                rid = eng.submit(prompt, args.max_new_tokens,
+                                 prefix_id=prefix_id)
                 submitted += 1
                 print(f"→ r{rid} submitted (prompt {lp} tokens)")
         for rid in eng.step():

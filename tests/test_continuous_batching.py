@@ -271,6 +271,41 @@ def test_serving_metrics(setup):
     assert m.gauges["slots_active"] == 0 and m.gauges["queue_depth"] == 0
 
 
+def test_random_traffic_fuzz(setup):
+    """Randomized mixed traffic — ragged lengths, random admission times,
+    random horizons, prefix and plain requests interleaved, slot churn —
+    every continuation must equal its solo generate() oracle."""
+    cfg, params = setup
+    for seed in (31, 32):
+        rng = np.random.default_rng(seed)
+        horizon = int(rng.integers(1, 5))
+        eng = ContinuousBatchingEngine(cfg, params,
+                                       n_slots=int(rng.integers(1, 4)),
+                                       step_horizon=horizon)
+        prefix = rng.integers(0, cfg.vocab_size, size=10).astype(np.int32)
+        pid = eng.register_prefix(prefix)
+        want, pending = {}, []
+        for _ in range(8):
+            lp = int(rng.integers(1, 14))
+            n = int(rng.integers(1, 11))
+            p = rng.integers(0, cfg.vocab_size, size=lp).astype(np.int32)
+            if rng.random() < 0.4:
+                rid = eng.submit(p, n, prefix_id=pid)
+                want[rid] = (np.concatenate([prefix, p]), n)
+            else:
+                rid = eng.submit(p, n)
+                want[rid] = (p, n)
+            pending.append(rid)
+            for _ in range(int(rng.integers(0, 3))):
+                eng.step()
+        out = eng.run()
+        assert set(out) == set(pending)
+        for rid, (full, n) in want.items():
+            np.testing.assert_array_equal(
+                out[rid], _want(cfg, params, full, n),
+                err_msg=f"seed {seed} request {rid} (horizon {horizon})")
+
+
 def test_sampled_engine_bounds(setup):
     """temperature > 0: output tokens are in-vocab and the run drains."""
     cfg, params = setup

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 from collections import defaultdict, deque
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Tuple
 
 try:  # prometheus_client ships in the image; degrade gracefully anyway
     import prometheus_client as _prom

@@ -234,7 +234,9 @@ class ContinuousBatchingEngine:
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size == 0:
             raise ValueError("empty prefix")
-        if tokens.size >= self.max_len:
+        if tokens.size > self.max_len - 2:
+            # every request needs >= 1 prompt token and >= 1 new token on
+            # top of the prefix — a longer prefix could never be used
             raise ValueError(f"prefix {tokens.size} leaves no room under "
                              f"max_len {self.max_len}")
         lp = int(tokens.size)
