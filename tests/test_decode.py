@@ -229,6 +229,99 @@ def test_int8_kv_cache_close_to_fp_and_halves_cache_bytes():
     assert out.shape == (2, 4)
 
 
+class TestInt8ServingWeights:
+    """W8A16 serving: int8 kernels + per-out-channel scales must stay
+    numerically close to the fp model, halve the weight bytes, and serve
+    end to end through generate() and the continuous engine."""
+
+    @staticmethod
+    def _setup():
+        cfg = dataclasses.replace(TransformerConfig.tiny(),
+                                  dtype=jnp.float32)
+        tokens = jnp.arange(12, dtype=jnp.int32)[None, :].repeat(2, axis=0)
+        params = Transformer(cfg).init(jax.random.key(0), tokens)["params"]
+        return cfg, params, tokens
+
+    def test_structure_and_bytes(self):
+        from tpu_on_k8s.models.decode import quantize_weights_for_serving
+
+        cfg, params, _ = self._setup()
+        q = quantize_weights_for_serving(params)
+        attn = q["blocks"]["attn"]
+        assert attn["wq"]["kernel_q"].dtype == jnp.int8
+        assert attn["wq"]["kernel_scale"].shape == (
+            cfg.n_layers, cfg.n_heads * cfg.head_dim)
+        assert "lm_head_q" in q and q["lm_head_q"].dtype == jnp.int8
+        assert q["embed"].dtype == params["embed"].dtype  # untouched
+        # converted kernels (int8 + scales) are ~half their bf16 bytes
+        kb = sum(np.asarray(x).nbytes for x in jax.tree.leaves(attn))
+        kb_bf16 = sum(np.asarray(x).astype(np.float16).nbytes
+                      for x in jax.tree.leaves(params["blocks"]["attn"]))
+        assert kb < 0.6 * kb_bf16
+
+    def test_logits_close_and_generate_runs(self):
+        from tpu_on_k8s.models.decode import (
+            decode_model,
+            init_cache,
+            quantize_weights_for_serving,
+        )
+
+        cfg, params, tokens = self._setup()
+        qp = quantize_weights_for_serving(params)
+        positions = jnp.broadcast_to(jnp.arange(12), (2, 12))
+        fp = decode_model(cfg)
+        w8 = decode_model(dataclasses.replace(cfg,
+                                              serve_int8_weights=True))
+        lf, _ = fp.apply({"params": params, "cache": init_cache(fp, 2)},
+                         tokens, positions, mutable=["cache"])
+        lq, _ = w8.apply({"params": qp, "cache": init_cache(w8, 2)},
+                         tokens, positions, mutable=["cache"])
+        rel = (np.max(np.abs(np.asarray(lf) - np.asarray(lq)))
+               / (np.max(np.abs(np.asarray(lf))) + 1e-9))
+        assert rel < 0.05, f"w8a16 rel err {rel:.4f}"
+
+        out = generate(dataclasses.replace(cfg, serve_int8_weights=True),
+                       qp, tokens, max_new_tokens=4)
+        assert out.shape == (2, 4)
+        assert bool((out >= 0).all() and (out < cfg.vocab_size).all())
+
+    def test_engine_int8_weights_and_validation(self):
+        from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+
+        cfg, params, _ = self._setup()
+        eng = ContinuousBatchingEngine(cfg, params, n_slots=2,
+                                       int8_weights=True)
+        assert eng.cfg.serve_int8_weights
+        r = eng.submit(np.arange(6, dtype=np.int32), 5)
+        out = eng.run()[r]
+        assert out.shape == (5,)
+
+        with pytest.raises(ValueError, match="decode"):
+            Transformer(dataclasses.replace(
+                cfg, serve_int8_weights=True)).init(
+                jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+        with pytest.raises(ValueError, match="fused_qkv"):
+            Transformer(dataclasses.replace(
+                cfg, serve_int8_weights=True, decode=True,
+                fused_qkv=True)).init(
+                jax.random.key(0), jnp.zeros((1, 4), jnp.int32),
+                jnp.zeros((1, 4), jnp.int32))
+
+    def test_tied_embeddings_head_stays_fp(self):
+        from tpu_on_k8s.models.decode import quantize_weights_for_serving
+
+        cfg = dataclasses.replace(
+            TransformerConfig.tiny(), dtype=jnp.float32, pos_emb="learned",
+            norm="ln", activation="gelu", tie_embeddings=True, n_kv_heads=4)
+        tokens = jnp.arange(8, dtype=jnp.int32)[None, :]
+        params = Transformer(cfg).init(jax.random.key(0), tokens)["params"]
+        qp = quantize_weights_for_serving(params)
+        assert "lm_head_q" not in qp and "embed" in qp
+        out = generate(dataclasses.replace(cfg, serve_int8_weights=True),
+                       qp, tokens, max_new_tokens=3)
+        assert out.shape == (1, 3)
+
+
 class TestSpeculative:
     """Greedy speculative decoding: draft proposes, target verifies in one
     forward — output must match plain greedy generate()."""

@@ -116,10 +116,13 @@ def stage_decode(timeout):
     if not _json_stage([sys.executable, "tools/driver_bench.py", "--write",
                         "--skip-resnet", "--skip-submit"], "decode", timeout):
         return False
-    # the int8-cache lever, measured beside the official bf16-cache number
+    # the int8-cache and W8A16-weight levers, beside the official number
     _lever_stage([sys.executable, "tools/driver_bench.py", "--write",
                   "--skip-resnet", "--skip-submit", "--cache-int8"],
                  "decode_cache_int8", timeout)
+    _lever_stage([sys.executable, "tools/driver_bench.py", "--write",
+                  "--skip-resnet", "--skip-submit", "--serve-int8"],
+                 "decode_w8a16", timeout)
     return True
 
 
@@ -217,7 +220,7 @@ def stage_continuous(timeout):
 # a stage only counts as done when primary AND extras are error-free)
 STAGES = [
     ("headline", stage_headline, 900, ()),
-    ("decode", stage_decode, 1200, ("decode_cache_int8",)),
+    ("decode", stage_decode, 1200, ("decode_cache_int8", "decode_w8a16")),
     ("sweep_stage_a", stage_sweep, 3600, ("sweep_stage_b",)),
     ("longcontext", stage_longcontext, 1800, ()),
     ("resnet50", stage_resnet, 1200, ()),

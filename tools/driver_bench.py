@@ -64,7 +64,8 @@ def bench_resnet50(batch: int = 256, steps: int = 20) -> dict:
 
 
 def bench_decode(batch: int = 8, prompt_len: int = 128,
-                 new_tokens: int = 128, cache_int8: bool = False) -> dict:
+                 new_tokens: int = 128, cache_int8: bool = False,
+                 serve_int8: bool = False) -> dict:
     """Serving-path throughput: KV-cache ``generate()`` on the 350M flagship
     (`tpu_on_k8s/models/decode.py`) — greedy decode, bf16 weights, one chip.
     Tokens/s counts *generated* tokens only (prefill excluded from the
@@ -90,6 +91,12 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
     # serving weights ship bf16: halves HBM reads in the bandwidth-bound
     # decode loop (master fp32 stays a training-side concern)
     params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+    if serve_int8:
+        # W8A16: int8 kernels + per-out-channel scales — half the weight
+        # bytes again (quantized from the bf16 serving weights)
+        from tpu_on_k8s.models.decode import quantize_weights_for_serving
+        cfg = dataclasses.replace(cfg, serve_int8_weights=True)
+        params = quantize_weights_for_serving(params)
 
     # compile + warmup (generate jits one program per (batch, lp, new))
     out = generate(cfg, params, prompt, new_tokens)
@@ -124,7 +131,9 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
         "prefill_ms": round(prefill_s * 1e3, 1),
         "cache": ("int8 + per-(token, head) fp32 scales" if cache_int8
                   else "bf16"),
-        "model": "350M flagship (bench.py config), bf16 weights, greedy",
+        "weights": ("int8 W8A16 + per-out-channel fp32 scales" if serve_int8
+                    else "bf16"),
+        "model": "350M flagship (bench.py config), greedy",
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
     }
 
@@ -280,6 +289,9 @@ def main() -> None:
     parser.add_argument("--cache-int8", action="store_true",
                         help="decode with the int8 KV cache (recorded under "
                              "decode_tokens_per_sec_cache_int8)")
+    parser.add_argument("--serve-int8", action="store_true",
+                        help="decode with W8A16 int8 weights (recorded "
+                             "under decode_tokens_per_sec_w8a16)")
     parser.add_argument("--continuous", action="store_true",
                         help="measure continuous-batching serving "
                              "throughput (mixed ragged traffic through the "
@@ -311,9 +323,13 @@ def main() -> None:
                                               step_horizon=args.horizon)
             print(json.dumps(published[key]))
         else:
-            key = ("decode_tokens_per_sec_cache_int8" if args.cache_int8
-                   else "decode_tokens_per_sec")
-            published[key] = bench_decode(cache_int8=args.cache_int8)
+            key = "decode_tokens_per_sec"
+            if args.cache_int8:
+                key += "_cache_int8"
+            if args.serve_int8:
+                key += "_w8a16"
+            published[key] = bench_decode(cache_int8=args.cache_int8,
+                                          serve_int8=args.serve_int8)
             print(json.dumps(published[key]))
 
     if args.write:

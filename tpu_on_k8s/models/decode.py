@@ -24,6 +24,47 @@ import numpy as np
 from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
 
 
+#: kernel-holding module names converted by quantize_weights_for_serving
+_W8_TARGETS = frozenset({"wq", "wk", "wv", "wo",
+                         "w_gate", "w_up", "w_down", "w_gateup"})
+
+
+def quantize_weights_for_serving(params) -> dict:
+    """W8A16 weight conversion for ``cfg.serve_int8_weights`` serving: each
+    targeted matmul kernel becomes an int8 ``kernel_q`` plus a
+    per-out-channel fp32 absmax ``kernel_scale`` (the layer-scanned leading
+    axis quantizes per layer). Matches the param structure the
+    ``serve_int8_weights`` modules declare (`transformer._W8Dense`, the
+    ``lm_head_q``/``lm_head_scale`` head); embeddings (and the tied head)
+    stay full precision. Exactness: the module rescales the matmul
+    product, so the only error is the int8 rounding of the kernel."""
+    def quantize(w):
+        w = np.asarray(w, np.float32)                   # [..., D, F]
+        s = np.max(np.abs(w), axis=-2) / 127.0          # [..., F]
+        s = np.maximum(s, 1e-9)
+        q = np.clip(np.round(w / s[..., None, :]), -127, 127)
+        return (jnp.asarray(q.astype(np.int8)),
+                jnp.asarray(s.astype(np.float32)))
+
+    def rec(tree):
+        out = {}
+        for k, v in tree.items():
+            if (isinstance(v, dict) and k in _W8_TARGETS
+                    and set(v) == {"kernel"}):
+                q, s = quantize(v["kernel"])
+                out[k] = {"kernel_q": q, "kernel_scale": s}
+            elif isinstance(v, dict):
+                out[k] = rec(v)
+            elif k == "lm_head":
+                q, s = quantize(v)
+                out["lm_head_q"], out["lm_head_scale"] = q, s
+            else:
+                out[k] = v
+        return out
+
+    return rec(params)
+
+
 def decode_model(cfg: TransformerConfig) -> Transformer:
     """The same architecture in KV-cache mode (plain attention; flash/ring
     are training-shape kernels, pointless for single-token queries)."""

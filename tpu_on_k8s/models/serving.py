@@ -52,7 +52,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
-from tpu_on_k8s.models.decode import _bucket_len, cache_shapes, init_cache
+from tpu_on_k8s.models.decode import (
+    _bucket_len,
+    cache_shapes,
+    init_cache,
+    quantize_weights_for_serving,
+)
 from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
 
 
@@ -108,9 +113,19 @@ class ContinuousBatchingEngine:
     def __init__(self, cfg: TransformerConfig, params, n_slots: int = 8,
                  max_len: Optional[int] = None, temperature: float = 0.0,
                  rng: Optional[jax.Array] = None, mesh=None, rules=None,
-                 step_horizon: int = 1, metrics=None):
+                 step_horizon: int = 1, metrics=None,
+                 int8_weights: bool = False):
         if step_horizon < 1:
             raise ValueError(f"step_horizon must be >= 1, got {step_horizon}")
+        if (int8_weights or cfg.serve_int8_weights) and mesh is not None:
+            # pre-quantized configs hit this too, not just the kwarg path —
+            # the partition rules target bf16 kernel shapes, and their
+            # regexes would mis-spec the q/scale split leaves
+            raise NotImplementedError(
+                "int8 serving weights + mesh are not supported together")
+        if int8_weights:
+            cfg = dataclasses.replace(cfg, serve_int8_weights=True)
+            params = quantize_weights_for_serving(params)
         #: Optional ``tpu_on_k8s.metrics.metrics.ServingMetrics`` — request
         #: counters, TTFT/queue-wait/latency histograms, slot/queue gauges,
         #: scrapeable via the same metrics.serve() path the operator uses.

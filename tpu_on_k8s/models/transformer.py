@@ -105,6 +105,15 @@ class TransformerConfig:
                                        # keep bf16 projections by design
                                        # (serving precision; skinny decode
                                        # matmuls gain nothing from int8)
+    serve_int8_weights: bool = False   # serving (decode-only): weights are
+                                       # int8 with per-out-channel fp32
+                                       # scales (W8A16,
+                                       # `decode.quantize_weights_for_serving`)
+                                       # — the bandwidth-bound decode loop
+                                       # reads ~half the weight bytes; the
+                                       # product rescale is exact
+                                       # per-channel math, quantization
+                                       # noise only from the int8 rounding.
     cache_int8: bool = False           # serving: store the KV cache int8
                                        # with per-(token, head) fp32 scales
                                        # — ~half the cache HBM traffic in
@@ -388,10 +397,14 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
         cfg = self.cfg
-        dense = lambda feats, name: nn.Dense(
-            feats, use_bias=False, name=name, dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
-            kernel_init=nn.initializers.normal(0.02))
+        if cfg.serve_int8_weights:
+            dense = lambda feats, name: _W8Dense(feats, name=name,
+                                                 dtype=cfg.dtype)
+        else:
+            dense = lambda feats, name: nn.Dense(
+                feats, use_bias=False, name=name, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.initializers.normal(0.02))
         if cfg.attn_impl in ("xla", "flash") and not cfg.decode:
             return self._attention_bhld(x, positions)
         b, l = x.shape[0], x.shape[1]
@@ -638,13 +651,39 @@ class _Int8Dense(nn.Module):
         return _int8_mm(self.impl)(x, kernel.astype(self.dtype))
 
 
+class _W8Dense(nn.Module):
+    """Serving-time W8A16 dense: an int8 kernel plus a per-out-channel fp32
+    scale (produced by ``decode.quantize_weights_for_serving`` — init values
+    are placeholders for structure only). The matmul reads int8 weights
+    from HBM (XLA fuses the widening convert into the dot operand) and
+    rescales the PRODUCT — ``x @ (q·s) == (x @ q)·s`` for a per-column
+    scale, so no dequantized kernel is ever materialized."""
+
+    features: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        q = self.param("kernel_q", nn.initializers.zeros_init(),
+                       (x.shape[-1], self.features), jnp.int8)
+        s = self.param("kernel_scale", nn.initializers.ones_init(),
+                       (self.features,), jnp.float32)
+        y = jnp.einsum("...d,df->...f", x, q.astype(self.dtype))
+        # rescale in fp32 (a bf16-rounded scale would add ~0.4% systematic
+        # per-channel error); the only rounding left is the final cast back
+        return (y.astype(jnp.float32) * s).astype(self.dtype)
+
+
 class MLP(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         cfg = self.cfg
-        if cfg.mlp_int8:
+        if cfg.serve_int8_weights:
+            dense = lambda feats, name: _W8Dense(feats, name=name,
+                                                 dtype=cfg.dtype)
+        elif cfg.mlp_int8:
             dense = lambda feats, name: _Int8Dense(
                 feats, name=name, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                 impl=cfg.int8_impl)
@@ -718,6 +757,10 @@ class Transformer(nn.Module):
     def __call__(self, tokens: jnp.ndarray,
                  positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         x, head = self._trunk(tokens, positions)
+        if isinstance(head, tuple):      # W8A16 head (serve_int8_weights)
+            hq, hs = head
+            return jnp.einsum("bld,dv->blv", x, hq.astype(self.cfg.dtype),
+                              preferred_element_type=jnp.float32) * hs
         if self.cfg.head_int8:
             return _int8_mm(self.cfg.int8_impl)(x, head,
                                                 out_dtype=jnp.float32)
@@ -729,6 +772,13 @@ class Transformer(nn.Module):
     def _trunk(self, tokens: jnp.ndarray,
                positions: Optional[jnp.ndarray] = None):
         cfg = self.cfg
+        if cfg.serve_int8_weights:
+            if not cfg.decode:
+                raise ValueError("serve_int8_weights is a serving (decode) "
+                                 "recipe; training keeps bf16 weights")
+            if cfg.fused_qkv or cfg.n_experts > 0:
+                raise ValueError("serve_int8_weights does not cover "
+                                 "fused_qkv or MoE layouts")
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1]), tokens.shape)
@@ -774,7 +824,15 @@ class Transformer(nn.Module):
 
         x = make_norm(cfg, "final_norm")(x)
         if cfg.tie_embeddings:
+            # tied head reads the embedding table (also used by the gather)
+            # — it stays full-precision under serve_int8_weights
             return x, embed.astype(cfg.dtype).T
+        if cfg.serve_int8_weights:
+            hq = self.param("lm_head_q", nn.initializers.zeros_init(),
+                            (cfg.d_model, cfg.vocab_size), jnp.int8)
+            hs = self.param("lm_head_scale", nn.initializers.ones_init(),
+                            (cfg.vocab_size,), jnp.float32)
+            return x, (hq, hs)
         head = self.param("lm_head", nn.initializers.normal(0.02),
                           (cfg.d_model, cfg.vocab_size), cfg.param_dtype)
         return x, head.astype(cfg.dtype)
