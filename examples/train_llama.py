@@ -44,6 +44,10 @@ def main(argv=None) -> float:
                         "v5e; exact bf16 backward — see ops/int8_matmul.py). "
                         "Combine with --bf16-moments for the full measured "
                         "bench recipe")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="microbatches per optimizer update (fp32 gradient "
+                        "accumulation under lax.scan; the GLOBAL batch — "
+                        "batch-per-host x hosts — must divide evenly)")
     p.add_argument("--bf16-moments", action="store_true",
                    help="store Adam moments in bfloat16 (the measured bench "
                         "recipe); off = fp32 moments, the historical "
@@ -64,7 +68,8 @@ def main(argv=None) -> float:
     moment_dtype = jnp.bfloat16 if args.bf16_moments else None
     opt = default_optimizer(warmup_steps=10, decay_steps=max(args.steps, 11),
                             mu_dtype=moment_dtype, nu_dtype=moment_dtype)
-    trainer = Trainer(model, flagship_partition_rules(), mesh, opt)
+    trainer = Trainer(model, flagship_partition_rules(), mesh, opt,
+                      grad_accum=args.grad_accum)
 
     global_batch = args.batch_per_host * ctx.num_processes
     seq = args.seq_len or cfg.max_seq_len

@@ -1,6 +1,7 @@
 """Flagship transformer + sharded trainer tests (8-device CPU mesh)."""
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
@@ -151,6 +152,36 @@ class TestShardedTraining:
         before = int(state.step)
         state2, _ = trainer.train_step(state, batch)
         assert int(state2.step) == before + 1
+
+    def test_grad_accum_matches_full_batch(self):
+        """grad_accum=4 (fp32-accumulated microbatch gradients, one
+        optimizer update) must match the full-batch step: same loss, same
+        updated params, on the sharded mesh."""
+        cfg = TransformerConfig.tiny()
+        model = Transformer(cfg)
+        tokens = jax.random.randint(jax.random.key(1), (8, 17), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        mesh = create_mesh(MeshConfig(data=2, fsdp=2, model=2, seq=1))
+        results = []
+        for accum in (1, 4):
+            tr = Trainer(model, flagship_partition_rules(), mesh,
+                         default_optimizer(warmup_steps=1, decay_steps=50),
+                         grad_accum=accum)
+            state = tr.init_state(jax.random.key(0), tokens[:, :-1])
+            state, metrics = tr.train_step(state, tr.shard_batch(tokens))
+            results.append((float(metrics["loss"]),
+                            jax.tree.map(np.asarray, state.params)))
+        assert abs(results[0][0] - results[1][0]) < 1e-5
+        for a, b in zip(jax.tree.leaves(results[0][1]),
+                        jax.tree.leaves(results[1][1])):
+            np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+        with pytest.raises(ValueError, match="divisible"):
+            tr = Trainer(model, flagship_partition_rules(), mesh,
+                         default_optimizer(warmup_steps=1, decay_steps=50),
+                         grad_accum=3)
+            state = tr.init_state(jax.random.key(0), tokens[:, :-1])
+            tr.train_step(state, tr.shard_batch(tokens))
 
     def test_sharded_matches_single_device(self):
         """The mesh must not change the math: 8-way vs 1-way step parity."""

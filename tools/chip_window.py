@@ -75,8 +75,13 @@ def _is_error(rec) -> bool:
 
 def _run(argv, timeout):
     print(f"[chip_window] $ {' '.join(argv)}", flush=True)
+    # persistent compilation cache: the tunnelled chip dies mid-window
+    # routinely, and without this every retry re-pays the multi-minute
+    # XLA compiles before measuring anything
+    env = {**os.environ,
+           "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache")}
     proc = subprocess.run(argv, capture_output=True, text=True,
-                          timeout=timeout, cwd=REPO)
+                          timeout=timeout, cwd=REPO, env=env)
     sys.stdout.write(proc.stdout)
     if proc.returncode != 0:
         sys.stderr.write(proc.stderr[-4000:])

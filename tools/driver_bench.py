@@ -140,7 +140,8 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
 
 def bench_continuous(n_slots: int = 8, n_requests: int = 32,
                      new_tokens: int = 128, cache_int8: bool = False,
-                     step_horizon: int = 1) -> dict:
+                     step_horizon: int = 1,
+                     serve_int8: bool = False) -> dict:
     """Continuous-batching serving throughput on the 350M flagship
     (`tpu_on_k8s/models/serving.py`): ragged prompts (64-256 tokens)
     streaming through a fixed slot pool, greedy, bf16 weights. Unlike
@@ -171,7 +172,8 @@ def bench_continuous(n_slots: int = 8, n_requests: int = 32,
 
     rng = np.random.default_rng(0)
     eng = ContinuousBatchingEngine(cfg, params, n_slots=n_slots,
-                                   max_len=512, step_horizon=step_horizon)
+                                   max_len=512, step_horizon=step_horizon,
+                                   int8_weights=serve_int8)
     # warmup compiles: the step program, the admit program, and one
     # prefill program per 128-bucket the traffic below can hit
     for lp in (100, 200):
@@ -207,7 +209,9 @@ def bench_continuous(n_slots: int = 8, n_requests: int = 32,
                             if eng.stats["steps"] else None,
         "cache": ("int8 + per-(token, head) fp32 scales" if cache_int8
                   else "bf16"),
-        "model": "350M flagship (bench.py config), bf16 weights, greedy",
+        "weights": ("int8 W8A16 + per-out-channel fp32 scales" if serve_int8
+                    else "bf16"),
+        "model": "350M flagship (bench.py config), greedy",
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
     }
 
@@ -314,13 +318,16 @@ def main() -> None:
         print(json.dumps(published["resnet50_images_per_sec_per_chip"]))
     if not args.skip_decode:
         if args.continuous:
-            key = ("continuous_batching_tokens_per_sec_cache_int8"
-                   if args.cache_int8
-                   else "continuous_batching_tokens_per_sec")
+            key = "continuous_batching_tokens_per_sec"
+            if args.cache_int8:
+                key += "_cache_int8"
+            if args.serve_int8:
+                key += "_w8a16"
             if args.horizon > 1:
                 key += f"_h{args.horizon}"
             published[key] = bench_continuous(cache_int8=args.cache_int8,
-                                              step_horizon=args.horizon)
+                                              step_horizon=args.horizon,
+                                              serve_int8=args.serve_int8)
             print(json.dumps(published[key]))
         else:
             key = "decode_tokens_per_sec"

@@ -176,6 +176,7 @@ def make_sharded_init(model: Any, optimizer: optax.GradientTransformation,
 
 def make_train_step(model: Any, optimizer: optax.GradientTransformation,
                     aux_loss_weight: float = 0.0, loss_chunks: int = 0,
+                    grad_accum: int = 1,
                     ) -> Callable[[TrainState, jnp.ndarray], Tuple[TrainState, dict]]:
     """One language-model train step on a [B, L] token batch (next-token CE,
     internal shift). Donates the state buffers. jit shardings propagate from
@@ -185,6 +186,11 @@ def make_train_step(model: Any, optimizer: optax.GradientTransformation,
     load-balance terms, `tpu_on_k8s/models/moe.py`) into the objective.
     ``loss_chunks`` > 0 uses the chunked head+CE path (requires the model to
     expose ``features``; see ``chunked_cross_entropy``).
+    ``grad_accum`` > 1 splits the batch into that many equal microbatches
+    under ``lax.scan``, accumulating gradients in fp32 before ONE optimizer
+    update — the effective batch grows without the activation memory
+    (microbatch means of equal size average exactly to the full-batch
+    mean, so the objective is unchanged up to summation order).
     """
 
     def loss_fn(params: Any, tokens: jnp.ndarray):
@@ -205,9 +211,34 @@ def make_train_step(model: Any, optimizer: optax.GradientTransformation,
                if aux_loss_weight else jnp.zeros((), jnp.float32))
         return ce + aux_loss_weight * aux, aux
 
+    def grads_and_loss(params: Any, tokens: jnp.ndarray):
+        if grad_accum <= 1:
+            return jax.value_and_grad(loss_fn, has_aux=True)(params, tokens)
+        b = tokens.shape[0]
+        if b % grad_accum:
+            raise ValueError(
+                f"batch {b} not divisible by grad_accum {grad_accum}")
+        micro = tokens.reshape(grad_accum, b // grad_accum, tokens.shape[1])
+
+        def body(carry, mb):
+            gsum, lsum, asum = carry
+            (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, mb)
+            gsum = jax.tree.map(lambda s, x: s + x.astype(jnp.float32),
+                                gsum, g)
+            return (gsum, lsum + loss, asum + aux), None
+
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             params)
+        (gsum, lsum, asum), _ = jax.lax.scan(
+            body, (zeros, jnp.zeros((), jnp.float32),
+                   jnp.zeros((), jnp.float32)), micro)
+        grads = jax.tree.map(lambda g, p: (g / grad_accum).astype(p.dtype),
+                             gsum, params)
+        return (lsum / grad_accum, asum / grad_accum), grads
+
     def step(state: TrainState, tokens: jnp.ndarray) -> Tuple[TrainState, dict]:
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, tokens)
+        (loss, aux), grads = grads_and_loss(state.params, tokens)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss,
@@ -232,13 +263,15 @@ class Trainer:
     def __init__(self, model: Any, rules: Sequence[PartitionRule],
                  mesh: Mesh,
                  optimizer: Optional[optax.GradientTransformation] = None,
-                 aux_loss_weight: float = 0.0, loss_chunks: int = 0):
+                 aux_loss_weight: float = 0.0, loss_chunks: int = 0,
+                 grad_accum: int = 1):
         self.model = model
         self.rules = list(rules)
         self.mesh = mesh
         self.optimizer = optimizer or default_optimizer()
         self._step = make_train_step(self.model, self.optimizer,
-                                     aux_loss_weight, loss_chunks)
+                                     aux_loss_weight, loss_chunks,
+                                     grad_accum)
         self._init_cache = {}
 
     def init_state(self, rng: jax.Array, example_tokens: jnp.ndarray) -> TrainState:
