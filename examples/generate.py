@@ -21,6 +21,10 @@ def main(argv=None):
     p.add_argument("--prompt-len", type=int, default=8)
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=0,
+                   help="sample only the k highest-probability tokens")
+    p.add_argument("--top-p", type=float, default=0.0,
+                   help="nucleus sampling: smallest token set with mass p")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--draft-config", default="", choices=["", *sorted(CONFIGS)],
                    help="enable greedy speculative decoding with this config "
@@ -83,8 +87,8 @@ def main(argv=None):
         print("speculative stats:", stats)
     else:
         out = generate(cfg, params, prompt, args.max_new_tokens,
-                       temperature=args.temperature,
-                       rng=jax.random.key(args.seed + 1))
+                       temperature=args.temperature, top_k=args.top_k,
+                       top_p=args.top_p, rng=jax.random.key(args.seed + 1))
     print("prompt:", prompt[0].tolist())
     print("continuation:", out[0].tolist())
     return out

@@ -41,6 +41,10 @@ def main(argv=None):
     p.add_argument("--horizon", type=int, default=1,
                    help="decode steps scanned per compiled call")
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=0,
+                   help="sample only the k highest-probability tokens")
+    p.add_argument("--top-p", type=float, default=0.0,
+                   help="nucleus sampling: smallest token set with mass p")
     p.add_argument("--model-axis", type=int, default=1,
                    help=">1 serves tensor-parallel over the mesh")
     p.add_argument("--fsdp", type=int, default=0,
@@ -99,6 +103,7 @@ def main(argv=None):
     eng = ContinuousBatchingEngine(
         cfg, params, n_slots=args.n_slots,
         max_len=args.max_len or None, temperature=args.temperature,
+        top_k=args.top_k, top_p=args.top_p,
         rng=jax.random.key(args.seed + 1), mesh=mesh, rules=rules,
         step_horizon=args.horizon, metrics=metrics)
 

@@ -1,0 +1,89 @@
+"""Top-k / nucleus sampling: the one sampler behind every serving path."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_on_k8s.models.sampling import SamplingParams, sample
+
+
+def _logits():
+    # fixed, well-separated logits: probs ~ [0.64, 0.24, 0.09, 0.02, ...]
+    return jnp.asarray([[5.0, 4.0, 3.0, 1.5, 1.0, 0.5, 0.0, -1.0]])
+
+
+def _draw_many(params, n=512):
+    keys = jax.random.split(jax.random.key(0), n)
+    return np.asarray(jax.vmap(lambda k: sample(_logits(), k, params))(keys))
+
+
+def test_greedy_ignores_filters():
+    sp = SamplingParams(temperature=0.0, top_k=3, top_p=0.5)
+    assert int(sample(_logits(), jax.random.key(1), sp)[0]) == 0
+
+
+def test_top_k_restricts_support():
+    draws = _draw_many(SamplingParams(temperature=1.0, top_k=3))
+    assert set(np.unique(draws)) <= {0, 1, 2}
+    # all three survivors actually appear at temperature 1
+    assert len(set(np.unique(draws))) == 3
+
+
+def test_top_k_1_is_greedy():
+    draws = _draw_many(SamplingParams(temperature=2.0, top_k=1), n=64)
+    assert set(np.unique(draws)) == {0}
+
+
+def test_top_p_keeps_smallest_prefix():
+    # cumulative mass: tok0 ~0.63, +tok1 ~0.87 — p=0.7 keeps {0, 1}
+    draws = _draw_many(SamplingParams(temperature=1.0, top_p=0.7))
+    assert set(np.unique(draws)) <= {0, 1}
+    # tiny p: the top token always survives
+    draws = _draw_many(SamplingParams(temperature=1.0, top_p=1e-6), n=64)
+    assert set(np.unique(draws)) == {0}
+
+
+def test_top_p_1_is_unfiltered():
+    full = _draw_many(SamplingParams(temperature=1.0))
+    nuc = _draw_many(SamplingParams(temperature=1.0, top_p=1.0))
+    np.testing.assert_array_equal(full, nuc)   # same keys, same filter-off
+
+
+def test_filters_compose():
+    # top_k=4 then top_p=0.7 over renormalized survivors → {0, 1}
+    draws = _draw_many(SamplingParams(temperature=1.0, top_k=4, top_p=0.7))
+    assert set(np.unique(draws)) <= {0, 1}
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="top_k"):
+        SamplingParams(top_k=-1)
+    with pytest.raises(ValueError, match="top_p"):
+        SamplingParams(top_p=1.5)
+
+
+def test_generate_and_engine_accept_filters():
+    from tpu_on_k8s.models.decode import generate
+    from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+    from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+
+    cfg = dataclasses.replace(TransformerConfig.tiny(), dtype=jnp.float32,
+                              max_seq_len=64)
+    tok = jax.random.randint(jax.random.key(0), (1, 6), 0, cfg.vocab_size,
+                             jnp.int32)
+    params = Transformer(cfg).init(jax.random.key(1), tok)["params"]
+
+    out = generate(cfg, params, tok, 5, temperature=0.8, top_k=10,
+                   top_p=0.9, rng=jax.random.key(2))
+    assert out.shape == (1, 5)
+    assert bool((out >= 0).all() and (out < cfg.vocab_size).all())
+
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2, temperature=0.8,
+                                   top_k=10, top_p=0.9,
+                                   rng=jax.random.key(3))
+    rid = eng.submit(np.asarray(tok[0]), 4)
+    got = eng.run()[rid]
+    assert got.shape == (4,)
+    assert (got >= 0).all() and (got < cfg.vocab_size).all()

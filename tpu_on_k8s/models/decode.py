@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_on_k8s.models.sampling import SamplingParams, sample
 from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
 
 
@@ -98,7 +99,7 @@ def _bucket_len(total: int, max_seq_len: int) -> int:
 
 @functools.lru_cache(maxsize=32)
 def _compiled_generate(cfg: TransformerConfig, b: int, lp: int,
-                       max_new_tokens: int, temperature: float):
+                       max_new_tokens: int, sp: SamplingParams):
     """One compiled generation program per (config, shape) — repeated
     ``generate()`` calls (a serving loop) reuse it instead of re-tracing.
     The config is a frozen dataclass, so it keys the cache directly.
@@ -121,10 +122,7 @@ def _compiled_generate(cfg: TransformerConfig, b: int, lp: int,
     shapes = cache_shapes(model, b)
 
     def pick(logits: jnp.ndarray, step_rng: jax.Array) -> jnp.ndarray:
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            step_rng, logits / temperature, axis=-1).astype(jnp.int32)
+        return sample(logits, step_rng, sp)
 
     @jax.jit
     def run(params, prompt, rng):
@@ -305,8 +303,10 @@ def speculative_generate(cfg: TransformerConfig, params,
 
 def generate(cfg: TransformerConfig, params, prompt: jnp.ndarray,
              max_new_tokens: int, temperature: float = 0.0,
-             rng: Optional[jax.Array] = None) -> jnp.ndarray:
-    """Greedy (temperature=0) or sampled continuation of ``prompt`` [B, Lp].
+             rng: Optional[jax.Array] = None, top_k: int = 0,
+             top_p: float = 0.0) -> jnp.ndarray:
+    """Greedy (temperature=0) or sampled continuation of ``prompt`` [B, Lp]
+    — optional top-k / nucleus filtering (`tpu_on_k8s/models/sampling.py`).
 
     Returns [B, max_new_tokens]. Total length must fit ``cfg.max_seq_len``.
     """
@@ -315,6 +315,7 @@ def generate(cfg: TransformerConfig, params, prompt: jnp.ndarray,
         raise ValueError(
             f"prompt {lp} + new {max_new_tokens} exceeds max_seq_len "
             f"{cfg.max_seq_len}")
-    run = _compiled_generate(cfg, b, lp, max_new_tokens, temperature)
+    sp = SamplingParams(temperature=temperature, top_k=top_k, top_p=top_p)
+    run = _compiled_generate(cfg, b, lp, max_new_tokens, sp)
     rng = rng if rng is not None else jax.random.key(0)
     return run(params, prompt, rng)

@@ -1,0 +1,85 @@
+"""Token sampling: greedy, temperature, top-k, nucleus (top-p).
+
+One jit-traceable sampler shared by every serving path — ``generate()``
+(`tpu_on_k8s/models/decode.py`), the continuous-batching engine
+(`tpu_on_k8s/models/serving.py`) — so a sampling change can never apply
+to one path and not another. All operations are static-shape (sort +
+mask, no dynamic gather sizes), exactly what XLA wants on TPU.
+
+The reference operator never samples tokens (it schedules pods); this is
+the compute plane's own surface.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Hashable (usable in jit cache keys) sampling configuration.
+
+    ``temperature <= 0`` is greedy argmax and ignores the rest. ``top_k``
+    keeps the k highest logits; ``top_p`` keeps the smallest set of
+    tokens whose probability mass reaches p (the first token always
+    survives). Both filters compose: top-k first, then top-p over the
+    renormalized survivors — the common (vLLM/HF) convention.
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0        # 0 = off
+    top_p: float = 0.0    # 0 or 1 = off (values outside [0, 1] rejected)
+
+    def __post_init__(self):
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 <= self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {self.top_p}")
+
+    @property
+    def is_greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+_NEG = -1e30
+
+
+def _top_k_mask(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Mask all but EXACTLY the k highest logits per row to -inf (ties
+    truncate by index, inheriting jax.lax.top_k's order; k beyond the
+    vocabulary clamps — the HF/vLLM convention)."""
+    k = min(k, logits.shape[-1])
+    _, idx = jax.lax.top_k(logits, k)                       # [..., k]
+    keep = jax.nn.one_hot(idx, logits.shape[-1],
+                          dtype=jnp.bool_).any(axis=-2)     # [..., V]
+    return jnp.where(keep, logits, _NEG)
+
+
+def _top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filter: keep the smallest prefix of the probability-sorted
+    vocabulary whose mass reaches ``p``; the top token always survives."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # a token is kept if the mass BEFORE it is < p (so the token that
+    # crosses the threshold is included)
+    keep_sorted = (cum - probs) < p
+    # threshold logit = smallest kept logit; everything below drops
+    kth = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                  axis=-1, keepdims=True)
+    return jnp.where(logits >= kth, logits, _NEG)
+
+
+def sample(logits: jnp.ndarray, key: jax.Array,
+           params: SamplingParams) -> jnp.ndarray:
+    """Next token per row of ``logits [..., V]`` under ``params``."""
+    if params.is_greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / params.temperature
+    if params.top_k:
+        logits = _top_k_mask(logits, params.top_k)
+    if 0.0 < params.top_p < 1.0:
+        logits = _top_p_mask(logits, params.top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

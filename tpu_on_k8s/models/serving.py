@@ -58,17 +58,8 @@ from tpu_on_k8s.models.decode import (
     init_cache,
     quantize_weights_for_serving,
 )
+from tpu_on_k8s.models.sampling import SamplingParams, sample as _pick
 from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
-
-
-def _pick(logits: jnp.ndarray, key: jax.Array,
-          temperature: float) -> jnp.ndarray:
-    """Greedy (temperature<=0) or sampled next token — the ONE sampling
-    rule for both the prefill's first token and every step token."""
-    if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        key, logits / temperature, axis=-1).astype(jnp.int32)
 
 
 @dataclasses.dataclass
@@ -112,6 +103,7 @@ class ContinuousBatchingEngine:
 
     def __init__(self, cfg: TransformerConfig, params, n_slots: int = 8,
                  max_len: Optional[int] = None, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0,
                  rng: Optional[jax.Array] = None, mesh=None, rules=None,
                  step_horizon: int = 1, metrics=None,
                  int8_weights: bool = False):
@@ -140,7 +132,8 @@ class ContinuousBatchingEngine:
         self.cfg = cfg
         self.n_slots = n_slots
         self.max_len = max_len
-        self.temperature = temperature
+        self.sampling = SamplingParams(temperature=temperature,
+                                       top_k=top_k, top_p=top_p)
         self._rng = rng if rng is not None else jax.random.key(0)
 
         base = dataclasses.replace(cfg, decode=True, remat=False,
@@ -185,7 +178,7 @@ class ContinuousBatchingEngine:
         self.mesh = mesh
         self._params = params
 
-        temp = temperature
+        sp = self.sampling
         self.step_horizon = horizon = step_horizon
 
         @functools.partial(
@@ -201,7 +194,7 @@ class ContinuousBatchingEngine:
                 logits, upd = self._step_model.apply(
                     {"params": params, "cache": cache}, tok[:, None],
                     p[:, None], mutable=["cache"])
-                nxt = _pick(logits[:, -1], step_key, temp)
+                nxt = _pick(logits[:, -1], step_key, sp)
                 return (upd["cache"], nxt, p + 1), nxt
 
             (cache, _, _), toks_out = jax.lax.scan(
@@ -302,7 +295,7 @@ class ContinuousBatchingEngine:
         if fn is None:
             model = self._prefill_model
             shapes = cache_shapes(model, 1)   # length set by max_len, not lp
-            temp = self.temperature
+            sp = self.sampling
 
             @jax.jit
             def prefill(params, prompt, lp, key):
@@ -312,7 +305,7 @@ class ContinuousBatchingEngine:
                 logits, upd = model.apply(
                     {"params": params, "cache": cache}, prompt, positions,
                     mutable=["cache"])
-                return upd["cache"], _pick(logits[0, lp - 1], key, temp)
+                return upd["cache"], _pick(logits[0, lp - 1], key, sp)
 
             fn = self._prefill_cache[bucket] = prefill
         return fn
@@ -326,7 +319,7 @@ class ContinuousBatchingEngine:
         if fn is None:
             from tpu_on_k8s.models.decode import _set_cursor
             model = self._prefill_model
-            temp = self.temperature
+            sp = self.sampling
 
             @jax.jit
             def prefill(params, pre_cache, suffix, plen, slen, key):
@@ -336,7 +329,7 @@ class ContinuousBatchingEngine:
                 logits, upd = model.apply(
                     {"params": params, "cache": cache}, suffix, positions,
                     mutable=["cache"])
-                return upd["cache"], _pick(logits[0, slen - 1], key, temp)
+                return upd["cache"], _pick(logits[0, slen - 1], key, sp)
 
             fn = self._suffix_prefill_cache[bucket] = prefill
         return fn
