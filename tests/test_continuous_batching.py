@@ -240,6 +240,61 @@ def test_prefix_caching_validation(setup):
         eng.submit(np.arange(10), 20, prefix_id=pid)   # 40+10+20 > 64
 
 
+def test_streaming_callback(setup):
+    """on_token streams every kept token in order, as it is emitted —
+    the stream equals the final output, and it arrives incrementally
+    (some tokens seen while the request is still in flight)."""
+    cfg, params = setup
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=1, step_horizon=2)
+    streamed, partial_seen = [], []
+
+    def on_token(rid, tok):
+        streamed.append((rid, tok))
+        partial_seen.append(eng.result(rid) is None)  # still in flight?
+
+    rng = np.random.default_rng(17)
+    p = rng.integers(0, cfg.vocab_size, size=5).astype(np.int32)
+    rid = eng.submit(p, 7, on_token=on_token)
+    out = eng.run()[rid]
+    assert [t for _, t in streamed] == out.tolist()
+    assert all(r == rid for r, _ in streamed)
+    assert partial_seen[0]          # first token streamed before completion
+
+    # eos: the stream stops exactly at the kept tokens (no surplus leaks)
+    full = _want(cfg, params, p, 12)
+    eos = int(full[3])
+    streamed.clear()
+    r2 = eng.submit(p, 12, eos_id=eos, on_token=on_token)
+    out2 = eng.run()[r2]
+    assert [t for _, t in streamed] == out2.tolist()
+
+
+def test_raising_callback_cannot_poison_the_batch(setup):
+    """A callback that raises (disconnected streaming client) is detached
+    with a warning; the request completes, and a CONCURRENT request's
+    continuation stays exact."""
+    import warnings
+
+    cfg, params = setup
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2, step_horizon=2)
+    rng = np.random.default_rng(19)
+    p_bad = rng.integers(0, cfg.vocab_size, size=4).astype(np.int32)
+    p_good = rng.integers(0, cfg.vocab_size, size=7).astype(np.int32)
+
+    def explode(rid, tok):
+        raise RuntimeError("client went away")
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        bad = eng.submit(p_bad, 6, on_token=explode)
+        good = eng.submit(p_good, 9)
+        out = eng.run()
+    assert any("streaming detached" in str(x.message) for x in w)
+    assert out[bad].shape == (6,)          # the request itself completed
+    np.testing.assert_array_equal(out[good],
+                                  _want(cfg, params, p_good, 9))
+
+
 def test_serving_metrics(setup):
     """The engine reports through the framework's metrics plane: counters,
     TTFT/queue-wait/latency histograms, slot/queue gauges."""

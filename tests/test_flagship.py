@@ -153,6 +153,22 @@ class TestShardedTraining:
         state2, _ = trainer.train_step(state, batch)
         assert int(state2.step) == before + 1
 
+    def test_eval_step_matches_train_loss(self, trainer_state):
+        """eval_step computes the exact objective train_step reports
+        (pre-update), without touching the state."""
+        trainer, make_state, tokens = trainer_state
+        state = make_state()
+        batch = trainer.shard_batch(tokens)
+        ev = trainer.eval_step(state, batch)
+        # the train step (run AFTER eval, from the same state) reports the
+        # identical pre-update loss — so eval computed the same objective
+        # and mutated nothing
+        _, metrics = trainer.train_step(state, batch)
+        assert abs(float(ev["loss"]) - float(metrics["loss"])) < 1e-5
+        assert float(ev["perplexity"]) == pytest.approx(
+            float(np.exp(float(ev["loss"]))), rel=1e-5)
+        assert set(ev) == {"loss", "perplexity", "aux_loss"}
+
     def test_grad_accum_matches_full_batch(self):
         """grad_accum=4 (fp32-accumulated microbatch gradients, one
         optimizer update) must match the full-batch step: same loss, same

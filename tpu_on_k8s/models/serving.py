@@ -72,6 +72,7 @@ class _Slot:
     max_new_tokens: int
     eos_id: Optional[int]
     submitted_at: float = 0.0     # monotonic submit time (metrics)
+    on_token: Optional[Any] = None   # streaming callback (rid, token)
 
 
 @dataclasses.dataclass
@@ -82,6 +83,7 @@ class _Pending:
     eos_id: Optional[int]
     submitted_at: float = 0.0
     prefix_id: Optional[int] = None
+    on_token: Optional[Any] = None
 
 
 def _strip_index(cache: Any) -> Any:
@@ -261,10 +263,14 @@ class ContinuousBatchingEngine:
 
     def submit(self, prompt, max_new_tokens: int,
                eos_id: Optional[int] = None,
-               prefix_id: Optional[int] = None) -> int:
+               prefix_id: Optional[int] = None,
+               on_token=None) -> int:
         """Enqueue a request; returns its id. ``prompt`` is a 1-D token
         sequence (with ``prefix_id``: the tokens AFTER the registered
-        prefix); admission happens on a later ``step()``."""
+        prefix); admission happens on a later ``step()``. ``on_token``
+        streams each emitted token as ``on_token(request_id, token)``
+        the moment the host sees it (per admission / per horizon) —
+        exactly what an SSE/gRPC streaming frontend forwards."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -284,7 +290,7 @@ class ContinuousBatchingEngine:
         rid = self._next_id
         self._next_id += 1
         self._queue.append(_Pending(rid, prompt, max_new_tokens, eos_id,
-                                    time.monotonic(), prefix_id))
+                                    time.monotonic(), prefix_id, on_token))
         if self.metrics is not None:
             self.metrics.inc("requests_submitted")
             self.metrics.set_gauge("queue_depth", len(self._queue))
@@ -366,7 +372,8 @@ class ContinuousBatchingEngine:
             first = int(first)   # host sync: the first token IS emitted now
             self._slots[i] = _Slot(req.request_id, lp, first, [first],
                                    req.max_new_tokens, req.eos_id,
-                                   req.submitted_at)
+                                   req.submitted_at, req.on_token)
+            self._fire_on_token(self._slots[i], first)
             self.stats["admitted"] += 1
             self.stats["emitted"] += 1
             if self.metrics is not None:
@@ -377,6 +384,23 @@ class ContinuousBatchingEngine:
                 self.metrics.inc("tokens_emitted")
                 self.metrics.set_gauge("queue_depth", len(self._queue))
             self._retire_if_done(i)
+
+    @staticmethod
+    def _fire_on_token(slot: _Slot, token: int) -> None:
+        """Streaming callbacks run between device steps — a raising
+        callback (e.g. a disconnected SSE client) must not unwind the
+        engine loop mid-horizon, or OTHER slots' host state desyncs from
+        the already-advanced device cache. Detach it and keep serving."""
+        if slot.on_token is None:
+            return
+        try:
+            slot.on_token(slot.request_id, token)
+        except Exception as e:  # noqa: BLE001 — isolate per-request faults
+            slot.on_token = None
+            import warnings
+            warnings.warn(f"on_token callback for request "
+                          f"{slot.request_id} raised {type(e).__name__}: "
+                          f"{e}; streaming detached", stacklevel=2)
 
     def _retire_if_done(self, i: int) -> bool:
         slot = self._slots[i]
@@ -422,6 +446,7 @@ class ContinuousBatchingEngine:
                     slot.emitted.append(slot.last_token)
                     self.stats["emitted"] += 1
                     emitted_now += 1
+                    self._fire_on_token(slot, slot.last_token)
                     if self._retire_if_done(i):
                         break  # surplus horizon tokens are discarded
             if self.metrics is not None:

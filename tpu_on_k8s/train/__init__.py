@@ -3,6 +3,7 @@ from tpu_on_k8s.train.trainer import (
     TrainState,
     Trainer,
     cross_entropy_loss,
+    make_eval_step,
     make_sharded_init,
     make_train_step,
 )
@@ -11,6 +12,7 @@ __all__ = [
     "TrainState",
     "Trainer",
     "cross_entropy_loss",
+    "make_eval_step",
     "make_sharded_init",
     "make_train_step",
 ]

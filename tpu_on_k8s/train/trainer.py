@@ -174,6 +174,49 @@ def make_sharded_init(model: Any, optimizer: optax.GradientTransformation,
     return jax.jit(init, out_shardings=shardings)
 
 
+def _make_loss_fn(model: Any, aux_loss_weight: float, loss_chunks: int):
+    """(params, tokens [B, L+1]) → (objective, aux) — shared by the train
+    and eval steps so the two can never compute different losses."""
+
+    def loss_fn(params: Any, tokens: jnp.ndarray):
+        mutable = ["losses"] if aux_loss_weight else False
+        if loss_chunks:
+            out = model.apply({"params": params}, tokens[:, :-1],
+                              method="features", mutable=mutable)
+            (feats, head), losses = out if aux_loss_weight else (out, {})
+            ce = chunked_cross_entropy(feats, head, tokens[:, 1:],
+                                       loss_chunks)
+        else:
+            out = model.apply({"params": params}, tokens[:, :-1],
+                              mutable=mutable)
+            logits, losses = out if aux_loss_weight else (out, {})
+            ce = cross_entropy_loss(logits, tokens[:, 1:])
+        aux = (sum(jnp.sum(leaf)
+                   for leaf in jax.tree.leaves(dict(losses).get("losses", {})))
+               if aux_loss_weight else jnp.zeros((), jnp.float32))
+        return ce + aux_loss_weight * aux, aux
+
+    return loss_fn
+
+
+def make_eval_step(model: Any, aux_loss_weight: float = 0.0,
+                   loss_chunks: int = 0) -> Callable[[Any, jnp.ndarray], dict]:
+    """Forward-only evaluation on a [B, L+1] token batch: the same
+    objective as ``make_train_step`` (shared loss fn), no gradients, no
+    state mutation. Returns {"loss", "perplexity", "aux_loss"}."""
+    loss_fn = _make_loss_fn(model, aux_loss_weight, loss_chunks)
+
+    def step(params: Any, tokens: jnp.ndarray) -> dict:
+        loss, aux = loss_fn(params, tokens)
+        # perplexity is exp(CROSS-ENTROPY); the objective folds the aux
+        # penalty in, so back it out (loss = ce + w·aux)
+        return {"loss": loss,
+                "perplexity": jnp.exp(loss - aux_loss_weight * aux),
+                "aux_loss": aux}
+
+    return jax.jit(step)
+
+
 def make_train_step(model: Any, optimizer: optax.GradientTransformation,
                     aux_loss_weight: float = 0.0, loss_chunks: int = 0,
                     grad_accum: int = 1,
@@ -193,23 +236,7 @@ def make_train_step(model: Any, optimizer: optax.GradientTransformation,
     mean, so the objective is unchanged up to summation order).
     """
 
-    def loss_fn(params: Any, tokens: jnp.ndarray):
-        mutable = ["losses"] if aux_loss_weight else False
-        if loss_chunks:
-            out = model.apply({"params": params}, tokens[:, :-1],
-                              method="features", mutable=mutable)
-            (feats, head), losses = out if aux_loss_weight else (out, {})
-            ce = chunked_cross_entropy(feats, head, tokens[:, 1:],
-                                       loss_chunks)
-        else:
-            out = model.apply({"params": params}, tokens[:, :-1],
-                              mutable=mutable)
-            logits, losses = out if aux_loss_weight else (out, {})
-            ce = cross_entropy_loss(logits, tokens[:, 1:])
-        aux = (sum(jnp.sum(leaf)
-                   for leaf in jax.tree.leaves(dict(losses).get("losses", {})))
-               if aux_loss_weight else jnp.zeros((), jnp.float32))
-        return ce + aux_loss_weight * aux, aux
+    loss_fn = _make_loss_fn(model, aux_loss_weight, loss_chunks)
 
     def grads_and_loss(params: Any, tokens: jnp.ndarray):
         if grad_accum <= 1:
@@ -272,6 +299,8 @@ class Trainer:
         self._step = make_train_step(self.model, self.optimizer,
                                      aux_loss_weight, loss_chunks,
                                      grad_accum)
+        self._eval = make_eval_step(self.model, aux_loss_weight,
+                                    loss_chunks)
         self._init_cache = {}
 
     def init_state(self, rng: jax.Array, example_tokens: jnp.ndarray) -> TrainState:
@@ -293,3 +322,9 @@ class Trainer:
         # attn_impl="ring" models can build their seq-axis shard_map.
         with ring_context(self.mesh):
             return self._step(state, tokens)
+
+    def eval_step(self, state: TrainState, tokens: jnp.ndarray) -> dict:
+        """Forward-only loss/perplexity on a held-out batch — the same
+        objective the train step optimizes, no state change."""
+        with ring_context(self.mesh):
+            return self._eval(state.params, tokens)
