@@ -40,6 +40,9 @@ def main(argv=None):
                    help="engine cache length (0 = the model's max_seq_len)")
     p.add_argument("--horizon", type=int, default=1,
                    help="decode steps scanned per compiled call")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help=">0: prompts longer than this prefill one chunk "
+                        "per step (decode keeps flowing for other slots)")
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=0,
                    help="sample only the k highest-probability tokens")
@@ -104,6 +107,7 @@ def main(argv=None):
         cfg, params, n_slots=args.n_slots,
         max_len=args.max_len or None, temperature=args.temperature,
         top_k=args.top_k, top_p=args.top_p,
+        prefill_chunk=args.prefill_chunk,
         rng=jax.random.key(args.seed + 1), mesh=mesh, rules=rules,
         step_horizon=args.horizon, metrics=metrics)
 

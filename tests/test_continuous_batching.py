@@ -240,6 +240,63 @@ def test_prefix_caching_validation(setup):
         eng.submit(np.arange(10), 20, prefix_id=pid)   # 40+10+20 > 64
 
 
+def test_chunked_prefill_matches_whole_prompt(setup):
+    """prefill_chunk splits a long prompt across engine steps (private
+    accumulating cache, exact cursor-seeded appends) — continuations must
+    equal the unchunked engine's, with and without a shared prefix."""
+    cfg, params = setup
+    rng = np.random.default_rng(23)
+    prefix = rng.integers(0, cfg.vocab_size, size=9).astype(np.int32)
+    long_p = rng.integers(0, cfg.vocab_size, size=25).astype(np.int32)
+    short_p = rng.integers(0, cfg.vocab_size, size=3).astype(np.int32)
+
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2, prefill_chunk=7)
+    pid = eng.register_prefix(prefix)
+    r_long = eng.submit(long_p, 6)                      # 25 → 4 chunks
+    r_pref = eng.submit(long_p[:10], 5, prefix_id=pid)  # 10 → 2 chunks
+    r_short = eng.submit(short_p, 4)                    # under the chunk
+    out = eng.run()
+    np.testing.assert_array_equal(out[r_long],
+                                  _want(cfg, params, long_p, 6))
+    np.testing.assert_array_equal(
+        out[r_pref],
+        _want(cfg, params, np.concatenate([prefix, long_p[:10]]), 5))
+    np.testing.assert_array_equal(out[r_short],
+                                  _want(cfg, params, short_p, 4))
+
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ContinuousBatchingEngine(cfg, params, prefill_chunk=-1)
+
+
+def test_chunked_prefill_does_not_stall_decode(setup):
+    """While a long prompt prefills chunk by chunk, an already-active
+    request keeps emitting tokens — the defining property of chunked
+    prefill (a synchronous prefill would freeze it)."""
+    cfg, params = setup
+    rng = np.random.default_rng(24)
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2, prefill_chunk=5)
+    active_p = rng.integers(0, cfg.vocab_size, size=4).astype(np.int32)
+    long_p = rng.integers(0, cfg.vocab_size, size=30).astype(np.int32)
+
+    def emitted(rid):
+        return next(len(s.emitted) for s in eng._slots
+                    if s is not None and s.request_id == rid)
+
+    r_active = eng.submit(active_p, 20)
+    eng.step()                                  # r_active decoding
+    before = emitted(r_active)
+    r_long = eng.submit(long_p, 3)              # 30 tokens → 6 chunks
+    for _ in range(3):                          # long prompt still mid-prefill
+        eng.step()
+    assert eng._prefilling is not None          # genuinely chunked
+    assert emitted(r_active) >= before + 3      # decode kept flowing
+    out = eng.run()
+    np.testing.assert_array_equal(out[r_active],
+                                  _want(cfg, params, active_p, 20))
+    np.testing.assert_array_equal(out[r_long],
+                                  _want(cfg, params, long_p, 3))
+
+
 def test_streaming_callback(setup):
     """on_token streams every kept token in order, as it is emitted —
     the stream equals the final output, and it arrives incrementally

@@ -86,6 +86,20 @@ class _Pending:
     on_token: Optional[Any] = None
 
 
+@dataclasses.dataclass
+class _Prefilling:
+    """A long prompt mid-chunked-prefill: its KV accumulates in a private
+    batch-1 cache, one chunk per engine step, while decode continues for
+    everyone else; the reserved slot admits it when the last chunk lands."""
+
+    req: _Pending
+    pre_cache: Any                # [1, max_len, ...] accumulating KV
+    base: int                     # prefix length (0 without a prefix_id)
+    done: int                     # positions cached so far (incl. prefix)
+    total: int                    # base + prompt length
+    dequeued_at: float
+
+
 def _strip_index(cache: Any) -> Any:
     """Drop the cursor leaves from an ordinary decode cache so its structure
     matches the multislot cache (which has none)."""
@@ -108,9 +122,12 @@ class ContinuousBatchingEngine:
                  top_k: int = 0, top_p: float = 0.0,
                  rng: Optional[jax.Array] = None, mesh=None, rules=None,
                  step_horizon: int = 1, metrics=None,
-                 int8_weights: bool = False):
+                 int8_weights: bool = False, prefill_chunk: int = 0):
         if step_horizon < 1:
             raise ValueError(f"step_horizon must be >= 1, got {step_horizon}")
+        if prefill_chunk < 0:
+            raise ValueError(f"prefill_chunk must be >= 0, got "
+                             f"{prefill_chunk}")
         if (int8_weights or cfg.serve_int8_weights) and mesh is not None:
             # pre-quantized configs hit this too, not just the kwarg path —
             # the partition rules target bf16 kernel shapes, and their
@@ -134,6 +151,17 @@ class ContinuousBatchingEngine:
         self.cfg = cfg
         self.n_slots = n_slots
         self.max_len = max_len
+        #: > 0: prompts longer than this prefill one chunk per engine step
+        #: (in a private cache; the slot admits when the last chunk lands)
+        #: instead of one long synchronous prefill — decode for the OTHER
+        #: slots continues between chunks, bounding the TTFT spike a long
+        #: prompt inflicts on everyone ("chunked prefill"). 0 = whole-prompt
+        #: admission. Chunks pad to 128-token prefill buckets, so at
+        #: production lengths the chunk rounds UP to a 128 multiple — a
+        #: smaller chunk would pay the full bucket's FLOPs anyway.
+        if prefill_chunk and max_len > 128:
+            prefill_chunk = -(-prefill_chunk // 128) * 128
+        self.prefill_chunk = prefill_chunk
         self.sampling = SamplingParams(temperature=temperature,
                                        top_k=top_k, top_p=top_p)
         self._rng = rng if rng is not None else jax.random.key(0)
@@ -230,6 +258,8 @@ class ContinuousBatchingEngine:
         self._queue: deque[_Pending] = deque()
         self._next_id = 0
         self._finished: Dict[int, np.ndarray] = {}
+        self._prefilling: Optional[_Prefilling] = None
+        self._reserved_slot: Optional[int] = None
         self.stats = {"steps": 0, "emitted": 0, "admitted": 0}
 
     # ---- request lifecycle -------------------------------------------------
@@ -341,18 +371,36 @@ class ContinuousBatchingEngine:
         return fn
 
     def _admit_pending(self) -> None:
+        if self._prefilling is not None:
+            self._advance_prefill()       # one chunk per engine step
         for i in range(self.n_slots):
             if not self._queue:
                 return
-            if self._slots[i] is not None:
+            if self._slots[i] is not None or i == self._reserved_slot:
                 continue
-            req = self._queue.popleft()
+            req = self._queue[0]
+            prefix_cache, plen = ((None, 0) if req.prefix_id is None
+                                  else self._prefixes[req.prefix_id])
+            if (self.prefill_chunk
+                    and req.prompt.size > self.prefill_chunk):
+                if self._prefilling is not None:
+                    return    # strict FIFO: one chunked prefill in flight
+                self._queue.popleft()
+                if self.metrics is not None:
+                    self.metrics.set_gauge("queue_depth", len(self._queue))
+                pre_cache = (prefix_cache if prefix_cache is not None
+                             else init_cache(self._prefill_model, 1))
+                self._prefilling = _Prefilling(
+                    req, pre_cache, plen, plen,
+                    plen + int(req.prompt.size), time.monotonic())
+                self._reserved_slot = i
+                self._advance_prefill()
+                continue
+            self._queue.popleft()
             dequeued_at = time.monotonic()   # queue wait ends HERE — the
                                              # prefill that follows is TTFT
             slen = int(req.prompt.size)
             self._rng, key = jax.random.split(self._rng)
-            prefix_cache, plen = ((None, 0) if req.prefix_id is None
-                                  else self._prefixes[req.prefix_id])
             # the (suffix) bucket may not spill past max_len: appends land
             # at plen..plen+bucket-1 (dynamic_update_slice would clamp a
             # spilling start and corrupt earlier rows)
@@ -366,24 +414,55 @@ class ContinuousBatchingEngine:
             else:
                 pre_cache, first = self._prefill_fn(bucket)(
                     self._params, jnp.asarray(padded), slen, key)
-            lp = plen + slen
-            self._cache = self._admit(self._cache, pre_cache,
-                                      jnp.int32(i), jnp.int32(lp))
-            first = int(first)   # host sync: the first token IS emitted now
-            self._slots[i] = _Slot(req.request_id, lp, first, [first],
-                                   req.max_new_tokens, req.eos_id,
-                                   req.submitted_at, req.on_token)
-            self._fire_on_token(self._slots[i], first)
-            self.stats["admitted"] += 1
-            self.stats["emitted"] += 1
-            if self.metrics is not None:
-                self.metrics.observe("queue_wait_seconds",
-                                     dequeued_at - req.submitted_at)
-                self.metrics.observe("time_to_first_token_seconds",
-                                     time.monotonic() - req.submitted_at)
-                self.metrics.inc("tokens_emitted")
-                self.metrics.set_gauge("queue_depth", len(self._queue))
-            self._retire_if_done(i)
+            self._finish_admission(i, req, pre_cache, first, plen + slen,
+                                   dequeued_at)
+
+    def _advance_prefill(self) -> None:
+        """One chunk of the in-flight chunked prefill: append this chunk's
+        KV to the request's private cache via the (exact) cursor-seeded
+        suffix program; on the last chunk, sample the first token and
+        admit into the reserved slot."""
+        st = self._prefilling
+        offset = st.done - st.base
+        chunk = st.req.prompt[offset:offset + self.prefill_chunk]
+        clen = int(chunk.size)
+        bucket = _bucket_len(clen, self.max_len - st.done)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :clen] = chunk
+        self._rng, key = jax.random.split(self._rng)
+        st.pre_cache, first = self._suffix_prefill_fn(bucket)(
+            self._params, st.pre_cache, jnp.asarray(padded),
+            jnp.int32(st.done), jnp.int32(clen), key)
+        st.done += clen
+        if st.done == st.total:
+            i = self._reserved_slot
+            self._prefilling = None
+            self._reserved_slot = None
+            self._finish_admission(i, st.req, st.pre_cache, first,
+                                   st.total, st.dequeued_at)
+
+    def _finish_admission(self, i: int, req: _Pending, pre_cache, first,
+                          lp: int, dequeued_at: float) -> None:
+        """Copy a fully prefilled request into slot ``i`` and activate it;
+        the first token (already sampled by the prefill program) is
+        emitted here."""
+        self._cache = self._admit(self._cache, pre_cache,
+                                  jnp.int32(i), jnp.int32(lp))
+        first = int(first)   # host sync: the first token IS emitted now
+        self._slots[i] = _Slot(req.request_id, lp, first, [first],
+                               req.max_new_tokens, req.eos_id,
+                               req.submitted_at, req.on_token)
+        self._fire_on_token(self._slots[i], first)
+        self.stats["admitted"] += 1
+        self.stats["emitted"] += 1
+        if self.metrics is not None:
+            self.metrics.observe("queue_wait_seconds",
+                                 dequeued_at - req.submitted_at)
+            self.metrics.observe("time_to_first_token_seconds",
+                                 time.monotonic() - req.submitted_at)
+            self.metrics.inc("tokens_emitted")
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+        self._retire_if_done(i)
 
     @staticmethod
     def _fire_on_token(slot: _Slot, token: int) -> None:
@@ -459,7 +538,8 @@ class ContinuousBatchingEngine:
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drain the queue and every active slot; returns {id: tokens}."""
-        while self._queue or any(s is not None for s in self._slots):
+        while (self._queue or self._prefilling is not None
+               or any(s is not None for s in self._slots)):
             self.step()
         out, self._finished = self._finished, {}
         return out
@@ -471,4 +551,5 @@ class ContinuousBatchingEngine:
 
     @property
     def free_slots(self) -> int:
-        return sum(s is None for s in self._slots)
+        free = sum(s is None for s in self._slots)
+        return free - (1 if self._reserved_slot is not None else 0)
