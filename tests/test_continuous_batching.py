@@ -383,6 +383,29 @@ def test_serving_metrics(setup):
     assert m.gauges["slots_active"] == 0 and m.gauges["queue_depth"] == 0
 
 
+def test_gpt2_family_engine():
+    """Learned-positional (GPT-2-style, tied-embeddings) models serve
+    through the engine too — the cache stays at the trained table length
+    and continuations match generate()."""
+    cfg = dataclasses.replace(
+        TransformerConfig.tiny(), dtype=jnp.float32, pos_emb="learned",
+        norm="ln", activation="gelu", tie_embeddings=True, n_kv_heads=4,
+        max_seq_len=64)
+    tok = jax.random.randint(jax.random.key(5), (1, 8), 0, cfg.vocab_size,
+                             jnp.int32)
+    params = Transformer(cfg).init(jax.random.key(6), tok)["params"]
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2)
+    assert eng.max_len == cfg.max_seq_len    # learned table pins the length
+    rng = np.random.default_rng(25)
+    prompts = [rng.integers(0, cfg.vocab_size, size=n).astype(np.int32)
+               for n in (5, 11)]
+    ids = [eng.submit(p, n) for p, n in zip(prompts, (7, 4))]
+    out = eng.run()
+    for rid, p, n in zip(ids, prompts, (7, 4)):
+        np.testing.assert_array_equal(out[rid], _want(cfg, params, p, n),
+                                      err_msg=f"gpt2 request {rid}")
+
+
 def test_random_traffic_fuzz(setup):
     """Randomized mixed traffic — ragged lengths, random admission times,
     random horizons, prefix and plain requests interleaved, slot churn —

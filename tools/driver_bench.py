@@ -170,10 +170,13 @@ def bench_continuous(n_slots: int = 8, n_requests: int = 32,
     params = model.init(jax.random.key(0), probe)["params"]
     params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
 
+    from tpu_on_k8s.metrics.metrics import ServingMetrics
+
     rng = np.random.default_rng(0)
+    metrics = ServingMetrics()
     eng = ContinuousBatchingEngine(cfg, params, n_slots=n_slots,
                                    max_len=512, step_horizon=step_horizon,
-                                   int8_weights=serve_int8)
+                                   int8_weights=serve_int8, metrics=metrics)
     # warmup compiles: the step program, the admit program, and one
     # prefill program per 128-bucket the traffic below can hit
     for lp in (100, 200):
@@ -182,6 +185,7 @@ def bench_continuous(n_slots: int = 8, n_requests: int = 32,
     eng.run()
     # the published numbers cover the timed region only, not the warmup
     eng.stats = {"steps": 0, "emitted": 0, "admitted": 0}
+    metrics.histograms.clear()
 
     lengths = rng.integers(64, 257, size=n_requests)
     t0 = time.perf_counter()
@@ -192,10 +196,24 @@ def bench_continuous(n_slots: int = 8, n_requests: int = 32,
     dt = time.perf_counter() - t0
     total = sum(len(v) for v in out.values())
     devices = jax.devices()
+
+    def p50(name):
+        vals = list(metrics.histograms[name])
+        return round(statistics.median(vals) * 1e3, 1) if vals else None
+
+    def p95(name):
+        vals = list(metrics.histograms[name])
+        return (round(statistics.quantiles(vals, n=20)[-1] * 1e3, 1)
+                if len(vals) >= 2 else None)
+
     return {
         "metric": "continuous_batching_tokens_per_sec",
         "value": round(total / dt, 1),
         "unit": "tokens/s",
+        "ttft_ms_p50": p50("time_to_first_token_seconds"),
+        "ttft_ms_p95": p95("time_to_first_token_seconds"),
+        "latency_ms_p50": p50("request_latency_seconds"),
+        "latency_ms_p95": p95("request_latency_seconds"),
         "n_slots": n_slots,
         "n_requests": n_requests,
         "prompt_lens": "uniform[64,256]",
