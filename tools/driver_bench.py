@@ -202,9 +202,12 @@ def bench_continuous(n_slots: int = 8, n_requests: int = 32,
         return round(statistics.median(vals) * 1e3, 1) if vals else None
 
     def p95(name):
+        # len >= 20 keeps exclusive quantiles interpolating WITHIN the
+        # sample (fewer observations would extrapolate past the observed
+        # max — the same reason the submit bench guards its p90 at n >= 10)
         vals = list(metrics.histograms[name])
         return (round(statistics.quantiles(vals, n=20)[-1] * 1e3, 1)
-                if len(vals) >= 2 else None)
+                if len(vals) >= 20 else None)
 
     return {
         "metric": "continuous_batching_tokens_per_sec",
