@@ -383,6 +383,26 @@ def test_serving_metrics(setup):
     assert m.gauges["slots_active"] == 0 and m.gauges["queue_depth"] == 0
 
 
+def test_burst_admission_batches_prefills(setup):
+    """A burst of same-bucket requests admits through ONE batched prefill
+    program (not one dispatch per request) — and still matches the solo
+    generate() oracle per request."""
+    cfg, params = setup
+    rng = np.random.default_rng(27)
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=4)
+    prompts = [rng.integers(0, cfg.vocab_size, size=n).astype(np.int32)
+               for n in (4, 9, 6, 12)]   # all bucket to 64 on the tiny cfg
+    ids = [eng.submit(p, 5) for p in prompts]
+    eng.step()                            # burst admits in one pass
+    assert eng.free_slots == 0
+    # exactly one prefill program, compiled at batch 4
+    assert set(eng._prefill_cache) == {(64, 4)}
+    out = eng.run()
+    for rid, p in zip(ids, prompts):
+        np.testing.assert_array_equal(out[rid], _want(cfg, params, p, 5),
+                                      err_msg=f"burst request {rid}")
+
+
 def test_gpt2_family_engine():
     """Learned-positional (GPT-2-style, tied-embeddings) models serve
     through the engine too — the cache stays at the trained table length

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import itertools
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
@@ -234,22 +235,23 @@ class ContinuousBatchingEngine:
         @functools.partial(
             jax.jit, donate_argnums=(0,),
             out_shardings=cache_shardings if mesh is not None else None)
-        def admit(cache, pre_cache, slot, lp):
-            """Mask the prefill cache's first ``lp`` positions into row
-            ``slot`` of the pool. Positions >= lp (pad garbage) keep the
-            slot's old bytes — never attended, same invariant as appends."""
+        def admit(cache, pre_cache, slot, lp, row):
+            """Mask row ``row`` of a prefill cache's first ``lp`` positions
+            into row ``slot`` of the pool (batched prefills admit one row
+            per call). Positions >= lp (pad garbage) keep the slot's old
+            bytes — never attended, same invariant as appends."""
             def write(shared, pre):
                 # cache leaves are layer-stacked by the block scan
                 # (variable_axes {"cache": 0}): [L, B, max_len, ...]
                 keep = jnp.arange(shared.shape[2]) < lp        # positions
                 keep = keep.reshape((1, -1) + (1,) * (pre.ndim - 3))
                 return shared.at[:, slot].set(
-                    jnp.where(keep, pre[:, 0], shared[:, slot]))
+                    jnp.where(keep, pre[:, row], shared[:, slot]))
             return jax.tree.map(write, cache, _strip_index(pre_cache))
 
         self._step = step
         self._admit = admit
-        self._prefill_cache: Dict[int, Any] = {}
+        self._prefill_cache: Dict[tuple, Any] = {}  # (bucket, b) -> program
         self._suffix_prefill_cache: Dict[int, Any] = {}
         self._prefixes: Dict[int, Any] = {}   # id → (cache pytree, length)
         self._next_prefix_id = 0
@@ -284,8 +286,9 @@ class ContinuousBatchingEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :lp] = tokens
         self._rng, key = jax.random.split(self._rng)
-        cache, _ = self._prefill_fn(bucket)(self._params,
-                                            jnp.asarray(padded), lp, key)
+        cache, _ = self._prefill_fn(bucket)(
+            self._params, jnp.asarray(padded),
+            jnp.asarray([lp], np.int32), key)
         pid = self._next_prefix_id
         self._next_prefix_id += 1
         self._prefixes[pid] = (cache, lp)
@@ -326,24 +329,29 @@ class ContinuousBatchingEngine:
             self.metrics.set_gauge("queue_depth", len(self._queue))
         return rid
 
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill_cache.get(bucket)
+    def _prefill_fn(self, bucket: int, b: int = 1):
+        """Prefill ``b`` same-bucket prompts in ONE program: prompts
+        [b, bucket], per-row true lengths ``lps`` [b]; returns the [b]-row
+        cache plus each row's first token (picked at its own lp-1)."""
+        fn = self._prefill_cache.get((bucket, b))
         if fn is None:
             model = self._prefill_model
-            shapes = cache_shapes(model, 1)   # length set by max_len, not lp
+            shapes = cache_shapes(model, b)   # length set by max_len, not lp
             sp = self.sampling
 
             @jax.jit
-            def prefill(params, prompt, lp, key):
+            def prefill(params, prompts, lps, key):
                 cache = jax.tree.map(
                     lambda s: jnp.zeros(s.shape, s.dtype), shapes)
-                positions = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+                positions = jnp.broadcast_to(
+                    jnp.arange(bucket, dtype=jnp.int32), (b, bucket))
                 logits, upd = model.apply(
-                    {"params": params, "cache": cache}, prompt, positions,
+                    {"params": params, "cache": cache}, prompts, positions,
                     mutable=["cache"])
-                return upd["cache"], _pick(logits[0, lp - 1], key, sp)
+                rows = jnp.arange(b)
+                return upd["cache"], _pick(logits[rows, lps - 1], key, sp)
 
-            fn = self._prefill_cache[bucket] = prefill
+            fn = self._prefill_cache[(bucket, b)] = prefill
         return fn
 
     def _suffix_prefill_fn(self, bucket: int):
@@ -370,14 +378,18 @@ class ContinuousBatchingEngine:
             fn = self._suffix_prefill_cache[bucket] = prefill
         return fn
 
+    #: batched-admission program sizes (largest that fits is used); a
+    #: bounded set so (bucket, b) programs can't proliferate
+    _ADMIT_BATCH_SIZES = (4, 2, 1)
+
     def _admit_pending(self) -> None:
         if self._prefilling is not None:
             self._advance_prefill()       # one chunk per engine step
-        for i in range(self.n_slots):
-            if not self._queue:
+        while self._queue:
+            free = [i for i in range(self.n_slots)
+                    if self._slots[i] is None and i != self._reserved_slot]
+            if not free:
                 return
-            if self._slots[i] is not None or i == self._reserved_slot:
-                continue
             req = self._queue[0]
             prefix_cache, plen = ((None, 0) if req.prefix_id is None
                                   else self._prefixes[req.prefix_id])
@@ -393,29 +405,59 @@ class ContinuousBatchingEngine:
                 self._prefilling = _Prefilling(
                     req, pre_cache, plen, plen,
                     plen + int(req.prompt.size), time.monotonic())
-                self._reserved_slot = i
+                self._reserved_slot = free[0]
                 self._advance_prefill()
                 continue
-            self._queue.popleft()
-            dequeued_at = time.monotonic()   # queue wait ends HERE — the
-                                             # prefill that follows is TTFT
-            slen = int(req.prompt.size)
-            self._rng, key = jax.random.split(self._rng)
-            # the (suffix) bucket may not spill past max_len: appends land
-            # at plen..plen+bucket-1 (dynamic_update_slice would clamp a
-            # spilling start and corrupt earlier rows)
-            bucket = _bucket_len(slen, self.max_len - plen)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :slen] = req.prompt
             if prefix_cache is not None:
+                self._queue.popleft()
+                dequeued_at = time.monotonic()
+                slen = int(req.prompt.size)
+                self._rng, key = jax.random.split(self._rng)
+                # the suffix bucket may not spill past max_len: appends
+                # land at plen..plen+bucket-1 (dynamic_update_slice would
+                # clamp a spilling start and corrupt earlier rows)
+                bucket = _bucket_len(slen, self.max_len - plen)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :slen] = req.prompt
                 pre_cache, first = self._suffix_prefill_fn(bucket)(
                     self._params, prefix_cache, jnp.asarray(padded),
                     jnp.int32(plen), jnp.int32(slen), key)
-            else:
-                pre_cache, first = self._prefill_fn(bucket)(
-                    self._params, jnp.asarray(padded), slen, key)
-            self._finish_admission(i, req, pre_cache, first, plen + slen,
-                                   dequeued_at)
+                self._finish_admission(free[0], req, pre_cache, first,
+                                       plen + slen, dequeued_at)
+                continue
+            # plain requests: batch the front FIFO run that shares this
+            # request's prompt bucket into ONE prefill program — a burst
+            # of arrivals pays one dispatch, not one per request
+            bucket = _bucket_len(int(req.prompt.size), self.max_len)
+            group = [req]
+            for nxt in itertools.islice(self._queue, 1,
+                                        self._ADMIT_BATCH_SIZES[0]):
+                if (len(group) >= min(len(free),
+                                      self._ADMIT_BATCH_SIZES[0])
+                        or nxt.prefix_id is not None
+                        or (self.prefill_chunk
+                            and nxt.prompt.size > self.prefill_chunk)
+                        or _bucket_len(int(nxt.prompt.size),
+                                       self.max_len) != bucket):
+                    break
+                group.append(nxt)
+            b = max(s for s in self._ADMIT_BATCH_SIZES
+                    if s <= min(len(group), len(free)))
+            group = group[:b]
+            for _ in group:
+                self._queue.popleft()
+            dequeued_at = time.monotonic()
+            lps = np.asarray([r.prompt.size for r in group], np.int32)
+            padded = np.zeros((b, bucket), np.int32)
+            for j, r in enumerate(group):
+                padded[j, :r.prompt.size] = r.prompt
+            self._rng, key = jax.random.split(self._rng)
+            pre_cache, firsts = self._prefill_fn(bucket, b)(
+                self._params, jnp.asarray(padded), jnp.asarray(lps), key)
+            firsts = np.asarray(firsts)
+            for j, (r, i) in enumerate(zip(group, free)):
+                self._finish_admission(i, r, pre_cache, firsts[j],
+                                       int(lps[j]), dequeued_at, row=j)
 
     def _advance_prefill(self) -> None:
         """One chunk of the in-flight chunked prefill: append this chunk's
@@ -442,12 +484,14 @@ class ContinuousBatchingEngine:
                                    st.total, st.dequeued_at)
 
     def _finish_admission(self, i: int, req: _Pending, pre_cache, first,
-                          lp: int, dequeued_at: float) -> None:
-        """Copy a fully prefilled request into slot ``i`` and activate it;
-        the first token (already sampled by the prefill program) is
-        emitted here."""
+                          lp: int, dequeued_at: float,
+                          row: int = 0) -> None:
+        """Copy row ``row`` of a prefilled cache into slot ``i`` and
+        activate it; the first token (already sampled by the prefill
+        program) is emitted here."""
         self._cache = self._admit(self._cache, pre_cache,
-                                  jnp.int32(i), jnp.int32(lp))
+                                  jnp.int32(i), jnp.int32(lp),
+                                  jnp.int32(row))
         first = int(first)   # host sync: the first token IS emitted now
         self._slots[i] = _Slot(req.request_id, lp, first, [first],
                                req.max_new_tokens, req.eos_id,
