@@ -177,12 +177,16 @@ def bench_continuous(n_slots: int = 8, n_requests: int = 32,
     eng = ContinuousBatchingEngine(cfg, params, n_slots=n_slots,
                                    max_len=512, step_horizon=step_horizon,
                                    int8_weights=serve_int8, metrics=metrics)
-    # warmup compiles: the step program, the admit program, and one
-    # prefill program per 128-bucket the traffic below can hit
+    # warmup compiles: the step program, the admit program, and the
+    # prefill programs for every (bucket, batch) shape the traffic below
+    # can hit — 7 same-bucket submissions admit as groups of 4, 2, and 1,
+    # covering all _ADMIT_BATCH_SIZES so no burst-prefill compile lands
+    # in the timed region
     for lp in (100, 200):
-        eng.submit(rng.integers(0, cfg.vocab_size, size=lp).astype(np.int32),
-                   4)
-    eng.run()
+        for _ in range(7):
+            eng.submit(rng.integers(0, cfg.vocab_size,
+                                    size=lp).astype(np.int32), 4)
+        eng.run()
     # the published numbers cover the timed region only, not the warmup
     eng.stats = {"steps": 0, "emitted": 0, "admitted": 0}
     metrics.histograms.clear()

@@ -1,0 +1,123 @@
+"""HuggingFace Llama checkpoint interop.
+
+``from_hf_llama`` maps a ``transformers`` ``LlamaForCausalLM`` (or its
+state dict) onto this framework's flagship transformer
+(`tpu_on_k8s/models/transformer.py`): users bring real Llama-family
+weights, and — just as importantly — the mapping gives the whole stack an
+INDEPENDENT external oracle: logit parity against HF's torch
+implementation exercises rope (both use the rotate-half convention with
+``inv_freq = theta^(-2i/d)``), GQA head grouping, SwiGLU, RMSNorm
+epsilon handling, and the tied/untied head in one comparison no
+self-authored test can fake (`tests/test_hf_interop.py`).
+
+Layout notes: torch ``nn.Linear`` stores ``weight [out, in]`` and
+computes ``x @ weight.T``; our kernels are ``[in, out]`` — every
+projection transposes. Scanned blocks stack per-layer leaves on axis 0.
+The reference operator never touches checkpoints beyond mounting them
+(SURVEY.md §2.6); interop is compute-plane surface.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+
+
+def config_from_hf_llama(hf_config) -> TransformerConfig:
+    """A ``TransformerConfig`` matching a ``transformers.LlamaConfig``."""
+    head_dim = getattr(hf_config, "head_dim", None) or (
+        hf_config.hidden_size // hf_config.num_attention_heads)
+    if head_dim * hf_config.num_attention_heads != hf_config.hidden_size:
+        raise ValueError(
+            f"unsupported head_dim {head_dim}: this transformer derives "
+            f"head_dim as hidden_size/num_heads")
+    if getattr(hf_config, "attention_bias", False):
+        raise ValueError("attention_bias=True is not supported")
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=float(hf_config.rope_theta),
+        norm_eps=float(hf_config.rms_norm_eps),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                    False)),
+        remat=False,
+    )
+
+
+def params_from_hf_llama(state_dict, cfg: TransformerConfig,
+                         dtype=jnp.float32) -> dict:
+    """Our param pytree from an HF Llama ``state_dict`` (torch tensors or
+    numpy arrays)."""
+    def arr(name: str) -> np.ndarray:
+        w = state_dict[name]
+        if hasattr(w, "detach"):          # torch tensor
+            w = w.detach().to("cpu").float().numpy()
+        return np.asarray(w, np.float32)
+
+    def stacked(fmt: str, transpose: bool = True) -> jnp.ndarray:
+        ws = [arr(fmt.format(i)) for i in range(cfg.n_layers)]
+        ws = [w.T if transpose else w for w in ws]
+        return jnp.asarray(np.stack(ws), dtype)
+
+    blocks = {
+        "attn": {
+            "wq": {"kernel": stacked(
+                "model.layers.{}.self_attn.q_proj.weight")},
+            "wk": {"kernel": stacked(
+                "model.layers.{}.self_attn.k_proj.weight")},
+            "wv": {"kernel": stacked(
+                "model.layers.{}.self_attn.v_proj.weight")},
+            "wo": {"kernel": stacked(
+                "model.layers.{}.self_attn.o_proj.weight")},
+        },
+        "attn_norm": {"scale": stacked(
+            "model.layers.{}.input_layernorm.weight", transpose=False)},
+        "mlp": {
+            "w_gate": {"kernel": stacked(
+                "model.layers.{}.mlp.gate_proj.weight")},
+            "w_up": {"kernel": stacked(
+                "model.layers.{}.mlp.up_proj.weight")},
+            "w_down": {"kernel": stacked(
+                "model.layers.{}.mlp.down_proj.weight")},
+        },
+        "mlp_norm": {"scale": stacked(
+            "model.layers.{}.post_attention_layernorm.weight",
+            transpose=False)},
+    }
+    params: dict[str, Any] = {
+        "embed": jnp.asarray(arr("model.embed_tokens.weight"), dtype),
+        "blocks": blocks,
+        "final_norm": {"scale": jnp.asarray(arr("model.norm.weight"),
+                                            dtype)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(arr("lm_head.weight").T, dtype)
+    return params
+
+
+def from_hf_llama(hf_model, dtype=jnp.float32, compute_dtype=None
+                  ) -> Tuple[TransformerConfig, dict]:
+    """(config, params) from a loaded ``LlamaForCausalLM`` — ready for
+    ``Transformer``, ``generate()``, the continuous-batching engine, or a
+    fine-tuning ``Trainer``.
+
+    ``dtype`` stores the converted params; ``compute_dtype`` (default:
+    same as ``dtype``) sets the model's activation dtype — pass
+    ``jnp.bfloat16`` for TPU serving, keep fp32 when comparing logits
+    against the HF oracle bit-closely."""
+    import dataclasses
+
+    cfg = config_from_hf_llama(hf_model.config)
+    cfg = dataclasses.replace(cfg, dtype=compute_dtype or dtype,
+                              param_dtype=dtype)
+    params = params_from_hf_llama(hf_model.state_dict(), cfg, dtype)
+    Transformer(cfg)  # config constructs; bad fields fail loudly here
+    return cfg, params
