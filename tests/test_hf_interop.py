@@ -86,3 +86,70 @@ def test_config_validation():
     hf.config.attention_bias = True
     with pytest.raises(ValueError, match="attention_bias"):
         config_from_hf_llama(hf.config)
+    hf.config.attention_bias = False
+    hf.config.rope_scaling = {"rope_type": "llama3", "factor": 8.0}
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf_llama(hf.config)   # silently-wrong logits otherwise
+    hf.config.rope_scaling = None
+    hf.config.hidden_act = "gelu"
+    with pytest.raises(ValueError, match="hidden_act"):
+        config_from_hf_llama(hf.config)
+
+
+class TestGPT2:
+    """GPT-2-family oracle: learned positions, LayerNorm (with bias),
+    tanh-gelu, biased Conv1D projections, tied head."""
+
+    @staticmethod
+    def _tiny_gpt2():
+        hf_cfg = transformers.GPT2Config(
+            vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+            n_inner=None, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+        torch.manual_seed(0)
+        return transformers.GPT2LMHeadModel(hf_cfg).eval()
+
+    def test_logits_match_hf(self):
+        from tpu_on_k8s.models.convert import from_hf_gpt2
+
+        hf = self._tiny_gpt2()
+        cfg, params = from_hf_gpt2(hf)
+        assert cfg.use_bias and cfg.tie_embeddings
+        assert cfg.pos_emb == "learned" and cfg.activation == "gelu"
+
+        tokens = np.array([[3, 17, 95, 4, 88, 120, 7, 1],
+                           [9, 2, 64, 31, 5, 77, 12, 40]], np.int32)
+        with torch.no_grad():
+            want = hf(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+        got = np.asarray(Transformer(cfg).apply({"params": params},
+                                                jnp.asarray(tokens)))
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+
+    def test_generate_matches_hf_greedy(self):
+        from tpu_on_k8s.models.convert import from_hf_gpt2
+        from tpu_on_k8s.models.decode import generate
+
+        hf = self._tiny_gpt2()
+        cfg, params = from_hf_gpt2(hf)
+        prompt = np.array([[5, 9, 2, 66, 8, 1]], np.int32)
+        with torch.no_grad():
+            want = hf.generate(torch.tensor(prompt.astype(np.int64)),
+                               max_new_tokens=6, do_sample=False,
+                               pad_token_id=0)[0, 6:].numpy()
+        got = np.asarray(generate(cfg, params, jnp.asarray(prompt), 6))[0]
+        np.testing.assert_array_equal(got, want)
+
+    def test_unsupported_configs_rejected(self):
+        from tpu_on_k8s.models.convert import config_from_hf_gpt2
+
+        hf = self._tiny_gpt2()
+        hf.config.activation_function = "relu"
+        with pytest.raises(ValueError, match="activation"):
+            config_from_hf_gpt2(hf.config)
+        hf.config.activation_function = "gelu_new"
+        hf.config.scale_attn_by_inverse_layer_idx = True
+        with pytest.raises(ValueError, match="scale_attn"):
+            config_from_hf_gpt2(hf.config)
+        hf.config.scale_attn_by_inverse_layer_idx = False
+        hf.config.reorder_and_upcast_attn = True
+        with pytest.raises(ValueError, match="reorder"):
+            config_from_hf_gpt2(hf.config)

@@ -26,6 +26,14 @@ import numpy as np
 from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
 
 
+def _to_np(state_dict, name: str) -> np.ndarray:
+    """fp32 numpy view of a state-dict entry (torch tensor or array)."""
+    w = state_dict[name]
+    if hasattr(w, "detach"):          # torch tensor
+        w = w.detach().to("cpu").float().numpy()
+    return np.asarray(w, np.float32)
+
+
 def config_from_hf_llama(hf_config) -> TransformerConfig:
     """A ``TransformerConfig`` matching a ``transformers.LlamaConfig``."""
     head_dim = getattr(hf_config, "head_dim", None) or (
@@ -36,6 +44,16 @@ def config_from_hf_llama(hf_config) -> TransformerConfig:
             f"head_dim as hidden_size/num_heads")
     if getattr(hf_config, "attention_bias", False):
         raise ValueError("attention_bias=True is not supported")
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
+        # Llama-3.1+ rescales rope frequencies; converting silently would
+        # produce wrong logits far from the trained context behavior
+        raise ValueError(f"rope_scaling {scaling!r} is not supported "
+                         f"(plain rope only)")
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act != "silu":
+        raise ValueError(f"unsupported hidden_act {act!r}: the SwiGLU MLP "
+                         f"assumes silu gating")
     return TransformerConfig(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -57,10 +75,7 @@ def params_from_hf_llama(state_dict, cfg: TransformerConfig,
     """Our param pytree from an HF Llama ``state_dict`` (torch tensors or
     numpy arrays)."""
     def arr(name: str) -> np.ndarray:
-        w = state_dict[name]
-        if hasattr(w, "detach"):          # torch tensor
-            w = w.detach().to("cpu").float().numpy()
-        return np.asarray(w, np.float32)
+        return _to_np(state_dict, name)
 
     def stacked(fmt: str, transpose: bool = True) -> jnp.ndarray:
         ws = [arr(fmt.format(i)) for i in range(cfg.n_layers)]
@@ -101,6 +116,103 @@ def params_from_hf_llama(state_dict, cfg: TransformerConfig,
     if not cfg.tie_embeddings:
         params["lm_head"] = jnp.asarray(arr("lm_head.weight").T, dtype)
     return params
+
+
+def config_from_hf_gpt2(hf_config) -> TransformerConfig:
+    """A ``TransformerConfig`` matching a ``transformers.GPT2Config``
+    (learned positions, LayerNorm, (tanh-)gelu, tied embeddings, biased
+    projections)."""
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(f"unsupported activation {act!r}: this framework's "
+                         f"gelu is the tanh approximation")
+    if getattr(hf_config, "scale_attn_by_inverse_layer_idx", False):
+        raise ValueError("scale_attn_by_inverse_layer_idx changes the "
+                         "attention math; not supported")
+    if getattr(hf_config, "reorder_and_upcast_attn", False):
+        raise ValueError("reorder_and_upcast_attn changes the attention "
+                         "math; not supported")
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.n_embd,
+        n_layers=hf_config.n_layer,
+        n_heads=hf_config.n_head,
+        n_kv_heads=hf_config.n_head,
+        d_ff=hf_config.n_inner or 4 * hf_config.n_embd,
+        max_seq_len=hf_config.n_positions,
+        norm_eps=float(hf_config.layer_norm_epsilon),
+        pos_emb="learned", norm="ln", activation="gelu",
+        use_bias=True, tie_embeddings=True, remat=False,
+    )
+
+
+def params_from_hf_gpt2(state_dict, cfg: TransformerConfig,
+                        dtype=jnp.float32) -> dict:
+    """Our param pytree from an HF GPT-2 ``state_dict``. GPT-2's Conv1D
+    stores weights ``[in, out]`` (already our kernel layout — no
+    transpose, unlike Llama's Linear); the fused c_attn splits into
+    wq/wk/wv along the output dim in HF's q,k,v order."""
+    def arr(name: str) -> np.ndarray:
+        return _to_np(state_dict, name)
+
+    d = cfg.d_model
+
+    def stacked(fmt: str) -> np.ndarray:
+        return np.stack([arr(fmt.format(i)) for i in range(cfg.n_layers)])
+
+    c_attn_w = stacked("transformer.h.{}.attn.c_attn.weight")  # [L, D, 3D]
+    c_attn_b = stacked("transformer.h.{}.attn.c_attn.bias")    # [L, 3D]
+
+    def j(x):
+        return jnp.asarray(x, dtype)
+
+    blocks = {
+        "attn": {
+            "wq": {"kernel": j(c_attn_w[:, :, :d]),
+                   "bias": j(c_attn_b[:, :d])},
+            "wk": {"kernel": j(c_attn_w[:, :, d:2 * d]),
+                   "bias": j(c_attn_b[:, d:2 * d])},
+            "wv": {"kernel": j(c_attn_w[:, :, 2 * d:]),
+                   "bias": j(c_attn_b[:, 2 * d:])},
+            "wo": {"kernel": j(stacked(
+                       "transformer.h.{}.attn.c_proj.weight")),
+                   "bias": j(stacked(
+                       "transformer.h.{}.attn.c_proj.bias"))},
+        },
+        "attn_norm": {"scale": j(stacked("transformer.h.{}.ln_1.weight")),
+                      "bias": j(stacked("transformer.h.{}.ln_1.bias"))},
+        "mlp": {
+            "w_up": {"kernel": j(stacked(
+                         "transformer.h.{}.mlp.c_fc.weight")),
+                     "bias": j(stacked("transformer.h.{}.mlp.c_fc.bias"))},
+            "w_down": {"kernel": j(stacked(
+                           "transformer.h.{}.mlp.c_proj.weight")),
+                       "bias": j(stacked(
+                           "transformer.h.{}.mlp.c_proj.bias"))},
+        },
+        "mlp_norm": {"scale": j(stacked("transformer.h.{}.ln_2.weight")),
+                     "bias": j(stacked("transformer.h.{}.ln_2.bias"))},
+    }
+    return {
+        "embed": j(arr("transformer.wte.weight")),
+        "pos_embed": j(arr("transformer.wpe.weight")),
+        "blocks": blocks,
+        "final_norm": {"scale": j(arr("transformer.ln_f.weight")),
+                       "bias": j(arr("transformer.ln_f.bias"))},
+    }
+
+
+def from_hf_gpt2(hf_model, dtype=jnp.float32, compute_dtype=None
+                 ) -> Tuple[TransformerConfig, dict]:
+    """(config, params) from a loaded ``GPT2LMHeadModel``."""
+    import dataclasses
+
+    cfg = config_from_hf_gpt2(hf_model.config)
+    cfg = dataclasses.replace(cfg, dtype=compute_dtype or dtype,
+                              param_dtype=dtype)
+    params = params_from_hf_gpt2(hf_model.state_dict(), cfg, dtype)
+    Transformer(cfg)
+    return cfg, params
 
 
 def from_hf_llama(hf_model, dtype=jnp.float32, compute_dtype=None

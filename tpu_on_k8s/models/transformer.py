@@ -124,6 +124,9 @@ class TransformerConfig:
     pos_emb: str = "rope"              # "rope" | "learned" (GPT-2 family)
     norm: str = "rms"                  # "rms" | "ln"
     activation: str = "swiglu"         # "swiglu" | "gelu"
+    use_bias: bool = False             # biases on attention/MLP projections
+                                       # (GPT-2/BERT-family faithfulness;
+                                       # Llama family runs bias-free)
     tie_embeddings: bool = False       # lm_head = embed^T (GPT-2/BERT style)
     n_experts: int = 0                 # >0: MoE MLP (tpu_on_k8s/models/moe.py)
     experts_top_k: int = 2
@@ -317,6 +320,7 @@ class _HeadProj(nn.Module):
     param_dtype: Any
     int8: bool = False
     int8_impl: str = "xla"
+    use_bias: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -330,7 +334,14 @@ class _HeadProj(nn.Module):
             return y.reshape(b, l, self.heads,
                              self.head_dim).transpose(0, 2, 1, 3)
         k3 = kernel.reshape(d_in, self.heads, self.head_dim).astype(self.dtype)
-        return jnp.einsum("bld,dhf->bhlf", x, k3)
+        out = jnp.einsum("bld,dhf->bhlf", x, k3)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros_init(),
+                              (self.heads * self.head_dim,),
+                              self.param_dtype)
+            out = out + bias.reshape(self.heads, 1,
+                                     self.head_dim).astype(self.dtype)
+        return out
 
 
 class _FusedQKVProj(nn.Module):
@@ -376,6 +387,7 @@ class _OutProj(nn.Module):
     param_dtype: Any
     int8: bool = False
     int8_impl: str = "xla"
+    use_bias: bool = False
 
     @nn.compact
     def __call__(self, o: jnp.ndarray) -> jnp.ndarray:
@@ -388,7 +400,12 @@ class _OutProj(nn.Module):
             return _int8_mm(self.int8_impl)(flat, kernel.astype(self.dtype))
         k3 = kernel.reshape(self.heads, self.head_dim,
                             self.d_model).astype(self.dtype)
-        return jnp.einsum("bhlf,hfd->bld", o, k3)
+        out = jnp.einsum("bhlf,hfd->bld", o, k3)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros_init(),
+                              (self.d_model,), self.param_dtype)
+            out = out + bias.astype(self.dtype)
+        return out
 
 
 class Attention(nn.Module):
@@ -402,7 +419,7 @@ class Attention(nn.Module):
                                                  dtype=cfg.dtype)
         else:
             dense = lambda feats, name: nn.Dense(
-                feats, use_bias=False, name=name, dtype=cfg.dtype,
+                feats, use_bias=cfg.use_bias, name=name, dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
                 kernel_init=nn.initializers.normal(0.02))
         if cfg.attn_impl in ("xla", "flash") and not cfg.decode:
@@ -453,6 +470,7 @@ class Attention(nn.Module):
                                                cfg.param_dtype,
                                                int8=cfg.attn_int8,
                                                int8_impl=cfg.int8_impl,
+                                               use_bias=cfg.use_bias,
                                                name=name)
             q = hp(cfg.n_heads, "wq")(x)          # [B, H, L, Dh]
             k = hp(cfg.n_kv_heads, "wk")(x)       # [B, Hkv, L, Dh]
@@ -495,7 +513,8 @@ class Attention(nn.Module):
             out = xla_attention_bhld(q, k, v, causal=True)
         return _OutProj(cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.dtype,
                         cfg.param_dtype, int8=cfg.attn_int8,
-                        int8_impl=cfg.int8_impl, name="wo")(out)
+                        int8_impl=cfg.int8_impl, use_bias=cfg.use_bias,
+                        name="wo")(out)
 
     def _cached_attention(self, q, k, v, positions, rep: int) -> jnp.ndarray:
         """KV-cache attention: append this call's keys/values at the cache
@@ -689,7 +708,7 @@ class MLP(nn.Module):
                 impl=cfg.int8_impl)
         else:
             dense = lambda feats, name: nn.Dense(
-                feats, use_bias=False, name=name, dtype=cfg.dtype,
+                feats, use_bias=cfg.use_bias, name=name, dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
                 kernel_init=nn.initializers.normal(0.02))
         if cfg.activation == "gelu":
@@ -779,6 +798,10 @@ class Transformer(nn.Module):
             if cfg.fused_qkv or cfg.n_experts > 0:
                 raise ValueError("serve_int8_weights does not cover "
                                  "fused_qkv or MoE layouts")
+        if cfg.use_bias and (cfg.mlp_int8 or cfg.attn_int8
+                             or cfg.serve_int8_weights or cfg.fused_qkv):
+            raise ValueError("use_bias is not supported with the int8 or "
+                             "fused-qkv projection layouts")
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1]), tokens.shape)
