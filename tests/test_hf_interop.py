@@ -96,6 +96,53 @@ def test_config_validation():
         config_from_hf_llama(hf.config)
 
 
+class TestExport:
+    """to_hf_llama: the round trip back into transformers."""
+
+    def test_roundtrip_exact_logits(self):
+        from tpu_on_k8s.models.convert import from_hf_llama, to_hf_llama
+
+        a = _tiny_hf()
+        cfg, params = from_hf_llama(a)
+        sd = to_hf_llama(cfg, params)
+        b = transformers.LlamaForCausalLM(a.config).eval()
+        missing, unexpected = b.load_state_dict(sd, strict=False)
+        assert not unexpected
+        assert all("rotary" in m or "inv_freq" in m for m in missing), missing
+
+        tokens = torch.tensor([[3, 17, 95, 4, 88, 120, 7, 1]],
+                              dtype=torch.long)
+        with torch.no_grad():
+            la, lb = a(tokens).logits, b(tokens).logits
+        np.testing.assert_allclose(lb.numpy(), la.numpy(), atol=1e-6)
+
+    def test_fused_layout_exports(self):
+        """A fused-gateup/fused-qkv trained tree unfuses on export."""
+        import dataclasses
+
+        from tpu_on_k8s.models.convert import from_hf_llama, to_hf_llama
+        from tpu_on_k8s.train.checkpoint import migrate_param_layout
+
+        a = _tiny_hf()
+        cfg, params = from_hf_llama(a)
+        fused = migrate_param_layout(params, fused_qkv=True,
+                                     fused_gateup=True)
+        sd = to_hf_llama(dataclasses.replace(cfg, fused_qkv=True,
+                                             mlp_fused_gateup=True), fused)
+        want = to_hf_llama(cfg, params)
+        for k in want:
+            np.testing.assert_allclose(sd[k].numpy(), want[k].numpy(),
+                                       atol=0, err_msg=k)
+
+    def test_rejects_non_llama_families(self):
+        from tpu_on_k8s.models.convert import from_hf_gpt2, to_hf_llama
+
+        hf = TestGPT2._tiny_gpt2()
+        cfg, params = from_hf_gpt2(hf)
+        with pytest.raises(ValueError, match="Llama family"):
+            to_hf_llama(cfg, params)
+
+
 class TestGPT2:
     """GPT-2-family oracle: learned positions, LayerNorm (with bias),
     tanh-gelu, biased Conv1D projections, tied head."""

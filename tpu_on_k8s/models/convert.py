@@ -215,6 +215,56 @@ def from_hf_gpt2(hf_model, dtype=jnp.float32, compute_dtype=None
     return cfg, params
 
 
+def to_hf_llama(cfg: TransformerConfig, params) -> dict:
+    """HF Llama ``state_dict`` (torch tensors) from our param tree — the
+    inverse of ``params_from_hf_llama``, so a model fine-tuned here ships
+    back into the transformers ecosystem. Fused training layouts
+    (``wqkv``/``w_gateup``) are unfused first via the checkpoint
+    migration; round-trip and exported-logit parity are pinned by
+    `tests/test_hf_interop.py`."""
+    import torch
+
+    from tpu_on_k8s.models.layouts import migrate_param_layout
+
+    if (cfg.pos_emb, cfg.norm, cfg.activation) != ("rope", "rms", "swiglu"):
+        raise ValueError("to_hf_llama exports the Llama family only "
+                         "(rope + rmsnorm + swiglu)")
+    if cfg.use_bias or cfg.n_experts or cfg.serve_int8_weights:
+        raise ValueError("biased, MoE, or int8-serving param trees have no "
+                         "Llama state-dict form")
+    params = migrate_param_layout(params, fused_qkv=False,
+                                  fused_gateup=False)
+
+    def t(x, transpose: bool = False):
+        a = np.asarray(x, np.float32)
+        return torch.tensor(a.T if transpose else a)
+
+    b = params["blocks"]
+    sd = {"model.embed_tokens.weight": t(params["embed"]),
+          "model.norm.weight": t(params["final_norm"]["scale"])}
+    names = [("self_attn.q_proj", b["attn"]["wq"]["kernel"]),
+             ("self_attn.k_proj", b["attn"]["wk"]["kernel"]),
+             ("self_attn.v_proj", b["attn"]["wv"]["kernel"]),
+             ("self_attn.o_proj", b["attn"]["wo"]["kernel"]),
+             ("mlp.gate_proj", b["mlp"]["w_gate"]["kernel"]),
+             ("mlp.up_proj", b["mlp"]["w_up"]["kernel"]),
+             ("mlp.down_proj", b["mlp"]["w_down"]["kernel"])]
+    for i in range(cfg.n_layers):
+        for name, stack in names:
+            sd[f"model.layers.{i}.{name}.weight"] = t(stack[i],
+                                                      transpose=True)
+        sd[f"model.layers.{i}.input_layernorm.weight"] = t(
+            b["attn_norm"]["scale"][i])
+        sd[f"model.layers.{i}.post_attention_layernorm.weight"] = t(
+            b["mlp_norm"]["scale"][i])
+    # tied models share ONE tensor with the embedding (as HF itself ties
+    # them) — duplicating would double host memory at real vocab sizes
+    sd["lm_head.weight"] = (sd["model.embed_tokens.weight"]
+                            if cfg.tie_embeddings
+                            else t(params["lm_head"], transpose=True))
+    return sd
+
+
 def from_hf_llama(hf_model, dtype=jnp.float32, compute_dtype=None
                   ) -> Tuple[TransformerConfig, dict]:
     """(config, params) from a loaded ``LlamaForCausalLM`` — ready for

@@ -185,54 +185,7 @@ class CheckpointAgent:
 
 
 # --------------------------------------------------------- layout migration
-def migrate_param_layout(params: Any, *, fused_qkv: Optional[bool] = None,
-                         fused_gateup: Optional[bool] = None) -> Any:
-    """Convert a checkpointed param tree between the fused and unfused
-    projection layouts (`tpu_on_k8s/models/transformer.py`):
-
-    * ``fused_qkv=True`` packs ``attn/{wq,wk,wv}`` into ``attn/wqkv``
-      (concatenated on the output dim, q|k|v order); ``False`` splits.
-    * ``fused_gateup=True`` packs ``mlp/{w_gate,w_up}`` into
-      ``mlp/w_gateup`` (gate|up order); ``False`` splits.
-
-    The fused kernels are byte-identical concatenations of the unfused ones
-    (tested in tests/test_checkpoint.py), so conversion is exact — a
-    round-3 checkpoint loads into the round-4 bench config and vice versa.
-    ``None`` leaves that family untouched. Works on the scan-stacked layout
-    (leading ``layers`` axis) and per-layer trees alike: concatenation is
-    always on the last axis.
-    """
-    import numpy as _np
-
-    def walk(tree: Any) -> Any:
-        if not isinstance(tree, dict):
-            return tree
-        out = {k: walk(v) for k, v in tree.items()}
-        if fused_qkv is True and {"wq", "wk", "wv"} <= set(out):
-            packed = _np.concatenate(
-                [_np.asarray(out.pop(n)["kernel"]) for n in ("wq", "wk", "wv")],
-                axis=-1)
-            out["wqkv"] = {"kernel": packed}
-        elif fused_qkv is False and "wqkv" in out:
-            k = _np.asarray(out.pop("wqkv")["kernel"])
-            # widths recover from the unfused heads: q is as wide as wo's
-            # input; k and v split the rest evenly (GQA)
-            wo_in = _np.asarray(out["wo"]["kernel"]).shape[-2]
-            q_w = wo_in
-            kv_w = (k.shape[-1] - q_w) // 2
-            out["wq"] = {"kernel": k[..., :q_w]}
-            out["wk"] = {"kernel": k[..., q_w:q_w + kv_w]}
-            out["wv"] = {"kernel": k[..., q_w + kv_w:]}
-        if fused_gateup is True and {"w_gate", "w_up"} <= set(out):
-            packed = _np.concatenate(
-                [_np.asarray(out.pop(n)["kernel"])
-                 for n in ("w_gate", "w_up")], axis=-1)
-            out["w_gateup"] = {"kernel": packed}
-        elif fused_gateup is False and "w_gateup" in out:
-            k = _np.asarray(out.pop("w_gateup")["kernel"])
-            half = k.shape[-1] // 2
-            out["w_gate"] = {"kernel": k[..., :half]}
-            out["w_up"] = {"kernel": k[..., half:]}
-        return out
-
-    return walk(params)
+# re-exported from its dependency-free home so checkpoint callers keep
+# their import path (`tpu_on_k8s/models/layouts.py` holds the logic —
+# compute-plane users like the HF exporter reach it without orbax)
+from tpu_on_k8s.models.layouts import migrate_param_layout  # noqa: E402,F401
