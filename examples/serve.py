@@ -142,8 +142,11 @@ def main(argv=None):
                 submitted += 1
                 print(f"→ r{rid} submitted (prompt {lp} tokens)")
         for rid in eng.step():
-            finished[rid] = eng.result(rid)
-            print(f"← r{rid} done: {finished[rid].tolist()}")
+            toks = eng.result(rid)
+            if toks is None:     # claimed by another consumer (see step())
+                continue
+            finished[rid] = toks
+            print(f"← r{rid} done: {toks.tolist()}")
     dt = time.perf_counter() - t0
     total = sum(len(v) for v in finished.values())
     line = (f"served {len(finished)} requests, {total} tokens in {dt:.2f}s "

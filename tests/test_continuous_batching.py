@@ -352,6 +352,58 @@ def test_raising_callback_cannot_poison_the_batch(setup):
                                   _want(cfg, params, p_good, 9))
 
 
+def test_concurrent_submitters_one_driver(setup):
+    """The frontend shape: many threads submit while one driver thread
+    steps. Every request is served exactly once and every continuation
+    still matches its solo generate() oracle."""
+    import threading
+
+    cfg, params = setup
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=3, step_horizon=2)
+    n_threads, per_thread = 4, 5
+    submitted = {}
+    sub_lock = threading.Lock()
+    stop = threading.Event()
+
+    def frontend(tid):
+        rng = np.random.default_rng(100 + tid)
+        for _ in range(per_thread):
+            p = rng.integers(0, cfg.vocab_size,
+                             size=int(rng.integers(2, 10))).astype(np.int32)
+            n = int(rng.integers(1, 7))
+            rid = eng.submit(p, n)
+            with sub_lock:
+                assert rid not in submitted     # ids never collide
+                submitted[rid] = (p, n)
+
+    collected = {}
+
+    def driver():
+        while not stop.is_set() or eng._queue \
+                or any(s is not None for s in eng._slots):
+            for rid in eng.step():
+                collected[rid] = eng.result(rid)
+
+    threads = [threading.Thread(target=frontend, args=(t,))
+               for t in range(n_threads)]
+    drv = threading.Thread(target=driver)
+    drv.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    drv.join(timeout=120)
+    assert not drv.is_alive()
+
+    assert set(collected) == set(submitted)
+    assert len(collected) == n_threads * per_thread
+    for rid, (p, n) in submitted.items():
+        np.testing.assert_array_equal(collected[rid],
+                                      _want(cfg, params, p, n),
+                                      err_msg=f"request {rid}")
+
+
 def test_serving_metrics(setup):
     """The engine reports through the framework's metrics plane: counters,
     TTFT/queue-wait/latency histograms, slot/queue gauges."""

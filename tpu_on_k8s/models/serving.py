@@ -44,6 +44,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import itertools
+import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
@@ -263,6 +264,12 @@ class ContinuousBatchingEngine:
         self._prefilling: Optional[_Prefilling] = None
         self._reserved_slot: Optional[int] = None
         self.stats = {"steps": 0, "emitted": 0, "admitted": 0}
+        # Threading model: ONE driver thread calls step()/run(); submit()
+        # and result() may be called concurrently from request-handler
+        # threads (the SSE/gRPC frontend shape). This lock serializes the
+        # queue/bookkeeping against the driver — device work itself is
+        # single-threaded by design.
+        self._lock = threading.Lock()
 
     # ---- request lifecycle -------------------------------------------------
     def register_prefix(self, tokens) -> int:
@@ -320,13 +327,16 @@ class ContinuousBatchingEngine:
                 f"prefix {plen} + prompt {prompt.size} + new "
                 f"{max_new_tokens} exceeds the engine's max_len "
                 f"{self.max_len}")
-        rid = self._next_id
-        self._next_id += 1
-        self._queue.append(_Pending(rid, prompt, max_new_tokens, eos_id,
-                                    time.monotonic(), prefix_id, on_token))
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._queue.append(_Pending(rid, prompt, max_new_tokens,
+                                        eos_id, time.monotonic(),
+                                        prefix_id, on_token))
+            depth = len(self._queue)
         if self.metrics is not None:
             self.metrics.inc("requests_submitted")
-            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self.metrics.set_gauge("queue_depth", depth)
         return rid
 
     def _prefill_fn(self, bucket: int, b: int = 1):
@@ -386,30 +396,68 @@ class ContinuousBatchingEngine:
         if self._prefilling is not None:
             self._advance_prefill()       # one chunk per engine step
         while self._queue:
-            free = [i for i in range(self.n_slots)
-                    if self._slots[i] is None and i != self._reserved_slot]
-            if not free:
-                return
-            req = self._queue[0]
-            prefix_cache, plen = ((None, 0) if req.prefix_id is None
-                                  else self._prefixes[req.prefix_id])
-            if (self.prefill_chunk
-                    and req.prompt.size > self.prefill_chunk):
-                if self._prefilling is not None:
+            # selection runs under the lock (frontend threads append to
+            # the queue concurrently — iterating/popping must not race
+            # them); device work happens after release
+            with self._lock:
+                if not self._queue:
+                    return
+                free = [i for i in range(self.n_slots)
+                        if self._slots[i] is None
+                        and i != self._reserved_slot]
+                if not free:
+                    return
+                req = self._queue[0]
+                prefix_cache, plen = ((None, 0) if req.prefix_id is None
+                                      else self._prefixes[req.prefix_id])
+                chunked = (self.prefill_chunk
+                           and req.prompt.size > self.prefill_chunk)
+                if chunked and self._prefilling is not None:
                     return    # strict FIFO: one chunked prefill in flight
-                self._queue.popleft()
+                if chunked or prefix_cache is not None:
+                    self._queue.popleft()
+                    if chunked:
+                        # reserve under the lock: free_slots must never
+                        # overcount while the chunked prefill is staged
+                        self._reserved_slot = free[0]
+                    group = [req]
+                else:
+                    # plain requests: batch the front FIFO run sharing
+                    # this request's prompt bucket into ONE prefill
+                    # program — a burst pays one dispatch, not one per
+                    # request
+                    bucket = _bucket_len(int(req.prompt.size),
+                                         self.max_len)
+                    group = [req]
+                    for nxt in itertools.islice(
+                            self._queue, 1, self._ADMIT_BATCH_SIZES[0]):
+                        if (len(group) >= min(len(free),
+                                              self._ADMIT_BATCH_SIZES[0])
+                                or nxt.prefix_id is not None
+                                or (self.prefill_chunk
+                                    and (nxt.prompt.size
+                                         > self.prefill_chunk))
+                                or _bucket_len(int(nxt.prompt.size),
+                                               self.max_len) != bucket):
+                            break
+                        group.append(nxt)
+                    b = max(s for s in self._ADMIT_BATCH_SIZES
+                            if s <= min(len(group), len(free)))
+                    group = group[:b]
+                    for _ in group:
+                        self._queue.popleft()
+                depth = len(self._queue)
+            if chunked:
                 if self.metrics is not None:
-                    self.metrics.set_gauge("queue_depth", len(self._queue))
+                    self.metrics.set_gauge("queue_depth", depth)
                 pre_cache = (prefix_cache if prefix_cache is not None
                              else init_cache(self._prefill_model, 1))
                 self._prefilling = _Prefilling(
                     req, pre_cache, plen, plen,
                     plen + int(req.prompt.size), time.monotonic())
-                self._reserved_slot = free[0]
                 self._advance_prefill()
                 continue
             if prefix_cache is not None:
-                self._queue.popleft()
                 dequeued_at = time.monotonic()
                 slen = int(req.prompt.size)
                 self._rng, key = jax.random.split(self._rng)
@@ -425,27 +473,7 @@ class ContinuousBatchingEngine:
                 self._finish_admission(free[0], req, pre_cache, first,
                                        plen + slen, dequeued_at)
                 continue
-            # plain requests: batch the front FIFO run that shares this
-            # request's prompt bucket into ONE prefill program — a burst
-            # of arrivals pays one dispatch, not one per request
-            bucket = _bucket_len(int(req.prompt.size), self.max_len)
-            group = [req]
-            for nxt in itertools.islice(self._queue, 1,
-                                        self._ADMIT_BATCH_SIZES[0]):
-                if (len(group) >= min(len(free),
-                                      self._ADMIT_BATCH_SIZES[0])
-                        or nxt.prefix_id is not None
-                        or (self.prefill_chunk
-                            and nxt.prompt.size > self.prefill_chunk)
-                        or _bucket_len(int(nxt.prompt.size),
-                                       self.max_len) != bucket):
-                    break
-                group.append(nxt)
-            b = max(s for s in self._ADMIT_BATCH_SIZES
-                    if s <= min(len(group), len(free)))
-            group = group[:b]
-            for _ in group:
-                self._queue.popleft()
+            b = len(group)
             dequeued_at = time.monotonic()
             lps = np.asarray([r.prompt.size for r in group], np.int32)
             padded = np.zeros((b, bucket), np.int32)
@@ -479,9 +507,13 @@ class ContinuousBatchingEngine:
         if st.done == st.total:
             i = self._reserved_slot
             self._prefilling = None
-            self._reserved_slot = None
+            # fill the slot first, then drop the reservation — the brief
+            # filled+reserved overlap UNDERcounts free_slots (safe for
+            # admission control); the reverse order would overcount
             self._finish_admission(i, st.req, st.pre_cache, first,
                                    st.total, st.dequeued_at)
+            with self._lock:
+                self._reserved_slot = None
 
     def _finish_admission(self, i: int, req: _Pending, pre_cache, first,
                           lp: int, dequeued_at: float,
@@ -493,9 +525,10 @@ class ContinuousBatchingEngine:
                                   jnp.int32(i), jnp.int32(lp),
                                   jnp.int32(row))
         first = int(first)   # host sync: the first token IS emitted now
-        self._slots[i] = _Slot(req.request_id, lp, first, [first],
-                               req.max_new_tokens, req.eos_id,
-                               req.submitted_at, req.on_token)
+        with self._lock:
+            self._slots[i] = _Slot(req.request_id, lp, first, [first],
+                                   req.max_new_tokens, req.eos_id,
+                                   req.submitted_at, req.on_token)
         self._fire_on_token(self._slots[i], first)
         self.stats["admitted"] += 1
         self.stats["emitted"] += 1
@@ -531,9 +564,10 @@ class ContinuousBatchingEngine:
                 or (slot.eos_id is not None
                     and slot.emitted[-1] == slot.eos_id))
         if done:
-            self._finished[slot.request_id] = np.asarray(slot.emitted,
-                                                         np.int32)
-            self._slots[i] = None
+            with self._lock:
+                self._finished[slot.request_id] = np.asarray(slot.emitted,
+                                                             np.int32)
+                self._slots[i] = None
             if self.metrics is not None:
                 self.metrics.inc("requests_finished")
                 self.metrics.observe("request_latency_seconds",
@@ -544,9 +578,18 @@ class ContinuousBatchingEngine:
     def step(self) -> List[int]:
         """Admit queued requests, advance every active slot by one horizon
         (``step_horizon`` tokens in one compiled program), and return the
-        ids of requests that finished."""
+        ids of requests that finished. The ids are NOTIFICATIONS — the
+        payload is claimed by whoever calls ``result()`` first, so pick
+        ONE consumer per request (the driver loop or a polling frontend
+        thread, not both) and treat ``result() is None`` as
+        already-claimed."""
+        # snapshot BEFORE admission: a request that retires during
+        # admission itself (max_new_tokens=1, instant eos) must still be
+        # reported by THIS step, or a step()/result() driver never learns
+        # it finished
+        with self._lock:
+            before = set(self._finished)
         self._admit_pending()
-        before = set(self._finished)
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if active:
             toks = np.zeros(self.n_slots, np.int32)
@@ -578,7 +621,8 @@ class ContinuousBatchingEngine:
             self.metrics.set_gauge(
                 "slots_active",
                 sum(s is not None for s in self._slots))
-        return sorted(set(self._finished) - before)
+        with self._lock:
+            return sorted(set(self._finished) - before)
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drain the queue and every active slot; returns {id: tokens}."""
@@ -590,10 +634,13 @@ class ContinuousBatchingEngine:
 
     def result(self, request_id: int) -> Optional[np.ndarray]:
         """The finished continuation for ``request_id`` (None if still in
-        flight); pops it from the engine."""
-        return self._finished.pop(request_id, None)
+        flight); pops it from the engine. Thread-safe (frontend threads
+        poll while the driver steps)."""
+        with self._lock:
+            return self._finished.pop(request_id, None)
 
     @property
     def free_slots(self) -> int:
-        free = sum(s is None for s in self._slots)
-        return free - (1 if self._reserved_slot is not None else 0)
+        with self._lock:
+            free = sum(s is None for s in self._slots)
+            return free - (1 if self._reserved_slot is not None else 0)
