@@ -35,6 +35,10 @@ def main(argv=None):
     p = argparse.ArgumentParser(description="continuous-batching server")
     p.add_argument("--config", default="tiny", choices=sorted(CONFIGS))
     p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--hf-model", default="",
+                   help="local HF checkpoint dir (Llama or GPT-2 family) "
+                        "— overrides --config/--checkpoint-dir; serves "
+                        "with bf16 weights")
     p.add_argument("--n-slots", type=int, default=8)
     p.add_argument("--max-len", type=int, default=0,
                    help="engine cache length (0 = the model's max_seq_len)")
@@ -68,6 +72,21 @@ def main(argv=None):
                         "only its own suffix")
     args = p.parse_args(argv)
 
+    if args.hf_model:
+        import transformers
+
+        from tpu_on_k8s.models.convert import from_hf_gpt2, from_hf_llama
+        hf = transformers.AutoModelForCausalLM.from_pretrained(
+            args.hf_model)
+        conv = {"llama": from_hf_llama, "gpt2": from_hf_gpt2}.get(
+            hf.config.model_type)
+        if conv is None:
+            raise SystemExit(f"unsupported HF model_type "
+                             f"{hf.config.model_type!r} (llama | gpt2)")
+        cfg, params = conv(hf, dtype=jnp.bfloat16)
+        print(f"serving HF {hf.config.model_type} from {args.hf_model} "
+              f"({sum(p.size for p in jax.tree.leaves(params)):,} params)")
+        return _serve_loop(args, cfg, params)
     cfg = CONFIGS[args.config]()
     model = Transformer(cfg)
     probe = jax.random.randint(jax.random.key(args.seed), (1, 8), 0,
@@ -89,7 +108,10 @@ def main(argv=None):
         print(f"restored generation={gen} step={step}")
     else:
         params = model.init(jax.random.key(1), probe)["params"]
+    return _serve_loop(args, cfg, params)
 
+
+def _serve_loop(args, cfg, params):
     mesh = rules = None
     if args.model_axis > 1 or args.fsdp > 1:
         mesh = create_mesh(MeshConfig(
@@ -125,11 +147,11 @@ def main(argv=None):
             0, cfg.vocab_size, size=args.system_prompt_len).astype(np.int32))
         print(f"registered a {args.system_prompt_len}-token shared prefix "
               f"(id {prefix_id})")
-    submitted = 0
+    submitted = claimed = 0
     t0 = time.perf_counter()
     finished = {}
     # the serving loop a frontend would run: submit arrivals, step, collect
-    while submitted < args.n_requests or len(finished) < submitted:
+    while submitted < args.n_requests or len(finished) + claimed < submitted:
         if submitted < args.n_requests:
             for _ in range(rng.poisson(args.arrival)):
                 if submitted >= args.n_requests:
@@ -144,6 +166,7 @@ def main(argv=None):
         for rid in eng.step():
             toks = eng.result(rid)
             if toks is None:     # claimed by another consumer (see step())
+                claimed += 1
                 continue
             finished[rid] = toks
             print(f"← r{rid} done: {toks.tolist()}")

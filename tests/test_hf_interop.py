@@ -96,6 +96,18 @@ def test_config_validation():
         config_from_hf_llama(hf.config)
 
 
+def test_serve_example_loads_hf_checkpoint(tmp_path):
+    """examples/serve.py --hf-model serves a saved HF checkpoint dir."""
+    from examples.serve import main
+
+    _tiny_hf().save_pretrained(tmp_path)
+    out = main(["--hf-model", str(tmp_path), "--n-requests", "2",
+                "--n-slots", "2", "--max-new-tokens", "3", "--arrival",
+                "2", "--prompt-max", "10"])
+    assert len(out) == 2
+    assert all(len(v) == 3 for v in out.values())
+
+
 class TestExport:
     """to_hf_llama: the round trip back into transformers."""
 

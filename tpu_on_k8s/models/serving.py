@@ -263,6 +263,9 @@ class ContinuousBatchingEngine:
         self._finished: Dict[int, np.ndarray] = {}
         self._prefilling: Optional[_Prefilling] = None
         self._reserved_slot: Optional[int] = None
+        self._admitting: set = set()   # slots mid-admission (popped from
+                                       # the queue, prefill in flight) —
+                                       # free_slots must not count them
         self.stats = {"steps": 0, "emitted": 0, "admitted": 0}
         # Threading model: ONE driver thread calls step()/run(); submit()
         # and result() may be called concurrently from request-handler
@@ -395,7 +398,13 @@ class ContinuousBatchingEngine:
     def _admit_pending(self) -> None:
         if self._prefilling is not None:
             self._advance_prefill()       # one chunk per engine step
-        while self._queue:
+        with self._lock:
+            # bound this pass to the arrivals present at entry: under
+            # concurrent submitters an unbounded while-queue loop could
+            # admit-and-retire forever (instant-eos floods) and starve
+            # the decode section below
+            budget = len(self._queue)
+        while budget > 0:
             # selection runs under the lock (frontend threads append to
             # the queue concurrently — iterating/popping must not race
             # them); device work happens after release
@@ -404,7 +413,8 @@ class ContinuousBatchingEngine:
                     return
                 free = [i for i in range(self.n_slots)
                         if self._slots[i] is None
-                        and i != self._reserved_slot]
+                        and i != self._reserved_slot
+                        and i not in self._admitting]
                 if not free:
                     return
                 req = self._queue[0]
@@ -420,6 +430,8 @@ class ContinuousBatchingEngine:
                         # reserve under the lock: free_slots must never
                         # overcount while the chunked prefill is staged
                         self._reserved_slot = free[0]
+                    else:
+                        self._admitting.add(free[0])
                     group = [req]
                 else:
                     # plain requests: batch the front FIFO run sharing
@@ -446,7 +458,9 @@ class ContinuousBatchingEngine:
                     group = group[:b]
                     for _ in group:
                         self._queue.popleft()
+                    self._admitting.update(free[:len(group)])
                 depth = len(self._queue)
+            budget -= len(group)
             if chunked:
                 if self.metrics is not None:
                     self.metrics.set_gauge("queue_depth", depth)
@@ -457,35 +471,44 @@ class ContinuousBatchingEngine:
                     plen + int(req.prompt.size), time.monotonic())
                 self._advance_prefill()
                 continue
-            if prefix_cache is not None:
+            try:
+                if prefix_cache is not None:
+                    dequeued_at = time.monotonic()
+                    slen = int(req.prompt.size)
+                    self._rng, key = jax.random.split(self._rng)
+                    # the suffix bucket may not spill past max_len:
+                    # appends land at plen..plen+bucket-1
+                    # (dynamic_update_slice would clamp a spilling start
+                    # and corrupt earlier rows)
+                    bucket = _bucket_len(slen, self.max_len - plen)
+                    padded = np.zeros((1, bucket), np.int32)
+                    padded[0, :slen] = req.prompt
+                    pre_cache, first = self._suffix_prefill_fn(bucket)(
+                        self._params, prefix_cache, jnp.asarray(padded),
+                        jnp.int32(plen), jnp.int32(slen), key)
+                    self._finish_admission(free[0], req, pre_cache, first,
+                                           plen + slen, dequeued_at)
+                    continue
+                b = len(group)
                 dequeued_at = time.monotonic()
-                slen = int(req.prompt.size)
+                lps = np.asarray([r.prompt.size for r in group], np.int32)
+                padded = np.zeros((b, bucket), np.int32)
+                for j, r in enumerate(group):
+                    padded[j, :r.prompt.size] = r.prompt
                 self._rng, key = jax.random.split(self._rng)
-                # the suffix bucket may not spill past max_len: appends
-                # land at plen..plen+bucket-1 (dynamic_update_slice would
-                # clamp a spilling start and corrupt earlier rows)
-                bucket = _bucket_len(slen, self.max_len - plen)
-                padded = np.zeros((1, bucket), np.int32)
-                padded[0, :slen] = req.prompt
-                pre_cache, first = self._suffix_prefill_fn(bucket)(
-                    self._params, prefix_cache, jnp.asarray(padded),
-                    jnp.int32(plen), jnp.int32(slen), key)
-                self._finish_admission(free[0], req, pre_cache, first,
-                                       plen + slen, dequeued_at)
-                continue
-            b = len(group)
-            dequeued_at = time.monotonic()
-            lps = np.asarray([r.prompt.size for r in group], np.int32)
-            padded = np.zeros((b, bucket), np.int32)
-            for j, r in enumerate(group):
-                padded[j, :r.prompt.size] = r.prompt
-            self._rng, key = jax.random.split(self._rng)
-            pre_cache, firsts = self._prefill_fn(bucket, b)(
-                self._params, jnp.asarray(padded), jnp.asarray(lps), key)
-            firsts = np.asarray(firsts)
-            for j, (r, i) in enumerate(zip(group, free)):
-                self._finish_admission(i, r, pre_cache, firsts[j],
-                                       int(lps[j]), dequeued_at, row=j)
+                pre_cache, firsts = self._prefill_fn(bucket, b)(
+                    self._params, jnp.asarray(padded), jnp.asarray(lps),
+                    key)
+                firsts = np.asarray(firsts)
+                for j, (r, i) in enumerate(zip(group, free)):
+                    self._finish_admission(i, r, pre_cache, firsts[j],
+                                           int(lps[j]), dequeued_at,
+                                           row=j)
+            finally:
+                # a failing prefill must not leak reservations (success
+                # clears each slot in _finish_admission)
+                with self._lock:
+                    self._admitting.difference_update(free)
 
     def _advance_prefill(self) -> None:
         """One chunk of the in-flight chunked prefill: append this chunk's
@@ -529,6 +552,7 @@ class ContinuousBatchingEngine:
             self._slots[i] = _Slot(req.request_id, lp, first, [first],
                                    req.max_new_tokens, req.eos_id,
                                    req.submitted_at, req.on_token)
+            self._admitting.discard(i)
         self._fire_on_token(self._slots[i], first)
         self.stats["admitted"] += 1
         self.stats["emitted"] += 1
@@ -643,4 +667,5 @@ class ContinuousBatchingEngine:
     def free_slots(self) -> int:
         with self._lock:
             free = sum(s is None for s in self._slots)
-            return free - (1 if self._reserved_slot is not None else 0)
+            return (free - len(self._admitting)
+                    - (1 if self._reserved_slot is not None else 0))
