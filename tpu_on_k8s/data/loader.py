@@ -46,7 +46,7 @@ def _build_lib() -> Optional[ctypes.CDLL]:
     lib.tk_num_records.argtypes = [ctypes.c_void_p]
     lib.tk_close.argtypes = [ctypes.c_void_p]
     lib.tk_loader_start.restype = ctypes.c_void_p
-    lib.tk_loader_start.argtypes = [ctypes.c_void_p] + [ctypes.c_int64] * 4 + \
+    lib.tk_loader_start.argtypes = [ctypes.c_void_p] + [ctypes.c_int64] * 5 + \
         [ctypes.c_int32] * 3
     lib.tk_batches_per_epoch.restype = ctypes.c_int64
     lib.tk_batches_per_epoch.argtypes = [ctypes.c_void_p]
@@ -151,7 +151,10 @@ class DataLoader:
     def __init__(self, dataset: FixedRecordDataset, batch_size: int,
                  shard_id: int = 0, num_shards: int = 1, seed: int = 0,
                  shuffle: bool = True, num_workers: int = 2,
-                 prefetch: int = 4, force_python: bool = False):
+                 prefetch: int = 4, force_python: bool = False,
+                 start_batch: int = 0):
+        if start_batch < 0:
+            raise ValueError(f"start_batch must be >= 0, got {start_batch}")
         self.ds = dataset
         self.batch_size = batch_size
         self.shard_id = shard_id
@@ -163,7 +166,10 @@ class DataLoader:
             raise ValueError(
                 f"shard has {self.per_shard} records < batch {batch_size}")
         self.batches_per_epoch = self.per_shard // batch_size
-        self._ticket = 0
+        # tickets are absolute (epoch = ticket // batches_per_epoch), so a
+        # checkpointed position resumes the exact stream in O(1) — the
+        # data loop replays nothing and skips nothing after preemption
+        self._ticket = start_batch
         self._native = None
         self._handle = None
         lib = None if force_python else _get_lib()
@@ -172,7 +178,8 @@ class DataLoader:
             if handle:
                 loader = lib.tk_loader_start(
                     handle, batch_size, shard_id, num_shards, seed,
-                    1 if shuffle else 0, num_workers, prefetch)
+                    start_batch, 1 if shuffle else 0, num_workers,
+                    prefetch)
                 if loader:
                     self._native = lib
                     self._handle = handle
@@ -215,6 +222,30 @@ class DataLoader:
             out = self._next_python()
         self._ticket += 1
         return out
+
+    def state(self) -> dict:
+        """Checkpointable position + stream identity; restore with
+        ``DataLoader.resume(dataset, state)`` (which validates the
+        identity so a mismatched restore fails loudly)."""
+        return {"ticket": self._ticket, "seed": self.seed,
+                "shard_id": self.shard_id, "num_shards": self.num_shards,
+                "batch_size": self.batch_size}
+
+    @classmethod
+    def resume(cls, dataset: FixedRecordDataset, state: dict,
+               **kwargs) -> "DataLoader":
+        """A loader continuing the exact stream a ``state()`` snapshot
+        recorded. The identity fields (seed/shard/batch size) come FROM
+        the state; overriding them with different values raises — a
+        silent mismatch would resume a different permutation and corrupt
+        the training stream."""
+        for k in ("seed", "shard_id", "num_shards", "batch_size"):
+            if k in kwargs and kwargs[k] != state[k]:
+                raise ValueError(
+                    f"resume {k}={kwargs[k]} contradicts the checkpointed "
+                    f"{k}={state[k]}")
+            kwargs[k] = state[k]
+        return cls(dataset, start_batch=state["ticket"], **kwargs)
 
     def close(self) -> None:
         if self._native is not None:

@@ -182,12 +182,17 @@ void tk_close(void* handle) {
   delete ds;
 }
 
+// start_ticket resumes the deterministic stream mid-run in O(1): tickets
+// are absolute (epoch = ticket / batches_per_epoch), so a checkpointed
+// consumer position replays nothing and skips nothing.
 void* tk_loader_start(void* dataset, int64_t batch_size, int64_t shard,
-                      int64_t num_shards, int64_t seed, int32_t shuffle,
+                      int64_t num_shards, int64_t seed,
+                      int64_t start_ticket, int32_t shuffle,
                       int32_t num_workers, int32_t prefetch) {
   auto* ds = static_cast<Dataset*>(dataset);
   const int64_t per_shard = ds->n_records / num_shards;
-  if (per_shard < batch_size || batch_size <= 0) return nullptr;
+  if (per_shard < batch_size || batch_size <= 0 || start_ticket < 0)
+    return nullptr;
   auto* ld = new Loader();
   ld->ds = ds;
   ld->batch_size = batch_size;
@@ -197,6 +202,8 @@ void* tk_loader_start(void* dataset, int64_t batch_size, int64_t shard,
   ld->shuffle = shuffle != 0;
   ld->per_shard = per_shard;
   ld->batches_per_epoch = per_shard / batch_size;
+  ld->next_ticket = start_ticket;
+  ld->consumer_pos = start_ticket;
   ld->slots.resize(static_cast<size_t>(prefetch > 0 ? prefetch : 2));
   for (int32_t w = 0; w < (num_workers > 0 ? num_workers : 1); w++) {
     ld->workers.emplace_back([ld] { ld->worker_loop(); });
