@@ -133,6 +133,77 @@ def test_resume_from_checkpointed_position(dataset_path, force_python):
     # a different permutation
     with pytest.raises(ValueError, match="contradicts"):
         DataLoader.resume(ds, st, batch_size=64)
+    with pytest.raises(ValueError, match="contradicts"):
+        DataLoader.resume(ds, st, shuffle=False)
+    grown = dict(st, n_records=st["n_records"] + 8)  # re-packed corpus
+    with pytest.raises(ValueError, match="records"):
+        DataLoader.resume(ds, grown)
+
+
+class TestPacking:
+    """Ragged documents → fixed training windows (corpus-prep utils)."""
+
+    @staticmethod
+    def _docs(rng, n=40):
+        return [rng.integers(1, 100, size=int(rng.integers(1, 30)))
+                  .astype(np.int32) for _ in range(n)]
+
+    def test_stream_packing_preserves_every_token(self):
+        from tpu_on_k8s.data import pack_stream
+
+        rng = np.random.default_rng(0)
+        docs = self._docs(rng)
+        win = pack_stream(docs, seq_len=33, eos_id=0)
+        assert win.shape[1] == 33 and win.dtype == np.int32
+        # the windows ARE the joined stream, in order, minus the tail
+        stream = np.concatenate([np.concatenate([d, [0]]) for d in docs])
+        np.testing.assert_array_equal(win.reshape(-1),
+                                      stream[:win.size])
+        # zero waste: every slot is a corpus token or a separator
+        assert win.size == (stream.size // 33) * 33
+
+    def test_greedy_packing_never_splits_documents(self):
+        from tpu_on_k8s.data import pack_greedy
+
+        rng = np.random.default_rng(1)
+        docs = self._docs(rng)
+        win, mask = pack_greedy(docs, seq_len=64, eos_id=0)
+        assert win.shape == mask.shape and win.shape[1] == 64
+        # every document appears contiguously (EOS-terminated) in some row
+        rows = ["," + ",".join(map(str, r[m.astype(bool)])) + ","
+                for r, m in zip(win, mask)]
+        for d in docs:
+            needle = "," + ",".join(map(str, d.tolist())) + ",0,"
+            assert any(needle in r for r in rows), d
+        # masked-out tail is padding only
+        assert (win[mask == 0] == 0).all()
+
+    def test_greedy_rejects_oversized_doc(self):
+        from tpu_on_k8s.data import pack_greedy
+
+        with pytest.raises(ValueError, match="cannot fit"):
+            pack_greedy([np.arange(64, dtype=np.int32)], seq_len=64,
+                        eos_id=0)
+
+    def test_packed_corpus_feeds_the_loader(self, tmp_path):
+        """The whole corpus-prep path: ragged docs → stream packing →
+        write_records → the (native when available) loader."""
+        from tpu_on_k8s.data import pack_stream
+
+        rng = np.random.default_rng(2)
+        win = pack_stream(self._docs(rng, n=200), seq_len=17, eos_id=0)
+        path = tmp_path / "packed.bin"
+        write_records(str(path), win)
+        ds = FixedRecordDataset(str(path), (17,), np.int32)
+        ld = DataLoader(ds, batch_size=8, seed=1)
+        batches = [next(ld) for _ in range(3)]
+        ld.close()
+        assert all(b.shape == (8, 17) for b in batches)
+        # batches are real corpus windows, not garbage
+        as_set = {tuple(r) for r in win.tolist()}
+        for b in batches:
+            for row in b.tolist():
+                assert tuple(row) in as_set
 
 
 def test_bench_data_fed_training_loop(tmp_path):

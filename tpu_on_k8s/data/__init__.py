@@ -6,3 +6,4 @@ from tpu_on_k8s.data.loader import (  # noqa: F401
     native_available,
     write_records,
 )
+from tpu_on_k8s.data.packing import pack_greedy, pack_stream  # noqa: F401

@@ -229,7 +229,8 @@ class DataLoader:
         identity so a mismatched restore fails loudly)."""
         return {"ticket": self._ticket, "seed": self.seed,
                 "shard_id": self.shard_id, "num_shards": self.num_shards,
-                "batch_size": self.batch_size}
+                "batch_size": self.batch_size, "shuffle": self.shuffle,
+                "n_records": self.ds.n_records}
 
     @classmethod
     def resume(cls, dataset: FixedRecordDataset, state: dict,
@@ -239,7 +240,15 @@ class DataLoader:
         the state; overriding them with different values raises — a
         silent mismatch would resume a different permutation and corrupt
         the training stream."""
-        for k in ("seed", "shard_id", "num_shards", "batch_size"):
+        if ("n_records" in state
+                and dataset.n_records != state["n_records"]):
+            # a re-packed/grown corpus changes the permutation domain —
+            # every batch from the ticket on would silently differ
+            raise ValueError(
+                f"dataset has {dataset.n_records} records but the "
+                f"checkpoint recorded {state['n_records']}")
+        for k in ("seed", "shard_id", "num_shards", "batch_size",
+                  "shuffle"):
             if k in kwargs and kwargs[k] != state[k]:
                 raise ValueError(
                     f"resume {k}={kwargs[k]} contradicts the checkpointed "
