@@ -174,23 +174,68 @@ def make_sharded_init(model: Any, optimizer: optax.GradientTransformation,
     return jax.jit(init, out_shardings=shardings)
 
 
-def _make_loss_fn(model: Any, aux_loss_weight: float, loss_chunks: int):
+def packed_positions_and_segments(tokens: jnp.ndarray, eos_id: int
+                                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(positions, segments) for stream-packed windows (EOS-separated
+    documents, `tpu_on_k8s/data/packing.py::pack_stream`).
+
+    A token's segment = number of EOS separators strictly before it (the
+    EOS closes its own document), and its position RESTARTS at each
+    segment — with the block-diagonal attention mask this makes packed
+    training numerically identical to running each document alone
+    (positions and visible context both match the standalone run)."""
+    eq = (tokens == eos_id).astype(jnp.int32)
+    segments = jnp.cumsum(eq, axis=1) - eq
+    idx = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+    # start of a token's segment = (last EOS index before it) + 1, via an
+    # exclusive running max of (i+1)·[token_i is EOS]
+    marks = (idx + 1) * eq
+    cmax = jax.lax.cummax(marks, axis=1)
+    starts = jnp.concatenate(
+        [jnp.zeros_like(cmax[:, :1]), cmax[:, :-1]], axis=1)
+    return idx - starts, segments
+
+
+def packed_loss_mask(tokens: jnp.ndarray, eos_id: int) -> jnp.ndarray:
+    """[B, L] mask over the shifted next-token targets of a stream-packed
+    ``tokens [B, L+1]``: a position counts only when its input and target
+    share a segment. Cross-document boundaries (an EOS "predicting" the
+    first token of an unrelated shuffled document) are unlearnable noise,
+    and EOS-padded tails (``pack_greedy``) pair consecutive EOS tokens in
+    DIFFERENT segments — both mask to zero, so padding-heavy windows no
+    longer report systematically lower loss."""
+    _, seg = packed_positions_and_segments(tokens, eos_id)
+    return (seg[:, :-1] == seg[:, 1:]).astype(jnp.float32)
+
+
+def _make_loss_fn(model: Any, aux_loss_weight: float, loss_chunks: int,
+                  segment_eos: Optional[int] = None):
     """(params, tokens [B, L+1]) → (objective, aux) — shared by the train
-    and eval steps so the two can never compute different losses."""
+    and eval steps so the two can never compute different losses.
+    ``segment_eos``: treat batches as stream-packed windows (per-document
+    attention isolation + restarted positions)."""
 
     def loss_fn(params: Any, tokens: jnp.ndarray):
+        inputs = tokens[:, :-1]
+        positions = segments = loss_mask = None
+        if segment_eos is not None:
+            positions, segments = packed_positions_and_segments(
+                inputs, segment_eos)
+            loss_mask = packed_loss_mask(tokens, segment_eos)
         mutable = ["losses"] if aux_loss_weight else False
         if loss_chunks:
-            out = model.apply({"params": params}, tokens[:, :-1],
-                              method="features", mutable=mutable)
+            out = model.apply({"params": params}, inputs, positions,
+                              segments, method="features",
+                              mutable=mutable)
             (feats, head), losses = out if aux_loss_weight else (out, {})
             ce = chunked_cross_entropy(feats, head, tokens[:, 1:],
-                                       loss_chunks)
+                                       loss_chunks, mask=loss_mask)
         else:
-            out = model.apply({"params": params}, tokens[:, :-1],
-                              mutable=mutable)
+            out = model.apply({"params": params}, inputs, positions,
+                              segments, mutable=mutable)
             logits, losses = out if aux_loss_weight else (out, {})
-            ce = cross_entropy_loss(logits, tokens[:, 1:])
+            ce = cross_entropy_loss(logits, tokens[:, 1:],
+                                    mask=loss_mask)
         aux = (sum(jnp.sum(leaf)
                    for leaf in jax.tree.leaves(dict(losses).get("losses", {})))
                if aux_loss_weight else jnp.zeros((), jnp.float32))
@@ -200,11 +245,13 @@ def _make_loss_fn(model: Any, aux_loss_weight: float, loss_chunks: int):
 
 
 def make_eval_step(model: Any, aux_loss_weight: float = 0.0,
-                   loss_chunks: int = 0) -> Callable[[Any, jnp.ndarray], dict]:
+                   loss_chunks: int = 0, segment_eos: Optional[int] = None
+                   ) -> Callable[[Any, jnp.ndarray], dict]:
     """Forward-only evaluation on a [B, L+1] token batch: the same
     objective as ``make_train_step`` (shared loss fn), no gradients, no
     state mutation. Returns {"loss", "perplexity", "aux_loss"}."""
-    loss_fn = _make_loss_fn(model, aux_loss_weight, loss_chunks)
+    loss_fn = _make_loss_fn(model, aux_loss_weight, loss_chunks,
+                            segment_eos)
 
     def step(params: Any, tokens: jnp.ndarray) -> dict:
         loss, aux = loss_fn(params, tokens)
@@ -220,6 +267,7 @@ def make_eval_step(model: Any, aux_loss_weight: float = 0.0,
 def make_train_step(model: Any, optimizer: optax.GradientTransformation,
                     aux_loss_weight: float = 0.0, loss_chunks: int = 0,
                     grad_accum: int = 1,
+                    segment_eos: Optional[int] = None,
                     ) -> Callable[[TrainState, jnp.ndarray], Tuple[TrainState, dict]]:
     """One language-model train step on a [B, L] token batch (next-token CE,
     internal shift). Donates the state buffers. jit shardings propagate from
@@ -236,7 +284,8 @@ def make_train_step(model: Any, optimizer: optax.GradientTransformation,
     mean, so the objective is unchanged up to summation order).
     """
 
-    loss_fn = _make_loss_fn(model, aux_loss_weight, loss_chunks)
+    loss_fn = _make_loss_fn(model, aux_loss_weight, loss_chunks,
+                            segment_eos)
 
     def grads_and_loss(params: Any, tokens: jnp.ndarray):
         if grad_accum <= 1:
@@ -291,16 +340,17 @@ class Trainer:
                  mesh: Mesh,
                  optimizer: Optional[optax.GradientTransformation] = None,
                  aux_loss_weight: float = 0.0, loss_chunks: int = 0,
-                 grad_accum: int = 1):
+                 grad_accum: int = 1,
+                 segment_eos: Optional[int] = None):
         self.model = model
         self.rules = list(rules)
         self.mesh = mesh
         self.optimizer = optimizer or default_optimizer()
         self._step = make_train_step(self.model, self.optimizer,
                                      aux_loss_weight, loss_chunks,
-                                     grad_accum)
+                                     grad_accum, segment_eos)
         self._eval = make_eval_step(self.model, aux_loss_weight,
-                                    loss_chunks)
+                                    loss_chunks, segment_eos)
         self._init_cache = {}
 
     def init_state(self, rng: jax.Array, example_tokens: jnp.ndarray) -> TrainState:
