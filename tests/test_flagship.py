@@ -171,6 +171,41 @@ class TestPackedSegments:
             state, metrics = tr.train_step(state, jnp.asarray(batch))
             assert np.isfinite(float(metrics["loss"])), attn
 
+    def test_grad_accum_weighted_by_counted_targets(self):
+        """Packed loss + grad_accum: microbatch means are weighted by
+        their counted-target totals, so a padding-heavy microbatch does
+        not skew the objective — accum=2 equals the full batch exactly."""
+        import dataclasses
+
+        from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+        from tpu_on_k8s.train.trainer import Trainer, default_optimizer
+
+        cfg = dataclasses.replace(TransformerConfig.tiny(),
+                                  dtype=jnp.float32, remat=False)
+        rng = np.random.default_rng(6)
+        dense = rng.integers(1, cfg.vocab_size, size=(2, 17)) \
+                   .astype(np.int32)          # no eos: all targets count
+        padded = np.zeros((2, 17), np.int32)  # eos-heavy: few count
+        padded[:, :4] = rng.integers(1, cfg.vocab_size, size=(2, 4))
+        batch = np.concatenate([dense, padded])   # micro 1 dense, 2 padded
+        mesh = create_mesh(MeshConfig(data=1, fsdp=1, model=1, seq=1),
+                           jax.devices()[:1])
+        results = []
+        for accum in (1, 2):
+            tr = Trainer(Transformer(cfg), flagship_partition_rules(),
+                         mesh,
+                         default_optimizer(warmup_steps=1, decay_steps=10),
+                         grad_accum=accum, segment_eos=0)
+            state = tr.init_state(jax.random.key(0),
+                                  jnp.asarray(batch[:, :-1]))
+            state, metrics = tr.train_step(state, jnp.asarray(batch))
+            results.append((float(metrics["loss"]),
+                            jax.tree.map(np.asarray, state.params)))
+        assert abs(results[0][0] - results[1][0]) < 1e-5
+        for a, b in zip(jax.tree.leaves(results[0][1]),
+                        jax.tree.leaves(results[1][1])):
+            np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
     def test_loss_mask_drops_boundaries_and_pad_tails(self):
         """Cross-document boundary targets and EOS-padded tails are
         excluded from the packed objective; within-document targets

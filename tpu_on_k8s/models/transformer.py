@@ -494,12 +494,10 @@ class Attention(nn.Module):
         if cfg.pos_emb == "rope":
             q = rope_bhld(q, positions, cfg.rope_theta)
             k = rope_bhld(k, positions, cfg.rope_theta)
-        # packed windows on the flash path: until the kernel carries a
-        # segment operand, the exact XLA mask serves (block-diagonal ∧
-        # causal) — it IS the else branch below
-        if cfg.attn_impl == "flash" and segments is None:
+        if cfg.attn_impl == "flash":
             from tpu_on_k8s.ops.flash_attention import (
                 _flash,
+                _flash_seg,
                 auto_block,
                 padded_len,
             )
@@ -515,6 +513,10 @@ class Attention(nn.Module):
                 q = jnp.pad(q, pad)
                 k = jnp.pad(k, pad)
                 v = jnp.pad(v, pad)
+                if segments is not None:
+                    # pad rows get a sentinel segment; outputs sliced off
+                    segments = jnp.pad(segments, [(0, 0), (0, lp - l)],
+                                       constant_values=-1)
             bq = cfg.attn_block_q or auto_block(lp)
             bk = cfg.attn_block_k or auto_block(lp)
             if not cfg.attn_native_gqa:
@@ -522,7 +524,14 @@ class Attention(nn.Module):
                 k = jnp.repeat(k, rep, axis=1)
                 v = jnp.repeat(v, rep, axis=1)
             # else: the kernel's index maps route q-head → kv group natively
-            out = _flash(q, k, v, True, bq, bk, l if lp != l else 0)
+            valid = l if lp != l else 0
+            if segments is not None:
+                # packed windows stay on the kernel: segments ride as an
+                # int operand and mask in-VMEM (block-diagonal ∧ causal)
+                out = _flash_seg(q, k, v, segments.astype(jnp.int32),
+                                 True, bq, bk, valid)
+            else:
+                out = _flash(q, k, v, True, bq, bk, valid)
             if lp != l:
                 out = out[:, :, :l]
         else:

@@ -101,25 +101,40 @@ def _causal_steps(i, bq: int, bk: int, nk: int, causal: bool):
 # forward
 # ---------------------------------------------------------------------------
 
-def _mask_scores(s, qi, kj, bq: int, bk: int, causal: bool, valid: int):
-    """Apply the causal and/or key-validity (tail padding) masks to a score
-    block. ``valid`` = 0 means every key is real (the unpadded fast path —
-    no extra work is emitted)."""
-    if not causal and not valid:
+def _mask_scores(s, qi, kj, bq: int, bk: int, causal: bool, valid: int,
+                 seg_q=None, seg_k=None):
+    """Apply the causal / key-validity (tail padding) / segment masks to a
+    score block. ``valid`` = 0 means every key is real; ``seg_q [bq]`` /
+    ``seg_k [bk]`` (packed windows) keep only same-segment pairs — the
+    block-diagonal ∧ causal mask that stops documents packed into one
+    training window from attending across boundaries. The unmasked fast
+    path emits no extra work."""
+    if not causal and not valid and seg_q is None:
         return s
-    k_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    if causal:
-        q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        keep = q_pos >= k_pos
-        if valid:
-            keep = jnp.logical_and(keep, k_pos < valid)
-    else:
-        keep = k_pos < valid
+    keep = None
+    if causal or valid:
+        k_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (bq, bk), 0)
+            keep = q_pos >= k_pos
+            if valid:
+                keep = jnp.logical_and(keep, k_pos < valid)
+        else:
+            keep = k_pos < valid
+    if seg_q is not None:
+        eq = seg_q[:, None] == seg_k[None, :]
+        keep = eq if keep is None else jnp.logical_and(keep, eq)
     return jnp.where(keep, s, NEG_INF)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
-                block_q: int, block_k: int, causal: bool, valid: int):
+def _fwd_kernel(*refs, scale: float, block_q: int, block_k: int,
+                causal: bool, valid: int, segmented: bool):
+    if segmented:
+        q_ref, k_ref, v_ref, seg_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        seg_ref = None
     i = pl.program_id(2)
     # Dots take bf16 inputs with fp32 accumulation (preferred_element_type):
     # casting inputs to fp32 first would run the MXU in its slow fp32 mode.
@@ -127,15 +142,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     bq, d = q.shape
     nk = k_ref.shape[2] // block_k
     steps = _causal_steps(i, bq, block_k, nk, causal)
+    seg_q = (seg_ref[0, pl.ds(i * bq, bq)] if segmented else None)
 
     def body(j, carry):
         acc, m, l = carry
         k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
         v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        seg_k = (seg_ref[0, pl.ds(j * block_k, block_k)] if segmented
+                 else None)
         s = scale * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk] fp32
-        s = _mask_scores(s, i, j, bq, block_k, causal, valid)
+        s = _mask_scores(s, i, j, bq, block_k, causal, valid, seg_q, seg_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))        # [bq]
         p = jnp.exp(s - m_new[:, None])                    # [bq, bk] fp32
         correction = jnp.exp(m - m_new)                    # [bq]
@@ -154,13 +172,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
 
 
 def _fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool,
-         block_q: int, block_k: int,
-         valid_len: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+         block_q: int, block_k: int, valid_len: int = 0,
+         segments=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """q: [B, H, L, D]; k/v: [B, Hkv, L, D] with H % Hkv == 0 (GQA is native:
     the index maps route q-head h to kv-head h // rep — no repeated K/V ever
     materialises in HBM) → (out [B, H, L, D], lse [B, H, L]).
     ``valid_len`` > 0 marks trailing positions ≥ it as padding (keys are
-    masked; the caller slices padded query rows off)."""
+    masked; the caller slices padded query rows off). ``segments [B, L]``
+    int32 restricts attention to same-segment pairs (packed windows)."""
     b, h, l, d = q.shape
     if h % k.shape[1]:
         raise ValueError(
@@ -171,17 +190,24 @@ def _fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool,
     bk = _block(block_k, l)
     grid = (b, h, l // bq)
     kernel = functools.partial(_fwd_kernel, scale=d ** -0.5, block_q=bq,
-                               block_k=bk, causal=causal, valid=valid_len)
+                               block_k=bk, causal=causal, valid=valid_len,
+                               segmented=segments is not None)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, l, d),
+                     lambda b_, h_, i: (b_, h_ // rep, 0, 0)),
+        pl.BlockSpec((1, 1, l, d),
+                     lambda b_, h_, i: (b_, h_ // rep, 0, 0)),
+    ]
+    operands = [q, k, v]
+    if segments is not None:
+        # [B, L] int32, broadcast over heads by the index map
+        in_specs.append(pl.BlockSpec((1, l), lambda b_, h_, i: (b_, 0)))
+        operands.append(segments.astype(jnp.int32))
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, l, d),
-                         lambda b_, h_, i: (b_, h_ // rep, 0, 0)),
-            pl.BlockSpec((1, 1, l, d),
-                         lambda b_, h_, i: (b_, h_ // rep, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
             # [B, H, 1, L]: the singleton dim -2 satisfies Mosaic's block
@@ -193,16 +219,20 @@ def _fwd(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool,
             jax.ShapeDtypeStruct((b, h, 1, l), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*operands)
 
 
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale: float, block_q: int, block_k: int, causal: bool,
-               valid: int):
+def _dq_kernel(*refs, scale: float, block_q: int, block_k: int,
+               causal: bool, valid: int, segmented: bool):
+    if segmented:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_ref, dq_ref = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
+        seg_ref = None
     i = pl.program_id(2)
     q = q_ref[0, 0]                                        # [bq, D] bf16
     do = do_ref[0, 0]
@@ -211,13 +241,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     bq, d = q.shape
     nk = k_ref.shape[2] // block_k
     steps = _causal_steps(i, bq, block_k, nk, causal)
+    seg_q = (seg_ref[0, pl.ds(i * bq, bq)] if segmented else None)
 
     def body(j, dq):
         k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
         v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        seg_k = (seg_ref[0, pl.ds(j * block_k, block_k)] if segmented
+                 else None)
         s = scale * jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                         preferred_element_type=jnp.float32)
-        s = _mask_scores(s, i, j, bq, block_k, causal, valid)
+        s = _mask_scores(s, i, j, bq, block_k, causal, valid, seg_q, seg_k)
         p = jnp.exp(s - lse[:, None])                      # [bq, bk] fp32
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -229,13 +262,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale: float, block_q: int, block_k: int,
-                causal: bool, valid: int):
+def _dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
+                causal: bool, valid: int, segmented: bool):
     """Grid (B, Hkv, L/bk, rep): the innermost ``rep`` dim iterates the
     q-heads sharing this kv-head while the dk/dv output block stays resident
     (consecutive revisits — the Pallas-legal accumulation pattern), so GQA
     gradients sum in-kernel and no repeated K/V ever exists in HBM."""
+    if segmented:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seg_ref,
+         dk_ref, dv_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref = refs
+        seg_ref = None
     j = pl.program_id(2)
     r = pl.program_id(3)
     k_blk = k_ref[0, 0]                                    # [bk, D] bf16
@@ -244,6 +282,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     nq = q_ref.shape[2] // block_q
     # first Q block that attends into this K block: floor(j*bk / bq)
     start = (j * bk) // block_q if causal else 0
+    seg_k = (seg_ref[0, pl.ds(j * bk, bk)] if segmented else None)
 
     def body(i, carry):
         dk, dv = carry
@@ -251,11 +290,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, 0, 0, pl.ds(i * block_q, block_q)]
         delta = delta_ref[0, 0, 0, pl.ds(i * block_q, block_q)]
+        seg_q = (seg_ref[0, pl.ds(i * block_q, block_q)] if segmented
+                 else None)
         s = scale * jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                         preferred_element_type=jnp.float32)
         # note the transposed block orientation: rows are q, cols are k, so
         # qi=i (q-block index) and kj=j (k-block index) as in the forward
-        s = _mask_scores(s, i, j, block_q, bk, causal, valid)
+        s = _mask_scores(s, i, j, block_q, bk, causal, valid, seg_q, seg_k)
         p = jnp.exp(s - lse[:, None])                      # [bq, bk] fp32
         dv_new = dv + jax.lax.dot_general(p.astype(do.dtype), do,
                                           (((0,), (0,)), ((), ())),
@@ -282,7 +323,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(q, k, v, o, lse, do, causal: bool, block_q: int, block_k: int,
-         g_lse=None, valid_len: int = 0):
+         g_lse=None, valid_len: int = 0, segments=None):
     b, h, l, d = q.shape
     hkv = k.shape[1]
     if h % hkv:
@@ -304,16 +345,24 @@ def _bwd(q, k, v, o, lse, do, causal: bool, block_q: int, block_k: int,
         (1, 1, l, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0))
     row_qblk = lambda: pl.BlockSpec((1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i))
 
+    segmented = segments is not None
+    seg_ops = []
+    dq_specs = [qblk(), kv_full(), kv_full(), qblk(), row_qblk(),
+                row_qblk()]
+    if segmented:
+        segments = segments.astype(jnp.int32)
+        seg_ops = [segments]
+        dq_specs.append(pl.BlockSpec((1, l), lambda b_, h_, i: (b_, 0)))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=d ** -0.5, block_q=bq,
-                          block_k=bk, causal=causal, valid=valid_len),
+                          block_k=bk, causal=causal, valid=valid_len,
+                          segmented=segmented),
         grid=(b, h, l // bq),
-        in_specs=[qblk(), kv_full(), kv_full(), qblk(), row_qblk(),
-                  row_qblk()],
+        in_specs=dq_specs,
         out_specs=qblk(),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *seg_ops)
 
     # dkv grid: (B, Hkv, k-blocks, rep) — rep innermost so the dk/dv output
     # block is revisited consecutively and accumulates across the q-heads of
@@ -325,16 +374,21 @@ def _bwd(q, k, v, o, lse, do, causal: bool, block_q: int, block_k: int,
     kvblk = lambda: pl.BlockSpec(
         (1, 1, bk, d), lambda b_, hk, j, r_: (b_, hk, j, 0))
 
+    dkv_specs = [head(), kvblk(), kvblk(), head(), row_head(), row_head()]
+    if segmented:
+        dkv_specs.append(
+            pl.BlockSpec((1, l), lambda b_, hk, j, r_: (b_, 0)))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=d ** -0.5, block_q=bq,
-                          block_k=bk, causal=causal, valid=valid_len),
+                          block_k=bk, causal=causal, valid=valid_len,
+                          segmented=segmented),
         grid=(b, hkv, l // bk, rep),
-        in_specs=[head(), kvblk(), kvblk(), head(), row_head(), row_head()],
+        in_specs=dkv_specs,
         out_specs=[kvblk(), kvblk()],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *seg_ops)
     return dq, dk, dv
 
 
@@ -361,6 +415,33 @@ def _flash_bwd(causal, block_q, block_k, valid_len, residuals, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_seg(q, k, v, segments, causal: bool, block_q: int,
+               block_k: int, valid_len: int = 0):
+    """Segment-masked flash (packed windows): ``segments`` is a regular
+    int operand (arrays cannot be nondiff static args) whose cotangent is
+    the usual float0 zero."""
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, valid_len, segments)
+    return out
+
+
+def _flash_seg_fwd(q, k, v, segments, causal, block_q, block_k,
+                   valid_len=0):
+    out, lse = _fwd(q, k, v, causal, block_q, block_k, valid_len, segments)
+    return out, (q, k, v, out, lse, segments)
+
+
+def _flash_seg_bwd(causal, block_q, block_k, valid_len, residuals, g):
+    import numpy as _np
+    q, k, v, o, lse, segments = residuals
+    dq, dk, dv = _bwd(q, k, v, o, lse, g, causal, block_q, block_k,
+                      valid_len=valid_len, segments=segments)
+    return dq, dk, dv, _np.zeros(segments.shape, jax.dtypes.float0)
+
+
+_flash_seg.defvjp(_flash_seg_fwd, _flash_seg_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -390,7 +471,8 @@ flash_with_lse.defvjp(_fwl_fwd, _fwl_bwd)
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True,
                     block_q: int = 0,
-                    block_k: int = 0) -> jnp.ndarray:
+                    block_k: int = 0,
+                    segments=None) -> jnp.ndarray:
     """Flash attention on [B, L, H, D] q; k/v may carry fewer (grouped) heads
     [B, L, Hkv, D] with H % Hkv == 0 — GQA is handled natively by the kernel
     index maps, so no repeated K/V is ever materialised (pre-repeated k/v
@@ -411,13 +493,22 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if lp != l:
         pad = [(0, 0), (0, lp - l), (0, 0), (0, 0)]
         q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
+        if segments is not None:
+            # pad rows live in their own sentinel segment; their outputs
+            # are sliced off and the valid mask drops them as keys anyway
+            segments = jnp.pad(segments, [(0, 0), (0, lp - l)],
+                               constant_values=-1)
     block_q = block_q or auto_block(lp)
     block_k = block_k or auto_block(lp)
     # kernels run in [B, H, L, D]; the transpose stays on-chip (layout change).
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash(qt, kt, vt, causal, block_q, block_k,
-                 l if lp != l else 0)
+    if segments is not None:
+        out = _flash_seg(qt, kt, vt, segments, causal, block_q, block_k,
+                         l if lp != l else 0)
+    else:
+        out = _flash(qt, kt, vt, causal, block_q, block_k,
+                     l if lp != l else 0)
     out = out.transpose(0, 2, 1, 3)
     return out[:, :l] if lp != l else out

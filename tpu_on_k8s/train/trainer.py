@@ -239,7 +239,13 @@ def _make_loss_fn(model: Any, aux_loss_weight: float, loss_chunks: int,
         aux = (sum(jnp.sum(leaf)
                    for leaf in jax.tree.leaves(dict(losses).get("losses", {})))
                if aux_loss_weight else jnp.zeros((), jnp.float32))
-        return ce + aux_loss_weight * aux, aux
+        # weight = how many targets the mean covered — gradient
+        # accumulation must weight microbatch means by it or masked
+        # (packed) microbatches with few counted targets get over-weighted
+        weight = (jnp.sum(loss_mask) if loss_mask is not None
+                  else jnp.asarray(float(inputs.shape[0]
+                                         * inputs.shape[1]), jnp.float32))
+        return ce + aux_loss_weight * aux, (aux, weight)
 
     return loss_fn
 
@@ -254,7 +260,7 @@ def make_eval_step(model: Any, aux_loss_weight: float = 0.0,
                             segment_eos)
 
     def step(params: Any, tokens: jnp.ndarray) -> dict:
-        loss, aux = loss_fn(params, tokens)
+        loss, (aux, _) = loss_fn(params, tokens)
         # perplexity is exp(CROSS-ENTROPY); the objective folds the aux
         # penalty in, so back it out (loss = ce + w·aux)
         return {"loss": loss,
@@ -278,10 +284,11 @@ def make_train_step(model: Any, optimizer: optax.GradientTransformation,
     ``loss_chunks`` > 0 uses the chunked head+CE path (requires the model to
     expose ``features``; see ``chunked_cross_entropy``).
     ``grad_accum`` > 1 splits the batch into that many equal microbatches
-    under ``lax.scan``, accumulating gradients in fp32 before ONE optimizer
-    update — the effective batch grows without the activation memory
-    (microbatch means of equal size average exactly to the full-batch
-    mean, so the objective is unchanged up to summation order).
+    under ``lax.scan``, accumulating target-weighted gradient sums in fp32
+    before ONE optimizer update — the effective batch grows without the
+    activation memory, and the objective equals the full-batch mean
+    exactly (up to summation order) even when a packed loss mask leaves
+    microbatches with different counted-target counts.
     """
 
     loss_fn = _make_loss_fn(model, aux_loss_weight, loss_chunks,
@@ -289,7 +296,9 @@ def make_train_step(model: Any, optimizer: optax.GradientTransformation,
 
     def grads_and_loss(params: Any, tokens: jnp.ndarray):
         if grad_accum <= 1:
-            return jax.value_and_grad(loss_fn, has_aux=True)(params, tokens)
+            (loss, (aux, _)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, tokens)
+            return (loss, aux), grads
         b = tokens.shape[0]
         if b % grad_accum:
             raise ValueError(
@@ -297,21 +306,22 @@ def make_train_step(model: Any, optimizer: optax.GradientTransformation,
         micro = tokens.reshape(grad_accum, b // grad_accum, tokens.shape[1])
 
         def body(carry, mb):
-            gsum, lsum, asum = carry
-            (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, mb)
-            gsum = jax.tree.map(lambda s, x: s + x.astype(jnp.float32),
-                                gsum, g)
-            return (gsum, lsum + loss, asum + aux), None
+            gsum, lsum, asum, wsum = carry
+            (loss, (aux, w)), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            gsum = jax.tree.map(
+                lambda s, x: s + w * x.astype(jnp.float32), gsum, g)
+            return (gsum, lsum + w * loss, asum + w * aux, wsum + w), None
 
         zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
                              params)
-        (gsum, lsum, asum), _ = jax.lax.scan(
-            body, (zeros, jnp.zeros((), jnp.float32),
-                   jnp.zeros((), jnp.float32)), micro)
-        grads = jax.tree.map(lambda g, p: (g / grad_accum).astype(p.dtype),
+        z = jnp.zeros((), jnp.float32)
+        (gsum, lsum, asum, wsum), _ = jax.lax.scan(
+            body, (zeros, z, z, z), micro)
+        wsum = jnp.maximum(wsum, 1.0)
+        grads = jax.tree.map(lambda g, p: (g / wsum).astype(p.dtype),
                              gsum, params)
-        return (lsum / grad_accum, asum / grad_accum), grads
+        return (lsum / wsum, asum / wsum), grads
 
     def step(state: TrainState, tokens: jnp.ndarray) -> Tuple[TrainState, dict]:
         (loss, aux), grads = grads_and_loss(state.params, tokens)
