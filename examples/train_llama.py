@@ -53,6 +53,16 @@ def main(argv=None) -> float:
                         "recipe); off = fp32 moments, the historical "
                         "default, so optimizer numerics never change "
                         "implicitly")
+    p.add_argument("--data", default="",
+                   help="train from a packed corpus file (fixed [seq+1] "
+                        "int32 records, data.write_records/pack_stream) "
+                        "via the native loader instead of synthetic "
+                        "tokens; sharded per host, stream-resumable")
+    p.add_argument("--segment-eos", type=int, default=-1,
+                   help=">= 0: treat records as stream-packed windows "
+                        "with this EOS separator (segment-masked "
+                        "attention, per-document positions, boundary "
+                        "loss masking)")
     args = p.parse_args(argv)
     ctx, mesh = bring_up(args)
 
@@ -69,20 +79,43 @@ def main(argv=None) -> float:
     opt = default_optimizer(warmup_steps=10, decay_steps=max(args.steps, 11),
                             mu_dtype=moment_dtype, nu_dtype=moment_dtype)
     trainer = Trainer(model, flagship_partition_rules(), mesh, opt,
-                      grad_accum=args.grad_accum)
+                      grad_accum=args.grad_accum,
+                      segment_eos=(args.segment_eos
+                                   if args.segment_eos >= 0 else None))
 
     global_batch = args.batch_per_host * ctx.num_processes
     seq = args.seq_len or cfg.max_seq_len
-    tokens = synthetic_tokens(jax.random.key(args.seed), global_batch,
-                              seq + 1, cfg.vocab_size)
+    loader = None
+    if args.data:
+        import numpy as np
+
+        from tpu_on_k8s.data import DataLoader, FixedRecordDataset
+        ds = FixedRecordDataset(args.data, (seq + 1,), np.int32)
+        # each host loads its own disjoint shard of the corpus
+        loader = DataLoader(ds, batch_size=args.batch_per_host,
+                            shard_id=ctx.process_id,
+                            num_shards=ctx.num_processes, seed=args.seed)
+        # each host's disjoint shard assembles into the GLOBAL batch (a
+        # plain shard_batch would treat one shard as the whole batch and
+        # drop the other hosts' data); the loader's numpy batch goes
+        # straight to the sharded placement, no staging device_put
+        next_batch = lambda: trainer.shard_local_batch(next(loader))
+        tokens = next_batch()
+    else:
+        tokens = synthetic_tokens(jax.random.key(args.seed), global_batch,
+                                  seq + 1, cfg.vocab_size)
     state = trainer.init_state(jax.random.key(args.seed + 1), tokens[:, :-1])
-    batch = trainer.shard_batch(tokens)
+    batch = tokens if loader is not None else trainer.shard_batch(tokens)
     timer = StepTimer(global_batch * seq, ctx)
     loss = float("nan")
     for i in range(args.steps):
         state, metrics = trainer.train_step(state, batch)
         loss = float(metrics["loss"])
         timer.report(i, loss)
+        if loader is not None and i + 1 < args.steps:
+            batch = next_batch()
+    if loader is not None:
+        loader.close()
     if args.checkpoint_dir:
         manager = CheckpointManager(args.checkpoint_dir)
         manager.save(state, step=int(state.step))

@@ -70,6 +70,24 @@ def test_train_llama_tiny_ring():
     assert np.isfinite(loss)
 
 
+def test_train_llama_packed_corpus(tmp_path):
+    """The real-corpus CLI: packed records file → native loader (per-host
+    shards) → segment-masked training."""
+    from examples.train_llama import main
+    from tpu_on_k8s.data import pack_stream, write_records
+
+    rng = np.random.default_rng(0)
+    docs = [rng.integers(1, 256, size=int(rng.integers(3, 40)))
+              .astype(np.int32) for _ in range(300)]
+    path = tmp_path / "corpus.bin"
+    write_records(str(path), pack_stream(docs, seq_len=65, eos_id=0))
+    loss = main(["--steps", "2", "--batch-per-host", "8", "--config",
+                 "tiny", "--seq-len", "64", "--data", str(path),
+                 "--segment-eos", "0", "--fsdp", "4", "--model-axis", "2",
+                 "--seq-axis", "1"])
+    assert np.isfinite(loss)
+
+
 def test_serve_continuous_tiny():
     """The serving example drains mixed traffic end-to-end — plain and
     tensor-parallel with a step horizon."""

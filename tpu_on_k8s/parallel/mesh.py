@@ -102,6 +102,20 @@ def put_global(x, sharding: NamedSharding):
                                         lambda idx: arr[idx])
 
 
+def put_process_local(x_local, sharding: NamedSharding):
+    """Assemble a global array from PER-PROCESS local rows — each host
+    contributes a DISJOINT leading-dim shard (its ``DataLoader`` shard),
+    unlike ``put_global`` where every host holds the same full array.
+    Single-process the two coincide; multi-process this uses
+    ``jax.make_array_from_process_local_data``, which raises loudly if
+    the sharding's process layout cannot absorb the local contribution
+    (never silently drops or duplicates rows)."""
+    if jax.process_count() == 1:
+        return jax.device_put(x_local, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(x_local))
+
+
 def batch_sharding(mesh: Mesh,
                    shape: Optional[Tuple[int, ...]] = None) -> NamedSharding:
     """Sharding for a [batch, ...] input: batch split over every

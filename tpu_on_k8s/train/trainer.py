@@ -374,8 +374,19 @@ class Trainer:
 
     def shard_batch(self, tokens: jnp.ndarray) -> jnp.ndarray:
         # put_global handles multi-process meshes (each slice host
-        # contributes its addressable shards)
+        # contributes its addressable shards of the SAME full array)
         return put_global(tokens, batch_sharding(self.mesh, tokens.shape))
+
+    def shard_local_batch(self, tokens_local) -> jnp.ndarray:
+        """Global sharded batch from each host's DISJOINT loader shard
+        ([per_host, L] rows — ``DataLoader(shard_id=process_id)``); the
+        global batch is per_host × process_count. Using ``shard_batch``
+        here would silently treat one host's shard as the whole batch."""
+        from tpu_on_k8s.parallel.mesh import put_process_local
+        global_shape = ((tokens_local.shape[0] * jax.process_count(),)
+                        + tuple(tokens_local.shape[1:]))
+        return put_process_local(tokens_local,
+                                 batch_sharding(self.mesh, global_shape))
 
     def train_step(self, state: TrainState, tokens: jnp.ndarray):
         # ring_context makes the mesh ambient while jit traces, so
