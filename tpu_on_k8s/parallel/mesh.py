@@ -102,18 +102,25 @@ def put_global(x, sharding: NamedSharding):
                                         lambda idx: arr[idx])
 
 
-def put_process_local(x_local, sharding: NamedSharding):
+def put_process_local(x_local, sharding: NamedSharding,
+                      global_shape: Tuple[int, ...]):
     """Assemble a global array from PER-PROCESS local rows — each host
     contributes a DISJOINT leading-dim shard (its ``DataLoader`` shard),
     unlike ``put_global`` where every host holds the same full array.
     Single-process the two coincide; multi-process this uses
-    ``jax.make_array_from_process_local_data``, which raises loudly if
-    the sharding's process layout cannot absorb the local contribution
-    (never silently drops or duplicates rows)."""
+    ``jax.make_array_from_process_local_data`` with the EXPLICIT global
+    shape — without it, a sharding that shed its batch axis (non-dividing
+    batch) would be inferred as "replicated" and each host's different
+    rows silently accepted as the same array; with it, a layout the
+    processes cannot absorb raises loudly."""
     if jax.process_count() == 1:
+        if tuple(x_local.shape) != tuple(global_shape):
+            raise ValueError(
+                f"local shape {tuple(x_local.shape)} != global "
+                f"{tuple(global_shape)} for a single process")
         return jax.device_put(x_local, sharding)
     return jax.make_array_from_process_local_data(
-        sharding, np.asarray(x_local))
+        sharding, np.asarray(x_local), tuple(global_shape))
 
 
 def batch_sharding(mesh: Mesh,

@@ -22,7 +22,11 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh
 
-from tpu_on_k8s.parallel.mesh import batch_sharding, put_global
+from tpu_on_k8s.parallel.mesh import (
+    batch_sharding,
+    put_global,
+    put_process_local,
+)
 from tpu_on_k8s.parallel.partition import PartitionRule, named_sharding
 from tpu_on_k8s.parallel.ring import ring_context
 
@@ -382,11 +386,11 @@ class Trainer:
         ([per_host, L] rows — ``DataLoader(shard_id=process_id)``); the
         global batch is per_host × process_count. Using ``shard_batch``
         here would silently treat one host's shard as the whole batch."""
-        from tpu_on_k8s.parallel.mesh import put_process_local
         global_shape = ((tokens_local.shape[0] * jax.process_count(),)
                         + tuple(tokens_local.shape[1:]))
         return put_process_local(tokens_local,
-                                 batch_sharding(self.mesh, global_shape))
+                                 batch_sharding(self.mesh, global_shape),
+                                 global_shape)
 
     def train_step(self, state: TrainState, tokens: jnp.ndarray):
         # ring_context makes the mesh ambient while jit traces, so
