@@ -63,6 +63,12 @@ def main(argv=None) -> float:
                         "with this EOS separator (segment-masked "
                         "attention, per-document positions, boundary "
                         "loss masking)")
+    p.add_argument("--eval-data", default="",
+                   help="held-out packed records file; evaluated with the "
+                        "shared-objective forward-only eval step")
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="evaluate every N steps (0 = only at the end; "
+                        "needs --eval-data)")
     args = p.parse_args(argv)
     ctx, mesh = bring_up(args)
 
@@ -107,13 +113,50 @@ def main(argv=None) -> float:
     state = trainer.init_state(jax.random.key(args.seed + 1), tokens[:, :-1])
     batch = tokens if loader is not None else trainer.shard_batch(tokens)
     timer = StepTimer(global_batch * seq, ctx)
+
+    # the held-out sample loads ONCE, up front: a bad eval file fails here
+    # (before any training compute, not after the last step where it would
+    # also skip the checkpoint save), and periodic evals reuse the cached
+    # batches instead of respinning the loader per call
+    eval_batches = []
+    if args.eval_data:
+        import numpy as np
+
+        from tpu_on_k8s.data import DataLoader, FixedRecordDataset
+        eds = FixedRecordDataset(args.eval_data, (seq + 1,), np.int32)
+        eld = DataLoader(eds, batch_size=args.batch_per_host,
+                         shard_id=ctx.process_id,
+                         num_shards=ctx.num_processes, seed=0,
+                         shuffle=False)
+        eval_batches = [next(eld).copy()
+                        for _ in range(min(eld.batches_per_epoch, 8))]
+        eld.close()
+
+    def evaluate() -> None:
+        total = 0.0
+        for eb in eval_batches:
+            ev = trainer.eval_step(state, trainer.shard_local_batch(eb))
+            total += float(ev["loss"])
+        mean = total / len(eval_batches)
+        if ctx.is_coordinator:
+            print(f"[eval] step={int(state.step)} loss={mean:.4f} "
+                  f"perplexity={float(jax.numpy.exp(mean)):.1f}",
+                  flush=True)
+
     loss = float("nan")
+    evaluated_at = -1
     for i in range(args.steps):
         state, metrics = trainer.train_step(state, batch)
         loss = float(metrics["loss"])
         timer.report(i, loss)
+        if (eval_batches and args.eval_every
+                and (i + 1) % args.eval_every == 0):
+            evaluate()
+            evaluated_at = i + 1
         if loader is not None and i + 1 < args.steps:
             batch = next_batch()
+    if eval_batches and evaluated_at != args.steps:
+        evaluate()   # final eval, unless the periodic one just ran
     if loader is not None:
         loader.close()
     if args.checkpoint_dir:

@@ -478,6 +478,20 @@ def test_gpt2_family_engine():
                                       err_msg=f"gpt2 request {rid}")
 
 
+def test_moe_model_serves_through_engine():
+    """MoE configs (expert routing in the decode forward) serve through
+    generate() and the engine with exact agreement."""
+    cfg = dataclasses.replace(TransformerConfig.tiny(), dtype=jnp.float32,
+                              n_experts=4, experts_top_k=2, max_seq_len=64)
+    tok = jax.random.randint(jax.random.key(0), (1, 8), 0, cfg.vocab_size,
+                             jnp.int32)
+    params = Transformer(cfg).init(jax.random.key(1), tok)["params"]
+    want = np.asarray(generate(cfg, params, tok, 5))[0]
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2)
+    rid = eng.submit(np.asarray(tok[0]), 5)
+    np.testing.assert_array_equal(eng.run()[rid], want)
+
+
 def test_random_traffic_fuzz(setup):
     """Randomized mixed traffic — ragged lengths, random admission times,
     random horizons, prefix and plain requests interleaved, slot churn —
