@@ -155,6 +155,53 @@ class TestExport:
             to_hf_llama(cfg, params)
 
 
+class TestBert:
+    """Encoder-family oracle: post-LN blocks, erf-gelu, token types,
+    tied MLM decoder against transformers.BertForMaskedLM."""
+
+    def test_mlm_logits_match_hf(self):
+        from tpu_on_k8s.models.bert import Bert
+        from tpu_on_k8s.models.convert import from_hf_bert
+
+        hf_cfg = transformers.BertConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64, type_vocab_size=2,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+        torch.manual_seed(0)
+        hf = transformers.BertForMaskedLM(hf_cfg).eval()
+        cfg, params = from_hf_bert(hf)
+
+        tokens = np.array([[3, 17, 95, 4, 88, 120, 7, 1]], np.int32)
+        types = np.array([[0, 0, 0, 0, 1, 1, 1, 1]], np.int32)
+        with torch.no_grad():
+            want = hf(torch.tensor(tokens, dtype=torch.long),
+                      token_type_ids=torch.tensor(types, dtype=torch.long)
+                      ).logits.numpy()
+        got = np.asarray(Bert(cfg).apply({"params": params},
+                                         jnp.asarray(tokens),
+                                         jnp.asarray(types)))
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+
+    def test_unsupported_configs_rejected(self):
+        from tpu_on_k8s.models.convert import from_hf_bert
+
+        hf = transformers.BertForMaskedLM(transformers.BertConfig(
+            vocab_size=64, hidden_size=32, num_hidden_layers=1,
+            num_attention_heads=2, intermediate_size=64))
+        hf.config.hidden_act = "relu"
+        with pytest.raises(ValueError, match="hidden_act"):
+            from_hf_bert(hf)
+        hf.config.hidden_act = "gelu"
+        hf.config.position_embedding_type = "relative_key"
+        with pytest.raises(ValueError, match="absolute"):
+            from_hf_bert(hf)
+        hf.config.position_embedding_type = "absolute"
+        hf.config.tie_word_embeddings = False
+        with pytest.raises(ValueError, match="untied"):
+            from_hf_bert(hf)   # silently-wrong logits otherwise
+
+
 class TestGPT2:
     """GPT-2-family oracle: learned positions, LayerNorm (with bias),
     tanh-gelu, biased Conv1D projections, tied head."""

@@ -74,7 +74,9 @@ class EncoderBlock(nn.Module):
         attn = dense(cfg.d_model, "wo")(attn.reshape(b, l, cfg.d_model))
         x = ln("attn_norm")(x + attn).astype(cfg.dtype)
         h = dense(cfg.d_ff, "w_fc")(x)
-        h = dense(cfg.d_model, "w_proj")(nn.gelu(h))
+        # BERT's published activation is the exact (erf) gelu, not the
+        # tanh approximation — matters for HF checkpoint parity
+        h = dense(cfg.d_model, "w_proj")(nn.gelu(h, approximate=False))
         x = ln("mlp_norm")(x + h).astype(cfg.dtype)
         return x, None
 
@@ -115,9 +117,13 @@ class Bert(nn.Module):
         )(cfg, name="blocks")
         x, _ = stack(x, None)
 
-        # MLM head: transform + tied-embedding projection (BERT arrangement).
+        # MLM head: transform (dense + erf-gelu) + LN + tied-embedding
+        # projection — the exact BERT arrangement (HF's
+        # BertPredictionHeadTransform applies the activation between the
+        # dense and the LayerNorm).
         x = nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      name="mlm_transform")(x)
+        x = nn.gelu(x, approximate=False)
         x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
                          param_dtype=cfg.param_dtype, name="mlm_norm")(x)
         bias = self.param("mlm_bias", nn.initializers.zeros,

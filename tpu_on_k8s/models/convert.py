@@ -215,6 +215,89 @@ def from_hf_gpt2(hf_model, dtype=jnp.float32, compute_dtype=None
     return cfg, params
 
 
+def from_hf_bert(hf_model, dtype=jnp.float32, compute_dtype=None):
+    """(BertConfig, params) from a ``transformers.BertForMaskedLM`` —
+    the encoder-family oracle (post-LN blocks, erf-gelu, token types,
+    tied MLM decoder). Same layout rules as the Llama converter: torch
+    Linear weights transpose to our [in, out] kernels, per-layer leaves
+    stack for the scan."""
+    import dataclasses
+
+    from tpu_on_k8s.models.bert import BertConfig
+
+    hc = hf_model.config
+    if getattr(hc, "hidden_act", "gelu") != "gelu":
+        raise ValueError(f"unsupported hidden_act {hc.hidden_act!r}: this "
+                         f"encoder uses the exact (erf) gelu")
+    if getattr(hc, "position_embedding_type", "absolute") != "absolute":
+        raise ValueError("only absolute position embeddings are supported")
+    if not getattr(hc, "tie_word_embeddings", True):
+        # the MLM decoder here IS the word-embedding matrix; an untied
+        # checkpoint's independent decoder.weight would be silently dropped
+        raise ValueError("untied MLM decoder weights are not supported "
+                         "(this encoder ties the decoder to the "
+                         "embeddings)")
+    cfg = BertConfig(
+        vocab_size=hc.vocab_size, d_model=hc.hidden_size,
+        n_layers=hc.num_hidden_layers, n_heads=hc.num_attention_heads,
+        d_ff=hc.intermediate_size, max_seq_len=hc.max_position_embeddings,
+        type_vocab_size=hc.type_vocab_size,
+        norm_eps=float(hc.layer_norm_eps))
+    cfg = dataclasses.replace(cfg, dtype=compute_dtype or dtype,
+                              param_dtype=dtype)
+    sd = hf_model.state_dict()
+
+    def arr(name):
+        return _to_np(sd, name)
+
+    def stacked(fmt, transpose=True):
+        ws = [arr(fmt.format(i)) for i in range(cfg.n_layers)]
+        return jnp.asarray(np.stack([w.T if transpose else w for w in ws]),
+                           dtype)
+
+    L = "bert.encoder.layer.{}."
+    ln = lambda fmt: {"scale": stacked(fmt + ".weight", transpose=False),
+                      "bias": stacked(fmt + ".bias", transpose=False)}
+    dense = lambda fmt: {"kernel": stacked(fmt + ".weight"),
+                         "bias": stacked(fmt + ".bias", transpose=False)}
+    blocks = {
+        "wq": dense(L + "attention.self.query"),
+        "wk": dense(L + "attention.self.key"),
+        "wv": dense(L + "attention.self.value"),
+        "wo": dense(L + "attention.output.dense"),
+        "attn_norm": ln(L + "attention.output.LayerNorm"),
+        "w_fc": dense(L + "intermediate.dense"),
+        "w_proj": dense(L + "output.dense"),
+        "mlp_norm": ln(L + "output.LayerNorm"),
+    }
+    params = {
+        "embed": jnp.asarray(arr("bert.embeddings.word_embeddings.weight"),
+                             dtype),
+        "pos_embed": jnp.asarray(
+            arr("bert.embeddings.position_embeddings.weight"), dtype),
+        "type_embed": jnp.asarray(
+            arr("bert.embeddings.token_type_embeddings.weight"), dtype),
+        "embed_norm": {
+            "scale": jnp.asarray(arr("bert.embeddings.LayerNorm.weight"),
+                                 dtype),
+            "bias": jnp.asarray(arr("bert.embeddings.LayerNorm.bias"),
+                                dtype)},
+        "blocks": blocks,
+        "mlm_transform": {
+            "kernel": jnp.asarray(
+                arr("cls.predictions.transform.dense.weight").T, dtype),
+            "bias": jnp.asarray(
+                arr("cls.predictions.transform.dense.bias"), dtype)},
+        "mlm_norm": {
+            "scale": jnp.asarray(
+                arr("cls.predictions.transform.LayerNorm.weight"), dtype),
+            "bias": jnp.asarray(
+                arr("cls.predictions.transform.LayerNorm.bias"), dtype)},
+        "mlm_bias": jnp.asarray(arr("cls.predictions.bias"), dtype),
+    }
+    return cfg, params
+
+
 def to_hf_llama(cfg: TransformerConfig, params) -> dict:
     """HF Llama ``state_dict`` (torch tensors) from our param tree — the
     inverse of ``params_from_hf_llama``, so a model fine-tuned here ships
