@@ -183,6 +183,45 @@ class TestBert:
                                          jnp.asarray(types)))
         np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
 
+    def test_padding_mask_matches_hf(self):
+        """Batched ragged encoder inputs: the padding attention_mask
+        yields the same real-position logits HF computes."""
+        from tpu_on_k8s.models.bert import Bert
+        from tpu_on_k8s.models.convert import from_hf_bert
+
+        hf_cfg = transformers.BertConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0)
+        torch.manual_seed(1)
+        hf = transformers.BertForMaskedLM(hf_cfg).eval()
+        cfg, params = from_hf_bert(hf)
+
+        tokens = np.array([[3, 17, 95, 4, 0, 0, 0, 0],
+                           [9, 2, 64, 31, 5, 77, 12, 40]], np.int32)
+        mask = np.array([[1, 1, 1, 1, 0, 0, 0, 0],
+                         [1, 1, 1, 1, 1, 1, 1, 1]], np.int32)
+        with torch.no_grad():
+            want = hf(torch.tensor(tokens, dtype=torch.long),
+                      attention_mask=torch.tensor(mask, dtype=torch.long)
+                      ).logits.numpy()
+        got = np.asarray(Bert(cfg).apply(
+            {"params": params}, jnp.asarray(tokens), None,
+            jnp.asarray(mask)))
+        # real positions agree; pad positions are model-undefined in HF too
+        np.testing.assert_allclose(got[mask == 1], want[mask == 1],
+                                   atol=2e-4, rtol=2e-3)
+        # the mask rides the configured impl: the flash kernel (segments
+        # in-VMEM) matches too
+        import dataclasses
+        flash = np.asarray(Bert(dataclasses.replace(
+            cfg, attn_impl="flash")).apply(
+            {"params": params}, jnp.asarray(tokens), None,
+            jnp.asarray(mask)))
+        np.testing.assert_allclose(flash[mask == 1], want[mask == 1],
+                                   atol=2e-4, rtol=2e-3)
+
     def test_unsupported_configs_rejected(self):
         from tpu_on_k8s.models.convert import from_hf_bert
 

@@ -58,7 +58,7 @@ class EncoderBlock(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, _=None):
+    def __call__(self, x: jnp.ndarray, attention_mask=None):
         cfg = self.cfg
         dense = lambda feats, name: nn.Dense(
             feats, use_bias=True, name=name, dtype=cfg.dtype,
@@ -70,7 +70,21 @@ class EncoderBlock(nn.Module):
         q = dense(cfg.d_model, "wq")(x).reshape(b, l, cfg.n_heads, cfg.head_dim)
         k = dense(cfg.d_model, "wk")(x).reshape(b, l, cfg.n_heads, cfg.head_dim)
         v = dense(cfg.d_model, "wv")(x).reshape(b, l, cfg.n_heads, cfg.head_dim)
-        attn = _select_attention(cfg.attn_impl)(q, k, v, causal=False)
+        if attention_mask is not None:
+            # padding mask [B, L] (1 = real token) expressed as SEGMENTS:
+            # real tokens share segment 0, each pad gets a unique sentinel
+            # — so the mask rides the configured attention impl (including
+            # the Pallas flash kernel's in-VMEM segment operand) instead
+            # of a bespoke quadratic branch
+            if cfg.attn_impl not in ("xla", "flash"):
+                raise ValueError("attention_mask needs the xla or flash "
+                                 "attention path")
+            idx = jnp.arange(l, dtype=jnp.int32)[None, :]
+            seg = jnp.where(attention_mask.astype(bool), 0, -(idx + 1))
+            attn = _select_attention(cfg.attn_impl)(q, k, v, causal=False,
+                                                    segments=seg)
+        else:
+            attn = _select_attention(cfg.attn_impl)(q, k, v, causal=False)
         attn = dense(cfg.d_model, "wo")(attn.reshape(b, l, cfg.d_model))
         x = ln("attn_norm")(x + attn).astype(cfg.dtype)
         h = dense(cfg.d_ff, "w_fc")(x)
@@ -88,7 +102,8 @@ class Bert(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray,
-                 type_ids: jnp.ndarray = None) -> jnp.ndarray:
+                 type_ids: jnp.ndarray = None,
+                 attention_mask: jnp.ndarray = None) -> jnp.ndarray:
         cfg = self.cfg
         embed = self.param("embed", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
@@ -115,7 +130,7 @@ class Bert(nn.Module):
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )(cfg, name="blocks")
-        x, _ = stack(x, None)
+        x, _ = stack(x, attention_mask)
 
         # MLM head: transform (dense + erf-gelu) + LN + tied-embedding
         # projection — the exact BERT arrangement (HF's

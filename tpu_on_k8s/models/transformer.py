@@ -217,17 +217,25 @@ def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
 
 
 def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                  causal: bool = True) -> jnp.ndarray:
+                  causal: bool = True,
+                  segments: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Plain attention, letting XLA fuse; softmax statistics in fp32.
 
     q: [B, L, H, Dh]; k/v: [B, L, H, Dh] (kv already repeated to H heads).
+    ``segments [B, L]``: attend only within the same segment (packed
+    windows; also expresses key-padding masks via sentinel segments).
     """
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("blhd,bmhd->bhlm", q, k,
                         preferred_element_type=jnp.float32) * scale
+    l, m = logits.shape[-2], logits.shape[-1]
+    mask = jnp.ones((1, 1, l, m), dtype=bool)
     if causal:
-        l, m = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((l, m), dtype=bool))
+        mask = mask & jnp.tril(jnp.ones((l, m), dtype=bool))
+    if segments is not None:
+        mask = mask & (segments[:, None, :, None]
+                       == segments[:, None, None, :])
+    if causal or segments is not None:
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhlm,bmhd->blhd", probs, v)
