@@ -283,6 +283,24 @@ class TestGPT2:
         got = np.asarray(generate(cfg, params, jnp.asarray(prompt), 6))[0]
         np.testing.assert_array_equal(got, want)
 
+    def test_roundtrip_export(self):
+        """from_hf_gpt2 → to_hf_gpt2 reloads into HF with exact logits."""
+        from tpu_on_k8s.models.convert import from_hf_gpt2, to_hf_gpt2
+
+        a = self._tiny_gpt2()
+        cfg, params = from_hf_gpt2(a)
+        sd = to_hf_gpt2(cfg, params)
+        b = transformers.GPT2LMHeadModel(a.config).eval()
+        missing, unexpected = b.load_state_dict(sd, strict=False)
+        assert not unexpected, unexpected
+        assert all("attn.bias" in m or "masked_bias" in m
+                   for m in missing), missing   # HF's causal-mask buffers
+        tokens = torch.tensor([[3, 17, 95, 4, 88, 120, 7, 1]],
+                              dtype=torch.long)
+        with torch.no_grad():
+            np.testing.assert_allclose(b(tokens).logits.numpy(),
+                                       a(tokens).logits.numpy(), atol=1e-6)
+
     def test_unsupported_configs_rejected(self):
         from tpu_on_k8s.models.convert import config_from_hf_gpt2
 

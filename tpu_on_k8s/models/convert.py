@@ -348,6 +348,60 @@ def to_hf_llama(cfg: TransformerConfig, params) -> dict:
     return sd
 
 
+def to_hf_gpt2(cfg: TransformerConfig, params) -> dict:
+    """HF GPT-2 ``state_dict`` (torch tensors) from our param tree — the
+    inverse of ``params_from_hf_gpt2`` (Conv1D keeps our [in, out] layout;
+    wq/wk/wv re-fuse into c_attn)."""
+    import torch
+
+    from tpu_on_k8s.models.layouts import migrate_param_layout
+
+    if (cfg.pos_emb, cfg.norm, cfg.activation,
+            cfg.use_bias, cfg.tie_embeddings) != ("learned", "ln", "gelu",
+                                                  True, True):
+        raise ValueError("to_hf_gpt2 exports the GPT-2 family only "
+                         "(learned positions + LayerNorm + gelu + biased "
+                         "tied layout)")
+    if cfg.n_kv_heads != cfg.n_heads:
+        raise ValueError("HF GPT-2 has no GQA: n_kv_heads must equal "
+                         "n_heads")
+    if cfg.serve_int8_weights:
+        raise ValueError("int8-serving param trees have no GPT-2 "
+                         "state-dict form (export the bf16 checkpoint)")
+    params = migrate_param_layout(params, fused_qkv=False)
+
+    def t(x):
+        return torch.tensor(np.asarray(x, np.float32))
+
+    b = params["blocks"]
+    sd = {"transformer.wte.weight": t(params["embed"]),
+          "transformer.wpe.weight": t(params["pos_embed"]),
+          "transformer.ln_f.weight": t(params["final_norm"]["scale"]),
+          "transformer.ln_f.bias": t(params["final_norm"]["bias"])}
+    # tied head: share ONE tensor with the embedding, as HF itself does
+    sd["lm_head.weight"] = sd["transformer.wte.weight"]
+    attn, mlp = b["attn"], b["mlp"]
+    c_attn_w = np.concatenate([np.asarray(attn[n]["kernel"], np.float32)
+                               for n in ("wq", "wk", "wv")], axis=-1)
+    c_attn_b = np.concatenate([np.asarray(attn[n]["bias"], np.float32)
+                               for n in ("wq", "wk", "wv")], axis=-1)
+    for i in range(cfg.n_layers):
+        L = f"transformer.h.{i}."
+        sd[L + "attn.c_attn.weight"] = t(c_attn_w[i])
+        sd[L + "attn.c_attn.bias"] = t(c_attn_b[i])
+        sd[L + "attn.c_proj.weight"] = t(attn["wo"]["kernel"][i])
+        sd[L + "attn.c_proj.bias"] = t(attn["wo"]["bias"][i])
+        sd[L + "ln_1.weight"] = t(b["attn_norm"]["scale"][i])
+        sd[L + "ln_1.bias"] = t(b["attn_norm"]["bias"][i])
+        sd[L + "mlp.c_fc.weight"] = t(mlp["w_up"]["kernel"][i])
+        sd[L + "mlp.c_fc.bias"] = t(mlp["w_up"]["bias"][i])
+        sd[L + "mlp.c_proj.weight"] = t(mlp["w_down"]["kernel"][i])
+        sd[L + "mlp.c_proj.bias"] = t(mlp["w_down"]["bias"][i])
+        sd[L + "ln_2.weight"] = t(b["mlp_norm"]["scale"][i])
+        sd[L + "ln_2.bias"] = t(b["mlp_norm"]["bias"][i])
+    return sd
+
+
 def from_hf_llama(hf_model, dtype=jnp.float32, compute_dtype=None
                   ) -> Tuple[TransformerConfig, dict]:
     """(config, params) from a loaded ``LlamaForCausalLM`` — ready for
