@@ -18,6 +18,9 @@ def main(argv=None):
     p = argparse.ArgumentParser(description="generate from a checkpoint")
     p.add_argument("--config", default="tiny", choices=sorted(CONFIGS))
     p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--hf-model", default="",
+                   help="local HF checkpoint dir (Llama or GPT-2 family) "
+                        "— overrides --config/--checkpoint-dir")
     p.add_argument("--prompt-len", type=int, default=8)
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0)
@@ -39,6 +42,27 @@ def main(argv=None):
                    help="speculation window (draft proposals per round)")
     args = p.parse_args(argv)
 
+    if args.hf_model:
+        import transformers
+
+        from tpu_on_k8s.models.convert import from_hf_gpt2, from_hf_llama
+        hf = transformers.AutoModelForCausalLM.from_pretrained(
+            args.hf_model)
+        conv = {"llama": from_hf_llama, "gpt2": from_hf_gpt2}.get(
+            hf.config.model_type)
+        if conv is None:
+            raise SystemExit(f"unsupported HF model_type "
+                             f"{hf.config.model_type!r} (llama | gpt2)")
+        cfg, params = conv(hf, dtype=jnp.bfloat16)
+        prompt = jax.random.randint(jax.random.key(args.seed),
+                                    (1, args.prompt_len), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        out = generate(cfg, params, prompt, args.max_new_tokens,
+                       temperature=args.temperature, top_k=args.top_k,
+                       top_p=args.top_p, rng=jax.random.key(args.seed + 1))
+        print("prompt:", prompt[0].tolist())
+        print("continuation:", out[0].tolist())
+        return out
     cfg = CONFIGS[args.config]()
     model = Transformer(cfg)
     prompt = jax.random.randint(jax.random.key(args.seed),
