@@ -128,6 +128,9 @@ def stage_decode(timeout):
     _lever_stage([sys.executable, "tools/driver_bench.py", "--write",
                   "--skip-resnet", "--skip-submit", "--serve-int8"],
                  "decode_w8a16", timeout)
+    _lever_stage([sys.executable, "tools/driver_bench.py", "--write",
+                  "--skip-resnet", "--skip-submit", "--speculative"],
+                 "decode_speculative", timeout)
     return True
 
 
@@ -225,7 +228,8 @@ def stage_continuous(timeout):
 # a stage only counts as done when primary AND extras are error-free)
 STAGES = [
     ("headline", stage_headline, 900, ()),
-    ("decode", stage_decode, 1200, ("decode_cache_int8", "decode_w8a16")),
+    ("decode", stage_decode, 1200,
+     ("decode_cache_int8", "decode_w8a16", "decode_speculative")),
     ("sweep_stage_a", stage_sweep, 3600, ("sweep_stage_b",)),
     ("longcontext", stage_longcontext, 1800, ()),
     ("resnet50", stage_resnet, 1200, ()),

@@ -241,6 +241,71 @@ def bench_continuous(n_slots: int = 8, n_requests: int = 32,
     }
 
 
+def bench_speculative(prompt_len: int = 128, new_tokens: int = 123,
+                      k: int = 4) -> dict:
+    """Speculative decoding's mechanism overhead, measured with a
+    SELF-draft (draft == target): every proposal is accepted, so each
+    round emits k+1 tokens per target forward — the upper bound of the
+    speedup a trained draft can approach. Compares against plain
+    ``generate()`` on the same model; reported as the mechanism's
+    tokens/s and the ratio (< 1 means the draft forwards + host loop
+    cost more than the batched verify saves at this model size).
+    ``new_tokens`` defaults to 123 so BOTH paths bucket their KV cache to
+    the same 256 length (speculative adds k+1 positions before
+    bucketing) — otherwise the ratio conflates mechanism overhead with a
+    cache-size mismatch."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import bench_config
+    from tpu_on_k8s.models.decode import generate, speculative_generate
+    from tpu_on_k8s.models.transformer import Transformer
+
+    cfg = bench_config()
+    model = Transformer(cfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, prompt_len), 0,
+                                cfg.vocab_size, jnp.int32)
+    params = model.init(jax.random.key(0), prompt)["params"]
+    params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+
+    # warmup/compile both paths
+    out = generate(cfg, params, prompt, new_tokens)
+    int(out[0, 0])
+    spec, _ = speculative_generate(cfg, params, cfg, params, prompt,
+                                   new_tokens, k=k)
+    int(spec[0, 0])
+
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = generate(cfg, params, prompt, new_tokens)
+    int(out[0, 0])
+    base_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        spec, stats = speculative_generate(cfg, params, cfg, params,
+                                           prompt, new_tokens, k=k)
+    int(spec[0, 0])
+    spec_s = time.perf_counter() - t0
+    devices = jax.devices()
+    return {
+        "metric": "speculative_selfdraft_tokens_per_sec",
+        "value": round(reps * new_tokens / spec_s, 1),
+        "unit": "tokens/s",
+        "baseline_generate_tokens_per_sec": round(
+            reps * new_tokens / base_s, 1),
+        "ratio_vs_generate": round(base_s / spec_s, 3),
+        "k": k,
+        "acceptance_rate": stats["acceptance_rate"],
+        "tokens_per_target_forward": round(
+            stats["tokens_per_target_forward"], 2),
+        "note": "self-draft upper bound: a REAL draft adds its own "
+                "forwards but shrinks the target count toward this",
+        "device_kind": getattr(devices[0], "device_kind", "unknown"),
+    }
+
+
 def bench_submit_to_first_step(n_jobs: int = 20) -> dict:
     import threading
 
@@ -321,6 +386,9 @@ def main() -> None:
     parser.add_argument("--serve-int8", action="store_true",
                         help="decode with W8A16 int8 weights (recorded "
                              "under decode_tokens_per_sec_w8a16)")
+    parser.add_argument("--speculative", action="store_true",
+                        help="measure the speculative-decoding mechanism "
+                             "with a self-draft (acceptance=1 upper bound)")
     parser.add_argument("--continuous", action="store_true",
                         help="measure continuous-batching serving "
                              "throughput (mixed ragged traffic through the "
@@ -333,6 +401,11 @@ def main() -> None:
     if args.horizon > 1 and not args.continuous:
         parser.error("--horizon only applies to --continuous (the static "
                      "decode bench has no step horizon)")
+    if args.speculative and (args.cache_int8 or args.serve_int8
+                             or args.continuous):
+        parser.error("--speculative measures the plain bf16 mechanism; it "
+                     "does not combine with --cache-int8/--serve-int8/"
+                     "--continuous")
 
     published = {}
     if not args.skip_submit:
@@ -342,7 +415,12 @@ def main() -> None:
         published["resnet50_images_per_sec_per_chip"] = bench_resnet50()
         print(json.dumps(published["resnet50_images_per_sec_per_chip"]))
     if not args.skip_decode:
-        if args.continuous:
+        if args.speculative:
+            published["speculative_selfdraft_tokens_per_sec"] = \
+                bench_speculative()
+            print(json.dumps(
+                published["speculative_selfdraft_tokens_per_sec"]))
+        elif args.continuous:
             key = "continuous_batching_tokens_per_sec"
             if args.cache_int8:
                 key += "_cache_int8"
