@@ -9,13 +9,15 @@ mid-window loses nothing already measured.
 Priority order (VERDICT r4 next-round #1/#2/#5/#6):
  1. headline ``bench.py`` — the committed config's official number;
  2. decode throughput → ``BASELINE.json.published.decode_tokens_per_sec``
-    (two rounds overdue);
+    (two rounds overdue), plus the int8-KV / W8A16 / speculative levers;
  3. staged int8 levers (head_int8, attn_int8, pallas fused-dequant), then
     combination + batch/remat re-sweep of the winner set;
  4. long-context: flash_4096 vs the NEW padded flash_4000 (the ragged
     cliff check) → ``LONGCONTEXT_r05.json``;
  5. ResNet-50 images/s/chip (refresh);
- 6. ``bench.py --data`` — the native loader feeding the measured step.
+ 6. ``bench.py --data`` — the native loader feeding the measured step;
+ 7. continuous-batching serving (h=1 and the h=8 horizon lever) with
+    TTFT/latency percentiles.
 
 Usage: python tools/chip_window.py [--stage N] [--timeout S]
 With no --stage, runs all stages in order. Safe to re-run: stages already
