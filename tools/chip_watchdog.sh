@@ -18,15 +18,14 @@ EOF
     echo "[watchdog] $(date -u +%H:%M:%S) window pass done (rc=$?)" >> chip_watchdog.log
     # if everything measured cleanly, stop looping
     python - <<'EOF' && break
-import json, sys
-try:
-    d = json.load(open("CHIPWINDOW_r05.json"))
-except Exception:
-    sys.exit(1)
+import sys
+sys.path.insert(0, "tools")
+from chip_window import _is_error, _load  # the ONE retry-semantics oracle
+d = _load()
 keys = ["headline", "decode", "sweep_stage_a", "sweep_stage_b",
         "longcontext", "resnet50", "bench_data", "continuous"]
-ok = all(k in d and not (isinstance(d[k], dict) and ("error" in d[k] or d[k].get("rc"))) for k in keys)
-sys.exit(0 if ok else 1)
+sys.exit(0 if d and all(k in d and not _is_error(d[k]) for k in keys)
+         else 1)
 EOF
   else
     echo "[watchdog] $(date -u +%H:%M:%S) chip dead (probe timeout)" >> chip_watchdog.log

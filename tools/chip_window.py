@@ -59,31 +59,68 @@ def _load() -> dict:
     return {}
 
 
-def _save(key: str, value) -> None:
-    data = _load()
-    data[key] = value
+def _write(data: dict) -> None:
     data["updated"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     tmp = OUT + ".tmp"
     with open(tmp, "w") as f:
         json.dump(data, f, indent=2)
         f.write("\n")
     os.replace(tmp, OUT)  # atomic: a crash mid-write never loses prior stages
+
+
+def _save(key: str, value) -> None:
+    data = _load()
+    whole_stage_error = (isinstance(value, dict) and "rows" not in value
+                         and ("error" in value or value.get("rc")))
+    if whole_stage_error and key in data and not _is_error(data[key]):
+        # a stage-level error NEVER clobbers a measured success (a retry
+        # pass entered for a failed sibling key can hit a now-dead chip) —
+        # it is filed beside it instead. Row-bearing records (sweep
+        # progress, possibly with retry rows) always save: they are
+        # supersets of what they replace.
+        data[key + "_error"] = value
+    else:
+        data[key] = value
+        if not _is_error(value):
+            # a success retires any stale failure record from earlier
+            data.pop(key + "_error", None)
+    _write(data)
     print(f"[chip_window] recorded {key}", flush=True)
 
 
 def _is_error(rec) -> bool:
-    return isinstance(rec, dict) and ("error" in rec or rec.get("rc"))
+    """True when a recorded stage needs a retry. Sweep stages record row
+    LISTS (possibly wrapped in {"winners", "rows"}); a row that timed out
+    (vs a real measurement failure like an OOM, which retrying won't fix)
+    marks the stage retryable."""
+    if isinstance(rec, dict) and ("error" in rec or rec.get("rc")):
+        return True
+    rows = rec.get("rows") if isinstance(rec, dict) else rec
+    if isinstance(rows, list):
+        return any(isinstance(r, dict) and r.get("retry") for r in rows)
+    return False
 
 
 def _run(argv, timeout):
-    print(f"[chip_window] $ {' '.join(argv)}", flush=True)
-    # persistent compilation cache: the tunnelled chip dies mid-window
+    print(f"[chip_window] $ {' '.join(argv)} "
+          f"(t={time.strftime('%H:%M:%S', time.gmtime())})", flush=True)
+    # persistent compilation cache: the tunnelled chip dies mid-round
     # routinely, and without this every retry re-pays the multi-minute
     # XLA compiles before measuring anything
-    env = {**os.environ,
+    env = {**os.environ, "PYTHONUNBUFFERED": "1",
            "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache")}
-    proc = subprocess.run(argv, capture_output=True, text=True,
-                          timeout=timeout, cwd=REPO, env=env)
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=timeout, cwd=REPO, env=env)
+    except subprocess.TimeoutExpired as e:
+        # salvage the rows the child already printed: measurements that
+        # completed before the hang are real data, not collateral
+        def _txt(v):
+            return v.decode(errors="replace") if isinstance(v, bytes) \
+                else (v or "")
+        proc = subprocess.CompletedProcess(
+            argv, 124, _txt(e.stdout),
+            _txt(e.stderr) + f"\ntimeout after {timeout}s")
     sys.stdout.write(proc.stdout)
     if proc.returncode != 0:
         sys.stderr.write(proc.stderr[-4000:])
@@ -102,6 +139,13 @@ def _json_stage(argv, key, timeout) -> bool:
             rec = json.loads(line)
         except json.JSONDecodeError:
             rec = {"rc": proc.returncode, "error": f"bad json: {line[:500]}"}
+        else:
+            if proc.returncode:
+                # a salvaged JSON line from a run that then hung/died is
+                # NOT a completed measurement (--write never ran): keep rc
+                # so the resume path retries the stage
+                rec = {"rc": proc.returncode, "salvaged": rec,
+                       "error": proc.stderr[-500:]}
     _save(key, rec)
     return proc.returncode == 0
 
@@ -153,11 +197,58 @@ def _parse_sweep(stdout: str) -> list:
     return rows
 
 
+def _sweep_specs(specs, key, timeout, wrap=None, deadline=None,
+                 fresh=False):
+    """One subprocess per spec with its own timeout, saving after each —
+    a single hanging compile (the round-4 pallas kernel's first real
+    Mosaic compile is unproven) can no longer eat the whole stage.
+    Measured rows resume across runs (``fresh`` discards them); rows from
+    a nonzero-rc child (timeout, chip death) are marked ``retry`` and
+    re-attempted — in-process failures like OOMs are DATA (perf_sweep
+    prints them as FAILED rows and exits 0) and are kept. ``wrap`` maps
+    the row list to the saved record (stage B adds its winner set);
+    ``deadline`` (monotonic) stops launching new specs so one stage can't
+    starve the rest of the priority window — unlaunched specs stay
+    unrecorded, i.e. retryable."""
+    existing = None if fresh else _load().get(key)
+    if isinstance(existing, dict):
+        existing = existing.get("rows", [])
+    rows = [r for r in (existing if isinstance(existing, list) else [])
+            if isinstance(r, dict) and not r.get("retry")]
+    pending = [s for s in specs
+               if s not in {r.get("spec") for r in rows}]
+    while pending:
+        spec = pending.pop(0)
+        if deadline is not None and time.monotonic() > deadline:
+            # deferred specs get explicit retry rows — otherwise the
+            # record reads as complete and is skipped forever
+            print(f"[chip_window] {key}: deadline hit, deferring "
+                  f"{1 + len(pending)} specs", flush=True)
+            rows.extend({"spec": s, "retry": True, "failed": "deferred"}
+                        for s in [spec, *pending])
+            _save(key, wrap(rows) if wrap else rows)
+            break
+        proc = _run([sys.executable, "tools/perf_sweep.py", spec], timeout)
+        got = _parse_sweep(proc.stdout)
+        if proc.returncode:
+            # salvaged complete rows are real measurements; anything less
+            # from a killed/dead child must be re-attempted
+            got = [g for g in got if "step_ms" in g] or \
+                [{"spec": spec, "retry": True,
+                  "failed": f"rc={proc.returncode} "
+                  f"{proc.stderr[-300:]}"}]
+        rows.extend(got)
+        _save(key, wrap(rows) if wrap else rows)
+    return rows
+
+
 def stage_sweep(timeout):
-    proc = _run([sys.executable, "tools/perf_sweep.py", *SWEEP_STAGE_A],
-                timeout)
-    rows = _parse_sweep(proc.stdout)
-    _save("sweep_stage_a", rows)
+    per_spec = min(timeout, 1800)
+    # the whole stage (A + B) is bounded at 3x the old single-subprocess
+    # budget so a string of near-timeout compiles can't starve stages 4-7
+    deadline = time.monotonic() + 3 * timeout
+    rows = _sweep_specs(SWEEP_STAGE_A, "sweep_stage_a", per_spec,
+                        deadline=deadline)
     ok = [r for r in rows if "step_ms" in r]
     if not ok:
         return False
@@ -182,14 +273,17 @@ def stage_sweep(timeout):
         # no lever won alone — still re-check batch around the control
         stage_b = [CONTROL.replace("batch=12", f"batch={b}")
                    for b in (10, 14)]
-    try:
-        proc_b = _run([sys.executable, "tools/perf_sweep.py", *stage_b],
-                      timeout)
-        _save("sweep_stage_b",
-              {"winners": winners, "rows": _parse_sweep(proc_b.stdout)})
-    except Exception as e:  # noqa: BLE001 — stage A's data must survive
-        _save("sweep_stage_b",
-              {"winners": winners, "error": f"{type(e).__name__}: {e}"})
+    prev = _load().get("sweep_stage_b")
+    # rows measured under a DIFFERENT winner combo would be misattributed
+    # if resumed — a changed winner set restarts stage B from scratch
+    stale = isinstance(prev, dict) and prev.get("winners") != winners
+    rows_b = _sweep_specs(stage_b, "sweep_stage_b", per_spec,
+                          wrap=lambda rows: {"winners": winners,
+                                             "rows": rows},
+                          deadline=deadline, fresh=stale)
+    if not any("step_ms" in r for r in rows_b):
+        _save("sweep_stage_b", {"winners": winners, "rows": rows_b,
+                                "error": "no stage-B spec measured"})
         return False
     return True
 
@@ -230,7 +324,10 @@ def stage_continuous(timeout):
 # a stage only counts as done when primary AND extras are error-free)
 STAGES = [
     ("headline", stage_headline, 900, ()),
-    ("decode", stage_decode, 1200,
+    # decode's generation-program compiles alone exceeded 1200s on the
+    # relay twice (r5 window, CHIPWINDOW_r05.json history) — give the
+    # stage real compile room
+    ("decode", stage_decode, 3600,
      ("decode_cache_int8", "decode_w8a16", "decode_speculative")),
     ("sweep_stage_a", stage_sweep, 3600, ("sweep_stage_b",)),
     ("longcontext", stage_longcontext, 1800, ()),
@@ -262,15 +359,20 @@ def main() -> int:
             print(f"[chip_window] stage {i} ({key}) already recorded; skip",
                   flush=True)
             continue
+        if args.force:
+            # the sweep stages resume from their saved rows regardless of
+            # the skip above — force must drop the records themselves
+            data = _load()
+            for k in (key, *extras):
+                data.pop(k, None)
+                data.pop(k + "_error", None)
+            _write(data)
         print(f"[chip_window] === stage {i}: {key} ===", flush=True)
         try:
             ok = fn(args.timeout or timeout)
-        except subprocess.TimeoutExpired:
-            ok = False
-            err = {"error": f"timeout after {args.timeout or timeout}s"}
-            # never clobber data the stage already recorded under its key
-            _save(key + "_error" if key in _load() else key, err)
         except Exception as e:  # noqa: BLE001 — record and continue
+            # (timeouts never raise: _run converts them to rc=124 records
+            # with salvaged output)
             ok = False
             err = {"error": f"{type(e).__name__}: {e}"}
             _save(key + "_error" if key in _load() else key, err)
