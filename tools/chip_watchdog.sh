@@ -20,10 +20,11 @@ EOF
     python - <<'EOF' && break
 import sys
 sys.path.insert(0, "tools")
-from chip_window import _is_error, _load  # the ONE retry-semantics oracle
+# chip_window is the ONE retry-semantics oracle: same keys (primaries AND
+# lever extras), same error predicate as its own resume loop
+from chip_window import STAGES, _is_error, _load
 d = _load()
-keys = ["headline", "decode", "sweep_stage_a", "sweep_stage_b",
-        "longcontext", "resnet50", "bench_data", "continuous"]
+keys = [k for key, _, _, extras in STAGES for k in (key, *extras)]
 sys.exit(0 if d and all(k in d and not _is_error(d[k]) for k in keys)
          else 1)
 EOF

@@ -101,6 +101,43 @@ def _is_error(rec) -> bool:
     return False
 
 
+_CHIP_DEAD = False
+
+
+def _chip_alive(timeout: int = 150) -> bool:
+    """Tiny compile+execute probe in a subprocess. The relay chip dies
+    mid-window routinely and a dead chip HANGS in-flight work (the r5
+    window burned 2×1200s + 3600s of stage timeouts on a chip that died
+    minutes in) — probing between measurements ends the pass in ~2 min
+    instead. One failure latches: the rest of the pass is skipped and the
+    outer watchdog re-probes before relaunching."""
+    global _CHIP_DEAD
+    if _CHIP_DEAD:
+        return False
+    # CHIP_WINDOW_PROBE_PLATFORM exists for off-chip testing of the
+    # agenda itself: the image's site hook pins the axon platform, so a
+    # plain JAX_PLATFORMS env var cannot redirect the probe
+    plat = os.environ.get("CHIP_WINDOW_PROBE_PLATFORM")
+    code = ((f"import jax\njax.config.update('jax_platforms', {plat!r})\n"
+             if plat else "import jax\n")
+            + "import jax.numpy as jnp\n"
+            "x = jnp.ones((128, 128), jnp.bfloat16)\n"
+            "print(float(jax.jit(lambda a: a @ a)(x).sum()))\n")
+    env = {**os.environ,
+           "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache")}
+    try:
+        alive = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                               capture_output=True, cwd=REPO,
+                               env=env).returncode == 0
+    except subprocess.TimeoutExpired:
+        alive = False
+    if not alive:
+        _CHIP_DEAD = True
+        print("[chip_window] chip probe FAILED — ending this window pass",
+              flush=True)
+    return alive
+
+
 def _run(argv, timeout):
     print(f"[chip_window] $ {' '.join(argv)} "
           f"(t={time.strftime('%H:%M:%S', time.gmtime())})", flush=True)
@@ -130,6 +167,9 @@ def _run(argv, timeout):
 def _json_stage(argv, key, timeout) -> bool:
     """Run ``argv``, record its first JSON stdout line under ``key`` (or an
     error record), return success — the shared shape of every bench stage."""
+    if not _chip_alive():
+        _save(key, {"rc": -9, "error": "chip probe failed"})
+        return False
     proc = _run(argv, timeout)
     line = next((ln for ln in proc.stdout.splitlines()
                  if ln.startswith("{")), None)
@@ -164,19 +204,24 @@ def stage_headline(timeout):
 
 
 def stage_decode(timeout):
+    # primary gets the full compile room; the levers share a stage
+    # deadline so a slow-but-alive chip can't burn 4x timeout here while
+    # stages 4-7 starve (mirrors stage_sweep's bound)
+    deadline = time.monotonic() + 2 * timeout
     if not _json_stage([sys.executable, "tools/driver_bench.py", "--write",
                         "--skip-resnet", "--skip-submit"], "decode", timeout):
         return False
     # the int8-cache and W8A16-weight levers, beside the official number
-    _lever_stage([sys.executable, "tools/driver_bench.py", "--write",
-                  "--skip-resnet", "--skip-submit", "--cache-int8"],
-                 "decode_cache_int8", timeout)
-    _lever_stage([sys.executable, "tools/driver_bench.py", "--write",
-                  "--skip-resnet", "--skip-submit", "--serve-int8"],
-                 "decode_w8a16", timeout)
-    _lever_stage([sys.executable, "tools/driver_bench.py", "--write",
-                  "--skip-resnet", "--skip-submit", "--speculative"],
-                 "decode_speculative", timeout)
+    for flag, key in ((["--cache-int8"], "decode_cache_int8"),
+                      (["--serve-int8"], "decode_w8a16"),
+                      (["--speculative"], "decode_speculative")):
+        remaining = int(deadline - time.monotonic())
+        if remaining < 120:
+            _save(key, {"rc": -8, "error": "deferred: stage deadline"})
+            continue
+        _lever_stage([sys.executable, "tools/driver_bench.py", "--write",
+                      "--skip-resnet", "--skip-submit", *flag], key,
+                     min(timeout, remaining))
     return True
 
 
@@ -219,10 +264,12 @@ def _sweep_specs(specs, key, timeout, wrap=None, deadline=None,
                if s not in {r.get("spec") for r in rows}]
     while pending:
         spec = pending.pop(0)
-        if deadline is not None and time.monotonic() > deadline:
+        over = deadline is not None and time.monotonic() > deadline
+        if over or not _chip_alive():
             # deferred specs get explicit retry rows — otherwise the
             # record reads as complete and is skipped forever
-            print(f"[chip_window] {key}: deadline hit, deferring "
+            print(f"[chip_window] {key}: "
+                  f"{'deadline hit' if over else 'chip dead'}, deferring "
                   f"{1 + len(pending)} specs", flush=True)
             rows.extend({"spec": s, "retry": True, "failed": "deferred"}
                         for s in [spec, *pending])
@@ -250,10 +297,16 @@ def stage_sweep(timeout):
     rows = _sweep_specs(SWEEP_STAGE_A, "sweep_stage_a", per_spec,
                         deadline=deadline)
     ok = [r for r in rows if "step_ms" in r]
-    if not ok:
-        return False
     control = next((r for r in ok if r["spec"] == CONTROL), None)
     if control is None:
+        # distinguish "retry later" (retry rows pending) from "the control
+        # spec failed PERMANENTLY" (an OOM won't heal): the latter must
+        # record a terminal stage-B verdict or the watchdog relaunches a
+        # zero-work pass forever
+        if not any(r.get("retry") for r in rows):
+            _save("sweep_stage_b",
+                  {"rows": [], "exhausted": "control spec unmeasurable — "
+                   "stage B has no baseline"})
         return False
     # winners: levers that beat the control; stage B re-sweeps around them
     winners = []
@@ -282,13 +335,18 @@ def stage_sweep(timeout):
                                              "rows": rows},
                           deadline=deadline, fresh=stale)
     if not any("step_ms" in r for r in rows_b):
-        _save("sweep_stage_b", {"winners": winners, "rows": rows_b,
-                                "error": "no stage-B spec measured"})
+        if not any(r.get("retry") for r in rows_b):
+            # every spec failed permanently: terminal data, not a retry
+            _save("sweep_stage_b", {"winners": winners, "rows": rows_b,
+                                    "exhausted": "no spec measurable"})
         return False
     return True
 
 
 def stage_longcontext(timeout):
+    if not _chip_alive():
+        _save("longcontext", {"rc": -9, "error": "chip probe failed"})
+        return False
     proc = _run([sys.executable, "tools/longcontext_proof.py"], timeout)
     _save("longcontext", {"rc": proc.returncode,
                           "tail": proc.stdout[-2000:],
@@ -372,12 +430,16 @@ def main() -> int:
             ok = fn(args.timeout or timeout)
         except Exception as e:  # noqa: BLE001 — record and continue
             # (timeouts never raise: _run converts them to rc=124 records
-            # with salvaged output)
+            # with salvaged output; _save itself files errors beside an
+            # existing success rather than clobbering it)
             ok = False
-            err = {"error": f"{type(e).__name__}: {e}"}
-            _save(key + "_error" if key in _load() else key, err)
+            _save(key, {"error": f"{type(e).__name__}: {e}"})
         print(f"[chip_window] stage {i} ({key}): {'ok' if ok else 'FAILED'}",
               flush=True)
+        if _CHIP_DEAD:
+            print("[chip_window] chip dead — abandoning this pass "
+                  "(watchdog will relaunch)", flush=True)
+            return 2
     return 0
 
 
