@@ -1,0 +1,204 @@
+"""Resume semantics of the chip-window measurement runbook.
+
+The tunnelled v5e dies mid-window routinely (CHIPWINDOW_r05.json history:
+three stage timeouts burned 100 minutes against a dead chip), so the
+runbook's value IS its bookkeeping: measurements survive crashes, timeouts
+retry, permanent failures don't livelock the watchdog, and an error never
+overwrites a measured success. These tests pin that bookkeeping with stub
+measurement scripts — no TPU, no jax.
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def cw(tmp_path, monkeypatch):
+    """A chip_window module instance whose repo root, results file, and
+    measurement children all live in an isolated sandbox."""
+    spec = importlib.util.spec_from_file_location(
+        "chip_window_under_test",
+        os.path.join(ROOT, "tools", "chip_window.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    (tmp_path / "tools").mkdir()
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+    monkeypatch.setattr(mod, "OUT", str(tmp_path / "CHIPWINDOW.json"))
+    # liveness probes always pass: these tests exercise bookkeeping, not
+    # the probe (which needs a real backend)
+    monkeypatch.setattr(mod, "_chip_alive", lambda timeout=150: True)
+    # stub children must not pay the image site hook's multi-second jax
+    # import (it rides PYTHONPATH): with it, a 5s child timeout is ~1s of
+    # real margin and the suite takes ~70s for microseconds of stub work
+    monkeypatch.setenv("PYTHONPATH", "")
+    return mod
+
+
+def _stub_sweep(cw_mod, body: str) -> None:
+    path = os.path.join(cw_mod.REPO, "tools", "perf_sweep.py")
+    with open(path, "w") as f:
+        f.write("import sys, time\nspec = sys.argv[1]\n" + body)
+
+
+ROW = ('print(f"{spec:45s} step={ms:7.1f}ms tok/s=  57000.0 '
+       'MFU={mfu:.4f} (compile+warmup 1s)", flush=True)\n')
+
+
+class TestIsError:
+    def test_stage_level_errors(self, cw):
+        assert cw._is_error({"error": "boom"})
+        assert cw._is_error({"rc": 124})
+        assert not cw._is_error({"metric": "m", "value": 1})
+
+    def test_retry_rows_mark_sweeps_incomplete(self, cw):
+        assert cw._is_error([{"spec": "a", "retry": True}])
+        assert cw._is_error({"winners": [], "rows": [{"retry": True}]})
+
+    def test_permanent_failure_rows_are_data(self, cw):
+        # an OOM row retries never — the stage is complete with it
+        assert not cw._is_error([{"spec": "a", "step_ms": 1.0},
+                                 {"spec": "b", "failed": "OOM"}])
+        assert not cw._is_error({"rows": [{"spec": "a", "exhausted": 1}],
+                                 "exhausted": "no baseline"})
+
+
+class TestSave:
+    def test_error_never_clobbers_success(self, cw):
+        cw._save("decode", {"metric": "decode_tokens_per_sec", "value": 9})
+        cw._save("decode", {"rc": 124, "error": "timeout"})
+        data = cw._load()
+        assert data["decode"]["value"] == 9
+        assert data["decode_error"]["rc"] == 124
+
+    def test_success_retires_stale_error(self, cw):
+        # success, then error (filed beside it), then a fresh success:
+        # the stale headline_error record must be retired
+        cw._save("headline", {"metric": "m", "value": 1})
+        cw._save("headline", {"error": "timeout"})
+        assert cw._load()["headline_error"]["error"] == "timeout"
+        cw._save("headline", {"metric": "m", "value": 2})
+        data = cw._load()
+        assert data["headline"]["value"] == 2
+        assert "headline_error" not in data
+
+    def test_row_lists_with_retry_rows_still_save(self, cw):
+        # incremental sweep progress is a superset of what it replaces —
+        # the clobber guard must not divert it
+        cw._save("sweep_stage_a", [{"spec": "a", "step_ms": 1.0}])
+        cw._save("sweep_stage_a", [{"spec": "a", "step_ms": 1.0},
+                                   {"spec": "b", "retry": True}])
+        assert len(cw._load()["sweep_stage_a"]) == 2
+
+
+class TestSweepResume:
+    def test_timeout_row_retries_and_measured_rows_do_not(self, cw):
+        _stub_sweep(cw, (
+            "import os\n"
+            "if 'pallas' in spec and not os.path.exists('mark'):\n"
+            "    open('mark', 'w').close(); time.sleep(60)\n"
+            "ms, mfu = (198.0, 0.58) if 'hint8' in spec else (205.0, 0.54)\n"
+            + ROW))
+        rows = cw._sweep_specs(cw.SWEEP_STAGE_A, "sweep_stage_a", 5)
+        assert sum("step_ms" in r for r in rows) == 3
+        assert any(r.get("retry") for r in rows)
+        # second pass: pallas recovers, measured rows are NOT re-run
+        # (the stub would sleep again if re-invoked with the mark cleared)
+        rows = cw._sweep_specs(cw.SWEEP_STAGE_A, "sweep_stage_a", 5)
+        assert sum("step_ms" in r for r in rows) == 4
+        assert not any(r.get("retry") for r in rows)
+
+    def test_in_process_failures_are_kept_as_data(self, cw):
+        _stub_sweep(cw, (
+            "if 'aint8' in spec:\n"
+            "    print(f'{spec:45s} FAILED: RESOURCE_EXHAUSTED', flush=True)\n"
+            "    sys.exit(0)\n"
+            "ms, mfu = 205.0, 0.54\n" + ROW))
+        rows = cw._sweep_specs(cw.SWEEP_STAGE_A, "sweep_stage_a", 30)
+        failed = [r for r in rows if "failed" in r]
+        assert len(failed) == 1 and not failed[0].get("retry")
+        # the stage record reads complete: an OOM won't heal by retrying
+        assert not cw._is_error(cw._load()["sweep_stage_a"])
+
+    def test_control_oom_records_terminal_stage_b_verdict(self, cw):
+        # a permanently-failed control must not livelock the watchdog in
+        # zero-work relaunches: stage B gets a terminal non-error verdict
+        _stub_sweep(cw, (
+            "if spec.endswith('batch=12'):\n"
+            "    print(f'{spec:45s} FAILED: RESOURCE_EXHAUSTED', flush=True)\n"
+            "    sys.exit(0)\n"
+            "ms, mfu = 205.0, 0.54\n" + ROW))
+        assert cw.stage_sweep(30) is False
+        data = cw._load()
+        assert data["sweep_stage_b"]["exhausted"]
+        assert not cw._is_error(data["sweep_stage_a"])
+        assert not cw._is_error(data["sweep_stage_b"])
+
+    def test_winner_change_restarts_stage_b(self, cw):
+        _stub_sweep(cw, (
+            "ms, mfu = (198.0, 0.58) if 'hint8' in spec else (205.0, 0.54)\n"
+            + ROW))
+        assert cw.stage_sweep(30)
+        b1 = cw._load()["sweep_stage_b"]
+        assert b1["winners"] == ["hint8=1"]
+        assert all("hint8" in r["spec"] for r in b1["rows"])
+        # pallas becomes the (only) winner: stage B rows measured under
+        # the old combo would be misattributed — they must be discarded
+        cw._save("sweep_stage_a", [])
+        _stub_sweep(cw, (
+            "ms, mfu = (185.0, 0.60) if 'pallas' in spec else (205.0, 0.54)\n"
+            + ROW))
+        assert cw.stage_sweep(30)
+        b2 = cw._load()["sweep_stage_b"]
+        assert b2["winners"] == ["i8impl=pallas"]
+        assert all("pallas" in r["spec"] or "dots_kernels" in r["spec"]
+                   for r in b2["rows"])
+
+    def test_deadline_defers_with_retry_rows(self, cw):
+        # a deadline already in the past: every spec must defer with an
+        # explicit retry row (silently-unlaunched specs would read as a
+        # complete stage and be skipped forever)
+        _stub_sweep(cw, "ms, mfu = 205.0, 0.54\n" + ROW)
+        rows = cw._sweep_specs(cw.SWEEP_STAGE_A, "sweep_stage_a", 30,
+                               deadline=-1.0)
+        assert len(rows) == len(cw.SWEEP_STAGE_A)
+        assert all(r.get("retry") and r["failed"] == "deferred"
+                   for r in rows)
+        assert cw._is_error(cw._load()["sweep_stage_a"])
+
+
+class TestJsonStage:
+    def test_salvaged_json_from_timed_out_child_is_retried(self, cw):
+        path = os.path.join(cw.REPO, "tools", "hang_bench.py")
+        with open(path, "w") as f:
+            f.write("import time\n"
+                    "print('{\"metric\": \"m\", \"value\": 1}', flush=True)\n"
+                    "time.sleep(60)\n")
+        ok = cw._json_stage([sys.executable, path], "headline", 5)
+        assert not ok
+        rec = cw._load()["headline"]
+        assert rec["rc"] == 124 and rec["salvaged"]["value"] == 1
+        assert cw._is_error(rec)
+
+
+class TestDecodeDeadline:
+    def test_levers_defer_past_stage_deadline(self, cw):
+        path = os.path.join(cw.REPO, "tools", "driver_bench.py")
+        with open(path, "w") as f:
+            f.write("print('{\"metric\": \"decode_tokens_per_sec\", "
+                    "\"value\": 2}')\n")
+        # a 2*timeout=16s stage deadline leaves <120s after the primary:
+        # every lever must defer with a retryable record — not silently
+        # vanish
+        assert cw.stage_decode(8)
+        data = cw._load()
+        assert data["decode"]["value"] == 2
+        for k in ("decode_cache_int8", "decode_w8a16", "decode_speculative"):
+            assert cw._is_error(data[k])
