@@ -2,11 +2,34 @@
 # Probe the tunnelled TPU with a tiny compile+execute every POLL seconds;
 # the moment it answers, run the chip-window agenda (tools/chip_window.py,
 # which resumes: stages already measured are skipped, errored ones retried).
-# Loops forever: if the chip dies mid-window, the next healthy probe
+# Loops until done: if the chip dies mid-window, the next healthy probe
 # relaunches the remaining stages. Log: chip_watchdog.log.
+#
+# STOP_AT (unix epoch, default launch+8h) is a hard deadline: past it the
+# loop exits, any in-flight window pass is killed, and straggler
+# measurement children are reaped — the watchdog must NEVER contend with
+# the round driver's own end-of-round bench for the single chip.
 POLL=${POLL:-300}
+STOP_AT=${STOP_AT:-$(( $(date +%s) + 28800 ))}
 cd "$(dirname "$0")/.." || exit 1
+
+reap_children() {
+  # measurement children spawned by a killed chip_window would otherwise
+  # orphan onto the chip
+  pkill -f "tools/chip_window.py" 2>/dev/null
+  pkill -f "tools/perf_sweep.py" 2>/dev/null
+  pkill -f "tools/driver_bench.py" 2>/dev/null
+  pkill -f "tools/longcontext_proof.py" 2>/dev/null
+  pkill -f "bench\.py" 2>/dev/null
+}
+
 while true; do
+  now=$(date +%s)
+  if [ "$now" -ge "$STOP_AT" ]; then
+    echo "[watchdog] $(date -u +%H:%M:%S) STOP_AT reached — exiting" >> chip_watchdog.log
+    reap_children
+    exit 0
+  fi
   if timeout 150 python - <<'EOF' >/dev/null 2>&1
 import jax, jax.numpy as jnp
 x = jnp.ones((256, 256), jnp.bfloat16)
@@ -14,8 +37,12 @@ float(jax.jit(lambda a: a @ a)(x).sum())
 EOF
   then
     echo "[watchdog] $(date -u +%H:%M:%S) chip ANSWERED — running window" >> chip_watchdog.log
-    python tools/chip_window.py >> chip_window_run.log 2>&1
-    echo "[watchdog] $(date -u +%H:%M:%S) window pass done (rc=$?)" >> chip_watchdog.log
+    # the window pass cannot outlive STOP_AT: bound it to the remaining
+    # budget and reap any orphaned measurement children after
+    timeout $(( STOP_AT - $(date +%s) )) python tools/chip_window.py >> chip_window_run.log 2>&1
+    rc=$?
+    [ "$rc" -eq 124 ] && reap_children
+    echo "[watchdog] $(date -u +%H:%M:%S) window pass done (rc=$rc)" >> chip_watchdog.log
     # if everything measured cleanly, stop looping
     python - <<'EOF' && break
 import sys
