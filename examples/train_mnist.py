@@ -16,7 +16,9 @@ import optax
 
 from examples.common import bring_up, standard_parser, StepTimer
 from tpu_on_k8s.data import DataLoader, FixedRecordDataset, write_records
+from tpu_on_k8s.data.prefetch import device_prefetch
 from tpu_on_k8s.models.vision import MnistCNN, vision_partition_rules
+from tpu_on_k8s.parallel.mesh import data_sharding
 from tpu_on_k8s.train.vision import ClassifierTrainer
 
 
@@ -47,18 +49,23 @@ def main(argv=None) -> float:
     example = jnp.zeros((args.batch_per_host, 28, 28, 1), jnp.float32)
     state = trainer.init_state(jax.random.key(args.seed), example)
     timer = StepTimer(args.batch_per_host, ctx)
-    loss = float("nan")
-    for step in range(args.steps):
-        batch = next(loader)
-        images = (batch[:, :784].astype(np.float32) / 255.0).reshape(-1, 28, 28, 1)
-        labels = batch[:, 784]
-        images, labels = trainer.shard_batch(jnp.asarray(images),
-                                             jnp.asarray(labels))
-        state, metrics = trainer.train_step(state, images, labels)
-        loss = float(metrics["loss"])
-        timer.report(step, loss, float(metrics["accuracy"]))
+
+    def split(batch):
+        # host-side transform inside the prefetch ring: the H2D copy of
+        # batch N+1 overlaps step N
+        images = (batch[:, :784].astype(np.float32) / 255.0
+                  ).reshape(-1, 28, 28, 1)
+        return images, batch[:, 784]
+
+    batches = device_prefetch(loader, data_sharding(mesh), depth=2,
+                              transform=split)
+    # the zero-stall loop: metrics stay on device between report windows
+    result = trainer.fit(
+        state, batches, args.steps, log_every=1,
+        on_metrics=lambda step, m, dt:
+            timer.report(step - 1, m["loss"], m["accuracy"]))
     loader.close()
-    return loss
+    return result.last_metrics.get("loss", float("nan"))
 
 
 if __name__ == "__main__":

@@ -188,6 +188,64 @@ class TestJsonStage:
         assert cw._is_error(rec)
 
 
+def _stub_driver_bench(cw_mod, value=3):
+    """driver_bench stand-in that logs each invocation's args (ADVICE r5:
+    measured primaries must not re-run on a lever-only retry pass)."""
+    path = os.path.join(cw_mod.REPO, "tools", "driver_bench.py")
+    with open(path, "w") as f:
+        f.write("import sys\n"
+                "with open('calls.log', 'a') as f:\n"
+                "    f.write(' '.join(sys.argv[1:]) + '\\n')\n"
+                f"print('{{\"metric\": \"m\", \"value\": {value}}}')\n")
+
+
+def _calls(cw_mod):
+    path = os.path.join(cw_mod.REPO, "calls.log")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [ln.strip() for ln in f if ln.strip()]
+
+
+class TestPrimaryResumeSkip:
+    def test_decode_primary_not_rerun_on_lever_retry(self, cw):
+        # a prior window measured the primary but deferred the levers
+        cw._save("decode", {"metric": "decode_tokens_per_sec", "value": 9})
+        for k in ("decode_cache_int8", "decode_w8a16", "decode_speculative"):
+            cw._save(k, {"rc": -8, "error": "deferred: stage deadline"})
+        _stub_driver_bench(cw)
+        # timeout 120 keeps the stage deadline's 120s lever floor satisfied
+        assert cw.stage_decode(120)
+        calls = _calls(cw)
+        assert len(calls) == 3, calls
+        assert all(("--cache-int8" in c or "--serve-int8" in c
+                    or "--speculative" in c) for c in calls)
+        data = cw._load()
+        assert data["decode"]["value"] == 9  # the measured primary survived
+        assert all(data[k]["value"] == 3
+                   for k in ("decode_cache_int8", "decode_w8a16",
+                             "decode_speculative"))
+
+    def test_decode_primary_error_is_rerun(self, cw):
+        cw._save("decode", {"rc": 124, "error": "timeout"})
+        _stub_driver_bench(cw)
+        assert cw.stage_decode(120)
+        assert any("--cache-int8" not in c and "--serve-int8" not in c
+                   and "--speculative" not in c for c in _calls(cw))
+        assert cw._load()["decode"]["value"] == 3
+
+    def test_continuous_primary_and_lever_skip_when_measured(self, cw):
+        cw._save("continuous", {"metric": "m", "value": 5})
+        cw._save("continuous_h8", {"rc": 124, "error": "timeout"})
+        _stub_driver_bench(cw)
+        assert cw.stage_continuous(30)
+        calls = _calls(cw)
+        assert len(calls) == 1 and "--horizon" in calls[0]
+        data = cw._load()
+        assert data["continuous"]["value"] == 5
+        assert data["continuous_h8"]["value"] == 3
+
+
 class TestDecodeDeadline:
     def test_levers_defer_past_stage_deadline(self, cw):
         path = os.path.join(cw.REPO, "tools", "driver_bench.py")

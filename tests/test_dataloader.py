@@ -234,7 +234,7 @@ def test_bench_data_fed_training_loop(tmp_path):
     first = next(batches)
     assert first.shape == (batch, seqlen + 1)
     state = trainer.init_state(jax.random.key(0), first[:, :-1])
-    state, dt = bench._timed_steps(trainer, state, batches, 3)
+    state, dt = bench._timed_steps(trainer.train_step, state, batches, 3)
     assert dt > 0
     state, metrics = trainer.train_step(state, next(batches))
     assert bool(jnp.isfinite(metrics["loss"]))

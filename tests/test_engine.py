@@ -94,6 +94,46 @@ class TestLifecycle:
         assert w1.spec.node_selector[constants.NODE_SELECTOR_TPU_TOPOLOGY] == "2x4"
         assert w1.spec.containers[0].resources.requests[constants.RESOURCE_TPU] == 4
 
+    def test_compile_cache_and_perf_env_injected(self):
+        """Every slice host shares a node-local warm XLA compile cache and
+        the async-collective latency-hiding flags (train/compile.py reads
+        exactly this contract)."""
+        cluster, manager, engine, sim = make_env()
+        submit_job(cluster, job_spec())
+        manager.run_until_idle()
+        sim.run_pod("default", "j1-master-0")
+        manager.run_until_idle()
+        for pod in pods_of(cluster):
+            env = pod.spec.containers[0].env_map()
+            assert (env[constants.ENV_JAX_COMPILATION_CACHE_DIR]
+                    == constants.DEFAULT_COMPILE_CACHE_DIR)
+            assert (env[constants.ENV_LIBTPU_INIT_ARGS]
+                    == constants.LIBTPU_PERF_ARGS)
+            vols = {v.name: v for v in pod.spec.volumes}
+            assert (vols[constants.COMPILE_CACHE_VOLUME].host_path
+                    == constants.DEFAULT_COMPILE_CACHE_DIR)
+            mounts = {m.name: m.mount_path
+                      for m in pod.spec.containers[0].volume_mounts}
+            assert (mounts[constants.COMPILE_CACHE_VOLUME]
+                    == constants.DEFAULT_COMPILE_CACHE_DIR)
+
+    def test_user_perf_env_wins_over_injection(self):
+        """Setdefault semantics: a cache dir / LIBTPU flags the user set in
+        the pod template must survive the reconciler's injection."""
+        cluster, manager, engine, sim = make_env()
+        job = job_spec()
+        container = job.spec.tasks[TaskType.WORKER].template.spec.containers[0]
+        container.set_env(constants.ENV_JAX_COMPILATION_CACHE_DIR, "/my/cache")
+        container.set_env(constants.ENV_LIBTPU_INIT_ARGS, "--my_flag=1")
+        submit_job(cluster, job)
+        manager.run_until_idle()
+        sim.run_pod("default", "j1-master-0")
+        manager.run_until_idle()
+        pods = {p.metadata.name: p for p in pods_of(cluster)}
+        env = pods["j1-worker-0"].spec.containers[0].env_map()
+        assert env[constants.ENV_JAX_COMPILATION_CACHE_DIR] == "/my/cache"
+        assert env[constants.ENV_LIBTPU_INIT_ARGS] == "--my_flag=1"
+
     def test_services_per_replica_headless(self):
         cluster, manager, engine, sim = make_env()
         submit_job(cluster, job_spec())

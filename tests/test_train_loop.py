@@ -1,0 +1,381 @@
+"""Zero-stall TrainLoop + compile-cache/AOT subsystem (CPU-backed).
+
+Pins the loop's four contracts (ISSUE 1 acceptance):
+* metric sync cadence — at most ⌈steps/log_every⌉ host transfers per run,
+  counted by wrapping the loop module's single host-transfer point;
+* bounded async dispatch — backpressure past ``max_inflight`` uses device
+  waits, never extra host transfers;
+* non-blocking checkpoints — every mid-run save enqueues with
+  ``wait=False``; draining happens only at exit / on simulated preemption
+  (which also persists the stopping point);
+* watchdog — an artificially stalled step surfaces as a structured event
+  instead of a silent hang.
+Plus: ``compiled.cost_analysis()`` FLOPs within tolerance of the analytic
+6·N·T count (the new MFU denominator), and the TrainMetrics gauge feed.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tpu_on_k8s.train.loop as loop_mod
+from tpu_on_k8s.metrics import TrainMetrics
+from tpu_on_k8s.train.compile import (
+    analytic_train_flops,
+    compiled_flops,
+    setup_compilation_cache,
+    train_step_flops,
+)
+from tpu_on_k8s.train.loop import LoopResult, TrainLoop
+
+
+@jax.jit
+def _toy_step(state, batch):
+    new = {"x": state["x"] + jnp.sum(batch)}
+    return new, {"loss": jnp.mean(batch), "step": state["x"]}
+
+
+def _toy_state():
+    return {"x": jnp.zeros((), jnp.float32)}
+
+
+def _repeat(x):
+    while True:
+        yield x
+
+
+def _batches():
+    return _repeat(jnp.ones((4,), jnp.float32))
+
+
+@pytest.fixture()
+def sync_counter(monkeypatch):
+    """Count host transfers by wrapping THE host-transfer point."""
+    calls = {"host": 0, "device": 0}
+    real_sync, real_wait = loop_mod._host_sync, loop_mod._device_wait
+
+    def counting_sync(tree):
+        calls["host"] += 1
+        return real_sync(tree)
+
+    def counting_wait(tree):
+        calls["device"] += 1
+        return real_wait(tree)
+
+    monkeypatch.setattr(loop_mod, "_host_sync", counting_sync)
+    monkeypatch.setattr(loop_mod, "_device_wait", counting_wait)
+    return calls
+
+
+class TestSyncCadence:
+    def test_at_most_ceil_steps_over_log_every_host_syncs(self, sync_counter):
+        loop = TrainLoop(_toy_step, _toy_state(), _batches(), log_every=3)
+        result = loop.run(10)
+        assert result.steps == 10
+        assert result.host_syncs == 4          # ceil(10/3)
+        assert sync_counter["host"] == 4
+        assert [s for s, _ in result.history] == [3, 6, 9, 10]
+        # window metrics are real host floats, not device arrays
+        assert isinstance(result.last_metrics["loss"], float)
+
+    def test_single_sync_when_log_every_covers_run(self, sync_counter):
+        result = TrainLoop(_toy_step, _toy_state(), _batches(),
+                           log_every=50).run(20)
+        assert result.host_syncs == 1 and sync_counter["host"] == 1
+
+    def test_exhausted_batches_sync_partial_window(self, sync_counter):
+        batches = iter([jnp.ones((4,), jnp.float32)] * 5)
+        result = TrainLoop(_toy_step, _toy_state(), batches,
+                           log_every=4).run(100)
+        assert result.steps == 5
+        assert [s for s, _ in result.history] == [4, 5]
+
+    def test_on_metrics_callback_sees_host_values(self):
+        seen = []
+        TrainLoop(_toy_step, _toy_state(), _batches(), log_every=2,
+                  on_metrics=lambda step, m, dt: seen.append((step, m, dt))
+                  ).run(4)
+        assert [s for s, _, _ in seen] == [2, 4]
+        assert all(isinstance(m["loss"], float) for _, m, _ in seen)
+        assert all(dt > 0 for _, _, dt in seen)
+
+
+class TestBoundedDispatch:
+    def test_backpressure_uses_device_waits_not_host_syncs(self, sync_counter):
+        loop = TrainLoop(_toy_step, _toy_state(), _batches(), log_every=10,
+                         max_inflight=2)
+        result = loop.run(10)
+        # steps 3..10 each push pending past 2 → 8 backpressure device
+        # waits, plus 1 drain wait at the window sync (pending=2, last one
+        # crosses to host); the host sync count is untouched
+        assert sync_counter["device"] == 9
+        assert result.host_syncs == 1 and sync_counter["host"] == 1
+
+    def test_default_bound_adds_no_waits_beyond_window_drain(self, sync_counter):
+        result = TrainLoop(_toy_step, _toy_state(), _batches(),
+                           log_every=5).run(10)
+        # each window drains its pending steps with device waits (heartbeat
+        # food) and host-transfers only the last: exactly steps − syncs
+        # waits means backpressure never fired at the default bound
+        assert sync_counter["device"] == 10 - result.host_syncs
+        assert result.host_syncs == 2 == sync_counter["host"]
+
+    def test_bad_config_rejected(self):
+        with pytest.raises(ValueError):
+            TrainLoop(_toy_step, _toy_state(), _batches(), log_every=0)
+        with pytest.raises(ValueError):
+            TrainLoop(_toy_step, _toy_state(), _batches(), max_inflight=0)
+
+
+class _FakeManager:
+    """Records the orbax CheckpointManager calls the loop makes."""
+
+    def __init__(self):
+        self.events = []
+
+    def save(self, state, *, step, generation=0, wait=True):
+        self.events.append(("save", step, generation, wait))
+
+    def wait_until_finished(self):
+        self.events.append(("drain",))
+
+
+class TestAsyncCheckpoints:
+    def test_saves_enqueue_nonblocking_and_drain_at_exit(self):
+        mgr = _FakeManager()
+        result = TrainLoop(_toy_step, _toy_state(), _batches(), log_every=3,
+                           checkpoint_manager=mgr, checkpoint_every=2,
+                           generation=7).run(5)
+        saves = [e for e in mgr.events if e[0] == "save"]
+        assert [s[1] for s in saves] == [2, 4]
+        assert all(s[2] == 7 and s[3] is False for s in saves)
+        assert mgr.events[-1] == ("drain",)
+        assert result.checkpoints_enqueued == 2
+
+    def test_preemption_saves_stopping_point_then_drains(self, sync_counter):
+        mgr = _FakeManager()
+        fired = {"n": 0}
+
+        def preempted():
+            fired["n"] += 1
+            return fired["n"] > 3          # notice arrives before step 4
+
+        result = TrainLoop(_toy_step, _toy_state(), _batches(), log_every=10,
+                           checkpoint_manager=mgr, checkpoint_every=100,
+                           preemption_signal=preempted).run(10)
+        assert result.preempted and result.steps == 3
+        # the partial window synced before the final save
+        assert [s for s, _ in result.history] == [3]
+        assert mgr.events == [("save", 3, 0, False), ("drain",)]
+
+    def test_stop_requests_clean_preemption(self):
+        mgr = _FakeManager()
+        loop = TrainLoop(_toy_step, _toy_state(), _batches(), log_every=2,
+                         checkpoint_manager=mgr,
+                         on_metrics=lambda step, m, dt:
+                             loop.stop() if step >= 4 else None)
+        result = loop.run(100)
+        assert result.preempted and result.steps == 4
+        assert mgr.events[-2:] == [("save", 4, 0, False), ("drain",)]
+
+
+class TestWatchdog:
+    def test_stalled_step_fires_structured_event(self):
+        events = []
+        metrics = TrainMetrics(registry=None)
+
+        def slow_step(state, batch):
+            time.sleep(0.5)                # an artificially hung step
+            return state, {"loss": jnp.float32(1.0)}
+
+        TrainLoop(slow_step, _toy_state(), _batches(), log_every=1,
+                  stall_timeout=0.1, on_stall=events.append,
+                  metrics=metrics).run(2)
+        assert events, "watchdog never fired on a stalled step"
+        ev = events[0]
+        assert ev["event"] == "stalled_step"
+        assert ev["seconds_since_progress"] > 0.1
+        assert ev["stall_timeout"] == 0.1
+        assert metrics.counters["stalled_steps"] >= 1
+
+    def test_healthy_run_emits_no_stall_events(self):
+        events = []
+        TrainLoop(_toy_step, _toy_state(), _batches(), log_every=2,
+                  stall_timeout=30.0, on_stall=events.append).run(6)
+        assert events == []
+
+    def test_long_window_with_per_step_progress_is_not_a_stall(self):
+        """A window whose total compute exceeds stall_timeout must not
+        false-fire as long as individual steps keep completing: the window
+        drain waits feed the heartbeat step by step."""
+        events = []
+
+        def step_with_slow_device(state, batch):
+            # dispatch is fast; completion (observed at the drain wait)
+            # arrives per-step — emulate with a host-side pause at drain
+            # time via a metrics thunk is impossible with real arrays, so
+            # pace the dispatches themselves just under the timeout
+            time.sleep(0.05)
+            return state, {"loss": jnp.float32(1.0)}
+
+        TrainLoop(step_with_slow_device, _toy_state(), _batches(),
+                  log_every=8, stall_timeout=0.2,
+                  on_stall=events.append).run(8)
+        # 8 steps × 0.05s = 0.4s window >> 0.2s stall_timeout, yet each
+        # step's progress touched the heartbeat
+        assert events == []
+
+    def test_watchdog_thread_stops_with_loop(self):
+        import threading
+
+        before = {t.name for t in threading.enumerate()}
+        TrainLoop(_toy_step, _toy_state(), _batches(), log_every=2,
+                  stall_timeout=5.0).run(4)
+        lingering = [t for t in threading.enumerate()
+                     if t.name == "trainloop-watchdog"
+                     and t.name not in before]
+        assert not lingering
+
+
+class TestMetricsFeed:
+    def test_gauges_fed_per_window(self):
+        metrics = TrainMetrics(registry=None)
+        TrainLoop(_toy_step, _toy_state(), _batches(), log_every=2,
+                  metrics=metrics, tokens_per_step=64,
+                  flops_per_step=1e6, peak_flops=1e9).run(4)
+        assert metrics.counters["host_syncs"] == 2
+        assert metrics.gauges["step_seconds"] > 0
+        assert metrics.gauges["tokens_per_sec"] > 0
+        assert metrics.gauges["steps_inflight"] == 2.0  # depth at window close
+        # toy steps run in microseconds, so the "MFU" here is just the
+        # formula flops_per_step / step_seconds / peak — assert it's fed
+        assert metrics.gauges["mfu"] > 0
+
+
+class TestCostAnalysisFlops:
+    def test_train_step_flops_within_tolerance_of_6nt(self):
+        """The exact (cost-analysis) count sits in the analytic 6·N·T
+        estimate's neighborhood: below it by roughly the embedding share
+        (gathers do no matmul FLOPs), above it when attention dominates —
+        a units/plumbing error would be off by orders of magnitude."""
+        import bench
+        from tpu_on_k8s.models.transformer import (
+            Transformer, TransformerConfig, flagship_partition_rules)
+        from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+        from tpu_on_k8s.train.trainer import Trainer, default_optimizer
+
+        cfg = dataclasses.replace(TransformerConfig.tiny(), remat=False)
+        mesh = create_mesh(MeshConfig(data=1, fsdp=1, model=1, seq=1),
+                           jax.devices()[:1])
+        trainer = Trainer(Transformer(cfg), flagship_partition_rules(), mesh,
+                          default_optimizer(warmup_steps=1, decay_steps=10))
+        batch, seqlen = 2, 32
+        tokens = jax.random.randint(jax.random.key(1), (batch, seqlen + 1),
+                                    0, cfg.vocab_size, dtype=jnp.int32)
+        state = trainer.init_state(jax.random.key(0), tokens[:, :-1])
+        sharded = trainer.shard_batch(tokens)
+        flops, compiled = train_step_flops(trainer, state, sharded)
+        assert flops is not None and flops > 0
+        analytic = analytic_train_flops(bench.n_params(cfg), batch * seqlen)
+        assert 0.3 < flops / analytic < 1.7
+        # the AOT executable is directly loop-drivable (donation intact)
+        result = TrainLoop(lambda s, b: compiled(s, b), state,
+                           _repeat(sharded), log_every=2).run(2)
+        assert np.isfinite(result.last_metrics["loss"])
+
+    def test_compiled_flops_handles_backends_without_cost_analysis(self):
+        class NoAnalysis:
+            def cost_analysis(self):
+                raise NotImplementedError
+
+        class EmptyAnalysis:
+            def cost_analysis(self):
+                return []
+
+        assert compiled_flops(NoAnalysis()) is None
+        assert compiled_flops(EmptyAnalysis()) is None
+
+
+class TestCompilationCacheSetup:
+    def test_env_default_and_explicit_dir(self, tmp_path, monkeypatch):
+        from tpu_on_k8s.api import constants
+
+        monkeypatch.delenv(constants.ENV_JAX_COMPILATION_CACHE_DIR,
+                           raising=False)
+        # conftest already points the suite at tests/.jax_cache; restore it
+        # after poking the config
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            assert setup_compilation_cache() is None  # no env, no arg: no-op
+            d = tmp_path / "cache"
+            assert setup_compilation_cache(str(d)) == str(d)
+            assert d.is_dir()
+            assert jax.config.jax_compilation_cache_dir == str(d)
+            monkeypatch.setenv(constants.ENV_JAX_COMPILATION_CACHE_DIR,
+                               str(tmp_path / "env_cache"))
+            assert setup_compilation_cache() == str(tmp_path / "env_cache")
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+
+    def test_apply_perf_env_respects_existing(self):
+        from tpu_on_k8s.api import constants
+        from tpu_on_k8s.train.compile import apply_perf_env, perf_env
+
+        env = {}
+        apply_perf_env(env)
+        assert env[constants.ENV_LIBTPU_INIT_ARGS] == constants.LIBTPU_PERF_ARGS
+        env2 = {constants.ENV_LIBTPU_INIT_ARGS: "--mine=1"}
+        apply_perf_env(env2)
+        assert env2[constants.ENV_LIBTPU_INIT_ARGS] == "--mine=1"
+        # the reconciler contract is readable from one place
+        contract = perf_env()
+        assert contract[constants.ENV_JAX_COMPILATION_CACHE_DIR] \
+            == constants.DEFAULT_COMPILE_CACHE_DIR
+
+
+class TestTrainerFit:
+    def test_lm_trainer_fit_drives_loop(self, sync_counter):
+        from tpu_on_k8s.models.transformer import (
+            Transformer, TransformerConfig, flagship_partition_rules)
+        from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+        from tpu_on_k8s.train.trainer import Trainer, default_optimizer
+
+        cfg = TransformerConfig.tiny()
+        mesh = create_mesh(MeshConfig(data=1, fsdp=1, model=1, seq=1),
+                           jax.devices()[:1])
+        trainer = Trainer(Transformer(cfg), flagship_partition_rules(), mesh,
+                          default_optimizer(warmup_steps=1, decay_steps=10))
+        tokens = jax.random.randint(jax.random.key(1), (2, 17), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        state = trainer.init_state(jax.random.key(0), tokens[:, :-1])
+        sharded = trainer.shard_batch(tokens)
+        result = trainer.fit(state, _repeat(sharded), 4, log_every=2)
+        assert isinstance(result, LoopResult)
+        assert result.host_syncs == 2 == sync_counter["host"]
+        assert np.isfinite(result.last_metrics["loss"])
+        assert int(jax.device_get(result.state.step)) == 4
+
+    def test_classifier_fit_unpacks_image_label_batches(self):
+        import optax
+
+        from tpu_on_k8s.models.vision import MnistCNN, vision_partition_rules
+        from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+        from tpu_on_k8s.train.vision import ClassifierTrainer
+
+        mesh = create_mesh(MeshConfig(data=8, fsdp=1, model=1, seq=1))
+        trainer = ClassifierTrainer(MnistCNN(), vision_partition_rules(),
+                                    mesh, optax.adam(1e-3))
+        images = jax.random.normal(jax.random.key(0), (16, 28, 28, 1))
+        labels = jnp.arange(16) % 10
+        images, labels = trainer.shard_batch(images, labels)
+        state = trainer.init_state(jax.random.key(1), images)
+        result = trainer.fit(state, _repeat((images, labels)), 3,
+                             log_every=3)
+        assert result.steps == 3 and result.host_syncs == 1
+        assert np.isfinite(result.last_metrics["loss"])
+        assert 0.0 <= result.last_metrics["accuracy"] <= 1.0

@@ -11,16 +11,25 @@
 # the round driver's own end-of-round bench for the single chip.
 POLL=${POLL:-300}
 STOP_AT=${STOP_AT:-$(( $(date +%s) + 28800 ))}
+# never start a window pass with less than this much budget left: timeout 0
+# means UNBOUNDED and a negative value is rc-125 silently skipped — both
+# would break the STOP_AT contract
+MIN_WINDOW=60
 cd "$(dirname "$0")/.." || exit 1
 
+# The window pass runs as its own session/process group (setsid below), so
+# reaping kills exactly the children WE launched via the group id. A
+# host-global `pkill -f bench.py` here would kill the round driver's own
+# end-of-round bench — the exact process the STOP_AT guard protects.
+CW_PGID=""
+
 reap_children() {
-  # measurement children spawned by a killed chip_window would otherwise
-  # orphan onto the chip
-  pkill -f "tools/chip_window.py" 2>/dev/null
-  pkill -f "tools/perf_sweep.py" 2>/dev/null
-  pkill -f "tools/driver_bench.py" 2>/dev/null
-  pkill -f "tools/longcontext_proof.py" 2>/dev/null
-  pkill -f "bench\.py" 2>/dev/null
+  if [ -n "$CW_PGID" ]; then
+    kill -TERM -- "-$CW_PGID" 2>/dev/null
+    sleep 2
+    kill -KILL -- "-$CW_PGID" 2>/dev/null
+  fi
+  CW_PGID=""
 }
 
 while true; do
@@ -36,12 +45,38 @@ x = jnp.ones((256, 256), jnp.bfloat16)
 float(jax.jit(lambda a: a @ a)(x).sum())
 EOF
   then
-    echo "[watchdog] $(date -u +%H:%M:%S) chip ANSWERED — running window" >> chip_watchdog.log
+    # compute the remaining budget AFTER the probe (which can burn up to
+    # 150s): below the floor, launching is pointless and the timeout value
+    # would be degenerate — exit instead
+    rem=$(( STOP_AT - $(date +%s) ))
+    if [ "$rem" -lt "$MIN_WINDOW" ]; then
+      echo "[watchdog] $(date -u +%H:%M:%S) ${rem}s left < ${MIN_WINDOW}s floor — exiting" >> chip_watchdog.log
+      reap_children
+      exit 0
+    fi
+    echo "[watchdog] $(date -u +%H:%M:%S) chip ANSWERED — running window (${rem}s budget)" >> chip_watchdog.log
     # the window pass cannot outlive STOP_AT: bound it to the remaining
-    # budget and reap any orphaned measurement children after
-    timeout $(( STOP_AT - $(date +%s) )) python tools/chip_window.py >> chip_window_run.log 2>&1
+    # budget, in its own session/process group so a timeout reaps any
+    # orphaned measurement children without touching the rest of the host.
+    # The session leader writes its own pid (= the new PGID) to a file:
+    # depending on job control, setsid may fork, so $! is NOT reliably the
+    # group id. -w makes setsid wait either way, so rc propagates.
+    rm -f .cw_pgid
+    REM="$rem" setsid -w bash -c \
+      'echo "$$" > .cw_pgid; exec timeout "$REM" python tools/chip_window.py' \
+      >> chip_window_run.log 2>&1 &
+    wait $!
     rc=$?
-    [ "$rc" -eq 124 ] && reap_children
+    CW_PGID=$(cat .cw_pgid 2>/dev/null)
+    rm -f .cw_pgid
+    if [ "$rc" -ne 0 ]; then
+      # any abnormal exit (timeout 124, OOM-kill 137, chip-dead abandon)
+      # may strand measurement children in the group; reaping an already
+      # empty group is harmless
+      reap_children
+    else
+      CW_PGID=""
+    fi
     echo "[watchdog] $(date -u +%H:%M:%S) window pass done (rc=$rc)" >> chip_watchdog.log
     # if everything measured cleanly, stop looping
     python - <<'EOF' && break

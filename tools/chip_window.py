@@ -199,6 +199,19 @@ def _lever_stage(argv, key, timeout) -> None:
         _save(key, {"error": f"{type(e).__name__}: {e}"})
 
 
+def _primary_done(key: str) -> bool:
+    """True when ``key`` already holds a measured (non-error) record — a
+    retry pass entered because a SIBLING lever key errored must not re-run
+    an already-measured primary for up to an hour (the levers would starve
+    in a short chip window). Mirrors the sweep stages' row-level resume."""
+    rec = _load().get(key)
+    if rec is None or _is_error(rec):
+        return False
+    print(f"[chip_window] {key} already measured; skipping primary",
+          flush=True)
+    return True
+
+
 def stage_headline(timeout):
     return _json_stage([sys.executable, "bench.py"], "headline", timeout)
 
@@ -208,13 +221,16 @@ def stage_decode(timeout):
     # deadline so a slow-but-alive chip can't burn 4x timeout here while
     # stages 4-7 starve (mirrors stage_sweep's bound)
     deadline = time.monotonic() + 2 * timeout
-    if not _json_stage([sys.executable, "tools/driver_bench.py", "--write",
-                        "--skip-resnet", "--skip-submit"], "decode", timeout):
+    if not _primary_done("decode") and not _json_stage(
+            [sys.executable, "tools/driver_bench.py", "--write",
+             "--skip-resnet", "--skip-submit"], "decode", timeout):
         return False
     # the int8-cache and W8A16-weight levers, beside the official number
     for flag, key in ((["--cache-int8"], "decode_cache_int8"),
                       (["--serve-int8"], "decode_w8a16"),
                       (["--speculative"], "decode_speculative")):
+        if _primary_done(key):  # lever retries skip measured siblings too
+            continue
         remaining = int(deadline - time.monotonic())
         if remaining < 120:
             _save(key, {"rc": -8, "error": "deferred: stage deadline"})
@@ -366,15 +382,17 @@ def stage_bench_data(timeout):
 
 
 def stage_continuous(timeout):
-    if not _json_stage([sys.executable, "tools/driver_bench.py", "--write",
-                        "--skip-resnet", "--skip-submit", "--continuous"],
-                       "continuous", timeout):
+    if not _primary_done("continuous") and not _json_stage(
+            [sys.executable, "tools/driver_bench.py", "--write",
+             "--skip-resnet", "--skip-submit", "--continuous"],
+            "continuous", timeout):
         return False
     # the horizon lever (8 scanned steps per host round-trip), beside the
     # h=1 number so the dispatch-amortization win is visible
-    _lever_stage([sys.executable, "tools/driver_bench.py", "--write",
-                  "--skip-resnet", "--skip-submit", "--continuous",
-                  "--horizon", "8"], "continuous_h8", timeout)
+    if not _primary_done("continuous_h8"):
+        _lever_stage([sys.executable, "tools/driver_bench.py", "--write",
+                      "--skip-resnet", "--skip-submit", "--continuous",
+                      "--horizon", "8"], "continuous_h8", timeout)
     return True
 
 

@@ -83,6 +83,30 @@ ARG_RDZV_ID = "--rdzv_id"
 ARG_NPROC_PER_NODE = "--nproc_per_node"
 ARG_NNODES = "--nnodes"
 
+# ---- compile-cache / perf env (single source of truth) -------------------------
+# The reconciler injects these into every slice-host pod and the compute plane
+# (`tpu_on_k8s/train/compile.py`) consumes them, so the operator and the user
+# container can never disagree about where the persistent XLA compilation
+# cache lives or which latency-hiding flags are on.
+ENV_JAX_COMPILATION_CACHE_DIR = "JAX_COMPILATION_CACHE_DIR"
+ENV_LIBTPU_INIT_ARGS = "LIBTPU_INIT_ARGS"
+# hostPath mount shared by every pod incarnation on the node: a restarted /
+# failed-over worker finds the previous incarnation's compiled programs and
+# skips straight to execution (compilation-cache keys are content-addressed,
+# so stale entries are never wrong — only unused).
+COMPILE_CACHE_VOLUME = "xla-compile-cache"
+DEFAULT_COMPILE_CACHE_DIR = "/var/cache/tpu-on-k8s/xla"
+# Async-collective latency hiding: fuse collectives with compute and overlap
+# them on the TensorCore so ICI hops hide behind matmuls (the standard
+# MaxText/scaling-book production set for v4/v5e/v5p).
+LIBTPU_PERF_ARGS = (
+    "--xla_tpu_enable_async_collective_fusion=true "
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true "
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true "
+    "--xla_tpu_overlap_compute_collective_tc=true "
+    "--xla_enable_async_all_gather=true"
+)
+
 # ---- GKE TPU scheduling surface ------------------------------------------------
 RESOURCE_TPU = "google.com/tpu"                     # chips per host
 NODE_SELECTOR_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"

@@ -19,7 +19,15 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from tpu_on_k8s.api import constants
-from tpu_on_k8s.api.core import Container, EnvVar, EnvVarSource, Pod, PodPhase
+from tpu_on_k8s.api.core import (
+    Container,
+    EnvVar,
+    EnvVarSource,
+    Pod,
+    PodPhase,
+    Volume,
+    VolumeMount,
+)
 from tpu_on_k8s.api.defaults import set_defaults_tpujob
 from tpu_on_k8s.api.types import TaskType, TPUJob, JobConditionType
 from tpu_on_k8s.client.cluster import InMemoryCluster, WatchEvent
@@ -129,6 +137,7 @@ class TPUJobHooks:
             for c in pod.spec.containers:
                 c.resources.requests.setdefault(constants.RESOURCE_TPU, chips)
                 c.resources.limits.setdefault(constants.RESOURCE_TPU, chips)
+            self._inject_perf_env(pod)
 
         coordinator = self._coordinator_address(job, port)
         if (task_type == TaskType.MASTER and index == 0
@@ -188,6 +197,36 @@ class TPUJobHooks:
                 main.args = [a for a in rdzv if a.split("=")[0] not in existing] + main.args
             if task_type == TaskType.WORKER:
                 self._add_elastic_init_containers(job, pod, coordinator)
+
+    def _inject_perf_env(self, pod: Pod) -> None:
+        """Persistent-compile-cache + latency-hiding wiring for slice hosts
+        (consumed by `tpu_on_k8s/train/compile.py`): a node-local hostPath
+        volume mounted into every container plus ``JAX_COMPILATION_CACHE_DIR``
+        pointing at it, so a restarted/failed-over pod on the same node finds
+        the previous incarnation's compiled programs (content-addressed —
+        every slice host compiles the identical SPMD program, so the cache
+        warms once per node, ever); and the async-collective
+        ``LIBTPU_INIT_ARGS`` set. Setdefault semantics throughout: values the
+        user set in the pod template always win, and re-application during
+        elastic respec stays idempotent."""
+        if not any(v.name == constants.COMPILE_CACHE_VOLUME
+                   for v in pod.spec.volumes):
+            pod.spec.volumes.append(Volume(
+                name=constants.COMPILE_CACHE_VOLUME,
+                host_path=constants.DEFAULT_COMPILE_CACHE_DIR))
+        for container in pod.spec.containers:
+            if not any(m.name == constants.COMPILE_CACHE_VOLUME
+                       for m in container.volume_mounts):
+                container.volume_mounts.append(VolumeMount(
+                    name=constants.COMPILE_CACHE_VOLUME,
+                    mount_path=constants.DEFAULT_COMPILE_CACHE_DIR))
+            env = container.env_map()
+            if constants.ENV_JAX_COMPILATION_CACHE_DIR not in env:
+                container.set_env(constants.ENV_JAX_COMPILATION_CACHE_DIR,
+                                  constants.DEFAULT_COMPILE_CACHE_DIR)
+            if constants.ENV_LIBTPU_INIT_ARGS not in env:
+                container.set_env(constants.ENV_LIBTPU_INIT_ARGS,
+                                  constants.LIBTPU_PERF_ARGS)
 
     def _add_elastic_init_containers(self, job: TPUJob, pod: Pod, coordinator: str) -> None:
         """Image-warmup + master-waiter init containers for elastic workers

@@ -1,3 +1,3 @@
 """Job metrics (reference /root/reference/pkg/metrics/)."""
 
-from tpu_on_k8s.metrics.metrics import JobMetrics
+from tpu_on_k8s.metrics.metrics import JobMetrics, ServingMetrics, TrainMetrics

@@ -34,6 +34,7 @@ class _MetricsBase:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.counters: Dict[str, int] = defaultdict(int)
+        self.gauges: Dict[str, float] = {}
         cap = self.MIRROR_CAP
         self.histograms: Dict[str, deque] = defaultdict(
             lambda: deque(maxlen=cap))
@@ -41,6 +42,13 @@ class _MetricsBase:
         self._prom_hists = {}
         self._prom_gauges = {}
         self.registry = None
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = value
+        g = self._prom_gauges.get(name)
+        if g is not None:
+            g.set(value)
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -133,7 +141,6 @@ class ServingMetrics(_MetricsBase):
 
     def __init__(self, registry=None) -> None:
         super().__init__()
-        self.gauges: Dict[str, float] = {}
         if _prom is not None:
             registry = registry or _prom.CollectorRegistry()
             self.registry = registry
@@ -150,12 +157,33 @@ class ServingMetrics(_MetricsBase):
             for name in ("slots_active", "queue_depth"):
                 self._prom_gauges[name] = _prom.Gauge(
                     f"{ns}_{name}", f"Serving {name}", registry=registry)
-    def set_gauge(self, name: str, value: float) -> None:
-        with self._lock:
-            self.gauges[name] = value
-        g = self._prom_gauges.get(name)
-        if g is not None:
-            g.set(value)
+
+
+class TrainMetrics(_MetricsBase):
+    """Training-loop observability, fed by `tpu_on_k8s/train/loop.py`'s
+    ``TrainLoop`` at every host-sync window (same prometheus + plain-dict
+    mirror pattern and ``serve()`` scrape path as the job/serving metrics):
+    step-time / tokens-per-sec / MFU gauges (MFU's denominator comes from
+    ``compiled.cost_analysis()`` via ``train/compile.py``, not the 6·N·T
+    estimate), host-sync and async-checkpoint counters, and the watchdog's
+    stalled-step counter — a hung collective becomes a scrapeable signal."""
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        if _prom is not None:
+            registry = registry or _prom.CollectorRegistry()
+            self.registry = registry
+            ns = "tpu_on_k8s_train"
+            for name in ("host_syncs", "checkpoints_enqueued",
+                         "stalled_steps"):
+                self._prom_counters[name] = _prom.Counter(
+                    f"{ns}_{name}", f"Training loop {name}",
+                    registry=registry)
+            for name in ("step_seconds", "tokens_per_sec", "mfu",
+                         "steps_inflight"):
+                self._prom_gauges[name] = _prom.Gauge(
+                    f"{ns}_{name}", f"Training loop {name}",
+                    registry=registry)
 
 
 def serve(metrics, port: int = 8443):  # pragma: no cover - live mode

@@ -1,4 +1,14 @@
-"""Training loop machinery: sharded state, jitted step, checkpoint glue."""
+"""Training loop machinery: sharded state, jitted step, zero-stall loop,
+compile cache / AOT warmup, checkpoint glue."""
+from tpu_on_k8s.train.compile import (
+    aot_compile,
+    aot_compile_train_step,
+    analytic_train_flops,
+    compiled_flops,
+    setup_compilation_cache,
+    train_step_flops,
+)
+from tpu_on_k8s.train.loop import LoopResult, TrainLoop
 from tpu_on_k8s.train.trainer import (
     TrainState,
     Trainer,
@@ -9,10 +19,18 @@ from tpu_on_k8s.train.trainer import (
 )
 
 __all__ = [
+    "LoopResult",
+    "TrainLoop",
     "TrainState",
     "Trainer",
+    "analytic_train_flops",
+    "aot_compile",
+    "aot_compile_train_step",
+    "compiled_flops",
     "cross_entropy_loss",
     "make_eval_step",
     "make_sharded_init",
     "make_train_step",
+    "setup_compilation_cache",
+    "train_step_flops",
 ]

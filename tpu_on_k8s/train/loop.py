@@ -1,0 +1,334 @@
+"""Zero-stall step-driving loop: bounded async dispatch, device-resident
+metrics, non-blocking checkpoints, stalled-step watchdog.
+
+Every caller used to hand-roll ``for i in range(steps): state, m = step(...);
+float(m["loss"])`` — that ``float()`` is a host round-trip *per step*, which
+serializes dispatch with device compute: the host cannot enqueue step N+1
+until step N's result has crossed PCIe. ``TrainLoop`` inverts the contract:
+
+* **Metrics stay device-resident.** The loop holds them as in-flight device
+  arrays and transfers to host only every ``log_every`` steps — one transfer
+  per window, at most ⌈steps/log_every⌉ over a run. Because a host transfer
+  of step N's metrics waits (in program order) for steps 1..N, the window
+  sync is also the window's timing barrier.
+
+* **Dispatch is bounded, not unbounded.** Fire-and-forget dispatch with no
+  backpressure can run the host arbitrarily far ahead (donated buffers and
+  the dispatch queue grow with it); the loop waits on the oldest in-flight
+  step — a dispatch-queue wait, *not* a host transfer — once more than
+  ``max_inflight`` steps are unsynced.
+
+* **Checkpoints are enqueued, not awaited.** ``checkpoint_every`` saves go
+  through orbax's async path (``wait=False``); the loop drains with
+  ``wait_until_finished`` only at exit and on preemption notice
+  (``preemption_signal`` → final save + drain + clean stop), so a save's
+  serialization cost overlaps subsequent steps instead of stalling them.
+
+* **Hangs become events.** A dead chip or wedged collective used to present
+  as a silent forever-hang in ``float(...)``. The watchdog thread watches
+  sync progress; past ``stall_timeout`` seconds without any, it emits one
+  structured ``stalled_step`` event (log line + ``on_stall`` callback +
+  ``TrainMetrics`` counter) per stall episode — the orchestration plane's
+  failover machinery gets a signal instead of a mystery.
+
+The loop is step-shape agnostic: ``step_fn(state, batch) -> (state,
+metrics)`` covers the LM ``Trainer`` and (via a tuple-unpacking adapter) the
+vision ``ClassifierTrainer``; ``batches`` is any iterator — typically
+``data.prefetch.device_prefetch`` over the native ``DataLoader`` so H2D of
+batch N+1 overlaps step N, completing the pipeline: disk → host queue → HBM
+→ compute, with the host thread only ever *scheduling*.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import jax
+
+from tpu_on_k8s.utils.logging import get_logger, kv
+
+log = get_logger("train.loop")
+
+
+def _host_sync(tree: Any) -> Dict[str, Any]:
+    """THE host-transfer point — one device→host copy of a metrics pytree.
+    ``jax.device_get`` waits for the real values (unlike
+    ``block_until_ready`` on relay-backed platforms, a transfer cannot
+    return early), so this is both the sync and the progress proof. Module
+    level so tests can count transfers by wrapping it."""
+    host = jax.device_get(tree)
+    return {k: (float(v) if getattr(v, "size", None) == 1 else v)
+            for k, v in host.items()}
+
+
+def _device_wait(tree: Any) -> None:
+    """Bound the dispatch queue without a host transfer: wait for the
+    oldest in-flight step's buffers to exist on device. Module level so
+    tests can observe the backpressure path. Caveat: on relay-backed dev
+    images where ``block_until_ready`` returns before execution finishes
+    (see bench.py), this bound is advisory and the watchdog heartbeat it
+    feeds is optimistic — set ``stall_timeout`` comfortably above a full
+    window's wall time there; on conforming backends (CPU, real TPU) it is
+    exact."""
+    jax.block_until_ready(tree)
+
+
+@dataclass
+class LoopResult:
+    """What a ``TrainLoop.run`` returns: final state plus the run's
+    bookkeeping (every host-synced metrics window, in order)."""
+
+    state: Any
+    history: List[Tuple[int, Dict[str, Any]]] = field(default_factory=list)
+    steps: int = 0
+    host_syncs: int = 0
+    checkpoints_enqueued: int = 0
+    seconds: float = 0.0
+    preempted: bool = False
+
+    @property
+    def last_metrics(self) -> Dict[str, Any]:
+        return self.history[-1][1] if self.history else {}
+
+
+class TrainLoop:
+    """Drive ``step_fn`` over ``batches`` with bounded async dispatch.
+
+    Args:
+      step_fn: ``(state, batch) -> (state, metrics)`` — e.g.
+        ``Trainer.train_step`` (metrics must be a dict of device scalars).
+      state: initial (sharded) train state; donated through each step.
+      batches: iterator/iterable of device-ready batches (pair with
+        ``device_prefetch`` so H2D overlaps compute).
+      log_every: steps per host sync window. The ONLY host transfers the
+        loop performs happen at window boundaries (and the final partial
+        window): ⌈steps/log_every⌉ total.
+      max_inflight: cap on unsynced dispatched steps (default
+        ``2*log_every``); enforced with a device wait, not a host transfer.
+      checkpoint_manager / checkpoint_every / generation: enqueue
+        ``manager.save(state, step=..., generation=..., wait=False)`` every
+        N steps; drained at exit and on preemption.
+      preemption_signal: polled once per step; returning True triggers
+        final save + drain + clean stop (``LoopResult.preempted``).
+      on_metrics: ``(step, metrics_dict, step_seconds)`` per sync window.
+      on_stall / stall_timeout: watchdog — with ``stall_timeout > 0`` a
+        daemon thread emits one structured stall event per episode when no
+        sync progress happens for that long.
+      metrics: optional ``TrainMetrics`` — step-time/tokens-per-sec/MFU
+        gauges and sync/stall counters, fed at each window.
+      tokens_per_step / flops_per_step / peak_flops: throughput/MFU gauge
+        inputs (``flops_per_step`` from ``compile.train_step_flops``).
+    """
+
+    def __init__(self, step_fn: Callable[[Any, Any], Tuple[Any, Dict]],
+                 state: Any, batches: Iterable, *,
+                 log_every: int = 10,
+                 max_inflight: Optional[int] = None,
+                 checkpoint_manager: Any = None,
+                 checkpoint_every: int = 0,
+                 generation: int = 0,
+                 preemption_signal: Optional[Callable[[], bool]] = None,
+                 on_metrics: Optional[Callable[[int, Dict, float], None]] = None,
+                 on_stall: Optional[Callable[[Dict], None]] = None,
+                 stall_timeout: float = 0.0,
+                 metrics: Any = None,
+                 tokens_per_step: int = 0,
+                 flops_per_step: float = 0.0,
+                 peak_flops: float = 0.0):
+        if log_every < 1:
+            raise ValueError(f"log_every must be >= 1, got {log_every}")
+        self.step_fn = step_fn
+        self.state = state
+        self.batches = batches
+        self.log_every = log_every
+        self.max_inflight = (2 * log_every if max_inflight is None
+                             else max_inflight)
+        if self.max_inflight < 1:
+            raise ValueError(
+                f"max_inflight must be >= 1, got {self.max_inflight}")
+        self.checkpoint_manager = checkpoint_manager
+        self.checkpoint_every = checkpoint_every
+        self.generation = generation
+        self.preemption_signal = preemption_signal
+        self.on_metrics = on_metrics
+        self.on_stall = on_stall
+        self.stall_timeout = stall_timeout
+        self.metrics = metrics
+        self.tokens_per_step = tokens_per_step
+        self.flops_per_step = flops_per_step
+        self.peak_flops = peak_flops
+
+        self._should_stop = False
+        self._running = False
+        self._inflight = 0
+        self._dispatched = 0
+        self._heartbeat = time.perf_counter()
+        self._stall_latched = False
+        self._watchdog_stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- control
+    def stop(self) -> None:
+        """Request a clean stop before the next dispatch (callback/signal
+        safe). Treated like a preemption notice: final save + drain."""
+        self._should_stop = True
+
+    # ------------------------------------------------------------ watchdog
+    def _touch(self) -> None:
+        self._heartbeat = time.perf_counter()
+        self._stall_latched = False
+
+    def _watchdog_run(self) -> None:
+        poll = max(min(self.stall_timeout / 4.0, 1.0), 0.01)
+        while not self._watchdog_stop.wait(poll):
+            if not self._running or self._stall_latched:
+                continue
+            gap = time.perf_counter() - self._heartbeat
+            if gap <= self.stall_timeout:
+                continue
+            # one event per stall episode: latch until the next heartbeat
+            self._stall_latched = True
+            event = {"event": "stalled_step",
+                     "step": self._dispatched,
+                     "inflight": self._inflight,
+                     "seconds_since_progress": round(gap, 3),
+                     "stall_timeout": self.stall_timeout}
+            kv(log, logging.WARNING, "stalled_step", **event)
+            if self.metrics is not None:
+                self.metrics.inc("stalled_steps")
+            if self.on_stall is not None:
+                self.on_stall(event)
+
+    # ----------------------------------------------------------------- run
+    def run(self, steps: int) -> LoopResult:
+        """Drive ``steps`` training steps; returns the :class:`LoopResult`.
+        Host syncs happen only at ``log_every`` windows (+ the final
+        partial window); checkpoints drain before returning."""
+        result = LoopResult(state=self.state)
+        pending: collections.deque = collections.deque()
+        batches = iter(self.batches)
+        self._running = True
+        self._touch()
+        t0 = time.perf_counter()
+        t_window = t0
+        try:
+            for i in range(1, steps + 1):
+                if self._should_stop or (self.preemption_signal is not None
+                                         and self.preemption_signal()):
+                    result.preempted = True
+                    break
+                try:
+                    batch = next(batches)
+                except StopIteration:
+                    break
+                self.state, step_metrics = self.step_fn(self.state, batch)
+                pending.append(step_metrics)
+                self._dispatched = result.steps = i
+                self._inflight = len(pending)
+                # a returned dispatch is host progress; on a hung device
+                # dispatches stop within max_inflight steps (backpressure or
+                # the window sync blocks), so staleness still detects it
+                self._touch()
+                if i == 1 and self.stall_timeout > 0:
+                    # arm the watchdog only once the first dispatch has
+                    # returned: a lazily-jitted first step legitimately
+                    # spends minutes in trace+compile, which must not read
+                    # as a stall (AOT warmup via train/compile.py makes
+                    # this instant)
+                    self._watchdog_stop.clear()
+                    self._watchdog = threading.Thread(
+                        target=self._watchdog_run,
+                        name="trainloop-watchdog", daemon=True)
+                    self._watchdog.start()
+                # backpressure: a device wait on the oldest unsynced step,
+                # NOT a host transfer — the sync cadence is unaffected
+                while len(pending) > self.max_inflight:
+                    _device_wait(pending.popleft())
+                    self._inflight = len(pending)
+                    self._touch()
+                if self.checkpoint_every and i % self.checkpoint_every == 0:
+                    self._enqueue_save(result, i)
+                if i % self.log_every == 0 or i == steps:
+                    t_window = self._sync_window(result, pending, i, t_window)
+
+            # still inside the watchdog's watch: the exit path can hang in
+            # exactly the ways the loop body can (a wedged collective under
+            # the partial-window sync, a stuck checkpoint drain) and must
+            # surface as stall events too, not die silently
+            if pending:
+                # an early exit (preemption / stop / data end) leaves a
+                # partial window in flight: surface it before saving
+                self._sync_window(result, pending, result.steps, t_window)
+            if result.preempted and self.checkpoint_manager is not None:
+                # preemption notice: persist the exact stopping point, then
+                # drain — the restarted pod resumes here with a warm
+                # compile cache instead of replaying the window
+                self._enqueue_save(result, result.steps)
+            if self.checkpoint_manager is not None:
+                self.checkpoint_manager.wait_until_finished()
+        finally:
+            self._running = False
+            if self._watchdog is not None:
+                self._watchdog_stop.set()
+                self._watchdog.join(timeout=5.0)
+                self._watchdog = None
+        result.state = self.state
+        result.seconds = time.perf_counter() - t0
+        return result
+
+    # ------------------------------------------------------------- windows
+    def _sync_window(self, result: LoopResult, pending: collections.deque,
+                     step: int, t_window: float) -> float:
+        """One host transfer for the whole window: the last step's metrics
+        (program order on the device makes it wait for every prior step).
+        Earlier steps are drained with device waits first so each completed
+        step feeds the watchdog heartbeat — a long healthy window must not
+        read as a stall; only a step that never completes does."""
+        if self.metrics is not None:
+            # unsynced dispatch depth at window close (the gauge's scrape
+            # cadence is coarser than a step, so the window edge is the
+            # meaningful sample point)
+            self.metrics.set_gauge("steps_inflight", float(len(pending)))
+        last = pending.pop()
+        while pending:
+            _device_wait(pending.popleft())
+            self._inflight = len(pending) + 1
+            self._touch()
+        self._inflight = 0
+        host = _host_sync(last)
+        self._touch()
+        now = time.perf_counter()
+        result.host_syncs += 1
+        window_steps = max(step - (result.history[-1][0]
+                                   if result.history else 0), 1)
+        step_seconds = (now - t_window) / window_steps
+        result.history.append((step, host))
+        loss = host.get("loss")
+        kv(log, logging.INFO, "train_window", step=step,
+           loss=(round(loss, 4) if isinstance(loss, float) else loss),
+           step_ms=round(step_seconds * 1e3, 1))
+        if self.metrics is not None:
+            m = self.metrics
+            m.inc("host_syncs")
+            m.set_gauge("step_seconds", step_seconds)
+            if self.tokens_per_step:
+                m.set_gauge("tokens_per_sec",
+                            self.tokens_per_step / step_seconds)
+            if self.flops_per_step and self.peak_flops:
+                m.set_gauge("mfu", self.flops_per_step / step_seconds
+                            / self.peak_flops)
+        if self.on_metrics is not None:
+            self.on_metrics(step, host, step_seconds)
+        return now
+
+    # --------------------------------------------------------- checkpoints
+    def _enqueue_save(self, result: LoopResult, step: int) -> None:
+        self.checkpoint_manager.save(self.state, step=step,
+                                     generation=self.generation, wait=False)
+        result.checkpoints_enqueued += 1
+        if self.metrics is not None:
+            self.metrics.inc("checkpoints_enqueued")

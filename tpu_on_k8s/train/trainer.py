@@ -403,3 +403,14 @@ class Trainer:
         objective the train step optimizes, no state change."""
         with ring_context(self.mesh):
             return self._eval(state.params, tokens)
+
+    def fit(self, state: TrainState, batches, steps: int, **loop_kwargs):
+        """Drive ``steps`` training steps through the zero-stall
+        ``TrainLoop`` (device-resident metrics, bounded async dispatch,
+        non-blocking checkpoints — `tpu_on_k8s/train/loop.py`). ``batches``
+        is an iterator of device-ready token batches (pair with
+        ``data.prefetch.device_prefetch``). Returns a ``LoopResult``."""
+        from tpu_on_k8s.train.loop import TrainLoop
+
+        return TrainLoop(self.train_step, state, batches,
+                         **loop_kwargs).run(steps)

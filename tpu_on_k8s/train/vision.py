@@ -127,3 +127,15 @@ class ClassifierTrainer:
 
     def eval_step(self, state, images, labels):
         return self._eval(state, images, labels)
+
+    def fit(self, state, batches, steps: int, **loop_kwargs):
+        """Drive the classifier step through the zero-stall ``TrainLoop``
+        (`tpu_on_k8s/train/loop.py`): ``batches`` yields device-ready
+        ``(images, labels)`` tuples (e.g. ``device_prefetch`` over the
+        loader with a split transform); metrics stay device-resident
+        between ``log_every`` windows exactly as in the LM loop. Returns a
+        ``LoopResult``."""
+        from tpu_on_k8s.train.loop import TrainLoop
+
+        return TrainLoop(lambda s, batch: self._step(s, *batch), state,
+                         batches, **loop_kwargs).run(steps)
