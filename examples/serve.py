@@ -70,6 +70,18 @@ def main(argv=None):
                    help=">0 registers a shared prefix of this length once "
                         "(prefix caching); every request then prefills "
                         "only its own suffix")
+    p.add_argument("--gateway", action="store_true",
+                   help="route traffic through the production front door "
+                        "(tpu_on_k8s.serve.ServingGateway): bounded "
+                        "admission, tenant fairness, deadlines")
+    p.add_argument("--queue-bound", type=int, default=16,
+                   help="gateway admission queue bound (with --gateway)")
+    p.add_argument("--tenants", type=int, default=3,
+                   help="synthetic tenants to spread traffic across "
+                        "(with --gateway)")
+    p.add_argument("--deadline-s", type=float, default=0.0,
+                   help=">0: per-request deadline in seconds "
+                        "(with --gateway)")
     args = p.parse_args(argv)
 
     if args.hf_model:
@@ -131,7 +143,8 @@ def _serve_loop(args, cfg, params):
         top_k=args.top_k, top_p=args.top_p,
         prefill_chunk=args.prefill_chunk,
         rng=jax.random.key(args.seed + 1), mesh=mesh, rules=rules,
-        step_horizon=args.horizon, metrics=metrics)
+        step_horizon=args.horizon,
+        metrics=None if args.gateway else metrics)
 
     worst = (args.system_prompt_len + args.prompt_max
              + args.max_new_tokens)
@@ -147,6 +160,8 @@ def _serve_loop(args, cfg, params):
             0, cfg.vocab_size, size=args.system_prompt_len).astype(np.int32))
         print(f"registered a {args.system_prompt_len}-token shared prefix "
               f"(id {prefix_id})")
+    if args.gateway:
+        return _gateway_loop(args, cfg, eng, metrics, rng, prefix_id)
     submitted = claimed = 0
     t0 = time.perf_counter()
     finished = {}
@@ -181,6 +196,58 @@ def _serve_loop(args, cfg, params):
                  f"p50 TTFT {statistics.median(ttft) * 1e3:.0f}ms")
     print(line)
     return finished
+
+
+def _gateway_loop(args, cfg, eng, metrics, rng, prefix_id):
+    """The production shape: the same synthetic traffic, but through the
+    gateway — bounded admission (overflow prints as 429s), smooth-WRR
+    fairness across synthetic tenants, optional per-request deadlines,
+    and a graceful drain at the end."""
+    from tpu_on_k8s.serve import AdmissionConfig, Rejected, ServingGateway
+
+    gw = ServingGateway(
+        eng, AdmissionConfig(max_queue_depth=args.queue_bound),
+        metrics=metrics)
+    submitted = rejected = 0
+    finished = {}
+    t0 = time.perf_counter()
+    while submitted < args.n_requests:
+        for _ in range(rng.poisson(args.arrival)):
+            if submitted >= args.n_requests:
+                break
+            lp = int(rng.integers(args.prompt_min, args.prompt_max + 1))
+            prompt = rng.integers(0, cfg.vocab_size, size=lp).astype(np.int32)
+            r = gw.submit(prompt, args.max_new_tokens,
+                          tenant=f"tenant-{submitted % args.tenants}",
+                          deadline_s=args.deadline_s or None,
+                          prefix_id=prefix_id)
+            submitted += 1
+            if isinstance(r, Rejected):
+                rejected += 1
+                print(f"✗ rejected ({r.reason}): {r.detail}")
+            else:
+                print(f"→ r{r} submitted (prompt {lp} tokens)")
+        for rid in gw.step():
+            res = gw.result(rid)
+            if res is not None:
+                finished[rid] = res
+                print(f"← r{rid} {res.state.value}: {res.tokens.tolist()}")
+    for rid, res in gw.drain().items():
+        finished[rid] = res
+        print(f"← r{rid} {res.state.value}: {res.tokens.tolist()}")
+    dt = time.perf_counter() - t0
+    done = {rid: r.tokens for rid, r in finished.items() if r.ok}
+    expired = sum(r.state.value == "deadline_exceeded"
+                  for r in finished.values())
+    total = sum(len(v) for v in done.values())
+    line = (f"served {len(done)}/{submitted} requests ({rejected} rejected, "
+            f"{expired} expired), {total} tokens in {dt:.2f}s "
+            f"({total / dt:.1f} tok/s) — stats {eng.stats}")
+    ttft = metrics.histograms["time_to_first_token_seconds"]
+    if ttft:
+        line += f"; p50 TTFT {statistics.median(ttft) * 1e3:.0f}ms"
+    print(line)
+    return done
 
 
 if __name__ == "__main__":

@@ -246,6 +246,50 @@ class TestPrimaryResumeSkip:
         assert data["continuous_h8"]["value"] == 3
 
 
+def _stub_serve_load(cw_mod, value=7):
+    path = os.path.join(cw_mod.REPO, "tools", "serve_load.py")
+    with open(path, "w") as f:
+        f.write("print('{\"metric\": \"gateway_load_tokens_per_sec\", "
+                f"\"value\": {value}, \"ttft_ms_p50\": 12.5}}')\n")
+
+
+class TestServeTtftStage:
+    def test_records_gateway_load_summary(self, cw):
+        _stub_serve_load(cw)
+        assert cw.stage_serve_ttft(30)
+        rec = cw._load()["serve_ttft"]
+        assert rec["value"] == 7 and rec["ttft_ms_p50"] == 12.5
+
+
+class TestDebugArtifact:
+    def test_timeout_override_records_to_debug_file_only(self, cw,
+                                                         monkeypatch):
+        """ADVICE r5: a --timeout smoke of the agenda must never write
+        into the official artifact (a stale 'timeout after 5s' sat in
+        CHIPWINDOW_r05.json for a round)."""
+        official = cw.OUT
+        debug = os.path.join(cw.REPO, "CHIPWINDOW.debug.json")
+        monkeypatch.setattr(cw, "DEBUG_OUT", debug)
+        _stub_serve_load(cw)
+        idx = [k for k, _, _, _ in cw.STAGES].index("serve_ttft") + 1
+        monkeypatch.setattr(sys, "argv", ["chip_window.py", "--stage",
+                                          str(idx), "--timeout", "30"])
+        assert cw.main() == 0
+        assert not os.path.exists(official)
+        with open(debug) as f:
+            assert json.load(f)["serve_ttft"]["value"] == 7
+
+    def test_plain_run_still_records_officially(self, cw, monkeypatch):
+        official = cw.OUT
+        _stub_serve_load(cw)
+        idx = [k for k, _, _, _ in cw.STAGES].index("serve_ttft") + 1
+        monkeypatch.setattr(sys, "argv", ["chip_window.py", "--stage",
+                                          str(idx)])
+        assert cw.main() == 0
+        with open(official) as f:
+            assert json.load(f)["serve_ttft"]["value"] == 7
+
+
 class TestDecodeDeadline:
     def test_levers_defer_past_stage_deadline(self, cw):
         path = os.path.join(cw.REPO, "tools", "driver_bench.py")

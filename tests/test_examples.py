@@ -104,6 +104,22 @@ def test_serve_continuous_tiny():
     assert all(len(v) == 5 for v in out_tp.values())
 
 
+def test_serve_gateway_mode():
+    """--gateway routes the same traffic through the production front door:
+    within-bound traffic all completes; a tight bound rejects overflow."""
+    from examples.serve import main
+    out = main(["--config", "tiny", "--n-requests", "4", "--n-slots", "2",
+                "--max-new-tokens", "4", "--arrival", "2", "--gateway",
+                "--queue-bound", "8"])
+    assert len(out) == 4
+    assert all(len(v) == 4 for v in out.values())
+
+    out_tight = main(["--config", "tiny", "--n-requests", "6",
+                      "--n-slots", "1", "--max-new-tokens", "4",
+                      "--arrival", "6", "--gateway", "--queue-bound", "2"])
+    assert 0 < len(out_tight) < 6          # bound 2 sheds part of the burst
+
+
 def test_aimaster_run_loop():
     from examples.aimaster import run
     from tpu_on_k8s.api import constants

@@ -1,0 +1,460 @@
+"""The serving gateway (`tpu_on_k8s/serve/`): bounded admission with
+explicit rejection, deadlines (queued and mid-decode), cancellation that
+frees slots, graceful drain, multi-tenant WRR fairness — and oracle
+exactness for everything that completes through it (the same `generate()`
+oracle `tests/test_continuous_batching.py` holds the engine to)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_on_k8s.metrics.metrics import ServingMetrics
+from tpu_on_k8s.models.decode import generate
+from tpu_on_k8s.models.serving import (
+    ContinuousBatchingEngine,
+    EngineOverloadedError,
+)
+from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+from tpu_on_k8s.serve import (
+    AdmissionConfig,
+    Rejected,
+    RequestState,
+    ServingGateway,
+)
+from tpu_on_k8s.serve.admission import (
+    REASON_DEADLINE,
+    REASON_DRAINING,
+    REASON_LOAD_SHED,
+    REASON_QUEUE_FULL,
+    REASON_QUOTA,
+    AdmissionController,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = dataclasses.replace(TransformerConfig.tiny(), dtype=jnp.float32,
+                              max_seq_len=64)
+    tok = jax.random.randint(jax.random.key(0), (1, 8), 0, cfg.vocab_size,
+                             jnp.int32)
+    params = Transformer(cfg).init(jax.random.key(1), tok)["params"]
+    return cfg, params
+
+
+def _want(cfg, params, prompt, n):
+    """Oracle: the single-request greedy continuation."""
+    return np.asarray(generate(cfg, params,
+                               jnp.asarray(prompt, jnp.int32)[None, :],
+                               max_new_tokens=n))[0]
+
+
+class FakeClock:
+    """Deterministic time for deadline tests."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _gw(cfg, params, n_slots=2, clock=None, admission=None, weights=None,
+        metrics=None, **engine_kw):
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=n_slots, **engine_kw)
+    kw = {}
+    if clock is not None:
+        kw["clock"] = clock
+    return eng, ServingGateway(eng, admission, tenant_weights=weights,
+                               metrics=metrics, **kw)
+
+
+def test_burst_rejection_deadlines_and_exactness(setup):
+    """The acceptance scenario: a seeded burst of 4x slot capacity with
+    mixed deadlines. Exactly the overflow beyond the queue bound rejects;
+    every past-deadline request expires WITHOUT ever occupying a slot;
+    every completion is bit-identical to solo generate()."""
+    cfg, params = setup
+    rng = np.random.default_rng(41)
+    clock = FakeClock()
+    n_slots, bound = 2, 6
+    eng, gw = _gw(cfg, params, n_slots=n_slots, clock=clock,
+                  admission=AdmissionConfig(max_queue_depth=bound))
+    prompts = [rng.integers(0, cfg.vocab_size, size=int(n)).astype(np.int32)
+               for n in rng.integers(3, 14, size=4 * n_slots)]
+    # requests 2,3 carry a deadline that will expire while they queue
+    # behind 0,1; the rest are unbounded
+    rids, rejected = [], []
+    for i, p in enumerate(prompts):                 # one burst, no steps
+        r = gw.submit(p, 6, deadline_s=5.0 if i in (2, 3) else None)
+        (rejected if isinstance(r, Rejected) else rids).append(r)
+
+    # exactly the overflow beyond the bound rejected, all 429-queue-full
+    assert len(rejected) == len(prompts) - bound
+    assert all(r.reason == REASON_QUEUE_FULL for r in rejected)
+
+    gw.step()                                       # 0,1 take the slots
+    assert eng.stats["admitted"] == n_slots
+    clock.advance(10.0)                             # 2,3 expire in queue
+    out = gw.run()
+
+    assert gw.state(rids[2]) is None                # claimed by run()
+    for i in (2, 3):
+        assert out[rids[i]].state is RequestState.DEADLINE_EXCEEDED
+        assert out[rids[i]].tokens.size == 0
+    # the expired requests never reached a slot: only the 4 survivors did
+    assert eng.stats["admitted"] == 4
+    for i in (0, 1, 4, 5):
+        res = out[rids[i]]
+        assert res.ok
+        np.testing.assert_array_equal(
+            res.tokens, _want(cfg, params, prompts[i], 6),
+            err_msg=f"request {i}")
+
+
+def test_deadline_mid_decode_aborts_and_frees_slot(setup):
+    """A deadline that fires mid-decode: the slot is aborted and reusable
+    the same step, the partial tokens are the exact greedy prefix, and a
+    waiting request is admitted into the freed slot."""
+    cfg, params = setup
+    rng = np.random.default_rng(42)
+    clock = FakeClock()
+    eng, gw = _gw(cfg, params, n_slots=1, clock=clock)
+    p_dead = rng.integers(0, cfg.vocab_size, size=6).astype(np.int32)
+    p_wait = rng.integers(0, cfg.vocab_size, size=4).astype(np.int32)
+    r_dead = gw.submit(p_dead, 30, deadline_s=5.0)
+    r_wait = gw.submit(p_wait, 5)
+    for _ in range(3):
+        gw.step()
+    assert gw.state(r_dead) is RequestState.DECODING
+    clock.advance(10.0)
+    gw.step()      # abort frees the slot; r_wait admitted the same step
+    assert gw.state(r_dead) is RequestState.DEADLINE_EXCEEDED
+    assert gw.state(r_wait) is RequestState.DECODING
+    assert eng.stats["admitted"] == 2
+    res = gw.result(r_dead)
+    want_full = _want(cfg, params, p_dead, 30)
+    assert 0 < res.tokens.size < 30                 # genuinely partial
+    np.testing.assert_array_equal(res.tokens,
+                                  want_full[:res.tokens.size])
+    out = gw.run()
+    np.testing.assert_array_equal(out[r_wait].tokens,
+                                  _want(cfg, params, p_wait, 5))
+
+
+def test_cancel_mid_decode_frees_slot_same_step(setup):
+    """Acceptance: cancel retires the slot within one step() and a waiting
+    request is admitted the same step."""
+    cfg, params = setup
+    rng = np.random.default_rng(43)
+    eng, gw = _gw(cfg, params, n_slots=1)
+    p_a = rng.integers(0, cfg.vocab_size, size=5).astype(np.int32)
+    p_b = rng.integers(0, cfg.vocab_size, size=7).astype(np.int32)
+    r_a = gw.submit(p_a, 25)
+    r_b = gw.submit(p_b, 6)
+    gw.step()
+    assert gw.state(r_a) is RequestState.DECODING
+    assert gw.state(r_b) is RequestState.QUEUED     # slot taken
+    assert gw.cancel(r_a)
+    gw.step()
+    assert gw.state(r_a) is RequestState.CANCELLED
+    assert gw.state(r_b) is RequestState.DECODING   # admitted same step
+    assert not gw.cancel(r_a)                       # already terminal
+    res_a = gw.result(r_a)
+    assert res_a.state is RequestState.CANCELLED and res_a.tokens.size > 0
+    np.testing.assert_array_equal(
+        res_a.tokens, _want(cfg, params, p_a, 25)[:res_a.tokens.size])
+    out = gw.run()
+    np.testing.assert_array_equal(out[r_b].tokens,
+                                  _want(cfg, params, p_b, 6))
+
+
+def test_cancel_queued_is_immediate(setup):
+    cfg, params = setup
+    rng = np.random.default_rng(44)
+    eng, gw = _gw(cfg, params, n_slots=1)
+    r_a = gw.submit(rng.integers(0, cfg.vocab_size, size=4).astype(np.int32),
+                    10)
+    r_b = gw.submit(rng.integers(0, cfg.vocab_size, size=4).astype(np.int32),
+                    4)
+    assert gw.cancel(r_b)                    # still queued: retired here
+    assert gw.state(r_b) is RequestState.CANCELLED
+    assert not gw.cancel(999)                # unknown id
+    out = gw.run()
+    assert out[r_a].ok
+    assert eng.stats["admitted"] == 1        # b never touched the engine
+
+
+def test_drain_finishes_inflight_rejects_new(setup):
+    """Graceful drain: in-flight and queued work completes exactly; new
+    submissions get a typed draining rejection."""
+    cfg, params = setup
+    rng = np.random.default_rng(45)
+    eng, gw = _gw(cfg, params, n_slots=2)
+    prompts = [rng.integers(0, cfg.vocab_size, size=int(n)).astype(np.int32)
+               for n in (5, 9, 3)]
+    rids = [gw.submit(p, 6) for p in prompts]
+    gw.step()
+    gw.stop_accepting()
+    rej = gw.submit(prompts[0], 4)
+    assert isinstance(rej, Rejected) and rej.reason == REASON_DRAINING
+    out = gw.drain()
+    for rid, p in zip(rids, prompts):
+        assert out[rid].ok
+        np.testing.assert_array_equal(out[rid].tokens,
+                                      _want(cfg, params, p, 6))
+
+
+def test_drain_timeout_cancels_stragglers(setup):
+    """Past the drain deadline (the preemption grace period), live work is
+    cancelled rather than abandoned — budget freed, partials returned."""
+    cfg, params = setup
+    rng = np.random.default_rng(46)
+
+    class TickingClock(FakeClock):
+        def __call__(self) -> float:
+            self.t += 0.5
+            return self.t
+
+    eng, gw = _gw(cfg, params, n_slots=1, clock=TickingClock())
+    r = gw.submit(rng.integers(0, cfg.vocab_size, size=5).astype(np.int32),
+                  50)
+    gw.step()
+    out = gw.drain(timeout_s=2.0)
+    assert out[r].state is RequestState.CANCELLED
+    assert out[r].tokens.size < 50
+
+
+def test_wrr_fairness_proportions(setup):
+    """Smooth-WRR across 3 tenants at weights 2:1:1 on one slot: dispatch
+    order follows the configured shares exactly (6:3:3 over 12 picks),
+    independent of how many requests each tenant floods."""
+    cfg, params = setup
+    rng = np.random.default_rng(47)
+    eng, gw = _gw(cfg, params, n_slots=1,
+                  weights={"a": 2.0, "b": 1.0, "c": 1.0},
+                  admission=AdmissionConfig(max_queue_depth=64))
+    by_rid = {}
+    for i in range(8):                       # 8 per tenant, 1 token each:
+        for t in ("a", "b", "c"):            # each step completes exactly
+            p = rng.integers(0, cfg.vocab_size,  # one request, so completion
+                             size=4).astype(np.int32)  # order IS pick order
+            by_rid[gw.submit(p, 1, tenant=t)] = t
+    order = []
+    while len(order) < 12:
+        order.extend(by_rid[r] for r in gw.step())
+    counts = {t: order[:12].count(t) for t in "abc"}
+    assert counts == {"a": 6, "b": 3, "c": 3}
+    # smoothness: the heavy tenant never takes its whole share back-to-back
+    assert "aaa" not in "".join(order[:12])
+    gw.run()
+
+
+def test_priority_lanes_preempt_order(setup):
+    """A higher-priority request submitted later dispatches first."""
+    cfg, params = setup
+    rng = np.random.default_rng(48)
+    eng, gw = _gw(cfg, params, n_slots=1)
+    blocker = gw.submit(rng.integers(0, cfg.vocab_size,
+                                     size=4).astype(np.int32), 8)
+    gw.step()                                # blocker owns the slot
+    low = gw.submit(rng.integers(0, cfg.vocab_size,
+                                 size=4).astype(np.int32), 2, priority=0)
+    high = gw.submit(rng.integers(0, cfg.vocab_size,
+                                  size=4).astype(np.int32), 2, priority=5)
+    done = []
+    while len(done) < 3:
+        done.extend(gw.step())
+    assert done.index(high) < done.index(low)
+    gw.run()
+
+
+def test_load_shedding_spares_priority_lane(setup):
+    cfg, params = setup
+    rng = np.random.default_rng(49)
+    eng, gw = _gw(cfg, params, n_slots=1, admission=AdmissionConfig(
+        max_queue_depth=8, shed_threshold=2, shed_keep_priority=1))
+    p = rng.integers(0, cfg.vocab_size, size=4).astype(np.int32)
+    assert isinstance(gw.submit(p, 2), int)
+    assert isinstance(gw.submit(p, 2), int)
+    shed = gw.submit(p, 2)                       # depth 2 >= threshold
+    assert isinstance(shed, Rejected) and shed.reason == REASON_LOAD_SHED
+    kept = gw.submit(p, 2, priority=1)           # interactive lane kept
+    assert isinstance(kept, int)
+    gw.run()
+
+
+def test_tenant_token_budget_reserve_release(setup):
+    """Quota follows the coordinator's assumed-quota shape: reserved at
+    admission, released at the terminal state — a tenant's rejected burst
+    admits again once its in-flight work finishes."""
+    cfg, params = setup
+    rng = np.random.default_rng(50)
+    eng, gw = _gw(cfg, params, n_slots=2, admission=AdmissionConfig(
+        max_queue_depth=16, default_tenant_budget=20))
+    p = rng.integers(0, cfg.vocab_size, size=6).astype(np.int32)
+    a = gw.submit(p, 6, tenant="t")              # cost 12 of 20
+    over = gw.submit(p, 6, tenant="t")           # 24 > 20
+    assert isinstance(over, Rejected) and over.reason == REASON_QUOTA
+    other = gw.submit(p, 6, tenant="u")          # budgets are per tenant
+    assert isinstance(other, int)
+    out = gw.run()
+    assert out[a].ok and out[other].ok
+    again = gw.submit(p, 6, tenant="t")          # budget released
+    assert isinstance(again, int)
+    gw.run()
+
+
+def test_oracle_exact_mixed_traffic_with_prefix(setup):
+    """Ragged staggered traffic through the gateway — plain and
+    prefix-cached requests — every completion equals solo generate()."""
+    cfg, params = setup
+    rng = np.random.default_rng(51)
+    eng, gw = _gw(cfg, params, n_slots=2)
+    prefix = rng.integers(0, cfg.vocab_size, size=9).astype(np.int32)
+    pid = eng.register_prefix(prefix)
+    prompts = [rng.integers(0, cfg.vocab_size, size=int(n)).astype(np.int32)
+               for n in (5, 11, 3, 7)]
+    news = [8, 5, 10, 4]
+    r0 = gw.submit(prompts[0], news[0])
+    gw.step()
+    r1 = gw.submit(prompts[1], news[1], prefix_id=pid)
+    r2 = gw.submit(prompts[2], news[2])
+    gw.step()
+    r3 = gw.submit(prompts[3], news[3], tenant="other")
+    out = gw.run()
+    np.testing.assert_array_equal(out[r0].tokens,
+                                  _want(cfg, params, prompts[0], news[0]))
+    np.testing.assert_array_equal(
+        out[r1].tokens,
+        _want(cfg, params, np.concatenate([prefix, prompts[1]]), news[1]))
+    np.testing.assert_array_equal(out[r2].tokens,
+                                  _want(cfg, params, prompts[2], news[2]))
+    np.testing.assert_array_equal(out[r3].tokens,
+                                  _want(cfg, params, prompts[3], news[3]))
+
+
+def test_streaming_and_metrics_through_gateway(setup):
+    """on_token streams gateway ids in emission order; the metrics plane
+    records the full lifecycle (counters + TTFT/TPOT/queue-wait)."""
+    cfg, params = setup
+    rng = np.random.default_rng(52)
+    m = ServingMetrics()
+    eng, gw = _gw(cfg, params, n_slots=1, metrics=m,
+                  admission=AdmissionConfig(max_queue_depth=1))
+    streamed = []
+    p = rng.integers(0, cfg.vocab_size, size=5).astype(np.int32)
+    rid = gw.submit(p, 6, on_token=lambda r, t: streamed.append((r, t)))
+    rej = gw.submit(p, 4)                      # bound 1, queue holds rid
+    assert isinstance(rej, Rejected)
+    out = gw.run()
+    assert [t for _, t in streamed] == out[rid].tokens.tolist()
+    assert all(r == rid for r, _ in streamed)
+    c = gw.submit(p, 20)
+    gw.step()
+    gw.cancel(c)
+    gw.run()
+    assert m.counters["requests_submitted"] == 2
+    assert m.counters["requests_finished"] == 1
+    assert m.counters["requests_rejected"] == 1
+    assert m.counters["rejected_queue_full"] == 1
+    assert m.counters["requests_cancelled"] == 1
+    assert m.counters["tokens_emitted"] >= 7
+    assert len(m.histograms["time_to_first_token_seconds"]) == 2
+    assert len(m.histograms["queue_wait_seconds"]) == 2
+    assert len(m.histograms["time_per_output_token_seconds"]) == 1
+    assert len(m.histograms["request_latency_seconds"]) == 1
+    assert m.gauges["queue_depth"] == 0
+
+
+def test_validation_and_rejected_guardrails(setup):
+    cfg, params = setup
+    eng, gw = _gw(cfg, params, n_slots=1)
+    with pytest.raises(ValueError, match="empty"):
+        gw.submit(np.zeros(0, np.int32), 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        gw.submit(np.arange(4), 0)
+    with pytest.raises(ValueError, match="exceeds"):
+        gw.submit(np.arange(60), 10)
+    with pytest.raises(ValueError, match="unknown prefix_id"):
+        gw.submit(np.arange(4), 2, prefix_id=7)
+    past = gw.submit(np.arange(4), 2, deadline_s=-1.0)
+    assert isinstance(past, Rejected) and past.reason == REASON_DEADLINE
+    with pytest.raises(TypeError, match="no truth value"):
+        bool(past)                       # force isinstance checks
+    with pytest.raises(ValueError, match="one gateway per engine"):
+        ServingGateway(eng)
+    with pytest.raises(ValueError, match="weight"):
+        ServingGateway(ContinuousBatchingEngine(cfg, params, n_slots=1),
+                       tenant_weights={"a": 0.0})
+
+
+def test_admission_controller_unit():
+    """The three gates in isolation (no engine)."""
+    ctl = AdmissionController(AdmissionConfig(
+        max_queue_depth=4, shed_threshold=2, shed_keep_priority=1,
+        default_tenant_budget=100, tenant_budgets={"vip": 1000}))
+    assert ctl.admit("t", 60, 0, queue_depth=0) is None
+    assert ctl.reserved("t") == 60
+    quota = ctl.admit("t", 60, 0, queue_depth=0)
+    assert quota.reason == REASON_QUOTA
+    assert ctl.admit("vip", 600, 0, queue_depth=0) is None
+    shed = ctl.admit("t", 1, 0, queue_depth=2)
+    assert shed.reason == REASON_LOAD_SHED
+    assert ctl.admit("t", 1, 1, queue_depth=2) is None   # lane kept
+    full = ctl.admit("t", 1, 9, queue_depth=4)
+    assert full.reason == REASON_QUEUE_FULL
+    ctl.release("t", 60)
+    assert ctl.admit("t", 60, 0, queue_depth=0) is None
+    with pytest.raises(ValueError, match="never fire"):
+        AdmissionConfig(max_queue_depth=2, shed_threshold=3)
+
+
+def test_engine_typed_rejection_when_bypassing_gateway(setup):
+    """Satellite: raw engine.submit past queue_cap raises the typed
+    EngineOverloadedError instead of enqueueing unconditionally."""
+    cfg, params = setup
+    rng = np.random.default_rng(53)
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=1, queue_cap=2)
+    p = rng.integers(0, cfg.vocab_size, size=4).astype(np.int32)
+    eng.submit(p, 3)
+    eng.submit(p, 3)
+    with pytest.raises(EngineOverloadedError) as ei:
+        eng.submit(p, 3)
+    assert ei.value.inflight == 2 and ei.value.cap == 2
+    eng.run()                                  # capacity drains
+    assert isinstance(eng.submit(p, 3), int)   # and frees the cap
+    eng.run()
+    with pytest.raises(ValueError, match="queue_cap"):
+        ContinuousBatchingEngine(cfg, params, queue_cap=0)
+
+
+def test_serve_load_smoke(setup):
+    """Satellite: the deterministic closed-loop load generator — same seed,
+    same trace; every request accounted for; summary shape stable."""
+    from tools.serve_load import build_workload, run_load
+
+    cfg, params = setup
+    t1 = build_workload(np.random.default_rng(7), 10, rate=3.0,
+                        vocab_size=cfg.vocab_size)
+    t2 = build_workload(np.random.default_rng(7), 10, rate=3.0,
+                        vocab_size=cfg.vocab_size)
+    assert len(t1) == len(t2) == 10
+    for a, b in zip(t1, t2):
+        assert a.step == b.step and a.tenant == b.tenant
+        np.testing.assert_array_equal(a.prompt, b.prompt)
+
+    m = ServingMetrics()
+    eng, gw = _gw(cfg, params, n_slots=2, metrics=m,
+                  admission=AdmissionConfig(max_queue_depth=4))
+    summary = run_load(gw, t1)
+    assert summary["served"] + summary["rejected"] \
+        + summary["deadline_exceeded"] + summary["cancelled"] == 10
+    assert summary["served"] >= 4                # the bound admits >= 4
+    assert summary["tokens"] > 0
+    assert summary["ttft_ms_p50"] is not None
+    assert summary["queue_wait_ms_p50"] is not None

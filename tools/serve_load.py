@@ -1,0 +1,227 @@
+"""Deterministic closed-loop load generator for the serving gateway.
+
+Drives `tpu_on_k8s.serve.ServingGateway` with seeded Poisson arrivals and
+mixed prompt/output lengths — the same workload every run for a given
+seed, so CI can assert on it (the fast smoke test in
+`tests/test_serve_gateway.py`) and the chip window can measure hardware
+TTFT/TPOT on a reproducible trace (`tools/chip_window.py` serve_ttft
+stage).
+
+Closed loop: the generator is the driver — it submits each arrival at its
+assigned engine step, steps the gateway, and collects outcomes until every
+request is terminal. Arrival *steps* (not wall-clock) keep the trace
+independent of host speed.
+
+Usage:
+    python tools/serve_load.py                        # tiny config, CPU-ok
+    python tools/serve_load.py --bench --n-slots 8    # 350M flagship
+Prints one JSON summary line (throughput, outcome counts, TTFT/TPOT
+percentiles) — the shape chip_window's _json_stage records.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import math
+import os
+import sys
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass
+class Arrival:
+    """One scheduled request of the trace."""
+
+    step: int
+    tenant: str
+    prompt: np.ndarray
+    max_new_tokens: int
+    priority: int = 0
+    deadline_s: Optional[float] = None
+
+
+def build_workload(rng: np.random.Generator, n_requests: int, *,
+                   rate: float = 2.0,
+                   prompt_lens: Sequence[int] = (4, 24),
+                   new_tokens: Sequence[int] = (4, 16),
+                   tenants: Sequence[str] = ("tenant-a", "tenant-b",
+                                             "tenant-c"),
+                   vocab_size: int = 256,
+                   deadline_s: Optional[float] = None,
+                   deadline_fraction: float = 0.0) -> List[Arrival]:
+    """A reproducible trace: Poisson(``rate``) arrivals per engine step
+    (the seeded ``rng`` is passed IN — the caller owns determinism), mixed
+    uniform prompt/output lengths, tenants round-tripped through the same
+    rng. ``deadline_fraction`` of requests carry ``deadline_s``."""
+    arrivals: List[Arrival] = []
+    step = 0
+    while len(arrivals) < n_requests:
+        for _ in range(min(int(rng.poisson(rate)),
+                           n_requests - len(arrivals))):
+            lp = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
+            arrivals.append(Arrival(
+                step=step,
+                tenant=str(tenants[int(rng.integers(len(tenants)))]),
+                prompt=rng.integers(0, vocab_size, size=lp).astype(np.int32),
+                max_new_tokens=int(rng.integers(new_tokens[0],
+                                                new_tokens[1] + 1)),
+                deadline_s=(deadline_s
+                            if deadline_s is not None
+                            and rng.random() < deadline_fraction else None)))
+        step += 1
+    return arrivals
+
+
+def _pctl(values, q: float) -> Optional[float]:
+    """Empirical percentile (nearest-rank) in milliseconds."""
+    vals = sorted(values)
+    if not vals:
+        return None
+    idx = min(len(vals) - 1, max(0, math.ceil(q * len(vals)) - 1))
+    return round(vals[idx] * 1e3, 2)
+
+
+def run_load(gateway, arrivals: List[Arrival],
+             time_fn=time.perf_counter) -> dict:
+    """Drive the trace to completion; returns the summary dict. Outcome
+    counts come from gateway results; latency percentiles from the
+    gateway's ``ServingMetrics`` (None when the gateway has no metrics)."""
+    from tpu_on_k8s.serve.admission import Rejected
+
+    by_step: dict = {}
+    for a in arrivals:
+        by_step.setdefault(a.step, []).append(a)
+    outcomes: dict = {}
+    rejected = 0
+    t0 = time_fn()
+    step = 0
+    live = True
+    while by_step or live:
+        for a in by_step.pop(step, []):
+            r = gateway.submit(a.prompt, a.max_new_tokens, tenant=a.tenant,
+                               priority=a.priority, deadline_s=a.deadline_s)
+            if isinstance(r, Rejected):
+                rejected += 1
+        for rid in gateway.step():
+            res = gateway.result(rid)
+            if res is not None:
+                outcomes[rid] = res
+        live = gateway.queue_depth > 0 or gateway._live()
+        step += 1
+    dt = time_fn() - t0
+    states = [r.state.value for r in outcomes.values()]
+    total_tokens = sum(len(r.tokens) for r in outcomes.values())
+    m = gateway.metrics
+    summary = {
+        "metric": "gateway_load_tokens_per_sec",
+        "value": round(total_tokens / dt, 1) if dt > 0 else None,
+        "unit": "tokens/s",
+        "requests": len(arrivals),
+        "served": states.count("done"),
+        "rejected": rejected,
+        "deadline_exceeded": states.count("deadline_exceeded"),
+        "cancelled": states.count("cancelled"),
+        "tokens": total_tokens,
+        "driver_steps": step,
+        "wall_s": round(dt, 3),
+    }
+    if m is not None:
+        ttft = list(m.histograms["time_to_first_token_seconds"])
+        tpot = list(m.histograms["time_per_output_token_seconds"])
+        qw = list(m.histograms["queue_wait_seconds"])
+        summary.update(
+            ttft_ms_p50=_pctl(ttft, 0.50), ttft_ms_p99=_pctl(ttft, 0.99),
+            tpot_ms_p50=_pctl(tpot, 0.50), tpot_ms_p99=_pctl(tpot, 0.99),
+            queue_wait_ms_p50=_pctl(qw, 0.50),
+            queue_wait_ms_p99=_pctl(qw, 0.99))
+    return summary
+
+
+def main(argv=None) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_on_k8s.metrics.metrics import ServingMetrics
+    from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+    from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+    from tpu_on_k8s.serve import AdmissionConfig, ServingGateway
+
+    p = argparse.ArgumentParser(description="gateway load generator")
+    p.add_argument("--bench", action="store_true",
+                   help="350M flagship (bench.py config) instead of tiny — "
+                        "the chip-window hardware TTFT measurement")
+    p.add_argument("--n-slots", type=int, default=4)
+    p.add_argument("--n-requests", type=int, default=32)
+    p.add_argument("--rate", type=float, default=2.0,
+                   help="mean Poisson arrivals per engine step")
+    p.add_argument("--queue-bound", type=int, default=64)
+    p.add_argument("--prompt-min", type=int, default=4)
+    p.add_argument("--prompt-max", type=int, default=24)
+    p.add_argument("--new-min", type=int, default=4)
+    p.add_argument("--new-max", type=int, default=16)
+    p.add_argument("--deadline-s", type=float, default=0.0,
+                   help=">0: this deadline on --deadline-fraction of "
+                        "requests")
+    p.add_argument("--deadline-fraction", type=float, default=0.0)
+    p.add_argument("--horizon", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    if args.bench:
+        from bench import bench_config
+        cfg = bench_config()
+        max_len = 512
+    else:
+        cfg = dataclasses.replace(TransformerConfig.tiny(),
+                                  dtype=jnp.float32, max_seq_len=64)
+        max_len = None
+    model = Transformer(cfg)
+    probe = jax.random.randint(jax.random.key(1), (1, 8), 0,
+                               cfg.vocab_size, jnp.int32)
+    params = model.init(jax.random.key(0), probe)["params"]
+    if args.bench:
+        params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+
+    metrics = ServingMetrics()
+    engine = ContinuousBatchingEngine(cfg, params, n_slots=args.n_slots,
+                                      max_len=max_len,
+                                      step_horizon=args.horizon)
+    gateway = ServingGateway(
+        engine, AdmissionConfig(max_queue_depth=args.queue_bound),
+        metrics=metrics)
+    rng = np.random.default_rng(args.seed)
+    arrivals = build_workload(
+        rng, args.n_requests, rate=args.rate,
+        prompt_lens=(args.prompt_min, args.prompt_max),
+        new_tokens=(args.new_min, args.new_max),
+        vocab_size=cfg.vocab_size,
+        deadline_s=args.deadline_s or None,
+        deadline_fraction=args.deadline_fraction)
+    # warmup outside the measured trace: compile the step/admit programs
+    # AND every (bucket, batch) prefill shape the trace can hit — bursts
+    # admit as groups of 4/2/1 (engine._ADMIT_BATCH_SIZES), and a group
+    # shape compiling mid-trace would land multi-second outliers in the
+    # official hardware TTFT percentiles (same guard as bench_continuous)
+    from tpu_on_k8s.models.decode import _bucket_len
+    buckets = sorted({_bucket_len(int(a.prompt.size), engine.max_len)
+                      for a in arrivals})
+    for bucket in buckets:
+        lp = min(bucket, engine.max_len - 2)
+        for _ in range(7):
+            gateway.submit(rng.integers(0, cfg.vocab_size,
+                                        size=lp).astype(np.int32), 2)
+        gateway.run()
+    metrics.histograms.clear()
+    summary = run_load(gateway, arrivals)
+    print(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    main()

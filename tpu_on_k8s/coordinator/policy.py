@@ -25,6 +25,35 @@ class QueueSelector(Protocol):
     def next(self, queues: List[Queue]) -> Optional[Queue]: ...
 
 
+class SmoothWRR:
+    """The smooth-WRR core (nginx algorithm), detached from ``Queue`` so the
+    serving gateway's tenant scheduler (`tpu_on_k8s/serve/scheduler.py`) can
+    reuse the exact policy the coordinator runs: each pick adds every
+    candidate's weight to its running current-weight, picks the max, then
+    subtracts the total from the winner — a {5,1,1} weighting yields
+    a-b-a-a-c-a-a instead of bursts. State for vanished keys is dropped so
+    a departed tenant's debt doesn't linger. NOT thread-safe; callers hold
+    their own lock (both users already do)."""
+
+    def __init__(self) -> None:
+        self._current: Dict[str, float] = {}
+
+    def pick(self, weights: Dict[str, float]) -> Optional[str]:
+        if not weights:
+            return None
+        total = sum(weights.values())
+        self._current = {k: v for k, v in self._current.items()
+                         if k in weights}
+        best: Optional[str] = None
+        for key in sorted(weights):
+            cur = self._current.get(key, 0.0) + weights[key]
+            self._current[key] = cur
+            if best is None or cur > self._current[best]:
+                best = key
+        self._current[best] -= total
+        return best
+
+
 class RoundRobinSelector:
     """Plain RR over queue names (policy.go:31-76)."""
 
@@ -54,23 +83,13 @@ class SmoothWeightedRoundRobinSelector:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._current: Dict[str, float] = {}
+        self._wrr = SmoothWRR()
 
     def next(self, queues: List[Queue]) -> Optional[Queue]:
-        candidates = [q for q in queues if len(q) > 0]
+        candidates = {q.name: q for q in queues if len(q) > 0}
         if not candidates:
             return None
-        candidates.sort(key=lambda q: q.name)
         with self._lock:
-            weights = {q.name: max(q.total_tasks(), 1) for q in candidates}
-            total = sum(weights.values())
-            # Drop state for vanished queues so their debt doesn't linger.
-            self._current = {n: v for n, v in self._current.items() if n in weights}
-            best: Optional[Queue] = None
-            for q in candidates:
-                cur = self._current.get(q.name, 0.0) + weights[q.name]
-                self._current[q.name] = cur
-                if best is None or cur > self._current[best.name]:
-                    best = q
-            self._current[best.name] -= total
-            return best
+            weights = {name: float(max(q.total_tasks(), 1))
+                       for name, q in candidates.items()}
+            return candidates[self._wrr.pick(weights)]

@@ -146,11 +146,25 @@ class ServingMetrics(_MetricsBase):
             self.registry = registry
             ns = "tpu_on_k8s_serving"
             for name in ("requests_submitted", "requests_finished",
-                         "tokens_emitted"):
+                         "tokens_emitted",
+                         # gateway lifecycle (tpu_on_k8s/serve/gateway.py):
+                         # explicit rejection, client cancel, deadline abort
+                         "requests_rejected", "requests_cancelled",
+                         "deadline_exceeded",
+                         # per-reason rejection breakdown — an operator
+                         # must be able to tell quota exhaustion from
+                         # queue overflow off the scrape alone (reasons
+                         # from tpu_on_k8s/serve/admission.py)
+                         "rejected_queue_full", "rejected_load_shed",
+                         "rejected_quota", "rejected_deadline",
+                         "rejected_draining"):
                 self._prom_counters[name] = _prom.Counter(
                     f"{ns}_{name}", f"Serving {name}", registry=registry)
             for name in ("time_to_first_token_seconds",
-                         "queue_wait_seconds", "request_latency_seconds"):
+                         "queue_wait_seconds", "request_latency_seconds",
+                         # inter-token latency (TPOT) — the streaming-felt
+                         # speed, distinct from TTFT
+                         "time_per_output_token_seconds"):
                 self._prom_hists[name] = _prom.Histogram(
                     f"{ns}_{name}", f"Serving {name}",
                     buckets=_SERVING_BUCKETS, registry=registry)

@@ -1,0 +1,37 @@
+"""The serving request plane: admission, fairness, lifecycle, gateway.
+
+``ContinuousBatchingEngine`` (`tpu_on_k8s/models/serving.py`) is the
+compute plane — oracle-exact continuous batching over one compiled step
+program. This package is the missing layer between that and a service:
+
+* `admission`  — bounded queue, load shedding, tenant token budgets,
+  typed 429-style ``Rejected``;
+* `scheduler`  — priority lanes + smooth-WRR tenant fairness (the
+  coordinator's own policy core, reused);
+* `lifecycle`  — request states, deadlines, cancellation, drain;
+* `gateway`    — ``ServingGateway``, the single front door tying them
+  together.
+"""
+from tpu_on_k8s.serve.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    Rejected,
+)
+from tpu_on_k8s.serve.gateway import ServingGateway
+from tpu_on_k8s.serve.lifecycle import (
+    GatewayRequest,
+    RequestResult,
+    RequestState,
+)
+from tpu_on_k8s.serve.scheduler import FairScheduler
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "FairScheduler",
+    "GatewayRequest",
+    "Rejected",
+    "RequestResult",
+    "RequestState",
+    "ServingGateway",
+]

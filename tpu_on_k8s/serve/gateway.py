@@ -1,0 +1,362 @@
+"""ServingGateway: the single front door to the continuous-batching engine.
+
+Wraps a ``ContinuousBatchingEngine`` without re-implementing it — the
+engine keeps doing the one thing it does (one compiled step program over a
+slot pool); the gateway owns everything a *service* needs around it:
+
+* **Bounded admission** (`serve/admission.py`): queue bound, load
+  shedding, per-tenant token budgets — overload becomes a typed
+  ``Rejected``, not an unbounded queue.
+* **Fair ordering** (`serve/scheduler.py`): priority lanes, smooth-WRR
+  across tenants. The gateway dispatches into the engine only as slots
+  free up, so the engine's own FIFO never holds more than the in-flight
+  set and the gateway's policy — not arrival order — decides who runs.
+* **Lifecycle** (`serve/lifecycle.py`): per-request deadlines (expired
+  while queued: reaped before ever occupying a slot; expired mid-decode:
+  slot aborted and reusable the same step), client-driven ``cancel()``,
+  graceful drain for preemption (``stop_accepting()`` + finish in-flight,
+  the serving analog of `controller/failover.py` recovery semantics).
+* **Observability**: queue-depth / reject / cancel / deadline counters and
+  TTFT / TPOT / queue-wait histograms through ``ServingMetrics``, plus
+  streaming via the engine's existing ``on_token`` hook.
+
+Threading model mirrors the engine's: ONE driver thread calls ``step()`` /
+``run()`` / ``drain()``; any number of frontend threads call ``submit()``,
+``cancel()``, ``result()``, ``state()``. Cancels from frontend threads only
+mark the request — the driver performs the actual ``engine.abort`` at the
+top of its next step (``abort`` is not safe concurrent with a running
+device step).
+
+Give the *gateway* the ``ServingMetrics`` instance and leave the engine's
+``metrics=None``: the gateway measures queue-wait/TTFT from gateway
+submit time (the number a client sees); the engine would measure from its
+own submit, which under the gateway is dispatch time.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Union
+
+from tpu_on_k8s.serve.admission import (
+    REASON_DEADLINE,
+    REASON_DRAINING,
+    AdmissionConfig,
+    AdmissionController,
+    Rejected,
+)
+from tpu_on_k8s.serve.lifecycle import (
+    LIVE_STATES,
+    GatewayRequest,
+    RequestResult,
+    RequestState,
+    finalize,
+)
+from tpu_on_k8s.serve.scheduler import FairScheduler
+
+
+class ServingGateway:
+    """Admission + fairness + lifecycle over one engine. See module doc."""
+
+    def __init__(self, engine, admission: Optional[AdmissionConfig] = None,
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 metrics=None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if getattr(engine, "_on_retire", None) is not None:
+            raise ValueError("engine already has an on_retire consumer — "
+                             "one gateway per engine")
+        self.engine = engine
+        self.metrics = metrics
+        self._clock = clock
+        self._admission = AdmissionController(admission)
+        self._sched = FairScheduler(tenant_weights)
+        self._lock = threading.Lock()
+        self._requests: Dict[int, GatewayRequest] = {}
+        self._by_engine: Dict[int, int] = {}       # engine rid → gateway rid
+        self._next_id = 0
+        self._in_engine = 0      # dispatched, not yet retired/aborted: each
+                                 # holds (or will hold) exactly one slot
+        self._accepting = True
+        self._newly_terminal: List[int] = []
+        engine._on_retire = self._on_engine_retire
+
+    # ---- frontend API ------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *, tenant: str = "default",
+               priority: int = 0, deadline_s: Optional[float] = None,
+               eos_id: Optional[int] = None, prefix_id: Optional[int] = None,
+               on_token=None) -> Union[int, Rejected]:
+        """Admit a request: returns its id, or a ``Rejected`` (check with
+        ``isinstance``) when the bounded queue / load shedding / tenant
+        quota / drain refuses it. ``deadline_s`` is relative seconds: past
+        it the request is expired wherever it is. Malformed requests
+        (empty prompt, impossible lengths) raise ``ValueError`` — caller
+        bugs, not load conditions."""
+        # the engine owns its request invariants (empty prompt, length vs
+        # max_len, prefix existence) — validate through it so a request
+        # that would fail at dispatch never reserves budget
+        prompt = self.engine.check_request(prompt, max_new_tokens, prefix_id)
+        cost = int(prompt.size) + max_new_tokens
+        with self._lock:
+            now = self._clock()
+            if not self._accepting:
+                return self._reject(Rejected(
+                    REASON_DRAINING, "gateway is draining"))
+            if deadline_s is not None and deadline_s <= 0:
+                return self._reject(Rejected(
+                    REASON_DEADLINE, f"deadline_s {deadline_s} already "
+                    f"expired at submit"))
+            rej = self._admission.admit(tenant, cost, priority,
+                                        queue_depth=len(self._sched))
+            if rej is not None:
+                return self._reject(rej)
+            rid = self._next_id
+            self._next_id += 1
+            req = GatewayRequest(
+                rid=rid, tenant=tenant, priority=priority, prompt=prompt,
+                max_new_tokens=max_new_tokens, eos_id=eos_id,
+                prefix_id=prefix_id, cost=cost,
+                deadline=(now + deadline_s if deadline_s is not None
+                          else None),
+                submitted_at=now, on_token=on_token)
+            self._requests[rid] = req
+            self._sched.push(req)
+            depth = len(self._sched)
+        if self.metrics is not None:
+            self.metrics.inc("requests_submitted")
+            self.metrics.set_gauge("queue_depth", depth)
+        return rid
+
+    def cancel(self, request_id: int) -> bool:
+        """Client-driven cancellation. A QUEUED request is retired here and
+        now; an in-engine one is marked and its slot is aborted (freed for
+        the next admission) at the top of the driver's next ``step()``.
+        False when the id is unknown or already terminal."""
+        with self._lock:
+            req = self._requests.get(request_id)
+            if req is None or req.state not in LIVE_STATES:
+                return False
+            if req.state is RequestState.QUEUED:
+                self._sched.remove(req)
+                self._finalize_locked(req, RequestState.CANCELLED)
+            else:
+                req.cancel_requested = True
+        return True
+
+    def result(self, request_id: int) -> Optional[RequestResult]:
+        """The terminal outcome (popped — one consumer per request, like
+        ``engine.result``), or None while the request is live. Partial
+        tokens ride along for mid-decode cancels/expiries."""
+        with self._lock:
+            req = self._requests.get(request_id)
+            if req is None or req.state in LIVE_STATES:
+                return None
+            del self._requests[request_id]
+            return RequestResult(request_id, req.state, req.tokens)
+
+    def state(self, request_id: int) -> Optional[RequestState]:
+        with self._lock:
+            req = self._requests.get(request_id)
+            return None if req is None else req.state
+
+    # ---- lifecycle internals ----------------------------------------------
+    def _reject(self, rej: Rejected) -> Rejected:
+        if self.metrics is not None:
+            self.metrics.inc("requests_rejected")
+            self.metrics.inc(f"rejected_{rej.reason}")
+        return rej
+
+    def _finalize_locked(self, req: GatewayRequest, state: RequestState,
+                         tokens=None) -> None:
+        """Terminal transition + budget release + counters. Lock held."""
+        finalize(req, state, tokens)
+        self._admission.release(req.tenant, req.cost)
+        self._newly_terminal.append(req.rid)
+        if self.metrics is None:
+            return
+        now = self._clock()
+        if state is RequestState.DONE:
+            self.metrics.inc("requests_finished")
+            self.metrics.observe("request_latency_seconds",
+                                 now - req.submitted_at)
+            if req.n_tokens >= 2 and req.first_token_at is not None:
+                self.metrics.observe(
+                    "time_per_output_token_seconds",
+                    (req.last_token_at - req.first_token_at)
+                    / (req.n_tokens - 1))
+        elif state is RequestState.CANCELLED:
+            self.metrics.inc("requests_cancelled")
+        elif state is RequestState.DEADLINE_EXCEEDED:
+            self.metrics.inc("deadline_exceeded")
+
+    def _on_engine_retire(self, engine_rid: int, tokens) -> None:
+        """Engine hook: a dispatched request finished (fires during
+        ``engine.step()``, outside the engine lock)."""
+        with self._lock:
+            rid = self._by_engine.pop(engine_rid, None)
+            if rid is None:
+                return               # direct-to-engine traffic, not ours —
+                                     # leave its result for its consumer
+            # claim from the engine so its finished dict stays flat (lock
+            # order gateway→engine, same as dispatch/reap)
+            self.engine.result(engine_rid)
+            self._in_engine -= 1
+            self._finalize_locked(self._requests[rid], RequestState.DONE,
+                                  tokens)
+
+    def _wrap_on_token(self, req: GatewayRequest):
+        def hook(engine_rid: int, token: int) -> None:
+            with self._lock:
+                now = self._clock()
+                first = req.first_token_at is None
+                if first:
+                    req.first_token_at = now
+                    if req.state is RequestState.ADMITTED:
+                        req.state = RequestState.DECODING
+                req.last_token_at = now
+                req.n_tokens += 1
+            if self.metrics is not None:
+                self.metrics.inc("tokens_emitted")
+                if first:
+                    self.metrics.observe("time_to_first_token_seconds",
+                                         now - req.submitted_at)
+            if req.on_token is not None:
+                # isolate the user's callback ourselves: if the engine saw
+                # it raise it would detach this whole hook, and the
+                # gateway's TTFT/TPOT bookkeeping would go dark with it
+                try:
+                    req.on_token(req.rid, token)
+                except Exception as e:  # noqa: BLE001
+                    req.on_token = None
+                    import warnings
+                    warnings.warn(
+                        f"on_token callback for request {req.rid} raised "
+                        f"{type(e).__name__}: {e}; streaming detached",
+                        stacklevel=2)
+        return hook
+
+    def _reap_locked(self, now: float) -> None:
+        """Expire/cancel queued and in-engine requests. Lock held. Engine
+        aborts are safe here: the driver thread is the only caller and the
+        device step has not been launched yet this iteration."""
+        for req in list(self._sched.queued()):
+            if req.cancel_requested or req.expired(now):
+                self._sched.remove(req)
+                self._finalize_locked(
+                    req, RequestState.CANCELLED if req.cancel_requested
+                    else RequestState.DEADLINE_EXCEEDED)
+        for rid in list(self._by_engine.values()):
+            req = self._requests[rid]
+            if req.state not in LIVE_STATES:
+                continue
+            if req.cancel_requested or req.expired(now):
+                partial = self.engine.abort(req.engine_rid)
+                if partial is None:
+                    continue      # mid-admission this instant; next step
+                self._by_engine.pop(req.engine_rid, None)
+                self._in_engine -= 1
+                self._finalize_locked(
+                    req, RequestState.CANCELLED if req.cancel_requested
+                    else RequestState.DEADLINE_EXCEEDED, partial)
+
+    def _dispatch_locked(self, now: float) -> None:
+        """Feed the engine up to its slot count — never more, so the fair
+        queue (not the engine FIFO) stays the ordering authority."""
+        from tpu_on_k8s.models.serving import EngineOverloadedError
+        while self._in_engine < self.engine.n_slots:
+            req = self._sched.pop()
+            if req is None:
+                break
+            try:
+                req.engine_rid = self.engine.submit(
+                    req.prompt, req.max_new_tokens, eos_id=req.eos_id,
+                    prefix_id=req.prefix_id,
+                    on_token=self._wrap_on_token(req))
+            except EngineOverloadedError:
+                # a capped engine shared with direct submitters can fill
+                # outside our accounting: un-pop (head, not tail — the
+                # request keeps its FIFO place) and retry next step
+                self._sched.push_front(req)
+                break
+            req.state = RequestState.ADMITTED
+            req.dispatched_at = now
+            self._by_engine[req.engine_rid] = req.rid
+            self._in_engine += 1
+            if self.metrics is not None:
+                self.metrics.observe("queue_wait_seconds",
+                                     now - req.submitted_at)
+
+    # ---- the driver loop ---------------------------------------------------
+    def step(self) -> List[int]:
+        """One gateway iteration: reap cancels/deadlines (freeing their
+        slots), dispatch from the fair queue into the freed capacity, then
+        advance the engine one step. Returns ids that reached a terminal
+        state — notifications, like ``engine.step``; the payload goes to
+        whoever calls ``result(rid)``."""
+        with self._lock:
+            now = self._clock()
+            self._reap_locked(now)
+            self._dispatch_locked(now)
+        if self._in_engine:
+            self.engine.step()
+        with self._lock:
+            out, self._newly_terminal = self._newly_terminal, []
+            depth = len(self._sched)
+        if self.metrics is not None:
+            self.metrics.set_gauge("queue_depth", depth)
+            self.metrics.set_gauge(
+                "slots_active",
+                self.engine.n_slots - self.engine.free_slots)
+        return out
+
+    def run(self) -> Dict[int, RequestResult]:
+        """Step until every accepted request is terminal; claim and return
+        all unclaimed results (convenience for batch-style callers and
+        tests — a live server just loops ``step()``)."""
+        while self._live():
+            self.step()
+        return self._claim_all()
+
+    def stop_accepting(self) -> None:
+        """New ``submit()`` calls return ``Rejected("draining")`` from now
+        on; everything already accepted keeps running."""
+        with self._lock:
+            self._accepting = False
+
+    def drain(self, timeout_s: Optional[float] = None
+              ) -> Dict[int, RequestResult]:
+        """Graceful shutdown: stop accepting, finish in-flight work, and
+        past ``timeout_s`` cancel whatever remains (the CRR/preemption
+        shape: SIGTERM grace period, then the pod dies anyway — better to
+        cancel cleanly and free the budget than be killed mid-step)."""
+        self.stop_accepting()
+        deadline = (self._clock() + timeout_s if timeout_s is not None
+                    else None)
+        while self._live():
+            if deadline is not None and self._clock() >= deadline:
+                with self._lock:
+                    for req in self._requests.values():
+                        if req.state in LIVE_STATES:
+                            req.cancel_requested = True
+                deadline = None      # one sweep marks everything live
+            self.step()
+        return self._claim_all()
+
+    def _live(self) -> bool:
+        with self._lock:
+            return any(r.state in LIVE_STATES
+                       for r in self._requests.values())
+
+    def _claim_all(self) -> Dict[int, RequestResult]:
+        with self._lock:
+            done = [rid for rid, r in self._requests.items()
+                    if r.state not in LIVE_STATES]
+            out = {}
+            for rid in done:
+                req = self._requests.pop(rid)
+                out[rid] = RequestResult(rid, req.state, req.tokens)
+            return out
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._sched)

@@ -1,0 +1,112 @@
+"""Request lifecycle: states, deadlines, cancellation, and drain.
+
+The engine (`tpu_on_k8s/models/serving.py`) knows three things about a
+request: queued, in a slot, finished. A service needs the full lifecycle —
+
+    queued ──► admitted ──► decoding ──► done
+      │            │            │
+      │            └────┬───────┴──► cancelled
+      ├─► rejected      └──────────► deadline_exceeded
+      ├─► cancelled
+      └─► deadline_exceeded
+
+Terminal states are sticky; ``rejected`` is only ever assigned at
+``submit()`` time (a rejected request never enters the queue). Deadlines
+are enforced in two places with different costs: a QUEUED request past its
+deadline is expired before it ever occupies a slot (free), and an
+ADMITTED/DECODING one is aborted via ``engine.abort`` — its slot is
+returned the same step (cheap: host bookkeeping, no device work). This is
+the serving analog of the controller's failover semantics
+(`controller/failover.py`): preemption arrives as ``stop_accepting()`` +
+bounded drain rather than a pod kill.
+
+All clock reads go through an injectable ``clock`` (the gateway passes its
+own) so deadline behavior is deterministic under test — the same pattern
+`coordinator/plugins.py` uses for quota reservation TTLs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+class RequestState(str, enum.Enum):
+    """Gateway-visible request states (see the module diagram)."""
+
+    QUEUED = "queued"                        # in the gateway's fair queue
+    ADMITTED = "admitted"                    # handed to the engine (may be
+                                             # mid-chunked-prefill)
+    DECODING = "decoding"                    # first token emitted
+    DONE = "done"
+    CANCELLED = "cancelled"
+    DEADLINE_EXCEEDED = "deadline_exceeded"
+    REJECTED = "rejected"
+
+
+#: states a request can still leave
+LIVE_STATES = frozenset({RequestState.QUEUED, RequestState.ADMITTED,
+                         RequestState.DECODING})
+TERMINAL_STATES = frozenset(RequestState) - LIVE_STATES
+
+
+@dataclasses.dataclass
+class GatewayRequest:
+    """One request's full gateway-side record. ``tokens`` holds the final
+    continuation for DONE and the partial one for a mid-decode cancel or
+    deadline abort (clients often want the partial text they paid for)."""
+
+    rid: int
+    tenant: str
+    priority: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_id: Optional[int]
+    prefix_id: Optional[int]
+    cost: int                         # reserved token budget (prompt + new)
+    deadline: Optional[float]         # absolute clock() time, None = never
+    submitted_at: float
+    on_token: Optional[Callable[[int, int], None]] = None
+    state: RequestState = RequestState.QUEUED
+    engine_rid: Optional[int] = None
+    dispatched_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    last_token_at: Optional[float] = None
+    n_tokens: int = 0
+    tokens: Optional[np.ndarray] = None
+    cancel_requested: bool = False
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestResult:
+    """What ``gateway.result()`` hands back: the terminal state plus
+    whatever tokens were produced (complete for DONE, partial for
+    CANCELLED / DEADLINE_EXCEEDED after decode started, empty otherwise)."""
+
+    rid: int
+    state: RequestState
+    tokens: np.ndarray
+
+    @property
+    def ok(self) -> bool:
+        return self.state is RequestState.DONE
+
+
+def finalize(req: GatewayRequest, state: RequestState,
+             tokens: Optional[Any] = None) -> GatewayRequest:
+    """Move ``req`` to a terminal state exactly once (idempotent: a second
+    transition is ignored so e.g. a cancel racing a deadline keeps the
+    first verdict)."""
+    if req.state in TERMINAL_STATES:
+        return req
+    req.state = state
+    if tokens is not None:
+        req.tokens = np.asarray(tokens, np.int32)
+    elif req.tokens is None:
+        req.tokens = np.zeros(0, np.int32)
+    return req
