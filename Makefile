@@ -6,14 +6,22 @@
 # docker-build produces.
 IMG ?= tpu-on-k8s/manager:latest
 
-.PHONY: test test-fast native bench dryrun manager samples clean \
+.PHONY: test test-fast chaos-soak native bench dryrun manager samples clean \
         docker-build docker-push deploy undeploy
+
+# fixed seed so a red run is replayable verbatim; the soak itself prints
+# CHAOS_SOAK_FAILED seed=... on any failure
+CHAOS_SEED ?= 1234
 
 test:
 	python -m pytest tests/ -q
 
 test-fast:  ## skip the slow sharded-compile suites
 	python -m pytest tests/ -q -k "not decode and not ring and not moe"
+
+chaos-soak:  ## the end-to-end failure-recovery scenario suite, twice, logs compared
+	JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed $(CHAOS_SEED) --repeat 2
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos -p no:cacheprovider
 
 native:  ## build the C++ data pipeline explicitly (also built lazily on import)
 	g++ -O2 -std=c++17 -shared -fPIC \

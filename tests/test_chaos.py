@@ -1,0 +1,644 @@
+"""Chaos harness + end-to-end failure recovery.
+
+Tier-1 section: the injector's determinism contract, each fault site's
+typed surfacing (REST client, apiserver, controller reconcile, serving
+engine, train loop), and the two recovery gaps the harness closed —
+serving-request replay after an engine crash and preemption-safe
+bit-exact train resume.
+
+The full multi-plane soak (watch outage → slice preemption → engine crash
+→ train preemption, twice, identical event logs) lives behind the
+``chaos`` + ``slow`` markers; run it with ``make chaos-soak``.
+"""
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+from tpu_on_k8s import chaos
+from tpu_on_k8s.chaos import scenarios
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    """A test that forgets to uninstall must not poison its neighbors."""
+    yield
+    chaos.uninstall()
+
+
+# ---------------------------------------------------------------- injector
+
+def test_trigger_validation():
+    with pytest.raises(ValueError, match="needs at=, every=, or prob="):
+        chaos.Trigger()
+    with pytest.raises(ValueError, match="every"):
+        chaos.Trigger(every=0)
+    with pytest.raises(ValueError, match="prob"):
+        chaos.Trigger(prob=1.5)
+
+
+def test_injector_counters_fire_deterministically():
+    rules = [
+        chaos.FaultRule("site.a", chaos.on_call(2, 4), chaos.HttpError(503)),
+        chaos.FaultRule("site.a", chaos.every(3), chaos.Conflict()),
+        chaos.FaultRule("site.b", chaos.with_prob(0.5, limit=3),
+                        chaos.TimeoutFault()),
+    ]
+
+    def run():
+        inj = chaos.FaultInjector(rules, seed=99)
+        fires = []
+        for i in range(10):
+            fires.append(type(inj.fire("site.a", n=i)).__name__)
+        for i in range(10):
+            fires.append(type(inj.fire("site.b", n=i)).__name__)
+        return fires, inj.events
+
+    f1, e1 = run()
+    f2, e2 = run()
+    assert f1 == f2 and e1 == e2, "same schedule+seed must fire identically"
+    # at=(2,4) wins on its calls; every=3 fires where the first rule did not
+    a = f1[:10]
+    assert a[1] == "HttpError" and a[3] == "HttpError"
+    assert a[2] == "Conflict"            # call 3 → every=3
+    assert a.count("HttpError") == 2
+    # prob rule respects its limit
+    assert f1[10:].count("TimeoutFault") <= 3
+
+
+def test_injector_match_filters_and_counts_per_rule():
+    inj = chaos.FaultInjector([
+        chaos.FaultRule("s", chaos.Trigger(at=(1,), match={"kind": "Pod"}),
+                        chaos.WatchDrop()),
+    ])
+    assert inj.fire("s", kind="Service") is None     # filtered, not counted
+    assert isinstance(inj.fire("s", kind="Pod"), chaos.WatchDrop)
+    assert inj.fire("s", kind="Pod") is None         # at=(1,) spent
+    assert inj.counts()["s#0"] == (2, 1)
+
+
+def test_install_refuses_stacking_and_fire_is_free_when_empty():
+    assert chaos.fire("anything") is None
+    inj = chaos.FaultInjector([])
+    chaos.install(inj)
+    with pytest.raises(RuntimeError, match="already installed"):
+        chaos.install(chaos.FaultInjector([]))
+    chaos.uninstall(inj)
+    assert chaos.active() is None
+
+
+# ------------------------------------------------------------- REST client
+
+@pytest.fixture()
+def rest_pair():
+    from tpu_on_k8s.client.apiserver import ApiServer
+    from tpu_on_k8s.client.rest import RestCluster
+
+    srv = ApiServer().start()
+    client = RestCluster(srv.url)
+    yield srv, client
+    client.close()
+    srv.stop()
+
+
+def test_rest_request_faults_surface_typed(rest_pair):
+    from tpu_on_k8s.api.types import TPUJob
+    from tpu_on_k8s.client.cluster import ApiError, ConflictError
+
+    _, rest = rest_pair
+    with chaos.FaultInjector([chaos.FaultRule(
+            chaos.SITE_REST_REQUEST, chaos.on_call(1),
+            chaos.HttpError(503))]):
+        with pytest.raises(ApiError, match="503"):
+            rest.list(TPUJob)
+    with chaos.FaultInjector([chaos.FaultRule(
+            chaos.SITE_REST_REQUEST, chaos.on_call(1), chaos.Conflict())]):
+        with pytest.raises(ConflictError):
+            rest.list(TPUJob)
+    # a single connection-level fault takes the real stale-keep-alive retry
+    # path and is absorbed
+    with chaos.FaultInjector([chaos.FaultRule(
+            chaos.SITE_REST_REQUEST, chaos.on_call(1),
+            chaos.ConnectionResetFault())]):
+        assert rest.list(TPUJob) == []
+    # both attempts faulted → the failure propagates (timeout is an OSError)
+    with chaos.FaultInjector([chaos.FaultRule(
+            chaos.SITE_REST_REQUEST, chaos.on_call(1, 2),
+            chaos.TimeoutFault())]):
+        with pytest.raises(OSError):
+            rest.list(TPUJob)
+
+
+def test_apiserver_side_injection(rest_pair):
+    from tpu_on_k8s.api.types import TPUJob
+    from tpu_on_k8s.client.cluster import ApiError
+
+    _, rest = rest_pair
+    with chaos.FaultInjector([chaos.FaultRule(
+            chaos.SITE_APISERVER_REQUEST, chaos.on_call(1),
+            chaos.HttpError(500))]):
+        with pytest.raises(ApiError, match="500"):
+            rest.list(TPUJob)
+    # server-side reset: connection dies, client's retry redials and lands
+    with chaos.FaultInjector([chaos.FaultRule(
+            chaos.SITE_APISERVER_REQUEST, chaos.on_call(1),
+            chaos.ConnectionResetFault())]):
+        assert rest.list(TPUJob) == []
+
+
+def test_watch_drop_reconnects_and_delivers(rest_pair):
+    from tpu_on_k8s.api.core import Container, ObjectMeta, Pod, PodSpec
+
+    _, rest = rest_pair
+    seen = []
+    rest.watch(lambda ev: seen.append(ev.obj.metadata.name), kinds=["Pod"])
+
+    def mk(i):
+        return Pod(metadata=ObjectMeta(name=f"p{i}"),
+                   spec=PodSpec(containers=[Container(name="c", image="i")]))
+
+    inj = scenarios.watch_outage(kind="Pod", reconnect_failures=2).injector()
+    with inj:
+        for i in range(4):
+            rest.create(mk(i))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and inj.fired_total() < 3:
+            time.sleep(0.05)
+    assert inj.fired_total() == 3, inj.counts()
+    # recovery: every pod is eventually delivered despite drop + refused
+    # dials (resume replay may duplicate — level-triggered consumers cope)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and len(set(seen)) < 4:
+        time.sleep(0.05)
+    assert {f"p{i}" for i in range(4)} <= set(seen)
+
+
+def test_conflict_retries_exhausted_typed_and_counted(rest_pair):
+    from tpu_on_k8s.api.core import ObjectMeta
+    from tpu_on_k8s.api.types import TPUJob
+    from tpu_on_k8s.client.cluster import (
+        ConflictError,
+        ConflictRetriesExhausted,
+    )
+    from tpu_on_k8s.metrics import JobMetrics
+
+    _, rest = rest_pair
+    rest.metrics = JobMetrics()
+    rest.create(TPUJob(metadata=ObjectMeta(name="c")))
+    with chaos.FaultInjector([chaos.FaultRule(
+            chaos.SITE_REST_REQUEST,
+            chaos.Trigger(every=1, match={"method": "PUT"}),
+            chaos.Conflict())]):
+        with pytest.raises(ConflictRetriesExhausted) as ei:
+            rest.update_with_retry(TPUJob, "default", "c", lambda j: None,
+                                   attempts=3)
+    assert isinstance(ei.value, ConflictError)   # subclass contract
+    assert rest.metrics.counters["conflict_retries"] == 3
+    with pytest.raises(ValueError, match="attempts"):
+        rest.update_with_retry(TPUJob, "default", "c", lambda j: None,
+                               attempts=0)
+
+
+def test_inmemory_conflict_retries_exhausted():
+    from tpu_on_k8s.api.core import ObjectMeta
+    from tpu_on_k8s.api.types import TPUJob
+    from tpu_on_k8s.client import InMemoryCluster
+    from tpu_on_k8s.client.cluster import ConflictRetriesExhausted
+
+    cluster = InMemoryCluster()
+    cluster.create(TPUJob(metadata=ObjectMeta(name="c")))
+
+    def racing_mutate(job):
+        # another writer wins every race: bump the stored object AFTER our
+        # read so our write always carries a stale resourceVersion
+        fresh = cluster.get(TPUJob, "default", "c")
+        fresh.metadata.labels["race"] = str(time.monotonic_ns())
+        cluster.update(fresh)
+
+    with pytest.raises(ConflictRetriesExhausted):
+        cluster.update_with_retry(TPUJob, "default", "c", racing_mutate,
+                                  attempts=3)
+
+
+def test_watch_backoff_decorrelated_jitter(rest_pair):
+    import random
+
+    _, rest = rest_pair
+    rest._backoff_rng = random.Random(7)
+    seen = set()
+    prev = rest.WATCH_BACKOFF_INITIAL
+    for _ in range(50):
+        nxt = rest._next_backoff(prev)
+        assert rest.WATCH_BACKOFF_INITIAL <= nxt <= rest.WATCH_BACKOFF_MAX
+        assert nxt <= max(prev * 3.0, rest.WATCH_BACKOFF_INITIAL)
+        seen.add(round(nxt, 6))
+        prev = nxt
+    # jitter means the sequence is spread, not a deterministic ladder
+    assert len(seen) > 40
+    # two clients seeded differently desynchronize immediately
+    other = random.Random(8)
+    a = random.Random(7).uniform(0.2, 0.6)
+    b = other.uniform(0.2, 0.6)
+    assert a != b
+
+
+# ------------------------------------------------------ controller plane
+
+def _job(name, workers=4, topology="4x4"):
+    from tpu_on_k8s.api.core import (
+        Container,
+        ObjectMeta,
+        PodSpec,
+        PodTemplateSpec,
+    )
+    from tpu_on_k8s.api.types import (
+        RestartPolicy,
+        TaskSpec,
+        TaskType,
+        TPUJob,
+        TPUJobSpec,
+        TPUPolicy,
+    )
+
+    template = PodTemplateSpec(
+        spec=PodSpec(containers=[Container(name="tpu", image="i")]))
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            tasks={TaskType.MASTER: TaskSpec(num_tasks=1, template=template),
+                   TaskType.WORKER: TaskSpec(
+                       num_tasks=workers, template=template,
+                       restart_policy=RestartPolicy.ON_EXIT_CODE)},
+            tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice",
+                                 topology=topology),
+        ))
+
+
+def _operator_with_running_job(name, workers=4, topology="4x4"):
+    from tpu_on_k8s.client import KubeletSim
+    from tpu_on_k8s.controller.tpujob import submit_job
+    from tpu_on_k8s.main import Operator, build_parser
+
+    op = Operator(build_parser().parse_args([]))
+    submit_job(op.cluster, _job(name, workers, topology))
+    sim = KubeletSim(op.cluster)
+    for _ in range(8):
+        op.run_once()
+        sim.run_all("default")
+    return op, sim
+
+
+def test_injected_pod_kill_triggers_failover():
+    from tpu_on_k8s.api.core import Pod, PodPhase
+    from tpu_on_k8s.controller.runtime import Request
+
+    op, sim = _operator_with_running_job("kill")
+    inj = scenarios.pod_kill("default/kill", index=2, exit_code=137,
+                             reason="OOMKilled").injector()
+    with inj:
+        op.engine.reconcile(Request("default", "kill"))
+        for _ in range(10):
+            op.run_once()
+            sim.run_all("default")
+    assert inj.events == ["pod_fail(index=2, reason=OOMKilled) "
+                          "note=kill worker-2 of default/kill"]
+    pod = op.cluster.get(Pod, "default", "kill-worker-2")
+    assert pod.status.phase == PodPhase.RUNNING     # recreated by failover
+
+
+def test_injected_slice_preemption_recovers_whole_slice():
+    """Evicted-reason injection on a whole slice: every worker in slice 0
+    fails at once (the TPU failure domain) and failover returns the job
+    to all-Running."""
+    from tpu_on_k8s.api.core import Pod, PodPhase
+    from tpu_on_k8s.controller.runtime import Request
+
+    op, sim = _operator_with_running_job("preempt")
+    before = {p.metadata.uid for p in op.cluster.list(Pod, "default")
+              if "worker" in p.metadata.name}
+    inj = scenarios.slice_preemption("default/preempt",
+                                    slice_index=0).injector()
+    with inj:
+        op.engine.reconcile(Request("default", "preempt"))
+        for _ in range(12):
+            op.run_once()
+            sim.run_all("default")
+    pods = op.cluster.list(Pod, "default")
+    assert sum(p.status.phase == PodPhase.RUNNING for p in pods) == 5
+    after = {p.metadata.uid for p in pods if "worker" in p.metadata.name}
+    # 4x4 on v5e = one 4-host slice: every worker was replaced
+    assert not (before & after), "slice pods must be recreated, not reused"
+
+
+# ---------------------------------------------------------- serving plane
+
+@pytest.fixture(scope="module")
+def serve_setup():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+
+    cfg = dataclasses.replace(TransformerConfig.tiny(), dtype=jnp.float32,
+                              max_seq_len=64)
+    tok = jax.random.randint(jax.random.key(0), (1, 8), 0, cfg.vocab_size,
+                             jnp.int32)
+    params = Transformer(cfg).init(jax.random.key(1), tok)["params"]
+    return cfg, params
+
+
+def _gateway(cfg, params, n_slots=2, **kw):
+    from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+    from tpu_on_k8s.serve import ServingGateway
+
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=n_slots)
+    return eng, ServingGateway(eng, **kw)
+
+
+def test_engine_crash_mid_decode_replays_to_exact_completion(serve_setup):
+    """The tentpole recovery: crash mid-decode, every in-flight request is
+    re-admitted through the fair queue and finishes with tokens
+    bit-identical to solo generate() — zero silently lost."""
+    import jax.numpy as jnp
+
+    from tpu_on_k8s.metrics.metrics import ServingMetrics
+    from tpu_on_k8s.models.decode import generate
+    from tpu_on_k8s.serve import ReplayPolicy, RequestState
+
+    cfg, params = serve_setup
+    rng = np.random.default_rng(60)
+    m = ServingMetrics()
+    eng, gw = _gateway(cfg, params, metrics=m,
+                       replay=ReplayPolicy(max_replays=2,
+                                           backoff_base_s=0.0))
+    prompts = [rng.integers(0, cfg.vocab_size, size=int(n)).astype(np.int32)
+               for n in (5, 9, 3, 7)]
+    rids = [gw.submit(p, 6) for p in prompts]
+    inj = scenarios.engine_crash_mid_decode(at_steps=(3,)).injector()
+    with inj:
+        out = gw.run()
+    assert eng.stats["crashes"] == 1
+    assert m.counters["engine_crashes"] == 1
+    assert m.counters["requests_replayed"] == 2      # the 2 in-flight slots
+    assert m.counters["retry_exhausted"] == 0
+    assert set(out) == set(rids), "no request may be silently lost"
+    for rid, p in zip(rids, prompts):
+        assert out[rid].state is RequestState.DONE
+        want = np.asarray(generate(cfg, params,
+                                   jnp.asarray(p, jnp.int32)[None, :],
+                                   max_new_tokens=6))[0]
+        np.testing.assert_array_equal(out[rid].tokens, want)
+
+
+def test_replay_budget_exhaustion_is_accounted_not_silent(serve_setup):
+    from tpu_on_k8s.metrics.metrics import ServingMetrics
+    from tpu_on_k8s.serve import ReplayPolicy, RequestState
+
+    cfg, params = serve_setup
+    rng = np.random.default_rng(61)
+    m = ServingMetrics()
+    eng, gw = _gateway(cfg, params, metrics=m,
+                       replay=ReplayPolicy(max_replays=1,
+                                           backoff_base_s=0.0))
+    rids = [gw.submit(rng.integers(0, cfg.vocab_size,
+                                   size=5).astype(np.int32), 6)
+            for _ in range(2)]
+    inj = scenarios.engine_crash_mid_decode(at_steps=(1, 2, 3, 4)).injector()
+    with inj:
+        out = gw.run()
+    assert set(out) == set(rids)
+    assert all(out[r].state is RequestState.RETRY_EXHAUSTED for r in rids)
+    assert m.counters["requests_replayed"] == 2      # one replay each
+    assert m.counters["retry_exhausted"] == 2
+    assert m.counters["engine_crashes"] == 2         # terminal after crash 2
+
+
+def test_replay_backoff_gates_redispatch(serve_setup):
+    """A crash survivor waits out its exponential backoff before taking a
+    slot again (deterministic via the injected clock)."""
+    from tpu_on_k8s.serve import ReplayPolicy, RequestState
+
+    cfg, params = serve_setup
+    rng = np.random.default_rng(62)
+
+    class FakeClock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    eng, gw = _gateway(cfg, params, n_slots=1, clock=clock,
+                       replay=ReplayPolicy(max_replays=2,
+                                           backoff_base_s=10.0))
+    rid = gw.submit(rng.integers(0, cfg.vocab_size,
+                                 size=5).astype(np.int32), 4)
+    inj = scenarios.engine_crash_mid_decode(at_steps=(1,)).injector()
+    with inj:
+        gw.step()                       # dispatch + crash + replay mark
+    assert gw.state(rid) is RequestState.QUEUED
+    gw.step()
+    assert gw.state(rid) is RequestState.QUEUED, \
+        "must not re-dispatch before the backoff elapses"
+    assert eng.stats["steps"] == 0      # engine untouched during backoff
+    clock.t = 10.0                      # backoff (10s * 2^0) elapsed
+    gw.step()
+    assert gw.state(rid) is RequestState.DECODING
+    out = gw.run()
+    assert out[rid].state is RequestState.DONE
+
+
+def test_queued_requests_survive_crash_untouched(serve_setup):
+    """Requests still in the gateway's fair queue never touched the engine;
+    a crash must not consume their replay budget."""
+    from tpu_on_k8s.serve import ReplayPolicy, RequestState
+
+    cfg, params = serve_setup
+    rng = np.random.default_rng(63)
+    eng, gw = _gateway(cfg, params, n_slots=1,
+                       replay=ReplayPolicy(max_replays=1,
+                                           backoff_base_s=0.0))
+    first = gw.submit(rng.integers(0, cfg.vocab_size,
+                                   size=5).astype(np.int32), 4)
+    queued = gw.submit(rng.integers(0, cfg.vocab_size,
+                                    size=5).astype(np.int32), 4)
+    inj = scenarios.engine_crash_mid_decode(at_steps=(2,)).injector()
+    with inj:
+        gw.step()                        # first decodes, queued waits
+        assert gw.state(queued) is RequestState.QUEUED
+        out = gw.run()
+    assert out[first].state is RequestState.DONE     # replayed once, done
+    assert out[queued].state is RequestState.DONE
+    assert eng.stats["crashes"] == 1
+
+
+def test_cancel_and_deadline_apply_to_replay_pending(serve_setup):
+    """A crash survivor waiting out its backoff is still cancellable and
+    still expires — the replay list is not a lifecycle blind spot."""
+    from tpu_on_k8s.serve import ReplayPolicy, RequestState
+
+    cfg, params = serve_setup
+    rng = np.random.default_rng(64)
+
+    class FakeClock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    eng, gw = _gateway(cfg, params, n_slots=2, clock=clock,
+                       replay=ReplayPolicy(max_replays=2,
+                                           backoff_base_s=100.0))
+    p = rng.integers(0, cfg.vocab_size, size=5).astype(np.int32)
+    r_cancel = gw.submit(p, 4)
+    r_expire = gw.submit(p, 4, deadline_s=5.0)
+    inj = scenarios.engine_crash_mid_decode(at_steps=(1,)).injector()
+    with inj:
+        gw.step()
+    assert gw.state(r_cancel) is RequestState.QUEUED
+    assert gw.cancel(r_cancel)
+    assert gw.state(r_cancel) is RequestState.CANCELLED
+    clock.t = 6.0                        # past r_expire's deadline
+    gw.step()
+    assert gw.state(r_expire) is RequestState.DEADLINE_EXCEEDED
+
+
+def test_engine_reset_drops_requests_keeps_results(serve_setup):
+    from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+
+    cfg, params = serve_setup
+    rng = np.random.default_rng(65)
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2)
+    done_rid = eng.submit(rng.integers(0, cfg.vocab_size,
+                                       size=4).astype(np.int32), 2)
+    while eng.result(done_rid) is None:
+        eng.step()
+        finished = eng.result(done_rid)
+        if finished is not None:
+            eng._finished[done_rid] = finished   # put back for the assert
+            break
+    live = eng.submit(rng.integers(0, cfg.vocab_size,
+                                   size=4).astype(np.int32), 8)
+    eng.step()
+    eng.reset()
+    assert eng.free_slots == eng.n_slots
+    assert eng.abort(live) is None                  # live request is gone
+    assert eng.result(done_rid) is not None         # finished work survives
+
+
+# ------------------------------------------------------------- train plane
+
+def _toy_train():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step_fn(state, batch):
+        x, y = batch
+        loss, grad = jax.value_and_grad(
+            lambda w: jnp.mean((x @ w - y) ** 2))(state["w"])
+        return ({"w": state["w"] - 0.1 * grad,
+                 "step": state["step"] + 1}, {"loss": loss})
+
+    def init_state():
+        return {"w": jnp.zeros((4, 2), jnp.float32),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def batches_from(start, seed=0):
+        i = start
+        while True:
+            brng = np.random.default_rng((seed, i))
+            yield (jnp.asarray(brng.normal(size=(8, 4)), jnp.float32),
+                   jnp.asarray(brng.normal(size=(8, 2)), jnp.float32))
+            i += 1
+
+    return step_fn, init_state, batches_from
+
+
+def test_injected_preemption_resumes_bit_exact(tmp_path):
+    """The tentpole train recovery: preemption notice at an injected step,
+    the preemption-time save FAILS, resume falls back to the last periodic
+    checkpoint, and the stitched loss trajectory equals the no-fault run
+    bit-for-bit."""
+    from tpu_on_k8s.train.checkpoint import CheckpointManager
+    from tpu_on_k8s.train.loop import TrainLoop
+
+    step_fn, init_state, batches_from = _toy_train()
+    steps, preempt_at, every = 12, 8, 3
+    base = TrainLoop(step_fn, init_state(), batches_from(1),
+                     log_every=1).run(steps)
+    base_losses = {s: float(h["loss"]) for s, h in base.history}
+
+    mgr = CheckpointManager(str(tmp_path))
+    inj = scenarios.train_preemption(preempt_at, fail_save=True).injector()
+    loop = TrainLoop(step_fn, init_state(), batches_from(1), log_every=1,
+                     checkpoint_manager=mgr, checkpoint_every=every)
+    with inj:
+        first = loop.run(steps)
+    assert first.preempted and first.steps == preempt_at - 1
+    assert first.checkpoint_failures == 1
+
+    restored, _, step = mgr.restore(init_state())
+    assert step == ((preempt_at - 1) // every) * every   # periodic fallback
+    resumed = TrainLoop(step_fn, restored, batches_from(step + 1),
+                        log_every=1).run(steps - step)
+    mgr.close()
+    stitched = {s: float(h["loss"]) for s, h in first.history}
+    stitched.update({s + step: float(h["loss"])
+                     for s, h in resumed.history})
+    assert stitched == base_losses, "resume must replay the exact trajectory"
+
+
+def test_save_failure_is_survivable_and_counted(tmp_path):
+    from tpu_on_k8s.metrics import TrainMetrics
+    from tpu_on_k8s.train.checkpoint import CheckpointManager
+    from tpu_on_k8s.train.loop import TrainLoop
+
+    step_fn, init_state, batches_from = _toy_train()
+    mgr = CheckpointManager(str(tmp_path))
+    metrics = TrainMetrics()
+    inj = chaos.FaultInjector([chaos.FaultRule(
+        chaos.SITE_TRAIN_SAVE, chaos.on_call(1), chaos.SaveFailure())])
+    loop = TrainLoop(step_fn, init_state(), batches_from(1), log_every=1,
+                     checkpoint_manager=mgr, checkpoint_every=3,
+                     metrics=metrics)
+    with inj:
+        result = loop.run(7)
+    assert result.steps == 7, "a failed save must not stop training"
+    assert result.checkpoint_failures == 1
+    assert result.checkpoints_enqueued == 1          # step 6 landed
+    assert metrics.counters["checkpoint_failures"] == 1
+    assert mgr.latest() == (0, 6)
+    mgr.close()
+
+
+def test_injected_step_failure_raises_typed():
+    from tpu_on_k8s.train.loop import TrainLoop
+
+    step_fn, init_state, batches_from = _toy_train()
+    inj = chaos.FaultInjector([chaos.FaultRule(
+        chaos.SITE_TRAIN_STEP, chaos.on_call(3), chaos.StepFailure())])
+    loop = TrainLoop(step_fn, init_state(), batches_from(1), log_every=1)
+    with inj, pytest.raises(chaos.ChaosStepError):
+        loop.run(10)
+
+
+# ------------------------------------------------------------ the full soak
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_full_recovery_soak_twice_identical_logs():
+    """The acceptance scenario: watch drop + slice preemption (Evicted) +
+    engine crash mid-decode + train preemption, under one fixed seed, run
+    twice — recovery on every plane and byte-identical event logs."""
+    from tools.chaos_soak import DEFAULT_SEED, run_all
+
+    first = run_all(DEFAULT_SEED)
+    second = run_all(DEFAULT_SEED)
+    assert first["events"] == second["events"]
+    assert first["operator"]["replaced"] == 4
+    assert first["serve"]["done"] == 6
+    assert first["serve"]["retry_exhausted_storm"] == 2
+    assert first["train"]["steps"] == 14

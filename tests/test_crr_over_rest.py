@@ -593,3 +593,97 @@ def test_failed_sibling_crr_falls_back_to_recreate():
     # the sibling was recreated (new uid) instead of silently kept running
     w1 = cluster.try_get(Pod, "default", "sib-worker-1")
     assert w1 is None or w1.metadata.uid != w1_uid
+
+
+# ------------------------------------------- stale-CRR expiry / collect()
+
+def _running_pod(cluster, name="w0"):
+    pod = Pod(metadata=ObjectMeta(name=name),
+              spec=PodSpec(containers=[Container(name="tpu", image="i")]))
+    cluster.create(pod)
+    KubeletSim(cluster).run_pod("default", name)
+    return cluster.get(Pod, "default", name)
+
+
+def test_restarter_expires_stale_incarnation_crr():
+    """A CRR labeled with a DEAD incarnation's uid (the pod was recreated
+    under the same name while the CRR sat unserved) is expired — deleted,
+    PENDING — and the next pass posts a fresh CRR pinned to the live uid,
+    so a node agent can never restart the wrong incarnation."""
+    from tpu_on_k8s.controller.failover import RestartOutcome
+
+    cluster = InMemoryCluster()
+    live = _running_pod(cluster)
+    restarter = CRRRestarter(cluster, wait_seconds=30.0)
+    stale = ContainerRecreateRequest(
+        metadata=ObjectMeta(name="w0", labels={
+            LABEL_CRR_POD_UID: "uid-of-a-dead-incarnation"}))
+    cluster.create(stale)
+    assert restarter.restart(cluster, live) is RestartOutcome.PENDING
+    after = cluster.try_get(ContainerRecreateRequest, "default", "w0")
+    assert after is None, "stale incarnation's CRR must be deleted"
+    # next pass: a fresh CRR pinned to the LIVE uid appears
+    assert restarter.restart(cluster, live) is RestartOutcome.PENDING
+    fresh = cluster.get(ContainerRecreateRequest, "default", "w0")
+    assert fresh.metadata.labels[LABEL_CRR_POD_UID] == live.metadata.uid
+
+
+def test_restarter_expires_stale_succeeded_crr():
+    """A Succeeded CRR whose pod is NOT Running is a leftover from an
+    earlier incident: it is consumed (deleted) and PENDING returned, so a
+    fresh CRR — not the stale success — drives the real restart."""
+    from tpu_on_k8s.controller.failover import RestartOutcome
+
+    cluster = InMemoryCluster()
+    live = _running_pod(cluster)
+    restarter = CRRRestarter(cluster, wait_seconds=30.0)
+    assert restarter.restart(cluster, live) is RestartOutcome.PENDING
+
+    def succeed(r):
+        r.status.phase = PHASE_SUCCEEDED
+    cluster.update_with_retry(ContainerRecreateRequest, "default", "w0",
+                              succeed, subresource="status")
+    # meanwhile the pod failed again — the success is stale
+    KubeletSim(cluster).fail_pod("default", "w0", exit_code=137,
+                                 reason="OOMKilled")
+    failed = cluster.get(Pod, "default", "w0")
+    out = restarter.restart(cluster, failed)
+    assert out is RestartOutcome.PENDING
+    assert cluster.try_get(ContainerRecreateRequest, "default", "w0") is None
+
+
+def test_collect_timeout_path_fails_and_cleans_up():
+    """``collect()`` (observe-only, fire-and-forget sibling restarts): a
+    CRR older than ``wait_seconds`` with no agent alive settles FAILED and
+    is deleted — never PENDING forever, never re-posted by collect."""
+    from tpu_on_k8s.controller.failover import RestartOutcome
+
+    cluster = InMemoryCluster()
+    live = _running_pod(cluster)
+    restarter = CRRRestarter(cluster, wait_seconds=0.2)
+    assert restarter.restart(cluster, live) is RestartOutcome.PENDING
+    # young CRR: collect observes PENDING without touching it
+    assert restarter.collect(live) is RestartOutcome.PENDING
+    assert cluster.try_get(ContainerRecreateRequest, "default",
+                           "w0") is not None
+    time.sleep(0.25)
+    out = restarter.collect(live)
+    assert out is RestartOutcome.FAILED
+    assert cluster.try_get(ContainerRecreateRequest, "default", "w0") is None
+    # observe-only contract: a further collect sees nothing and posts nothing
+    assert restarter.collect(live) is None
+    assert cluster.try_get(ContainerRecreateRequest, "default", "w0") is None
+
+
+def test_collect_ignores_other_incarnations_crr():
+    cluster = InMemoryCluster()
+    live = _running_pod(cluster)
+    restarter = CRRRestarter(cluster, wait_seconds=30.0)
+    stale = ContainerRecreateRequest(
+        metadata=ObjectMeta(name="w0", labels={
+            LABEL_CRR_POD_UID: "someone-elses-uid"}))
+    cluster.create(stale)
+    # uid mismatch: not this incarnation's CRR — collect must not consume it
+    assert restarter.collect(live) is None
+    assert cluster.try_get(ContainerRecreateRequest, "default",
+                           "w0") is not None

@@ -229,6 +229,44 @@ def test_drain_timeout_cancels_stragglers(setup):
     assert out[r].tokens.size < 50
 
 
+def test_drain_timeout_with_engine_stalled_mid_step(setup):
+    """Satellite: ``drain(timeout_s)`` expiring while the engine is wedged
+    mid-step (chaos ``EngineStall``: steps run but make no progress). The
+    drain must still terminate — past the deadline live work is cancelled,
+    slots freed by host-side abort, partials returned — instead of looping
+    forever on an engine that will never finish anything."""
+    from tpu_on_k8s import chaos
+
+    cfg, params = setup
+    rng = np.random.default_rng(54)
+
+    class TickingClock(FakeClock):
+        def __call__(self) -> float:
+            self.t += 0.5
+            return self.t
+
+    eng, gw = _gw(cfg, params, n_slots=1, clock=TickingClock())
+    decoding = gw.submit(
+        rng.integers(0, cfg.vocab_size, size=5).astype(np.int32), 50)
+    queued = gw.submit(
+        rng.integers(0, cfg.vocab_size, size=4).astype(np.int32), 10)
+    gw.step()                                # decoding owns the slot
+    assert gw.state(decoding) is RequestState.DECODING
+    stall = chaos.FaultInjector([chaos.FaultRule(
+        chaos.SITE_SERVE_STEP, chaos.Trigger(every=1),
+        chaos.EngineStall())])
+    try:
+        with stall:
+            out = gw.drain(timeout_s=2.0)
+    finally:
+        chaos.uninstall()
+    assert out[decoding].state is RequestState.CANCELLED
+    assert 0 < out[decoding].tokens.size < 50    # pre-stall partials kept
+    assert out[queued].state is RequestState.CANCELLED
+    assert out[queued].tokens.size == 0          # never reached a slot
+    assert eng.free_slots == eng.n_slots         # aborts freed the slot
+
+
 def test_wrr_fairness_proportions(setup):
     """Smooth-WRR across 3 tenants at weights 2:1:1 on one slot: dispatch
     order follows the configured shares exactly (6:3:3 over 12 picks),

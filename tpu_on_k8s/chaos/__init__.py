@@ -1,0 +1,104 @@
+"""Deterministic, seed-driven fault injection for the whole stack.
+
+The operator's value proposition is surviving failure — exit-code-classified
+failover (`controller/failover.py`), watch-stream resume (`client/rest.py`),
+request replay (`serve/gateway.py`), preemption-safe checkpoint resume
+(`train/loop.py` + `train/checkpoint.py`). None of that is real until
+something exercises it on demand. This package is that something:
+
+* `faults`    — the typed fault vocabulary (API 5xx/409/timeout/reset,
+  watch-stream drops, pod kills / slice preemption / Evicted injection,
+  engine crash and stall, train step/save failures, preemption notices)
+  and the named SITE_* call sites threaded through the production layers.
+* `injector`  — ``FaultInjector``: a declarative schedule of
+  ``FaultRule(site, trigger, fault)`` evaluated deterministically (per-rule
+  invocation counters; probabilistic triggers draw from a ``Random`` seeded
+  by (seed, site, rule index), never global randomness) with an append-only
+  event log so a seeded run is replayable and two runs are comparable.
+* `scenarios` — prebuilt declarative schedules (watch outage, slice
+  preemption, engine crash mid-decode, train preemption) composed by
+  `tools/chaos_soak.py` into the end-to-end recovery soak.
+
+Production call sites pay one function call and a None-check when no
+injector is installed (`fire` short-circuits on the module global), so the
+instrumentation is free in real deployments. Install is process-global and
+explicitly NOT for concurrent test sessions — one injector at a time,
+typically via ``with FaultInjector(rules, seed=s):``.
+"""
+from tpu_on_k8s.chaos.faults import (
+    SITE_APISERVER_REQUEST,
+    SITE_APISERVER_WATCH,
+    SITE_RECONCILE,
+    SITE_REST_REQUEST,
+    SITE_REST_WATCH_CONNECT,
+    SITE_REST_WATCH_EVENT,
+    SITE_SERVE_STEP,
+    SITE_TRAIN_PREEMPT,
+    SITE_TRAIN_SAVE,
+    SITE_TRAIN_STEP,
+    ChaosSaveError,
+    ChaosStepError,
+    Conflict,
+    ConnectionResetFault,
+    EngineCrash,
+    EngineStall,
+    Fault,
+    HttpError,
+    PodFail,
+    PreemptNotice,
+    SaveFailure,
+    SlicePreempt,
+    StepFailure,
+    TimeoutFault,
+    WatchDrop,
+)
+from tpu_on_k8s.chaos.injector import (
+    FaultInjector,
+    FaultRule,
+    Trigger,
+    active,
+    every,
+    fire,
+    install,
+    on_call,
+    uninstall,
+    with_prob,
+)
+
+__all__ = [
+    "SITE_APISERVER_REQUEST",
+    "SITE_APISERVER_WATCH",
+    "SITE_RECONCILE",
+    "SITE_REST_REQUEST",
+    "SITE_REST_WATCH_CONNECT",
+    "SITE_REST_WATCH_EVENT",
+    "SITE_SERVE_STEP",
+    "SITE_TRAIN_PREEMPT",
+    "SITE_TRAIN_SAVE",
+    "SITE_TRAIN_STEP",
+    "ChaosSaveError",
+    "ChaosStepError",
+    "Conflict",
+    "ConnectionResetFault",
+    "EngineCrash",
+    "EngineStall",
+    "Fault",
+    "FaultInjector",
+    "FaultRule",
+    "HttpError",
+    "PodFail",
+    "PreemptNotice",
+    "SaveFailure",
+    "SlicePreempt",
+    "StepFailure",
+    "TimeoutFault",
+    "Trigger",
+    "WatchDrop",
+    "active",
+    "every",
+    "fire",
+    "install",
+    "on_call",
+    "uninstall",
+    "with_prob",
+]

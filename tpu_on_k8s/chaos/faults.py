@@ -1,0 +1,210 @@
+"""The typed fault vocabulary and the named injection sites.
+
+Faults are frozen dataclasses so a schedule is data — printable, hashable,
+comparable across runs — and each knows how to surface at its call site
+(``to_exception()`` for the raising sites; the controller / engine /
+gateway / train loop interpret the rest by type). Sites are stable string
+constants: they land in scenario files and event logs, so treat them as
+API.
+
+| site                   | threaded through                    | faults interpreted |
+|------------------------|-------------------------------------|--------------------|
+| rest.request           | RestCluster._request                | HttpError, Conflict, TimeoutFault, ConnectionResetFault |
+| rest.watch.connect     | RestCluster._watch_loop (dial)      | WatchDrop, ConnectionResetFault, HttpError |
+| rest.watch.event       | RestCluster._watch_loop (per frame) | WatchDrop |
+| apiserver.request      | apiserver._Handler (every verb)     | HttpError, Conflict, ConnectionResetFault, TimeoutFault |
+| apiserver.watch        | apiserver._stream_watch (per frame) | WatchDrop |
+| controller.reconcile   | JobEngine.reconcile                 | PodFail, SlicePreempt |
+| serve.engine.step      | ContinuousBatchingEngine.step       | EngineCrash, EngineStall |
+| train.step             | TrainLoop.run (per dispatch)        | StepFailure |
+| train.save             | TrainLoop._enqueue_save             | SaveFailure |
+| train.preempt          | TrainLoop.run (per iteration)       | PreemptNotice |
+
+This module imports only the stdlib — any layer may import it without
+dragging in jax or the client stack (exception mapping imports lazily).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import ClassVar, Optional
+
+
+# ---------------------------------------------------------------- site names
+SITE_REST_REQUEST = "rest.request"
+SITE_REST_WATCH_CONNECT = "rest.watch.connect"
+SITE_REST_WATCH_EVENT = "rest.watch.event"
+SITE_APISERVER_REQUEST = "apiserver.request"
+SITE_APISERVER_WATCH = "apiserver.watch"
+SITE_RECONCILE = "controller.reconcile"
+SITE_SERVE_STEP = "serve.engine.step"
+SITE_TRAIN_STEP = "train.step"
+SITE_TRAIN_SAVE = "train.save"
+SITE_TRAIN_PREEMPT = "train.preempt"
+
+
+class ChaosStepError(RuntimeError):
+    """An injected training-step failure (``StepFailure``)."""
+
+
+class ChaosSaveError(OSError):
+    """An injected checkpoint-save failure (``SaveFailure``) — an OSError
+    because that is what a full disk / revoked GCS token raises."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """Base class; ``kind`` is the stable name used in event logs."""
+
+    kind: ClassVar[str] = "fault"
+
+    def to_exception(self) -> Exception:
+        raise NotImplementedError(f"{self.kind} is interpreted by its call "
+                                  f"site, not raised")
+
+
+@dataclasses.dataclass(frozen=True)
+class HttpError(Fault):
+    """A server-side 5xx. Client sites raise the generic ``ApiError`` the
+    real client maps unrecognized statuses to; the apiserver site answers
+    with this code and a Status body."""
+
+    code: int = 503
+    kind: ClassVar[str] = "http_error"
+
+    def to_exception(self) -> Exception:
+        from tpu_on_k8s.client.cluster import ApiError
+        return ApiError(f"HTTP {self.code}: chaos injected server error")
+
+
+@dataclasses.dataclass(frozen=True)
+class Conflict(Fault):
+    """An optimistic-concurrency 409 — what a losing read-modify-write
+    write sees under contention."""
+
+    kind: ClassVar[str] = "conflict"
+
+    def to_exception(self) -> Exception:
+        from tpu_on_k8s.client.cluster import ConflictError
+        return ConflictError("chaos injected write conflict")
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeoutFault(Fault):
+    """A request that never completes within the socket timeout.
+    ``TimeoutError`` is an ``OSError``, so client sites exercise the real
+    stale-connection retry path."""
+
+    kind: ClassVar[str] = "timeout"
+
+    def to_exception(self) -> Exception:
+        return TimeoutError("chaos injected request timeout")
+
+
+@dataclasses.dataclass(frozen=True)
+class ConnectionResetFault(Fault):
+    """Peer reset mid-request (LB restart, apiserver roll)."""
+
+    kind: ClassVar[str] = "connection_reset"
+
+    def to_exception(self) -> Exception:
+        return ConnectionResetError("chaos injected connection reset")
+
+
+@dataclasses.dataclass(frozen=True)
+class WatchDrop(Fault):
+    """Close the watch stream: the client must reconnect from its last
+    observed revision (or re-list on 410) without going deaf."""
+
+    kind: ClassVar[str] = "watch_drop"
+
+    def to_exception(self) -> Exception:
+        return ConnectionResetError("chaos injected watch-stream drop")
+
+
+@dataclasses.dataclass(frozen=True)
+class PodFail(Fault):
+    """Kill one pod of the reconciled job the way a kubelet reports it:
+    phase Failed, the given container exit code and kill reason. With
+    ``reason="Evicted"`` this is a node-pressure eviction / single-host
+    TPU-VM preemption (retryable per `controller/failover.py`)."""
+
+    task_type: str = "worker"
+    index: int = 0
+    exit_code: int = 137
+    reason: str = "Killed"
+    kind: ClassVar[str] = "pod_fail"
+
+
+@dataclasses.dataclass(frozen=True)
+class SlicePreempt(Fault):
+    """Preempt a whole TPU slice: every worker pod whose task index falls
+    in slice ``slice_index`` (hosts-per-slice comes from the job's
+    tpu_policy) goes Failed/Evicted at once — how a real slice preemption
+    lands (the slice is one failure domain, SURVEY §5.3)."""
+
+    slice_index: int = 0
+    exit_code: int = 137
+    reason: str = "Evicted"
+    kind: ClassVar[str] = "slice_preempt"
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineCrash(Fault):
+    """The serving engine dies mid-decode (``EngineCrashError`` from
+    ``step()``): every slot's host/device request state is lost. The
+    gateway's replay machinery is the recovery under test."""
+
+    kind: ClassVar[str] = "engine_crash"
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineStall(Fault):
+    """The engine's device step wedges: ``step()`` makes no progress (no
+    admission, no tokens, no retirement) but does not raise — the shape of
+    a hung collective. Drain timeouts are the recovery under test."""
+
+    kind: ClassVar[str] = "engine_stall"
+
+
+@dataclasses.dataclass(frozen=True)
+class StepFailure(Fault):
+    """A training step raises (bad batch, NaN guard, device error)."""
+
+    kind: ClassVar[str] = "step_failure"
+
+    def to_exception(self) -> Exception:
+        return ChaosStepError("chaos injected training-step failure")
+
+
+@dataclasses.dataclass(frozen=True)
+class SaveFailure(Fault):
+    """A checkpoint save fails (full disk, revoked credentials). The loop
+    must survive it — training continues, resume falls back to the last
+    good checkpoint."""
+
+    kind: ClassVar[str] = "save_failure"
+
+    def to_exception(self) -> Exception:
+        return ChaosSaveError("chaos injected checkpoint-save failure")
+
+
+@dataclasses.dataclass(frozen=True)
+class PreemptNotice(Fault):
+    """A SIGTERM-style preemption notice: the train loop must save its
+    exact stopping point, drain, and stop cleanly."""
+
+    kind: ClassVar[str] = "preempt_notice"
+
+
+def describe(fault: Fault, note: Optional[str] = None) -> str:
+    """Stable one-line event-log form: the fault kind plus its non-default
+    fields, plus the rule's note. Deliberately excludes call-site context
+    (paths, invocation counts) — those vary with thread timing, and the
+    event log must be identical across two runs of the same seed."""
+    fields = []
+    for f in dataclasses.fields(fault):
+        v = getattr(fault, f.name)
+        if v != f.default:
+            fields.append(f"{f.name}={v}")
+    body = f"{fault.kind}" + (f"({', '.join(fields)})" if fields else "")
+    return f"{body} note={note}" if note else body

@@ -1,0 +1,233 @@
+"""FaultInjector: deterministic, seed-driven evaluation of a fault schedule.
+
+A schedule is a list of ``FaultRule(site, trigger, fault)``. Every
+instrumented call site calls ``chaos.fire(site, **ctx)``; with no injector
+installed that is one module-global read and a None return. With one
+installed, the injector counts the invocation against every rule whose
+site and ``match`` filter apply, and returns the first rule's fault whose
+trigger elects this invocation.
+
+Determinism contract (what makes a seeded run replayable):
+
+* ``at`` / ``every`` triggers depend only on the per-rule count of
+  *matching* invocations — same call sequence, same fires.
+* ``prob`` triggers draw from a ``random.Random`` seeded by
+  ``(seed, site, rule index)`` — never global randomness, so two injectors
+  built from the same schedule+seed fire identically, and an unrelated
+  rule added later does not shift another rule's draws.
+* The event log records only the rule's stable description
+  (`faults.describe`) — no wall-clock, no thread-dependent context — so
+  two runs of the same scenario produce byte-identical logs (the
+  acceptance check `tools/chaos_soak.py` enforces).
+
+Thread-safety: ``fire`` takes the injector lock (watch loops and frontend
+threads hit sites concurrently). Rules fire in schedule order; at most one
+fault is returned per invocation.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from tpu_on_k8s.chaos.faults import Fault, describe
+
+
+@dataclasses.dataclass(frozen=True)
+class Trigger:
+    """When a rule fires, in terms of its own matching-invocation count
+    (1-based). Exactly one of ``at`` / ``every`` / ``prob`` should be set:
+
+    * ``at``    — fire on these invocation indices (e.g. ``(1,)``: first).
+    * ``every`` — fire on every nth invocation.
+    * ``prob``  — fire with this probability per invocation (seeded rng).
+    * ``limit`` — cap total fires (``at`` implies ``len(at)``).
+    * ``match`` — ctx filter: every key must be present in the call's ctx
+      and equal after ``str()`` — except that a string value matches as a
+      substring of a string ctx value (so ``{"path": "/pods"}`` matches
+      any pod route).
+    """
+
+    at: Tuple[int, ...] = ()
+    every: Optional[int] = None
+    prob: Optional[float] = None
+    limit: Optional[int] = None
+    match: Mapping[str, object] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.every is not None and self.every < 1:
+            raise ValueError(f"every must be >= 1, got {self.every}")
+        if self.prob is not None and not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f"prob must be in [0, 1], got {self.prob}")
+        if not self.at and self.every is None and self.prob is None:
+            raise ValueError("trigger needs at=, every=, or prob=")
+
+    def max_fires(self) -> Optional[int]:
+        if self.limit is not None:
+            return self.limit
+        if self.at and self.every is None and self.prob is None:
+            return len(self.at)
+        return None
+
+
+def on_call(*indices: int) -> Trigger:
+    """Fire on exactly these 1-based matching invocations."""
+    return Trigger(at=tuple(indices))
+
+
+def every(n: int, limit: Optional[int] = None) -> Trigger:
+    return Trigger(every=n, limit=limit)
+
+
+def with_prob(p: float, limit: Optional[int] = None) -> Trigger:
+    return Trigger(prob=p, limit=limit)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    """One line of a fault schedule. ``note`` is a human label carried
+    into the event log (stable across runs — put stage names here, not
+    timestamps)."""
+
+    site: str
+    trigger: Trigger
+    fault: Fault
+    note: str = ""
+
+
+class _RuleState:
+    __slots__ = ("seen", "fired")
+
+    def __init__(self) -> None:
+        self.seen = 0
+        self.fired = 0
+
+
+def _ctx_matches(match: Mapping[str, object], ctx: Mapping[str, object]) -> bool:
+    for key, want in match.items():
+        if key not in ctx:
+            return False
+        have = ctx[key]
+        if isinstance(want, str) and isinstance(have, str):
+            if want not in have:
+                return False
+        elif str(want) != str(have):
+            return False
+    return True
+
+
+class FaultInjector:
+    """Evaluate a fault schedule; usable as a context manager that
+    installs itself process-globally (one at a time)."""
+
+    def __init__(self, rules: Sequence[FaultRule], *, seed: int = 0,
+                 name: str = "") -> None:
+        self.rules: List[FaultRule] = list(rules)
+        self.seed = seed
+        self.name = name
+        self.events: List[str] = []
+        self._lock = threading.Lock()
+        self._state: List[_RuleState] = [_RuleState() for _ in self.rules]
+        # one rng per rule, seeded by (seed, site, index): adding a rule
+        # never perturbs another rule's draws
+        self._rngs: Dict[int, random.Random] = {
+            i: random.Random(f"{seed}:{r.site}:{i}")
+            for i, r in enumerate(self.rules) if r.trigger.prob is not None}
+
+    # ---------------------------------------------------------------- firing
+    def fire(self, site: str, **ctx) -> Optional[Fault]:
+        """Count this invocation against every matching rule; return the
+        first rule's fault elected to fire (or None)."""
+        hit: Optional[FaultRule] = None
+        with self._lock:
+            for i, rule in enumerate(self.rules):
+                if rule.site != site:
+                    continue
+                if rule.trigger.match and not _ctx_matches(rule.trigger.match,
+                                                           ctx):
+                    continue
+                st = self._state[i]
+                st.seen += 1
+                if hit is not None:
+                    continue  # keep counting later rules; one fault per call
+                cap = rule.trigger.max_fires()
+                if cap is not None and st.fired >= cap:
+                    continue
+                if self._elects(rule.trigger, st.seen, self._rngs.get(i)):
+                    st.fired += 1
+                    hit = rule
+                    self.events.append(describe(rule.fault,
+                                                rule.note or None))
+        return hit.fault if hit is not None else None
+
+    @staticmethod
+    def _elects(trigger: Trigger, seen: int,
+                rng: Optional[random.Random]) -> bool:
+        if trigger.at and seen in trigger.at:
+            return True
+        if trigger.every is not None and seen % trigger.every == 0:
+            return True
+        if trigger.prob is not None and rng is not None:
+            return rng.random() < trigger.prob
+        return False
+
+    # ------------------------------------------------------------ inspection
+    def counts(self) -> Dict[str, Tuple[int, int]]:
+        """{``site#index``: (seen, fired)} — for assertions and debugging."""
+        with self._lock:
+            return {f"{r.site}#{i}": (s.seen, s.fired)
+                    for i, (r, s) in enumerate(zip(self.rules, self._state))}
+
+    def fired_total(self) -> int:
+        with self._lock:
+            return sum(s.fired for s in self._state)
+
+    # ----------------------------------------------------------- installation
+    def __enter__(self) -> "FaultInjector":
+        install(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        uninstall(self)
+
+
+# --------------------------------------------------------- the global seam
+_active: Optional[FaultInjector] = None
+_install_lock = threading.Lock()
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    """Make ``injector`` the process-global injector. Refuses to stack —
+    a forgotten uninstall in one test must fail loudly in the next, not
+    silently merge schedules."""
+    global _active
+    with _install_lock:
+        if _active is not None and _active is not injector:
+            raise RuntimeError(
+                f"a FaultInjector ({_active.name or 'unnamed'}) is already "
+                f"installed; uninstall it first")
+        _active = injector
+    return injector
+
+
+def uninstall(injector: Optional[FaultInjector] = None) -> None:
+    """Remove the global injector (a specific one, or whatever is
+    installed). Idempotent."""
+    global _active
+    with _install_lock:
+        if injector is None or _active is injector:
+            _active = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _active
+
+
+def fire(site: str, **ctx) -> Optional[Fault]:
+    """The production call-site entry point: free when nothing is
+    installed."""
+    inj = _active
+    if inj is None:
+        return None
+    return inj.fire(site, **ctx)

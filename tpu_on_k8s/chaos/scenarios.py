@@ -1,0 +1,124 @@
+"""Declarative chaos scenarios: named, seeded, replayable fault schedules.
+
+A scenario is data — ``(name, rules, seed)`` — so every recovery claim in
+`docs/resilience.md` maps to a schedule that can be re-run bit-for-bit.
+`tools/chaos_soak.py` composes these into the end-to-end soak (watch
+outage → slice preemption → engine crash mid-decode → train preemption)
+and asserts two runs of the same seed produce identical event logs.
+
+Builders return ``Scenario`` objects; ``scenario.injector()`` mints a
+fresh ``FaultInjector`` (rule counters zeroed) so a scenario can be run
+any number of times.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from tpu_on_k8s.chaos import faults
+from tpu_on_k8s.chaos.injector import FaultInjector, FaultRule, Trigger, on_call
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """A named, seeded fault schedule."""
+
+    name: str
+    rules: Tuple[FaultRule, ...]
+    seed: int = 0
+
+    def injector(self) -> FaultInjector:
+        return FaultInjector(self.rules, seed=self.seed, name=self.name)
+
+
+def watch_outage(kind: str = "Pod", *, reconnect_failures: int = 2,
+                 seed: int = 0) -> Scenario:
+    """Drop ``kind``'s live watch stream on the first frame delivered
+    after install, then fail the next ``reconnect_failures`` dials — an
+    API-server blip plus a slow comeback. Dial counting starts at
+    injector install (the stream is usually already established when
+    chaos arrives), so dial #1 is the reconnect the drop provokes.
+    Recovery under test: the informer resumes from its last revision with
+    decorrelated-jitter backoff and no controller goes deaf."""
+    rules = [FaultRule(faults.SITE_REST_WATCH_EVENT,
+                       Trigger(at=(1,), match={"kind": kind}),
+                       faults.WatchDrop(), note=f"drop {kind} stream")]
+    if reconnect_failures:
+        fail_at = tuple(range(1, 1 + reconnect_failures))
+        rules.append(FaultRule(faults.SITE_REST_WATCH_CONNECT,
+                               Trigger(at=fail_at, match={"kind": kind}),
+                               faults.ConnectionResetFault(),
+                               note=f"refuse {kind} reconnect"))
+    return Scenario("watch-outage", tuple(rules), seed)
+
+
+def apiserver_flaky(every_n: int = 7, *, limit: int = 4,
+                    seed: int = 0) -> Scenario:
+    """Every nth API request answers 503 — sustained flakiness the
+    clients' retries and the controllers' requeues must absorb."""
+    return Scenario("apiserver-flaky", (
+        FaultRule(faults.SITE_APISERVER_REQUEST,
+                  Trigger(every=every_n, limit=limit),
+                  faults.HttpError(503), note="flaky apiserver"),
+    ), seed)
+
+
+def slice_preemption(job: str, *, slice_index: int = 0,
+                     seed: int = 0) -> Scenario:
+    """Evict a whole slice of ``job`` (namespace/name) on the next
+    reconcile pass. Recovery under test: exit-code-classified failover
+    brings the slice's task group back to Running as one unit."""
+    return Scenario("slice-preemption", (
+        FaultRule(faults.SITE_RECONCILE,
+                  Trigger(at=(1,), match={"job": job}),
+                  faults.SlicePreempt(slice_index=slice_index),
+                  note=f"preempt slice {slice_index} of {job}"),
+    ), seed)
+
+
+def pod_kill(job: str, *, task_type: str = "worker", index: int = 0,
+             exit_code: int = 137, reason: str = "OOMKilled",
+             seed: int = 0) -> Scenario:
+    """Kill one pod of ``job`` with a classified exit code on the next
+    reconcile pass."""
+    return Scenario("pod-kill", (
+        FaultRule(faults.SITE_RECONCILE,
+                  Trigger(at=(1,), match={"job": job}),
+                  faults.PodFail(task_type=task_type, index=index,
+                                 exit_code=exit_code, reason=reason),
+                  note=f"kill {task_type}-{index} of {job}"),
+    ), seed)
+
+
+def engine_crash_mid_decode(at_steps: Tuple[int, ...] = (3,), *,
+                            seed: int = 0) -> Scenario:
+    """Crash the serving engine on these driver steps (counted per
+    ``engine.step()`` call). Recovery under test: the gateway re-admits
+    surviving in-flight requests through the fair queue with retry budget
+    + backoff; nothing is silently lost."""
+    return Scenario("engine-crash", (
+        FaultRule(faults.SITE_SERVE_STEP, on_call(*at_steps),
+                  faults.EngineCrash(), note="crash mid-decode"),
+    ), seed)
+
+
+def train_preemption(at_step: int, *, fail_save: bool = False,
+                     seed: int = 0) -> Scenario:
+    """Deliver a SIGTERM-style preemption notice before training step
+    ``at_step`` dispatches; with ``fail_save`` the preemption-time save
+    also fails, forcing resume to fall back to the last periodic
+    checkpoint. Recovery under test: generation-versioned resume
+    reproduces the no-fault loss trajectory bit-for-bit."""
+    rules = [FaultRule(faults.SITE_TRAIN_PREEMPT, on_call(at_step),
+                       faults.PreemptNotice(),
+                       note=f"preempt before step {at_step}")]
+    if fail_save:
+        # the preemption-time save carries the stopping step (at_step - 1)
+        # in its ctx — match it so periodic saves land and only the final
+        # one fails
+        rules.append(FaultRule(faults.SITE_TRAIN_SAVE,
+                               Trigger(every=1, limit=1,
+                                       match={"step": at_step - 1}),
+                               faults.SaveFailure(),
+                               note="fail the preemption save"))
+    return Scenario("train-preemption", tuple(rules), seed)

@@ -20,6 +20,7 @@ import tpu_on_k8s.api  # noqa: F401  — anchors the api→defaults→gang→cli
 from tpu_on_k8s.client.cluster import (
     ApiError,
     ConflictError,
+    ConflictRetriesExhausted,
     InMemoryCluster,
     NotFoundError,
     WatchEvent,

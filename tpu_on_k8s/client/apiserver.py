@@ -49,6 +49,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
+from tpu_on_k8s import chaos
 from tpu_on_k8s.client import resources
 from tpu_on_k8s.client.cluster import (
     AlreadyExistsError,
@@ -200,6 +201,34 @@ class _Handler(BaseHTTPRequestHandler):
                                           "bearer token missing or invalid"))
         return False
 
+    def _chaos_fault(self) -> bool:
+        """Server-side fault injection (``apiserver.request``): answer a
+        typed failure or kill the connection before the verb runs. Returns
+        True when a fault consumed the request."""
+        fault = chaos.fire(chaos.SITE_APISERVER_REQUEST,
+                           method=self.command, path=self.path)
+        if fault is None:
+            return False
+        from tpu_on_k8s.chaos import faults as _faults
+        if isinstance(fault, _faults.HttpError):
+            self._send_json(fault.code, _status_body(
+                fault.code, "InternalError", "chaos injected server error"))
+            return True
+        if isinstance(fault, _faults.Conflict):
+            self._send_json(409, _status_body(
+                409, "Conflict", "chaos injected write conflict"))
+            return True
+        # TimeoutFault / ConnectionResetFault / WatchDrop: the request never
+        # gets an answer — close the socket so the client sees a reset (the
+        # observable shape of both a timeout-then-close LB and a crashed
+        # apiserver replica)
+        self.close_connection = True
+        try:
+            self.connection.close()
+        except OSError:
+            pass
+        return True
+
     # ------------------------------------------------------------------ routing
     def _parse(self) -> Tuple[Optional[_Route], Dict[str, List[str]]]:
         parsed = urlparse(self.path)
@@ -256,6 +285,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:
         if not self._authorized():
             return
+        if self._chaos_fault():
+            return
         route, qs = self._parse()
         if route is None:
             self._send_json(404, _status_body(404, "NotFound", self.path))
@@ -302,6 +333,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:
         if not self._authorized():
             return
+        if self._chaos_fault():
+            return
         route, _ = self._parse()
         if route is None:
             self._send_json(404, _status_body(404, "NotFound", self.path))
@@ -328,6 +361,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_PUT(self) -> None:
         if not self._authorized():
             return
+        if self._chaos_fault():
+            return
         route, _ = self._parse()
         if route is None or route.name is None:
             self._send_json(404, _status_body(404, "NotFound", self.path))
@@ -344,6 +379,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_PATCH(self) -> None:
         if not self._authorized():
+            return
+        if self._chaos_fault():
             return
         route, _ = self._parse()
         if route is None or route.name is None:
@@ -367,6 +404,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_DELETE(self) -> None:
         if not self._authorized():
+            return
+        if self._chaos_fault():
             return
         route, _ = self._parse()
         if route is None or route.name is None:
@@ -453,6 +492,10 @@ class _Handler(BaseHTTPRequestHandler):
                 if event is _WatchHub._CLOSE:
                     break
                 deliver(event)
+                if chaos.fire(chaos.SITE_APISERVER_WATCH,
+                              kind=route.rt.kind) is not None:
+                    break  # injected server-side stream drop: the client
+                           # must resume from its last delivered revision
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away
         finally:

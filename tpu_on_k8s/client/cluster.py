@@ -44,6 +44,37 @@ class ConflictError(ApiError):
     """resourceVersion mismatch — caller must re-read and retry."""
 
 
+class ConflictRetriesExhausted(ConflictError):
+    """A bounded read-modify-write loop saw nothing but 409s for its whole
+    attempt budget — sustained contention (or an injected chaos schedule),
+    not the ordinary losing-one-race case. Subclasses ``ConflictError`` so
+    callers that treat any conflict as retryable-later keep working; callers
+    that want to alert on livelock can catch this specifically."""
+
+
+def run_conflict_retries(attempts: int, attempt: Callable[[], Any],
+                         describe: str, metrics: Any = None) -> Any:
+    """THE bounded conflict-retry loop — shared by every read-modify-write
+    path (in-memory and REST ``update_with_retry``, REST finalizer
+    ``patch_meta``) so the retry contract lives in one place. ``attempt``
+    performs one full read-mutate-write; each retried ``ConflictError``
+    feeds the ``conflict_retries`` counter on ``metrics`` (when wired);
+    exhaustion raises the typed ``ConflictRetriesExhausted``."""
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    last: Optional[Exception] = None
+    for _ in range(attempts):
+        try:
+            return attempt()
+        except ConflictError as e:
+            last = e
+            if metrics is not None:
+                metrics.inc("conflict_retries")
+    raise ConflictRetriesExhausted(
+        f"{describe} still conflicted after {attempts} attempts: "
+        f"{last}") from last
+
+
 class ExpiredError(ApiError):
     """Requested watch resourceVersion fell off the history window (the
     apiserver's 410 Gone) — the client must re-list and re-watch."""
@@ -455,13 +486,14 @@ class InMemoryCluster:
                           mutate: Callable[[Any], None], *, subresource: str = "",
                           attempts: int = 5) -> Any:
         """Read-mutate-write with conflict retry — the centralized analog of the
-        reference's scattered RetryOnConflict blocks (SURVEY §7 hard parts)."""
-        last: Optional[Exception] = None
-        for _ in range(attempts):
+        reference's scattered RetryOnConflict blocks (SURVEY §7 hard parts).
+        Bounded: sustained 409s past ``attempts`` raise the typed
+        ``ConflictRetriesExhausted`` (same contract as ``RestCluster``)."""
+        def attempt() -> Any:
             obj = self.get(cls, namespace, name)
             mutate(obj)
-            try:
-                return self.update(obj, subresource=subresource)
-            except ConflictError as e:
-                last = e
-        raise last  # type: ignore[misc]
+            return self.update(obj, subresource=subresource)
+
+        return run_conflict_retries(attempts, attempt,
+                                    f"update of {namespace}/{name}",
+                                    getattr(self, "metrics", None))

@@ -29,6 +29,7 @@ same failure modes the controllers face in-memory.
 from __future__ import annotations
 
 import json
+import random
 import ssl
 import threading
 import time
@@ -37,6 +38,7 @@ from http.client import HTTPConnection, HTTPSConnection
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 from urllib.parse import quote, urlparse
 
+from tpu_on_k8s import chaos
 from tpu_on_k8s.api.core import Event, ObjectReference, utcnow
 from tpu_on_k8s.client import resources
 from tpu_on_k8s.client.cluster import (
@@ -46,6 +48,7 @@ from tpu_on_k8s.client.cluster import (
     ExpiredError,
     NotFoundError,
     WatchEvent,
+    run_conflict_retries,
 )
 from tpu_on_k8s.utils import serde
 from tpu_on_k8s.utils.logging import get_logger
@@ -110,6 +113,13 @@ class RestCluster:
                 self._ssl_ctx.load_cert_chain(client_cert_path,
                                               client_key_path)
         self._local = threading.local()
+        #: optional JobMetrics sink (conflict-retry counter); the operator
+        #: wires its own instance in, library callers may leave None
+        self.metrics = None
+        # Decorrelated-jitter state for watch reconnects. Entropy-seeded by
+        # default (each process jitters differently — that is the point);
+        # tests needing determinism reseed ``_backoff_rng`` directly.
+        self._backoff_rng = random.Random()
         self._watch_lock = threading.Lock()
         self._watch_callbacks: List[Callable[[WatchEvent], None]] = []
         self._watch_threads: List[threading.Thread] = []
@@ -157,6 +167,19 @@ class RestCluster:
         payload = json.dumps(body).encode() if body is not None else None
         headers = self._headers(content_type if payload is not None else None)
         for attempt in (0, 1):  # one retry on a stale keep-alive connection
+            fault = chaos.fire(chaos.SITE_REST_REQUEST, method=method,
+                               path=path, attempt=attempt)
+            if fault is not None:
+                exc = fault.to_exception()
+                if isinstance(exc, OSError) and not isinstance(exc, ApiError):
+                    # connection-level fault: takes the real stale-connection
+                    # path (drop the conn, retry once) — a single injected
+                    # reset is absorbed exactly like a real keep-alive reset
+                    self._local.conn = None
+                    if attempt:
+                        raise exc
+                    continue
+                raise exc  # HTTP-level fault (5xx/409): surfaces typed
             conn = self._conn()
             try:
                 conn.request(method, path, body=payload, headers=headers)
@@ -249,8 +272,7 @@ class RestCluster:
                 {"metadata": meta},
                 content_type="application/merge-patch+json")
             return serde.from_dict(rt.cls, data)
-        last: Optional[Exception] = None
-        for _ in range(5):
+        def attempt() -> Any:
             cur = self.get(cls, namespace, name)
             fins = [f for f in cur.metadata.finalizers if f not in remove_f]
             fins += [f for f in add_f if f not in fins]
@@ -258,15 +280,15 @@ class RestCluster:
             patch_meta["finalizers"] = fins
             # opaque string on the wire, like every k8s resourceVersion
             patch_meta["resourceVersion"] = str(cur.metadata.resource_version)
-            try:
-                data = self._request(
-                    "PATCH", rt.item_path(namespace, quote(name)),
-                    {"metadata": patch_meta},
-                    content_type="application/merge-patch+json")
-                return serde.from_dict(rt.cls, data)
-            except ConflictError as e:
-                last = e
-        raise last  # type: ignore[misc]
+            data = self._request(
+                "PATCH", rt.item_path(namespace, quote(name)),
+                {"metadata": patch_meta},
+                content_type="application/merge-patch+json")
+            return serde.from_dict(rt.cls, data)
+
+        return run_conflict_retries(5, attempt,
+                                    f"metadata patch of {namespace}/{name}",
+                                    self.metrics)
 
     def delete(self, cls: type, namespace: str, name: str) -> None:
         rt = resources.by_class(cls)
@@ -275,15 +297,20 @@ class RestCluster:
     def update_with_retry(self, cls: type, namespace: str, name: str,
                           mutate: Callable[[Any], None], *,
                           subresource: str = "", attempts: int = 5) -> Any:
-        last: Optional[Exception] = None
-        for _ in range(attempts):
+        """Read-mutate-write, BOUNDED: past ``attempts`` sustained 409s it
+        raises the typed ``ConflictRetriesExhausted`` (a ``ConflictError``
+        subclass, so existing handlers keep working) instead of spinning —
+        under a chaos schedule injecting permanent conflicts an unbounded
+        loop is a livelock. Every retried conflict feeds the
+        ``conflict_retries`` counter when ``self.metrics`` is wired."""
+        def attempt() -> Any:
             obj = self.get(cls, namespace, name)
             mutate(obj)
-            try:
-                return self.update(obj, subresource=subresource)
-            except ConflictError as e:
-                last = e
-        raise last  # type: ignore[misc]
+            return self.update(obj, subresource=subresource)
+
+        return run_conflict_retries(attempts, attempt,
+                                    f"update of {namespace}/{name}",
+                                    self.metrics)
 
     # ----------------------------------------------------------- events & logs
     def record_event(self, obj: Any, etype: str, reason: str,
@@ -411,11 +438,23 @@ class RestCluster:
             self._dispatch(WatchEvent("ADDED", rt.kind, obj))
         return rv
 
+    def _next_backoff(self, prev: float) -> float:
+        """Decorrelated-jitter reconnect backoff (AWS architecture blog's
+        "decorrelated jitter"): ``uniform(initial, 3*prev)`` capped at the
+        max. Plain exponential backoff resynchronizes every watcher that an
+        API-server blip disconnected at the same instant — they all retry
+        in lockstep at t+0.2, t+0.6, ... and the thundering herd re-kills
+        the server; jitter spreads the herd across the whole window."""
+        return min(self.WATCH_BACKOFF_MAX,
+                   self._backoff_rng.uniform(self.WATCH_BACKOFF_INITIAL,
+                                             prev * 3.0))
+
     def _watch_loop(self, rt: resources.ResourceType,
                     ready: threading.Event) -> None:
         """List-then-watch with resume and recovery (informer semantics):
-        dropped stream → reconnect from the last seen revision with backoff;
-        410 Expired → full re-list. Never goes silently deaf."""
+        dropped stream → reconnect from the last seen revision with
+        decorrelated-jitter backoff; 410 Expired → full re-list. Never goes
+        silently deaf."""
         rv: Optional[int] = None
         backoff = self.WATCH_BACKOFF_INITIAL
         while not self._watch_stop.is_set():
@@ -424,6 +463,10 @@ class RestCluster:
                 if rv is None:
                     rv = self._sync(rt)
                     ready.set()
+                fault = chaos.fire(chaos.SITE_REST_WATCH_CONNECT,
+                                   kind=rt.kind)
+                if fault is not None:
+                    raise fault.to_exception()
                 conn = self._new_conn(None)  # no timeout: long-lived stream
                 path = (rt.all_namespaces_path()
                         + f"?watch=true&resourceVersion={rv}"
@@ -462,11 +505,14 @@ class RestCluster:
                     rv = obj.metadata.resource_version
                     self._dispatch(WatchEvent(mtype, rt.kind, obj))
                     backoff = self.WATCH_BACKOFF_INITIAL
+                    if chaos.fire(chaos.SITE_REST_WATCH_EVENT,
+                                  kind=rt.kind) is not None:
+                        break  # injected mid-stream drop → reconnect from rv
                 # Clean close: back off too — a server that closes streams on
                 # arrival (overflow, shutdown races) must not induce a hot
                 # list/watch spin; delivered events above reset the backoff.
                 self._watch_stop.wait(backoff)
-                backoff = min(backoff * 2, self.WATCH_BACKOFF_MAX)
+                backoff = self._next_backoff(backoff)
             except (ConnectionError, OSError, ApiError,
                     json.JSONDecodeError) as exc:
                 if self._watch_stop.is_set():
@@ -477,7 +523,7 @@ class RestCluster:
                                   "error": repr(exc),
                                   "backoff_s": round(backoff, 2)}})
                 self._watch_stop.wait(backoff)
-                backoff = min(backoff * 2, self.WATCH_BACKOFF_MAX)
+                backoff = self._next_backoff(backoff)
             finally:
                 if conn is not None:
                     conn.close()

@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
+from tpu_on_k8s import chaos
 from tpu_on_k8s.api import constants
 from tpu_on_k8s.api.core import (
     EnvVar,
@@ -195,6 +196,12 @@ class JobEngine:
             return Result()
 
         key = self.job_key(job)
+        fault = chaos.fire(chaos.SITE_RECONCILE, job=key)
+        if fault is not None:
+            # injected BEFORE expectations/pod listing so the very pass that
+            # carries the fault also observes and classifies it — the same
+            # ordering a kubelet status write racing a reconcile produces
+            self._apply_chaos_fault(job, fault)
         if not self._expectations_satisfied(job):
             return Result(requeue_after=self.config.sync_period_seconds)
 
@@ -549,6 +556,58 @@ class JobEngine:
                 job, "Normal", "SliceFailover",
                 f"slice {slice_id}: restarting {initiated} surviving host(s) "
                 f"after {failed.metadata.name} failed")
+
+    def _apply_chaos_fault(self, job: TPUJob, fault) -> None:
+        """Materialize an injected ``PodFail`` / ``SlicePreempt`` as the pod
+        status a kubelet would report (phase Failed, terminated exit code,
+        kill reason), so the ordinary failover classification path — not a
+        test backdoor — performs the recovery. Unknown fault types are
+        ignored: a schedule aimed at another layer must not break reconciles."""
+        from tpu_on_k8s.chaos import faults as chaos_faults
+        from tpu_on_k8s.client.testing import KubeletSim  # the kubelet seam
+
+        sim = KubeletSim(self.cluster)
+        if isinstance(fault, chaos_faults.PodFail):
+            try:
+                tt = TaskType.normalize(fault.task_type)
+            except ValueError:
+                return
+            name = conditions.gen_general_name(job.metadata.name, tt,
+                                               fault.index)
+            try:
+                sim.terminate_pod(job.metadata.namespace, name,
+                                  fault.exit_code, reason=fault.reason,
+                                  phase=PodPhase.FAILED)
+            except NotFoundError:
+                pass
+            return
+        if isinstance(fault, chaos_faults.SlicePreempt):
+            from tpu_on_k8s.gang import topology as tpu_topology
+
+            tpu = job.spec.tpu_policy
+            try:
+                hosts_per = tpu_topology.hosts_per_slice(tpu.accelerator,
+                                                         tpu.topology)
+            except (KeyError, ValueError):
+                hosts_per = 1
+            selector = {constants.LABEL_JOB_NAME: job.metadata.name,
+                        constants.LABEL_TASK_TYPE:
+                            TaskType.WORKER.value.lower()}
+            for pod in self.cluster.list(Pod, job.metadata.namespace,
+                                         selector):
+                idx = self.pod_index(pod)
+                if idx < 0 or idx // hosts_per != fault.slice_index:
+                    continue
+                if pod.status.phase not in (PodPhase.PENDING,
+                                            PodPhase.RUNNING):
+                    continue
+                try:
+                    sim.terminate_pod(pod.metadata.namespace,
+                                      pod.metadata.name, fault.exit_code,
+                                      reason=fault.reason,
+                                      phase=PodPhase.FAILED)
+                except NotFoundError:
+                    pass
 
     def _collect_slice_restarts(self, job: TPUJob) -> None:
         """Settle the job's in-flight CRRs: both fire-and-forget slice-

@@ -229,6 +229,9 @@ class Operator:
         self.cluster = cluster if cluster is not None else build_cluster(args)
         self.manager = Manager()
         self.metrics = JobMetrics()
+        # both backends count conflict retries against the operator's own
+        # metrics (client/rest.py + client/cluster.py update_with_retry)
+        self.cluster.metrics = self.metrics
         self.gates = (features.FeatureGates.parse(args.feature_gates)
                       if args.feature_gates else features.FeatureGates())
         self.config = JobControllerConfig(

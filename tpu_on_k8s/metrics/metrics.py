@@ -83,6 +83,14 @@ class JobMetrics(_MetricsBase):
             self._prom_counters["errors"] = _prom.Counter(
                 f"{ns}_controller_errors_total",
                 "Exceptions caught in controller run loops", registry=registry)
+            # optimistic-concurrency health: every retried 409 in a
+            # read-modify-write loop (client update_with_retry/patch_meta).
+            # A climbing rate means writers are fighting — the precursor of
+            # ConflictRetriesExhausted livelocks.
+            self._prom_counters["conflict_retries"] = _prom.Counter(
+                f"{ns}_conflict_retries_total",
+                "Conflict (409) retries across client write loops",
+                registry=registry)
             for name in ("first_pod_launch_delay_seconds", "all_pods_launch_delay_seconds"):
                 self._prom_hists[name] = _prom.Histogram(
                     f"{ns}_jobs_{name}", f"Job {name}", buckets=_BUCKETS,
@@ -157,7 +165,14 @@ class ServingMetrics(_MetricsBase):
                          # from tpu_on_k8s/serve/admission.py)
                          "rejected_queue_full", "rejected_load_shed",
                          "rejected_quota", "rejected_deadline",
-                         "rejected_draining"):
+                         "rejected_draining",
+                         # crash recovery (tpu_on_k8s/serve/gateway.py):
+                         # engine deaths, in-flight requests re-admitted
+                         # through the fair queue, and requests whose
+                         # replay budget ran out — together these prove
+                         # no request is ever silently lost to a crash
+                         "engine_crashes", "requests_replayed",
+                         "retry_exhausted"):
                 self._prom_counters[name] = _prom.Counter(
                     f"{ns}_{name}", f"Serving {name}", registry=registry)
             for name in ("time_to_first_token_seconds",
@@ -189,7 +204,7 @@ class TrainMetrics(_MetricsBase):
             self.registry = registry
             ns = "tpu_on_k8s_train"
             for name in ("host_syncs", "checkpoints_enqueued",
-                         "stalled_steps"):
+                         "checkpoint_failures", "stalled_steps"):
                 self._prom_counters[name] = _prom.Counter(
                     f"{ns}_{name}", f"Training loop {name}",
                     registry=registry)

@@ -54,6 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+from tpu_on_k8s import chaos
 from tpu_on_k8s.models.decode import (
     _bucket_len,
     cache_shapes,
@@ -77,6 +78,16 @@ class EngineOverloadedError(RuntimeError):
                          f">= queue_cap {cap}")
         self.inflight = inflight
         self.cap = cap
+
+
+class EngineCrashError(RuntimeError):
+    """The engine died mid-step: every slot's host/device request state is
+    unrecoverable (the in-process shape of a decode-worker process crash).
+    ``reset()`` brings the engine itself back — compiled programs and the
+    cache pool survive — but in-flight requests are lost; the gateway
+    (`tpu_on_k8s/serve/gateway.py`) owns re-admitting them (request
+    replay). Raised by chaos injection; an external supervisor translating
+    a real worker death should raise it too so recovery stays typed."""
 
 
 @dataclasses.dataclass
@@ -284,7 +295,7 @@ class ContinuousBatchingEngine:
         self._admitting: set = set()   # slots mid-admission (popped from
                                        # the queue, prefill in flight) —
                                        # free_slots must not count them
-        self.stats = {"steps": 0, "emitted": 0, "admitted": 0}
+        self.stats = {"steps": 0, "emitted": 0, "admitted": 0, "crashes": 0}
         #: hard bound on requests in flight (queued + prefilling + slots);
         #: ``submit`` past it raises ``EngineOverloadedError``. None keeps
         #: the historical unbounded queue (library use; the gateway bounds
@@ -690,6 +701,32 @@ class ContinuousBatchingEngine:
                     return np.asarray(s.emitted, np.int32)
         return None
 
+    def reset(self) -> List[int]:
+        """Recover the engine after a crash (``EngineCrashError``): drop all
+        host-side request state — slots, queue, chunked prefill, admission
+        reservations — as a restarted decode worker would come up empty.
+        The compiled programs, parameters, registered prefixes, and the
+        device cache pool survive (stale cache rows are never attended and
+        are overwritten on the next admission — the same invariant slot
+        retirement relies on). Already-finished results stay claimable.
+        In-flight requests are LOST here by design; the returned ids are
+        everything dropped, so the caller (the gateway's replay machinery)
+        can re-admit its own and account for any it does not own."""
+        with self._lock:
+            lost = [p.request_id for p in self._queue]
+            if self._prefilling is not None:
+                lost.append(self._prefilling.req.request_id)
+            lost += [s.request_id for s in self._slots if s is not None]
+            self._slots = [None] * self.n_slots
+            self._queue.clear()
+            self._prefilling = None
+            self._reserved_slot = None
+            self._admitting.clear()
+        if self.metrics is not None:
+            self.metrics.set_gauge("queue_depth", 0)
+            self.metrics.set_gauge("slots_active", 0)
+        return sorted(lost)
+
     # ---- the engine loop ---------------------------------------------------
     def step(self) -> List[int]:
         """Admit queued requests, advance every active slot by one horizon
@@ -699,6 +736,16 @@ class ContinuousBatchingEngine:
         ONE consumer per request (the driver loop or a polling frontend
         thread, not both) and treat ``result() is None`` as
         already-claimed."""
+        fault = chaos.fire(chaos.SITE_SERVE_STEP, steps=self.stats["steps"])
+        if fault is not None:
+            if isinstance(fault, chaos.EngineCrash):
+                self.stats["crashes"] += 1
+                raise EngineCrashError("chaos: engine crashed mid-decode")
+            if isinstance(fault, chaos.EngineStall):
+                # a wedged device step: no admission, no tokens, no
+                # retirement — the caller's own timeout machinery (gateway
+                # drain deadline) is the only way out
+                return []
         # snapshot BEFORE admission: a request that retires during
         # admission itself (max_new_tokens=1, instant eos) must still be
         # reported by THIS step, or a step()/result() driver never learns

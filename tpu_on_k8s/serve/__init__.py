@@ -17,7 +17,7 @@ from tpu_on_k8s.serve.admission import (
     AdmissionController,
     Rejected,
 )
-from tpu_on_k8s.serve.gateway import ServingGateway
+from tpu_on_k8s.serve.gateway import ReplayPolicy, ServingGateway
 from tpu_on_k8s.serve.lifecycle import (
     GatewayRequest,
     RequestResult,
@@ -31,6 +31,7 @@ __all__ = [
     "FairScheduler",
     "GatewayRequest",
     "Rejected",
+    "ReplayPolicy",
     "RequestResult",
     "RequestState",
     "ServingGateway",

@@ -19,6 +19,15 @@ slot pool); the gateway owns everything a *service* needs around it:
 * **Observability**: queue-depth / reject / cancel / deadline counters and
   TTFT / TPOT / queue-wait histograms through ``ServingMetrics``, plus
   streaming via the engine's existing ``on_token`` hook.
+* **Crash recovery / request replay** (``ReplayPolicy``): when the engine
+  dies mid-decode (``EngineCrashError`` out of ``engine.step()``) the
+  gateway resets the engine and re-admits every surviving in-flight
+  request through the fair queue — per-request retry budget, exponential
+  backoff before re-dispatch — finalizing budget-exhausted requests as
+  ``RETRY_EXHAUSTED``. A crash therefore never silently loses work:
+  every accepted request still reaches exactly one terminal state
+  (done / replayed-then-done / retry_exhausted / cancelled / deadline).
+  Queued requests never touched the engine and simply keep their place.
 
 Threading model mirrors the engine's: ONE driver thread calls ``step()`` /
 ``run()`` / ``drain()``; any number of frontend threads call ``submit()``,
@@ -34,6 +43,7 @@ own submit, which under the gateway is dispatch time.
 """
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Union
@@ -55,13 +65,41 @@ from tpu_on_k8s.serve.lifecycle import (
 from tpu_on_k8s.serve.scheduler import FairScheduler
 
 
+@dataclasses.dataclass(frozen=True)
+class ReplayPolicy:
+    """How in-flight requests survive an engine crash. ``max_replays`` is
+    PER REQUEST across the gateway's lifetime (a request that keeps landing
+    on a crashing engine eventually stops consuming capacity);
+    ``backoff_base_s`` doubles per replay of that request up to
+    ``backoff_cap_s`` — a crashed-and-reset engine usually needs a beat
+    before it is trustworthy, and an immediate full-pressure re-dispatch
+    of every survivor is exactly the load spike that re-kills it."""
+
+    max_replays: int = 2
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+
+    def __post_init__(self) -> None:
+        if self.max_replays < 0:
+            raise ValueError(f"max_replays must be >= 0, got "
+                             f"{self.max_replays}")
+        if self.backoff_base_s < 0 or self.backoff_cap_s < 0:
+            raise ValueError("replay backoff must be >= 0")
+
+    def backoff_for(self, replays: int) -> float:
+        """Backoff before the ``replays``-th re-admission (1-based)."""
+        return min(self.backoff_cap_s,
+                   self.backoff_base_s * (2 ** max(replays - 1, 0)))
+
+
 class ServingGateway:
     """Admission + fairness + lifecycle over one engine. See module doc."""
 
     def __init__(self, engine, admission: Optional[AdmissionConfig] = None,
                  tenant_weights: Optional[Dict[str, float]] = None,
                  metrics=None,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 replay: Optional[ReplayPolicy] = None) -> None:
         if getattr(engine, "_on_retire", None) is not None:
             raise ValueError("engine already has an on_retire consumer — "
                              "one gateway per engine")
@@ -78,6 +116,10 @@ class ServingGateway:
                                  # holds (or will hold) exactly one slot
         self._accepting = True
         self._newly_terminal: List[int] = []
+        self._replay = replay or ReplayPolicy()
+        # crash survivors waiting out their backoff before re-entering the
+        # fair queue, in original-admission (rid) order
+        self._replay_pending: List[GatewayRequest] = []
         engine._on_retire = self._on_engine_retire
 
     # ---- frontend API ------------------------------------------------------
@@ -136,7 +178,13 @@ class ServingGateway:
             if req is None or req.state not in LIVE_STATES:
                 return False
             if req.state is RequestState.QUEUED:
-                self._sched.remove(req)
+                # a QUEUED request lives either in the fair queue or — as a
+                # crash survivor waiting out its backoff — in the replay list
+                if not self._sched.remove(req):
+                    try:
+                        self._replay_pending.remove(req)
+                    except ValueError:
+                        pass
                 self._finalize_locked(req, RequestState.CANCELLED)
             else:
                 req.cancel_requested = True
@@ -187,6 +235,8 @@ class ServingGateway:
             self.metrics.inc("requests_cancelled")
         elif state is RequestState.DEADLINE_EXCEEDED:
             self.metrics.inc("deadline_exceeded")
+        elif state is RequestState.RETRY_EXHAUSTED:
+            self.metrics.inc("retry_exhausted")
 
     def _on_engine_retire(self, engine_rid: int, tokens) -> None:
         """Engine hook: a dispatched request finished (fires during
@@ -214,9 +264,17 @@ class ServingGateway:
                         req.state = RequestState.DECODING
                 req.last_token_at = now
                 req.n_tokens += 1
+                # TTFT is observed once per REQUEST: a replay attempt's
+                # "first" token is a re-emission, not the client's first —
+                # unless the crash beat the original first token, in which
+                # case the replay's really is it (the flag, not the replay
+                # count, captures that distinction)
+                observe_ttft = first and not req.ttft_observed
+                if observe_ttft:
+                    req.ttft_observed = True
             if self.metrics is not None:
                 self.metrics.inc("tokens_emitted")
-                if first:
+                if observe_ttft:
                     self.metrics.observe("time_to_first_token_seconds",
                                          now - req.submitted_at)
             if req.on_token is not None:
@@ -234,10 +292,33 @@ class ServingGateway:
                         stacklevel=2)
         return hook
 
+    def _release_replays_locked(self, now: float) -> None:
+        """Crash survivors whose backoff has elapsed re-enter the fair
+        queue at the HEAD of their tenant's FIFO (they are that tenant's
+        oldest work — tail insertion would let later arrivals leapfrog a
+        request the crash already delayed once). Lock held."""
+        if not self._replay_pending:
+            return
+        ready = [r for r in self._replay_pending
+                 if r.state is RequestState.QUEUED and now >= r.not_before]
+        if not ready:
+            return
+        for req in reversed(ready):   # reversed: push_front keeps rid order
+            self._sched.push_front(req)
+        self._replay_pending = [r for r in self._replay_pending
+                                if r not in ready]
+
     def _reap_locked(self, now: float) -> None:
-        """Expire/cancel queued and in-engine requests. Lock held. Engine
-        aborts are safe here: the driver thread is the only caller and the
-        device step has not been launched yet this iteration."""
+        """Expire/cancel queued, replay-pending, and in-engine requests.
+        Lock held. Engine aborts are safe here: the driver thread is the
+        only caller and the device step has not been launched yet this
+        iteration."""
+        for req in list(self._replay_pending):
+            if req.cancel_requested or req.expired(now):
+                self._replay_pending.remove(req)
+                self._finalize_locked(
+                    req, RequestState.CANCELLED if req.cancel_requested
+                    else RequestState.DEADLINE_EXCEEDED)
         for req in list(self._sched.queued()):
             if req.cancel_requested or req.expired(now):
                 self._sched.remove(req)
@@ -281,23 +362,79 @@ class ServingGateway:
             req.dispatched_at = now
             self._by_engine[req.engine_rid] = req.rid
             self._in_engine += 1
-            if self.metrics is not None:
+            if self.metrics is not None and not req.queue_wait_observed:
+                # once per request: a replay's second trip through the
+                # queue must not add a second sample for the same rid
+                req.queue_wait_observed = True
                 self.metrics.observe("queue_wait_seconds",
                                      now - req.submitted_at)
 
-    # ---- the driver loop ---------------------------------------------------
-    def step(self) -> List[int]:
-        """One gateway iteration: reap cancels/deadlines (freeing their
-        slots), dispatch from the fair queue into the freed capacity, then
-        advance the engine one step. Returns ids that reached a terminal
-        state — notifications, like ``engine.step``; the payload goes to
-        whoever calls ``result(rid)``."""
+    def _recover_engine_crash(self) -> None:
+        """The engine died mid-decode: reset it (compiled programs and the
+        cache pool survive; host request state does not) and route every
+        in-flight request through the replay state machine — back to the
+        fair queue with backoff while its retry budget lasts, terminal
+        ``RETRY_EXHAUSTED`` after. Queued requests never reached the
+        engine and are untouched."""
+        dropped = self.engine.reset()
+        with self._lock:
+            orphans = [rid for rid in dropped if rid not in self._by_engine]
+        if orphans:
+            # the gateway can only replay what it owns: direct-to-engine
+            # traffic (discouraged on a gateway-owned engine, but possible
+            # under a shared queue_cap) dies with the crash — say so loudly
+            # instead of letting its consumers poll result() forever
+            import warnings
+            warnings.warn(
+                f"engine crash dropped {len(orphans)} non-gateway "
+                f"request(s) {orphans}; direct engine.submit traffic "
+                f"cannot be replayed", stacklevel=2)
         with self._lock:
             now = self._clock()
+            victims = sorted((self._requests[rid]
+                              for rid in self._by_engine.values()),
+                             key=lambda r: r.rid)
+            self._by_engine.clear()
+            self._in_engine = 0
+            replayed = 0
+            for req in victims:
+                if req.state not in LIVE_STATES:
+                    continue
+                if req.replays >= self._replay.max_replays:
+                    # the crash ate this attempt's partial tokens with the
+                    # engine; an empty terminal result that SAYS so beats a
+                    # silent loss
+                    self._finalize_locked(req, RequestState.RETRY_EXHAUSTED)
+                    continue
+                req.reset_for_replay(
+                    now, self._replay.backoff_for(req.replays + 1))
+                self._replay_pending.append(req)
+                replayed += 1
+        if self.metrics is not None:
+            self.metrics.inc("engine_crashes")
+            if replayed:
+                self.metrics.inc("requests_replayed", replayed)
+
+    # ---- the driver loop ---------------------------------------------------
+    def step(self) -> List[int]:
+        """One gateway iteration: release crash survivors whose backoff
+        elapsed, reap cancels/deadlines (freeing their slots), dispatch
+        from the fair queue into the freed capacity, then advance the
+        engine one step — recovering via request replay if the engine
+        crashes under it. Returns ids that reached a terminal state —
+        notifications, like ``engine.step``; the payload goes to whoever
+        calls ``result(rid)``."""
+        from tpu_on_k8s.models.serving import EngineCrashError
+        with self._lock:
+            now = self._clock()
+            self._release_replays_locked(now)
             self._reap_locked(now)
             self._dispatch_locked(now)
         if self._in_engine:
-            self.engine.step()
+            try:
+                self.engine.step()
+            except EngineCrashError:
+                self._recover_engine_crash()
         with self._lock:
             out, self._newly_terminal = self._newly_terminal, []
             depth = len(self._sched)
@@ -308,12 +445,31 @@ class ServingGateway:
                 self.engine.n_slots - self.engine.free_slots)
         return out
 
+    def _idle_wait(self) -> None:
+        """Between steps of ``run``/``drain``: if the ONLY live work is
+        crash survivors waiting out replay backoff, sleep toward the
+        earliest ``not_before`` instead of hot-spinning the lock. Capped
+        small so an injected test clock (which wall sleep cannot advance)
+        costs bounded real time per loop turn."""
+        with self._lock:
+            if (self._in_engine or len(self._sched)
+                    or not self._replay_pending):
+                return
+            gates = [r.not_before for r in self._replay_pending
+                     if r.state is RequestState.QUEUED]
+            if not gates:
+                return
+            delay = min(gates) - self._clock()
+        if delay > 0:
+            time.sleep(min(delay, 0.05))
+
     def run(self) -> Dict[int, RequestResult]:
         """Step until every accepted request is terminal; claim and return
         all unclaimed results (convenience for batch-style callers and
         tests — a live server just loops ``step()``)."""
         while self._live():
             self.step()
+            self._idle_wait()
         return self._claim_all()
 
     def stop_accepting(self) -> None:
@@ -339,6 +495,9 @@ class ServingGateway:
                             req.cancel_requested = True
                 deadline = None      # one sweep marks everything live
             self.step()
+            # harmless after the cancel sweep: the next step's reap empties
+            # the replay list, so the gate list is empty and this no-ops
+            self._idle_wait()
         return self._claim_all()
 
     def _live(self) -> bool:

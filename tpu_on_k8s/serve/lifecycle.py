@@ -4,11 +4,21 @@ The engine (`tpu_on_k8s/models/serving.py`) knows three things about a
 request: queued, in a slot, finished. A service needs the full lifecycle —
 
     queued ──► admitted ──► decoding ──► done
-      │            │            │
+      ▲            │            │
       │            └────┬───────┴──► cancelled
-      ├─► rejected      └──────────► deadline_exceeded
+      │(replay)         └──────────► deadline_exceeded
+      ├───────◄── engine crash (retry budget left)
+      │            └──────────────► retry_exhausted (budget spent)
+      ├─► rejected
       ├─► cancelled
       └─► deadline_exceeded
+
+An engine crash (``EngineCrashError``) sends surviving in-flight requests
+BACK to ``queued`` — the replay edge — with their decode bookkeeping
+(first-token time, token count, partial tokens) reset; a request whose
+per-request retry budget is already spent terminates as
+``retry_exhausted`` instead, so a crashed engine can never silently lose
+work (`docs/resilience.md` has the full replay state machine).
 
 Terminal states are sticky; ``rejected`` is only ever assigned at
 ``submit()`` time (a rejected request never enters the queue). Deadlines
@@ -44,6 +54,9 @@ class RequestState(str, enum.Enum):
     CANCELLED = "cancelled"
     DEADLINE_EXCEEDED = "deadline_exceeded"
     REJECTED = "rejected"
+    RETRY_EXHAUSTED = "retry_exhausted"       # engine crashed more times
+                                              # than the request's replay
+                                              # budget allows
 
 
 #: states a request can still leave
@@ -77,9 +90,32 @@ class GatewayRequest:
     n_tokens: int = 0
     tokens: Optional[np.ndarray] = None
     cancel_requested: bool = False
+    replays: int = 0                  # times re-admitted after engine crash
+    not_before: float = 0.0           # replay backoff gate (clock() time)
+    # one histogram sample per REQUEST, not per attempt: these survive
+    # reset_for_replay so a replayed request cannot double-observe
+    # queue-wait/TTFT (counts must stay comparable to requests_submitted)
+    queue_wait_observed: bool = False
+    ttft_observed: bool = False
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
+
+    def reset_for_replay(self, now: float, backoff_s: float) -> None:
+        """Send the request back to QUEUED after an engine crash: the
+        engine-side identity and all decode bookkeeping are void (the
+        crashed engine's partial KV and tokens are gone; decode restarts
+        from scratch, so streaming consumers may see tokens re-emitted —
+        at-least-once delivery). The deadline and submit time are NOT
+        reset: the client's clock kept running through the crash."""
+        self.replays += 1
+        self.state = RequestState.QUEUED
+        self.engine_rid = None
+        self.dispatched_at = None
+        self.first_token_at = None
+        self.last_token_at = None
+        self.n_tokens = 0
+        self.not_before = now + backoff_s
 
 
 @dataclasses.dataclass(frozen=True)

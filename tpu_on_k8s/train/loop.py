@@ -49,6 +49,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import jax
 
+from tpu_on_k8s import chaos
 from tpu_on_k8s.utils.logging import get_logger, kv
 
 log = get_logger("train.loop")
@@ -87,6 +88,7 @@ class LoopResult:
     steps: int = 0
     host_syncs: int = 0
     checkpoints_enqueued: int = 0
+    checkpoint_failures: int = 0
     seconds: float = 0.0
     preempted: bool = False
 
@@ -217,14 +219,21 @@ class TrainLoop:
         t_window = t0
         try:
             for i in range(1, steps + 1):
+                # the chaos site is a second preemption source: a scheduled
+                # PreemptNotice lands exactly like a SIGTERM-handler flag
                 if self._should_stop or (self.preemption_signal is not None
-                                         and self.preemption_signal()):
+                                         and self.preemption_signal()) or (
+                        chaos.fire(chaos.SITE_TRAIN_PREEMPT, step=i)
+                        is not None):
                     result.preempted = True
                     break
                 try:
                     batch = next(batches)
                 except StopIteration:
                     break
+                step_fault = chaos.fire(chaos.SITE_TRAIN_STEP, step=i)
+                if step_fault is not None:
+                    raise step_fault.to_exception()
                 self.state, step_metrics = self.step_fn(self.state, batch)
                 pending.append(step_metrics)
                 self._dispatched = result.steps = i
@@ -269,7 +278,17 @@ class TrainLoop:
                 # compile cache instead of replaying the window
                 self._enqueue_save(result, result.steps)
             if self.checkpoint_manager is not None:
-                self.checkpoint_manager.wait_until_finished()
+                try:
+                    self.checkpoint_manager.wait_until_finished()
+                except Exception as e:  # noqa: BLE001 — async save failed
+                    # an async save that failed in the background surfaces
+                    # here; the training that happened since is still real —
+                    # record the failure, keep the state we computed
+                    result.checkpoint_failures += 1
+                    kv(log, logging.WARNING, "checkpoint_drain_failed",
+                       error=f"{type(e).__name__}: {e}")
+                    if self.metrics is not None:
+                        self.metrics.inc("checkpoint_failures")
         finally:
             self._running = False
             if self._watchdog is not None:
@@ -327,8 +346,25 @@ class TrainLoop:
 
     # --------------------------------------------------------- checkpoints
     def _enqueue_save(self, result: LoopResult, step: int) -> None:
-        self.checkpoint_manager.save(self.state, step=step,
-                                     generation=self.generation, wait=False)
+        """Enqueue an async save. A FAILING save (full disk, revoked
+        credentials, injected ``SaveFailure``) must not kill the run —
+        training state is intact and the next cadence save gets a fresh
+        chance; resume falls back to the last checkpoint that did land
+        (the chaos soak proves the fallback reproduces the trajectory)."""
+        try:
+            fault = chaos.fire(chaos.SITE_TRAIN_SAVE, step=step)
+            if fault is not None:
+                raise fault.to_exception()
+            self.checkpoint_manager.save(self.state, step=step,
+                                         generation=self.generation,
+                                         wait=False)
+        except Exception as e:  # noqa: BLE001 — saves are best-effort
+            result.checkpoint_failures += 1
+            kv(log, logging.WARNING, "checkpoint_save_failed", step=step,
+               error=f"{type(e).__name__}: {e}")
+            if self.metrics is not None:
+                self.metrics.inc("checkpoint_failures")
+            return
         result.checkpoints_enqueued += 1
         if self.metrics is not None:
             self.metrics.inc("checkpoints_enqueued")
