@@ -6,12 +6,13 @@
 # docker-build produces.
 IMG ?= tpu-on-k8s/manager:latest
 
-.PHONY: test test-fast chaos-soak native bench dryrun manager samples clean \
-        docker-build docker-push deploy undeploy
+.PHONY: test test-fast chaos-soak fleet-soak native bench dryrun manager \
+        samples clean docker-build docker-push deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
 # CHAOS_SOAK_FAILED seed=... on any failure
 CHAOS_SEED ?= 1234
+FLEET_SEED ?= 4321
 
 test:
 	python -m pytest tests/ -q
@@ -22,6 +23,11 @@ test-fast:  ## skip the slow sharded-compile suites
 chaos-soak:  ## the end-to-end failure-recovery scenario suite, twice, logs compared
 	JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed $(CHAOS_SEED) --repeat 2
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos -p no:cacheprovider
+
+fleet-soak:  ## 2-replica routed fleet under a crash mid-trace: zero-silent-loss accounting
+	JAX_PLATFORMS=cpu python tools/serve_load.py --replicas 2 --soak \
+	    --n-requests 48 --rate 2.0 --prefix-bucket 8 \
+	    --crash-replica 1 --crash-step 5 --seed $(FLEET_SEED)
 
 native:  ## build the C++ data pipeline explicitly (also built lazily on import)
 	g++ -O2 -std=c++17 -shared -fPIC \

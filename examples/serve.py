@@ -74,6 +74,18 @@ def main(argv=None):
                    help="route traffic through the production front door "
                         "(tpu_on_k8s.serve.ServingGateway): bounded "
                         "admission, tenant fairness, deadlines")
+    p.add_argument("--replicas", type=int, default=0,
+                   help=">0: serve a routed fleet of this many replicas "
+                        "(tpu_on_k8s.serve.ServingFleet): prefix-affinity "
+                        "+ least-outstanding-tokens routing, slow-start "
+                        "readiness, crash ejection with replay")
+    p.add_argument("--prefix-bucket", type=int, default=16,
+                   help="router prefix-affinity bucket length "
+                        "(with --replicas)")
+    p.add_argument("--rollout-demo", action="store_true",
+                   help="with --replicas: after half the trace, roll the "
+                        "fleet to a v2 parameter set under load (surge → "
+                        "ready → weight shift → drain) and report phases")
     p.add_argument("--queue-bound", type=int, default=16,
                    help="gateway admission queue bound (with --gateway)")
     p.add_argument("--tenants", type=int, default=3,
@@ -124,6 +136,8 @@ def main(argv=None):
 
 
 def _serve_loop(args, cfg, params):
+    if args.replicas > 0:
+        return _fleet_loop(args, cfg, params)
     mesh = rules = None
     if args.model_axis > 1 or args.fsdp > 1:
         mesh = create_mesh(MeshConfig(
@@ -196,6 +210,99 @@ def _serve_loop(args, cfg, params):
                  f"p50 TTFT {statistics.median(ttft) * 1e3:.0f}ms")
     print(line)
     return finished
+
+
+def _fleet_loop(args, cfg, params):
+    """The fleet shape: N replicas behind the router. Traffic repeats a
+    few synthetic system prompts so prefix affinity has something to
+    exploit; with --rollout-demo a fresh v2 parameter set rolls in under
+    load (the closed train → image → deploy → serve loop, in-process)."""
+    from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+    from tpu_on_k8s.serve import (
+        AdmissionConfig,
+        FleetRolloutPolicy,
+        ProbeConfig,
+        Rejected,
+        Router,
+        RolloutPhase,
+        ServingFleet,
+    )
+
+    def factory_for(p):
+        def make(name):
+            return ContinuousBatchingEngine(
+                cfg, p, n_slots=args.n_slots, max_len=args.max_len or None,
+                temperature=args.temperature, top_k=args.top_k,
+                top_p=args.top_p, step_horizon=args.horizon)
+        return make
+
+    fleet = ServingFleet(
+        factory_for(params), args.replicas,
+        admission=AdmissionConfig(max_queue_depth=args.queue_bound),
+        probe=ProbeConfig(slow_start_steps=2),
+        router=Router(prefix_bucket_len=args.prefix_bucket))
+    while not any(r.routable for r in fleet.replicas.values()):
+        fleet.step()                       # slow start: earn readiness
+    rng = np.random.default_rng(args.seed)
+    shared = [rng.integers(0, cfg.vocab_size,
+                           size=args.prefix_bucket).astype(np.int32)
+              for _ in range(3)]           # repeated "system prompts"
+    submitted = rejected = 0
+    finished = {}
+    rollout_started = False
+    phases = []
+    t0 = time.perf_counter()
+    while submitted < args.n_requests or fleet.has_live_requests \
+            or fleet.rollout_phase not in (RolloutPhase.IDLE,
+                                           RolloutPhase.COMPLETE):
+        if args.rollout_demo and not rollout_started \
+                and submitted >= args.n_requests // 2:
+            v2 = Transformer(cfg).init(
+                jax.random.key(args.seed + 99),
+                jax.random.randint(jax.random.key(0), (1, 8), 0,
+                                   cfg.vocab_size, jnp.int32))["params"]
+            fleet.start_rollout(factory_for(v2), "v2",
+                                FleetRolloutPolicy(max_surge=1,
+                                                   canary_weight=0.25))
+            rollout_started = True
+            print("=== rollout v1 → v2 started under load ===")
+        if submitted < args.n_requests:
+            for _ in range(rng.poisson(args.arrival)):
+                if submitted >= args.n_requests:
+                    break
+                suffix = rng.integers(
+                    0, cfg.vocab_size,
+                    size=int(rng.integers(2, 9))).astype(np.int32)
+                prompt = np.concatenate(
+                    [shared[submitted % len(shared)], suffix])
+                r = fleet.submit(prompt, args.max_new_tokens)
+                submitted += 1
+                if isinstance(r, Rejected):
+                    rejected += 1
+                    print(f"✗ rejected ({r.reason})")
+        for rid in fleet.step():
+            res = fleet.result(rid)
+            if res is not None:
+                finished[rid] = res
+        if not phases or phases[-1] != fleet.rollout_phase:
+            phases.append(fleet.rollout_phase)
+            if args.rollout_demo and rollout_started:
+                print(f"--- rollout phase: {fleet.rollout_phase.value} "
+                      f"(weights {fleet.router.weights})")
+    dt = time.perf_counter() - t0
+    done = {rid: r.tokens for rid, r in finished.items() if r.ok}
+    total = sum(len(v) for v in done.values())
+    per = {name: rep.routed for name, rep in sorted(fleet.replicas.items())}
+    print(f"fleet served {len(done)}/{submitted} requests "
+          f"({rejected} rejected), {total} tokens in {dt:.2f}s "
+          f"({total / dt:.1f} tok/s) — routed {per}, "
+          f"prefix hits/misses {fleet.stats['prefix_hits']}/"
+          f"{fleet.stats['prefix_misses']}, rerouted "
+          f"{fleet.stats['rerouted']}")
+    if args.rollout_demo:
+        print(f"rollout phases: {[p.value for p in phases]}; retired "
+              f"{[(r['name'], r['drained_clean']) for r in fleet.retired]}")
+    return done
 
 
 def _gateway_loop(args, cfg, eng, metrics, rng, prefix_id):

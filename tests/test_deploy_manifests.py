@@ -37,14 +37,15 @@ def _load_kustomize_tree(entry: Path):
 def test_default_kustomization_resolves_and_parses():
     docs = _load_kustomize_tree(CONFIG / "default")
     kinds = [d["kind"] for d in docs]
-    # TPUJob, Model, ModelVersion + the kruise-analog ContainerRecreateRequest
-    assert kinds.count("CustomResourceDefinition") == 4
+    # TPUJob, Model, ModelVersion, InferenceService + the kruise-analog
+    # ContainerRecreateRequest
+    assert kinds.count("CustomResourceDefinition") == 5
     assert "DaemonSet" in kinds  # the CRR node agent (config/nodeagent/)
     assert "Deployment" in kinds and "ServiceAccount" in kinds
     assert "Role" in kinds and "RoleBinding" in kinds  # leader election
     # reference's 16-file RBAC surface: aggregated editor/viewer per CRD
     names = {d["metadata"]["name"] for d in docs}
-    for crd in ("tpujob", "model", "modelversion"):
+    for crd in ("tpujob", "model", "modelversion", "inferenceservice"):
         assert f"tpu-on-k8s-{crd}-editor-role" in names
         assert f"tpu-on-k8s-{crd}-viewer-role" in names
     assert "tpu-on-k8s-metrics-reader" in names

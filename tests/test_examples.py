@@ -120,6 +120,18 @@ def test_serve_gateway_mode():
     assert 0 < len(out_tight) < 6          # bound 2 sheds part of the burst
 
 
+def test_serve_fleet_mode_with_rollout_demo():
+    """--replicas routes through the ServingFleet (router + slow start +
+    prefix affinity) and --rollout-demo rolls v1 → v2 under the same
+    load — everything still completes."""
+    from examples.serve import main
+    out = main(["--config", "tiny", "--n-requests", "6", "--n-slots", "2",
+                "--max-new-tokens", "4", "--arrival", "2", "--replicas",
+                "2", "--prefix-bucket", "8", "--rollout-demo"])
+    assert len(out) == 6
+    assert all(len(v) == 4 for v in out.values())
+
+
 def test_aimaster_run_loop():
     from examples.aimaster import run
     from tpu_on_k8s.api import constants

@@ -1,0 +1,310 @@
+"""InferenceService controller: deploying Model.status.latest_image as a
+gang-scheduled replica fleet, and the zero-downtime rolling rollout when a
+new image lands — surge within max_surge, drain-before-delete, the ready
+floor (replicas - max_unavailable) never violated, canary weight tracking
+the rollout position."""
+from typing import List
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import ObjectMeta, Pod
+from tpu_on_k8s.api.inference_types import (
+    InferenceService,
+    InferenceServiceSpec,
+    RolloutPolicy,
+    ServicePhase,
+)
+from tpu_on_k8s.api.model_types import Model, ModelStatus
+from tpu_on_k8s.api.types import TPUPolicy
+from tpu_on_k8s.client import InMemoryCluster, KubeletSim
+from tpu_on_k8s.controller.inferenceservice import (
+    image_hash,
+    setup_inferenceservice_controller,
+)
+from tpu_on_k8s.controller.runtime import Manager
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_env():
+    cluster = InMemoryCluster()
+    manager = Manager()
+    clock = FakeClock()
+    setup_inferenceservice_controller(cluster, manager, clock=clock)
+    return cluster, manager, KubeletSim(cluster), clock
+
+
+def make_model(cluster, name="m1", image="reg.local/m1:v1"):
+    return cluster.create(Model(
+        metadata=ObjectMeta(name=name),
+        status=ModelStatus(latest_version_name="mv-" + image.split(":")[-1],
+                           latest_image=image)))
+
+
+def make_svc(cluster, name="svc", replicas=2, rollout=None, model="m1",
+             topology="2x2"):
+    return cluster.create(InferenceService(
+        metadata=ObjectMeta(name=name),
+        spec=InferenceServiceSpec(
+            model_name=model, replicas=replicas,
+            tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice",
+                                 topology=topology),
+            rollout=rollout or RolloutPolicy())))
+
+
+def svc_pods(cluster, name="svc") -> List[Pod]:
+    return sorted(cluster.list(
+        Pod, "default",
+        {constants.LABEL_INFERENCESERVICE_NAME: name}),
+        key=lambda p: p.metadata.name)
+
+
+def pump(manager, clock, rounds=30):
+    """Drive to quiescence. The controller's workqueue shares the fake
+    clock, so items requeued for the future stay parked until a test
+    advances the clock explicitly — run_until_idle alone processes
+    everything currently due (an ``advance=`` callback would livelock
+    here: progression legitimately waits on the KubeletSim)."""
+    manager.run_until_idle()
+
+
+def test_deploys_replicas_from_model_latest_image():
+    cluster, manager, sim, clock = make_env()
+    make_model(cluster)
+    make_svc(cluster, replicas=2)
+    manager.run_until_idle()
+    pods = svc_pods(cluster)
+    assert len(pods) == 2                       # 2x2 v5e slice = 1 host
+    h = image_hash("reg.local/m1:v1")
+    for p in pods:
+        assert p.spec.containers[0].image == "reg.local/m1:v1"
+        assert p.metadata.labels[constants.LABEL_SERVING_IMAGE_HASH] == h
+        # gang + slice scheduling surface
+        assert constants.ANNOTATION_GANG_GROUP_NAME in p.metadata.annotations
+        assert p.spec.node_selector[
+            constants.NODE_SELECTOR_TPU_TOPOLOGY] == "2x2"
+        assert p.spec.containers[0].resources.requests[
+            constants.RESOURCE_TPU] > 0
+        assert any(r.kind == "InferenceService"
+                   for r in p.metadata.owner_references)
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.phase is ServicePhase.PROGRESSING
+    assert svc.status.replicas == 2 and svc.status.ready_replicas == 0
+
+    sim.run_all("default")
+    manager.run_until_idle()
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.phase is ServicePhase.READY
+    assert svc.status.ready_replicas == 2
+    assert svc.status.current_image == "reg.local/m1:v1"
+    assert svc.status.canary_weight == 1.0
+
+
+def test_pending_without_image_then_deploys_when_model_publishes():
+    cluster, manager, sim, clock = make_env()
+    cluster.create(Model(metadata=ObjectMeta(name="m1")))
+    make_svc(cluster, replicas=1)
+    pump(manager, clock)
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.phase is ServicePhase.PENDING
+    assert svc_pods(cluster) == []
+
+    def publish(m: Model) -> None:
+        m.status.latest_image = "reg.local/m1:v1"
+    cluster.update_with_retry(Model, "default", "m1", publish,
+                              subresource="status")
+    manager.run_until_idle()             # Model watch enqueues the service
+    assert len(svc_pods(cluster)) == 1
+
+
+def test_multi_host_slice_is_one_gang():
+    cluster, manager, sim, clock = make_env()
+    make_model(cluster)
+    make_svc(cluster, replicas=1, topology="4x4")   # 16 chips -> 4 hosts
+    manager.run_until_idle()
+    pods = svc_pods(cluster)
+    assert len(pods) == 4
+    gangs = {p.metadata.annotations[constants.ANNOTATION_GANG_GROUP_NAME]
+             for p in pods}
+    assert len(gangs) == 1                          # all-or-nothing placement
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.replicas == 1                 # counted in gangs
+    # a partially-ready gang is not a ready replica
+    sim.run_pod("default", pods[0].metadata.name)
+    manager.run_until_idle()
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.ready_replicas == 0
+    sim.run_all("default")
+    manager.run_until_idle()
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.ready_replicas == 1
+
+
+def test_rolling_rollout_surge_drain_delete_order():
+    """The rollout state machine: new image -> surge one new replica
+    (maxSurge=1), old capacity untouched until the new gang is Ready,
+    then old replicas drain (annotation first — the serve plane's
+    stop_accepting) and are only deleted after the drain grace."""
+    cluster, manager, sim, clock = make_env()
+    make_model(cluster)
+    make_svc(cluster, replicas=2,
+             rollout=RolloutPolicy(max_surge=1, max_unavailable=0,
+                                   drain_seconds=10.0))
+    manager.run_until_idle()
+    sim.run_all("default")
+    manager.run_until_idle()
+    h1 = image_hash("reg.local/m1:v1")
+
+    def publish(m: Model) -> None:
+        m.status.latest_image = "reg.local/m1:v2"
+    cluster.update_with_retry(Model, "default", "m1", publish,
+                              subresource="status")
+    manager.run_until_idle()
+
+    pods = svc_pods(cluster)
+    by_hash = {}
+    for p in pods:
+        by_hash.setdefault(
+            p.metadata.labels[constants.LABEL_SERVING_IMAGE_HASH],
+            []).append(p)
+    h2 = image_hash("reg.local/m1:v2")
+    # surge: exactly ONE new replica above desired; both old still serving
+    assert len(by_hash[h2]) == 1 and len(by_hash[h1]) == 2
+    assert not any(constants.ANNOTATION_SERVING_DRAIN_DEADLINE
+                   in p.metadata.annotations for p in by_hash[h1])
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.phase is ServicePhase.PROGRESSING
+    assert svc.status.target_image == "reg.local/m1:v2"
+    assert svc.status.current_image == "reg.local/m1:v1"
+    assert svc.status.canary_weight == 0.0        # no new replica ready yet
+
+    # the new gang comes Ready -> one old replica may drain (floor holds)
+    sim.run_all("default")
+    manager.run_until_idle()
+    pods = svc_pods(cluster)
+    old = [p for p in pods if p.metadata.labels[
+        constants.LABEL_SERVING_IMAGE_HASH] == h1]
+    draining = [p for p in old if constants.ANNOTATION_SERVING_DRAIN_DEADLINE
+                in p.metadata.annotations]
+    assert len(old) == 2 and len(draining) == 1   # drained, NOT deleted
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.canary_weight >= 0.1        # canary share granted
+
+    # drain grace elapses -> drained old replica deleted, second new surges
+    clock.advance(11.0)
+    pump(manager, clock)
+    sim.run_all("default")
+    pump(manager, clock)
+    clock.advance(11.0)
+    pump(manager, clock)
+    pods = svc_pods(cluster)
+    assert {p.metadata.labels[constants.LABEL_SERVING_IMAGE_HASH]
+            for p in pods} == {h2}
+    assert len(pods) == 2
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.phase is ServicePhase.READY
+    assert svc.status.current_image == "reg.local/m1:v2"
+    assert svc.status.canary_weight == 1.0
+
+
+def test_ready_floor_respected_while_new_not_ready():
+    """With max_unavailable=0 no old replica drains until a new one is
+    actually Ready — a rollout onto a broken image never reduces serving
+    capacity."""
+    cluster, manager, sim, clock = make_env()
+    make_model(cluster)
+    make_svc(cluster, replicas=2,
+             rollout=RolloutPolicy(max_surge=1, max_unavailable=0))
+    manager.run_until_idle()
+    sim.run_all("default")
+    manager.run_until_idle()
+
+    def publish(m: Model) -> None:
+        m.status.latest_image = "reg.local/m1:bad"
+    cluster.update_with_retry(Model, "default", "m1", publish,
+                              subresource="status")
+    pump(manager, clock)
+    # the surged pod never comes up; old replicas must be untouched
+    h1 = image_hash("reg.local/m1:v1")
+    old = [p for p in svc_pods(cluster) if p.metadata.labels[
+        constants.LABEL_SERVING_IMAGE_HASH] == h1]
+    assert len(old) == 2
+    assert not any(constants.ANNOTATION_SERVING_DRAIN_DEADLINE
+                   in p.metadata.annotations for p in old)
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.ready_replicas == 2
+
+
+def test_failed_gang_recreated():
+    cluster, manager, sim, clock = make_env()
+    make_model(cluster)
+    make_svc(cluster, replicas=1)
+    manager.run_until_idle()
+    name = svc_pods(cluster)[0].metadata.name
+    sim.run_pod("default", name)
+    manager.run_until_idle()
+    sim.terminate_pod("default", name, exit_code=137, reason="OOMKilled")
+    manager.run_until_idle()
+    pods = svc_pods(cluster)
+    assert len(pods) == 1                        # torn down and recreated
+    assert pods[0].status.phase == "Pending"
+
+
+def test_lost_gang_pod_is_recreated():
+    """A pod deleted out from under a multi-host gang (node drain, manual
+    delete — no Failed phase to classify) self-heals: the reconciler
+    recreates the missing host pod instead of leaving the gang partial
+    forever."""
+    cluster, manager, sim, clock = make_env()
+    make_model(cluster)
+    make_svc(cluster, replicas=1, topology="4x4")   # 4-host gang
+    manager.run_until_idle()
+    sim.run_all("default")
+    manager.run_until_idle()
+    pods = svc_pods(cluster)
+    assert len(pods) == 4
+    lost = pods[2].metadata.name
+    cluster.delete(Pod, "default", lost)
+    manager.run_until_idle()
+    pods = svc_pods(cluster)
+    assert len(pods) == 4                            # gang repaired
+    assert lost in {p.metadata.name for p in pods}
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.ready_replicas == 0            # until the pod runs
+    sim.run_all("default")
+    manager.run_until_idle()
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.ready_replicas == 1
+
+
+def test_scale_down_drains_surplus():
+    cluster, manager, sim, clock = make_env()
+    make_model(cluster)
+    make_svc(cluster, replicas=3,
+             rollout=RolloutPolicy(drain_seconds=5.0))
+    manager.run_until_idle()
+    sim.run_all("default")
+    manager.run_until_idle()
+    assert len(svc_pods(cluster)) == 3
+
+    def shrink(s: InferenceService) -> None:
+        s.spec.replicas = 1
+    cluster.update_with_retry(InferenceService, "default", "svc", shrink)
+    manager.run_until_idle()
+    pods = svc_pods(cluster)
+    assert len(pods) == 3                        # drain first, delete later
+    draining = [p for p in pods
+                if constants.ANNOTATION_SERVING_DRAIN_DEADLINE
+                in p.metadata.annotations]
+    assert len(draining) == 2
+    clock.advance(6.0)
+    pump(manager, clock)
+    assert len(svc_pods(cluster)) == 1

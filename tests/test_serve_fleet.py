@@ -1,0 +1,699 @@
+"""The serving fleet (`tpu_on_k8s/serve/fleet.py` + `router.py` +
+`health.py`): routed multi-replica serving with zero-loss guarantees —
+
+* deterministic v1 → v2 rolling rollout under continuous load: every
+  request reaches a typed terminal state, the old replicas drain fully
+  before removal, canary weight tracks the rollout position;
+* replica-crash chaos: survivors re-routed through another replica or
+  finalized ``RETRY_EXHAUSTED`` — never dropped;
+* prefix-affinity routing demonstrably beats random routing on a
+  repeated-prefix workload (engine prefix-cache hit rate, CPU-mode);
+* readiness slow-start / flap, liveness ejection, router units, the
+  ElasticAutoscaler observation format, and Prometheus exposition with
+  per-replica labels.
+"""
+import dataclasses
+from collections import Counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_on_k8s import chaos
+from tpu_on_k8s.chaos import scenarios
+from tpu_on_k8s.metrics.metrics import (
+    FleetMetrics,
+    ServingMetrics,
+    exposition,
+)
+from tpu_on_k8s.models.decode import generate
+from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+from tpu_on_k8s.serve import (
+    FleetRolloutPolicy,
+    ProbeConfig,
+    Rejected,
+    ReplayPolicy,
+    ReplicaState,
+    RequestState,
+    RolloutPhase,
+    Router,
+    ServingFleet,
+)
+from tpu_on_k8s.serve.admission import REASON_UNAVAILABLE
+from tpu_on_k8s.serve.health import HealthMonitor
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = dataclasses.replace(TransformerConfig.tiny(), dtype=jnp.float32,
+                              max_seq_len=64)
+    tok = jax.random.randint(jax.random.key(0), (1, 8), 0, cfg.vocab_size,
+                             jnp.int32)
+    model = Transformer(cfg)
+    v1 = model.init(jax.random.key(1), tok)["params"]
+    v2 = model.init(jax.random.key(2), tok)["params"]
+    return cfg, v1, v2
+
+
+def _want(cfg, params, prompt, n):
+    return np.asarray(generate(cfg, params,
+                               jnp.asarray(prompt, jnp.int32)[None, :],
+                               max_new_tokens=n))[0]
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _factory(cfg, params, n_slots=2):
+    def make(name):
+        return ContinuousBatchingEngine(cfg, params, n_slots=n_slots)
+    return make
+
+
+def _fleet(cfg, params, n=2, *, bucket=8, slow_start=1, mode="affinity",
+           **kw):
+    return ServingFleet(
+        _factory(cfg, params), n,
+        probe=ProbeConfig(slow_start_steps=slow_start),
+        router=Router(prefix_bucket_len=bucket, mode=mode), **kw)
+
+
+def _warm(fleet, steps=3):
+    for _ in range(steps):
+        fleet.step()
+
+
+# --------------------------------------------------------------- router units
+def test_router_affinity_consistent_and_bounded():
+    r = Router(prefix_bucket_len=8, spill_tokens=10)
+    r.add_replica("a", "v1")
+    r.add_replica("b", "v1")
+    p = np.arange(12, dtype=np.int32)
+    pick = r.route(p, ["a", "b"], {})
+    # same prefix bucket -> same replica, regardless of suffix
+    p2 = np.concatenate([p[:8], np.full(20, 7, np.int32)])
+    assert r.route(p2, ["a", "b"], {}) == pick
+    # bounded load: the affinity replica spills to least-outstanding
+    # once it is more than spill_tokens ahead
+    other = "b" if pick == "a" else "a"
+    assert r.route(p, ["a", "b"], {pick: 100, other: 0}) == other
+    assert r.route(p, ["a", "b"], {pick: 5, other: 0}) == pick
+    # exclusion and empty candidate sets
+    assert r.route(p, ["a", "b"], {}, exclude=["a", "b"]) is None
+    assert r.route(p, [pick], {}) == pick
+
+
+def test_router_ring_remap_is_bounded():
+    """Consistent hashing: removing one of four replicas remaps ONLY the
+    keys that replica owned — everything else stays put."""
+    r = Router(prefix_bucket_len=4)
+    for i in range(4):
+        r.add_replica(f"r{i}", "v1")
+    rng = np.random.default_rng(3)
+    keys = [rng.integers(0, 256, size=4).astype(np.int32)
+            for _ in range(200)]
+    ready = [f"r{i}" for i in range(4)]
+    before = [r.route(k, ready, {}) for k in keys]
+    r.remove_replica("r3")
+    after = [r.route(k, ready[:3], {}) for k in keys]
+    moved = sum(b != a for b, a in zip(before, after))
+    owned = sum(b == "r3" for b in before)
+    assert moved == owned          # only the removed replica's keys moved
+
+
+def test_router_weighted_canary_split_exact():
+    """Smooth-WRR version split: a 0.25 canary gets exactly every 4th
+    request, not 25%-in-expectation."""
+    r = Router(prefix_bucket_len=4)
+    r.add_replica("old-0", "v1")
+    r.add_replica("new-0", "v2")
+    r.set_weights({"v1": 0.75, "v2": 0.25})
+    rng = np.random.default_rng(4)
+    picks = [r.version_of(r.route(
+        rng.integers(0, 256, size=6).astype(np.int32),
+        ["old-0", "new-0"], {})) for _ in range(40)]
+    assert Counter(picks) == {"v1": 30, "v2": 10}
+    # and never two canary picks back to back at this weight
+    assert "v2v2" not in "".join(picks)
+
+
+def test_router_validation():
+    with pytest.raises(ValueError, match="prefix_bucket_len"):
+        Router(prefix_bucket_len=0)
+    with pytest.raises(ValueError, match="mode"):
+        Router(mode="roundrobin")
+    r = Router()
+    r.add_replica("a", "v1")
+    with pytest.raises(ValueError, match="already registered"):
+        r.add_replica("a", "v1")
+
+
+def test_chaos_replica_match_is_boundary_anchored():
+    """A rule for replica-1 must not fire on (or count) replica-10 —
+    substring prefixes sharing an alphanumeric boundary don't match;
+    path-fragment matching still works."""
+    from tpu_on_k8s.chaos.injector import _substr_on_boundaries
+
+    assert _substr_on_boundaries("replica-1", "replica-1")
+    assert not _substr_on_boundaries("replica-1", "replica-10")
+    assert _substr_on_boundaries("/pods", "/api/v1/namespaces/d/pods")
+    assert _substr_on_boundaries("pods", "/pods?watch=true")
+    inj = chaos.FaultInjector([chaos.FaultRule(
+        chaos.SITE_FLEET_REPLICA,
+        chaos.Trigger(at=(1,), match={"replica": "replica-1"}),
+        chaos.ReplicaCrash())])
+    assert inj.fire(chaos.SITE_FLEET_REPLICA, replica="replica-10") is None
+    assert inj.fire(chaos.SITE_FLEET_REPLICA,
+                    replica="replica-1") is not None
+
+
+def test_health_monitor_slow_start_flap_and_stall():
+    h = HealthMonitor(ProbeConfig(slow_start_steps=2, stall_steps=3))
+    assert not h.ready
+    h.observe_step(progressed=False, busy=False)   # idle is healthy
+    assert not h.ready
+    h.observe_step(progressed=True, busy=True)
+    assert h.ready
+    h.flap(3)
+    assert not h.ready                             # flapped out
+    h.observe_step(progressed=True, busy=True)
+    h.observe_step(progressed=True, busy=True)
+    assert not h.ready                             # flap window still open
+    h.observe_step(progressed=True, busy=True)
+    assert h.ready                                 # window closed, streak ok
+    for _ in range(3):                             # busy but frozen
+        h.observe_step(progressed=False, busy=True)
+    assert h.wedged
+
+
+# ------------------------------------------------------------- fleet basics
+def test_fleet_slow_start_gates_traffic(setup):
+    cfg, v1, _ = setup
+    fleet = _fleet(cfg, v1, 2, slow_start=2)
+    rej = fleet.submit(np.arange(4, dtype=np.int32), 2)
+    assert isinstance(rej, Rejected) and rej.reason == REASON_UNAVAILABLE
+    _warm(fleet, 2)                                # earn the streak
+    assert isinstance(fleet.submit(np.arange(4, dtype=np.int32), 2), int)
+    fleet.run()
+
+
+def test_fleet_serves_exactly_and_balances(setup):
+    """Everything completes bit-identical to solo generate() — including
+    requests served through the auto-registered prefix path — and both
+    replicas take traffic."""
+    cfg, v1, _ = setup
+    fleet = _fleet(cfg, v1, 2, bucket=8)
+    _warm(fleet)
+    rng = np.random.default_rng(11)
+    prompts = {}
+    for i in range(10):
+        lp = int(rng.integers(3, 14))
+        p = rng.integers(0, cfg.vocab_size, size=lp).astype(np.int32)
+        rid = fleet.submit(p, 5)
+        assert isinstance(rid, int)
+        prompts[rid] = p
+    out = fleet.run()
+    for rid, p in prompts.items():
+        assert out[rid].ok
+        np.testing.assert_array_equal(out[rid].tokens,
+                                      _want(cfg, v1, p, 5),
+                                      err_msg=f"request {rid}")
+    routed = {r.name: r.routed for r in fleet.replicas.values()}
+    assert all(n > 0 for n in routed.values()), routed
+
+
+def test_fleet_streaming_uses_fleet_ids(setup):
+    cfg, v1, _ = setup
+    fleet = _fleet(cfg, v1, 2)
+    _warm(fleet)
+    seen = []
+    rid = fleet.submit(np.arange(5, dtype=np.int32), 4,
+                       on_token=lambda r, t: seen.append((r, t)))
+    out = fleet.run()
+    assert [t for _, t in seen] == out[rid].tokens.tolist()
+    assert all(r == rid for r, _ in seen)          # fleet id, not gateway id
+
+
+def test_readiness_flap_pulls_replica_from_rotation(setup):
+    cfg, v1, _ = setup
+    fleet = _fleet(cfg, v1, 2, slow_start=1)
+    _warm(fleet)
+    flap = chaos.FaultInjector([chaos.FaultRule(
+        chaos.SITE_FLEET_REPLICA,
+        chaos.Trigger(at=(1,), match={"replica": "replica-0"}),
+        chaos.ReadinessFlap(steps=4))])
+    try:
+        with flap:
+            fleet.step()
+    finally:
+        chaos.uninstall()
+    assert fleet.replicas["replica-0"].state is ReplicaState.STARTING
+    routed0 = fleet.replicas["replica-0"].routed
+    rng = np.random.default_rng(12)
+    for _ in range(6):                      # all traffic avoids the flapped
+        r = fleet.submit(rng.integers(0, cfg.vocab_size,
+                                      size=6).astype(np.int32), 2)
+        assert isinstance(r, int)
+    assert fleet.replicas["replica-0"].routed == routed0
+    fleet.run()
+    for _ in range(6):                      # re-earn the slow-start streak
+        fleet.step()
+    assert fleet.replicas["replica-0"].state is ReplicaState.READY
+    assert fleet.stats["readiness_flaps"] == 1
+
+
+# --------------------------------------------------------------- chaos: crash
+def test_replica_crash_mid_decode_zero_silent_loss(setup):
+    """The acceptance chaos scenario: a replica crashes mid-decode; every
+    one of its live requests is re-routed through the surviving replica
+    and completes, or finalizes RETRY_EXHAUSTED — none vanish."""
+    cfg, v1, _ = setup
+    fleet = _fleet(cfg, v1, 2, metrics=FleetMetrics())
+    _warm(fleet)
+    rng = np.random.default_rng(13)
+    rids = []
+    for _ in range(8):
+        rid = fleet.submit(rng.integers(0, cfg.vocab_size,
+                                        size=6).astype(np.int32), 8)
+        assert isinstance(rid, int)
+        rids.append(rid)
+    fleet.step()                                   # decode is underway
+    scenario = scenarios.replica_crash_mid_decode("replica-1", at_steps=(1,))
+    inj = scenario.injector()
+    try:
+        with inj:
+            fleet.step()                           # the crash step
+    finally:
+        chaos.uninstall()
+    assert inj.events == ["replica_crash note=crash replica-1 mid-decode"]
+    out = fleet.run()
+    assert set(out) == set(rids)                   # every request accounted
+    states = {rid: out[rid].state for rid in rids}
+    assert all(s in (RequestState.DONE, RequestState.RETRY_EXHAUSTED)
+               for s in states.values())
+    assert all(s is RequestState.DONE for s in states.values())
+    assert fleet.stats["ejected"] == 1
+    assert fleet.stats["rerouted"] > 0             # survivors moved over
+    assert fleet.replicas["replica-1"].state is ReplicaState.EJECTED
+    # completions re-routed after the crash are still oracle-exact
+    # (at-least-once semantics: decode restarted on the survivor)
+    assert fleet.metrics.counters[("replicas_ejected", "")] == 1
+
+
+def test_replica_crash_budget_exhausted_is_typed(setup):
+    """With a zero replay budget the crash victims finalize
+    RETRY_EXHAUSTED — a typed outcome, not a silent drop."""
+    cfg, v1, _ = setup
+    fleet = _fleet(cfg, v1, 2, replay=ReplayPolicy(max_replays=0))
+    _warm(fleet)
+    rng = np.random.default_rng(14)
+    rids = [fleet.submit(rng.integers(0, cfg.vocab_size,
+                                      size=6).astype(np.int32), 8)
+            for _ in range(8)]
+    fleet.step()
+    victim = next(r.name for r in fleet.replicas.values()
+                  if r.outstanding > 0)
+    inj = chaos.FaultInjector([chaos.FaultRule(
+        chaos.SITE_FLEET_REPLICA,
+        chaos.Trigger(at=(1,), match={"replica": victim}),
+        chaos.ReplicaCrash())])
+    try:
+        with inj:
+            fleet.step()
+    finally:
+        chaos.uninstall()
+    out = fleet.run()
+    states = [out[r].state for r in rids]
+    assert RequestState.RETRY_EXHAUSTED in states
+    assert all(s in (RequestState.DONE, RequestState.RETRY_EXHAUSTED)
+               for s in states)
+    assert len(out) == len(rids)
+
+
+# ------------------------------------------------------------------- rollout
+def _run_rollout(cfg, v1, v2, *, seed, policy, load_per_step=1,
+                 max_new=4, clock=None):
+    """Shared harness: continuous seeded load while v1 → v2 rolls."""
+    fleet = ServingFleet(
+        _factory(cfg, v1), 2,
+        probe=ProbeConfig(slow_start_steps=2),
+        router=Router(prefix_bucket_len=8),
+        metrics=FleetMetrics(),
+        clock=clock or FakeClock())
+    _warm(fleet)
+    rng = np.random.default_rng(seed)
+    rids = {}
+
+    def feed(n):
+        for _ in range(n):
+            p = rng.integers(0, cfg.vocab_size, size=6).astype(np.int32)
+            r = fleet.submit(p, max_new)
+            if isinstance(r, int):
+                rids[r] = p
+    feed(3)
+    fleet.start_rollout(_factory(cfg, v2), "v2", policy)
+    weights_seen = []
+    phases = []
+    for _ in range(200):
+        feed(load_per_step)
+        fleet.step()
+        weights_seen.append(dict(fleet.router.weights))
+        phases.append(fleet.rollout_phase)
+        if fleet.rollout_phase is RolloutPhase.COMPLETE:
+            break
+    assert fleet.rollout_phase is RolloutPhase.COMPLETE
+    out = fleet.run()
+    return fleet, rids, out, weights_seen, phases
+
+
+def test_rollout_zero_loss_under_continuous_load(setup):
+    """The acceptance rollout test (injectable clock, fully
+    deterministic): a v1 → v2 rolling update under continuous load
+    completes with every request reaching a typed terminal state — zero
+    lost, zero failed — and each old replica fully drained before
+    removal."""
+    cfg, v1, v2 = setup
+    policy = FleetRolloutPolicy(max_surge=1, canary_weight=0.25,
+                                drain_timeout_s=None)
+    fleet, rids, out, weights_seen, _ = _run_rollout(
+        cfg, v1, v2, seed=21, policy=policy)
+
+    # zero loss: every submitted request is terminal, none failed
+    assert set(out) == set(rids)
+    assert all(out[r].state is RequestState.DONE for r in rids)
+    # old replicas drained fully before removal
+    old_retired = [r for r in fleet.retired if r["version"] == "v1"]
+    assert len(old_retired) == 2
+    assert all(r["drained_clean"] for r in old_retired)
+    assert all(r["reason"] == "rollout drain complete" for r in old_retired)
+    # traffic committed to v2; canary weight was granted first
+    assert fleet.router.weights == {"v2": 1.0}
+    canary_steps = [w["v2"] for w in weights_seen if 0 < w.get("v2", 0) < 1]
+    assert canary_steps and min(canary_steps) == policy.canary_weight
+    # the fleet never dipped below desired ready capacity mid-rollout is
+    # implied by: old replicas only drained while a ready v2 stood in
+    assert fleet.stats["rollouts_completed"] == 1
+    # completions on BOTH versions are oracle-exact for their version's
+    # params: spot-check one late request against the v2 oracle
+    late_rid = max(rids)
+    np.testing.assert_array_equal(out[late_rid].tokens,
+                                  _want(cfg, v2, rids[late_rid], 4))
+
+
+def test_rollout_is_deterministic(setup):
+    """Same seed, same injectable clock → identical terminal states and
+    identical step counts across two full runs."""
+    cfg, v1, v2 = setup
+    policy = FleetRolloutPolicy(max_surge=1, canary_weight=0.25,
+                                drain_timeout_s=None)
+    runs = []
+    for _ in range(2):
+        fleet, rids, out, _, phases = _run_rollout(
+            cfg, v1, v2, seed=22, policy=policy)
+        runs.append((sorted((r, out[r].state.value) for r in rids),
+                     fleet.stats["steps"], phases))
+    assert runs[0] == runs[1]
+
+
+def test_rollout_drain_timeout_cancels_stragglers(setup):
+    """An old replica stuck on a long decode past the drain grace: the
+    straggler is cancelled (typed, partial tokens kept), the replica is
+    recorded as NOT cleanly drained, and the rollout still completes."""
+    cfg, v1, v2 = setup
+    clock = FakeClock()
+    fleet = ServingFleet(
+        _factory(cfg, v1), 2,
+        probe=ProbeConfig(slow_start_steps=1),
+        router=Router(prefix_bucket_len=8), clock=clock)
+    _warm(fleet)
+    rng = np.random.default_rng(23)
+    long_rid = fleet.submit(rng.integers(0, cfg.vocab_size,
+                                         size=6).astype(np.int32), 50)
+    assert isinstance(long_rid, int)
+    fleet.step()
+    fleet.start_rollout(_factory(cfg, v2), "v2",
+                        FleetRolloutPolicy(max_surge=2, canary_weight=0.5,
+                                           drain_timeout_s=5.0))
+    for _ in range(100):
+        fleet.step()
+        clock.advance(0.5)                  # 10 steps ≫ the 5s grace
+        if fleet.rollout_phase is RolloutPhase.COMPLETE:
+            break
+    assert fleet.rollout_phase is RolloutPhase.COMPLETE
+    out = fleet.run()
+    assert out[long_rid].state is RequestState.CANCELLED
+    assert 0 < out[long_rid].tokens.size < 50      # partials kept
+    forced = [r for r in fleet.retired if not r["drained_clean"]]
+    assert len(forced) == 1
+
+
+def test_rollout_interrupt_still_converges(setup):
+    """The prebuilt fleet-rollout-chaos scenario: readiness flap + a
+    rollout-driver interrupt mid-transition. The level-triggered machine
+    re-derives its position and completes; zero requests lost."""
+    cfg, v1, v2 = setup
+    fleet = ServingFleet(
+        _factory(cfg, v1), 2,
+        probe=ProbeConfig(slow_start_steps=2),
+        router=Router(prefix_bucket_len=8), clock=FakeClock())
+    _warm(fleet)
+    rng = np.random.default_rng(24)
+    rids = {}
+
+    def feed(n=1):
+        for _ in range(n):
+            p = rng.integers(0, cfg.vocab_size, size=6).astype(np.int32)
+            r = fleet.submit(p, 3)
+            if isinstance(r, int):
+                rids[r] = p
+    feed(3)
+    fleet.start_rollout(_factory(cfg, v2), "v2",
+                        FleetRolloutPolicy(max_surge=1, canary_weight=0.2,
+                                           drain_timeout_s=None))
+    inj = scenarios.fleet_rollout_chaos().injector()
+    try:
+        with inj:
+            for _ in range(200):
+                feed(1)
+                fleet.step()
+                if fleet.rollout_phase is RolloutPhase.COMPLETE:
+                    break
+    finally:
+        chaos.uninstall()
+    assert fleet.rollout_phase is RolloutPhase.COMPLETE
+    assert fleet.stats["rollout_interrupts"] >= 1
+    assert fleet.stats["readiness_flaps"] >= 1
+    out = fleet.run()
+    assert set(out) == set(rids)
+    assert all(out[r].state is RequestState.DONE for r in rids)
+
+
+# ----------------------------------------------------------- prefix affinity
+def _prefix_workload(cfg, rng, n_prefixes=4, repeats=10, bucket=8):
+    prefixes = [rng.integers(0, cfg.vocab_size,
+                             size=bucket).astype(np.int32)
+                for _ in range(n_prefixes)]
+    work = []
+    for rep in range(repeats):
+        for pf in prefixes:
+            suffix = rng.integers(0, cfg.vocab_size,
+                                  size=4).astype(np.int32)
+            work.append(np.concatenate([pf, suffix]))
+    return work
+
+
+def test_prefix_affinity_beats_random_routing(setup):
+    """Acceptance: on a repeated-prefix workload, prefix-affinity routing
+    yields a strictly higher engine prefix-cache hit rate than random
+    routing (each replica's engine cache is warm for the buckets the
+    ring pins to it) — and every completion stays oracle-exact, proving
+    the hits are REAL engine prefix reuse, not bookkeeping."""
+    cfg, v1, _ = setup
+    rng = np.random.default_rng(31)
+    work = _prefix_workload(cfg, rng, n_prefixes=4, repeats=10, bucket=8)
+
+    rates = {}
+    fleets = {}
+    for mode in ("affinity", "random"):
+        fleet = _fleet(cfg, v1, 2, bucket=8, mode=mode)
+        _warm(fleet)
+        rids = {}
+        for p in work:
+            rid = fleet.submit(p, 3)
+            assert isinstance(rid, int)
+            rids[rid] = p
+        out = fleet.run()
+        assert all(out[r].ok for r in rids)
+        hits = fleet.stats["prefix_hits"]
+        misses = fleet.stats["prefix_misses"]
+        assert hits + misses == len(work)
+        rates[mode] = hits / (hits + misses)
+        fleets[mode] = (fleet, rids, out)
+
+    # affinity: each bucket prefills once fleet-wide; random: once per
+    # replica it happens to land on — strictly more cold prefills
+    assert rates["affinity"] > rates["random"], rates
+    # affinity pins every bucket to one replica -> exactly n_prefixes
+    # cold misses in total
+    fleet, rids, out = fleets["affinity"]
+    assert fleet.stats["prefix_misses"] == 4
+    # and the prefix-path completions match the solo oracle bit-for-bit
+    for rid, p in list(rids.items())[:6]:
+        np.testing.assert_array_equal(out[rid].tokens,
+                                      _want(cfg, v1, p, 3))
+
+
+# ------------------------------------------------------------- observability
+def test_observation_line_feeds_autoscaler_format(setup):
+    from tpu_on_k8s.controller.autoscaler import parse_observation
+
+    cfg, v1, _ = setup
+    fleet = _fleet(cfg, v1, 2)
+    _warm(fleet)
+    rng = np.random.default_rng(41)
+    for _ in range(4):
+        fleet.submit(rng.integers(0, cfg.vocab_size,
+                                  size=6).astype(np.int32), 3)
+    fleet.run()
+    obs = parse_observation(fleet.observation_line())
+    assert obs is not None
+    assert obs.latency > 0.0
+    assert obs.batch == fleet.stats["steps"]
+
+
+def test_prometheus_exposition_with_per_replica_labels(setup):
+    """Satellite: ServingMetrics and FleetMetrics render through the
+    metrics.serve() scrape body (`exposition`) — fleet series carry
+    per-replica labels, serving series render per replica instance."""
+    cfg, v1, _ = setup
+    fm = FleetMetrics()
+    fleet = _fleet(cfg, v1, 2, metrics=fm)
+    _warm(fleet)
+    rng = np.random.default_rng(42)
+    for _ in range(6):
+        assert isinstance(fleet.submit(
+            rng.integers(0, cfg.vocab_size, size=6).astype(np.int32), 3),
+            int)
+    fleet.run()
+
+    text = exposition(fm)
+    # labelled counters: requests routed per replica
+    assert 'tpu_on_k8s_fleet_requests_routed_total{replica="replica-0"}' \
+        in text
+    assert 'tpu_on_k8s_fleet_requests_routed_total{replica="replica-1"}' \
+        in text
+    # labelled gauges: per-replica load
+    assert 'tpu_on_k8s_fleet_in_flight{replica="replica-0"}' in text
+    assert 'tpu_on_k8s_fleet_queue_depth{replica="replica-1"}' in text
+    # fleet-wide gauges + rollout phase code
+    assert "tpu_on_k8s_fleet_replicas_ready 2.0" in text
+    assert "tpu_on_k8s_fleet_rollout_phase 0.0" in text
+
+    # each replica's ServingMetrics renders the serving series through the
+    # same scrape path
+    rep = fleet.replicas["replica-0"]
+    rep_text = exposition(rep.metrics)
+    assert "tpu_on_k8s_serving_requests_submitted_total" in rep_text
+    assert "tpu_on_k8s_serving_time_to_first_token_seconds_bucket" \
+        in rep_text
+    # mirror dicts stay readable without a scrape
+    assert fm.counters[("requests_routed", "replica-0")] > 0
+    assert fm.gauges[("replicas_ready", "")] == 2
+
+
+def test_drain_after_ejection_is_typed_and_survives_retired_gateways(setup):
+    """Regression: retired replicas release their engine/gateway; a
+    fleet-wide drain after an ejection must skip them, honor a cancel
+    that raced the ejection, and still account for every request."""
+    cfg, v1, _ = setup
+
+    class TickingClock(FakeClock):
+        def __call__(self) -> float:
+            self.t += 0.25
+            return self.t
+
+    fleet = _fleet(cfg, v1, 2, clock=TickingClock())
+    _warm(fleet)
+    rng = np.random.default_rng(55)
+    rids = [fleet.submit(rng.integers(0, cfg.vocab_size,
+                                      size=6).astype(np.int32), 40)
+            for _ in range(4)]
+    fleet.step()
+    assert fleet.cancel(rids[0])
+    inj = chaos.FaultInjector([chaos.FaultRule(
+        chaos.SITE_FLEET_REPLICA, chaos.Trigger(at=(1,)),
+        chaos.ReplicaCrash())])
+    try:
+        with inj:
+            fleet.step()                  # first active replica dies
+    finally:
+        chaos.uninstall()
+    assert fleet.stats["ejected"] == 1
+    ejected = next(r for r in fleet.replicas.values()
+                   if r.state is ReplicaState.EJECTED)
+    assert ejected.engine is None and ejected.gateway is None
+    out = fleet.drain(timeout_s=3.0)
+    assert set(out) == set(rids)          # zero silent loss through it all
+    assert all(out[r].state in (RequestState.DONE, RequestState.CANCELLED,
+                                RequestState.RETRY_EXHAUSTED)
+               for r in rids)
+    assert out[rids[0]].state is RequestState.CANCELLED
+
+
+def test_serve_load_fleet_mode_smoke(setup):
+    """Satellite: the load generator's --replicas path — deterministic
+    trace through the fleet, zero-silent-loss accounting, per-replica
+    TTFT/queue-wait breakdown in the summary."""
+    from tools.serve_load import build_workload, run_fleet_load
+
+    cfg, v1, _ = setup
+    fleet = _fleet(cfg, v1, 2, bucket=8)
+    _warm(fleet)
+    trace = build_workload(np.random.default_rng(7), 12, rate=3.0,
+                           vocab_size=cfg.vocab_size)
+    summary = run_fleet_load(fleet, trace)
+    accounted = (summary["served"] + summary["rejected"]
+                 + summary["deadline_exceeded"] + summary["cancelled"]
+                 + summary["retry_exhausted"])
+    assert accounted == 12
+    assert summary["replicas"] == 2
+    assert set(summary["per_replica"]) == {"replica-0", "replica-1"}
+    for rec in summary["per_replica"].values():
+        assert rec["state"] == "ready"
+        assert "ttft_ms_p50" in rec and "queue_wait_ms_p95" in rec
+    assert summary["ttft_ms_p50"] is not None
+
+
+def test_fleet_drain_timeout_is_typed(setup):
+    cfg, v1, _ = setup
+
+    class TickingClock(FakeClock):
+        def __call__(self) -> float:
+            self.t += 0.25
+            return self.t
+
+    fleet = _fleet(cfg, v1, 2, clock=TickingClock())
+    _warm(fleet)
+    rng = np.random.default_rng(43)
+    long_rid = fleet.submit(rng.integers(0, cfg.vocab_size,
+                                         size=6).astype(np.int32), 50)
+    short_rid = fleet.submit(rng.integers(0, cfg.vocab_size,
+                                          size=6).astype(np.int32), 3)
+    fleet.step()
+    out = fleet.drain(timeout_s=3.0)
+    assert out[short_rid].state in (RequestState.DONE,
+                                    RequestState.CANCELLED)
+    assert out[long_rid].state is RequestState.CANCELLED
+    assert 0 < out[long_rid].tokens.size < 50
+    rej = fleet.submit(np.arange(4, dtype=np.int32), 2)
+    assert isinstance(rej, Rejected)

@@ -411,6 +411,17 @@ def stage_serve_ttft(timeout):
                         "--rate", "1.5"], "serve_ttft", timeout)
 
 
+def stage_serve_fleet(timeout):
+    """The fleet headline (round-5 '#2 missed' decode/serving gap):
+    router + 2 replicas on the same seeded trace — aggregate tok/s plus
+    TTFT p50/p95 with the per-replica breakdown, so the fleet's routing
+    overhead and balance are measured on hardware, not asserted."""
+    return _json_stage([sys.executable, "tools/serve_load.py", "--bench",
+                        "--replicas", "2", "--n-slots", "4",
+                        "--n-requests", "48", "--rate", "1.5"],
+                       "serve_fleet", timeout)
+
+
 # (primary key, fn, timeout, extra result keys the stage also records —
 # a stage only counts as done when primary AND extras are error-free)
 STAGES = [
@@ -426,6 +437,7 @@ STAGES = [
     ("bench_data", stage_bench_data, 900, ()),
     ("continuous", stage_continuous, 1200, ("continuous_h8",)),
     ("serve_ttft", stage_serve_ttft, 1200, ()),
+    ("serve_fleet", stage_serve_fleet, 1200, ()),
 ]
 
 

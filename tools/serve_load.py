@@ -1,11 +1,12 @@
-"""Deterministic closed-loop load generator for the serving gateway.
+"""Deterministic closed-loop load generator for the serving gateway/fleet.
 
-Drives `tpu_on_k8s.serve.ServingGateway` with seeded Poisson arrivals and
+Drives `tpu_on_k8s.serve.ServingGateway` — or, with ``--replicas N``, a
+routed `tpu_on_k8s.serve.ServingFleet` — with seeded Poisson arrivals and
 mixed prompt/output lengths — the same workload every run for a given
 seed, so CI can assert on it (the fast smoke test in
 `tests/test_serve_gateway.py`) and the chip window can measure hardware
-TTFT/TPOT on a reproducible trace (`tools/chip_window.py` serve_ttft
-stage).
+TTFT/TPOT on a reproducible trace (`tools/chip_window.py` serve_ttft /
+serve_fleet stages).
 
 Closed loop: the generator is the driver — it submits each arrival at its
 assigned engine step, steps the gateway, and collects outcomes until every
@@ -15,8 +16,15 @@ independent of host speed.
 Usage:
     python tools/serve_load.py                        # tiny config, CPU-ok
     python tools/serve_load.py --bench --n-slots 8    # 350M flagship
+    python tools/serve_load.py --replicas 2           # fleet + router
+    python tools/serve_load.py --replicas 2 --soak \
+        --crash-replica 1 --crash-step 5              # `make fleet-soak`
 Prints one JSON summary line (throughput, outcome counts, TTFT/TPOT
-percentiles) — the shape chip_window's _json_stage records.
+percentiles; fleet mode adds a per-replica TTFT/queue-wait breakdown) —
+the shape chip_window's _json_stage records. ``--soak`` additionally
+asserts the zero-silent-loss accounting and prints
+``FLEET_SOAK_FAILED seed=N`` on any violation (exit 1) so a red run is
+replayable verbatim.
 """
 from __future__ import annotations
 
@@ -54,21 +62,36 @@ def build_workload(rng: np.random.Generator, n_requests: int, *,
                                              "tenant-c"),
                    vocab_size: int = 256,
                    deadline_s: Optional[float] = None,
-                   deadline_fraction: float = 0.0) -> List[Arrival]:
+                   deadline_fraction: float = 0.0,
+                   shared_prefixes: int = 0,
+                   shared_prefix_len: int = 0,
+                   shared_fraction: float = 0.0) -> List[Arrival]:
     """A reproducible trace: Poisson(``rate``) arrivals per engine step
     (the seeded ``rng`` is passed IN — the caller owns determinism), mixed
     uniform prompt/output lengths, tenants round-tripped through the same
-    rng. ``deadline_fraction`` of requests carry ``deadline_s``."""
+    rng. ``deadline_fraction`` of requests carry ``deadline_s``. With
+    ``shared_prefixes`` > 0, ``shared_fraction`` of requests prepend one
+    of that many fixed ``shared_prefix_len``-token prefixes (the
+    system-prompt shape real traffic has — what the fleet router's prefix
+    affinity exists to exploit; fully independent prompts would leave
+    that path structurally cold)."""
+    pool = [rng.integers(0, vocab_size,
+                         size=shared_prefix_len).astype(np.int32)
+            for _ in range(shared_prefixes)] if shared_prefix_len else []
     arrivals: List[Arrival] = []
     step = 0
     while len(arrivals) < n_requests:
         for _ in range(min(int(rng.poisson(rate)),
                            n_requests - len(arrivals))):
             lp = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
+            prompt = rng.integers(0, vocab_size, size=lp).astype(np.int32)
+            if pool and rng.random() < shared_fraction:
+                prompt = np.concatenate(
+                    [pool[int(rng.integers(len(pool)))], prompt])
             arrivals.append(Arrival(
                 step=step,
                 tenant=str(tenants[int(rng.integers(len(tenants)))]),
-                prompt=rng.integers(0, vocab_size, size=lp).astype(np.int32),
+                prompt=prompt,
                 max_new_tokens=int(rng.integers(new_tokens[0],
                                                 new_tokens[1] + 1)),
                 deadline_s=(deadline_s
@@ -143,6 +166,171 @@ def run_load(gateway, arrivals: List[Arrival],
     return summary
 
 
+def run_fleet_load(fleet, arrivals: List[Arrival],
+                   time_fn=time.perf_counter) -> dict:
+    """Drive the trace through a ``ServingFleet``: same closed loop as
+    ``run_load``, plus the per-replica TTFT/queue-wait breakdown (from
+    each replica's own ``ServingMetrics``) and the fleet's routing /
+    ejection / replay accounting."""
+    from tpu_on_k8s.serve.admission import Rejected
+
+    by_step: dict = {}
+    for a in arrivals:
+        by_step.setdefault(a.step, []).append(a)
+    outcomes: dict = {}
+    rejected = 0
+    t0 = time_fn()
+    step = 0
+    live = True
+    while by_step or live:
+        for a in by_step.pop(step, []):
+            r = fleet.submit(a.prompt, a.max_new_tokens, tenant=a.tenant,
+                             priority=a.priority, deadline_s=a.deadline_s)
+            if isinstance(r, Rejected):
+                rejected += 1
+        for rid in fleet.step():
+            res = fleet.result(rid)
+            if res is not None:
+                outcomes[rid] = res
+        live = fleet.queue_depth > 0 or fleet.has_live_requests
+        step += 1
+    dt = time_fn() - t0
+    states = [r.state.value for r in outcomes.values()]
+    total_tokens = sum(len(r.tokens) for r in outcomes.values())
+    all_ttft: List[float] = []
+    all_qw: List[float] = []
+    per_replica: dict = {}
+    for name, rep in sorted(fleet.replicas.items()):
+        m = rep.metrics
+        if m is None:
+            continue
+        ttft = list(m.histograms["time_to_first_token_seconds"])
+        qw = list(m.histograms["queue_wait_seconds"])
+        all_ttft += ttft
+        all_qw += qw
+        per_replica[name] = {
+            "routed": rep.routed,
+            "state": rep.state.value,
+            "ttft_ms_p50": _pctl(ttft, 0.50),
+            "ttft_ms_p95": _pctl(ttft, 0.95),
+            "queue_wait_ms_p50": _pctl(qw, 0.50),
+            "queue_wait_ms_p95": _pctl(qw, 0.95),
+        }
+    return {
+        "metric": "fleet_load_tokens_per_sec",
+        "value": round(total_tokens / dt, 1) if dt > 0 else None,
+        "unit": "tokens/s",
+        "replicas": len(fleet.replicas),
+        "requests": len(arrivals),
+        "served": states.count("done"),
+        "rejected": rejected,
+        "deadline_exceeded": states.count("deadline_exceeded"),
+        "cancelled": states.count("cancelled"),
+        "retry_exhausted": states.count("retry_exhausted"),
+        "rerouted": fleet.stats["rerouted"],
+        "ejected": fleet.stats["ejected"],
+        "prefix_hits": fleet.stats["prefix_hits"],
+        "prefix_misses": fleet.stats["prefix_misses"],
+        "tokens": total_tokens,
+        "driver_steps": step,
+        "wall_s": round(dt, 3),
+        "ttft_ms_p50": _pctl(all_ttft, 0.50),
+        "ttft_ms_p95": _pctl(all_ttft, 0.95),
+        "queue_wait_ms_p50": _pctl(all_qw, 0.50),
+        "queue_wait_ms_p95": _pctl(all_qw, 0.95),
+        "per_replica": per_replica,
+    }
+
+
+def _fleet_main(args, cfg, params, max_len) -> dict:
+    """``--replicas N`` mode: route the trace through a ServingFleet
+    (optionally crashing a replica mid-trace for the soak)."""
+    import jax
+
+    from tpu_on_k8s import chaos
+    from tpu_on_k8s.models.decode import _bucket_len
+    from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+    from tpu_on_k8s.serve import (
+        AdmissionConfig,
+        ProbeConfig,
+        Router,
+        ServingFleet,
+    )
+
+    def factory(name):
+        return ContinuousBatchingEngine(cfg, params, n_slots=args.n_slots,
+                                        max_len=max_len,
+                                        step_horizon=args.horizon)
+
+    fleet = ServingFleet(
+        factory, args.replicas,
+        admission=AdmissionConfig(max_queue_depth=args.queue_bound),
+        probe=ProbeConfig(slow_start_steps=1),
+        router=Router(prefix_bucket_len=args.prefix_bucket),
+        clock=time.monotonic)
+    rng = np.random.default_rng(args.seed)
+    arrivals = build_workload(
+        rng, args.n_requests, rate=args.rate,
+        prompt_lens=(args.prompt_min, args.prompt_max),
+        new_tokens=(args.new_min, args.new_max),
+        vocab_size=cfg.vocab_size,
+        deadline_s=args.deadline_s or None,
+        deadline_fraction=args.deadline_fraction,
+        shared_prefixes=args.shared_prefixes,
+        shared_prefix_len=args.prefix_bucket if args.shared_prefixes
+        else 0,
+        shared_fraction=args.shared_fraction)
+    # warm every replica's compile caches off-trace (same guard as the
+    # single-gateway path) and earn readiness
+    buckets = sorted({_bucket_len(int(a.prompt.size),
+                                  next(iter(fleet.replicas.values()))
+                                  .engine.max_len)
+                      for a in arrivals})
+    for rep in fleet.replicas.values():
+        for bucket in buckets:
+            lp = min(bucket, rep.engine.max_len - 2)
+            for _ in range(7):
+                rep.gateway.submit(rng.integers(
+                    0, cfg.vocab_size, size=lp).astype(np.int32), 2)
+            rep.gateway.run()
+        if rep.metrics is not None:
+            rep.metrics.histograms.clear()
+    for _ in range(3):
+        fleet.step()
+
+    inj = None
+    if args.crash_replica >= 0:
+        inj = chaos.FaultInjector([chaos.FaultRule(
+            chaos.SITE_FLEET_REPLICA,
+            chaos.Trigger(at=(args.crash_step,),
+                          match={"replica": f"replica-{args.crash_replica}"}),
+            chaos.ReplicaCrash(),
+            note=f"soak: crash replica-{args.crash_replica}")],
+            seed=args.seed, name="fleet-soak")
+        chaos.install(inj)
+    try:
+        summary = run_fleet_load(fleet, arrivals)
+    finally:
+        if inj is not None:
+            chaos.uninstall(inj)
+    if args.soak:
+        accounted = (summary["served"] + summary["rejected"]
+                     + summary["deadline_exceeded"] + summary["cancelled"]
+                     + summary["retry_exhausted"])
+        ok = accounted == args.n_requests
+        if args.crash_replica >= 0:
+            ok = ok and summary["ejected"] >= 1
+        summary["soak_ok"] = ok
+        if not ok:
+            print(json.dumps(summary))
+            print(f"FLEET_SOAK_FAILED seed={args.seed} "
+                  f"accounted={accounted}/{args.n_requests}")
+            raise SystemExit(1)
+        print(f"FLEET_SOAK_OK seed={args.seed}", file=sys.stderr)
+    print(json.dumps(summary))
+    return summary
+
+
 def main(argv=None) -> dict:
     import jax
     import jax.numpy as jnp
@@ -171,6 +359,29 @@ def main(argv=None) -> dict:
     p.add_argument("--deadline-fraction", type=float, default=0.0)
     p.add_argument("--horizon", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--replicas", type=int, default=0,
+                   help=">0: route the trace through a ServingFleet of "
+                        "this many replicas (router + per-replica "
+                        "TTFT/queue-wait breakdown)")
+    p.add_argument("--prefix-bucket", type=int, default=128,
+                   help="router prefix-affinity bucket length "
+                        "(with --replicas)")
+    p.add_argument("--shared-prefixes", type=int, default=3,
+                   help="fixed system prompts (of --prefix-bucket tokens) "
+                        "a --shared-fraction of fleet requests prepend — "
+                        "0 leaves the affinity path structurally cold")
+    p.add_argument("--shared-fraction", type=float, default=0.6,
+                   help="fraction of fleet requests carrying a shared "
+                        "prefix")
+    p.add_argument("--soak", action="store_true",
+                   help="assert zero-silent-loss accounting; print "
+                        "FLEET_SOAK_FAILED seed=N and exit 1 on violation")
+    p.add_argument("--crash-replica", type=int, default=-1,
+                   help=">=0: chaos-crash replica-N mid-trace "
+                        "(with --replicas)")
+    p.add_argument("--crash-step", type=int, default=5,
+                   help="fleet step (per replica, 1-based) the crash "
+                        "fires on")
     args = p.parse_args(argv)
 
     if args.bench:
@@ -187,6 +398,9 @@ def main(argv=None) -> dict:
     params = model.init(jax.random.key(0), probe)["params"]
     if args.bench:
         params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+
+    if args.replicas > 0:
+        return _fleet_main(args, cfg, params, max_len)
 
     metrics = ServingMetrics()
     engine = ContinuousBatchingEngine(cfg, params, n_slots=args.n_slots,

@@ -12,6 +12,7 @@ API_VERSION = "v1alpha1"
 KIND_TPUJOB = "TPUJob"
 KIND_MODEL = "Model"
 KIND_MODELVERSION = "ModelVersion"
+KIND_INFERENCESERVICE = "InferenceService"
 
 # ---- labels (selector surface) ------------------------------------------------
 LABEL_JOB_NAME = "tpujob.distributed.tpu.io/job-name"
@@ -22,6 +23,12 @@ LABEL_TASK_ROLE = "task-role"
 LABEL_JOB_GENERATION = "distributed.tpu.io/job-generation"
 LABEL_SPOT_TASK = "distributed.tpu.io/spot-task"
 LABEL_MODEL_NAME = "model.distributed.tpu.io/model-name"
+# serving fleet (controller/inferenceservice.py): pods of one InferenceService,
+# grouped by the image generation they run (label values forbid '/' and ':',
+# so the image rides an annotation and a short content hash rides the label)
+LABEL_INFERENCESERVICE_NAME = "serving.distributed.tpu.io/inference-service-name"
+LABEL_SERVING_IMAGE_HASH = "serving.distributed.tpu.io/image-hash"
+LABEL_SERVING_REPLICA_INDEX = "serving.distributed.tpu.io/replica-index"
 
 # ---- annotations (protocol surface) -------------------------------------------
 ANNOTATION_NETWORK_MODE = "distributed.tpu.io/network-mode"
@@ -51,6 +58,12 @@ ANNOTATION_SLICE_RESTART_FOR = "distributed.tpu.io/slice-restart-for"
 # keeps re-driving a pending restart; this annotation stops the respec
 # write itself from repeating on every pass in between.
 ANNOTATION_RESPEC_GENERATION = "distributed.tpu.io/respec-generation"
+# serving rollout drain protocol (controller/inferenceservice.py): an
+# old-version replica pod is marked draining (the serve plane's
+# stop_accepting) with an absolute controller-clock deadline; the pod is
+# only deleted once the deadline passes, so in-flight requests finish
+ANNOTATION_SERVING_DRAIN_DEADLINE = "serving.distributed.tpu.io/drain-deadline"
+ANNOTATION_SERVING_IMAGE = "serving.distributed.tpu.io/image"
 # gang scheduler podgroup binding (reference: scheduling.k8s.io/group-name,
 # /root/reference/pkg/gangscheduler/volcano/volcano.go:238-287)
 ANNOTATION_GANG_GROUP_NAME = "scheduling.k8s.io/group-name"

@@ -1,0 +1,126 @@
+"""InferenceService API type: the serving half of the model pipeline.
+
+The reference operator's serving story ends the moment the trained
+artifact is baked into an OCI image (SURVEY §3.5 — ModelVersion sets
+``Model.status.latest_image`` and stops). An ``InferenceService`` is the
+missing kind that *deploys* that image: a declarative request for N
+engine replicas on TPU slices, following a ``Model``'s latest image, with
+a rollout policy that governs how traffic and capacity move when a new
+``ModelVersion`` lands.
+
+Two planes consume this type:
+
+* `controller/inferenceservice.py` reconciles gang-scheduled replica pods
+  from the spec (one gang of ``hosts_per_slice`` pods per replica) and
+  drives the rolling rollout — surge within ``max_surge``, drain old
+  replicas before deletion, never dip below
+  ``replicas - max_unavailable`` ready;
+* `serve/fleet.py` is the in-process realization of the same state
+  machine: a ``ServingFleet`` owning one gateway per replica and a
+  ``Router`` that honors the canary weight while a rollout progresses.
+
+``RolloutPolicy`` deliberately mirrors a Deployment's rollingUpdate knobs
+(maxSurge / maxUnavailable) plus the serving-specific ``canary_weight`` —
+the traffic share the FIRST ready new-version replica receives before the
+fleet commits to shifting the rest.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import ObjectMeta
+from tpu_on_k8s.api.types import TPUPolicy
+
+
+@dataclass
+class RolloutPolicy:
+    """How a new model image replaces the old one under live traffic.
+
+    ``max_surge`` extra replicas may exist above ``spec.replicas`` during
+    a rollout (capacity first, then traffic); at most ``max_unavailable``
+    of the desired replicas may be not-ready at any instant.
+    ``canary_weight`` is the router share granted to the new version once
+    its first replica is ready — held until more new replicas come up,
+    after which the share grows with the replaced fraction.
+    ``drain_seconds`` is the grace an old replica gets between
+    stop-accepting and deletion (the serving analog of
+    terminationGracePeriodSeconds)."""
+
+    max_surge: int = 1
+    max_unavailable: int = 0
+    canary_weight: float = 0.1
+    drain_seconds: float = 30.0
+
+    def normalized(self) -> "RolloutPolicy":
+        """Defaulted-and-clamped copy (API types stay passive records, like
+        the reference's defaulting webhook shape): surge/unavailable floors
+        at 0, surge forced to >= 1 when both knobs are 0 (a rollout that can
+        neither add nor remove a replica would wedge), canary weight clamped
+        to [0, 1], drain floored at 0."""
+        surge = max(int(self.max_surge), 0)
+        unavail = max(int(self.max_unavailable), 0)
+        if surge == 0 and unavail == 0:
+            surge = 1
+        return RolloutPolicy(
+            max_surge=surge, max_unavailable=unavail,
+            canary_weight=min(max(float(self.canary_weight), 0.0), 1.0),
+            drain_seconds=max(float(self.drain_seconds), 0.0))
+
+
+@dataclass
+class InferenceServiceSpec:
+    """``model_name`` follows that Model's ``status.latest_image`` (the
+    closed train → image → deploy loop); ``image`` pins an explicit image
+    instead (and wins when both are set). ``tpu_policy`` is the slice
+    each replica occupies — a replica is one gang of ``hosts_per_slice``
+    pods. ``n_slots`` / ``prefix_bucket_len`` parameterize the engine and
+    router inside each replica (the serve plane reads them; the
+    controller passes them through as env)."""
+
+    model_name: str = ""
+    image: str = ""
+    replicas: int = 1
+    tpu_policy: TPUPolicy = field(default_factory=TPUPolicy)
+    rollout: RolloutPolicy = field(default_factory=RolloutPolicy)
+    n_slots: int = 8
+    prefix_bucket_len: int = 128
+
+
+class ServicePhase(str, enum.Enum):
+    PENDING = "Pending"            # no image to deploy yet
+    PROGRESSING = "Progressing"    # scaling or rolling a new image
+    READY = "Ready"                # all desired replicas on current image
+    DEGRADED = "Degraded"          # ready count below the rollout floor
+
+
+@dataclass
+class InferenceServiceStatus:
+    """``current_image`` is what the fleet is converging FROM,
+    ``target_image`` what it is converging TO (equal once a rollout
+    completes). ``canary_weight`` is the router share currently granted
+    to ``target_image`` — 0 before the first new replica is ready, 1.0
+    at completion — the single number the serve plane needs to split
+    traffic consistently with the controller's rollout position."""
+
+    phase: Optional[ServicePhase] = None
+    message: str = ""
+    current_image: str = ""
+    target_image: str = ""
+    replicas: int = 0              # replica gangs that exist (any version)
+    ready_replicas: int = 0        # replica gangs fully Running+Ready
+    updated_replicas: int = 0      # replica gangs on target_image
+    canary_weight: float = 0.0
+    observed_model_version: str = ""
+
+
+@dataclass
+class InferenceService:
+    api_version: str = f"{constants.API_GROUP}/{constants.API_VERSION}"
+    kind: str = constants.KIND_INFERENCESERVICE
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: InferenceServiceSpec = field(default_factory=InferenceServiceSpec)
+    status: InferenceServiceStatus = field(
+        default_factory=InferenceServiceStatus)

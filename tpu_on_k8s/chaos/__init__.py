@@ -28,6 +28,8 @@ typically via ``with FaultInjector(rules, seed=s):``.
 from tpu_on_k8s.chaos.faults import (
     SITE_APISERVER_REQUEST,
     SITE_APISERVER_WATCH,
+    SITE_FLEET_REPLICA,
+    SITE_FLEET_ROLLOUT,
     SITE_RECONCILE,
     SITE_REST_REQUEST,
     SITE_REST_WATCH_CONNECT,
@@ -46,6 +48,9 @@ from tpu_on_k8s.chaos.faults import (
     HttpError,
     PodFail,
     PreemptNotice,
+    ReadinessFlap,
+    ReplicaCrash,
+    RolloutInterrupt,
     SaveFailure,
     SlicePreempt,
     StepFailure,
@@ -68,6 +73,8 @@ from tpu_on_k8s.chaos.injector import (
 __all__ = [
     "SITE_APISERVER_REQUEST",
     "SITE_APISERVER_WATCH",
+    "SITE_FLEET_REPLICA",
+    "SITE_FLEET_ROLLOUT",
     "SITE_RECONCILE",
     "SITE_REST_REQUEST",
     "SITE_REST_WATCH_CONNECT",
@@ -88,6 +95,9 @@ __all__ = [
     "HttpError",
     "PodFail",
     "PreemptNotice",
+    "ReadinessFlap",
+    "ReplicaCrash",
+    "RolloutInterrupt",
     "SaveFailure",
     "SlicePreempt",
     "StepFailure",

@@ -16,6 +16,8 @@ API.
 | apiserver.watch        | apiserver._stream_watch (per frame) | WatchDrop |
 | controller.reconcile   | JobEngine.reconcile                 | PodFail, SlicePreempt |
 | serve.engine.step      | ContinuousBatchingEngine.step       | EngineCrash, EngineStall |
+| serve.fleet.replica    | ServingFleet.step (per replica)     | ReplicaCrash, ReadinessFlap |
+| serve.fleet.rollout    | ServingFleet rollout transitions    | RolloutInterrupt |
 | train.step             | TrainLoop.run (per dispatch)        | StepFailure |
 | train.save             | TrainLoop._enqueue_save             | SaveFailure |
 | train.preempt          | TrainLoop.run (per iteration)       | PreemptNotice |
@@ -37,6 +39,8 @@ SITE_APISERVER_REQUEST = "apiserver.request"
 SITE_APISERVER_WATCH = "apiserver.watch"
 SITE_RECONCILE = "controller.reconcile"
 SITE_SERVE_STEP = "serve.engine.step"
+SITE_FLEET_REPLICA = "serve.fleet.replica"
+SITE_FLEET_ROLLOUT = "serve.fleet.rollout"
 SITE_TRAIN_STEP = "train.step"
 SITE_TRAIN_SAVE = "train.save"
 SITE_TRAIN_PREEMPT = "train.preempt"
@@ -164,6 +168,39 @@ class EngineStall(Fault):
     a hung collective. Drain timeouts are the recovery under test."""
 
     kind: ClassVar[str] = "engine_stall"
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaCrash(Fault):
+    """A whole serving replica dies (pod kill / VM preemption — harder
+    than ``EngineCrash``, which the replica's own gateway replays in
+    place): the fleet must EJECT the replica and re-route every one of
+    its live requests through a surviving replica, reusing the
+    ``ReplayPolicy`` budget — zero silent loss, same typed outcomes.
+    Matched by ``replica`` in the site ctx to target one replica."""
+
+    kind: ClassVar[str] = "replica_crash"
+
+
+@dataclasses.dataclass(frozen=True)
+class ReadinessFlap(Fault):
+    """The replica's readiness probe fails for ``steps`` fleet steps: the
+    router must stop sending it NEW traffic (in-flight work keeps
+    decoding) and only resume after the replica re-earns its slow-start
+    streak — a flapping replica must not oscillate at full weight."""
+
+    steps: int = 2
+    kind: ClassVar[str] = "readiness_flap"
+
+
+@dataclasses.dataclass(frozen=True)
+class RolloutInterrupt(Fault):
+    """The rollout driver is interrupted mid-transition (controller
+    restart / lost leadership): transient surge state is discarded and
+    the state machine must re-derive its position and still converge —
+    with every in-flight request reaching a typed terminal state."""
+
+    kind: ClassVar[str] = "rollout_interrupt"
 
 
 @dataclasses.dataclass(frozen=True)

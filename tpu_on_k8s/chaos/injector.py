@@ -45,8 +45,9 @@ class Trigger:
     * ``limit`` — cap total fires (``at`` implies ``len(at)``).
     * ``match`` — ctx filter: every key must be present in the call's ctx
       and equal after ``str()`` — except that a string value matches as a
-      substring of a string ctx value (so ``{"path": "/pods"}`` matches
-      any pod route).
+      boundary-anchored substring of a string ctx value (so
+      ``{"path": "/pods"}`` matches any pod route, but
+      ``{"replica": "replica-1"}`` does NOT match ``replica-10``).
     """
 
     at: Tuple[int, ...] = ()
@@ -104,13 +105,39 @@ class _RuleState:
         self.fired = 0
 
 
+def _substr_on_boundaries(want: str, have: str) -> bool:
+    """``want`` occurs in ``have`` with non-alphanumeric (or string-edge)
+    characters on both sides. Plain substring matching would make a rule
+    for ``replica-1`` also hit ``replica-10`` — mistargeting the fault
+    AND corrupting the per-rule invocation count its ``at=`` trigger
+    indexes. Boundary-anchored matching keeps the path-fragment use case
+    (``/pods`` inside ``/api/v1/namespaces/default/pods``) working while
+    names that merely share a prefix no longer collide."""
+    if not want:
+        return True
+    start = have.find(want)
+    while start != -1:
+        end = start + len(want)
+        # an edge is a boundary when the adjacent outside char OR the
+        # pattern's own edge char is non-alphanumeric ("/pods" carries
+        # its left boundary with it)
+        pre = (start == 0 or not have[start - 1].isalnum()
+               or not want[0].isalnum())
+        post = (end == len(have) or not have[end].isalnum()
+                or not want[-1].isalnum())
+        if pre and post:
+            return True
+        start = have.find(want, start + 1)
+    return False
+
+
 def _ctx_matches(match: Mapping[str, object], ctx: Mapping[str, object]) -> bool:
     for key, want in match.items():
         if key not in ctx:
             return False
         have = ctx[key]
         if isinstance(want, str) and isinstance(have, str):
-            if want not in have:
+            if not _substr_on_boundaries(want, have):
                 return False
         elif str(want) != str(have):
             return False

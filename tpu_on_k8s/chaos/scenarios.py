@@ -102,6 +102,43 @@ def engine_crash_mid_decode(at_steps: Tuple[int, ...] = (3,), *,
     ), seed)
 
 
+def replica_crash_mid_decode(replica: str = "replica-1", *,
+                             at_steps: Tuple[int, ...] = (3,),
+                             seed: int = 0) -> Scenario:
+    """Kill one serving-fleet replica on these fleet steps (counted per
+    replica per ``fleet.step()``). Harder than ``engine_crash_mid_decode``:
+    the replica is GONE, not resettable. Recovery under test: the fleet
+    ejects it and re-routes every live request through a survivor under
+    the ``ReplayPolicy`` budget — every request still reaches a typed
+    terminal state (done / retry_exhausted), zero silent loss."""
+    return Scenario("replica-crash", (
+        FaultRule(faults.SITE_FLEET_REPLICA,
+                  Trigger(at=at_steps, match={"replica": replica}),
+                  faults.ReplicaCrash(),
+                  note=f"crash {replica} mid-decode"),
+    ), seed)
+
+
+def fleet_rollout_chaos(*, flap_replica: str = "replica-0",
+                        flap_at: int = 2, flap_steps: int = 3,
+                        interrupt_at: Tuple[int, ...] = (4,),
+                        seed: int = 0) -> Scenario:
+    """A rollout under weather: one replica's readiness flaps (the router
+    must pull it out of rotation and slow-start it back) and the rollout
+    driver is interrupted mid-transition (transient surge state lost; the
+    level-triggered machine must re-derive its position). Recovery under
+    test: the rollout still completes with every request terminal."""
+    return Scenario("fleet-rollout-chaos", (
+        FaultRule(faults.SITE_FLEET_REPLICA,
+                  Trigger(at=(flap_at,), match={"replica": flap_replica}),
+                  faults.ReadinessFlap(steps=flap_steps),
+                  note=f"flap {flap_replica} readiness"),
+        FaultRule(faults.SITE_FLEET_ROLLOUT, Trigger(at=interrupt_at),
+                  faults.RolloutInterrupt(),
+                  note="interrupt the rollout driver"),
+    ), seed)
+
+
 def train_preemption(at_step: int, *, fail_save: bool = False,
                      seed: int = 0) -> Scenario:
     """Deliver a SIGTERM-style preemption notice before training step

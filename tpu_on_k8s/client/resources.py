@@ -25,6 +25,7 @@ from tpu_on_k8s.api.core import (
     Service,
 )
 from tpu_on_k8s.api.crr import ContainerRecreateRequest
+from tpu_on_k8s.api.inference_types import InferenceService
 from tpu_on_k8s.api.model_types import Model, ModelVersion
 from tpu_on_k8s.api.types import TPUJob
 
@@ -91,6 +92,8 @@ def _build() -> Tuple[Dict[str, ResourceType], Dict[Tuple[str, str], ResourceTyp
         ResourceType(constants.KIND_MODEL, Model, tpu_group, tpu_ver, "models"),
         ResourceType(constants.KIND_MODELVERSION, ModelVersion, tpu_group,
                      tpu_ver, "modelversions"),
+        ResourceType(constants.KIND_INFERENCESERVICE, InferenceService,
+                     tpu_group, tpu_ver, "inferenceservices"),
     ]
     return ({r.kind: r for r in rows},
             {(r.group, r.plural): r for r in rows})

@@ -27,6 +27,9 @@ from tpu_on_k8s.controller.autoscaler import setup_elastic_autoscaler
 from tpu_on_k8s.controller.config import JobControllerConfig
 from tpu_on_k8s.controller.elastic import ElasticController
 from tpu_on_k8s.controller.failover import CRRRestarter, InMemoryRestarter
+from tpu_on_k8s.controller.inferenceservice import (
+    setup_inferenceservice_controller,
+)
 from tpu_on_k8s.controller.modelversion import setup_modelversion_controller
 from tpu_on_k8s.controller.runtime import Manager
 from tpu_on_k8s.controller.tpujob import setup_tpujob_controller
@@ -262,6 +265,8 @@ class Operator:
         self.autoscaler = setup_elastic_autoscaler(
             self.cluster, config=self.config, metrics=self.metrics)
         self.modelversion = setup_modelversion_controller(
+            self.cluster, self.manager, config=self.config)
+        self.inferenceservice = setup_inferenceservice_controller(
             self.cluster, self.manager, config=self.config)
         self.scheduler_loop = None
         if getattr(args, "enable_slice_scheduler", False):

@@ -215,9 +215,83 @@ class TrainMetrics(_MetricsBase):
                     registry=registry)
 
 
+class FleetMetrics(_MetricsBase):
+    """Serving-fleet observability (`tpu_on_k8s/serve/fleet.py`): the
+    router/rollout layer above per-replica ``ServingMetrics``. Counters
+    and gauges carry a ``replica`` label so one scrape shows the whole
+    fleet's balance (in-flight per replica, routed/rerouted per replica)
+    next to the fleet-wide rollout state — the per-replica breakdown an
+    operator needs to see a hot replica or a stuck drain. Mirror dicts
+    key by ``(name, replica)`` like ``JobMetrics`` keys by label."""
+
+    #: rollout phase gauge encoding (stable — lands in dashboards)
+    ROLLOUT_PHASE_CODES = {"idle": 0, "surging": 1, "shifting": 2,
+                           "draining": 3, "complete": 4}
+
+    _LABELED_COUNTERS = ("requests_routed", "requests_rerouted")
+    _PLAIN_COUNTERS = ("replicas_ejected", "prefix_cache_hits",
+                       "prefix_cache_misses", "rollout_interrupts",
+                       "rollouts_completed", "readiness_flaps")
+    _LABELED_GAUGES = ("in_flight", "queue_depth", "outstanding_tokens")
+    _PLAIN_GAUGES = ("replicas_ready", "replicas_total", "rollout_phase")
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        self.counters: Dict[Tuple[str, str], int] = defaultdict(int)
+        self.gauges: Dict[Tuple[str, str], float] = {}
+        if _prom is not None:
+            registry = registry or _prom.CollectorRegistry()
+            self.registry = registry
+            ns = "tpu_on_k8s_fleet"
+            for name in self._LABELED_COUNTERS:
+                self._prom_counters[name] = _prom.Counter(
+                    f"{ns}_{name}", f"Fleet {name}", ["replica"],
+                    registry=registry)
+            for name in self._PLAIN_COUNTERS:
+                self._prom_counters[name] = _prom.Counter(
+                    f"{ns}_{name}", f"Fleet {name}", registry=registry)
+            for name in self._LABELED_GAUGES:
+                self._prom_gauges[name] = _prom.Gauge(
+                    f"{ns}_{name}", f"Fleet {name}", ["replica"],
+                    registry=registry)
+            for name in self._PLAIN_GAUGES:
+                self._prom_gauges[name] = _prom.Gauge(
+                    f"{ns}_{name}", f"Fleet {name}", registry=registry)
+
+    def inc(self, name: str, n: int = 1, replica: str = "") -> None:
+        with self._lock:
+            self.counters[(name, replica)] += n
+        c = self._prom_counters.get(name)
+        if c is not None:
+            (c.labels(replica) if name in self._LABELED_COUNTERS
+             else c).inc(n)
+
+    def set_gauge(self, name: str, value: float, replica: str = "") -> None:
+        with self._lock:
+            self.gauges[(name, replica)] = value
+        g = self._prom_gauges.get(name)
+        if g is not None:
+            (g.labels(replica) if name in self._LABELED_GAUGES
+             else g).set(value)
+
+    def set_rollout_phase(self, phase: str) -> None:
+        self.set_gauge("rollout_phase",
+                       self.ROLLOUT_PHASE_CODES.get(phase, -1))
+
+
+def exposition(metrics) -> str:
+    """The Prometheus text-format scrape body for any metrics instance
+    (what ``serve()``'s endpoint returns) — separated out so tests and
+    push-style exporters can render without binding a port."""
+    if _prom is None or metrics.registry is None:
+        raise RuntimeError("prometheus_client unavailable")
+    return _prom.generate_latest(metrics.registry).decode()
+
+
 def serve(metrics, port: int = 8443):  # pragma: no cover - live mode
     """Expose /metrics (reference pkg/metrics/server.go:29-37) for a
-    ``JobMetrics`` or ``ServingMetrics`` instance."""
+    ``JobMetrics``, ``ServingMetrics``, or ``FleetMetrics`` instance
+    (the scrape body is ``exposition(metrics)``)."""
     if _prom is None or metrics.registry is None:
         raise RuntimeError("prometheus_client unavailable")
     return _prom.start_http_server(port, registry=metrics.registry)

@@ -1,4 +1,5 @@
-"""The serving request plane: admission, fairness, lifecycle, gateway.
+"""The serving request plane: admission, fairness, lifecycle, gateway —
+and the fleet layer above them.
 
 ``ContinuousBatchingEngine`` (`tpu_on_k8s/models/serving.py`) is the
 compute plane — oracle-exact continuous batching over one compiled step
@@ -9,30 +10,51 @@ program. This package is the missing layer between that and a service:
 * `scheduler`  — priority lanes + smooth-WRR tenant fairness (the
   coordinator's own policy core, reused);
 * `lifecycle`  — request states, deadlines, cancellation, drain;
-* `gateway`    — ``ServingGateway``, the single front door tying them
-  together.
+* `gateway`    — ``ServingGateway``, the single front door to ONE engine;
+* `router`     — prefix-affinity consistent hashing + bounded-load
+  least-outstanding-tokens + weighted canary splits;
+* `health`     — replica readiness (slow start) and liveness probes;
+* `fleet`      — ``ServingFleet``: many replicas behind one routed front
+  door, with ejection + cross-replica replay and zero-loss rolling
+  rollouts (the serve-plane twin of `controller/inferenceservice.py`).
 """
 from tpu_on_k8s.serve.admission import (
     AdmissionConfig,
     AdmissionController,
     Rejected,
 )
+from tpu_on_k8s.serve.fleet import (
+    FleetRolloutPolicy,
+    Replica,
+    RolloutPhase,
+    ServingFleet,
+)
 from tpu_on_k8s.serve.gateway import ReplayPolicy, ServingGateway
+from tpu_on_k8s.serve.health import HealthMonitor, ProbeConfig, ReplicaState
 from tpu_on_k8s.serve.lifecycle import (
     GatewayRequest,
     RequestResult,
     RequestState,
 )
+from tpu_on_k8s.serve.router import Router
 from tpu_on_k8s.serve.scheduler import FairScheduler
 
 __all__ = [
     "AdmissionConfig",
     "AdmissionController",
     "FairScheduler",
+    "FleetRolloutPolicy",
     "GatewayRequest",
+    "HealthMonitor",
+    "ProbeConfig",
     "Rejected",
+    "Replica",
+    "ReplicaState",
     "ReplayPolicy",
     "RequestResult",
     "RequestState",
+    "RolloutPhase",
+    "Router",
+    "ServingFleet",
     "ServingGateway",
 ]

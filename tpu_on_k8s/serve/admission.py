@@ -36,6 +36,9 @@ REASON_LOAD_SHED = "load_shed"
 REASON_QUOTA = "quota"
 REASON_DEADLINE = "deadline"
 REASON_DRAINING = "draining"
+# fleet-level (serve/fleet.py): no replica is READY to take the request —
+# every replica starting, draining, flapped, or ejected
+REASON_UNAVAILABLE = "unavailable"
 
 
 @dataclasses.dataclass(frozen=True)

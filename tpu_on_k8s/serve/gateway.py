@@ -519,3 +519,10 @@ class ServingGateway:
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._sched)
+
+    @property
+    def has_live_requests(self) -> bool:
+        """Public drain/removal gate: True while any accepted request has
+        not reached a terminal state (what a fleet checks before removing
+        a drained replica)."""
+        return self._live()
