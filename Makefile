@@ -6,13 +6,14 @@
 # docker-build produces.
 IMG ?= tpu-on-k8s/manager:latest
 
-.PHONY: test test-fast chaos-soak fleet-soak native bench dryrun manager \
-        samples clean docker-build docker-push deploy undeploy
+.PHONY: test test-fast chaos-soak fleet-soak autoscale-soak native bench \
+        dryrun manager samples clean docker-build docker-push deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
 # CHAOS_SOAK_FAILED seed=... on any failure
 CHAOS_SEED ?= 1234
 FLEET_SEED ?= 4321
+AUTOSCALE_SEED ?= 2468
 
 test:
 	python -m pytest tests/ -q
@@ -28,6 +29,11 @@ fleet-soak:  ## 2-replica routed fleet under a crash mid-trace: zero-silent-loss
 	JAX_PLATFORMS=cpu python tools/serve_load.py --replicas 2 --soak \
 	    --n-requests 48 --rate 2.0 --prefix-bucket 8 \
 	    --crash-replica 1 --crash-step 5 --seed $(FLEET_SEED)
+
+autoscale-soak:  ## SLO autoscaler on a bursty trace, twice: byte-identical decision logs
+	JAX_PLATFORMS=cpu python tools/serve_load.py --autoscale --soak \
+	    --n-requests 72 --rate 1.0 --burst-start 6 --burst-len 10 \
+	    --burst-rate 6.0 --seed $(AUTOSCALE_SEED)
 
 native:  ## build the C++ data pipeline explicitly (also built lazily on import)
 	g++ -O2 -std=c++17 -shared -fPIC \

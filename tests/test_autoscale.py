@@ -1,0 +1,843 @@
+"""The SLO-driven serving autoscaler (`tpu_on_k8s/autoscale/` +
+`controller/fleetautoscaler.py` + `ServingFleet.scale_to`):
+
+* signal layer: windowed p95 aggregation, delta scraping, the staleness
+  contract (a dead scrape is "no data", never "zero load");
+* policy: slice-legal target tracking with hysteresis, separate up/down
+  cooldowns, flap damping, severity-bounded steps, warm floor;
+* fleet execution: scale-up slow-starts, scale-down drains first and
+  reaps only empty replicas — zero silent loss, ready floor held;
+* the deterministic end-to-end loop: a seeded bursty trace through
+  ServingFleet + FleetAutoscaler scales up on SLO breach and back down
+  after the burst — every transition slice-legal, no decision during
+  cooldown, byte-identical decision logs across runs, and the
+  `autoscale_under_crash` chaos scenario converging without thrash;
+* the CRD plane: pod-log observation lines → spec.replicas patch → the
+  InferenceService reconciler surging real replica gangs.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_on_k8s import chaos
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import ObjectMeta, Pod
+from tpu_on_k8s.api.inference_types import (
+    AutoscalePolicy,
+    InferenceService,
+    InferenceServiceSpec,
+)
+from tpu_on_k8s.api.model_types import Model, ModelStatus
+from tpu_on_k8s.api.types import TPUPolicy
+from tpu_on_k8s.autoscale import (
+    FleetObservation,
+    FleetSample,
+    FleetScraper,
+    Recommender,
+    SignalAggregator,
+    dead_sample,
+    sample_from_line,
+)
+from tpu_on_k8s.chaos import scenarios
+from tpu_on_k8s.client import InMemoryCluster, KubeletSim
+from tpu_on_k8s.controller.autoscaler import parse_observation
+from tpu_on_k8s.controller.config import JobControllerConfig
+from tpu_on_k8s.controller.fleetautoscaler import (
+    FleetAutoscaler,
+    setup_fleet_autoscaler,
+)
+from tpu_on_k8s.controller.inferenceservice import (
+    setup_inferenceservice_controller,
+)
+from tpu_on_k8s.controller.runtime import Manager
+from tpu_on_k8s.gang import topology
+from tpu_on_k8s.metrics.metrics import AutoscaleMetrics, exposition
+from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+from tpu_on_k8s.serve import (
+    ProbeConfig,
+    Rejected,
+    ReplicaState,
+    Router,
+    ServingFleet,
+)
+
+ACC = "tpu-v5-lite-podslice"   # legal host counts: 1, 2, 4, 8, 16, 32, 64
+LEGAL = set(topology.legal_host_counts(ACC))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = dataclasses.replace(TransformerConfig.tiny(), dtype=jnp.float32,
+                              max_seq_len=64)
+    tok = jax.random.randint(jax.random.key(0), (1, 8), 0, cfg.vocab_size,
+                             jnp.int32)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(1), tok)["params"]
+    return cfg, params
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _obs(seq=1, ttft=None, qw=None, depth=0, inflight=0, slots=8, ready=2,
+         samples=1, stale=False):
+    return FleetObservation(seq=seq, ttft_p95=ttft, queue_wait_p95=qw,
+                            queue_depth=depth, inflight_tokens=inflight,
+                            slots=slots, ready_replicas=ready,
+                            samples=samples, stale=stale)
+
+
+def _policy(**kw):
+    base = dict(min_replicas=1, max_replicas=8, target_ttft_s=0.25,
+                hysteresis=0.1, max_step=1, scale_up_cooldown_s=30.0,
+                scale_down_cooldown_s=60.0, flap_guard_s=90.0)
+    base.update(kw)
+    return AutoscalePolicy(**base)
+
+
+# ---------------------------------------------------------------- signals
+class TestSignals:
+    def test_dead_scrapes_mark_stale_not_zero(self):
+        agg = SignalAggregator(window=4, stale_after=2)
+        obs = agg.record(FleetSample(seq=1, ttft=(0.5, 0.6), slots=4,
+                                     ready_replicas=2))
+        assert not obs.stale and obs.ttft_p95 == 0.6
+        # one dead scrape: the live window survives, not stale yet
+        obs = agg.record(dead_sample(2))
+        assert not obs.stale
+        assert obs.ttft_p95 == 0.6          # held, NOT zeroed
+        # second consecutive dead scrape crosses stale_after
+        obs = agg.record(dead_sample(3))
+        assert obs.stale
+        # recovery: one live scrape clears the streak
+        obs = agg.record(FleetSample(seq=4, ttft=(0.3,), slots=4,
+                                     ready_replicas=2))
+        assert not obs.stale
+
+    def test_window_p95_and_gauges_from_latest(self):
+        agg = SignalAggregator(window=2, stale_after=3)
+        agg.record(FleetSample(seq=1, ttft=(9.0,), queue_depth=7, slots=4,
+                               inflight_tokens=100, ready_replicas=1))
+        obs = agg.record(FleetSample(seq=2, ttft=(0.1, 0.2), queue_depth=1,
+                                     slots=8, inflight_tokens=10,
+                                     ready_replicas=2))
+        # window=2 keeps both samples' latencies; gauges come from newest
+        assert obs.ttft_p95 == 9.0
+        assert obs.queue_depth == 1 and obs.slots == 8
+        assert obs.tokens_per_slot == pytest.approx(10 / 8)
+        obs = agg.record(FleetSample(seq=3, ttft=(0.3,), slots=8,
+                                     ready_replicas=2))
+        assert obs.ttft_p95 == 0.3          # the 9.0 sample aged out
+
+    def test_sample_from_line_roundtrip_and_sentinel(self):
+        line = ("[elastic-metrics] epoch=0 batch=12 latency=0.350000 "
+                "accuracy=0.0 queue_wait=0.100000 queue_depth=3 "
+                "inflight=64 slots=8 ready=2")
+        s = sample_from_line(line, seq=5)
+        assert s.ttft == (0.35,) and s.queue_wait == (0.1,)
+        assert s.queue_depth == 3 and s.slots == 8 and s.ready_replicas == 2
+        # the nan sentinel contributes NO observation
+        s = sample_from_line(
+            "[elastic-metrics] epoch=0 batch=13 latency=nan accuracy=0.0 "
+            "queue_wait=nan queue_depth=0 inflight=0 slots=8 ready=2", 6)
+        assert s.ttft == () and s.queue_wait == ()
+        assert sample_from_line("a normal log line", 1) is None
+
+    def test_scraper_survives_mirror_deque_saturation(self):
+        # regression: positioning by len() went permanently blind once
+        # the bounded histogram mirror saturated (len freezes at cap);
+        # the monotone observation count keeps the delta read alive
+        import threading
+        import types
+        from collections import defaultdict, deque
+
+        m = types.SimpleNamespace(
+            _lock=threading.Lock(),
+            histograms=defaultdict(lambda: deque(maxlen=5)),
+            histogram_counts=defaultdict(int))
+
+        def observe(key, v):
+            m.histograms[key].append(v)
+            m.histogram_counts[key] += 1
+
+        rep = types.SimpleNamespace(
+            state=ReplicaState.READY, engine=types.SimpleNamespace(
+                n_slots=2), outstanding=0, routable=True, metrics=m)
+        fleet = types.SimpleNamespace(replicas={"replica-0": rep},
+                                      queue_depth=0)
+        for i in range(10):      # saturates the cap-5 deque
+            observe("time_to_first_token_seconds", float(i))
+        scraper = FleetScraper()
+        s = scraper.scrape(fleet)
+        assert s.ttft == (5.0, 6.0, 7.0, 8.0, 9.0)   # what survives
+        # post-saturation appends MUST still be seen
+        observe("time_to_first_token_seconds", 99.0)
+        assert scraper.scrape(fleet).ttft == (99.0,)
+        assert scraper.scrape(fleet).ttft == ()
+
+    def test_line_parsers_reject_overflowing_numbers(self):
+        from tpu_on_k8s.autoscale.signals import line_watermark
+        # regression: int(float("9e999")) raises OverflowError, which
+        # escaped the ValueError-only handlers and wedged the tick
+        assert parse_observation(
+            "[elastic-metrics] epoch=9e999 batch=2 latency=0.5") is None
+        assert line_watermark(
+            "[elastic-metrics] epoch=0 batch=9e999 latency=0.5") is None
+        s = sample_from_line(
+            "[elastic-metrics] epoch=0 batch=1 latency=0.5 "
+            "queue_depth=9e999", 1)
+        assert s is not None and s.queue_depth == 0
+
+    def test_scraper_reads_deltas_only(self, setup):
+        cfg, params = setup
+        fleet = _fleet(cfg, params, 1)
+        _warm(fleet)
+        rng = np.random.default_rng(3)
+        scraper = FleetScraper()
+        fleet.submit(rng.integers(0, cfg.vocab_size, 6).astype(np.int32), 3)
+        fleet.run()
+        first = scraper.scrape(fleet)
+        assert len(first.ttft) == 1
+        # no new traffic: the second scrape must be empty, not re-read
+        again = scraper.scrape(fleet)
+        assert again.ttft == () and again.ok
+
+
+# ----------------------------------------------------------------- policy
+class TestPolicy:
+    def test_scale_up_is_slice_legal(self):
+        r = Recommender(_policy(), accelerator=ACC)
+        d = r.decide(_obs(ttft=0.5), cur=2, now=0.0)
+        assert d.action == "up" and d.target == 4   # 2 -> 4, never 3
+        r2 = Recommender(_policy(slice_legal=False), accelerator=ACC)
+        assert r2.decide(_obs(ttft=0.5), cur=2, now=0.0).target == 3
+
+    def test_hysteresis_dead_band_holds(self):
+        r = Recommender(_policy(), accelerator=ACC)
+        # above target but inside the 10% band: no decision
+        d = r.decide(_obs(ttft=0.26), cur=2, now=0.0)
+        assert d.action == "hold" and d.reason == "steady"
+
+    def test_severity_bounded_multi_step(self):
+        r = Recommender(_policy(max_step=2), accelerator=ACC)
+        d = r.decide(_obs(ttft=0.8), cur=1, now=0.0)   # 3.2x breach
+        assert d.action == "up" and d.target == 4       # 1 -> 2 -> 4
+        # a mild breach still takes one quantum only
+        r2 = Recommender(_policy(max_step=2), accelerator=ACC)
+        assert r2.decide(_obs(ttft=0.3), cur=1, now=0.0).target == 2
+
+    def test_up_cooldown_blocks_then_releases(self):
+        r = Recommender(_policy(), accelerator=ACC)
+        d = r.decide(_obs(ttft=0.5), cur=1, now=0.0)
+        assert d.action == "up"
+        r.commit(d, now=0.0)
+        held = r.decide(_obs(seq=2, ttft=0.5), cur=2, now=10.0)
+        assert held.action == "hold" and "up_cooldown" in held.reason
+        again = r.decide(_obs(seq=3, ttft=0.5), cur=2, now=31.0)
+        assert again.action == "up"
+
+    def test_flap_damping_blocks_reversal(self):
+        r = Recommender(_policy(), accelerator=ACC)
+        down = r.decide(_obs(ttft=0.05, ready=4), cur=4, now=0.0)
+        assert down.action == "down"
+        r.commit(down, now=0.0)
+        # breach right after a scale-down: reversal needs flap_guard_s
+        d = r.decide(_obs(seq=2, ttft=0.5), cur=2, now=30.0)
+        assert d.action == "hold" and "flap_damped" in d.reason
+        assert r.decide(_obs(seq=3, ttft=0.5), cur=2, now=91.0).action == "up"
+
+    def test_stale_holds_last_known_good(self):
+        r = Recommender(_policy(), accelerator=ACC)
+        d = r.decide(_obs(stale=True, ttft=None, ready=0, slots=0), cur=4,
+                     now=0.0)
+        assert d.action == "hold" and "stale_signal" in d.reason
+
+    def test_no_data_with_load_never_scales_down(self):
+        r = Recommender(_policy(), accelerator=ACC)
+        # no TTFT sample but a non-empty queue: not idle, no evidence
+        d = r.decide(_obs(ttft=None, depth=3, ready=2), cur=2, now=0.0)
+        assert d.action == "hold"
+        # truly idle (no queue, nothing in flight): down is allowed
+        d = r.decide(_obs(ttft=None, depth=0, inflight=0, ready=2), cur=2,
+                     now=0.0)
+        assert d.action == "down" and d.target == 1
+
+    def test_down_waits_for_world_assembled(self):
+        r = Recommender(_policy(), accelerator=ACC)
+        # 2 of 4 replicas ready: never shrink into a still-forming world
+        d = r.decide(_obs(ttft=0.05, ready=2), cur=4, now=0.0)
+        assert d.action == "hold"
+
+    def test_warm_floor_preempts_and_burns_no_cooldown(self):
+        r = Recommender(_policy(min_warm=4), accelerator=ACC)
+        # even a stale signal cannot hold the floor down
+        d = r.decide(_obs(stale=True), cur=1, now=0.0)
+        assert d.action == "up" and d.target == 4
+        assert d.reason.startswith("warm_floor")
+        r.commit(d, now=0.0)
+        # floor bump stamped no cooldown: a load breach fires immediately
+        d = r.decide(_obs(seq=2, ttft=0.5), cur=4, now=1.0)
+        assert d.action == "up" and d.target == 8
+
+    def test_zero_signal_policy_never_ratchets_down(self):
+        # regression: an autoscale block with only min/max set (every
+        # signal at its 0 default) had no scale-up path but still
+        # scaled down on "queue is empty" — shrinking a live fleet to
+        # min with no way back. No signal → hold.
+        r = Recommender(AutoscalePolicy(min_replicas=1, max_replicas=8),
+                        accelerator=ACC)
+        d = r.decide(_obs(ttft=None, depth=0, inflight=0, ready=4),
+                     cur=4, now=0.0)
+        assert d.action == "hold" and d.reason == "steady"
+
+    def test_clamped_targets_stay_slice_legal(self):
+        # regression: clamping to floor/max emitted slice-illegal
+        # targets when min/max_replicas are not themselves legal quanta
+        r = Recommender(_policy(min_replicas=3), accelerator=ACC)
+        # scale-down from 4: next quantum (2) undershoots floor 3; the
+        # legal landing spot for the floor is 4 == cur -> hold, never 3
+        d = r.decide(_obs(ttft=None, ready=4), cur=4, now=0.0)
+        assert d.action == "hold" and d.reason == "at_floor"
+        # warm floor 3 snaps UP to the legal 4
+        r2 = Recommender(_policy(min_warm=3), accelerator=ACC)
+        d = r2.decide(_obs(), cur=1, now=0.0)
+        assert d.action == "up" and d.target == 4
+        # warm floor capped by an illegal max_replicas lands on the
+        # largest legal count under it
+        r3 = Recommender(_policy(min_warm=3, max_replicas=3),
+                         accelerator=ACC)
+        d = r3.decide(_obs(), cur=1, now=0.0)
+        assert d.action == "up" and d.target == 2
+
+    def test_at_max_and_at_floor(self):
+        r = Recommender(_policy(max_replicas=4), accelerator=ACC)
+        assert r.decide(_obs(ttft=9.0), cur=4, now=0.0).action == "hold"
+        assert r.decide(_obs(ttft=None), cur=1, now=0.0).reason == "at_floor"
+
+
+# ----------------------------------------------------------- fleet scaling
+def _factory(cfg, params, n_slots=2):
+    def make(name):
+        return ContinuousBatchingEngine(cfg, params, n_slots=n_slots)
+    return make
+
+
+def _fleet(cfg, params, n=1, *, clock=None, **kw):
+    return ServingFleet(
+        _factory(cfg, params), n,
+        probe=ProbeConfig(slow_start_steps=1),
+        router=Router(prefix_bucket_len=8),
+        **({"clock": clock} if clock is not None else {}), **kw)
+
+
+def _warm(fleet, steps=3):
+    for _ in range(steps):
+        fleet.step()
+
+
+class TestFleetScaleTo:
+    def test_scale_up_slow_starts_new_replicas(self, setup):
+        cfg, params = setup
+        fleet = _fleet(cfg, params, 1)
+        _warm(fleet)
+        assert fleet.scale_to(2) == 1
+        rep = fleet.replicas["replica-1"]
+        assert rep.state is ReplicaState.STARTING   # no traffic yet
+        _warm(fleet, 2)
+        assert rep.state is ReplicaState.READY
+        assert fleet.desired_replicas == 2
+
+    def test_scale_down_drains_then_reaps_zero_loss(self, setup):
+        cfg, params = setup
+        fleet = _fleet(cfg, params, 3)
+        _warm(fleet)
+        rng = np.random.default_rng(11)
+        rids = [fleet.submit(rng.integers(0, cfg.vocab_size,
+                                          6).astype(np.int32), 4)
+                for _ in range(9)]
+        assert all(isinstance(r, int) for r in rids)
+        fleet.step()
+        assert fleet.scale_to(1) == -2
+        draining = [r for r in fleet.replicas.values()
+                    if r.state is ReplicaState.DRAINING]
+        assert len(draining) == 2
+        # drained replicas are removed only once EMPTY; the survivor set
+        # never dips below the target (ready floor)
+        while fleet.has_live_requests or fleet._scaledown:
+            assert sum(r.state in (ReplicaState.STARTING,
+                                   ReplicaState.READY)
+                       for r in fleet.replicas.values()) >= 1
+            fleet.step()
+        out = {rid: fleet.result(rid) for rid in rids}
+        assert all(res is not None and res.state.value == "done"
+                   for res in out.values())          # zero silent loss
+        stopped = [r for r in fleet.retired
+                   if r["reason"] == "scale-down drain complete"]
+        assert len(stopped) == 2
+        assert all(r["drained_clean"] for r in stopped)
+
+    def test_scale_up_rebalances_queued_backlog(self, setup):
+        # regression: queued work was pinned to the gateway it was
+        # dispatched into, so new capacity idled while the old replica's
+        # queue drained alone — a scale-up could never relieve the very
+        # SLO breach that triggered it
+        cfg, params = setup
+        fleet = _fleet(cfg, params, 1)
+        _warm(fleet)
+        rng = np.random.default_rng(5)
+        rids = [fleet.submit(rng.integers(0, cfg.vocab_size,
+                                          6).astype(np.int32), 4)
+                for _ in range(10)]     # 2 slots -> 8 deep backlog
+        assert fleet.replicas["replica-0"].gateway.queue_depth >= 6
+        fleet.scale_to(3)
+        while fleet.has_live_requests:
+            fleet.step()
+        assert fleet.stats["rebalanced"] > 0
+        # the evicted backlog actually decoded on the new replicas
+        assert any(rep.routed > 0
+                   for name, rep in fleet.replicas.items()
+                   if name != "replica-0")
+        out = {rid: fleet.result(rid) for rid in rids}
+        assert all(r is not None and r.state.value == "done"
+                   for r in out.values())
+
+    def test_scale_refused_mid_rollout(self, setup):
+        cfg, params = setup
+        fleet = _fleet(cfg, params, 1)
+        _warm(fleet)
+        fleet.start_rollout(_factory(cfg, params), "v2")
+        with pytest.raises(RuntimeError):
+            fleet.scale_to(2)
+
+    def test_scale_up_reclaims_draining_victims(self, setup):
+        # regression: a scale-down victim still draining is a warm,
+        # loaded engine — a scale-up reversal must un-drain it, not
+        # mint a fresh replica beside it (transiently exceeding the
+        # configured slice count and paying spin-up again)
+        cfg, params = setup
+        fleet = _fleet(cfg, params, 2)
+        _warm(fleet)
+        rng = np.random.default_rng(13)
+        rid = fleet.submit(rng.integers(0, cfg.vocab_size,
+                                        6).astype(np.int32), 8)
+        fleet.step()
+        fleet.scale_to(1)
+        victim = next(r for r in fleet.replicas.values()
+                      if r.state is ReplicaState.DRAINING)
+        fleet.scale_to(2)
+        assert victim.state in (ReplicaState.STARTING, ReplicaState.READY)
+        assert len(fleet.replicas) == 2        # no third replica minted
+        while fleet.has_live_requests:
+            fleet.step()
+        assert fleet.result(rid).state.value == "done"
+        # the reclaimed replica accepts new traffic again
+        _warm(fleet, 2)
+        assert victim.routable
+
+    def test_evict_queued_takes_lowest_priority_newest_first(self, setup):
+        cfg, params = setup
+        fleet = _fleet(cfg, params, 1)
+        _warm(fleet)
+        gw = fleet.replicas["replica-0"].gateway
+        rng = np.random.default_rng(17)
+
+        def sub(prio):
+            r = gw.submit(rng.integers(0, cfg.vocab_size,
+                                       6).astype(np.int32), 3,
+                          priority=prio)
+            assert isinstance(r, int)
+            return r
+
+        for _ in range(2):       # fill both slots
+            sub(0)
+        gw.step()
+        low_old, low_new = sub(0), sub(0)
+        high = sub(5)
+        # farthest from dispatch moves first: the NEWEST low-priority
+        # request — never the high-priority head-of-line work
+        assert gw.evict_queued(1) == [low_new]
+        assert gw.evict_queued(1) == [low_old]
+        assert gw.state(high) is not None      # still queued here
+        assert gw.evict_queued() == [high]     # only when nothing else left
+
+    def test_observation_line_is_windowed_not_lifetime(self, setup):
+        # regression: the line folded the cumulative histogram mirror,
+        # so one historical burst kept the reported p95 breached long
+        # after traffic recovered — pinning a log-scraping autoscaler
+        # at max replicas forever
+        cfg, params = setup
+        fleet = _fleet(cfg, params, 1)
+        _warm(fleet)
+        rng = np.random.default_rng(19)
+        fleet.submit(rng.integers(0, cfg.vocab_size,
+                                  6).astype(np.int32), 3)
+        fleet.run()
+        line1 = fleet.observation_line()
+        assert "latency=nan" not in line1      # the window has a sample
+        # no new traffic since: the next window reports NO data, not
+        # the stale lifetime percentile
+        line2 = fleet.observation_line()
+        assert "latency=nan" in line2
+
+    def test_observation_line_no_data_sentinel(self, setup):
+        cfg, params = setup
+        fleet = _fleet(cfg, params, 1)
+        _warm(fleet)
+        line = fleet.observation_line()
+        assert "latency=nan" in line
+        # the elastic parser maps the sentinel to None (satellite: the
+        # old latency=0.0 fallback read as "infinitely fast")
+        assert parse_observation(line) is None
+        # ...and the autoscale signal layer takes it as zero observations
+        s = sample_from_line(line, 1)
+        assert s is not None and s.ttft == () and s.slots == 2
+
+
+# -------------------------------------------------- end-to-end closed loop
+def _svc(autoscale, replicas=1, name="svc"):
+    return InferenceService(
+        metadata=ObjectMeta(name=name),
+        spec=InferenceServiceSpec(
+            image="inproc", replicas=replicas,
+            tpu_policy=TPUPolicy(accelerator=ACC, topology="2x2"),
+            autoscale=autoscale))
+
+
+def _drive_burst(cfg, params, *, seed=0, injector=None, conflict=False):
+    """The acceptance driver: a seeded bursty trace through ServingFleet
+    + FleetAutoscaler on a fake clock. Returns everything the e2e
+    assertions need."""
+    clock = FakeClock()
+    fleet = _fleet(cfg, params, 1, clock=clock)
+    cluster = InMemoryCluster()
+    cluster.create(_svc(AutoscalePolicy(
+        min_replicas=1, max_replicas=4, target_ttft_s=0.3,
+        hysteresis=0.1, max_step=2, scale_up_cooldown_s=0.5,
+        scale_down_cooldown_s=1.5, flap_guard_s=1.0)))
+    metrics = AutoscaleMetrics()
+    scaler = FleetAutoscaler(
+        cluster, config=JobControllerConfig(autoscale_window_scrapes=3,
+                                            autoscale_stale_scrapes=3),
+        metrics=metrics, clock=clock)
+    scaler.attach_fleet("default", "svc", fleet)
+
+    rng = np.random.default_rng(seed)
+    rids = []
+    rejected = 0
+    transitions = []        # (virtual time, old, new) of executed scales
+    step = 0
+    tail = 60
+
+    def tick():
+        before = cluster.get(InferenceService, "default", "svc").spec.replicas
+        scaler.run_once()
+        after = cluster.get(InferenceService, "default", "svc").spec.replicas
+        if after != before:
+            transitions.append((clock.t, before, after))
+
+    if injector is not None:
+        chaos.install(injector)
+    try:
+        while step < 40 or fleet.has_live_requests or fleet.queue_depth \
+                or tail > 0:
+            if 4 <= step < 14:                     # the burst
+                for _ in range(int(rng.integers(3, 6))):
+                    r = fleet.submit(rng.integers(0, cfg.vocab_size,
+                                                  6).astype(np.int32), 4)
+                    if isinstance(r, Rejected):
+                        rejected += 1
+                    else:
+                        rids.append(r)
+            fleet.step()
+            clock.advance(0.05)
+            if step % 2 == 0:
+                tick()
+            if step >= 40 and not fleet.has_live_requests \
+                    and not fleet.queue_depth:
+                tail -= 1
+            step += 1
+    finally:
+        if injector is not None:
+            chaos.uninstall(injector)
+    results = {rid: fleet.result(rid) for rid in rids}
+    return dict(cluster=cluster, fleet=fleet, scaler=scaler,
+                metrics=metrics, transitions=transitions, rids=rids,
+                rejected=rejected, results=results)
+
+
+class TestClosedLoopE2E:
+    def test_burst_scales_up_then_down(self, setup):
+        cfg, params = setup
+        env = _drive_burst(cfg, params, seed=0)
+        trans = env["transitions"]
+        assert trans, "the burst must trigger at least one scale"
+        # scales up during the burst, back down to the floor after
+        assert any(new > old for _, old, new in trans)
+        svc = env["cluster"].get(InferenceService, "default", "svc")
+        assert svc.spec.replicas == 1
+        # (a) every transition lands on a slice-legal count
+        for _, old, new in trans:
+            assert new in LEGAL, (old, new)
+        # (b) no decision during cooldown: executed same-direction scales
+        # are spaced by at least the cooldown, reversals by flap_guard
+        for (t1, o1, n1), (t2, o2, n2) in zip(trans, trans[1:]):
+            up1, up2 = n1 > o1, n2 > o2
+            if up1 and up2:
+                assert t2 - t1 >= 0.5
+            elif not up1 and not up2:
+                assert t2 - t1 >= 1.5
+            else:
+                assert t2 - t1 >= 1.0
+        # executed actions never thrash: monotone up-phase then down-phase
+        dirs = ["u" if n > o else "d" for _, o, n in trans]
+        assert "".join(dirs) == "u" * dirs.count("u") + "d" * dirs.count("d")
+        # (c) zero silent loss + scale-down removed only drained replicas
+        assert all(r is not None and r.state.value == "done"
+                   for r in env["results"].values())
+        assert env["fleet"].stats["ejected"] == 0
+        assert all(rec["drained_clean"] for rec in env["fleet"].retired)
+        # status mirrors the loop's output
+        assert svc.status.desired_replicas == 1
+        assert "down" in svc.status.autoscale_message
+        # instrumentation: decisions counted by action, gauges labelled
+        assert env["metrics"].counters[("decisions", "up")] >= 1
+        assert env["metrics"].counters[("decisions", "down")] >= 1
+        assert env["metrics"].gauges[("desired_replicas",
+                                      "default/svc")] == 1
+
+    def test_decision_log_byte_identical_across_runs(self, setup):
+        cfg, params = setup
+        a = _drive_burst(cfg, params, seed=7)["scaler"].decision_log
+        b = _drive_burst(cfg, params, seed=7)["scaler"].decision_log
+        assert a == b and len(a) > 10
+        c = _drive_burst(cfg, params, seed=8)["scaler"].decision_log
+        assert c != a   # the log reflects the trace, not a constant
+
+    def test_autoscale_under_crash_converges_without_thrash(self, setup):
+        cfg, params = setup
+        scenario = scenarios.autoscale_under_crash(
+            replica="replica-1", crash_at=3, outage_at=(2, 3, 4))
+        env = _drive_burst(cfg, params, seed=3,
+                           injector=scenario.injector())
+        fleet = env["fleet"]
+        assert fleet.stats["ejected"] == 1          # the crash landed
+        # outage ticks held last-known-good instead of scaling to min
+        log = env["scaler"].decision_log
+        assert any("stale_signal" in line for line in log)
+        # zero silent loss even across the ejection re-routes
+        assert all(r is not None and r.state.value in
+                   ("done", "retry_exhausted")
+                   for r in env["results"].values())
+        # converged: up-phase then down-phase, no oscillation
+        dirs = ["u" if n > o else "d" for _, o, n in env["transitions"]]
+        assert "".join(dirs) == "u" * dirs.count("u") + "d" * dirs.count("d")
+        assert any(d == "u" for d in dirs)
+        svc = env["cluster"].get(InferenceService, "default", "svc")
+        assert svc.spec.replicas == 1               # back at the floor
+
+    def test_failed_patch_burns_no_cooldown(self, setup):
+        cfg, params = setup
+        inj = chaos.FaultInjector([chaos.FaultRule(
+            chaos.SITE_AUTOSCALE_PATCH, chaos.on_call(1),
+            chaos.Conflict(), note="first patch conflicts")], seed=0)
+        env = _drive_burst(cfg, params, seed=0, injector=inj)
+        log = list(env["scaler"].decision_log)
+        failed = [i for i, l in enumerate(log) if "patch_failed" in l]
+        assert failed, "the conflict must surface in the decision log"
+        assert env["metrics"].counters[("patch_failures", "")] == 1
+        # the very next up decision executed — no cooldown was burned by
+        # the failed attempt
+        after = [l for l in log[failed[0] + 1:] if "action=up" in l]
+        assert after and "up_cooldown" not in after[0]
+        assert env["transitions"], "the retry must land"
+
+
+# ------------------------------------------------------------- CRD plane
+class TestCRDPlane:
+    def _env(self):
+        cluster = InMemoryCluster()
+        manager = Manager()
+        clock = FakeClock()
+        setup_inferenceservice_controller(cluster, manager, clock=clock)
+        scaler = setup_fleet_autoscaler(
+            cluster, config=JobControllerConfig(
+                autoscale_window_scrapes=3, autoscale_stale_scrapes=3),
+            clock=clock)
+        return cluster, manager, KubeletSim(cluster), clock, scaler
+
+    def test_log_lines_to_patch_to_replica_gangs(self, setup):
+        cluster, manager, sim, clock, scaler = self._env()
+        cluster.create(Model(
+            metadata=ObjectMeta(name="m1"),
+            status=ModelStatus(latest_version_name="mv1",
+                               latest_image="reg.local/m1:v1")))
+        cluster.create(InferenceService(
+            metadata=ObjectMeta(name="svc"),
+            spec=InferenceServiceSpec(
+                model_name="m1", replicas=1,
+                tpu_policy=TPUPolicy(accelerator=ACC, topology="2x2"),
+                autoscale=AutoscalePolicy(
+                    min_replicas=1, max_replicas=4, target_ttft_s=0.3,
+                    scale_up_cooldown_s=10.0))))
+        assert scaler.registered() == ["default/svc"]
+        manager.run_until_idle()
+        pods = cluster.list(Pod, "default",
+                            {constants.LABEL_INFERENCESERVICE_NAME: "svc"})
+        assert len(pods) == 1                      # 2x2 v5e = 1 host/slice
+        # the serving pod prints breached observation lines; the
+        # autoscaler tails them (one per tick, watermarked by batch=)
+        pod = pods[0].metadata.name
+        for i in range(3):
+            sim.log_line("default", pod,
+                         f"[elastic-metrics] epoch=0 batch={i + 1} "
+                         f"latency=0.900000 accuracy=0.0 "
+                         f"queue_wait=0.500000 queue_depth=5 inflight=12 "
+                         f"slots=2 ready=1")
+            clock.advance(1.0)
+            scaler.run_once()
+        svc = cluster.get(InferenceService, "default", "svc")
+        assert svc.spec.replicas == 2              # slice-legal step up
+        assert svc.status.desired_replicas == 2
+        # the reconciler executes the patch as a real surge
+        manager.run_until_idle()
+        pods = cluster.list(Pod, "default",
+                            {constants.LABEL_INFERENCESERVICE_NAME: "svc"})
+        assert len(pods) == 2
+        # a quiet log (no new lines) goes stale and HOLDS — it must not
+        # read as idle and scale back down
+        for _ in range(6):
+            clock.advance(1.0)
+            scaler.run_once()
+        assert cluster.get(InferenceService, "default",
+                           "svc").spec.replicas == 2
+        assert any("stale_signal" in l for l in scaler.decision_log)
+
+    def test_log_scrape_watermark_is_per_pod(self):
+        # regression: one shared watermark made any pod whose own batch
+        # counter lagged another's permanently invisible
+        cluster, manager, sim, clock, scaler = self._env()
+        cluster.create(InferenceService(
+            metadata=ObjectMeta(name="svc"),
+            spec=InferenceServiceSpec(
+                image="img", replicas=2,
+                tpu_policy=TPUPolicy(accelerator=ACC, topology="2x2"),
+                autoscale=AutoscalePolicy(min_replicas=1,
+                                          max_replicas=4))))
+        manager.run_until_idle()
+        pods = sorted(p.metadata.name for p in cluster.list(
+            Pod, "default",
+            {constants.LABEL_INFERENCESERVICE_NAME: "svc"}))
+        assert len(pods) == 2
+        # pod A is at batch 500; pod B just started at batch 1
+        sim.log_line("default", pods[0],
+                     "[elastic-metrics] epoch=0 batch=500 latency=0.1 "
+                     "queue_wait=0.1 queue_depth=1 inflight=4 slots=2 "
+                     "ready=1")
+        sim.log_line("default", pods[1],
+                     "[elastic-metrics] epoch=0 batch=1 latency=0.2 "
+                     "queue_wait=0.1 queue_depth=2 inflight=6 slots=2 "
+                     "ready=1")
+        state = scaler._services["default/svc"]
+        svc = cluster.get(InferenceService, "default", "svc")
+        sample = scaler._collect("default/svc", svc, state)
+        # BOTH pods contribute: latencies concatenate, gauges sum
+        assert sorted(sample.ttft) == [0.1, 0.2]
+        assert sample.slots == 4 and sample.queue_depth == 3
+        assert sample.ready_replicas == 2
+        # each pod advances its own watermark
+        assert state.watermark == {pods[0]: 500, pods[1]: 1}
+
+    def test_log_scrape_reanchors_on_emitter_restart_and_prunes(self):
+        # regression: a restarted pod's batch counter resets to 0 and a
+        # sticky watermark blinded the scrape until it re-passed the old
+        # mark; departed pods' watermarks also accumulated forever
+        cluster, manager, sim, clock, scaler = self._env()
+        cluster.create(InferenceService(
+            metadata=ObjectMeta(name="svc"),
+            spec=InferenceServiceSpec(
+                image="img", replicas=1,
+                tpu_policy=TPUPolicy(accelerator=ACC, topology="2x2"),
+                autoscale=AutoscalePolicy(min_replicas=1,
+                                          max_replicas=4))))
+        manager.run_until_idle()
+        [pod] = [p.metadata.name for p in cluster.list(
+            Pod, "default",
+            {constants.LABEL_INFERENCESERVICE_NAME: "svc"})]
+        svc = cluster.get(InferenceService, "default", "svc")
+        state = scaler._services["default/svc"]
+        sim.log_line("default", pod,
+                     "[elastic-metrics] epoch=0 batch=500 latency=0.4 "
+                     "queue_depth=0 inflight=0 slots=2 ready=1")
+        assert scaler._collect("default/svc", svc, state).ok
+        assert state.watermark[pod] == 500
+        # the container restarts: counter resets far below the watermark
+        sim.log_line("default", pod,
+                     "[elastic-metrics] epoch=0 batch=3 latency=0.7 "
+                     "queue_depth=4 inflight=8 slots=2 ready=1")
+        s = scaler._collect("default/svc", svc, state)
+        assert s.ok and s.ttft == (0.7,)    # re-anchored, not blind
+        assert state.watermark[pod] == 3
+        # a quiet tail after re-anchor is a dead scrape, not a re-read
+        assert not scaler._collect("default/svc", svc, state).ok
+        # departed pods are pruned from the watermark map
+        cluster.delete(Pod, "default", pod)
+        scaler._collect("default/svc", svc, state)
+        assert state.watermark == {}
+
+    def test_scrape_seq_monotone_across_outage(self, setup):
+        # regression: dead scrapes advanced the service counter while the
+        # fleet scraper kept its own — the sequence went backwards after
+        # an outage and the decision log showed duplicate/regressing seqs
+        cfg, params = setup
+        clock = FakeClock()
+        fleet = _fleet(cfg, params, 1, clock=clock)
+        _warm(fleet)
+        cluster = InMemoryCluster()
+        cluster.create(_svc(AutoscalePolicy(min_replicas=1,
+                                            max_replicas=4)))
+        scaler = FleetAutoscaler(cluster, clock=clock)
+        scaler.attach_fleet("default", "svc", fleet)
+        inj = chaos.FaultInjector([chaos.FaultRule(
+            chaos.SITE_AUTOSCALE_SIGNAL, chaos.Trigger(at=(2, 3)),
+            chaos.SignalOutage())], seed=0)
+        with inj:
+            for _ in range(5):
+                scaler.run_once()
+                clock.advance(1.0)
+        seqs = [int(line.split("seq=")[1].split()[0])
+                for line in scaler.decision_log]
+        assert seqs == sorted(set(seqs)) == [1, 2, 3, 4, 5]
+
+    def test_unregistered_without_autoscale_block(self):
+        cluster, manager, sim, clock, scaler = self._env()
+        cluster.create(InferenceService(
+            metadata=ObjectMeta(name="manual"),
+            spec=InferenceServiceSpec(image="img", replicas=2)))
+        assert scaler.registered() == []
+        scaler.run_once()     # no-op, no crash
+
+
+# --------------------------------------------------------------- metrics
+def test_autoscale_metrics_exposition():
+    m = AutoscaleMetrics()
+    m.decision("up")
+    m.decision("hold")
+    m.set_gauge("desired_replicas", 4, label="default/svc")
+    m.set_gauge("observed_ttft_p95", 0.42, label="default/svc")
+    text = exposition(m)
+    assert 'tpu_on_k8s_autoscale_decisions_total{action="up"} 1.0' in text
+    assert ('tpu_on_k8s_autoscale_desired_replicas{service="default/svc"} '
+            '4.0') in text
+    assert 'observed_ttft_p95{service="default/svc"} 0.42' in text

@@ -69,12 +69,51 @@ class TestParsing:
         assert parse_observation("loss=0.5 step=10") is None
         assert parse_observation("[elastic-metrics] epoch=1") is None  # no latency
 
+    def test_parse_rejects_malformed_and_negative(self):
+        # malformed value: the old numeric-class regex extracted digit
+        # fragments out of garbage instead of rejecting the line
+        assert parse_observation(
+            "[elastic-metrics] epoch=1 batch=2 latency=x1.5") is None
+        # negative latency is not a measurement
+        assert parse_observation(
+            "[elastic-metrics] epoch=1 batch=2 latency=-0.3") is None
+        # non-finite sentinels mean "no data", never a number (the
+        # ServingFleet emits latency=nan before its first sample)
+        assert parse_observation(
+            "[elastic-metrics] epoch=1 batch=2 latency=nan") is None
+        assert parse_observation(
+            "[elastic-metrics] epoch=1 batch=2 latency=inf") is None
+        # a malformed secondary field rejects the whole line too
+        assert parse_observation(
+            "[elastic-metrics] epoch=oops batch=2 latency=0.5") is None
+
+    def test_parse_duplicate_keys_last_wins(self):
+        o = parse_observation(
+            "[elastic-metrics] epoch=1 batch=2 latency=0.1 latency=0.2")
+        assert o is not None and o.latency == pytest.approx(0.2)
+
+    def test_parse_extended_fleet_line(self):
+        # the fleet's extended observation line stays parseable by the
+        # elastic consumer (extra keys ignored)
+        o = parse_observation(
+            "[elastic-metrics] epoch=0 batch=42 latency=0.125000 "
+            "accuracy=0.0 queue_wait=0.050000 queue_depth=3 inflight=64 "
+            "slots=8 ready=2")
+        assert o is not None and o.batch == 42
+        assert o.latency == pytest.approx(0.125)
+
     def test_continue_rule(self):
         # latency/replica improved: 1.0/2 = 0.5 > 0.6/4 = 0.15 → continue
         assert is_satisfy_elastic_continue(2, 1.0, 4, 0.6)
         # regressed: 1.0/2 = 0.5 < 2.4/4 = 0.6 → stop
         assert not is_satisfy_elastic_continue(2, 1.0, 4, 2.4)
         assert is_satisfy_elastic_continue(0, 0.0, 2, 1.0)  # first window
+
+    def test_continue_rule_zero_current_replicas(self):
+        # regression: cur_replicas == 0 raised ZeroDivisionError; a
+        # zero-replica world has no throughput — never "keep growing"
+        assert not is_satisfy_elastic_continue(2, 1.0, 0, 1.0)
+        assert is_satisfy_elastic_continue(0, 0.0, 0, 0.0)  # guard order
 
 
 class TestScalingLoop:
@@ -166,6 +205,33 @@ class TestScalingLoop:
         assert job.spec.tasks[TaskType.WORKER].num_tasks == 2  # reverted
         es = job.status.elastic_statuses[TaskType.WORKER]
         assert "revert" in es.message
+
+    def test_watermark_excludes_pre_scale_lines(self):
+        # the _JobState watermark race, pinned directly: worker-0's log
+        # tail still holds pre-scale lines right after a rescale; only
+        # (epoch, batch) strictly above the watermark may enter the new
+        # replica bucket
+        from tpu_on_k8s.controller.autoscaler import _JobState
+        from tpu_on_k8s.utils import conditions
+
+        cluster = InMemoryCluster()
+        scaler = ElasticAutoscaler(cluster)
+        job = native_job(workers=4)
+        worker0 = conditions.gen_general_name("nj", TaskType.WORKER, 0)
+        for batch in (3, 4, 5, 6, 7):
+            cluster.append_pod_log(
+                "default", worker0,
+                f"[elastic-metrics] epoch=1 batch={batch} latency=0.5 "
+                f"accuracy=0.9")
+        state = _JobState(watermark=(1, 5))
+        obs = scaler._collect_observations(job, state, replicas=4)
+        assert [o.batch for o in obs] == [6, 7]
+        # and a malformed line mid-tail is skipped, not mis-parsed
+        cluster.append_pod_log(
+            "default", worker0,
+            "[elastic-metrics] epoch=1 batch=8 latency=bogus")
+        obs = scaler._collect_observations(job, state, replicas=4)
+        assert [o.batch for o in obs] == [6, 7]
 
     def test_stale_observations_never_feed_new_size(self):
         # After a grow, the old log lines must not fill the new bucket: with

@@ -411,6 +411,19 @@ def stage_serve_ttft(timeout):
                         "--rate", "1.5"], "serve_ttft", timeout)
 
 
+def stage_serve_autoscale(timeout):
+    """The SLO autoscaler's closed loop on hardware: bursty seeded trace
+    through ServingFleet + FleetAutoscaler (virtual-clock decisions —
+    deterministic regardless of chip speed), recording the decision
+    trace, replica trajectory, and TTFT before/after the scale-up."""
+    return _json_stage([sys.executable, "tools/serve_load.py", "--bench",
+                        "--autoscale", "--n-slots", "4",
+                        "--n-requests", "64", "--rate", "1.0",
+                        "--burst-start", "6", "--burst-len", "10",
+                        "--burst-rate", "6.0"],
+                       "serve_autoscale", timeout)
+
+
 def stage_serve_fleet(timeout):
     """The fleet headline (round-5 '#2 missed' decode/serving gap):
     router + 2 replicas on the same seeded trace — aggregate tok/s plus
@@ -438,6 +451,7 @@ STAGES = [
     ("continuous", stage_continuous, 1200, ("continuous_h8",)),
     ("serve_ttft", stage_serve_ttft, 1200, ()),
     ("serve_fleet", stage_serve_fleet, 1200, ()),
+    ("serve_autoscale", stage_serve_autoscale, 1200, ()),
 ]
 
 

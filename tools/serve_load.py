@@ -65,7 +65,10 @@ def build_workload(rng: np.random.Generator, n_requests: int, *,
                    deadline_fraction: float = 0.0,
                    shared_prefixes: int = 0,
                    shared_prefix_len: int = 0,
-                   shared_fraction: float = 0.0) -> List[Arrival]:
+                   shared_fraction: float = 0.0,
+                   burst_start: int = 0,
+                   burst_len: int = 0,
+                   burst_rate: float = 0.0) -> List[Arrival]:
     """A reproducible trace: Poisson(``rate``) arrivals per engine step
     (the seeded ``rng`` is passed IN — the caller owns determinism), mixed
     uniform prompt/output lengths, tenants round-tripped through the same
@@ -74,14 +77,20 @@ def build_workload(rng: np.random.Generator, n_requests: int, *,
     of that many fixed ``shared_prefix_len``-token prefixes (the
     system-prompt shape real traffic has — what the fleet router's prefix
     affinity exists to exploit; fully independent prompts would leave
-    that path structurally cold)."""
+    that path structurally cold). With ``burst_len`` > 0, steps in
+    ``[burst_start, burst_start + burst_len)`` arrive at ``burst_rate``
+    instead of ``rate`` — the bursty trace the SLO autoscaler's reactive
+    loop is measured against."""
     pool = [rng.integers(0, vocab_size,
                          size=shared_prefix_len).astype(np.int32)
             for _ in range(shared_prefixes)] if shared_prefix_len else []
     arrivals: List[Arrival] = []
     step = 0
     while len(arrivals) < n_requests:
-        for _ in range(min(int(rng.poisson(rate)),
+        step_rate = (burst_rate if burst_len > 0
+                     and burst_start <= step < burst_start + burst_len
+                     else rate)
+        for _ in range(min(int(rng.poisson(step_rate)),
                            n_requests - len(arrivals))):
             lp = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
             prompt = rng.integers(0, vocab_size, size=lp).astype(np.int32)
@@ -331,6 +340,226 @@ def _fleet_main(args, cfg, params, max_len) -> dict:
     return summary
 
 
+class _VirtualClock:
+    """Deterministic fleet time: one fixed increment per driver step.
+    TTFT/queue-wait/cooldowns all derive from it, so the autoscaler's
+    decision log is a pure function of (seed, flags) — byte-identical
+    across runs, which is the property `make autoscale-soak` asserts."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def run_autoscale_trace(args, cfg, params, max_len, *,
+                        enabled: bool = True) -> dict:
+    """One seeded bursty trace through ServingFleet + FleetAutoscaler:
+    the closed loop scrapes the fleet, patches the InferenceService's
+    ``spec.replicas``, and applies the target back to the fleet. Returns
+    the summary (decisions, replica trajectory, TTFT percentiles,
+    zero-loss accounting). ``enabled=False`` is the control arm: same
+    trace, same virtual clock, autoscaler never ticked — the fleet stays
+    at ``min_replicas`` (what "TTFT before autoscaling" means)."""
+    from tpu_on_k8s.api.core import ObjectMeta
+    from tpu_on_k8s.api.inference_types import (
+        AutoscalePolicy,
+        InferenceService,
+        InferenceServiceSpec,
+    )
+    from tpu_on_k8s.api.types import TPUPolicy
+    from tpu_on_k8s.client import InMemoryCluster
+    from tpu_on_k8s.controller.config import JobControllerConfig
+    from tpu_on_k8s.controller.fleetautoscaler import FleetAutoscaler
+    from tpu_on_k8s.metrics.metrics import AutoscaleMetrics
+    from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+    from tpu_on_k8s.serve import (
+        AdmissionConfig,
+        ProbeConfig,
+        Rejected,
+        Router,
+        ServingFleet,
+    )
+
+    vclock = _VirtualClock()
+
+    def factory(name):
+        return ContinuousBatchingEngine(cfg, params, n_slots=args.n_slots,
+                                        max_len=max_len,
+                                        step_horizon=args.horizon)
+
+    fleet = ServingFleet(
+        factory, args.min_replicas,
+        admission=AdmissionConfig(max_queue_depth=args.queue_bound),
+        probe=ProbeConfig(slow_start_steps=1),
+        router=Router(prefix_bucket_len=args.prefix_bucket),
+        clock=vclock)
+
+    cluster = InMemoryCluster()
+    cluster.create(InferenceService(
+        metadata=ObjectMeta(name="load"),
+        spec=InferenceServiceSpec(
+            image="inproc", replicas=args.min_replicas,
+            tpu_policy=TPUPolicy(accelerator=args.accelerator,
+                                 topology="2x2"),
+            autoscale=AutoscalePolicy(
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                min_warm=args.min_warm,
+                target_ttft_s=args.target_ttft,
+                hysteresis=0.1, max_step=args.max_scale_step,
+                scale_up_cooldown_s=args.up_cooldown,
+                scale_down_cooldown_s=args.down_cooldown,
+                flap_guard_s=args.flap_guard))))
+    autoscaler = FleetAutoscaler(
+        cluster,
+        config=JobControllerConfig(autoscale_window_scrapes=3,
+                                   autoscale_stale_scrapes=3),
+        metrics=AutoscaleMetrics(), clock=vclock)
+    autoscaler.attach_fleet("default", "load", fleet)
+
+    rng = np.random.default_rng(args.seed)
+    arrivals = build_workload(
+        rng, args.n_requests, rate=args.rate,
+        prompt_lens=(args.prompt_min, args.prompt_max),
+        new_tokens=(args.new_min, args.new_max),
+        vocab_size=cfg.vocab_size,
+        burst_start=args.burst_start, burst_len=args.burst_len,
+        burst_rate=args.burst_rate)
+
+    by_step: dict = {}
+    for a in arrivals:
+        by_step.setdefault(a.step, []).append(a)
+    first_token_t: dict = {}
+    submit_t: dict = {}
+    outcomes: dict = {}
+    rejected = 0
+    trajectory = []      # (driver step, active replicas) at each change
+    first_up_step = None
+    first_up_t = None    # virtual time the first scale-up executed
+    step = 0
+    # the idle tail is where scale-down is observed; the control arm has
+    # nothing to scale down and drains straight to exit
+    tail = max(int(args.tail_steps), 0) if enabled else 0
+
+    def active_count():
+        return sum(r.state.value in ("starting", "ready")
+                   for r in fleet.replicas.values())
+
+    def on_token(rid, _tok):
+        if rid not in first_token_t:
+            first_token_t[rid] = vclock.t
+
+    while by_step or fleet.has_live_requests or fleet.queue_depth > 0 \
+            or tail > 0:
+        for a in by_step.pop(step, []):
+            r = fleet.submit(a.prompt, a.max_new_tokens, tenant=a.tenant,
+                             priority=a.priority, deadline_s=a.deadline_s,
+                             on_token=on_token)
+            if isinstance(r, Rejected):
+                rejected += 1
+            else:
+                submit_t[r] = vclock.t
+        for rid in fleet.step():
+            res = fleet.result(rid)
+            if res is not None:
+                outcomes[rid] = res
+        vclock.advance(args.step_dt)
+        if enabled and step % args.autoscale_every == 0:
+            ups0 = fleet.stats["scale_ups"]
+            autoscaler.run_once()
+            if first_up_step is None and fleet.stats["scale_ups"] > ups0:
+                first_up_step = step
+                first_up_t = vclock.t
+        if not trajectory or trajectory[-1][1] != active_count():
+            trajectory.append((step, active_count()))
+        if not by_step and not fleet.has_live_requests \
+                and fleet.queue_depth == 0:
+            tail -= 1
+        step += 1
+
+    # split by when the first token LANDED, not when the request was
+    # submitted: a burst's whole backlog arrives before the scale-up
+    # executes, and the scale-up's effect is that queued requests start
+    # decoding sooner once the new replicas are ready
+    ttft = {rid: first_token_t[rid] - submit_t[rid]
+            for rid in first_token_t if rid in submit_t}
+    pre = [v for rid, v in ttft.items()
+           if first_up_t is None or first_token_t[rid] <= first_up_t]
+    post = [v for rid, v in ttft.items()
+            if first_up_t is not None and first_token_t[rid] > first_up_t]
+    states = [r.state.value for r in outcomes.values()]
+    svc = cluster.get(InferenceService, "default", "load")
+    summary = {
+        "metric": "autoscale_trace",
+        "requests": len(arrivals),
+        "served": states.count("done"),
+        "rejected": rejected,
+        "deadline_exceeded": states.count("deadline_exceeded"),
+        "cancelled": states.count("cancelled"),
+        "retry_exhausted": states.count("retry_exhausted"),
+        "driver_steps": step,
+        "first_scale_up_step": first_up_step,
+        "replica_trajectory": trajectory,
+        "final_spec_replicas": svc.spec.replicas,
+        "final_active_replicas": active_count(),
+        "max_active_replicas": max(n for _, n in trajectory),
+        "scale_ups": fleet.stats["scale_ups"],
+        "scale_downs": fleet.stats["scale_downs"],
+        # virtual-clock TTFT: deterministic, comparable across runs.
+        # pre/post split by when the first token landed relative to the
+        # first executed scale-up (the burst backlog counts as post: its
+        # wait is exactly what the scale-up exists to cut short)
+        "ttft_ms_p95": _pctl(list(ttft.values()), 0.95),
+        "ttft_ms_p50": _pctl(list(ttft.values()), 0.50),
+        "ttft_ms_p95_pre_scale": _pctl(pre, 0.95),
+        "ttft_ms_p95_post_scale": _pctl(post, 0.95),
+        "decisions": list(autoscaler.decision_log),
+    }
+    return summary
+
+
+def _autoscale_main(args, cfg, params, max_len) -> dict:
+    """``--autoscale``: the SLO-driven loop on a bursty trace, plus a
+    static control arm (same trace, fleet pinned at ``--min-replicas``)
+    so the summary shows TTFT before/after autoscaling on identical
+    load. With ``--soak`` the autoscaled trace runs TWICE from scratch
+    and the two decision logs must be byte-identical (plus
+    zero-silent-loss accounting and an actual scale-up) —
+    ``AUTOSCALE_SOAK_FAILED seed=N`` on violation."""
+    baseline = run_autoscale_trace(args, cfg, params, max_len,
+                                   enabled=False)
+    summary = run_autoscale_trace(args, cfg, params, max_len)
+    summary["ttft_ms_p95_static_baseline"] = baseline["ttft_ms_p95"]
+    summary["ttft_ms_p50_static_baseline"] = baseline["ttft_ms_p50"]
+    summary["baseline_driver_steps"] = baseline["driver_steps"]
+    if args.soak:
+        rerun = run_autoscale_trace(args, cfg, params, max_len)
+        accounted = (summary["served"] + summary["rejected"]
+                     + summary["deadline_exceeded"] + summary["cancelled"]
+                     + summary["retry_exhausted"])
+        ok = (accounted == args.n_requests
+              and summary["scale_ups"] >= 1
+              and summary["decisions"] == rerun["decisions"])
+        summary["soak_ok"] = ok
+        summary["decision_log_replayed"] = (
+            summary["decisions"] == rerun["decisions"])
+        if not ok:
+            print(json.dumps(summary))
+            print(f"AUTOSCALE_SOAK_FAILED seed={args.seed} "
+                  f"accounted={accounted}/{args.n_requests} "
+                  f"scale_ups={summary['scale_ups']} "
+                  f"replayed={summary['decision_log_replayed']}")
+            raise SystemExit(1)
+        print(f"AUTOSCALE_SOAK_OK seed={args.seed}", file=sys.stderr)
+    print(json.dumps(summary))
+    return summary
+
+
 def main(argv=None) -> dict:
     import jax
     import jax.numpy as jnp
@@ -375,7 +604,45 @@ def main(argv=None) -> dict:
                         "prefix")
     p.add_argument("--soak", action="store_true",
                    help="assert zero-silent-loss accounting; print "
-                        "FLEET_SOAK_FAILED seed=N and exit 1 on violation")
+                        "FLEET_SOAK_FAILED seed=N and exit 1 on violation "
+                        "(with --autoscale: also run the trace twice and "
+                        "require byte-identical decision logs)")
+    # --- SLO autoscaler mode (tpu_on_k8s/autoscale/ closed loop) ---
+    p.add_argument("--autoscale", action="store_true",
+                   help="drive a bursty trace through ServingFleet + "
+                        "FleetAutoscaler on a virtual clock: decisions, "
+                        "replica trajectory, TTFT before/after scale-up")
+    p.add_argument("--burst-start", type=int, default=6,
+                   help="driver step the burst begins at (--autoscale)")
+    p.add_argument("--burst-len", type=int, default=10,
+                   help="burst length in driver steps (--autoscale)")
+    p.add_argument("--burst-rate", type=float, default=6.0,
+                   help="mean arrivals per step during the burst")
+    p.add_argument("--autoscale-every", type=int, default=2,
+                   help="autoscaler tick every N driver steps")
+    p.add_argument("--step-dt", type=float, default=0.05,
+                   help="virtual seconds per driver step")
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=4)
+    p.add_argument("--min-warm", type=int, default=0,
+                   help="warm floor: pre-provisioned burst capacity")
+    p.add_argument("--target-ttft", type=float, default=0.4,
+                   help="TTFT p95 SLO in virtual seconds (--autoscale)")
+    p.add_argument("--max-scale-step", type=int, default=2,
+                   help="slice-legal quanta one decision may jump")
+    p.add_argument("--up-cooldown", type=float, default=0.5,
+                   help="scale-up cooldown, virtual seconds")
+    p.add_argument("--down-cooldown", type=float, default=2.0,
+                   help="scale-down cooldown, virtual seconds")
+    p.add_argument("--flap-guard", type=float, default=1.0,
+                   help="minimum spacing of direction reversals, "
+                        "virtual seconds")
+    p.add_argument("--tail-steps", type=int, default=120,
+                   help="idle steps after the trace drains (the window "
+                        "in which scale-down is observed)")
+    p.add_argument("--accelerator", default="tpu-v5-lite-podslice",
+                   help="accelerator whose legal host counts scale "
+                        "steps snap to (--autoscale)")
     p.add_argument("--crash-replica", type=int, default=-1,
                    help=">=0: chaos-crash replica-N mid-trace "
                         "(with --replicas)")
@@ -399,6 +666,8 @@ def main(argv=None) -> dict:
     if args.bench:
         params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
 
+    if args.autoscale:
+        return _autoscale_main(args, cfg, params, max_len)
     if args.replicas > 0:
         return _fleet_main(args, cfg, params, max_len)
 

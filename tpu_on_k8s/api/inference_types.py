@@ -71,6 +71,65 @@ class RolloutPolicy:
 
 
 @dataclass
+class AutoscalePolicy:
+    """SLO-driven replica autoscaling for the serving fleet (consumed by
+    `controller/fleetautoscaler.py`; decision core in
+    `tpu_on_k8s/autoscale/policy.py`). Setting this block opts the
+    service into autoscaling: ``spec.replicas`` becomes the
+    autoscaler's output rather than a hand-set value.
+
+    ``target_ttft_s`` / ``target_queue_wait_s`` are the latency SLOs
+    (p95, seconds; 0 disables that signal). ``util_high``/``util_low``
+    bound tokens-in-flight per engine slot — the early-warning band that
+    scales up before latency degrades. ``min_warm`` is the warm floor:
+    replicas pre-provisioned for burst absorption, because a TPU slice
+    spins up in minutes and reactive-only scaling structurally misses
+    the front of every burst. ``hysteresis`` is the dead band around
+    each target; ``max_step`` bounds how many slice-legal quanta one
+    decision may jump; cooldowns and ``flap_guard_s`` (minimum spacing
+    between direction reversals) set the tempo. ``slice_legal`` snaps
+    targets to `gang/topology` host-count quanta for the service's
+    accelerator (on a 3D-torus part, N+1 replicas may simply not
+    exist)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    min_warm: int = 0
+    target_ttft_s: float = 0.0
+    target_queue_wait_s: float = 0.0
+    util_high: float = 0.0
+    util_low: float = 0.0
+    hysteresis: float = 0.1
+    max_step: int = 1
+    scale_up_cooldown_s: float = 30.0
+    scale_down_cooldown_s: float = 120.0
+    flap_guard_s: float = 180.0
+    slice_legal: bool = True
+
+    def normalized(self) -> "AutoscalePolicy":
+        """Defaulted-and-clamped copy (same passive-record defaulting
+        shape as ``RolloutPolicy``): floors at 1 replica, max >= min,
+        warm floor within [0, max], non-negative targets/tempo, at
+        least one legal step per decision."""
+        lo = max(int(self.min_replicas), 1)
+        hi = max(int(self.max_replicas), lo)
+        return AutoscalePolicy(
+            min_replicas=lo, max_replicas=hi,
+            min_warm=min(max(int(self.min_warm), 0), hi),
+            target_ttft_s=max(float(self.target_ttft_s), 0.0),
+            target_queue_wait_s=max(float(self.target_queue_wait_s), 0.0),
+            util_high=max(float(self.util_high), 0.0),
+            util_low=max(float(self.util_low), 0.0),
+            hysteresis=max(float(self.hysteresis), 0.0),
+            max_step=max(int(self.max_step), 1),
+            scale_up_cooldown_s=max(float(self.scale_up_cooldown_s), 0.0),
+            scale_down_cooldown_s=max(float(self.scale_down_cooldown_s),
+                                      0.0),
+            flap_guard_s=max(float(self.flap_guard_s), 0.0),
+            slice_legal=bool(self.slice_legal))
+
+
+@dataclass
 class InferenceServiceSpec:
     """``model_name`` follows that Model's ``status.latest_image`` (the
     closed train → image → deploy loop); ``image`` pins an explicit image
@@ -87,6 +146,9 @@ class InferenceServiceSpec:
     rollout: RolloutPolicy = field(default_factory=RolloutPolicy)
     n_slots: int = 8
     prefix_bucket_len: int = 128
+    #: present = autoscaled: `controller/fleetautoscaler.py` owns
+    #: ``replicas`` (within [min_replicas, max_replicas]) from here on
+    autoscale: Optional[AutoscalePolicy] = None
 
 
 class ServicePhase(str, enum.Enum):
@@ -114,6 +176,9 @@ class InferenceServiceStatus:
     updated_replicas: int = 0      # replica gangs on target_image
     canary_weight: float = 0.0
     observed_model_version: str = ""
+    # --- autoscaler-owned (written by controller/fleetautoscaler.py) ---
+    desired_replicas: int = 0      # the autoscaler's last committed target
+    autoscale_message: str = ""    # last decision, human-readable
 
 
 @dataclass

@@ -1,0 +1,54 @@
+"""SLO-driven serving autoscaling: fleet load → ``InferenceService.replicas``.
+
+Training replicas already scale elastically
+(`controller/autoscaler.ElasticAutoscaler`); this package closes the same
+loop for the serving plane:
+
+* `signals` — windowed aggregation of per-replica gateway/fleet metrics
+  (TTFT p95, queue-wait p95, queue depth, tokens-in-flight per slot)
+  into a ``FleetObservation``, with an explicit staleness bit so a dead
+  scrape is "no data", never "zero load";
+* `policy`  — ``Recommender``: a deterministic target-tracking policy
+  (SLO targets + utilization band) producing **slice-legal** replica
+  targets via `gang/topology.next_legal_host_count`, with hysteresis,
+  separate up/down cooldowns, flap damping, bounded step size, and a
+  ``min_warm`` warm floor (slice spin-up is minutes — reactive-only
+  scaling misses bursts);
+* execution lives in `controller/fleetautoscaler.FleetAutoscaler`, the
+  second control loop over the ``InferenceService`` CRD: it patches
+  ``spec.replicas`` and lets the reconciler's surge/drain machinery
+  (and, in-process, ``ServingFleet.scale_to``) do the rest.
+"""
+from tpu_on_k8s.autoscale.policy import (
+    ACTION_DOWN,
+    ACTION_HOLD,
+    ACTION_UP,
+    Decision,
+    Recommender,
+)
+from tpu_on_k8s.autoscale.signals import (
+    NO_DATA,
+    FleetObservation,
+    FleetSample,
+    FleetScraper,
+    SignalAggregator,
+    dead_sample,
+    line_watermark,
+    sample_from_line,
+)
+
+__all__ = [
+    "ACTION_DOWN",
+    "ACTION_HOLD",
+    "ACTION_UP",
+    "Decision",
+    "FleetObservation",
+    "FleetSample",
+    "FleetScraper",
+    "NO_DATA",
+    "Recommender",
+    "SignalAggregator",
+    "dead_sample",
+    "line_watermark",
+    "sample_from_line",
+]

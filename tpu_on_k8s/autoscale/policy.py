@@ -1,0 +1,280 @@
+"""Deterministic target-tracking recommender: SLO signals → replica target.
+
+The decision core of the serving autoscaler, deliberately free of I/O so
+every decision is a pure function of ``(observation, current replicas,
+clock)`` plus a handful of monotone stamps — the property that makes two
+runs of the same seeded trace produce byte-identical decision logs
+(`make autoscale-soak` enforces this).
+
+Policy shape (knobs live on the CRD as
+`api/inference_types.AutoscalePolicy`):
+
+* **SLO targets** — scale up when TTFT p95 or queue-wait p95 breaches
+  the target by more than the hysteresis margin; scale down only when
+  every configured signal reads comfortably BELOW target (the dead band
+  between the two thresholds absorbs noise).
+* **Utilization band** — tokens-in-flight per engine slot above
+  ``util_high`` scales up even before latency degrades (queueing theory:
+  at high utilization, wait explodes); below ``util_low`` (with an empty
+  queue) it is scale-down evidence.
+* **Slice-legal steps** — TPU serving replicas occupy whole slices, and
+  host counts come in topology quanta: steps land on
+  `gang/topology.next_legal_host_count` values, never free-form N±1
+  (on v5e those coincide at small counts; on 3D-torus parts they do not).
+* **Tempo** — separate scale-up/scale-down cooldowns (up is cheap to
+  regret, down risks an SLO breach), flap damping (a direction reversal
+  needs ``flap_guard_s`` since the opposite move), and a bounded step
+  size scaled by breach severity.
+* **Warm floor** — ``min_warm`` pre-provisions capacity for burst
+  absorption: slice spin-up is minutes, not seconds, so a purely
+  reactive policy structurally misses the front of every burst (the
+  elastic-allocation argument in PAPERS.md). The floor overrides load
+  evidence and is exempt from cooldowns — it is configuration, not
+  reaction.
+* **Outage** — a stale observation (see `autoscale/signals.py`) holds
+  last-known-good. No data is never "no load".
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from tpu_on_k8s.autoscale.signals import FleetObservation
+from tpu_on_k8s.gang import topology
+
+ACTION_UP = "up"
+ACTION_DOWN = "down"
+ACTION_HOLD = "hold"
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One recommendation. ``line()`` is the stable decision-log form:
+    only observation-derived values (deterministic under an injected
+    clock) — no wall time, no object ids."""
+
+    seq: int
+    action: str
+    current: int
+    target: int
+    reason: str
+
+    def line(self) -> str:
+        return (f"seq={self.seq} action={self.action} "
+                f"replicas={self.current}->{self.target} "
+                f"reason={self.reason}")
+
+
+def _fmt(v: Optional[float]) -> str:
+    return "none" if v is None else f"{v:.6f}"
+
+
+class Recommender:
+    """Target-tracking policy evaluation. ``decide()`` is pure (no state
+    mutated); the caller ``commit()``s a decision only after executing
+    it, so a failed patch never burns a cooldown window."""
+
+    def __init__(self, policy, *, accelerator: str = "") -> None:
+        # ``policy`` is an api.inference_types.AutoscalePolicy (duck-typed
+        # to keep this module importable without the api layer in tests)
+        self.policy = policy.normalized() if hasattr(policy, "normalized") \
+            else policy
+        self.accelerator = accelerator if getattr(
+            self.policy, "slice_legal", True) else ""
+        self._last_up_t: Optional[float] = None
+        self._last_down_t: Optional[float] = None
+
+    # ------------------------------------------------------------ legality
+    def _step_up(self, cur: int) -> Optional[int]:
+        if self.accelerator:
+            return topology.next_legal_host_count(self.accelerator, cur)
+        return cur + 1
+
+    def _step_down(self, cur: int) -> Optional[int]:
+        if self.accelerator:
+            return topology.next_legal_host_count(self.accelerator, cur,
+                                                  direction=-1)
+        return cur - 1 if cur > 1 else None
+
+    def legalize_up(self, desired: int) -> int:
+        """Smallest legal count >= desired (identity without an
+        accelerator)."""
+        if self.accelerator:
+            return topology.snap_host_count(self.accelerator, desired)
+        return desired
+
+    def legalize_down(self, desired: int) -> Optional[int]:
+        """Largest legal count <= desired (identity without an
+        accelerator; None when every legal count exceeds it)."""
+        if not self.accelerator:
+            return desired
+        if desired in topology.legal_host_counts(self.accelerator):
+            return desired
+        return topology.next_legal_host_count(self.accelerator, desired,
+                                              direction=-1)
+
+    # ------------------------------------------------------------ decision
+    def decide(self, obs: FleetObservation, cur: int, now: float) -> Decision:
+        p = self.policy
+        floor = max(p.min_replicas, p.min_warm)
+
+        # warm floor first: pre-provisioned burst capacity is config, not
+        # load reaction — no cooldown, no signal needed, stale or not.
+        # The target stays slice-legal even when floor/max_replicas are
+        # not themselves legal quanta: snap the floor up, and fall back
+        # to the largest legal count under max if that overshoots.
+        if cur < floor:
+            target = self.legalize_up(floor)
+            if target > p.max_replicas:
+                target = self.legalize_down(p.max_replicas)
+            if target is not None and target > cur:
+                return Decision(obs.seq, ACTION_UP, cur, target,
+                                f"warm_floor {floor}")
+
+        if obs.stale:
+            return Decision(obs.seq, ACTION_HOLD, cur, cur,
+                            "stale_signal holding_last_known_good")
+
+        up = self._up_reasons(obs)
+        if up:
+            return self._scale_up(obs, cur, now, up)
+        if self._down_ok(obs, cur):
+            return self._scale_down(obs, cur, now)
+        return Decision(obs.seq, ACTION_HOLD, cur, cur, "steady")
+
+    def commit(self, decision: Decision, now: float) -> None:
+        """Record a *executed* scale (cooldown/flap stamps). Warm-floor
+        bumps are exempt — they must not delay the first load-driven
+        scale-up."""
+        if decision.reason.startswith("warm_floor"):
+            return
+        if decision.action == ACTION_UP:
+            self._last_up_t = now
+        elif decision.action == ACTION_DOWN:
+            self._last_down_t = now
+
+    # ----------------------------------------------------------- internals
+    def _up_reasons(self, obs: FleetObservation) -> List[str]:
+        p = self.policy
+        h = 1.0 + p.hysteresis
+        reasons: List[str] = []
+        if p.target_ttft_s > 0 and obs.ttft_p95 is not None \
+                and obs.ttft_p95 > p.target_ttft_s * h:
+            reasons.append(f"ttft_p95={_fmt(obs.ttft_p95)}"
+                           f">slo={_fmt(p.target_ttft_s)}")
+        if p.target_queue_wait_s > 0 and obs.queue_wait_p95 is not None \
+                and obs.queue_wait_p95 > p.target_queue_wait_s * h:
+            reasons.append(f"queue_wait_p95={_fmt(obs.queue_wait_p95)}"
+                           f">slo={_fmt(p.target_queue_wait_s)}")
+        util = obs.tokens_per_slot
+        if p.util_high > 0 and util is not None and util > p.util_high:
+            reasons.append(f"tokens_per_slot={_fmt(util)}"
+                           f">high={_fmt(p.util_high)}")
+        return reasons
+
+    def _severity(self, obs: FleetObservation) -> float:
+        """Worst breach ratio across configured signals — how many
+        bounded steps the scale-up takes (a 3x TTFT breach should not
+        crawl up one quantum per cooldown window)."""
+        p = self.policy
+        worst = 1.0
+        if p.target_ttft_s > 0 and obs.ttft_p95 is not None:
+            worst = max(worst, obs.ttft_p95 / p.target_ttft_s)
+        if p.target_queue_wait_s > 0 and obs.queue_wait_p95 is not None:
+            worst = max(worst, obs.queue_wait_p95 / p.target_queue_wait_s)
+        util = obs.tokens_per_slot
+        if p.util_high > 0 and util is not None:
+            worst = max(worst, util / p.util_high)
+        return worst
+
+    def _scale_up(self, obs: FleetObservation, cur: int, now: float,
+                  reasons: List[str]) -> Decision:
+        p = self.policy
+        reason = ",".join(reasons)
+        if cur >= p.max_replicas:
+            return Decision(obs.seq, ACTION_HOLD, cur, cur,
+                            f"at_max {reason}")
+        if self._last_up_t is not None \
+                and now - self._last_up_t < p.scale_up_cooldown_s:
+            return Decision(obs.seq, ACTION_HOLD, cur, cur,
+                            f"up_cooldown {reason}")
+        if self._last_down_t is not None \
+                and now - self._last_down_t < p.flap_guard_s:
+            return Decision(obs.seq, ACTION_HOLD, cur, cur,
+                            f"flap_damped {reason}")
+        steps = min(p.max_step, max(1, int(self._severity(obs))))
+        target = cur
+        for _ in range(steps):
+            nxt = self._step_up(target)
+            if nxt is None or nxt > p.max_replicas:
+                break
+            target = nxt
+        if target == cur:
+            # the next legal quantum overshoots max_replicas: an
+            # integer-mode policy would have stepped, a slice-legal one
+            # is simply capped here
+            return Decision(obs.seq, ACTION_HOLD, cur, cur,
+                            f"at_max_legal {reason}")
+        return Decision(obs.seq, ACTION_UP, cur, target, reason)
+
+    def _down_ok(self, obs: FleetObservation, cur: int) -> bool:
+        """Scale-down needs EVERY configured signal comfortably low.
+        Missing latency data (no recent requests) counts as low only
+        when the load gauges prove the fleet idle — absent data alone
+        must never read as fast."""
+        p = self.policy
+        h = 1.0 - p.hysteresis
+        idle = obs.queue_depth == 0 and obs.inflight_tokens == 0
+        if not (p.target_ttft_s > 0 or p.target_queue_wait_s > 0
+                or p.util_low > 0):
+            # no scale-down signal configured at all: a zero-signal
+            # policy must hold, not ratchet a live fleet to min on
+            # "queue happens to be empty"
+            return False
+        if obs.ready_replicas < cur:
+            return False   # world still assembling — never shrink into it
+        if obs.queue_depth > 0:
+            return False
+        if p.target_ttft_s > 0:
+            if obs.ttft_p95 is None:
+                if not idle:
+                    return False
+            elif obs.ttft_p95 >= p.target_ttft_s * h:
+                return False
+        if p.target_queue_wait_s > 0:
+            if obs.queue_wait_p95 is None:
+                if not idle:
+                    return False
+            elif obs.queue_wait_p95 >= p.target_queue_wait_s * h:
+                return False
+        if p.util_low > 0:
+            util = obs.tokens_per_slot
+            if util is None or util >= p.util_low:
+                return False
+        return True
+
+    def _scale_down(self, obs: FleetObservation, cur: int,
+                    now: float) -> Decision:
+        p = self.policy
+        floor = max(p.min_replicas, p.min_warm)
+        reason = (f"underutilized ttft_p95={_fmt(obs.ttft_p95)} "
+                  f"tokens_per_slot={_fmt(obs.tokens_per_slot)}")
+        if cur <= floor:
+            return Decision(obs.seq, ACTION_HOLD, cur, cur, "at_floor")
+        if self._last_down_t is not None \
+                and now - self._last_down_t < p.scale_down_cooldown_s:
+            return Decision(obs.seq, ACTION_HOLD, cur, cur,
+                            f"down_cooldown {reason}")
+        if self._last_up_t is not None \
+                and now - self._last_up_t < p.flap_guard_s:
+            return Decision(obs.seq, ACTION_HOLD, cur, cur,
+                            f"flap_damped {reason}")
+        nxt = self._step_down(cur)
+        if nxt is None or nxt < floor:
+            # the next quantum undershoots the floor: land on the
+            # smallest legal count satisfying it instead (a raw clamp
+            # to `floor` could emit a slice-illegal target)
+            nxt = self.legalize_up(floor)
+        if nxt >= cur:
+            return Decision(obs.seq, ACTION_HOLD, cur, cur, "at_floor")
+        return Decision(obs.seq, ACTION_DOWN, cur, nxt, reason)

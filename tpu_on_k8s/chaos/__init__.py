@@ -28,6 +28,8 @@ typically via ``with FaultInjector(rules, seed=s):``.
 from tpu_on_k8s.chaos.faults import (
     SITE_APISERVER_REQUEST,
     SITE_APISERVER_WATCH,
+    SITE_AUTOSCALE_PATCH,
+    SITE_AUTOSCALE_SIGNAL,
     SITE_FLEET_REPLICA,
     SITE_FLEET_ROLLOUT,
     SITE_RECONCILE,
@@ -52,6 +54,7 @@ from tpu_on_k8s.chaos.faults import (
     ReplicaCrash,
     RolloutInterrupt,
     SaveFailure,
+    SignalOutage,
     SlicePreempt,
     StepFailure,
     TimeoutFault,
@@ -73,6 +76,8 @@ from tpu_on_k8s.chaos.injector import (
 __all__ = [
     "SITE_APISERVER_REQUEST",
     "SITE_APISERVER_WATCH",
+    "SITE_AUTOSCALE_PATCH",
+    "SITE_AUTOSCALE_SIGNAL",
     "SITE_FLEET_REPLICA",
     "SITE_FLEET_ROLLOUT",
     "SITE_RECONCILE",
@@ -99,6 +104,7 @@ __all__ = [
     "ReplicaCrash",
     "RolloutInterrupt",
     "SaveFailure",
+    "SignalOutage",
     "SlicePreempt",
     "StepFailure",
     "TimeoutFault",

@@ -18,6 +18,8 @@ API.
 | serve.engine.step      | ContinuousBatchingEngine.step       | EngineCrash, EngineStall |
 | serve.fleet.replica    | ServingFleet.step (per replica)     | ReplicaCrash, ReadinessFlap |
 | serve.fleet.rollout    | ServingFleet rollout transitions    | RolloutInterrupt |
+| autoscale.signal       | FleetAutoscaler signal scrape       | SignalOutage |
+| autoscale.patch        | FleetAutoscaler spec.replicas patch | Conflict, HttpError, TimeoutFault |
 | train.step             | TrainLoop.run (per dispatch)        | StepFailure |
 | train.save             | TrainLoop._enqueue_save             | SaveFailure |
 | train.preempt          | TrainLoop.run (per iteration)       | PreemptNotice |
@@ -44,6 +46,8 @@ SITE_FLEET_ROLLOUT = "serve.fleet.rollout"
 SITE_TRAIN_STEP = "train.step"
 SITE_TRAIN_SAVE = "train.save"
 SITE_TRAIN_PREEMPT = "train.preempt"
+SITE_AUTOSCALE_SIGNAL = "autoscale.signal"
+SITE_AUTOSCALE_PATCH = "autoscale.patch"
 
 
 class ChaosStepError(RuntimeError):
@@ -201,6 +205,16 @@ class RolloutInterrupt(Fault):
     with every in-flight request reaching a typed terminal state."""
 
     kind: ClassVar[str] = "rollout_interrupt"
+
+
+@dataclasses.dataclass(frozen=True)
+class SignalOutage(Fault):
+    """The autoscaler's fleet scrape fails (dead metrics endpoint, log
+    tail outage): the tick records a dead sample. The recovery under
+    test is the signal layer's staleness contract — "no data" must hold
+    last-known-good, never read as "zero load, scale to min"."""
+
+    kind: ClassVar[str] = "signal_outage"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -139,6 +139,40 @@ def fleet_rollout_chaos(*, flap_replica: str = "replica-0",
     ), seed)
 
 
+def autoscale_under_crash(replica: str = "replica-1", *,
+                          crash_at: int = 3,
+                          outage_at: Tuple[int, ...] = (2, 3),
+                          conflict_at: Tuple[int, ...] = (),
+                          seed: int = 0) -> Scenario:
+    """A burst is in flight, the autoscaler is mid-reaction — and then a
+    serving replica dies (fleet step ``crash_at`` of that replica), the
+    signal scrape blacks out for the ticks in ``outage_at``, and
+    (optionally) the ``spec.replicas`` patch hits write conflicts.
+    Recovery under test: the crashed replica's requests re-route with
+    zero silent loss, the stale window HOLDS last-known-good instead of
+    scaling to min, a failed patch burns no cooldown, and the loop still
+    converges to the SLO-satisfying replica count without oscillating
+    (no up→down→up thrash) — the acceptance scenario for
+    `controller/fleetautoscaler.py`."""
+    rules = [
+        FaultRule(faults.SITE_FLEET_REPLICA,
+                  Trigger(at=(crash_at,), match={"replica": replica}),
+                  faults.ReplicaCrash(),
+                  note=f"crash {replica} mid-burst"),
+    ]
+    if outage_at:
+        rules.append(FaultRule(faults.SITE_AUTOSCALE_SIGNAL,
+                               Trigger(at=outage_at),
+                               faults.SignalOutage(),
+                               note="black out the fleet scrape"))
+    if conflict_at:
+        rules.append(FaultRule(faults.SITE_AUTOSCALE_PATCH,
+                               Trigger(at=conflict_at),
+                               faults.Conflict(),
+                               note="conflict the replicas patch"))
+    return Scenario("autoscale-under-crash", tuple(rules), seed)
+
+
 def train_preemption(at_step: int, *, fail_save: bool = False,
                      seed: int = 0) -> Scenario:
     """Deliver a SIGTERM-style preemption notice before training step

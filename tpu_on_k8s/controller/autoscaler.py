@@ -28,7 +28,7 @@ Observation line format (what ``tpu_on_k8s.train`` emits):
 """
 from __future__ import annotations
 
-import re
+import math
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -36,6 +36,7 @@ from typing import Dict, List, Optional
 from tpu_on_k8s.api import constants
 from tpu_on_k8s.api.core import Pod, PodPhase
 from tpu_on_k8s.api.types import ElasticStatus, TaskType, TPUJob
+from tpu_on_k8s.autoscale.signals import KV_RE, METRICS_TAG
 from tpu_on_k8s.client.cluster import InMemoryCluster, NotFoundError
 from tpu_on_k8s.controller.config import JobControllerConfig
 from tpu_on_k8s.controller.elastic import ElasticController, apply_host_count
@@ -46,8 +47,12 @@ from tpu_on_k8s.utils.logging import get_logger
 
 _log = get_logger("autoscaler")
 
-METRICS_TAG = "[elastic-metrics]"
-_KV_RE = re.compile(r"(\w+)=([-+.\deE]+)")
+# The observation-line vocabulary lives in `autoscale/signals.py` (one
+# home, stdlib-only). Values are captured loosely (any non-space run)
+# and validated by float() below: the old numeric-class pattern silently
+# extracted digit fragments out of malformed values ("latency=x1.5"
+# parsed as 1.5) instead of rejecting the line.
+_KV_RE = KV_RE
 
 
 @dataclass
@@ -62,29 +67,47 @@ class MetricObservation:
 
 
 def parse_observation(line: str) -> Optional[MetricObservation]:
-    """Parse a ``[elastic-metrics] key=value ...`` line; None if not one."""
+    """Parse a ``[elastic-metrics] key=value ...`` line; None if not one.
+
+    Rejected outright (None, never a zeroed observation): a missing or
+    malformed ``latency``, a negative latency, and the non-finite
+    ``nan``/``inf`` sentinels — ``latency=nan`` is how an emitter with
+    no samples yet says "no data" (`serve/fleet.observation_line`), and
+    folding it in as a number would read as infinitely fast and scale
+    the consumer straight to min. Duplicate keys keep the LAST value
+    (the rightmost write wins, like repeated flag parsing)."""
     if METRICS_TAG not in line:
         return None
     fields = {k: v for k, v in _KV_RE.findall(line)}
     if "latency" not in fields:
         return None
     try:
-        return MetricObservation(
+        latency = float(fields["latency"])
+        obs = MetricObservation(
             epoch=int(float(fields.get("epoch", 0))),
             batch=int(float(fields.get("batch", 0))),
-            latency=float(fields["latency"]),
+            latency=latency,
             accuracy=float(fields.get("accuracy", 0.0)),
         )
-    except ValueError:
+    except (ValueError, OverflowError):
+        # OverflowError: int(float("9e999")) — an absurd epoch/batch is
+        # as malformed as a non-numeric one
         return None
+    if not math.isfinite(latency) or latency < 0.0:
+        return None
+    return obs
 
 
 def is_satisfy_elastic_continue(last_replicas: int, last_latency: float,
                                 cur_replicas: int, cur_latency: float) -> bool:
     """The throughput test (reference torchelastic job.go:94-100): keep
-    growing while latency-per-replica improves."""
+    growing while latency-per-replica improves. Both denominators are
+    guarded: a zero-replica current world has no throughput to compare
+    (the reference would divide by zero here) — never "keep growing"."""
     if last_replicas <= 0:
         return True
+    if cur_replicas <= 0:
+        return False
     return last_latency / last_replicas > cur_latency / cur_replicas
 
 

@@ -23,6 +23,14 @@ class JobControllerConfig:
     quota_assume_ttl_seconds: float = 60.0         # plugins/quota.go:48
     elastic_loop_period_seconds: float = 30.0      # elastictorchjob_controller.go:60
     elastic_metric_count: int = 5
+    # Serving autoscaler (controller/fleetautoscaler.py): tick period,
+    # scrapes aggregated per observation window, consecutive dead scrapes
+    # before the signal is stale (hold, don't scale), and the pod-log tail
+    # depth the out-of-process signal source reads per tick.
+    serving_autoscale_period_seconds: float = 15.0
+    autoscale_window_scrapes: int = 4
+    autoscale_stale_scrapes: int = 3
+    autoscale_log_tail: int = 20
     # Consecutive autoscaler ticks tolerating Pending pods at a grown size
     # before reverting (the reference polls up to 1min, elastic_scale.go:440).
     elastic_pending_grace_ticks: int = 2

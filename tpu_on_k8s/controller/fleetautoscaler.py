@@ -1,0 +1,400 @@
+"""FleetAutoscaler: close the fleet-load → ``InferenceService.replicas`` loop.
+
+The serving twin of `controller/autoscaler.ElasticAutoscaler` — a second
+control loop over the ``InferenceService`` CRD that *decides* replica
+counts from observed serving load, while the existing reconciler
+(`controller/inferenceservice.py`) *executes* the resulting spec change
+with its surge/drain machinery:
+
+* per registered service (``spec.autoscale`` set), every tick: collect
+  one ``FleetSample`` — from an attached in-process ``ServingFleet``
+  (`autoscale/signals.FleetScraper` delta-reads its per-replica
+  histograms) or by tailing replica pod logs for the extended
+  ``[elastic-metrics]`` observation line the fleet prints
+  (`serve/fleet.ServingFleet.observation_line`);
+* fold the window into a ``FleetObservation``
+  (`autoscale/signals.SignalAggregator` — dead scrapes mark the window
+  stale, never zero);
+* run the deterministic target-tracking policy
+  (`autoscale/policy.Recommender`: SLO targets, utilization band,
+  slice-legal steps, hysteresis, cooldowns, flap damping, warm floor);
+* execute: patch ``spec.replicas`` through the cluster client (the
+  reconciler and/or an attached fleet's ``scale_to`` do the rest), write
+  the decision into ``status.desired_replicas`` / ``autoscale_message``,
+  and append one stable line to ``decision_log`` — the byte-comparable
+  artifact `make autoscale-soak` replays.
+
+Failure discipline: a chaos/genuine scrape failure records a dead sample
+(staleness holds last-known-good); a failed patch
+(``SITE_AUTOSCALE_PATCH``) burns NO cooldown — ``Recommender.commit``
+runs only after the write lands — so the loop retries at full speed next
+tick instead of sulking through a cooldown it never used.
+
+``run_once()`` is the deterministic unit tests and soak drive; ``run()``
+wraps it in a thread at ``serving_autoscale_period_seconds`` cadence,
+wired in `main.py` beside the elastic autoscaler.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from tpu_on_k8s import chaos
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.api.core import Pod
+from tpu_on_k8s.api.inference_types import InferenceService
+from tpu_on_k8s.autoscale.policy import ACTION_HOLD, Recommender
+from tpu_on_k8s.autoscale.signals import (
+    FleetSample,
+    FleetScraper,
+    SignalAggregator,
+    dead_sample,
+    line_watermark,
+    sample_from_line,
+)
+from tpu_on_k8s.client.cluster import InMemoryCluster, NotFoundError
+from tpu_on_k8s.controller.config import JobControllerConfig
+from tpu_on_k8s.metrics.metrics import AutoscaleMetrics
+from tpu_on_k8s.utils.logging import get_logger
+
+_log = get_logger("fleetautoscaler")
+
+
+class _ServiceState:
+    """Per-service loop state: the policy's cooldown stamps live in the
+    recommender; the aggregator owns the signal window; ``fleet`` is the
+    optional in-process execution target (single-binary serving)."""
+
+    def __init__(self) -> None:
+        self.recommender: Optional[Recommender] = None
+        self.policy_key: Optional[Tuple] = None
+        self.aggregator: Optional[SignalAggregator] = None
+        self.scraper = FleetScraper()
+        self.fleet = None
+        self.apply_to_fleet = True
+        self.seq = 0                 # one counter across live AND dead scrapes
+        #: newest observation-line batch consumed, PER POD — every pod's
+        #: fleet runs its own step counter, so one shared watermark would
+        #: permanently blind the scrape to any pod that started later
+        self.watermark: Dict[str, int] = {}
+
+
+class FleetAutoscaler:
+    """See module doc. One instance watches every autoscaled
+    ``InferenceService`` in the cluster."""
+
+    def __init__(self, cluster: InMemoryCluster,
+                 config: Optional[JobControllerConfig] = None,
+                 metrics: Optional[AutoscaleMetrics] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.cluster = cluster
+        self.config = config or JobControllerConfig()
+        self.metrics = metrics
+        self.clock = clock
+        #: stable one-line-per-decision record (byte-identical across two
+        #: runs of the same seeded trace — the autoscale-soak contract).
+        #: Bounded: one line per service per tick accrues forever on a
+        #: long-lived operator, and a soak fits well inside the cap.
+        self.decision_log: Deque[str] = deque(maxlen=10_000)
+        self._lock = threading.Lock()
+        self._services: Dict[str, _ServiceState] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ registration
+    def register(self, svc: InferenceService) -> None:
+        if svc.spec.autoscale is None:
+            return
+        key = f"{svc.metadata.namespace}/{svc.metadata.name}"
+        with self._lock:
+            self._services.setdefault(key, _ServiceState())
+
+    def deregister(self, svc: InferenceService) -> None:
+        key = f"{svc.metadata.namespace}/{svc.metadata.name}"
+        with self._lock:
+            self._services.pop(key, None)
+
+    def observe_event(self, event) -> None:
+        """Watch glue: register on ADDED/MODIFIED (the autoscale block
+        may be added to an existing service), deregister on DELETED."""
+        if event.kind != constants.KIND_INFERENCESERVICE:
+            return
+        if event.type in ("ADDED", "MODIFIED"):
+            self.register(event.obj)
+        elif event.type == "DELETED":
+            self.deregister(event.obj)
+
+    def registered(self) -> List[str]:
+        with self._lock:
+            return sorted(self._services)
+
+    def attach_fleet(self, namespace: str, name: str, fleet, *,
+                     apply: bool = True) -> None:
+        """Bind an in-process ``ServingFleet`` as both the signal source
+        (scraped directly, no log round-trip) and — with ``apply`` — the
+        execution target (``fleet.scale_to`` after each committed
+        patch). Single-binary deployments and the deterministic
+        end-to-end tests use this; the CRD-only path tails pod logs."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            state = self._services.setdefault(key, _ServiceState())
+            state.fleet = fleet
+            state.apply_to_fleet = apply
+
+    # ------------------------------------------------------------ decision loop
+    def run_once(self) -> None:
+        with self._lock:
+            items = sorted(self._services.items())
+        for key, state in items:
+            ns, name = key.split("/", 1)
+            svc = self.cluster.try_get(InferenceService, ns, name)
+            if svc is None or svc.spec.autoscale is None:
+                with self._lock:
+                    self._services.pop(key, None)
+                continue
+            try:
+                self._tick(key, svc, state)
+            except NotFoundError:
+                continue
+
+    def _tick(self, key: str, svc: InferenceService,
+              state: _ServiceState) -> None:
+        self._ensure_policy(svc, state)
+        if self.metrics is not None:
+            self.metrics.inc("ticks")
+
+        sample = self._collect(key, svc, state)
+        obs = state.aggregator.record(sample)
+        cur = max(int(svc.spec.replicas), 0)
+        now = self.clock()
+        decision = state.recommender.decide(obs, cur, now)
+        self._record(key, svc, obs, decision)
+        if decision.action == ACTION_HOLD or decision.target == cur:
+            return
+
+        # execute: the patch is the commit point — chaos (and real
+        # conflicts) before it mean the scale never happened, so no
+        # cooldown is burned and next tick retries at full speed
+        fault = chaos.fire(chaos.SITE_AUTOSCALE_PATCH, service=key,
+                           target=decision.target)
+        try:
+            if fault is not None:
+                raise fault.to_exception()
+
+            def mutate(s: InferenceService) -> None:
+                s.spec.replicas = decision.target
+
+            self.cluster.update_with_retry(
+                InferenceService, svc.metadata.namespace, svc.metadata.name,
+                mutate)
+        except Exception as e:  # noqa: BLE001 — typed below, loop survives
+            self.decision_log.append(
+                f"svc={key} seq={decision.seq} patch_failed "
+                f"{type(e).__name__}")
+            if self.metrics is not None:
+                self.metrics.inc("patch_failures")
+            _log.warning("replicas patch for %s failed: %s", key, e)
+            return
+        state.recommender.commit(decision, now)
+        if self.metrics is not None:
+            # the gauge tracks COMMITTED targets only — set after the
+            # patch lands, so a failed write never reports a phantom
+            # pending scale
+            self.metrics.set_gauge("desired_replicas", decision.target,
+                                   label=key)
+        self._write_status(svc, decision)
+        self.cluster.record_event(
+            svc, "Normal", "AutoscaleReplicas",
+            f"fleet autoscaler: {decision.current} -> {decision.target} "
+            f"({decision.reason})")
+        if state.fleet is not None and state.apply_to_fleet:
+            try:
+                state.fleet.scale_to(decision.target)
+            except RuntimeError as e:
+                # a rollout owns desired_replicas right now; the spec
+                # patch stands and the reconciler/fleet converge later
+                _log.warning("fleet scale_to(%d) for %s deferred: %s",
+                             decision.target, key, e)
+
+    # --------------------------------------------------------------- signals
+    def _ensure_policy(self, svc: InferenceService,
+                       state: _ServiceState) -> None:
+        """(Re)build the recommender/aggregator when the service's
+        autoscale block changes — edits apply next tick, but cooldown
+        stamps survive an unchanged policy."""
+        ap = svc.spec.autoscale
+        pkey = (tuple(sorted(vars(ap).items())),
+                svc.spec.tpu_policy.accelerator)
+        if state.policy_key == pkey:
+            return
+        state.policy_key = pkey
+        state.recommender = Recommender(
+            ap, accelerator=svc.spec.tpu_policy.accelerator)
+        state.aggregator = SignalAggregator(
+            window=self.config.autoscale_window_scrapes,
+            stale_after=self.config.autoscale_stale_scrapes)
+
+    def _collect(self, key: str, svc: InferenceService,
+                 state: _ServiceState) -> FleetSample:
+        state.seq += 1   # one monotone counter: dead scrapes count too
+        fault = chaos.fire(chaos.SITE_AUTOSCALE_SIGNAL, service=key)
+        if isinstance(fault, chaos.SignalOutage):
+            if self.metrics is not None:
+                self.metrics.inc("stale_scrapes")
+            return dead_sample(state.seq)
+        if state.fleet is not None:
+            try:
+                return state.scraper.scrape(state.fleet, seq=state.seq)
+            except Exception:  # noqa: BLE001 — a dying fleet is an outage
+                if self.metrics is not None:
+                    self.metrics.inc("stale_scrapes")
+                return dead_sample(state.seq)
+        return self._scrape_logs(svc, state)
+
+    def _scrape_logs(self, svc: InferenceService,
+                     state: _ServiceState) -> FleetSample:
+        """The CRD-plane signal source: tail every replica pod's log for
+        observation lines strictly newer than that POD's watermark
+        (``batch=`` is the emitter's own step counter — monotone per
+        pod, so each line is consumed exactly once; pods start their
+        counters independently, so the watermark must be per pod). Each
+        pod contributes its newest unseen line; the per-pod samples
+        merge into one fleet sample (latencies concatenate, load gauges
+        sum). No pod with a new line = a dead scrape: the fleet may be
+        gone, or just quiet — staleness, not zero."""
+        pods = self.cluster.list(
+            Pod, svc.metadata.namespace,
+            {constants.LABEL_INFERENCESERVICE_NAME: svc.metadata.name})
+        merged: List[FleetSample] = []
+        listed = set()
+        for pod in sorted(pods, key=lambda p: p.metadata.name):
+            listed.add(pod.metadata.name)
+            try:
+                lines = self.cluster.read_pod_log(
+                    pod.metadata.namespace, pod.metadata.name,
+                    tail=self.config.autoscale_log_tail)
+            except NotFoundError:
+                continue
+            # newest observation line in the tail = the LAST parseable
+            # one (the tail is chronological; the batch counter is NOT
+            # globally monotone — it resets when the container restarts)
+            newest = -1
+            newest_sample = None
+            for line in lines:
+                mark = line_watermark(line)
+                if mark is None:
+                    continue
+                sample = sample_from_line(line, state.seq)
+                if sample is not None:
+                    newest, newest_sample = mark, sample
+            seen = state.watermark.get(pod.metadata.name, -1)
+            # newest > seen: fresh data. newest < seen (but exists): the
+            # emitter RESTARTED and its step counter reset — re-anchor
+            # instead of going blind until it re-passes the old mark
+            # (the log-plane twin of FleetScraper's total<n reset).
+            # newest == seen: quiet pod, nothing new.
+            if newest_sample is not None and newest != seen:
+                state.watermark[pod.metadata.name] = newest
+                merged.append(newest_sample)
+        # prune departed pods (rollouts mint fresh names every cycle —
+        # dead entries both leak and hold poisoned marks for any future
+        # pod that reuses the name)
+        for name in list(state.watermark):
+            if name not in listed:
+                del state.watermark[name]
+        if not merged:
+            if self.metrics is not None:
+                self.metrics.inc("stale_scrapes")
+            return dead_sample(state.seq)
+        return FleetSample(
+            seq=state.seq,
+            ttft=tuple(v for s in merged for v in s.ttft),
+            queue_wait=tuple(v for s in merged for v in s.queue_wait),
+            queue_depth=sum(s.queue_depth for s in merged),
+            inflight_tokens=sum(s.inflight_tokens for s in merged),
+            slots=sum(s.slots for s in merged),
+            ready_replicas=sum(s.ready_replicas for s in merged))
+
+    # ------------------------------------------------------------- recording
+    def _record(self, key: str, svc: InferenceService, obs,
+                decision) -> None:
+        self.decision_log.append(f"svc={key} " + decision.line())
+        m = self.metrics
+        if m is None:
+            return
+        m.decision(decision.action)
+        if decision.target == decision.current:
+            # holds confirm the current size; executed scales update the
+            # gauge only once the patch commits (see _tick)
+            m.set_gauge("desired_replicas", decision.target, label=key)
+        m.set_gauge("current_replicas", decision.current, label=key)
+        m.set_gauge("signal_stale", float(obs.stale), label=key)
+        if obs.ttft_p95 is not None:
+            m.set_gauge("observed_ttft_p95", obs.ttft_p95, label=key)
+        if obs.queue_wait_p95 is not None:
+            m.set_gauge("observed_queue_wait_p95", obs.queue_wait_p95,
+                        label=key)
+        m.set_gauge("observed_queue_depth", obs.queue_depth, label=key)
+        if obs.tokens_per_slot is not None:
+            m.set_gauge("observed_tokens_per_slot", obs.tokens_per_slot,
+                        label=key)
+
+    def _write_status(self, svc: InferenceService, decision) -> None:
+        def mutate(s: InferenceService) -> None:
+            s.status.desired_replicas = decision.target
+            s.status.autoscale_message = (
+                f"{decision.action} {decision.current}->"
+                f"{decision.target}: {decision.reason}")
+        try:
+            self.cluster.update_with_retry(
+                InferenceService, svc.metadata.namespace, svc.metadata.name,
+                mutate, subresource="status")
+        except NotFoundError:
+            pass
+
+    # ----------------------------------------------------------------- run loop
+    def run(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.run_once()
+                except Exception:
+                    # same discipline as the elastic loop: a crashing
+                    # tick surfaces in the log, never dies silently —
+                    # under its own counter, not patch_failures (a
+                    # scrape/status/policy crash is not an API write
+                    # failure)
+                    _log.exception("fleet autoscaler tick failed")
+                    if self.metrics is not None:
+                        self.metrics.inc("tick_errors")
+                self._stop.wait(self.config.serving_autoscale_period_seconds)
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name="fleet-autoscaler")
+        t.start()
+        self._thread = t
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2)
+
+
+def setup_fleet_autoscaler(cluster: InMemoryCluster,
+                           config: Optional[JobControllerConfig] = None,
+                           metrics: Optional[AutoscaleMetrics] = None,
+                           clock: Callable[[], float] = time.monotonic
+                           ) -> FleetAutoscaler:
+    """Wire the autoscaler's service registry to the cluster watch (the
+    serving twin of ``setup_elastic_autoscaler``)."""
+    scaler = FleetAutoscaler(cluster, config=config, metrics=metrics,
+                             clock=clock)
+    cluster.watch(scaler.observe_event)
+    return scaler

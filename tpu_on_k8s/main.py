@@ -2,8 +2,9 @@
 
 Mirrors the reference operator's process wiring (main.go:50-120): parse
 flags and feature gates, construct the cluster client, register the gang
-scheduler, wire every controller (TPUJob, elastic, autoscaler, ModelVersion),
-start the coordinator loop and the metrics server, then run the manager.
+scheduler, wire every controller (TPUJob, elastic, autoscaler, ModelVersion,
+InferenceService, the serving fleet autoscaler), start the coordinator loop
+and the metrics server, then run the manager.
 
 The cluster backend is pluggable: the in-process `InMemoryCluster` is the
 default (tests / local driver — the analog of envtest); a real GKE backend
@@ -27,6 +28,7 @@ from tpu_on_k8s.controller.autoscaler import setup_elastic_autoscaler
 from tpu_on_k8s.controller.config import JobControllerConfig
 from tpu_on_k8s.controller.elastic import ElasticController
 from tpu_on_k8s.controller.failover import CRRRestarter, InMemoryRestarter
+from tpu_on_k8s.controller.fleetautoscaler import setup_fleet_autoscaler
 from tpu_on_k8s.controller.inferenceservice import (
     setup_inferenceservice_controller,
 )
@@ -36,7 +38,7 @@ from tpu_on_k8s.controller.tpujob import setup_tpujob_controller
 from tpu_on_k8s.coordinator.core import Coordinator
 from tpu_on_k8s.features import features
 from tpu_on_k8s.gang.scheduler import GANG_SCHEDULER_NAME, default_registry
-from tpu_on_k8s.metrics.metrics import JobMetrics, serve
+from tpu_on_k8s.metrics.metrics import AutoscaleMetrics, JobMetrics, serve
 
 
 def parse_port_range(spec: str) -> Tuple[int, int]:
@@ -62,6 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
     # tunables the reference hard-coded (SURVEY §5.6)
     p.add_argument("--coordinator-period-seconds", type=float, default=0.1)
     p.add_argument("--elastic-loop-period-seconds", type=float, default=30.0)
+    p.add_argument("--serving-autoscale-period-seconds", type=float,
+                   default=15.0,
+                   help="Tick period of the serving SLO autoscaler "
+                        "(InferenceServices with spec.autoscale set)")
     p.add_argument("--once", action="store_true",
                    help="Pump controllers to quiescence and exit (smoke mode)")
     p.add_argument("--leader-elect", default=False,
@@ -244,6 +250,8 @@ class Operator:
             model_image_builder=args.model_image_builder,
             coordinator_period_seconds=args.coordinator_period_seconds,
             elastic_loop_period_seconds=args.elastic_loop_period_seconds,
+            serving_autoscale_period_seconds=getattr(
+                args, "serving_autoscale_period_seconds", 15.0),
         )
 
         gang = None
@@ -268,6 +276,15 @@ class Operator:
             self.cluster, self.manager, config=self.config)
         self.inferenceservice = setup_inferenceservice_controller(
             self.cluster, self.manager, config=self.config)
+        # the serving twin of the elastic autoscaler: fleet load →
+        # InferenceService.spec.replicas (controller/fleetautoscaler.py).
+        # Shares the operator's registry so --metrics-port scrapes the
+        # autoscale series alongside the job series.
+        self.autoscale_metrics = AutoscaleMetrics(
+            registry=self.metrics.registry)
+        self.fleetautoscaler = setup_fleet_autoscaler(
+            self.cluster, config=self.config,
+            metrics=self.autoscale_metrics)
         self.scheduler_loop = None
         if getattr(args, "enable_slice_scheduler", False):
             from tpu_on_k8s.gang.scheduler import (
@@ -309,6 +326,7 @@ class Operator:
             if self.coordinator is not None:
                 self.coordinator.run()
             self.autoscaler.run()
+            self.fleetautoscaler.run()
             if self.scheduler_loop is not None:
                 self.scheduler_loop.run()
 
@@ -323,6 +341,7 @@ class Operator:
             if self.coordinator is not None:
                 self.coordinator.stop()
             self.autoscaler.stop()
+            self.fleetautoscaler.stop()
             if self.scheduler_loop is not None:
                 self.scheduler_loop.stop()
             self.manager.stop()

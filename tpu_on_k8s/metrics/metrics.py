@@ -38,6 +38,10 @@ class _MetricsBase:
         cap = self.MIRROR_CAP
         self.histograms: Dict[str, deque] = defaultdict(
             lambda: deque(maxlen=cap))
+        # monotone observation counts per histogram: the bounded mirror
+        # rotates at cap (len() freezes), so delta readers (the
+        # autoscaler's FleetScraper) position by THIS, never by len()
+        self.histogram_counts: Dict[str, int] = defaultdict(int)
         self._prom_counters = {}
         self._prom_hists = {}
         self._prom_gauges = {}
@@ -60,6 +64,7 @@ class _MetricsBase:
     def observe(self, name: str, seconds: float) -> None:
         with self._lock:
             self.histograms[name].append(seconds)
+            self.histogram_counts[name] += 1
         h = self._prom_hists.get(name)
         if h is not None:
             h.observe(seconds)
@@ -228,10 +233,12 @@ class FleetMetrics(_MetricsBase):
     ROLLOUT_PHASE_CODES = {"idle": 0, "surging": 1, "shifting": 2,
                            "draining": 3, "complete": 4}
 
-    _LABELED_COUNTERS = ("requests_routed", "requests_rerouted")
+    _LABELED_COUNTERS = ("requests_routed", "requests_rerouted",
+                         "requests_rebalanced")
     _PLAIN_COUNTERS = ("replicas_ejected", "prefix_cache_hits",
                        "prefix_cache_misses", "rollout_interrupts",
-                       "rollouts_completed", "readiness_flaps")
+                       "rollouts_completed", "readiness_flaps",
+                       "scale_ups", "scale_downs")
     _LABELED_GAUGES = ("in_flight", "queue_depth", "outstanding_tokens")
     _PLAIN_GAUGES = ("replicas_ready", "replicas_total", "rollout_phase")
 
@@ -277,6 +284,63 @@ class FleetMetrics(_MetricsBase):
     def set_rollout_phase(self, phase: str) -> None:
         self.set_gauge("rollout_phase",
                        self.ROLLOUT_PHASE_CODES.get(phase, -1))
+
+
+class AutoscaleMetrics(_MetricsBase):
+    """Serving-autoscaler observability (`controller/fleetautoscaler.py`
+    + `tpu_on_k8s/autoscale/`): every decision (labelled by action, so a
+    thrashing loop is visible as alternating up/down increments), patch
+    failures, stale scrapes, and per-service gauges for the closed
+    loop's input (observed TTFT/queue-wait p95, queue depth, tokens per
+    slot) next to its output (``desired_replicas``) — an operator can
+    read SLO breach → decision → target off one scrape. Mirror dicts
+    key by ``(name, label)`` like ``JobMetrics``."""
+
+    _ACTION_COUNTERS = ("decisions",)
+    _PLAIN_COUNTERS = ("patch_failures", "stale_scrapes", "ticks",
+                       "tick_errors")
+    _SERVICE_GAUGES = ("desired_replicas", "current_replicas",
+                       "observed_ttft_p95", "observed_queue_wait_p95",
+                       "observed_queue_depth", "observed_tokens_per_slot",
+                       "signal_stale")
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        self.counters: Dict[Tuple[str, str], int] = defaultdict(int)
+        self.gauges: Dict[Tuple[str, str], float] = {}
+        if _prom is not None:
+            registry = registry or _prom.CollectorRegistry()
+            self.registry = registry
+            ns = "tpu_on_k8s_autoscale"
+            for name in self._ACTION_COUNTERS:
+                self._prom_counters[name] = _prom.Counter(
+                    f"{ns}_{name}", f"Autoscale {name}", ["action"],
+                    registry=registry)
+            for name in self._PLAIN_COUNTERS:
+                self._prom_counters[name] = _prom.Counter(
+                    f"{ns}_{name}", f"Autoscale {name}", registry=registry)
+            for name in self._SERVICE_GAUGES:
+                self._prom_gauges[name] = _prom.Gauge(
+                    f"{ns}_{name}", f"Autoscale {name}", ["service"],
+                    registry=registry)
+
+    def inc(self, name: str, n: int = 1, label: str = "") -> None:
+        with self._lock:
+            self.counters[(name, label)] += n
+        c = self._prom_counters.get(name)
+        if c is not None:
+            (c.labels(label) if name in self._ACTION_COUNTERS else c).inc(n)
+
+    def set_gauge(self, name: str, value: float, label: str = "") -> None:
+        with self._lock:
+            self.gauges[(name, label)] = value
+        g = self._prom_gauges.get(name)
+        if g is not None:
+            (g.labels(label) if name in self._SERVICE_GAUGES else g).set(
+                value)
+
+    def decision(self, action: str) -> None:
+        self.inc("decisions", label=action)
 
 
 def exposition(metrics) -> str:

@@ -189,6 +189,8 @@ class ServingFleet:
         self._next_rid = 0
         self._next_ordinal = 0
         self._accepting = True
+        self._scaledown: set = set()      # replica names draining for scale-down
+        self._obs_scraper = None          # observation_line's delta reader
         self._rollout = None              # type: Optional[_Rollout]
         self.rollout_phase = RolloutPhase.IDLE
         #: records of removed replicas: {"name", "version", "reason",
@@ -197,7 +199,8 @@ class ServingFleet:
         self.stats = {"steps": 0, "routed": 0, "rerouted": 0,
                       "ejected": 0, "prefix_hits": 0, "prefix_misses": 0,
                       "readiness_flaps": 0, "rollout_interrupts": 0,
-                      "rollouts_completed": 0}
+                      "rollouts_completed": 0, "scale_ups": 0,
+                      "scale_downs": 0, "rebalanced": 0}
         self._lock = threading.Lock()
         for _ in range(n_replicas):
             self._add_replica(engine_factory, version)
@@ -233,6 +236,7 @@ class ServingFleet:
         rep.engine = None
         rep.gateway = None
         rep.prefix_ids.clear()
+        self._scaledown.discard(rep.name)
         if self.metrics is not None:
             # zero the dead replica's labelled gauges — a retired series
             # frozen at its last value reads as phantom load forever
@@ -241,6 +245,107 @@ class ServingFleet:
 
     def _ready_names(self) -> List[str]:
         return [r.name for r in self.replicas.values() if r.routable]
+
+    @staticmethod
+    def _ordinal(name: str) -> int:
+        try:
+            return int(name.rsplit("-", 1)[-1])
+        except ValueError:
+            return -1
+
+    def scale_to(self, n: int,
+                 factory: Optional[Callable[[str], object]] = None) -> int:
+        """Resize the fleet to ``n`` replicas of the current serving
+        version (the execution half of the SLO autoscaler's loop —
+        `controller/fleetautoscaler.py` calls this after patching
+        ``InferenceService.spec.replicas``; the CRD-plane twin is the
+        reconciler's surge/drain machinery).
+
+        Scale-up adds fresh replicas immediately (they earn readiness
+        through slow start before taking traffic). Scale-down NEVER
+        removes a replica outright: victims — not-yet-ready replicas
+        first (nothing routed at them), then the highest-ordinal ready
+        ones — are marked DRAINING (``stop_accepting``; in-flight work
+        finishes) and only reaped by ``step()`` once their gateway is
+        empty, and a READY victim is only marked while the remaining
+        ready count stays >= ``n`` (the ready floor). Returns the
+        number of replicas added (+) or marked draining (-).
+        Refused mid-rollout: two machines moving ``desired_replicas``
+        at once cannot both be right."""
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        with self._lock:
+            if self._rollout is not None:
+                raise RuntimeError("cannot scale during a rollout")
+            self.desired_replicas = n
+            live = [r for r in self.replicas.values()
+                    if r.state in (ReplicaState.STARTING, ReplicaState.READY)]
+            cur = len(live)
+            if n > cur:
+                need = n - cur
+                # reclaim still-draining scale-down victims first: a
+                # warm engine already holding its weights beats minutes
+                # of fresh-replica spin-up, and leaving it to drain
+                # while minting a replacement would transiently hold
+                # more slices than the operator configured
+                for name in sorted(self._scaledown, key=self._ordinal):
+                    if need <= 0:
+                        break
+                    rep = self.replicas.get(name)
+                    if rep is None or rep.state is not ReplicaState.DRAINING:
+                        continue
+                    rep.gateway.resume_accepting()
+                    rep.state = (ReplicaState.READY if rep.health.ready
+                                 else ReplicaState.STARTING)
+                    self._scaledown.discard(name)
+                    need -= 1
+                for _ in range(need):
+                    self._add_replica(factory or self._factory, self.version)
+                self.stats["scale_ups"] += 1
+                if self.metrics is not None:
+                    self.metrics.inc("scale_ups")
+                return n - cur
+            if n == cur:
+                return 0
+            excess = cur - n
+            ready = sum(1 for r in live if r.state is ReplicaState.READY)
+            victims: List[Replica] = []
+            starting = sorted(
+                (r for r in live if r.state is ReplicaState.STARTING),
+                key=lambda r: -self._ordinal(r.name))
+            victims.extend(starting[:excess])
+            for rep in sorted(
+                    (r for r in live if r.state is ReplicaState.READY),
+                    key=lambda r: -self._ordinal(r.name)):
+                if len(victims) >= excess:
+                    break
+                if ready - 1 < n:
+                    break      # ready floor: keep n replicas routable
+                ready -= 1
+                victims.append(rep)
+            for rep in victims:
+                rep.state = ReplicaState.DRAINING
+                rep.gateway.stop_accepting()
+                self._scaledown.add(rep.name)
+            if victims:
+                self.stats["scale_downs"] += 1
+                if self.metrics is not None:
+                    self.metrics.inc("scale_downs")
+            return -len(victims)
+
+    def _reap_scaledown_locked(self) -> None:
+        """Retire scale-down victims whose drain finished: gateway empty
+        means every routed request reached a typed terminal state — the
+        zero-silent-loss half of the scale-down contract."""
+        for name in sorted(self._scaledown):
+            rep = self.replicas.get(name)
+            if rep is None or rep.state is not ReplicaState.DRAINING:
+                self._scaledown.discard(name)
+                continue
+            if rep.gateway is not None and not rep.gateway.has_live_requests:
+                self._retire_replica(rep, state=ReplicaState.STOPPED,
+                                     reason="scale-down drain complete",
+                                     drained_clean=True)
 
     def _outstanding(self) -> Dict[str, int]:
         return {r.name: r.outstanding for r in self.replicas.values()}
@@ -437,6 +542,67 @@ class ServingFleet:
             return prompt, None, key, prompt[:blen].copy()
         return prompt, None, key, None
 
+    def _rebalance_locked(self) -> None:
+        """Queued work is pinned to the gateway it was dispatched into —
+        so fresh capacity (a scale-up, a rollout surge, a replica back
+        from a flap) would sit idle while an older replica's queue
+        drains alone, and the SLO breach that triggered the scale-up
+        would never heal. When a ready replica has free slots and an
+        empty queue while another active replica holds queued work,
+        evict that backlog (newest first; dispatched work never moves)
+        back to the fleet pending queue — the router re-spreads it onto
+        the idle capacity this same step. Bounded by the idle slot
+        count, so a balanced fleet pays one queue-depth read per
+        replica and moves nothing. Lock held."""
+        ready = [r for r in self.replicas.values() if r.routable]
+        if len(ready) < 2:
+            return
+        idle = [r for r in ready
+                if r.gateway.queue_depth == 0 and r.engine.free_slots > 0]
+        if not idle:
+            return
+        budgets = {r.name: r.engine.free_slots for r in idle}
+        idle_cap = sum(budgets.values())
+        donors = sorted((r for r in ready if r.gateway.queue_depth > 0),
+                        key=lambda r: -r.gateway.queue_depth)
+        for rep in donors:
+            if idle_cap <= 0:
+                break
+            # prefix-warm requests stay: they were pinned here FOR the
+            # warm engine cache, and moving one trades a guaranteed hit
+            # for a cold prefill elsewhere — affinity's imbalance is a
+            # deliberate trade the rebalancer must not undo
+            for sub in rep.gateway.evict_queued(
+                    idle_cap, skip=lambda r: r.prefix_id is not None):
+                rid = self._by_sub.pop((rep.name, sub), None)
+                if rid is None:
+                    continue
+                req = self._requests[rid]
+                rep.outstanding -= req.cost
+                req.replica = None
+                req.sub_rid = None
+                # place directly onto the least-loaded idle replica —
+                # the router's affinity-with-bounded-load would happily
+                # send a small request straight back to the donor it was
+                # just evicted from (its outstanding lead can sit under
+                # spill_tokens while its queue is deep)
+                target = min(
+                    (r for r in idle if budgets[r.name] > 0),
+                    key=lambda r: (r.outstanding, self._ordinal(r.name)),
+                    default=None)
+                r = (self._dispatch_locked(req, target)
+                     if target is not None else None)
+                if target is None or isinstance(r, Rejected):
+                    if rid not in self._pending:
+                        self._pending.append(rid)
+                    continue
+                budgets[target.name] -= 1
+                idle_cap -= 1
+                self.stats["rebalanced"] += 1
+                if self.metrics is not None:
+                    self.metrics.inc("requests_rebalanced",
+                                     replica=target.name)
+
     # -------------------------------------------------------------- ejection
     def _eject_locked(self, rep: Replica, reason: str) -> None:
         """Replica death: remove it from the routable set and move every
@@ -522,6 +688,7 @@ class ServingFleet:
         with self._lock:
             now = self._clock()
             self._advance_rollout_locked(now)
+            self._reap_scaledown_locked()
             active = [r for r in self.replicas.values()
                       if r.state in ACTIVE_STATES]
         for rep in active:
@@ -552,6 +719,7 @@ class ServingFleet:
                     rep.state = (ReplicaState.READY if rep.health.ready
                                  else ReplicaState.STARTING)
         with self._lock:
+            self._rebalance_locked()
             for rid in list(self._pending):
                 req = self._requests[rid]
                 now = self._clock()
@@ -611,7 +779,7 @@ class ServingFleet:
     def run(self) -> Dict[int, RequestResult]:
         """Step until every accepted request is terminal (and any rollout
         in flight completes); claim and return all unclaimed results."""
-        while self._live() or self._rollout is not None:
+        while self._live() or self._rollout is not None or self._scaledown:
             self.step()
         return self._claim_all()
 
@@ -716,6 +884,10 @@ class ServingFleet:
             # version and finish
             self.router.set_weights({ro.version: 1.0})
             self.rollout_phase = RolloutPhase.COMPLETE
+            # future scale-ups must mint the version that WON, not the
+            # one the fleet was constructed with
+            self.version = ro.version
+            self._factory = ro.factory
             self.stats["rollouts_completed"] += 1
             if self.metrics is not None:
                 self.metrics.inc("rollouts_completed")
@@ -784,24 +956,50 @@ class ServingFleet:
     # --------------------------------------------------------- observability
     def observation_line(self) -> str:
         """The fleet's load signal in the ElasticAutoscaler observation
-        format (`controller/autoscaler.parse_observation`):
+        format (`controller/autoscaler.parse_observation`), extended
+        with the keys the serving autoscaler's signal layer consumes
+        (`tpu_on_k8s/autoscale/signals.sample_from_line`):
         ``[elastic-metrics] epoch=<rollouts> batch=<steps>
-        latency=<p50 TTFT seconds>`` — so replica count can ride the same
-        scale-up/down loop training replicas do. Falls back to p50 queue
-        wait, then 0, when no TTFT sample exists yet."""
-        ttft: List[float] = []
-        qwait: List[float] = []
-        for rep in self.replicas.values():
-            if rep.metrics is None:
-                continue
-            ttft.extend(rep.metrics.histograms[
-                "time_to_first_token_seconds"])
-            qwait.extend(rep.metrics.histograms["queue_wait_seconds"])
-        src = sorted(ttft) or sorted(qwait)
-        latency = src[len(src) // 2] if src else 0.0
+        latency=<p95 TTFT s> accuracy=0.0 queue_wait=<p95 s>
+        queue_depth=<n> inflight=<tokens> slots=<n> ready=<n>``.
+
+        Percentiles cover only the samples accrued SINCE THE PREVIOUS
+        line (the emitter delta-reads through the signal layer's own
+        ``FleetScraper``): each line is one window, exactly what
+        ``sample_from_line`` documents. Folding the lifetime histograms
+        instead would let one historical burst keep the reported p95
+        breached long after traffic recovered — pinning a log-scraping
+        autoscaler at max replicas forever.
+
+        With no TTFT sample this window, ``latency`` falls back to p95
+        queue wait; with no sample of either kind it emits the ``nan``
+        sentinel — "no data", which every parser maps to None. The old
+        ``latency=0.0`` fallback read as "infinitely fast" to any
+        consumer and would have scaled a freshly-started fleet straight
+        to min replicas."""
+        # function-level import: signals is stdlib-only, but the
+        # autoscale package pulls gang/, which fleet must not load at
+        # module import time
+        from tpu_on_k8s.autoscale.signals import (
+            NO_DATA,
+            FleetScraper,
+            percentile,
+        )
+        if self._obs_scraper is None:
+            self._obs_scraper = FleetScraper()
+        s = self._obs_scraper.scrape(self)
+
+        def p95(vals) -> float:
+            v = percentile(vals, 0.95)
+            return NO_DATA if v is None else v
+
+        src = s.ttft or s.queue_wait
         return (f"[elastic-metrics] epoch={self.stats['rollouts_completed']} "
-                f"batch={self.stats['steps']} latency={latency:.6f} "
-                f"accuracy=0.0")
+                f"batch={self.stats['steps']} latency={p95(src):.6f} "
+                f"accuracy=0.0 queue_wait={p95(s.queue_wait):.6f} "
+                f"queue_depth={s.queue_depth} "
+                f"inflight={s.inflight_tokens} "
+                f"slots={s.slots} ready={s.ready_replicas}")
 
 
 class _Rollout:

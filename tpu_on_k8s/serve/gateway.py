@@ -190,6 +190,40 @@ class ServingGateway:
                 req.cancel_requested = True
         return True
 
+    def evict_queued(self, max_n: Optional[int] = None,
+                     skip: Optional[Callable[[GatewayRequest], bool]] = None
+                     ) -> List[int]:
+        """Remove up to ``max_n`` QUEUED requests from the BACK of the
+        fair queue and return their ids — WITHOUT finalizing them. The
+        fleet's scale-up rebalance: work that queued here before new
+        capacity existed moves back to the fleet and re-routes onto an
+        idle replica. Budget is released (the request leaves this
+        gateway entirely); the oldest queued work keeps its place here,
+        where it is closest to dispatch. Requests already dispatched,
+        cancelled, waiting out a crash-replay backoff, or matched by
+        ``skip`` (the fleet skips prefix-warm requests — moving those
+        would trade a cache hit for a cold prefill) never move."""
+        with self._lock:
+            evicted: List[int] = []
+            # farthest-from-dispatch first: lowest priority lane, and
+            # the newest arrival within it (queued() itself implies no
+            # dispatch order, so order explicitly — evicting the
+            # next-to-dispatch high-priority request would invert the
+            # fairness the scheduler exists to provide)
+            for req in sorted(self._sched.queued(),
+                              key=lambda r: (r.priority, -r.rid)):
+                if max_n is not None and len(evicted) >= max_n:
+                    break
+                if req.state is not RequestState.QUEUED \
+                        or req.cancel_requested \
+                        or (skip is not None and skip(req)):
+                    continue
+                self._sched.remove(req)
+                self._admission.release(req.tenant, req.cost)
+                del self._requests[req.rid]
+                evicted.append(req.rid)
+            return evicted
+
     def result(self, request_id: int) -> Optional[RequestResult]:
         """The terminal outcome (popped — one consumer per request, like
         ``engine.result``), or None while the request is live. Partial
@@ -477,6 +511,14 @@ class ServingGateway:
         on; everything already accepted keeps running."""
         with self._lock:
             self._accepting = False
+
+    def resume_accepting(self) -> None:
+        """Reopen the front door — the fleet reclaiming a scale-down
+        victim on a scale-up reversal (a warm, already-loaded engine
+        beats minutes of fresh-replica spin-up). Only meaningful before
+        the replica is retired; a drained-and-removed gateway is gone."""
+        with self._lock:
+            self._accepting = True
 
     def drain(self, timeout_s: Optional[float] = None
               ) -> Dict[int, RequestResult]:
