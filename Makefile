@@ -6,14 +6,16 @@
 # docker-build produces.
 IMG ?= tpu-on-k8s/manager:latest
 
-.PHONY: test test-fast chaos-soak fleet-soak autoscale-soak native bench \
-        dryrun manager samples clean docker-build docker-push deploy undeploy
+.PHONY: test test-fast chaos-soak fleet-soak autoscale-soak disagg-soak \
+        native bench dryrun manager samples clean docker-build docker-push \
+        deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
 # CHAOS_SOAK_FAILED seed=... on any failure
 CHAOS_SEED ?= 1234
 FLEET_SEED ?= 4321
 AUTOSCALE_SEED ?= 2468
+DISAGG_SEED ?= 8642
 
 test:
 	python -m pytest tests/ -q
@@ -34,6 +36,12 @@ autoscale-soak:  ## SLO autoscaler on a bursty trace, twice: byte-identical deci
 	JAX_PLATFORMS=cpu python tools/serve_load.py --autoscale --soak \
 	    --n-requests 72 --rate 1.0 --burst-start 6 --burst-len 10 \
 	    --burst-rate 6.0 --seed $(AUTOSCALE_SEED)
+
+disagg-soak:  ## disagg fleet vs monolithic control, disagg arm twice: byte-identical event logs + both headline wins
+	JAX_PLATFORMS=cpu python tools/serve_load.py --disagg --soak \
+	    --n-requests 24 --prefix-bucket 8 --prompt-min 4 --prompt-max 12 \
+	    --new-min 4 --new-max 8 --decode-replicas 2 \
+	    --shared-prefixes 2 --shared-fraction 0.8 --seed $(DISAGG_SEED)
 
 native:  ## build the C++ data pipeline explicitly (also built lazily on import)
 	g++ -O2 -std=c++17 -shared -fPIC \

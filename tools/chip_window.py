@@ -424,6 +424,24 @@ def stage_serve_autoscale(timeout):
                        "serve_autoscale", timeout)
 
 
+def stage_serve_disagg(timeout):
+    """Disaggregated prefill/decode on hardware: the shared-prefix
+    bursty trace through DisaggFleet AND the monolithic control arm —
+    per-pool TTFT/TPOT breakdown, cost-model decode TPOT p95, and the
+    fleet-wide prefix-prefill recompute count side by side (the
+    tokens-per-chip lever ROADMAP item 2 claims, measured not
+    asserted)."""
+    return _json_stage([sys.executable, "tools/serve_load.py", "--bench",
+                        "--disagg", "--n-slots", "4",
+                        "--prefill-replicas", "1", "--decode-replicas",
+                        "2", "--n-requests", "48", "--rate", "1.5",
+                        "--burst-rate", "6.0", "--prefix-bucket", "128",
+                        "--shared-prefixes", "2",
+                        "--shared-fraction", "0.8",
+                        "--prompt-min", "8", "--prompt-max", "64"],
+                       "serve_disagg", timeout)
+
+
 def stage_serve_fleet(timeout):
     """The fleet headline (round-5 '#2 missed' decode/serving gap):
     router + 2 replicas on the same seeded trace — aggregate tok/s plus
@@ -452,6 +470,7 @@ STAGES = [
     ("serve_ttft", stage_serve_ttft, 1200, ()),
     ("serve_fleet", stage_serve_fleet, 1200, ()),
     ("serve_autoscale", stage_serve_autoscale, 1200, ()),
+    ("serve_disagg", stage_serve_disagg, 1200, ()),
 ]
 
 

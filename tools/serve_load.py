@@ -560,6 +560,224 @@ def _autoscale_main(args, cfg, params, max_len) -> dict:
     return summary
 
 
+#: explicit device-time cost model for the disagg comparison: an
+#: engine's step costs BASE plus PREFILL_COST per padded prefill
+#: position it executed that step — a monolithic engine's co-resident
+#: prefills inflate its decode token intervals; a dedicated decode
+#: engine's never do. Units are abstract "device steps", so the
+#: comparison is deterministic and host-speed-independent.
+_DISAGG_STEP_BASE = 1.0
+_DISAGG_PREFILL_COST = 0.05
+
+
+def run_disagg_trace(args, cfg, params, max_len, *,
+                     disagg: bool = True) -> dict:
+    """One seeded shared-prefix bursty trace through a ``DisaggFleet``
+    (or, with ``disagg=False``, the monolithic ``ServingFleet`` control
+    arm with the same engine count) on a virtual clock. Returns outcome
+    accounting, the cost-model decode TPOT percentiles, the fleet-wide
+    prefix-prefill recomputation count, per-pool TTFT/TPOT breakdowns,
+    and (disagg) the byte-comparable event log."""
+    from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+    from tpu_on_k8s.serve import (
+        DisaggFleet,
+        ProbeConfig,
+        Rejected,
+        Router,
+        ServingFleet,
+    )
+
+    vclock = _VirtualClock()
+
+    def factory(name):
+        return ContinuousBatchingEngine(cfg, params, n_slots=args.n_slots,
+                                        max_len=max_len,
+                                        step_horizon=args.horizon)
+
+    if disagg:
+        fleet = DisaggFleet(
+            factory, prefill_replicas=args.prefill_replicas,
+            decode_replicas=args.decode_replicas,
+            prefix_bucket_len=args.prefix_bucket,
+            handoff_capacity=args.handoff_capacity,
+            max_queue_depth=args.queue_bound, clock=vclock)
+        decode_names = {n for n, r in fleet.replicas.items()
+                        if r.pool == "decode"}
+    else:
+        fleet = ServingFleet(
+            factory, args.prefill_replicas + args.decode_replicas,
+            probe=ProbeConfig(slow_start_steps=1),
+            router=Router(prefix_bucket_len=args.prefix_bucket,
+                          spill_tokens=args.spill_tokens),
+            clock=vclock)
+        for _ in range(2):
+            fleet.step()
+        decode_names = set(fleet.replicas)
+
+    rng = np.random.default_rng(args.seed)
+    arrivals = build_workload(
+        rng, args.n_requests, rate=args.rate,
+        prompt_lens=(args.prompt_min, args.prompt_max),
+        new_tokens=(args.new_min, args.new_max),
+        vocab_size=cfg.vocab_size,
+        shared_prefixes=args.shared_prefixes,
+        shared_prefix_len=args.prefix_bucket if args.shared_prefixes
+        else 0,
+        shared_fraction=args.shared_fraction,
+        burst_start=args.burst_start, burst_len=args.burst_len,
+        burst_rate=args.burst_rate)
+
+    by_step: dict = {}
+    for a in arrivals:
+        by_step.setdefault(a.step, []).append(a)
+    outcomes: dict = {}
+    rejected = 0
+    tpot_cost: List[float] = []
+    last: dict = {}
+    step = 0
+    while by_step or fleet.has_live_requests or fleet.queue_depth > 0:
+        for a in by_step.pop(step, []):
+            r = fleet.submit(a.prompt, a.max_new_tokens, tenant=a.tenant,
+                             priority=a.priority, deadline_s=a.deadline_s)
+            if isinstance(r, Rejected):
+                rejected += 1
+        for rid in fleet.step():
+            res = fleet.result(rid)
+            if res is not None:
+                outcomes[rid] = res
+        for name, rep in fleet.replicas.items():
+            e = rep.engine
+            if e is None:
+                continue
+            em0, ad0, pp0 = last.get(name, (e.stats["emitted"],
+                                            e.stats["admitted"],
+                                            e.stats["prefill_positions"]))
+            em, ad, pp = (e.stats["emitted"], e.stats["admitted"],
+                          e.stats["prefill_positions"])
+            last[name] = (em, ad, pp)
+            if name not in decode_names:
+                continue
+            cost = _DISAGG_STEP_BASE + _DISAGG_PREFILL_COST * (pp - pp0)
+            decode_tokens = ((em - em0) - (ad - ad0) if not disagg
+                             else em - em0)
+            tpot_cost.extend([cost] * max(decode_tokens, 0))
+        vclock.advance(args.step_dt)
+        step += 1
+
+    states = [r.state.value for r in outcomes.values()]
+    total_tokens = sum(len(r.tokens) for r in outcomes.values())
+    from tpu_on_k8s.autoscale.signals import percentile
+    tp = sorted(tpot_cost)
+
+    def cost_pctl(q):
+        # the repo's ONE nearest-rank definition — a local formula would
+        # make one JSON blob disagree with itself
+        p = percentile(tp, q)
+        return None if p is None else round(p, 3)
+
+    per_pool: dict = {}
+    for name, rep in sorted(fleet.replicas.items()):
+        pool = getattr(rep, "pool", "monolithic")
+        m = rep.metrics
+        if m is None:
+            continue
+        agg = per_pool.setdefault(pool, {"replicas": 0, "ttft": [],
+                                         "queue_wait": [], "tpot": []})
+        agg["replicas"] += 1
+        agg["ttft"] += list(m.histograms["time_to_first_token_seconds"])
+        agg["queue_wait"] += list(m.histograms["queue_wait_seconds"])
+        agg["tpot"] += list(
+            m.histograms["time_per_output_token_seconds"])
+    breakdown = {
+        pool: {
+            "replicas": agg["replicas"],
+            "ttft_ms_p50": _pctl(agg["ttft"], 0.50),
+            "ttft_ms_p95": _pctl(agg["ttft"], 0.95),
+            "queue_wait_ms_p95": _pctl(agg["queue_wait"], 0.95),
+            "tpot_ms_p50": _pctl(agg["tpot"], 0.50),
+            "tpot_ms_p95": _pctl(agg["tpot"], 0.95),
+        } for pool, agg in sorted(per_pool.items())}
+
+    if disagg:
+        recompute = fleet.store.stats["misses"]
+    else:
+        recompute = sum(r.engine.stats["prefix_prefills"]
+                        for r in fleet.replicas.values()
+                        if r.engine is not None)
+    summary = {
+        "metric": "disagg_trace" if disagg else "disagg_control_trace",
+        "requests": len(arrivals),
+        "served": states.count("done"),
+        "rejected": rejected,
+        "deadline_exceeded": states.count("deadline_exceeded"),
+        "cancelled": states.count("cancelled"),
+        "retry_exhausted": states.count("retry_exhausted"),
+        "tokens": total_tokens,
+        "driver_steps": step,
+        "decode_tpot_cost_p50": cost_pctl(0.50),
+        "decode_tpot_cost_p95": cost_pctl(0.95),
+        "prefix_prefill_recompute": recompute,
+        "per_pool": breakdown,
+    }
+    if disagg:
+        summary.update(
+            handoffs_enqueued=fleet.stats["handoffs_enqueued"],
+            handoffs_adopted=fleet.stats["handoffs_adopted"],
+            handoffs_lost=fleet.stats["handoffs_lost"],
+            handoffs_corrupt=fleet.stats["handoffs_corrupt"],
+            replayed=fleet.stats["replayed"],
+            prefix_store=dict(fleet.store.stats),
+            event_log=list(fleet.event_log))
+    return summary
+
+
+def _disagg_main(args, cfg, params, max_len) -> dict:
+    """``--disagg``: the shared-prefix bursty trace through the
+    disaggregated fleet AND the monolithic control arm (same engine
+    count, same trace), reporting decode TPOT p95 and fleet-wide
+    prefix-prefill recomputation side by side. With ``--soak`` the
+    disagg trace runs TWICE from scratch and the event logs must be
+    byte-identical, the accounting must balance, and the disagg arm
+    must win both headline comparisons — ``DISAGG_SOAK_FAILED seed=N``
+    on any violation so a red run replays verbatim."""
+    control = run_disagg_trace(args, cfg, params, max_len, disagg=False)
+    summary = run_disagg_trace(args, cfg, params, max_len)
+    event_log = summary.pop("event_log")
+    summary["control"] = {
+        k: control[k] for k in ("decode_tpot_cost_p50",
+                                "decode_tpot_cost_p95",
+                                "prefix_prefill_recompute", "served",
+                                "per_pool")}
+    summary["tpot_p95_win"] = (
+        summary["decode_tpot_cost_p95"] is not None
+        and control["decode_tpot_cost_p95"] is not None
+        and summary["decode_tpot_cost_p95"]
+        < control["decode_tpot_cost_p95"])
+    summary["recompute_win"] = (summary["prefix_prefill_recompute"]
+                                < control["prefix_prefill_recompute"])
+    if args.soak:
+        rerun = run_disagg_trace(args, cfg, params, max_len)
+        accounted = (summary["served"] + summary["rejected"]
+                     + summary["deadline_exceeded"] + summary["cancelled"]
+                     + summary["retry_exhausted"])
+        replayed = event_log == rerun["event_log"]
+        ok = (accounted == args.n_requests and replayed
+              and summary["tpot_p95_win"] and summary["recompute_win"])
+        summary["soak_ok"] = ok
+        summary["event_log_replayed"] = replayed
+        if not ok:
+            print(json.dumps(summary))
+            print(f"DISAGG_SOAK_FAILED seed={args.seed} "
+                  f"accounted={accounted}/{args.n_requests} "
+                  f"replayed={replayed} "
+                  f"tpot_win={summary['tpot_p95_win']} "
+                  f"recompute_win={summary['recompute_win']}")
+            raise SystemExit(1)
+        print(f"DISAGG_SOAK_OK seed={args.seed}", file=sys.stderr)
+    print(json.dumps(summary))
+    return summary
+
+
 def main(argv=None) -> dict:
     import jax
     import jax.numpy as jnp
@@ -607,6 +825,24 @@ def main(argv=None) -> dict:
                         "FLEET_SOAK_FAILED seed=N and exit 1 on violation "
                         "(with --autoscale: also run the trace twice and "
                         "require byte-identical decision logs)")
+    # --- disaggregated serving mode (tpu_on_k8s/serve/disagg.py) ---
+    p.add_argument("--disagg", action="store_true",
+                   help="drive the shared-prefix bursty trace through a "
+                        "DisaggFleet plus a monolithic control arm: "
+                        "per-pool TTFT/TPOT breakdown, cost-model decode "
+                        "TPOT p95, fleet-wide prefix recompute count")
+    p.add_argument("--prefill-replicas", type=int, default=1,
+                   help="prefill pool size (--disagg; the control arm "
+                        "runs prefill+decode replicas monolithically)")
+    p.add_argument("--decode-replicas", type=int, default=1,
+                   help="decode pool size (--disagg)")
+    p.add_argument("--handoff-capacity", type=int, default=16,
+                   help="bounded prefill→decode handoff queue (--disagg)")
+    p.add_argument("--spill-tokens", type=int, default=24,
+                   help="control-arm router bounded-load threshold "
+                        "(--disagg): a bursty shared prefix spills past "
+                        "its affinity replica and recomputes there — the "
+                        "monolithic cost the fleet store eliminates")
     # --- SLO autoscaler mode (tpu_on_k8s/autoscale/ closed loop) ---
     p.add_argument("--autoscale", action="store_true",
                    help="drive a bursty trace through ServingFleet + "
@@ -666,6 +902,8 @@ def main(argv=None) -> dict:
     if args.bench:
         params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
 
+    if args.disagg:
+        return _disagg_main(args, cfg, params, max_len)
     if args.autoscale:
         return _autoscale_main(args, cfg, params, max_len)
     if args.replicas > 0:

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Dict, Optional
 
 from tpu_on_k8s.api import constants
 from tpu_on_k8s.api.core import ObjectMeta
@@ -97,6 +97,10 @@ class AutoscalePolicy:
     min_warm: int = 0
     target_ttft_s: float = 0.0
     target_queue_wait_s: float = 0.0
+    #: TPOT p95 SLO (seconds per output token; 0 disables): the decode
+    #: pool's scaling signal in disaggregated serving — queue-wait says
+    #: "prefill cannot keep up", TPOT says "decode cannot keep up"
+    target_tpot_s: float = 0.0
     util_high: float = 0.0
     util_low: float = 0.0
     hysteresis: float = 0.1
@@ -118,6 +122,7 @@ class AutoscalePolicy:
             min_warm=min(max(int(self.min_warm), 0), hi),
             target_ttft_s=max(float(self.target_ttft_s), 0.0),
             target_queue_wait_s=max(float(self.target_queue_wait_s), 0.0),
+            target_tpot_s=max(float(self.target_tpot_s), 0.0),
             util_high=max(float(self.util_high), 0.0),
             util_low=max(float(self.util_low), 0.0),
             hysteresis=max(float(self.hysteresis), 0.0),
@@ -127,6 +132,44 @@ class AutoscalePolicy:
                                       0.0),
             flap_guard_s=max(float(self.flap_guard_s), 0.0),
             slice_legal=bool(self.slice_legal))
+
+
+@dataclass
+class PoolSpec:
+    """One pool of a disaggregated service (`tpu_on_k8s/serve/disagg.py`).
+    ``replicas`` is that pool's size — hand-set, or owned by the fleet
+    autoscaler when ``autoscale`` is present (the per-pool twin of
+    ``spec.autoscale``: queue-wait p95 is the natural target for the
+    prefill pool, TPOT p95 for the decode pool)."""
+
+    replicas: int = 1
+    autoscale: Optional[AutoscalePolicy] = None
+
+    def normalized(self) -> "PoolSpec":
+        return PoolSpec(
+            replicas=max(int(self.replicas), 1),
+            autoscale=(self.autoscale.normalized()
+                       if self.autoscale is not None else None))
+
+
+@dataclass
+class PoolsSpec:
+    """Opt-in disaggregated prefill/decode serving: present, the service
+    splits into a prefill pool (chunked prefill only, KV handoff out)
+    and a decode pool (admits only handed-off KV), separately sized and
+    separately autoscaled. Absent, the service runs today's monolithic
+    replicas bit-for-bit. Engine shaping (slot counts, the handoff
+    queue bound) stays with the runtime that builds the ``DisaggFleet``
+    — a spec field the reconciler cannot yet honor (it does not mint
+    pool-labelled pods) would silently do nothing."""
+
+    prefill: PoolSpec = field(default_factory=PoolSpec)
+    decode: PoolSpec = field(default_factory=PoolSpec)
+
+    def normalized(self) -> "PoolsSpec":
+        return PoolsSpec(
+            prefill=self.prefill.normalized(),
+            decode=self.decode.normalized())
 
 
 @dataclass
@@ -149,6 +192,12 @@ class InferenceServiceSpec:
     #: present = autoscaled: `controller/fleetautoscaler.py` owns
     #: ``replicas`` (within [min_replicas, max_replicas]) from here on
     autoscale: Optional[AutoscalePolicy] = None
+    #: present = disaggregated: replicas split into prefill/decode pools
+    #: with KV handoff between them (`serve/disagg.py`); each pool's
+    #: ``replicas`` is sized by its own ``PoolSpec`` (and, when that
+    #: pool carries an ``autoscale`` block, by the fleet autoscaler's
+    #: per-pool loop). Absent ⇒ monolithic serving, unchanged.
+    pools: Optional[PoolsSpec] = None
 
 
 class ServicePhase(str, enum.Enum):
@@ -179,6 +228,9 @@ class InferenceServiceStatus:
     # --- autoscaler-owned (written by controller/fleetautoscaler.py) ---
     desired_replicas: int = 0      # the autoscaler's last committed target
     autoscale_message: str = ""    # last decision, human-readable
+    #: per-pool committed targets for disaggregated services
+    #: (``spec.pools.<pool>.autoscale`` loops) — {"prefill": n, ...}
+    pool_desired_replicas: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass

@@ -166,6 +166,11 @@ class Recommender:
                 and obs.queue_wait_p95 > p.target_queue_wait_s * h:
             reasons.append(f"queue_wait_p95={_fmt(obs.queue_wait_p95)}"
                            f">slo={_fmt(p.target_queue_wait_s)}")
+        tpot_slo = getattr(p, "target_tpot_s", 0.0)
+        if tpot_slo > 0 and obs.tpot_p95 is not None \
+                and obs.tpot_p95 > tpot_slo * h:
+            reasons.append(f"tpot_p95={_fmt(obs.tpot_p95)}"
+                           f">slo={_fmt(tpot_slo)}")
         util = obs.tokens_per_slot
         if p.util_high > 0 and util is not None and util > p.util_high:
             reasons.append(f"tokens_per_slot={_fmt(util)}"
@@ -182,6 +187,9 @@ class Recommender:
             worst = max(worst, obs.ttft_p95 / p.target_ttft_s)
         if p.target_queue_wait_s > 0 and obs.queue_wait_p95 is not None:
             worst = max(worst, obs.queue_wait_p95 / p.target_queue_wait_s)
+        tpot_slo = getattr(p, "target_tpot_s", 0.0)
+        if tpot_slo > 0 and obs.tpot_p95 is not None:
+            worst = max(worst, obs.tpot_p95 / tpot_slo)
         util = obs.tokens_per_slot
         if p.util_high > 0 and util is not None:
             worst = max(worst, util / p.util_high)
@@ -224,9 +232,10 @@ class Recommender:
         must never read as fast."""
         p = self.policy
         h = 1.0 - p.hysteresis
+        tpot_slo = getattr(p, "target_tpot_s", 0.0)
         idle = obs.queue_depth == 0 and obs.inflight_tokens == 0
         if not (p.target_ttft_s > 0 or p.target_queue_wait_s > 0
-                or p.util_low > 0):
+                or tpot_slo > 0 or p.util_low > 0):
             # no scale-down signal configured at all: a zero-signal
             # policy must hold, not ratchet a live fleet to min on
             # "queue happens to be empty"
@@ -246,6 +255,12 @@ class Recommender:
                 if not idle:
                     return False
             elif obs.queue_wait_p95 >= p.target_queue_wait_s * h:
+                return False
+        if tpot_slo > 0:
+            if obs.tpot_p95 is None:
+                if not idle:
+                    return False
+            elif obs.tpot_p95 >= tpot_slo * h:
                 return False
         if p.util_low > 0:
             util = obs.tokens_per_slot

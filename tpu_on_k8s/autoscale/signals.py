@@ -57,6 +57,7 @@ class FleetSample:
     seq: int
     ttft: Tuple[float, ...] = ()
     queue_wait: Tuple[float, ...] = ()
+    tpot: Tuple[float, ...] = ()
     queue_depth: int = 0
     inflight_tokens: int = 0
     slots: int = 0
@@ -85,6 +86,10 @@ class FleetObservation:
     ready_replicas: int
     samples: int          # latency observations backing the percentiles
     stale: bool
+    #: inter-token latency p95 (seconds/token) — the decode pool's SLO
+    #: signal in disaggregated serving; defaulted so pre-disagg
+    #: constructors (and their tests) stay source-compatible
+    tpot_p95: Optional[float] = None
 
     @property
     def tokens_per_slot(self) -> Optional[float]:
@@ -132,11 +137,16 @@ class FleetScraper:
             self._seq = seq
         ttft = []
         qwait = []
+        tpot = []
         slots = 0
         inflight = 0
         ready = 0
-        for name in sorted(fleet.replicas):
-            rep = fleet.replicas[name]
+        # bind once: a DisaggPool's ``replicas`` property takes the
+        # fleet lock and rebuilds a filtered dict per access — one
+        # snapshot here is one lock acquisition instead of N+1
+        replicas = fleet.replicas
+        for name in sorted(replicas):
+            rep = replicas[name]
             state = getattr(rep.state, "value", str(rep.state))
             if state not in _ACTIVE_REPLICA_STATES:
                 continue
@@ -147,7 +157,8 @@ class FleetScraper:
             if rep.metrics is None:
                 continue
             for key, out in (("time_to_first_token_seconds", ttft),
-                             ("queue_wait_seconds", qwait)):
+                             ("queue_wait_seconds", qwait),
+                             ("time_per_output_token_seconds", tpot)):
                 # snapshot under the mirror lock: the gateway appends
                 # from the driver thread while this scrape runs in the
                 # autoscaler's. Position by the monotone observation
@@ -170,8 +181,34 @@ class FleetScraper:
                 self._seen[mark] = total
         return FleetSample(
             seq=seq, ttft=tuple(ttft), queue_wait=tuple(qwait),
+            tpot=tuple(tpot),
             queue_depth=fleet.queue_depth, inflight_tokens=inflight,
             slots=slots, ready_replicas=ready)
+
+
+def format_observation_line(sample: FleetSample, *, epoch: int,
+                            batch: int) -> str:
+    """Render a ``FleetSample`` as the extended ElasticAutoscaler
+    observation line — the ONE emitter behind
+    ``ServingFleet.observation_line`` and
+    ``DisaggFleet.pool_observation_line``, and the inverse of
+    `sample_from_line` (the format is load-bearing: the log-scraping
+    autoscaler plane parses it, so a field added here reaches every
+    fleet type at once). With no latency sample of any kind the
+    ``latency`` field carries the ``nan`` sentinel — "no data", which
+    every parser maps to None, never "infinitely fast"."""
+    def p95(vals) -> float:
+        v = percentile(vals, 0.95)
+        return NO_DATA if v is None else v
+
+    src = sample.ttft or sample.queue_wait
+    return (f"{METRICS_TAG} epoch={epoch} batch={batch} "
+            f"latency={p95(src):.6f} accuracy=0.0 "
+            f"queue_wait={p95(sample.queue_wait):.6f} "
+            f"queue_depth={sample.queue_depth} "
+            f"inflight={sample.inflight_tokens} "
+            f"slots={sample.slots} ready={sample.ready_replicas} "
+            f"tpot={p95(sample.tpot):.6f}")
 
 
 def sample_from_line(line: str, seq: int) -> Optional[FleetSample]:
@@ -205,6 +242,7 @@ def sample_from_line(line: str, seq: int) -> Optional[FleetSample]:
 
     return FleetSample(
         seq=seq, ttft=_lat("latency"), queue_wait=_lat("queue_wait"),
+        tpot=_lat("tpot"),
         queue_depth=_int("queue_depth"), inflight_tokens=_int("inflight"),
         slots=_int("slots"), ready_replicas=_int("ready"))
 
@@ -255,15 +293,17 @@ class SignalAggregator:
     def observation(self) -> FleetObservation:
         ttft = [v for s in self._samples for v in s.ttft]
         qwait = [v for s in self._samples for v in s.queue_wait]
+        tpot = [v for s in self._samples for v in s.tpot]
         latest = self._samples[-1] if self._samples else None
         stale = self._dead_streak >= self.stale_after or latest is None
         return FleetObservation(
             seq=self._seq,
             ttft_p95=percentile(ttft, 0.95),
             queue_wait_p95=percentile(qwait, 0.95),
+            tpot_p95=percentile(tpot, 0.95),
             queue_depth=latest.queue_depth if latest else 0,
             inflight_tokens=latest.inflight_tokens if latest else 0,
             slots=latest.slots if latest else 0,
             ready_replicas=latest.ready_replicas if latest else 0,
-            samples=len(ttft) + len(qwait),
+            samples=len(ttft) + len(qwait) + len(tpot),
             stale=stale)

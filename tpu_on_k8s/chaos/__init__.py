@@ -32,6 +32,7 @@ from tpu_on_k8s.chaos.faults import (
     SITE_AUTOSCALE_SIGNAL,
     SITE_FLEET_REPLICA,
     SITE_FLEET_ROLLOUT,
+    SITE_KV_HANDOFF,
     SITE_RECONCILE,
     SITE_REST_REQUEST,
     SITE_REST_WATCH_CONNECT,
@@ -47,6 +48,8 @@ from tpu_on_k8s.chaos.faults import (
     EngineCrash,
     EngineStall,
     Fault,
+    HandoffCorrupt,
+    HandoffLoss,
     HttpError,
     PodFail,
     PreemptNotice,
@@ -80,6 +83,7 @@ __all__ = [
     "SITE_AUTOSCALE_SIGNAL",
     "SITE_FLEET_REPLICA",
     "SITE_FLEET_ROLLOUT",
+    "SITE_KV_HANDOFF",
     "SITE_RECONCILE",
     "SITE_REST_REQUEST",
     "SITE_REST_WATCH_CONNECT",
@@ -97,6 +101,8 @@ __all__ = [
     "Fault",
     "FaultInjector",
     "FaultRule",
+    "HandoffCorrupt",
+    "HandoffLoss",
     "HttpError",
     "PodFail",
     "PreemptNotice",

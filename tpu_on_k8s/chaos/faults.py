@@ -18,6 +18,7 @@ API.
 | serve.engine.step      | ContinuousBatchingEngine.step       | EngineCrash, EngineStall |
 | serve.fleet.replica    | ServingFleet.step (per replica)     | ReplicaCrash, ReadinessFlap |
 | serve.fleet.rollout    | ServingFleet rollout transitions    | RolloutInterrupt |
+| serve.kv.handoff       | DisaggFleet prefill→decode transfer | HandoffLoss, HandoffCorrupt |
 | autoscale.signal       | FleetAutoscaler signal scrape       | SignalOutage |
 | autoscale.patch        | FleetAutoscaler spec.replicas patch | Conflict, HttpError, TimeoutFault |
 | train.step             | TrainLoop.run (per dispatch)        | StepFailure |
@@ -43,6 +44,7 @@ SITE_RECONCILE = "controller.reconcile"
 SITE_SERVE_STEP = "serve.engine.step"
 SITE_FLEET_REPLICA = "serve.fleet.replica"
 SITE_FLEET_ROLLOUT = "serve.fleet.rollout"
+SITE_KV_HANDOFF = "serve.kv.handoff"
 SITE_TRAIN_STEP = "train.step"
 SITE_TRAIN_SAVE = "train.save"
 SITE_TRAIN_PREEMPT = "train.preempt"
@@ -205,6 +207,31 @@ class RolloutInterrupt(Fault):
     with every in-flight request reaching a typed terminal state."""
 
     kind: ClassVar[str] = "rollout_interrupt"
+
+
+@dataclasses.dataclass(frozen=True)
+class HandoffLoss(Fault):
+    """A prefill→decode KV handoff vanishes in transfer (the in-process
+    shape of a dead transport link or an OOM-killed staging buffer): the
+    payload never reaches the handoff queue. Recovery under test: the
+    disaggregated fleet re-runs the prefill under the request's
+    ``ReplayPolicy`` budget — a lost handoff costs latency, never the
+    request (and greedy decode makes the replayed output token-identical,
+    the zero-silent-loss proof `disagg_handoff_chaos` pins)."""
+
+    kind: ClassVar[str] = "handoff_loss"
+
+
+@dataclasses.dataclass(frozen=True)
+class HandoffCorrupt(Fault):
+    """A KV handoff arrives with flipped bytes (truncated copy, DMA
+    error). Undetected, the decode pool would serve silently-wrong
+    tokens from the poisoned cache — so the recovery under test is the
+    payload checksum: the adopting replica must REJECT the transfer
+    (``KVHandoff.verify()``) and route the request back through the
+    re-prefill replay path instead of decoding garbage."""
+
+    kind: ClassVar[str] = "handoff_corrupt"
 
 
 @dataclasses.dataclass(frozen=True)

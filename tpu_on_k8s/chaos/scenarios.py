@@ -139,6 +139,32 @@ def fleet_rollout_chaos(*, flap_replica: str = "replica-0",
     ), seed)
 
 
+def disagg_handoff_chaos(*, lose_at: Tuple[int, ...] = (2,),
+                         corrupt_at: Tuple[int, ...] = (4,),
+                         seed: int = 0) -> Scenario:
+    """The disaggregated fleet's prefill→decode handoff link under
+    weather: the ``lose_at``-th handoffs vanish in transfer and the
+    ``corrupt_at``-th arrive with flipped bytes (counted per handoff
+    enqueue across the fleet). Recovery under test: a lost handoff
+    re-runs its prefill under the ``ReplayPolicy`` budget; a corrupted
+    one is REJECTED by the adopting decode replica's checksum and
+    replayed the same way — never decoded into silently-wrong tokens.
+    Every request still reaches a typed terminal state, and greedy
+    replays produce token-identical output (the oracle check
+    `tests/test_serve_disagg.py` pins)."""
+    rules = []
+    if lose_at:
+        rules.append(FaultRule(faults.SITE_KV_HANDOFF, Trigger(at=lose_at),
+                               faults.HandoffLoss(),
+                               note="lose the KV handoff in transfer"))
+    if corrupt_at:
+        rules.append(FaultRule(faults.SITE_KV_HANDOFF,
+                               Trigger(at=corrupt_at),
+                               faults.HandoffCorrupt(),
+                               note="corrupt the KV handoff payload"))
+    return Scenario("disagg-handoff-chaos", tuple(rules), seed)
+
+
 def autoscale_under_crash(replica: str = "replica-1", *,
                           crash_at: int = 3,
                           outage_at: Tuple[int, ...] = (2, 3),

@@ -62,6 +62,22 @@ from tpu_on_k8s.utils.logging import get_logger
 _log = get_logger("fleetautoscaler")
 
 
+class _PoolState:
+    """One pool's decision loop (disaggregated services run two of
+    these — prefill and decode — instead of one service-level loop).
+    Same anatomy as the service loop: the recommender owns cooldown
+    stamps, the aggregator owns the signal window, the scraper owns
+    delta-read positions (per pool — the pools' replicas are disjoint,
+    but a shared scraper would interleave their sequence numbers)."""
+
+    def __init__(self) -> None:
+        self.recommender: Optional[Recommender] = None
+        self.policy_key: Optional[Tuple] = None
+        self.aggregator: Optional[SignalAggregator] = None
+        self.scraper = FleetScraper()
+        self.seq = 0
+
+
 class _ServiceState:
     """Per-service loop state: the policy's cooldown stamps live in the
     recommender; the aggregator owns the signal window; ``fleet`` is the
@@ -75,6 +91,8 @@ class _ServiceState:
         self.fleet = None
         self.apply_to_fleet = True
         self.seq = 0                 # one counter across live AND dead scrapes
+        #: per-pool loops (``spec.pools.<pool>.autoscale`` present)
+        self.pools: Dict[str, _PoolState] = {}
         #: newest observation-line batch consumed, PER POD — every pod's
         #: fleet runs its own step counter, so one shared watermark would
         #: permanently blind the scrape to any pod that started later
@@ -104,8 +122,19 @@ class FleetAutoscaler:
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ registration
+    @staticmethod
+    def _autoscaled(svc: InferenceService) -> bool:
+        """A service participates when its service-level autoscale block
+        is set, or — disaggregated — when either pool carries one."""
+        if svc.spec.autoscale is not None:
+            return True
+        pools = svc.spec.pools
+        return pools is not None and (
+            pools.prefill.autoscale is not None
+            or pools.decode.autoscale is not None)
+
     def register(self, svc: InferenceService) -> None:
-        if svc.spec.autoscale is None:
+        if not self._autoscaled(svc):
             return
         key = f"{svc.metadata.namespace}/{svc.metadata.name}"
         with self._lock:
@@ -150,12 +179,15 @@ class FleetAutoscaler:
         for key, state in items:
             ns, name = key.split("/", 1)
             svc = self.cluster.try_get(InferenceService, ns, name)
-            if svc is None or svc.spec.autoscale is None:
+            if svc is None or not self._autoscaled(svc):
                 with self._lock:
                     self._services.pop(key, None)
                 continue
             try:
-                self._tick(key, svc, state)
+                if svc.spec.pools is not None:
+                    self._tick_pools(key, svc, state)
+                else:
+                    self._tick(key, svc, state)
             except NotFoundError:
                 continue
 
@@ -173,50 +205,182 @@ class FleetAutoscaler:
         self._record(key, svc, obs, decision)
         if decision.action == ACTION_HOLD or decision.target == cur:
             return
+        self._execute(key, svc, state, state.recommender, decision, now)
 
-        # execute: the patch is the commit point — chaos (and real
-        # conflicts) before it mean the scale never happened, so no
-        # cooldown is burned and next tick retries at full speed
-        fault = chaos.fire(chaos.SITE_AUTOSCALE_PATCH, service=key,
+    # ------------------------------------------------------------ pool loops
+    def _tick_pools(self, key: str, svc: InferenceService,
+                    state: _ServiceState) -> None:
+        """A disaggregated service runs one decision loop PER POOL —
+        queue-wait p95 is the natural SLO for the prefill pool (work
+        waiting for a prefill seat), TPOT p95 for the decode pool
+        (decode cadence) — each with its own recommender (cooldowns,
+        hysteresis, flap damping, slice-legal steps) and its own signal
+        window, patching ``spec.pools.<pool>.replicas``. Signals come
+        from an attached in-process ``DisaggFleet`` (``pool(name)`` is
+        scraped exactly like a fleet); with none attached the window
+        goes stale and the policy holds — per-pool log scraping needs
+        pool-labelled pods the reconciler does not mint yet."""
+        spec_pools = svc.spec.pools.normalized()
+        pools = [p for p in ("prefill", "decode")
+                 if getattr(spec_pools, p).autoscale is not None]
+        if pools and self.metrics is not None:
+            # one tick per service per pass, matching _tick — NOT one
+            # per pool, which would make the counter mean different
+            # things for pooled vs monolithic services
+            self.metrics.inc("ticks")
+        for pool in pools:
+            self._tick_one_pool(key, svc, state, pool,
+                                getattr(spec_pools, pool))
+        if not pools and svc.spec.autoscale is not None:
+            # the service registered on its service-level autoscale block,
+            # but pools: present hands scaling to the per-pool loops — and
+            # neither pool carries one. Without this, migrating a
+            # monolithic autoscaled service to disagg while keeping the
+            # old block silently stops ALL autoscaling.
+            msg = ("pools present: service-level autoscale is ignored; "
+                   "set spec.pools.<pool>.autoscale to scale the pools")
+            if svc.status.autoscale_message != msg:
+                _log.warning("%s for %s", msg, key)
+
+                def mutate(s: InferenceService) -> None:
+                    s.status.autoscale_message = msg
+                try:
+                    self.cluster.update_with_retry(
+                        InferenceService, svc.metadata.namespace,
+                        svc.metadata.name, mutate, subresource="status")
+                except NotFoundError:
+                    pass
+
+    def _tick_one_pool(self, key: str, svc: InferenceService,
+                       state: _ServiceState, pool: str, pspec) -> None:
+        ps = state.pools.get(pool)
+        if ps is None:
+            ps = state.pools[pool] = _PoolState()
+        ap = pspec.autoscale
+        pkey = (tuple(sorted(vars(ap).items())),
+                svc.spec.tpu_policy.accelerator)
+        if ps.policy_key != pkey:
+            ps.policy_key = pkey
+            ps.recommender = Recommender(
+                ap, accelerator=svc.spec.tpu_policy.accelerator)
+            ps.aggregator = SignalAggregator(
+                window=self.config.autoscale_window_scrapes,
+                stale_after=self.config.autoscale_stale_scrapes)
+
+        sample = self._collect_pool(key, state, pool, ps)
+        obs = ps.aggregator.record(sample)
+        cur = max(int(pspec.replicas), 1)
+        now = self.clock()
+        decision = ps.recommender.decide(obs, cur, now)
+        self._record(key, svc, obs, decision, pool=pool)
+        if decision.action == ACTION_HOLD or decision.target == cur:
+            return
+        self._execute(key, svc, state, ps.recommender, decision, now,
+                      pool=pool)
+
+    def _collect_pool(self, key: str, state: _ServiceState, pool: str,
+                      ps: _PoolState) -> FleetSample:
+        """Pool twin of ``_collect``: scrape the attached fleet's pool
+        view; no attached fleet (or a dying one) is an outage — per-pool
+        log scraping needs pool-labelled pods the reconciler does not
+        mint yet."""
+        ps.seq += 1
+        fault = chaos.fire(chaos.SITE_AUTOSCALE_SIGNAL, service=key,
+                           pool=pool)
+        if not isinstance(fault, chaos.SignalOutage) \
+                and state.fleet is not None \
+                and hasattr(state.fleet, "pool"):
+            try:
+                return ps.scraper.scrape(state.fleet.pool(pool),
+                                         seq=ps.seq)
+            except Exception:  # noqa: BLE001 — a dying fleet is an outage
+                pass
+        if self.metrics is not None:
+            self.metrics.inc("stale_scrapes")
+        return dead_sample(ps.seq)
+
+    # ------------------------------------------------------------- execution
+    def _execute(self, key: str, svc: InferenceService,
+                 state: _ServiceState, recommender: Recommender,
+                 decision, now: float, *, pool: Optional[str] = None
+                 ) -> None:
+        """The committed half of a decision loop, shared by the service
+        and per-pool paths: patch the spec — the commit point, so chaos
+        (and real conflicts) before it mean the scale never happened and
+        no cooldown is burned; next tick retries at full speed — then
+        commit cooldown stamps, publish status + event, and apply to an
+        attached in-process fleet."""
+        label = key if pool is None else f"{key}/{pool}"
+        prefix = f"svc={key} " if pool is None \
+            else f"svc={key} pool={pool} "
+        fault = chaos.fire(chaos.SITE_AUTOSCALE_PATCH, service=label,
                            target=decision.target)
         try:
             if fault is not None:
                 raise fault.to_exception()
 
             def mutate(s: InferenceService) -> None:
-                s.spec.replicas = decision.target
+                if pool is None:
+                    s.spec.replicas = decision.target
+                elif s.spec.pools is None:
+                    raise NotFoundError("pools block removed")
+                else:
+                    getattr(s.spec.pools, pool).replicas = decision.target
 
             self.cluster.update_with_retry(
-                InferenceService, svc.metadata.namespace, svc.metadata.name,
-                mutate)
+                InferenceService, svc.metadata.namespace,
+                svc.metadata.name, mutate)
         except Exception as e:  # noqa: BLE001 — typed below, loop survives
             self.decision_log.append(
-                f"svc={key} seq={decision.seq} patch_failed "
+                f"{prefix}seq={decision.seq} patch_failed "
                 f"{type(e).__name__}")
             if self.metrics is not None:
                 self.metrics.inc("patch_failures")
-            _log.warning("replicas patch for %s failed: %s", key, e)
+            _log.warning("replicas patch for %s failed: %s", label, e)
             return
-        state.recommender.commit(decision, now)
+        recommender.commit(decision, now)
         if self.metrics is not None:
             # the gauge tracks COMMITTED targets only — set after the
             # patch lands, so a failed write never reports a phantom
             # pending scale
             self.metrics.set_gauge("desired_replicas", decision.target,
-                                   label=key)
-        self._write_status(svc, decision)
+                                   label=label)
+
+        def mutate_status(s: InferenceService) -> None:
+            if pool is None:
+                s.status.desired_replicas = decision.target
+                s.status.autoscale_message = (
+                    f"{decision.action} {decision.current}->"
+                    f"{decision.target}: {decision.reason}")
+            else:
+                s.status.pool_desired_replicas[pool] = decision.target
+                s.status.autoscale_message = (
+                    f"{pool}: {decision.action} {decision.current}->"
+                    f"{decision.target}: {decision.reason}")
+        try:
+            self.cluster.update_with_retry(
+                InferenceService, svc.metadata.namespace,
+                svc.metadata.name, mutate_status, subresource="status")
+        except NotFoundError:
+            pass
         self.cluster.record_event(
-            svc, "Normal", "AutoscaleReplicas",
-            f"fleet autoscaler: {decision.current} -> {decision.target} "
+            svc, "Normal",
+            "AutoscaleReplicas" if pool is None else "AutoscalePoolReplicas",
+            ("fleet autoscaler" if pool is None
+             else f"fleet autoscaler[{pool}]")
+            + f": {decision.current} -> {decision.target} "
             f"({decision.reason})")
         if state.fleet is not None and state.apply_to_fleet:
             try:
-                state.fleet.scale_to(decision.target)
-            except RuntimeError as e:
+                if pool is None:
+                    state.fleet.scale_to(decision.target)
+                else:
+                    state.fleet.scale_pool(pool, decision.target)
+            except (RuntimeError, ValueError) as e:
                 # a rollout owns desired_replicas right now; the spec
                 # patch stands and the reconciler/fleet converge later
-                _log.warning("fleet scale_to(%d) for %s deferred: %s",
-                             decision.target, key, e)
+                _log.warning("fleet apply for %s (-> %d) deferred: %s",
+                             label, decision.target, e)
 
     # --------------------------------------------------------------- signals
     def _ensure_policy(self, svc: InferenceService,
@@ -312,6 +476,7 @@ class FleetAutoscaler:
             seq=state.seq,
             ttft=tuple(v for s in merged for v in s.ttft),
             queue_wait=tuple(v for s in merged for v in s.queue_wait),
+            tpot=tuple(v for s in merged for v in s.tpot),
             queue_depth=sum(s.queue_depth for s in merged),
             inflight_tokens=sum(s.inflight_tokens for s in merged),
             slots=sum(s.slots for s in merged),
@@ -319,40 +484,37 @@ class FleetAutoscaler:
 
     # ------------------------------------------------------------- recording
     def _record(self, key: str, svc: InferenceService, obs,
-                decision) -> None:
-        self.decision_log.append(f"svc={key} " + decision.line())
+                decision, *, pool: Optional[str] = None) -> None:
+        """One decision recorded: a stable decision-log line plus the
+        observed/decided gauge set — labelled ``ns/name`` for the
+        service loop, ``ns/name/pool`` for a pool loop; both export the
+        full signal set (every observed gauge is a valid policy input on
+        either loop)."""
+        label = key if pool is None else f"{key}/{pool}"
+        self.decision_log.append(
+            (f"svc={key} " if pool is None else f"svc={key} pool={pool} ")
+            + decision.line())
         m = self.metrics
         if m is None:
             return
         m.decision(decision.action)
         if decision.target == decision.current:
             # holds confirm the current size; executed scales update the
-            # gauge only once the patch commits (see _tick)
-            m.set_gauge("desired_replicas", decision.target, label=key)
-        m.set_gauge("current_replicas", decision.current, label=key)
-        m.set_gauge("signal_stale", float(obs.stale), label=key)
+            # gauge only once the patch commits (see _execute)
+            m.set_gauge("desired_replicas", decision.target, label=label)
+        m.set_gauge("current_replicas", decision.current, label=label)
+        m.set_gauge("signal_stale", float(obs.stale), label=label)
         if obs.ttft_p95 is not None:
-            m.set_gauge("observed_ttft_p95", obs.ttft_p95, label=key)
+            m.set_gauge("observed_ttft_p95", obs.ttft_p95, label=label)
         if obs.queue_wait_p95 is not None:
             m.set_gauge("observed_queue_wait_p95", obs.queue_wait_p95,
-                        label=key)
-        m.set_gauge("observed_queue_depth", obs.queue_depth, label=key)
+                        label=label)
+        if obs.tpot_p95 is not None:
+            m.set_gauge("observed_tpot_p95", obs.tpot_p95, label=label)
+        m.set_gauge("observed_queue_depth", obs.queue_depth, label=label)
         if obs.tokens_per_slot is not None:
             m.set_gauge("observed_tokens_per_slot", obs.tokens_per_slot,
-                        label=key)
-
-    def _write_status(self, svc: InferenceService, decision) -> None:
-        def mutate(s: InferenceService) -> None:
-            s.status.desired_replicas = decision.target
-            s.status.autoscale_message = (
-                f"{decision.action} {decision.current}->"
-                f"{decision.target}: {decision.reason}")
-        try:
-            self.cluster.update_with_retry(
-                InferenceService, svc.metadata.namespace, svc.metadata.name,
-                mutate, subresource="status")
-        except NotFoundError:
-            pass
+                        label=label)
 
     # ----------------------------------------------------------------- run loop
     def run(self) -> None:

@@ -238,9 +238,28 @@ class FleetMetrics(_MetricsBase):
     _PLAIN_COUNTERS = ("replicas_ejected", "prefix_cache_hits",
                        "prefix_cache_misses", "rollout_interrupts",
                        "rollouts_completed", "readiness_flaps",
-                       "scale_ups", "scale_downs")
+                       "scale_ups", "scale_downs",
+                       # disaggregated serving (tpu_on_k8s/serve/disagg.py):
+                       # the prefill→decode KV handoff link — lost/corrupt
+                       # are the chaos-injected failures whose replays the
+                       # zero-silent-loss proof counts
+                       "handoffs_enqueued", "handoffs_adopted",
+                       "handoffs_lost", "handoffs_corrupt",
+                       "requests_replayed",
+                       # fleet prefix/KV store (tpu_on_k8s/serve/kvstore.py):
+                       # misses ARE the fleet-wide prefix-prefill recompute
+                       # count the disagg acceptance test compares
+                       "prefix_store_hits", "prefix_store_misses",
+                       "prefix_store_promotes", "prefix_store_evictions",
+                       "prefix_store_demotes")
     _LABELED_GAUGES = ("in_flight", "queue_depth", "outstanding_tokens")
-    _PLAIN_GAUGES = ("replicas_ready", "replicas_total", "rollout_phase")
+    _PLAIN_GAUGES = ("replicas_ready", "replicas_total", "rollout_phase",
+                     "handoff_queue_depth", "prefix_store_overflow_bytes")
+    #: per-pool view of a disaggregated fleet (label value: "prefill" /
+    #: "decode") — one scrape shows both pools' load side by side, which
+    #: is exactly what the per-pool autoscaler loops act on
+    _POOL_GAUGES = ("pool_replicas_ready", "pool_queue_depth",
+                    "pool_inflight_tokens", "pool_slots")
 
     def __init__(self, registry=None) -> None:
         super().__init__()
@@ -264,6 +283,15 @@ class FleetMetrics(_MetricsBase):
             for name in self._PLAIN_GAUGES:
                 self._prom_gauges[name] = _prom.Gauge(
                     f"{ns}_{name}", f"Fleet {name}", registry=registry)
+            for name in self._POOL_GAUGES:
+                self._prom_gauges[name] = _prom.Gauge(
+                    f"{ns}_{name}", f"Fleet {name}", ["pool"],
+                    registry=registry)
+            # handoff queue wait: enqueue → adoption on a decode replica
+            # (the latency the handoff link adds to TTFT)
+            self._prom_hists["handoff_wait_seconds"] = _prom.Histogram(
+                f"{ns}_handoff_wait_seconds", "Fleet handoff_wait_seconds",
+                buckets=_SERVING_BUCKETS, registry=registry)
 
     def inc(self, name: str, n: int = 1, replica: str = "") -> None:
         with self._lock:
@@ -273,13 +301,19 @@ class FleetMetrics(_MetricsBase):
             (c.labels(replica) if name in self._LABELED_COUNTERS
              else c).inc(n)
 
-    def set_gauge(self, name: str, value: float, replica: str = "") -> None:
+    def set_gauge(self, name: str, value: float, replica: str = "",
+                  pool: str = "") -> None:
+        label = pool or replica
         with self._lock:
-            self.gauges[(name, replica)] = value
+            self.gauges[(name, label)] = value
         g = self._prom_gauges.get(name)
         if g is not None:
-            (g.labels(replica) if name in self._LABELED_GAUGES
-             else g).set(value)
+            if name in self._LABELED_GAUGES:
+                g.labels(replica).set(value)
+            elif name in self._POOL_GAUGES:
+                g.labels(pool).set(value)
+            else:
+                g.set(value)
 
     def set_rollout_phase(self, phase: str) -> None:
         self.set_gauge("rollout_phase",
@@ -301,6 +335,7 @@ class AutoscaleMetrics(_MetricsBase):
                        "tick_errors")
     _SERVICE_GAUGES = ("desired_replicas", "current_replicas",
                        "observed_ttft_p95", "observed_queue_wait_p95",
+                       "observed_tpot_p95",
                        "observed_queue_depth", "observed_tokens_per_slot",
                        "signal_stale")
 

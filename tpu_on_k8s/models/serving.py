@@ -47,7 +47,7 @@ import itertools
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -115,6 +115,21 @@ class _Pending:
 
 
 @dataclasses.dataclass
+class _KVPending:
+    """A ``submit_kv`` request waiting for a free slot: its prefill
+    already happened elsewhere (the handoff carries the KV), so admission
+    is a pure cache splice — no prefill program runs here."""
+
+    request_id: int
+    handoff: "KVHandoff"
+    max_new_tokens: int
+    eos_id: Optional[int]
+    prefix_id: Optional[int]
+    submitted_at: float = 0.0
+    on_token: Optional[Any] = None
+
+
+@dataclasses.dataclass
 class _Prefilling:
     """A long prompt mid-chunked-prefill: its KV accumulates in a private
     batch-1 cache, one chunk per engine step, while decode continues for
@@ -134,6 +149,91 @@ def _strip_index(cache: Any) -> Any:
     if isinstance(cache, dict):
         return {k: _strip_index(v) for k, v in cache.items() if k != "index"}
     return cache
+
+
+def _graft_cursorless(template: Any, data: Any) -> Any:
+    """Fill a cursor-mode cache ``template``'s KV leaves from a cursorless
+    ``data`` pytree (a ``KVHandoff``/exported-prefix payload), keeping the
+    template's own ``index`` leaves — the inverse of ``_strip_index``.
+    The cursor value is irrelevant: every consumer re-seeds it
+    (``_set_cursor``) before use. Payload leaves may be position-trimmed
+    (exports carry their bucket, not max_len): the transfer ships the
+    trimmed bytes, then zero-pads back out on device — zeros past the
+    live positions are never attended."""
+    if isinstance(template, dict):
+        return {k: (v if k == "index" else _graft_cursorless(v, data[k]))
+                for k, v in template.items()}
+    leaf = jnp.asarray(np.asarray(data))
+    pad = template.shape[2] - leaf.shape[2]
+    if pad > 0:
+        leaf = jnp.pad(leaf, [(0, 0), (0, 0), (0, pad)]
+                       + [(0, 0)] * (leaf.ndim - 3))
+    return leaf
+
+
+def _host_leaves(cache: Any) -> Any:
+    """Device → host copy of a cursorless cache pytree (numpy leaves)."""
+    return jax.tree.map(np.asarray, cache)
+
+
+def _cache_nbytes(cache: Any) -> int:
+    return sum(int(leaf.nbytes) for leaf in jax.tree.leaves(cache))
+
+
+def _cache_checksum(cache: Any, *meta) -> str:
+    """Stable content hash of a host cache pytree plus metadata ints —
+    what lets a decode replica REJECT a handoff corrupted in transfer
+    instead of decoding silently-wrong tokens from a poisoned cache.
+    Leaf order is ``jax.tree`` flatten order: deterministic for a fixed
+    tree structure."""
+    import hashlib
+    h = hashlib.blake2b(digest_size=16)
+    h.update(repr(tuple(meta)).encode())
+    for leaf in jax.tree.leaves(cache):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """A completed prefill's KV, host-resident and engine-portable — the
+    payload the disaggregated fleet moves from its prefill pool to its
+    decode pool (`tpu_on_k8s/serve/disagg.py`).
+
+    ``cache`` is a cursorless batch-1 pytree (numpy leaves, the prefill
+    model's stripped structure — exactly what ``_admit`` masks into a
+    slot), position-trimmed to the 128-bucket of ``pos`` so payload,
+    copy, and checksum bytes scale with the request rather than the
+    engine's max_len. ``pos`` counts TOTAL cached positions; ``base`` counts
+    leading positions NOT carried (a suffix-only handoff: the shared
+    prefix identified by ``prefix_hash`` is expected resident on the
+    adopting engine, so only the suffix's KV crosses the wire —
+    position-absolute RoPE makes the spliced rows exact). ``emitted``
+    holds the tokens already produced (≥ 1: the prefill's first token),
+    so an adopted request resumes mid-stream with its budget intact.
+    ``verify()`` recomputes the transfer checksum — a corrupted payload
+    must be rejected, never decoded."""
+
+    cache: Any
+    pos: int
+    first_token: int
+    emitted: Tuple[int, ...]
+    base: int = 0
+    prefix_hash: Optional[str] = None
+    checksum: str = ""
+
+    def seal(self) -> "KVHandoff":
+        self.checksum = _cache_checksum(self.cache, self.pos, self.base,
+                                        self.emitted)
+        return self
+
+    def verify(self) -> bool:
+        return self.checksum == _cache_checksum(self.cache, self.pos,
+                                                self.base, self.emitted)
+
+    @property
+    def nbytes(self) -> int:
+        return _cache_nbytes(self.cache)
 
 
 class ContinuousBatchingEngine:
@@ -279,8 +379,46 @@ class ContinuousBatchingEngine:
                     jnp.where(keep, pre[:, row], shared[:, slot]))
             return jax.tree.map(write, cache, _strip_index(pre_cache))
 
+        admit_range_progs: Dict[int, Any] = {}
+
+        def admit_range_for(pb: int):
+            """``admit_range`` program for a pre cache whose position
+            axis is trimmed to ``pb`` (export/handoff payloads carry the
+            128-multiple bucket of their live positions, not max_len —
+            the transfer and checksum scale with the request): mask
+            positions ``[lo, hi)`` of a CURSORLESS batch cache's row
+            ``row`` into slot ``slot`` (``lo=0`` for a full handoff;
+            ``lo=base`` to lay a suffix over locally-seeded prefix
+            rows), zero-padding the pre rows back to max_len on device
+            first. Positions outside the range keep the slot's bytes,
+            same never-attended invariant as ``admit``. One program per
+            position bucket — the same bounded set the prefill programs
+            compile over (``pb == max_len`` is the untrimmed case)."""
+            fn = admit_range_progs.get(pb)
+            if fn is None:
+                @functools.partial(
+                    jax.jit, donate_argnums=(0,),
+                    out_shardings=(cache_shardings
+                                   if mesh is not None else None))
+                def admit_range(cache, pre_cache, slot, lo, hi, row):
+                    def write(shared, pre):
+                        pad = shared.shape[2] - pre.shape[2]
+                        pre = jnp.pad(
+                            pre, [(0, 0), (0, 0), (0, pad)]
+                            + [(0, 0)] * (pre.ndim - 3))
+                        span = jnp.arange(shared.shape[2])
+                        keep = (span >= lo) & (span < hi)
+                        keep = keep.reshape(
+                            (1, -1) + (1,) * (pre.ndim - 3))
+                        return shared.at[:, slot].set(
+                            jnp.where(keep, pre[:, row], shared[:, slot]))
+                    return jax.tree.map(write, cache, pre_cache)
+                fn = admit_range_progs[pb] = admit_range
+            return fn
+
         self._step = step
         self._admit = admit
+        self._admit_range_for = admit_range_for
         self._prefill_cache: Dict[tuple, Any] = {}  # (bucket, b) -> program
         self._suffix_prefill_cache: Dict[int, Any] = {}
         self._prefixes: Dict[int, Any] = {}   # id → (cache pytree, length)
@@ -288,6 +426,7 @@ class ContinuousBatchingEngine:
 
         self._slots: List[Optional[_Slot]] = [None] * n_slots
         self._queue: deque[_Pending] = deque()
+        self._kv_queue: deque[_KVPending] = deque()
         self._next_id = 0
         self._finished: Dict[int, np.ndarray] = {}
         self._prefilling: Optional[_Prefilling] = None
@@ -295,7 +434,12 @@ class ContinuousBatchingEngine:
         self._admitting: set = set()   # slots mid-admission (popped from
                                        # the queue, prefill in flight) —
                                        # free_slots must not count them
-        self.stats = {"steps": 0, "emitted": 0, "admitted": 0, "crashes": 0}
+        self.stats = {"steps": 0, "emitted": 0, "admitted": 0, "crashes": 0,
+                      # prefill accounting (the disagg pool-cost signal):
+                      # padded positions run through prefill programs, and
+                      # how many of those were shared-prefix registrations
+                      "prefill_positions": 0, "prefix_prefills": 0,
+                      "kv_adopted": 0, "kv_exported": 0}
         #: hard bound on requests in flight (queued + prefilling + slots);
         #: ``submit`` past it raises ``EngineOverloadedError``. None keeps
         #: the historical unbounded queue (library use; the gateway bounds
@@ -339,10 +483,51 @@ class ContinuousBatchingEngine:
         cache, _ = self._prefill_fn(bucket)(
             self._params, jnp.asarray(padded),
             jnp.asarray([lp], np.int32), key)
-        pid = self._next_prefix_id
-        self._next_prefix_id += 1
-        self._prefixes[pid] = (cache, lp)
+        self.stats["prefix_prefills"] += 1
+        self.stats["prefill_positions"] += bucket
+        with self._lock:
+            pid = self._next_prefix_id
+            self._next_prefix_id += 1
+            self._prefixes[pid] = (cache, lp)
         return pid
+
+    def export_prefix(self, prefix_id: int):
+        """Host copy of a registered prefix's KV: ``(cursorless numpy
+        pytree, length)`` — what the fleet prefix store
+        (`tpu_on_k8s/serve/kvstore.py`) keeps in its host-RAM overflow
+        tier so OTHER replicas can adopt the prefix without recomputing
+        its prefill."""
+        with self._lock:
+            cache, lp = self._prefixes[prefix_id]
+        # position-trimmed like export_kv: the overflow tier's host-RAM
+        # budget charges for the prefix's bucket, not max_len
+        pb = _bucket_len(lp, self.max_len)
+        return _host_leaves(jax.tree.map(
+            lambda leaf: leaf[:, :, :pb], _strip_index(cache))), lp
+
+    def import_prefix(self, cache, lp: int) -> int:
+        """Register an already-computed prefix KV (an ``export_prefix``
+        host copy from a same-config engine) without running any prefill
+        — a host→device copy instead of compute. Returns the new
+        prefix id."""
+        lp = int(lp)
+        if lp < 1 or lp > self.max_len - 2:
+            raise ValueError(f"prefix length {lp} does not fit under "
+                             f"max_len {self.max_len}")
+        device = _graft_cursorless(init_cache(self._prefill_model, 1), cache)
+        with self._lock:
+            pid = self._next_prefix_id
+            self._next_prefix_id += 1
+            self._prefixes[pid] = (device, lp)
+        return pid
+
+    def drop_prefix(self, prefix_id: int) -> bool:
+        """Release a registered prefix's device KV (the store's demotion
+        path — its host copy lives on in the overflow tier). The caller
+        owns the invariant that no queued/in-flight request still
+        references the id."""
+        with self._lock:
+            return self._prefixes.pop(prefix_id, None) is not None
 
     def check_request(self, prompt, max_new_tokens: int,
                       prefix_id: Optional[int] = None) -> np.ndarray:
@@ -383,9 +568,7 @@ class ContinuousBatchingEngine:
         prompt = self.check_request(prompt, max_new_tokens, prefix_id)
         with self._lock:
             if self.queue_cap is not None:
-                inflight = (len(self._queue) + len(self._admitting)
-                            + sum(s is not None for s in self._slots)
-                            + (1 if self._prefilling is not None else 0))
+                inflight = self._inflight_locked()
                 if inflight >= self.queue_cap:
                     raise EngineOverloadedError(inflight, self.queue_cap)
             rid = self._next_id
@@ -398,6 +581,99 @@ class ContinuousBatchingEngine:
             self.metrics.inc("requests_submitted")
             self.metrics.set_gauge("queue_depth", depth)
         return rid
+
+    def _inflight_locked(self) -> int:
+        return (len(self._queue) + len(self._kv_queue)
+                + len(self._admitting)
+                + sum(s is not None for s in self._slots)
+                + (1 if self._prefilling is not None else 0))
+
+    def submit_kv(self, handoff: "KVHandoff", max_new_tokens: int,
+                  eos_id: Optional[int] = None,
+                  prefix_id: Optional[int] = None,
+                  on_token=None) -> int:
+        """Enqueue a request whose prefill ALREADY HAPPENED on another
+        engine: ``handoff`` carries the KV (`KVHandoff`), so admission is
+        a cache splice into a free slot — zero prefill FLOPs here, which
+        is the whole point of a dedicated decode pool. ``max_new_tokens``
+        is the request's TOTAL budget; the handoff's already-emitted
+        tokens count against it (they seed the slot, and are NOT re-fired
+        through ``on_token`` — the caller delivered them). A suffix-only
+        handoff (``base > 0``) needs ``prefix_id`` naming a locally
+        registered prefix of exactly ``base`` positions. The caller
+        verifies the transfer checksum (``handoff.verify()``) — this
+        method trusts its input."""
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        if not handoff.emitted:
+            raise ValueError("handoff carries no emitted tokens")
+        if handoff.base > 0:
+            if prefix_id is None:
+                raise ValueError("suffix-only handoff needs a prefix_id")
+            with self._lock:
+                if prefix_id not in self._prefixes:
+                    raise ValueError(f"unknown prefix_id {prefix_id}")
+                plen = self._prefixes[prefix_id][1]
+            if plen != handoff.base:
+                raise ValueError(f"handoff base {handoff.base} != local "
+                                 f"prefix length {plen}")
+        remaining = max_new_tokens - len(handoff.emitted)
+        if handoff.pos + max(remaining, 0) > self.max_len:
+            raise ValueError(
+                f"cached {handoff.pos} + remaining {remaining} exceeds "
+                f"the engine's max_len {self.max_len}")
+        with self._lock:
+            if self.queue_cap is not None:
+                inflight = self._inflight_locked()
+                if inflight >= self.queue_cap:
+                    raise EngineOverloadedError(inflight, self.queue_cap)
+            rid = self._next_id
+            self._next_id += 1
+            self._kv_queue.append(_KVPending(
+                rid, handoff, max_new_tokens, eos_id, prefix_id,
+                time.monotonic(), on_token))
+        if self.metrics is not None:
+            self.metrics.inc("requests_submitted")
+        return rid
+
+    def export_kv(self, request_id: int) -> Optional["KVHandoff"]:
+        """Extract a slot-resident request's ACCUMULATED cache (prefix +
+        prompt + decoded-so-far) as a sealed host ``KVHandoff`` — adopting
+        it on a same-config engine via ``submit_kv`` continues decode
+        token-identically (the oracle test in
+        `tests/test_serve_disagg.py`). The request keeps running here;
+        pair with ``abort()`` to migrate it. ``None`` when the id is not
+        currently in a slot (queued / mid-prefill / finished). Driver
+        thread only, like ``abort`` — the slot row read must not race a
+        running device step."""
+        with self._lock:
+            found = None
+            for i, s in enumerate(self._slots):
+                if s is not None and s.request_id == request_id:
+                    found = (i, s)
+                    break
+            if found is None:
+                return None
+            i, s = found
+            pos, emitted = s.pos, tuple(s.emitted)
+        # trim to the 128-bucket of the live positions: the device→host
+        # copy, the checksum, and every hop downstream scale with the
+        # request, not with max_len (garbage past pos was never data)
+        pb = _bucket_len(pos, self.max_len)
+        row = jax.tree.map(
+            lambda leaf: np.asarray(leaf[:, i:i + 1, :pb]), self._cache)
+        self.stats["kv_exported"] += 1
+        return KVHandoff(cache=row, pos=pos, first_token=emitted[0],
+                         emitted=emitted).seal()
+
+    def start_prefill(self, prompt, prefix_id: Optional[int] = None
+                      ) -> "PrefillJob":
+        """Begin an incremental prefill that ends in a ``KVHandoff``
+        instead of a slot admission — the prefill-pool half of
+        disaggregated serving. See ``PrefillJob``."""
+        prompt = self.check_request(prompt, 1, prefix_id)
+        return PrefillJob(self, prompt, prefix_id)
 
     def _prefill_fn(self, bucket: int, b: int = 1):
         """Prefill ``b`` same-bucket prompts in ONE program: prompts
@@ -452,9 +728,63 @@ class ContinuousBatchingEngine:
     #: bounded set so (bucket, b) programs can't proliferate
     _ADMIT_BATCH_SIZES = (4, 2, 1)
 
+    def _admit_kv_pending(self) -> None:
+        """Adopt queued KV handoffs into free slots — before the regular
+        queue: a handed-off request already paid its prefill (and its
+        queue wait on the prefill pool), and its splice costs no prefill
+        program, so it never starves prompt admissions of device time."""
+        while True:
+            with self._lock:
+                if not self._kv_queue:
+                    return
+                free = [i for i in range(self.n_slots)
+                        if self._slots[i] is None
+                        and i != self._reserved_slot
+                        and i not in self._admitting]
+                if not free:
+                    return
+                req = self._kv_queue.popleft()
+                self._admitting.add(free[0])
+            i = free[0]
+            try:
+                self._adopt_into_slot(i, req)
+            finally:
+                with self._lock:
+                    self._admitting.discard(i)
+
+    def _adopt_into_slot(self, i: int, req: _KVPending) -> None:
+        """Splice a handoff's KV into slot ``i`` and activate it. A
+        suffix-only handoff lays its rows over the locally registered
+        prefix's (identical bytes to what the prefill replica attended —
+        same params, same tokens, same compiled programs)."""
+        h = req.handoff
+        device = jax.tree.map(jnp.asarray, h.cache)
+        pb = jax.tree.leaves(device)[0].shape[2]
+        if h.base > 0:
+            prefix_cache = self._prefixes[req.prefix_id][0]
+            self._cache = self._admit(self._cache, prefix_cache,
+                                      jnp.int32(i), jnp.int32(h.base),
+                                      jnp.int32(0))
+        self._cache = self._admit_range_for(pb)(
+            self._cache, device, jnp.int32(i),
+            jnp.int32(h.base), jnp.int32(h.pos), jnp.int32(0))
+        with self._lock:
+            self._slots[i] = _Slot(req.request_id, h.pos,
+                                   int(h.emitted[-1]), list(h.emitted),
+                                   req.max_new_tokens, req.eos_id,
+                                   req.submitted_at, req.on_token)
+        # pre-emitted tokens are NOT re-fired or re-counted: the prefill
+        # engine emitted them and the handoff's owner delivered them
+        self.stats["admitted"] += 1
+        self.stats["kv_adopted"] += 1
+        if self.metrics is not None:
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+        self._retire_if_done(i)
+
     def _admit_pending(self) -> None:
         if self._prefilling is not None:
             self._advance_prefill()       # one chunk per engine step
+        self._admit_kv_pending()
         with self._lock:
             # bound this pass to the arrivals present at entry: under
             # concurrent submitters an unbounded while-queue loop could
@@ -543,6 +873,7 @@ class ContinuousBatchingEngine:
                     pre_cache, first = self._suffix_prefill_fn(bucket)(
                         self._params, prefix_cache, jnp.asarray(padded),
                         jnp.int32(plen), jnp.int32(slen), key)
+                    self.stats["prefill_positions"] += bucket
                     self._finish_admission(free[0], req, pre_cache, first,
                                            plen + slen, dequeued_at)
                     continue
@@ -556,6 +887,7 @@ class ContinuousBatchingEngine:
                 pre_cache, firsts = self._prefill_fn(bucket, b)(
                     self._params, jnp.asarray(padded), jnp.asarray(lps),
                     key)
+                self.stats["prefill_positions"] += bucket * b
                 firsts = np.asarray(firsts)
                 for j, (r, i) in enumerate(zip(group, free)):
                     self._finish_admission(i, r, pre_cache, firsts[j],
@@ -583,6 +915,7 @@ class ContinuousBatchingEngine:
         st.pre_cache, first = self._suffix_prefill_fn(bucket)(
             self._params, st.pre_cache, jnp.asarray(padded),
             jnp.int32(st.done), jnp.int32(clen), key)
+        self.stats["prefill_positions"] += bucket
         st.done += clen
         if st.done == st.total:
             i = self._reserved_slot
@@ -688,6 +1021,12 @@ class ContinuousBatchingEngine:
                         self.metrics.set_gauge("queue_depth",
                                                len(self._queue))
                     return np.zeros(0, np.int32)
+            for idx, p in enumerate(self._kv_queue):
+                if p.request_id == request_id:
+                    del self._kv_queue[idx]
+                    # the handoff's tokens were already delivered by its
+                    # owner — partial, like a mid-decode abort
+                    return np.asarray(p.handoff.emitted, np.int32)
             st = self._prefilling
             if st is not None and st.req.request_id == request_id:
                 # drop the private prefill cache and the slot reservation;
@@ -714,11 +1053,13 @@ class ContinuousBatchingEngine:
         can re-admit its own and account for any it does not own."""
         with self._lock:
             lost = [p.request_id for p in self._queue]
+            lost += [p.request_id for p in self._kv_queue]
             if self._prefilling is not None:
                 lost.append(self._prefilling.req.request_id)
             lost += [s.request_id for s in self._slots if s is not None]
             self._slots = [None] * self.n_slots
             self._queue.clear()
+            self._kv_queue.clear()
             self._prefilling = None
             self._reserved_slot = None
             self._admitting.clear()
@@ -789,7 +1130,8 @@ class ContinuousBatchingEngine:
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drain the queue and every active slot; returns {id: tokens}."""
-        while (self._queue or self._prefilling is not None
+        while (self._queue or self._kv_queue
+               or self._prefilling is not None
                or any(s is not None for s in self._slots)):
             self.step()
         out, self._finished = self._finished, {}
@@ -808,3 +1150,127 @@ class ContinuousBatchingEngine:
             free = sum(s is None for s in self._slots)
             return (free - len(self._admitting)
                     - (1 if self._reserved_slot is not None else 0))
+
+
+def _zero_below(leaf: np.ndarray, base: int) -> np.ndarray:
+    """Zero a cache leaf's positions < ``base`` (axis 2 — the same axis
+    the admit programs span): a suffix-only handoff transfers nothing it
+    expects the adopting engine to supply, and its checksum covers
+    exactly the transferred bytes."""
+    out = np.array(leaf)
+    out[:, :, :base] = 0
+    return out
+
+
+class PrefillJob:
+    """Incremental prefill that ends in a ``KVHandoff`` instead of a slot
+    admission — the prefill-pool half of disaggregated serving
+    (`tpu_on_k8s/serve/disagg.py`).
+
+    ``advance()`` runs ONE chunk per call (``engine.prefill_chunk``
+    positions when chunking is on; otherwise the whole prompt), mirroring
+    exactly the admission path a monolithic engine with the same config
+    would take — same programs, same bucketing, same chunk boundaries —
+    so decode from the handed-off KV is oracle-identical to monolithic
+    decode. The job drives the engine's prefill programs directly and
+    never touches the slot pool; one job at a time per engine is the
+    caller's discipline (the disagg fleet runs one per prefill replica,
+    matching the engine's own one-chunked-prefill-in-flight rule).
+
+    With ``prefix_id`` the job prefills only the suffix over the
+    registered prefix's cache (the fleet-wide dedup win: the shared
+    prefix's prefill already happened, possibly on another replica via
+    the `FleetPrefixStore`)."""
+
+    def __init__(self, engine: ContinuousBatchingEngine, prompt: np.ndarray,
+                 prefix_id: Optional[int]) -> None:
+        self._engine = engine
+        self.prompt = prompt
+        self.prefix_id = prefix_id
+        if prefix_id is not None:
+            with engine._lock:
+                cache, base = engine._prefixes[prefix_id]
+            # never mutated: the suffix program is functional and the
+            # cursor re-seed rebuilds leaves
+            self._cache = cache
+        else:
+            base = 0
+            self._cache = None
+        self.base = base
+        self.done = base                   # positions cached so far
+        self.total = base + int(prompt.size)
+        self.first_token: Optional[int] = None
+
+    @property
+    def finished(self) -> bool:
+        return self.first_token is not None
+
+    @property
+    def remaining(self) -> int:
+        return self.total - self.done
+
+    def advance(self) -> bool:
+        """Prefill one chunk; returns True once the whole prompt is
+        cached (``first_token`` is then the prefill's sampled token)."""
+        if self.finished:
+            return True
+        eng = self._engine
+        chunked = (eng.prefill_chunk
+                   and self.prompt.size > eng.prefill_chunk)
+        if not chunked and self.base == 0:
+            # whole-prompt, no prefix: the monolithic cold-admission path
+            lp = int(self.prompt.size)
+            bucket = _bucket_len(lp, eng.max_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :lp] = self.prompt
+            eng._rng, key = jax.random.split(eng._rng)
+            self._cache, firsts = eng._prefill_fn(bucket)(
+                eng._params, jnp.asarray(padded),
+                jnp.asarray([lp], np.int32), key)
+            eng.stats["prefill_positions"] += bucket
+            self.done = self.total
+            self.first_token = int(np.asarray(firsts)[0])
+            eng.stats["emitted"] += 1
+            return True
+        if self._cache is None:
+            self._cache = init_cache(eng._prefill_model, 1)
+        offset = self.done - self.base
+        chunk = (self.prompt[offset:offset + eng.prefill_chunk]
+                 if chunked else self.prompt[offset:])
+        clen = int(chunk.size)
+        bucket = _bucket_len(clen, eng.max_len - self.done)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :clen] = chunk
+        eng._rng, key = jax.random.split(eng._rng)
+        self._cache, first = eng._suffix_prefill_fn(bucket)(
+            eng._params, self._cache, jnp.asarray(padded),
+            jnp.int32(self.done), jnp.int32(clen), key)
+        eng.stats["prefill_positions"] += bucket
+        self.done += clen
+        if self.done == self.total:
+            self.first_token = int(first)
+            eng.stats["emitted"] += 1
+        return self.finished
+
+    def handoff(self, *, suffix_only: bool = False,
+                prefix_hash: Optional[str] = None) -> KVHandoff:
+        """Export the finished prefill as a sealed host ``KVHandoff``.
+        ``suffix_only`` (with a prefix-seeded job) strips the shared
+        prefix's rows — the adopting engine supplies them from its own
+        registered copy of ``prefix_hash``, so only suffix bytes cross
+        the wire."""
+        if not self.finished:
+            raise RuntimeError("prefill is not finished")
+        # position-trimmed like export_kv: payload bytes track the
+        # request's bucket, not max_len
+        pb = _bucket_len(self.total, self._engine.max_len)
+        host = _host_leaves(jax.tree.map(
+            lambda leaf: leaf[:, :, :pb], _strip_index(self._cache)))
+        base = 0
+        if suffix_only and self.base > 0:
+            base = self.base
+            host = jax.tree.map(lambda leaf: _zero_below(leaf, base), host)
+        return KVHandoff(cache=host, pos=self.total,
+                         first_token=self.first_token,
+                         emitted=(self.first_token,), base=base,
+                         prefix_hash=prefix_hash).seal()

@@ -16,19 +16,25 @@ program. This package is the missing layer between that and a service:
 * `health`     — replica readiness (slow start) and liveness probes;
 * `fleet`      — ``ServingFleet``: many replicas behind one routed front
   door, with ejection + cross-replica replay and zero-loss rolling
-  rollouts (the serve-plane twin of `controller/inferenceservice.py`).
+  rollouts (the serve-plane twin of `controller/inferenceservice.py`);
+* `disagg`     — ``DisaggFleet``: prefill and decode as separately-scaled
+  pools with checksummed KV handoff between them;
+* `kvstore`    — ``FleetPrefixStore``: fleet-wide content-addressed
+  prefix/KV cache with a host-RAM overflow tier.
 """
 from tpu_on_k8s.serve.admission import (
     AdmissionConfig,
     AdmissionController,
     Rejected,
 )
+from tpu_on_k8s.serve.disagg import DisaggFleet, DisaggPool, PoolReplica
 from tpu_on_k8s.serve.fleet import (
     FleetRolloutPolicy,
     Replica,
     RolloutPhase,
     ServingFleet,
 )
+from tpu_on_k8s.serve.kvstore import FleetPrefixStore, prefix_hash
 from tpu_on_k8s.serve.gateway import ReplayPolicy, ServingGateway
 from tpu_on_k8s.serve.health import HealthMonitor, ProbeConfig, ReplicaState
 from tpu_on_k8s.serve.lifecycle import (
@@ -42,9 +48,14 @@ from tpu_on_k8s.serve.scheduler import FairScheduler
 __all__ = [
     "AdmissionConfig",
     "AdmissionController",
+    "DisaggFleet",
+    "DisaggPool",
     "FairScheduler",
+    "FleetPrefixStore",
     "FleetRolloutPolicy",
     "GatewayRequest",
+    "PoolReplica",
+    "prefix_hash",
     "HealthMonitor",
     "ProbeConfig",
     "Rejected",

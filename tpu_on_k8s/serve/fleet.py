@@ -65,6 +65,9 @@ from tpu_on_k8s.serve.lifecycle import (
 )
 from tpu_on_k8s.serve.router import Router
 
+#: "match not precomputed" sentinel — None is a real match_prefix result
+_UNSET = object()
+
 
 class RolloutPhase(str, enum.Enum):
     """Fleet rollout position (mirrored into ``FleetMetrics`` as the
@@ -364,8 +367,11 @@ class ServingFleet:
         with self._lock:
             if not self._accepting:
                 return Rejected(REASON_DRAINING, "fleet is draining")
+            # ONE noted-prefix scan per submit: routing and the prefix
+            # plan both consume this match instead of re-scanning
+            pmatch, pkey = self.router.affinity(prompt)
             target = self.router.route(prompt, self._ready_names(),
-                                       self._outstanding())
+                                       self._outstanding(), key=pkey)
             if target is None:
                 return Rejected(REASON_UNAVAILABLE,
                                 "no replica is ready for traffic",
@@ -382,7 +388,7 @@ class ServingFleet:
                 on_token=on_token,
                 cost=int(prompt.size) + max_new_tokens)
             send, pid, key, reg = self._prefix_plan_locked(
-                prompt, rep, allow_register=True)
+                prompt, rep, allow_register=True, match=pmatch)
             if reg is None:
                 r = self._dispatch_locked(req, rep, send, pid)
                 if isinstance(r, Rejected):
@@ -401,10 +407,14 @@ class ServingFleet:
         except Exception:                  # noqa: BLE001 — replica died
             new_pid = None                 # under us; serve cold instead
         with self._lock:
-            blen = self.router.prefix_bucket_len
             if new_pid is not None and rep.prefix_ids.get(key,
                                                           -1) is None:
                 rep.prefix_ids[key] = new_pid
+                # teach the router the registered CONTENT: prompts that
+                # share this prefix but diverge before the raw bucket
+                # boundary now key to the same replica (for the fleet's
+                # own bucket-length heads the key is unchanged)
+                self.router.note_prefix(reg)
             else:
                 rep.prefix_ids.pop(key, None)
             if req.state not in LIVE_STATES:
@@ -416,7 +426,7 @@ class ServingFleet:
                     self._pending.append(rid)
                 return rid
             if new_pid is not None:
-                send, pid = prompt[blen:], new_pid
+                send, pid = prompt[reg.size:], new_pid
             r = self._dispatch_locked(req, rep, send, pid)
             if isinstance(r, Rejected):
                 del self._requests[rid]
@@ -470,8 +480,8 @@ class ServingFleet:
     # ------------------------------------------------------------- dispatch
     def _dispatch_locked(self, req: _FleetRequest, rep: Replica,
                          send: Optional[np.ndarray] = None,
-                         prefix_id: Optional[int] = None
-                         ) -> Union[int, Rejected]:
+                         prefix_id: Optional[int] = None,
+                         match=_UNSET) -> Union[int, Rejected]:
         """Hand ``req`` to ``rep``'s gateway. ``submit()`` passes the
         prepared (suffix, prefix id) pair in; re-dispatch paths leave
         them None and get a no-registration prefix plan (a hit when the
@@ -479,7 +489,7 @@ class ServingFleet:
         never pay a registration prefill under the lock). Lock held."""
         if send is None:
             send, prefix_id, _, _ = self._prefix_plan_locked(
-                req.prompt, rep, allow_register=False)
+                req.prompt, rep, allow_register=False, match=match)
         now = self._clock()
         deadline_s = None
         if req.deadline is not None:
@@ -509,7 +519,7 @@ class ServingFleet:
         return r
 
     def _prefix_plan_locked(self, prompt: np.ndarray, rep: Replica, *,
-                            allow_register: bool
+                            allow_register: bool, match=_UNSET
                             ) -> Tuple[np.ndarray, Optional[int],
                                        Optional[int],
                                        Optional[np.ndarray]]:
@@ -526,7 +536,18 @@ class ServingFleet:
         if (not self._auto_prefix or prompt.size <= blen
                 or blen > rep.engine.max_len - 2):
             return prompt, None, None, None
-        key = self.router.bucket_key(prompt)
+        m = self.router.match_prefix(prompt) if match is _UNSET else match
+        if m is not None and m[1] < blen:
+            # The affinity key is a noted prefix SHORTER than the bucket:
+            # two prompts sharing it may diverge inside [match, blen), so
+            # the bucket-length engine-prefix registry must not be keyed
+            # by it — splicing another prompt's head KV would silently
+            # decode wrong tokens. Routing still gets the warm-replica
+            # affinity; the engine serves this prompt cold.
+            return prompt, None, None, None
+        # the match above already IS the bucket key when it hit;
+        # bucket_key() would re-run the whole noted-prefix scan
+        key = m[0] if m is not None else self.router.head_key(prompt)
         pid = rep.prefix_ids.get(key, -1)
         if pid is not None and pid >= 0:
             self.stats["prefix_hits"] += 1
@@ -644,10 +665,12 @@ class ServingFleet:
         otherwise park it in the fleet pending queue (retried every
         step). No backoff: unlike an in-place replay onto a
         just-crashed engine, the target here is a healthy survivor."""
+        pmatch, pkey = self.router.affinity(req.prompt)
         target = self.router.route(req.prompt, self._ready_names(),
-                                   self._outstanding())
+                                   self._outstanding(), key=pkey)
         if target is not None:
-            r = self._dispatch_locked(req, self.replicas[target])
+            r = self._dispatch_locked(req, self.replicas[target],
+                                      match=pmatch)
             if not isinstance(r, Rejected):
                 return
         if req.rid not in self._pending:
@@ -732,11 +755,13 @@ class ServingFleet:
                     self._finalize_locked(req,
                                           RequestState.DEADLINE_EXCEEDED)
                     continue
+                pmatch, pkey = self.router.affinity(req.prompt)
                 target = self.router.route(req.prompt, self._ready_names(),
-                                           self._outstanding())
+                                           self._outstanding(), key=pkey)
                 if target is None:
                     continue
-                r = self._dispatch_locked(req, self.replicas[target])
+                r = self._dispatch_locked(req, self.replicas[target],
+                                          match=pmatch)
                 if not isinstance(r, Rejected):
                     self._pending.remove(rid)
             self.stats["steps"] += 1
@@ -981,25 +1006,15 @@ class ServingFleet:
         # autoscale package pulls gang/, which fleet must not load at
         # module import time
         from tpu_on_k8s.autoscale.signals import (
-            NO_DATA,
             FleetScraper,
-            percentile,
+            format_observation_line,
         )
         if self._obs_scraper is None:
             self._obs_scraper = FleetScraper()
         s = self._obs_scraper.scrape(self)
-
-        def p95(vals) -> float:
-            v = percentile(vals, 0.95)
-            return NO_DATA if v is None else v
-
-        src = s.ttft or s.queue_wait
-        return (f"[elastic-metrics] epoch={self.stats['rollouts_completed']} "
-                f"batch={self.stats['steps']} latency={p95(src):.6f} "
-                f"accuracy=0.0 queue_wait={p95(s.queue_wait):.6f} "
-                f"queue_depth={s.queue_depth} "
-                f"inflight={s.inflight_tokens} "
-                f"slots={s.slots} ready={s.ready_replicas}")
+        return format_observation_line(
+            s, epoch=self.stats["rollouts_completed"],
+            batch=self.stats["steps"])
 
 
 class _Rollout:

@@ -1,0 +1,313 @@
+"""FleetPrefixStore: one shared prefix/KV tier for a whole serving fleet.
+
+`models/serving.register_prefix` is per-engine and device-resident: every
+replica that meets a shared system prompt pays its own prefill and its
+own HBM. At fleet scale that multiplies the single biggest shared cost in
+prefix-heavy traffic by the replica count — and the router's consistent
+hash only *reduces* the multiplier, it cannot make the work happen once.
+
+This store promotes prefix registration to a fleet-level concern:
+
+* **Content addressing** — a prefix is its token content's blake2b hash,
+  so two replicas (or two requests) naming the same bytes name the same
+  entry; registration is idempotent and per-replica engine prefix ids
+  become residency bookkeeping, not identity.
+* **Per-replica residency** — ``ensure(replica, engine, h)`` answers
+  "make this prefix usable on that engine" three ways, in cost order:
+  already registered there (**hit**, free); present in the host-RAM
+  overflow tier (**promote**: a host→device copy via
+  ``engine.import_prefix`` — bandwidth, not FLOPs); nowhere (**miss**:
+  one real prefill via ``engine.register_prefix``, exported into the
+  overflow tier so the fleet never computes it again). The miss counter
+  IS the fleet-wide prefix-prefill recomputation count the disagg
+  acceptance test compares against the monolithic fleet.
+* **Host-RAM overflow tier** — byte-budgeted LRU over host copies.
+  Eviction drops the host bytes (token content survives, so a later miss
+  can recompute) and NEVER touches an entry with live pins — a pinned
+  prefix backs in-flight decode work (a handoff mid-queue, a request
+  mid-adopt) and evicting it could force a recompute mid-request or, for
+  a suffix-only handoff, strand the transfer entirely.
+* **Device demotion** — engines hold at most
+  ``max_device_prefixes`` registered prefixes; registering past the cap
+  demotes the replica's least-recently-ensured unpinned prefix
+  (``engine.drop_prefix`` — the host copy lives on, so demotion costs a
+  future promote, never a recompute).
+
+Determinism: recency is a monotone operation counter, never wall time —
+the injectable ``clock`` only stamps metadata — so the same operation
+sequence produces the same evictions/promotions/demotions bit-for-bit
+(the property `tests/test_serve_disagg.py` pins).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def prefix_hash(tokens) -> str:
+    """Content address of a prefix: blake2b over its int32 token bytes."""
+    arr = np.asarray(tokens, np.int32).reshape(-1)
+    return hashlib.blake2b(arr.tobytes(), digest_size=16).hexdigest()
+
+
+@dataclasses.dataclass
+class _Entry:
+    """One fleet-known prefix. ``host`` is the overflow-tier copy (None =
+    evicted/never exported); ``residency`` maps replica name → that
+    engine's prefix id; ``last_used`` orders the LRU (monotone op
+    counter); ``pins`` counts in-flight decode references."""
+
+    tokens: np.ndarray
+    length: int
+    host: Optional[Any] = None
+    host_nbytes: int = 0
+    residency: Dict[str, int] = dataclasses.field(default_factory=dict)
+    replica_used: Dict[str, int] = dataclasses.field(default_factory=dict)
+    pins: int = 0
+    last_used: int = 0
+    registered_at: float = 0.0
+
+
+class FleetPrefixStore:
+    """See module doc. Thread-safe bookkeeping under one lock; device
+    work (register/import/drop) runs outside it — callers serialize per
+    engine exactly as the fleets already serialize replica access."""
+
+    def __init__(self, *, overflow_budget_bytes: int = 256 << 20,
+                 max_device_prefixes: int = 16, metrics=None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if overflow_budget_bytes < 0:
+            raise ValueError(f"overflow_budget_bytes must be >= 0, got "
+                             f"{overflow_budget_bytes}")
+        if max_device_prefixes < 1:
+            raise ValueError(f"max_device_prefixes must be >= 1, got "
+                             f"{max_device_prefixes}")
+        self.overflow_budget_bytes = overflow_budget_bytes
+        self.max_device_prefixes = max_device_prefixes
+        self.metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+        #: length → hashes of that length, maintained by ``register`` —
+        #: ``match`` runs on every fleet submit, so it must not rebuild
+        #: an index over all entries per call (entries are never removed;
+        #: eviction only drops host bytes)
+        self._by_len: Dict[int, set] = {}
+        self._op = 0                       # monotone recency counter
+        self.stats = {"hits": 0, "promotes": 0, "misses": 0,
+                      "evictions": 0, "demotes": 0, "overflow_bytes": 0,
+                      "pinned_eviction_skips": 0}
+
+    # ------------------------------------------------------------ registry
+    def register(self, tokens) -> str:
+        """Make a prefix fleet-known (idempotent; no device work — the
+        first ``ensure`` pays the one fleet-wide prefill). Returns its
+        content hash."""
+        arr = np.asarray(tokens, np.int32).reshape(-1)
+        if arr.size == 0:
+            raise ValueError("empty prefix")
+        h = prefix_hash(arr)
+        with self._lock:
+            if h not in self._entries:
+                self._entries[h] = _Entry(tokens=arr, length=int(arr.size),
+                                          registered_at=self._clock())
+                self._by_len.setdefault(int(arr.size), set()).add(h)
+        return h
+
+    def known(self, h: str) -> bool:
+        with self._lock:
+            return h in self._entries
+
+    def __len__(self) -> int:
+        """Registered-prefix count (entries are never removed — eviction
+        only drops host bytes), so fleets can cap auto-registration."""
+        with self._lock:
+            return len(self._entries)
+
+    def length_of(self, h: str) -> int:
+        with self._lock:
+            return self._entries[h].length
+
+    def tokens_of(self, h: str) -> np.ndarray:
+        with self._lock:
+            return self._entries[h].tokens
+
+    def match(self, prompt) -> Optional[Tuple[str, int]]:
+        """Longest registered prefix that ``prompt`` starts with, as
+        ``(hash, length)`` — the content-aware affinity key
+        `serve/router.py`'s bucket fix mirrors. None when nothing
+        matches or the prompt IS the prefix (no suffix to serve)."""
+        arr = np.asarray(prompt, np.int32).reshape(-1)
+        with self._lock:
+            for ln in sorted(self._by_len, reverse=True):
+                if arr.size <= ln:
+                    continue
+                head = prefix_hash(arr[:ln])
+                if head in self._by_len[ln]:  # hash equality == content
+                    return head, ln           # equality at 16-byte digests
+        return None
+
+    def resident_on(self, h: str) -> List[str]:
+        """Replica names where ``h`` is device-registered (the KV-locality
+        signal the disagg decode router prefers)."""
+        with self._lock:
+            e = self._entries.get(h)
+            return sorted(e.residency) if e is not None else []
+
+    def resident_id(self, replica: str, h: str) -> Optional[int]:
+        with self._lock:
+            e = self._entries.get(h)
+            return None if e is None else e.residency.get(replica)
+
+    # ------------------------------------------------------------- pinning
+    def pin(self, h: str) -> None:
+        """Mark ``h`` as backing in-flight decode work: the overflow tier
+        must not evict it until every pin is released."""
+        with self._lock:
+            self._entries[h].pins += 1
+
+    def unpin(self, h: str) -> None:
+        with self._lock:
+            e = self._entries.get(h)
+            if e is not None and e.pins > 0:
+                e.pins -= 1
+
+    # ------------------------------------------------------------- ensure
+    def ensure(self, replica: str, engine, h: str) -> int:
+        """Make prefix ``h`` usable on ``replica``'s ``engine``; returns
+        that engine's prefix id. Hit < promote < miss (see module doc).
+        A miss exports the freshly computed KV into the overflow tier
+        (evicting LRU unpinned entries past the byte budget) so the rest
+        of the fleet promotes instead of recomputing."""
+        with self._lock:
+            e = self._entries[h]
+            self._op += 1
+            e.last_used = self._op
+            pid = e.residency.get(replica)
+            if pid is not None:
+                e.replica_used[replica] = self._op
+                self.stats["hits"] += 1
+                self._inc("prefix_store_hits")
+                return pid
+            host = e.host
+        if host is not None:
+            pid = engine.import_prefix(host, self._entries[h].length)
+            with self._lock:
+                e.residency[replica] = pid
+                e.replica_used[replica] = self._op
+                self.stats["promotes"] += 1
+                self._inc("prefix_store_promotes")
+        else:
+            pid = engine.register_prefix(self._entries[h].tokens)
+            cache, lp = engine.export_prefix(pid)
+            nbytes = sum(int(leaf.nbytes)
+                         for leaf in _tree_leaves(cache))
+            with self._lock:
+                e.residency[replica] = pid
+                e.replica_used[replica] = self._op
+                # re-check: a concurrent miss on another replica may have
+                # landed a host copy first — newest write wins, bytes
+                # charged once
+                if e.host is None:
+                    e.host = cache
+                    e.host_nbytes = nbytes
+                    self.stats["overflow_bytes"] += nbytes
+                self.stats["misses"] += 1
+                self._inc("prefix_store_misses")
+                self._evict_over_budget_locked()
+        self._demote_over_cap(replica, engine, keep=h)
+        self._gauges()
+        return pid
+
+    def forget_replica(self, replica: str) -> None:
+        """Drop ``replica``'s residency everywhere (ejection/scale-down —
+        its engine died with its registrations)."""
+        with self._lock:
+            for e in self._entries.values():
+                e.residency.pop(replica, None)
+                e.replica_used.pop(replica, None)
+
+    # ------------------------------------------------------------ eviction
+    def _evict_over_budget_locked(self) -> None:
+        """Drop LRU unpinned host copies until the byte budget holds.
+        Pinned entries are skipped — never evicted — and counted, so a
+        budget wedged open by pins is visible."""
+        if self.stats["overflow_bytes"] <= self.overflow_budget_bytes:
+            return
+        victims = sorted((e for e in self._entries.values()
+                          if e.host is not None),
+                         key=lambda e: e.last_used)
+        for e in victims:
+            if self.stats["overflow_bytes"] <= self.overflow_budget_bytes:
+                return
+            if e.pins > 0:
+                self.stats["pinned_eviction_skips"] += 1
+                continue
+            self.stats["overflow_bytes"] -= e.host_nbytes
+            e.host = None
+            e.host_nbytes = 0
+            self.stats["evictions"] += 1
+            self._inc("prefix_store_evictions")
+
+    def _demote_over_cap(self, replica: str, engine, *, keep: str) -> None:
+        """Hold ``replica`` at ``max_device_prefixes`` registrations:
+        demote its least-recently-ensured unpinned prefix (never the one
+        just ensured). Device HBM is the scarce tier; the host copy makes
+        demotion a future promote, not a recompute."""
+        while True:
+            with self._lock:
+                resident = [(e.replica_used.get(replica, 0), h, e)
+                            for h, e in self._entries.items()
+                            if replica in e.residency]
+                if len(resident) <= self.max_device_prefixes:
+                    return
+                resident.sort()
+                victim = next(((h, e) for _, h, e in resident
+                               if h != keep and e.pins == 0), None)
+                if victim is None:
+                    return             # everything else is pinned: hold
+                h, e = victim
+                pid = e.residency.pop(replica)
+                e.replica_used.pop(replica, None)
+                self.stats["demotes"] += 1
+                self._inc("prefix_store_demotes")
+            engine.drop_prefix(pid)
+
+    # ---------------------------------------------------------- observability
+    def _inc(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name)
+
+    def _gauges(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge("prefix_store_overflow_bytes",
+                                   self.stats["overflow_bytes"])
+
+    @property
+    def overflow_bytes(self) -> int:
+        with self._lock:
+            return self.stats["overflow_bytes"]
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """Stable per-entry view for tests/debugging."""
+        with self._lock:
+            return {h: {"length": e.length, "pins": e.pins,
+                        "in_overflow": e.host is not None,
+                        "residency": sorted(e.residency)}
+                    for h, e in sorted(self._entries.items())}
+
+
+def _tree_leaves(tree: Any) -> List[Any]:
+    """Leaves of a nested-dict pytree without importing jax (the store is
+    importable — and testable — from the stdlib-only control plane)."""
+    if isinstance(tree, dict):
+        out: List[Any] = []
+        for k in sorted(tree):
+            out.extend(_tree_leaves(tree[k]))
+        return out
+    return [tree]

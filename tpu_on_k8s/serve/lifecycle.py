@@ -50,6 +50,11 @@ class RequestState(str, enum.Enum):
     ADMITTED = "admitted"                    # handed to the engine (may be
                                              # mid-chunked-prefill)
     DECODING = "decoding"                    # first token emitted
+    # disaggregated-only states (`tpu_on_k8s/serve/disagg.py`): the
+    # request lifecycle there is queued → prefilling → handoff →
+    # decoding, with the prefill and decode halves on different replicas
+    PREFILLING = "prefilling"                # on a prefill-pool replica
+    HANDOFF = "handoff"                      # KV in the handoff queue
     DONE = "done"
     CANCELLED = "cancelled"
     DEADLINE_EXCEEDED = "deadline_exceeded"
@@ -61,7 +66,8 @@ class RequestState(str, enum.Enum):
 
 #: states a request can still leave
 LIVE_STATES = frozenset({RequestState.QUEUED, RequestState.ADMITTED,
-                         RequestState.DECODING})
+                         RequestState.DECODING, RequestState.PREFILLING,
+                         RequestState.HANDOFF})
 TERMINAL_STATES = frozenset(RequestState) - LIVE_STATES
 
 

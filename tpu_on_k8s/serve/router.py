@@ -79,6 +79,9 @@ class Router:
         self._ring: List[Tuple[int, str]] = []     # (point, name), sorted
         self._weights: Dict[str, float] = {}       # version → weight
         self._wrr = SmoothWRR()
+        #: registered shared-prefix contents, keyed by length: the
+        #: affinity key prefers these over the raw head bucket
+        self._prefix_keys: Dict[int, set] = {}
 
     # ------------------------------------------------------------- topology
     def add_replica(self, name: str, version: str) -> None:
@@ -108,20 +111,74 @@ class Router:
         return self._replicas.get(name)
 
     # -------------------------------------------------------------- routing
-    def bucket_key(self, prompt) -> int:
-        """Stable affinity key: hash of the prompt's first
-        ``prefix_bucket_len`` tokens (the whole prompt when shorter) —
-        the unit the engine's prefix cache is warmed at."""
+    def note_prefix(self, tokens) -> int:
+        """Teach the router a REGISTERED prefix's content, so the
+        affinity key for any prompt starting with it becomes the
+        prefix's own content hash rather than the raw
+        ``prefix_bucket_len``-token head. Without this, two prompts
+        sharing a registered prefix SHORTER than the bucket hash to
+        different ring points (their heads differ past the prefix) and
+        land on different replicas — missing the warm cache the prefix
+        was registered to provide. Returns the key it will produce."""
+        head = np.asarray(tokens, np.int32).reshape(-1)
+        if head.size == 0:
+            raise ValueError("empty prefix")
+        key = _hash64(head.tobytes())
+        self._prefix_keys.setdefault(int(head.size), set()).add(key)
+        return key
+
+    def match_prefix(self, prompt) -> Optional[Tuple[int, int]]:
+        """``(key, length)`` of the LONGEST noted prefix the prompt
+        starts with, or None. The length matters as much as the key: a
+        fleet must split warm submissions at the MATCHED prefix's
+        boundary, not the raw bucket — two prompts sharing a noted
+        prefix may differ anywhere past it."""
         head = np.asarray(prompt, np.int32).reshape(-1)
-        head = head[:self.prefix_bucket_len]
-        return _hash64(head.tobytes())
+        for length in sorted(self._prefix_keys, reverse=True):
+            if head.size < length:
+                continue
+            key = _hash64(head[:length].tobytes())
+            if key in self._prefix_keys[length]:
+                return key, length
+        return None
+
+    def bucket_key(self, prompt) -> int:
+        """Stable affinity key: the content hash of the LONGEST noted
+        prefix the prompt starts with (`note_prefix`), falling back to
+        the hash of the prompt's first ``prefix_bucket_len`` tokens (the
+        whole prompt when shorter) — the unit the engine's prefix cache
+        is warmed at. A noted prefix of exactly ``prefix_bucket_len``
+        tokens produces the identical key the raw head would, so noting
+        the fleet's auto-registered buckets changes nothing."""
+        m = self.match_prefix(prompt)
+        if m is not None:
+            return m[0]
+        return self.head_key(prompt)
+
+    def head_key(self, prompt) -> int:
+        """The raw-head fallback of ``bucket_key`` — for callers that
+        already ran ``match_prefix`` themselves and know it missed
+        (``bucket_key`` would repeat the whole scan)."""
+        head = np.asarray(prompt, np.int32).reshape(-1)
+        return _hash64(head[:self.prefix_bucket_len].tobytes())
+
+    def affinity(self, prompt) -> Tuple[Optional[Tuple[int, int]], int]:
+        """One noted-prefix scan yielding BOTH routing inputs:
+        ``(match_prefix result, bucket key)``. The fleet's submit and
+        re-dispatch paths pass the key into ``route`` and the match
+        into their prefix plan, so each request pays the scan once."""
+        m = self.match_prefix(prompt)
+        return m, (m[0] if m is not None else self.head_key(prompt))
 
     def route(self, prompt, ready: Sequence[str],
               outstanding: Mapping[str, int],
-              exclude: Iterable[str] = ()) -> Optional[str]:
+              exclude: Iterable[str] = (),
+              key: Optional[int] = None) -> Optional[str]:
         """Pick a replica for ``prompt`` among ``ready`` (minus
         ``exclude``), or None when no candidate exists. ``outstanding``
-        maps replica → in-flight token cost (missing = 0)."""
+        maps replica → in-flight token cost (missing = 0). ``key`` is a
+        precomputed ``bucket_key(prompt)`` — callers that already ran
+        the noted-prefix scan pass it so routing doesn't repeat it."""
         banned = set(exclude)
         candidates = [r for r in ready if r not in banned]
         if not candidates:
@@ -140,7 +197,8 @@ class Router:
         if self.mode == "random":
             return pool[self._rng.randrange(len(pool))]
         least = min(pool, key=lambda r: (outstanding.get(r, 0), r))
-        aff = self._ring_lookup(self.bucket_key(prompt), pool)
+        aff = self._ring_lookup(
+            self.bucket_key(prompt) if key is None else key, pool)
         if aff is None:
             return least
         if (outstanding.get(aff, 0)
