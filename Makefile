@@ -7,8 +7,8 @@
 IMG ?= tpu-on-k8s/manager:latest
 
 .PHONY: test test-fast chaos-soak fleet-soak autoscale-soak disagg-soak \
-        native bench dryrun manager samples clean docker-build docker-push \
-        deploy undeploy
+        trace-demo native bench dryrun manager samples clean docker-build \
+        docker-push deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
 # CHAOS_SOAK_FAILED seed=... on any failure
@@ -16,6 +16,10 @@ CHAOS_SEED ?= 1234
 FLEET_SEED ?= 4321
 AUTOSCALE_SEED ?= 2468
 DISAGG_SEED ?= 8642
+TRACE_SEED ?= 8642
+TRACE_FLAGS = --disagg --n-requests 24 --prefix-bucket 8 --prompt-min 4 \
+    --prompt-max 12 --new-min 4 --new-max 8 --decode-replicas 2 \
+    --shared-prefixes 2 --shared-fraction 0.8 --seed $(TRACE_SEED)
 
 test:
 	python -m pytest tests/ -q
@@ -42,6 +46,16 @@ disagg-soak:  ## disagg fleet vs monolithic control, disagg arm twice: byte-iden
 	    --n-requests 24 --prefix-bucket 8 --prompt-min 4 --prompt-max 12 \
 	    --new-min 4 --new-max 8 --decode-replicas 2 \
 	    --shared-prefixes 2 --shared-fraction 0.8 --seed $(DISAGG_SEED)
+
+trace-demo:  ## seeded disagg trace dumped twice: byte-identical span dumps + the TTFT critical-path report
+	JAX_PLATFORMS=cpu python tools/serve_load.py $(TRACE_FLAGS) \
+	    --trace-out /tmp/tpu_on_k8s_trace_a.json > /dev/null
+	JAX_PLATFORMS=cpu python tools/serve_load.py $(TRACE_FLAGS) \
+	    --trace-out /tmp/tpu_on_k8s_trace_b.json > /dev/null
+	cmp /tmp/tpu_on_k8s_trace_a.json /tmp/tpu_on_k8s_trace_b.json \
+	    || (echo "TRACE_DEMO_FAILED seed=$(TRACE_SEED): dumps differ"; exit 1)
+	@echo "trace dumps byte-identical (seed=$(TRACE_SEED))"
+	python tools/trace_report.py /tmp/tpu_on_k8s_trace_a.json
 
 native:  ## build the C++ data pipeline explicitly (also built lazily on import)
 	g++ -O2 -std=c++17 -shared -fPIC \

@@ -117,6 +117,36 @@ class TestLifecycle:
             assert (mounts[constants.COMPILE_CACHE_VOLUME]
                     == constants.DEFAULT_COMPILE_CACHE_DIR)
 
+    def test_profiling_env_injected_only_when_configured(self):
+        """--profile-dir/--profiler-port reach slice pods as env (the
+        train loop's `utils/profiling.py` activation contract); the
+        default config injects neither — behavior-neutral."""
+        from tpu_on_k8s.controller.config import JobControllerConfig
+
+        cluster = InMemoryCluster()
+        manager = Manager()
+        setup_tpujob_controller(cluster, manager, config=JobControllerConfig(
+            profile_dir="/prof", profiler_port=9009))
+        sim = KubeletSim(cluster)
+        submit_job(cluster, job_spec())
+        manager.run_until_idle()
+        sim.run_pod("default", "j1-master-0")
+        manager.run_until_idle()
+        for pod in pods_of(cluster):
+            env = pod.spec.containers[0].env_map()
+            assert env[constants.ENV_PROFILE_DIR] == "/prof"
+            assert env[constants.ENV_PROFILER_PORT] == "9009"
+
+        cluster2, manager2, _, sim2 = make_env()   # default config
+        submit_job(cluster2, job_spec())
+        manager2.run_until_idle()
+        sim2.run_pod("default", "j1-master-0")
+        manager2.run_until_idle()
+        for pod in pods_of(cluster2):
+            env = pod.spec.containers[0].env_map()
+            assert constants.ENV_PROFILE_DIR not in env
+            assert constants.ENV_PROFILER_PORT not in env
+
     def test_user_perf_env_wins_over_injection(self):
         """Setdefault semantics: a cache dir / LIBTPU flags the user set in
         the pod template must survive the reconciler's injection."""

@@ -1,0 +1,674 @@
+"""End-to-end request tracing (`tpu_on_k8s/obs/`) + exposition fallback.
+
+Pins the ISSUE 7 contracts:
+* deterministic spans — counter-derived ids, injectable clock, two
+  identical call sequences produce byte-identical dumps;
+* NOOP neutrality — tracing disabled reads no clock, allocates nothing
+  per call, and every instrumented call site works unchanged;
+* the gateway span tree — request → queue → decode with the
+  ``first_token`` anchor, trace-id exemplars on TTFT/TPOT observations;
+* the flight recorder — bounded ring, deterministic dump filenames,
+  dumped on engine crash;
+* `tools/trace_report.py` — queue/prefill/handoff/decode segments that
+  sum to the measured TTFT exactly under a virtual clock;
+* `metrics.exposition` — never a RuntimeError without prometheus_client:
+  the pure-Python fallback renders a parseable, correctly escaped
+  text-format body for all five metrics classes;
+* the resilience.md chaos-site table stays complete against `SITE_*`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+import tpu_on_k8s.metrics.metrics as metrics_mod
+from tpu_on_k8s.autoscale.signals import (
+    FleetSample,
+    format_observation_line,
+    sample_from_line,
+)
+from tpu_on_k8s.metrics.metrics import (
+    AutoscaleMetrics,
+    FleetMetrics,
+    JobMetrics,
+    ServingMetrics,
+    TrainMetrics,
+    exposition,
+    render_text,
+)
+from tpu_on_k8s.obs import (
+    NOOP,
+    NOOP_SPAN,
+    TRACE_FORMAT,
+    FlightRecorder,
+    Tracer,
+    dump_chrome_trace,
+    ensure,
+    load_trace,
+    to_chrome_trace,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+        self.reads = 0
+
+    def __call__(self) -> float:
+        self.reads += 1
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# --------------------------------------------------------------------------
+# the span substrate
+# --------------------------------------------------------------------------
+class TestTracer:
+    def test_counter_ids_and_injected_clock(self):
+        clock = FakeClock()
+        tr = Tracer(clock)
+        root = tr.start("request", rid=0)
+        assert (root.trace_id, root.span_id, root.parent_id) == (1, 1, None)
+        clock.advance(1.0)
+        child = tr.start("queue", parent=root)
+        assert (child.trace_id, child.span_id, child.parent_id) == (1, 2, 1)
+        clock.advance(0.5)
+        child.finish()
+        assert child.duration == 0.5
+        root.finish()
+        assert root.duration == 1.5
+        # a second trace roots at the next counter value — no uuids,
+        # no wall clock anywhere
+        other = tr.start("request", rid=1)
+        assert (other.trace_id, other.span_id) == (3, 3)
+
+    def test_finish_is_idempotent_first_verdict_wins(self):
+        tr = Tracer(FakeClock())
+        sp = tr.start("x")
+        sp.finish("done")
+        sp.finish("error")
+        assert sp.status == "done"
+        assert len(tr.spans) == 1
+
+    def test_span_context_manager_records_error_status(self):
+        tr = Tracer(FakeClock())
+        with pytest.raises(ValueError):
+            with tr.span("tick"):
+                raise ValueError("boom")
+        assert tr.spans[0].status == "error"
+        with tr.span("tick") as sp:
+            sp.set(ok=True)
+        assert tr.spans[1].status == "ok"
+
+    def test_events_carry_clock_time_and_attrs(self):
+        clock = FakeClock()
+        tr = Tracer(clock)
+        sp = tr.start("request")
+        clock.advance(2.0)
+        sp.event("first_token", n=1)
+        sp.finish()
+        assert sp.events == [{"name": "first_token", "t": 2.0,
+                              "attrs": {"n": 1}}]
+
+    def test_attr_named_name_does_not_collide(self):
+        # reconcile spans attach the OBJECT's name as an attr — the
+        # span-name positional must be positional-only
+        tr = Tracer(FakeClock())
+        with tr.span("reconcile.inferenceservice", name="svc",
+                     namespace="default") as sp:
+            pass
+        assert sp.attrs == {"name": "svc", "namespace": "default"}
+        NOOP.start("x", name="svc")
+        with NOOP.span("x", name="svc"):
+            pass
+
+    def test_byte_identical_dumps_for_identical_sequences(self, tmp_path):
+        def drive(tr, clock):
+            for rid in range(3):
+                root = tr.start("request", rid=rid)
+                q = tr.start("queue", parent=root)
+                clock.advance(0.25)
+                q.finish()
+                d = tr.start("decode", parent=root)
+                clock.advance(1.0)
+                root.event("first_token")
+                d.finish()
+                root.finish("done")
+
+        paths = []
+        for name in ("a.json", "b.json"):
+            clock = FakeClock()
+            tr = Tracer(clock)
+            drive(tr, clock)
+            p = tmp_path / name
+            tr.dump(str(p))
+            paths.append(p)
+        assert paths[0].read_bytes() == paths[1].read_bytes()
+
+    def test_max_spans_bounds_retention_and_counts_drops(self):
+        tr = Tracer(FakeClock(), max_spans=2)
+        for i in range(5):
+            tr.start(f"s{i}").finish()
+        assert len(tr.spans) == 2
+        assert tr.dropped == 3
+        with pytest.raises(ValueError):
+            Tracer(FakeClock(), max_spans=0)
+
+    def test_export_sorts_by_trace_then_span(self):
+        clock = FakeClock()
+        tr = Tracer(clock)
+        a = tr.start("request")            # trace 1
+        b = tr.start("request")            # trace 2
+        b.finish()                         # finishes FIRST
+        a.finish()
+        ids = [(s["trace"], s["span"]) for s in tr.export()]
+        assert ids == [(1, 1), (2, 2)]
+
+    def test_noop_is_inert(self):
+        assert ensure(None) is NOOP
+        real = Tracer(FakeClock())
+        assert ensure(real) is real
+        assert NOOP.start("x", rid=1) is NOOP_SPAN
+        assert NOOP_SPAN.set(a=1) is NOOP_SPAN
+        assert NOOP_SPAN.event("e") is NOOP_SPAN
+        assert NOOP_SPAN.finish("error") is NOOP_SPAN
+        assert NOOP_SPAN.to_dict() == {}
+        assert NOOP.export() == []
+        assert NOOP.crash_dump("anything") is None
+        with pytest.raises(RuntimeError):
+            NOOP.dump("/tmp/never-written.json")
+
+
+# --------------------------------------------------------------------------
+# exporters + flight recorder
+# --------------------------------------------------------------------------
+class TestExport:
+    def _traced(self):
+        clock = FakeClock()
+        tr = Tracer(clock)
+        root = tr.start("request", rid=0)
+        clock.advance(0.5)
+        root.event("first_token")
+        clock.advance(0.5)
+        root.finish("done")
+        return tr
+
+    def test_dump_and_load_round_trip(self, tmp_path):
+        tr = self._traced()
+        p = tmp_path / "t.json"
+        tr.dump(str(p))
+        spans = load_trace(str(p))
+        assert spans == tr.export()
+        doc = json.loads(p.read_text())
+        assert doc["format"] == TRACE_FORMAT
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"not": "a trace"}')
+        with pytest.raises(ValueError):
+            load_trace(str(bad))
+
+    def test_chrome_trace_shape(self, tmp_path):
+        tr = self._traced()
+        doc = to_chrome_trace(tr.spans)
+        kinds = [e["ph"] for e in doc["traceEvents"]]
+        assert kinds == ["X", "i"]          # one span + its event
+        x, i = doc["traceEvents"]
+        assert x["ts"] == 0.0 and x["dur"] == 1.0 * 1e6
+        assert i["ts"] == 0.5 * 1e6
+        assert x["tid"] == i["tid"] == 1    # one request = one track
+        p = tmp_path / "chrome.json"
+        dump_chrome_trace(tr.spans, str(p))
+        assert json.loads(p.read_text())["traceEvents"]
+
+    def test_flight_recorder_ring_is_bounded(self):
+        rec = FlightRecorder(capacity=3)
+        tr = Tracer(FakeClock(), recorder=rec)
+        for i in range(10):
+            tr.start(f"s{i}").finish()
+        names = [s["name"] for s in rec.snapshot()]
+        assert names == ["s7", "s8", "s9"]
+        with pytest.raises(ValueError):
+            FlightRecorder(capacity=0)
+
+    def test_crash_dump_writes_sequenced_sanitized_files(self, tmp_path):
+        rec = FlightRecorder(capacity=8, directory=str(tmp_path))
+        tr = Tracer(FakeClock(), recorder=rec)
+        tr.start("decode").finish()
+        p1 = tr.crash_dump("engine_crash")
+        p2 = tr.crash_dump("retry exhausted!")
+        assert os.path.basename(p1) == "flightrec-0001-engine_crash.json"
+        assert os.path.basename(p2) == "flightrec-0002-retry-exhausted-.json"
+        doc = json.loads(open(p1).read())
+        assert doc["reason"] == "engine_crash"
+        assert [s["name"] for s in doc["spans"]] == ["decode"]
+
+    def test_recorder_without_directory_rings_but_does_not_dump(self):
+        rec = FlightRecorder(capacity=4)
+        tr = Tracer(FakeClock(), recorder=rec)
+        tr.start("x").finish()
+        assert tr.crash_dump("crash") is None
+        assert len(rec.snapshot()) == 1
+
+
+# --------------------------------------------------------------------------
+# trace_report: the TTFT critical path
+# --------------------------------------------------------------------------
+class TestTraceReport:
+    def _disagg_trace(self, tr, clock, rid, *, queue=1.0, prefill=2.0,
+                      handoff=0.5, decode=0.25):
+        """Synthesize the disagg span shape with known segment widths."""
+        root = tr.start("request", rid=rid)
+        q = tr.start("queue", parent=root, attempt=0)
+        clock.advance(queue)
+        q.finish()
+        p = tr.start("prefill", parent=root, attempt=0)
+        clock.advance(prefill)
+        root.event("first_token")
+        p.finish()
+        h = tr.start("handoff", parent=root, attempt=0)
+        clock.advance(handoff)
+        h.finish()
+        d = tr.start("decode", parent=root, attempt=0)
+        clock.advance(decode)
+        d.event("first_decode_token")
+        clock.advance(3.0)                 # post-anchor decode tail
+        d.finish()
+        root.finish("done")
+
+    def test_segments_sum_to_ttft_exactly(self):
+        from tools.trace_report import build_report, decompose
+
+        clock = FakeClock()
+        tr = Tracer(clock)
+        self._disagg_trace(tr, clock, 0)
+        rec = decompose(tr.export())
+        assert rec["segments"] == {"queue": 1.0, "prefill": 2.0,
+                                   "handoff": 0.5, "decode": 0.25}
+        assert rec["ttft"] == pytest.approx(3.75)
+        assert rec["residual"] == pytest.approx(0.0)
+        # the client-visible streaming TTFT (prefill's first token) is
+        # reported alongside the decoded-token anchor
+        assert rec["first_token"] == pytest.approx(3.0)
+        report = build_report(tr.export())
+        assert report["decomposed"] == 1
+        assert report["residual_ms_max"] == 0.0
+        assert report["segments"]["prefill"]["share"] == pytest.approx(
+            2.0 / 3.75, abs=1e-4)
+
+    def test_monolithic_shape_decomposes_queue_plus_decode(self):
+        from tools.trace_report import decompose
+
+        clock = FakeClock()
+        tr = Tracer(clock)
+        root = tr.start("request", rid=0)
+        q = tr.start("queue", parent=root, attempt=0)
+        clock.advance(0.75)
+        q.finish()
+        d = tr.start("decode", parent=root, attempt=0)
+        clock.advance(0.25)
+        root.event("first_token")
+        clock.advance(1.0)
+        d.finish()
+        root.finish("done")
+        rec = decompose(tr.export())
+        assert rec["segments"] == {"queue": 0.75, "prefill": 0.0,
+                                   "handoff": 0.0, "decode": 0.25}
+        assert rec["ttft"] == pytest.approx(1.0)
+
+    def test_tokenless_requests_are_counted_not_decomposed(self):
+        from tools.trace_report import build_report
+
+        clock = FakeClock()
+        tr = Tracer(clock)
+        self._disagg_trace(tr, clock, 0)
+        root = tr.start("request", rid=1)   # rejected: no token ever
+        root.finish("rejected")
+        report = build_report(tr.export())
+        assert report["requests"] == 2
+        assert report["decomposed"] == 1
+        assert report["no_token"] == 1
+
+    def test_replay_attempts_attribute_their_wall_time(self):
+        from tools.trace_report import decompose
+
+        clock = FakeClock()
+        tr = Tracer(clock)
+        root = tr.start("request", rid=0)
+        q0 = tr.start("queue", parent=root, attempt=0)
+        clock.advance(1.0)
+        q0.finish()
+        d0 = tr.start("decode", parent=root, attempt=0)
+        clock.advance(0.5)
+        root.event("engine_crash")
+        d0.finish("error")                  # crash before any token
+        q1 = tr.start("queue", parent=root, attempt=1)
+        clock.advance(1.0)
+        q1.finish()
+        d1 = tr.start("decode", parent=root, attempt=1)
+        clock.advance(0.5)
+        root.event("first_token")
+        d1.finish()
+        root.finish("done")
+        rec = decompose(tr.export())
+        assert rec["replays"] == 1
+        assert rec["segments"]["queue"] == pytest.approx(2.0)
+        assert rec["segments"]["decode"] == pytest.approx(1.0)
+        assert rec["ttft"] == pytest.approx(3.0)
+        assert rec["residual"] == pytest.approx(0.0)
+
+
+# --------------------------------------------------------------------------
+# gateway integration: the span tree a real request leaves behind
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+
+    cfg = dataclasses.replace(TransformerConfig.tiny(), dtype=jnp.float32,
+                              max_seq_len=64)
+    tok = jax.random.randint(jax.random.key(0), (1, 8), 0, cfg.vocab_size,
+                             jnp.int32)
+    params = Transformer(cfg).init(jax.random.key(1), tok)["params"]
+    return cfg, params
+
+
+class TestGatewaySpans:
+    def _gateway(self, tiny, tracer, metrics=None, clock=None):
+        from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+        from tpu_on_k8s.serve import AdmissionConfig, ServingGateway
+
+        cfg, params = tiny
+        eng = ContinuousBatchingEngine(cfg, params, n_slots=2)
+        kw = {"clock": clock} if clock is not None else {}
+        return ServingGateway(eng, AdmissionConfig(max_queue_depth=4),
+                              metrics=metrics, tracer=tracer, **kw)
+
+    def test_request_span_tree_and_ttft_exemplars(self, tiny):
+        cfg, _ = tiny
+        clock = FakeClock()
+        tracer = Tracer(clock)
+        metrics = ServingMetrics()
+        gw = self._gateway(tiny, tracer, metrics=metrics, clock=clock)
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, cfg.vocab_size, size=6).astype(np.int32)
+                   for _ in range(2)]
+        rids = [gw.submit(p, 4) for p in prompts]
+        assert all(isinstance(r, int) for r in rids)
+        gw.run()
+        spans = tracer.export()
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        assert len(by_name["request"]) == 2
+        assert len(by_name["queue"]) == 2
+        assert len(by_name["decode"]) == 2
+        for root in by_name["request"]:
+            assert root["status"] == "done"
+            assert root["parent"] is None
+            kids = [s for s in spans if s.get("parent") == root["span"]]
+            assert sorted(s["name"] for s in kids) == ["decode", "queue"]
+            assert any(ev["name"] == "first_token"
+                       for ev in root.get("events", ()))
+        # TTFT/TPOT observations carry the request's trace id — the join
+        # key from a histogram sample back to its span tree
+        traces = {r["trace"] for r in by_name["request"]}
+        ttft_ex = list(metrics.exemplars["time_to_first_token_seconds"])
+        assert {t for _, t in ttft_ex} == traces
+
+    def test_rejected_requests_mint_no_spans(self, tiny):
+        from tpu_on_k8s.serve import Rejected
+
+        cfg, _ = tiny
+        tracer = Tracer(FakeClock())
+        gw = self._gateway(tiny, tracer)
+        rng = np.random.default_rng(3)
+        results = [gw.submit(rng.integers(0, cfg.vocab_size,
+                                          size=6).astype(np.int32), 4)
+                   for _ in range(12)]
+        rejected = [r for r in results if isinstance(r, Rejected)]
+        assert rejected                     # queue bound 4 + 2 slots < 12
+        gw.run()
+        roots = [s for s in tracer.export() if s["name"] == "request"]
+        assert len(roots) == len(results) - len(rejected)
+
+    def test_disabled_tracer_reads_no_clock(self, tiny):
+        cfg, _ = tiny
+        gw_clock = FakeClock()
+        gw = self._gateway(tiny, None, clock=gw_clock)
+        assert gw._tracer is NOOP
+        rng = np.random.default_rng(5)
+        gw.submit(rng.integers(0, cfg.vocab_size, size=6).astype(np.int32),
+                  3)
+        gw.run()
+        # the gateway read its own clock, the NOOP tracer read nothing
+        # (its clock is a constant) — nothing allocated, nothing recorded
+        assert NOOP.export() == []
+
+
+# --------------------------------------------------------------------------
+# exposition: prometheus parity + the pure-Python fallback
+# --------------------------------------------------------------------------
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<label>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"\})?'
+    r' (?P<sample>[0-9eE+.\-]+|NaN|nan)$')
+
+
+def _parse_body(body: str):
+    """Minimal text-format parser: every non-comment line must be a valid
+    sample; returns {sample_name: [(label_value_or_None, float)]}."""
+    out = {}
+    for line in body.splitlines():
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m is not None, f"unparseable sample line: {line!r}"
+        out.setdefault(m["name"], []).append(
+            (m["value"], float(m["sample"])))
+    return out
+
+
+def _populate(m):
+    """Exercise every metrics class through its public surface."""
+    if isinstance(m, JobMetrics):
+        m.created()
+        m.first_pod_launch_delay(3.0)
+        m.set_gauge("running", 2.0)
+    elif isinstance(m, ServingMetrics):
+        m.inc("requests_submitted", 4)
+        m.observe("time_to_first_token_seconds", 0.02, exemplar=9)
+        m.set_gauge("queue_depth", 1.0)
+    elif isinstance(m, TrainMetrics):
+        m.inc("host_syncs")
+        m.set_gauge("mfu", 0.42)
+    elif isinstance(m, FleetMetrics):
+        m.inc("requests_routed", replica="replica-0")
+        m.inc("handoffs_adopted", 2)
+        m.set_gauge("pool_slots", 8.0, pool="decode")
+        m.observe("handoff_wait_seconds", 0.004)
+    elif isinstance(m, AutoscaleMetrics):
+        m.decision("scale_up")
+        m.set_gauge("desired_replicas", 3.0, label="default/svc")
+
+
+_ALL_CLASSES = (JobMetrics, ServingMetrics, TrainMetrics, FleetMetrics,
+                AutoscaleMetrics)
+
+
+class TestExposition:
+    @pytest.mark.parametrize("cls", _ALL_CLASSES)
+    def test_scrape_body_parses_with_prometheus_backend(self, cls):
+        if metrics_mod._prom is None:
+            pytest.skip("prometheus_client not installed")
+        m = cls()
+        _populate(m)
+        samples = _parse_body(exposition(m))
+        assert samples, f"{cls.__name__}: empty scrape body"
+
+    @pytest.mark.parametrize("cls", _ALL_CLASSES)
+    def test_fallback_renders_conformant_body(self, cls, monkeypatch):
+        monkeypatch.setattr(metrics_mod, "_prom", None)
+        m = cls()
+        assert m.registry is None
+        _populate(m)
+        body = exposition(m)                # must NOT raise
+        samples = _parse_body(body)
+        assert samples
+        # every declared family appears with HELP + TYPE
+        for fam in m._families.values():
+            fname = (fam.full + "_total"
+                     if fam.kind == "counter"
+                     and not fam.full.endswith("_total") else fam.full)
+            assert f"# TYPE {fname} {fam.kind}" in body
+
+    def test_fallback_and_prometheus_agree_on_families(self, monkeypatch):
+        if metrics_mod._prom is None:
+            pytest.skip("prometheus_client not installed")
+        with_prom = ServingMetrics()
+        _populate(with_prom)
+        prom_names = set(_parse_body(exposition(with_prom)))
+        monkeypatch.setattr(metrics_mod, "_prom", None)
+        plain = ServingMetrics()
+        _populate(plain)
+        plain_names = set(_parse_body(exposition(plain)))
+        # prometheus adds _created noise gauges; everything the fallback
+        # exports must exist under prometheus with identical names
+        assert plain_names <= prom_names
+
+    def test_fallback_histogram_buckets_count_and_sum(self, monkeypatch):
+        monkeypatch.setattr(metrics_mod, "_prom", None)
+        m = ServingMetrics()
+        m.observe("queue_wait_seconds", 0.004)
+        m.observe("queue_wait_seconds", 0.3)
+        m.observe("queue_wait_seconds", 99.0)   # past the last bound
+        samples = _parse_body(exposition(m))
+        full = "tpu_on_k8s_serving_queue_wait_seconds"
+        buckets = dict(samples[f"{full}_bucket"])
+        assert buckets["0.001"] == 0.0
+        assert buckets["0.005"] == 1.0
+        assert buckets["0.5"] == 2.0
+        assert buckets["30.0"] == 2.0
+        assert buckets["+Inf"] == 3.0
+        assert samples[f"{full}_count"] == [(None, 3.0)]
+        assert samples[f"{full}_sum"][0][1] == pytest.approx(99.304)
+
+    def test_fallback_escapes_label_values(self, monkeypatch):
+        monkeypatch.setattr(metrics_mod, "_prom", None)
+        m = FleetMetrics()
+        hostile = 'rep"0\\x\ny'
+        m.inc("requests_routed", replica=hostile)
+        body = exposition(m)
+        line = next(l for l in body.splitlines()
+                    if l.startswith("tpu_on_k8s_fleet_requests_routed_total{"))
+        assert '\\"' in line and "\\\\" in line and "\\n" in line
+        assert "\n" not in line             # the literal newline is gone
+        # the escaped value round-trips through the parser
+        (value, n), = _parse_body(body)[
+            "tpu_on_k8s_fleet_requests_routed_total"]
+        unescaped = (value.replace("\\n", "\n").replace('\\"', '"')
+                     .replace("\\\\", "\\"))
+        assert unescaped == hostile and n == 1.0
+
+    def test_render_text_is_deterministic(self, monkeypatch):
+        monkeypatch.setattr(metrics_mod, "_prom", None)
+        a, b = ServingMetrics(), ServingMetrics()
+        for m in (a, b):
+            _populate(m)
+        assert render_text(a) == render_text(b)
+
+    def test_observation_line_round_trip(self):
+        sample = FleetSample(seq=0, ttft=(0.1, 0.4), queue_wait=(0.02,),
+                             tpot=(0.008, 0.009), queue_depth=5,
+                             inflight_tokens=37, slots=8,
+                             ready_replicas=2)
+        line = format_observation_line(sample, epoch=1, batch=17)
+        back = sample_from_line(line, seq=3)
+        assert back is not None and back.ok
+        assert back.seq == 3
+        # the emitter folds each window to its p95; the parse re-enters
+        # it as one observation per series
+        assert back.ttft == (0.4,)
+        assert back.queue_wait == (0.02,)
+        assert back.tpot == (0.009,)
+        assert (back.queue_depth, back.inflight_tokens, back.slots,
+                back.ready_replicas) == (5, 37, 8, 2)
+
+    def test_observation_line_no_data_sentinel_round_trip(self):
+        line = format_observation_line(FleetSample(seq=0), epoch=1, batch=0)
+        assert "latency=nan" in line
+        back = sample_from_line(line, seq=1)
+        assert back is not None
+        assert back.ttft == () and back.queue_wait == () and back.tpot == ()
+
+
+# --------------------------------------------------------------------------
+# docs stay honest
+# --------------------------------------------------------------------------
+def test_every_chaos_site_in_resilience_site_table():
+    from tpu_on_k8s.chaos import faults
+
+    doc = open(os.path.join(os.path.dirname(__file__), "..", "docs",
+                            "resilience.md")).read()
+    sites = {v for k, v in vars(faults).items()
+             if k.startswith("SITE_") and isinstance(v, str)}
+    assert sites, "no SITE_* constants found"
+    missing = {s for s in sites if f"`{s}`" not in doc}
+    assert not missing, (
+        f"chaos sites missing from docs/resilience.md site table: "
+        f"{sorted(missing)}")
+
+
+def test_observability_doc_exists_and_covers_span_taxonomy():
+    doc = open(os.path.join(os.path.dirname(__file__), "..", "docs",
+                            "observability.md")).read()
+    for needle in ("trace_report", "first_token", "queue", "prefill",
+                   "handoff", "decode", "FlightRecorder", "--trace-out",
+                   "--profile-dir", "exposition"):
+        assert needle in doc, f"docs/observability.md missing {needle!r}"
+
+
+# --------------------------------------------------------------------------
+# acceptance: the seeded disagg run end-to-end (ISSUE 7)
+# --------------------------------------------------------------------------
+class TestServeLoadTraceAcceptance:
+    def test_disagg_trace_out_byte_identical_and_fully_decomposed(
+            self, tmp_path, capsys):
+        """Two seeded ``serve_load --disagg --trace-out`` runs produce
+        byte-identical dumps; trace_report decomposes every request that
+        produced a token into segments summing to its TTFT exactly
+        (virtual clock ⇒ zero residual)."""
+        from tools import serve_load
+        from tools.trace_report import build_report
+
+        flags = ["--disagg", "--n-requests", "12", "--prefix-bucket", "8",
+                 "--prompt-min", "4", "--prompt-max", "12",
+                 "--new-min", "4", "--new-max", "8",
+                 "--decode-replicas", "2", "--shared-prefixes", "2",
+                 "--shared-fraction", "0.8"]
+        p1, p2 = tmp_path / "a.json", tmp_path / "b.json"
+        summary = serve_load.main(flags + ["--trace-out", str(p1)])
+        serve_load.main(flags + ["--trace-out", str(p2)])
+        capsys.readouterr()
+        assert p1.read_bytes() == p2.read_bytes()
+
+        from tpu_on_k8s.obs import load_trace
+        report = build_report(load_trace(str(p1)))
+        assert report["requests"] == 12
+        assert report["decomposed"] + report["no_token"] == 12
+        assert report["residual_ms_max"] == 0.0
+        cp = summary["ttft_critical_path"]
+        assert cp["ttft_ms_p95"] == report["ttft_ms_p95"]
+        assert cp["residual_ms_max"] == 0.0
+        # control-plane + request spans share the dump's one timeline
+        assert set(report["span_names"]) >= {"request", "queue",
+                                             "prefill", "handoff",
+                                             "decode"}

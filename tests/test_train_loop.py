@@ -379,3 +379,133 @@ class TestTrainerFit:
         assert result.steps == 3 and result.host_syncs == 1
         assert np.isfinite(result.last_metrics["loss"])
         assert 0.0 <= result.last_metrics["accuracy"] <= 1.0
+
+
+class TestProfilingHooksAndWindowSpans:
+    """ISSUE 7 satellite: the previously-dead `utils/profiling.py` hooks
+    activated by the loop (env-driven, operator-flag-fed) + the
+    ``train.window`` spans that put training on the one trace timeline."""
+
+    def _clear_env(self, monkeypatch):
+        from tpu_on_k8s.api import constants
+        monkeypatch.delenv(constants.ENV_PROFILE_DIR, raising=False)
+        monkeypatch.delenv(constants.ENV_PROFILER_PORT, raising=False)
+
+    def test_env_flags_feed_profiling_config(self, monkeypatch):
+        from tpu_on_k8s.api import constants
+        self._clear_env(monkeypatch)
+        monkeypatch.setenv(constants.ENV_PROFILE_DIR, "/tmp/prof")
+        monkeypatch.setenv(constants.ENV_PROFILER_PORT, "9999")
+        loop = TrainLoop(_toy_step, _toy_state(), _batches())
+        assert loop.profile_dir == "/tmp/prof"
+        assert loop.profiler_port == 9999
+        assert loop.annotate_steps is True      # rides along with capture
+
+    def test_unset_env_is_behavior_neutral(self, monkeypatch):
+        self._clear_env(monkeypatch)
+        loop = TrainLoop(_toy_step, _toy_state(), _batches())
+        assert loop.profile_dir is None
+        assert loop.profiler_port is None
+        assert loop.annotate_steps is False
+
+    def test_profiling_session_activates_both_hooks(self, monkeypatch,
+                                                    tmp_path):
+        import contextlib
+        self._clear_env(monkeypatch)
+        calls = {"annotations": 0}
+
+        @contextlib.contextmanager
+        def fake_trace(d):
+            calls["dir"] = d
+            yield
+
+        @contextlib.contextmanager
+        def fake_annotate(name):
+            assert name == "train.step"
+            calls["annotations"] += 1
+            yield
+
+        monkeypatch.setattr(loop_mod.profiling, "start_server",
+                            lambda port: calls.setdefault("port", port))
+        monkeypatch.setattr(loop_mod.profiling, "trace", fake_trace)
+        monkeypatch.setattr(loop_mod.profiling, "annotate", fake_annotate)
+        loop = TrainLoop(_toy_step, _toy_state(), _batches(), log_every=2,
+                         profile_dir=str(tmp_path), profiler_port=8791)
+        result = loop.run(4)
+        assert result.steps == 4
+        assert calls["port"] == 8791
+        assert calls["dir"] == str(tmp_path)
+        assert calls["annotations"] == 4        # one region per dispatch
+
+    def test_profiling_failure_degrades_to_warning(self, monkeypatch):
+        self._clear_env(monkeypatch)
+
+        def boom(*a, **k):
+            raise OSError("port in use")
+
+        monkeypatch.setattr(loop_mod.profiling, "start_server", boom)
+        monkeypatch.setattr(loop_mod.profiling, "trace", boom)
+        loop = TrainLoop(_toy_step, _toy_state(), _batches(), log_every=2,
+                         profile_dir="/nope", profiler_port=1)
+        with pytest.warns(UserWarning):
+            result = loop.run(4)                # training survives
+        assert result.steps == 4
+
+    def test_window_spans_one_per_host_sync(self, monkeypatch):
+        from tpu_on_k8s.obs import Tracer
+        self._clear_env(monkeypatch)
+        tracer = Tracer(time.monotonic)
+        result = TrainLoop(_toy_step, _toy_state(), _batches(), log_every=2,
+                           tracer=tracer).run(5)
+        windows = [s for s in tracer.export() if s["name"] == "train.window"]
+        assert len(windows) == result.host_syncs == 3
+        assert [w["attrs"]["start_step"] for w in windows] == [1, 3, 5]
+        assert [w["attrs"]["step"] for w in windows] == [2, 4, 5]
+        assert all(w["status"] == "ok" for w in windows)
+        assert all(isinstance(w["attrs"].get("loss"), float)
+                   for w in windows)
+
+    def test_aborted_run_closes_open_window_span(self, monkeypatch):
+        from tpu_on_k8s.obs import Tracer
+        self._clear_env(monkeypatch)
+        tracer = Tracer(time.monotonic)
+        calls = {"n": 0}
+
+        def failing_step(state, batch):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("device fell over")
+            return _toy_step(state, batch)
+
+        loop = TrainLoop(failing_step, _toy_state(), _batches(),
+                         log_every=10, tracer=tracer)
+        with pytest.raises(RuntimeError):
+            loop.run(5)
+        windows = [s for s in tracer.export() if s["name"] == "train.window"]
+        assert len(windows) == 1
+        assert windows[0]["status"] == "aborted"
+
+    def test_no_tracer_is_neutral(self, monkeypatch):
+        self._clear_env(monkeypatch)
+        from tpu_on_k8s.obs import NOOP
+        loop = TrainLoop(_toy_step, _toy_state(), _batches(), log_every=2)
+        assert loop._tracer is NOOP
+        assert loop.run(4).steps == 4
+
+    def test_profiling_teardown_failure_degrades_to_warning(
+            self, monkeypatch):
+        import contextlib
+        self._clear_env(monkeypatch)
+
+        @contextlib.contextmanager
+        def trace_fails_at_stop(d):
+            yield
+            raise OSError("disk full at trace stop")
+
+        monkeypatch.setattr(loop_mod.profiling, "trace",
+                            trace_fails_at_stop)
+        loop = TrainLoop(_toy_step, _toy_state(), _batches(), log_every=2,
+                         profile_dir="/full")
+        with pytest.warns(UserWarning, match="finalize"):
+            result = loop.run(4)        # the trace writes at STOP —
+        assert result.steps == 4        # a full disk must not eat the run

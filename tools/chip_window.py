@@ -442,6 +442,25 @@ def stage_serve_disagg(timeout):
                        "serve_disagg", timeout)
 
 
+def stage_serve_trace(timeout):
+    """End-to-end request tracing on hardware: the seeded disagg trace
+    re-run with ``--trace-out``, so the recorded summary carries the
+    per-request TTFT critical-path segment breakdown
+    (queue/prefill/handoff/decode p50/p95 + share of TTFT mass, computed
+    by tools/trace_report.py from the span dump) — the attribution that
+    says WHERE a TTFT regression between windows lives."""
+    return _json_stage([sys.executable, "tools/serve_load.py", "--bench",
+                        "--disagg", "--n-slots", "4",
+                        "--prefill-replicas", "1", "--decode-replicas",
+                        "2", "--n-requests", "48", "--rate", "1.5",
+                        "--burst-rate", "6.0", "--prefix-bucket", "128",
+                        "--shared-prefixes", "2",
+                        "--shared-fraction", "0.8",
+                        "--prompt-min", "8", "--prompt-max", "64",
+                        "--trace-out", "/tmp/chip_serve_trace.json"],
+                       "serve_trace", timeout)
+
+
 def stage_serve_fleet(timeout):
     """The fleet headline (round-5 '#2 missed' decode/serving gap):
     router + 2 replicas on the same seeded trace — aggregate tok/s plus
@@ -471,6 +490,7 @@ STAGES = [
     ("serve_fleet", stage_serve_fleet, 1200, ()),
     ("serve_autoscale", stage_serve_autoscale, 1200, ()),
     ("serve_disagg", stage_serve_disagg, 1200, ()),
+    ("serve_trace", stage_serve_trace, 1200, ()),
 ]
 
 

@@ -110,6 +110,41 @@ def build_workload(rng: np.random.Generator, n_requests: int, *,
     return arrivals
 
 
+def _make_tracer(args, clock):
+    """Span substrate for ``--trace-out`` (`tpu_on_k8s/obs/trace.py`):
+    counter-derived ids + THIS driver's clock, so virtual-clock modes
+    produce byte-identical dumps across seeded replays (the property
+    ``make trace-demo`` asserts). None (tracing off) keeps every mode
+    bit-for-bit on its pre-tracing behavior."""
+    if not args.trace_out:
+        return None
+    from tpu_on_k8s.obs import Tracer
+    return Tracer(clock)
+
+
+def _dump_trace(tracer, args, summary) -> None:
+    """Write the canonical dump and fold the TTFT critical-path segment
+    breakdown (`tools/trace_report.py`) into the summary — the shape the
+    chip window's ``serve_trace`` stage records."""
+    if tracer is None:
+        return
+    from tools.trace_report import SEGMENTS, build_report
+    tracer.dump(args.trace_out)
+    report = build_report(tracer.export(), top=1)
+    summary["trace_out"] = args.trace_out
+    summary["trace_spans"] = report["spans"]
+    summary["ttft_critical_path"] = {
+        "decomposed": report["decomposed"],
+        "no_token": report["no_token"],
+        "ttft_ms_p50": report["ttft_ms_p50"],
+        "ttft_ms_p95": report["ttft_ms_p95"],
+        "residual_ms_max": report["residual_ms_max"],
+        "segments": {n: {k: report["segments"][n][k]
+                         for k in ("p50_ms", "p95_ms", "share")}
+                     for n in SEGMENTS},
+    }
+
+
 def _pctl(values, q: float) -> Optional[float]:
     """Empirical percentile (nearest-rank) in milliseconds."""
     vals = sorted(values)
@@ -271,12 +306,13 @@ def _fleet_main(args, cfg, params, max_len) -> dict:
                                         max_len=max_len,
                                         step_horizon=args.horizon)
 
+    tracer = _make_tracer(args, time.monotonic)
     fleet = ServingFleet(
         factory, args.replicas,
         admission=AdmissionConfig(max_queue_depth=args.queue_bound),
         probe=ProbeConfig(slow_start_steps=1),
         router=Router(prefix_bucket_len=args.prefix_bucket),
-        clock=time.monotonic)
+        clock=time.monotonic, tracer=tracer)
     rng = np.random.default_rng(args.seed)
     arrivals = build_workload(
         rng, args.n_requests, rate=args.rate,
@@ -306,6 +342,8 @@ def _fleet_main(args, cfg, params, max_len) -> dict:
             rep.metrics.histograms.clear()
     for _ in range(3):
         fleet.step()
+    if tracer is not None:
+        tracer.spans.clear()     # warmup is not the measured trace
 
     inj = None
     if args.crash_replica >= 0:
@@ -322,6 +360,7 @@ def _fleet_main(args, cfg, params, max_len) -> dict:
     finally:
         if inj is not None:
             chaos.uninstall(inj)
+    _dump_trace(tracer, args, summary)
     if args.soak:
         accounted = (summary["served"] + summary["rejected"]
                      + summary["deadline_exceeded"] + summary["cancelled"]
@@ -357,7 +396,8 @@ class _VirtualClock:
 
 
 def run_autoscale_trace(args, cfg, params, max_len, *,
-                        enabled: bool = True) -> dict:
+                        enabled: bool = True,
+                        trace: bool = False) -> dict:
     """One seeded bursty trace through ServingFleet + FleetAutoscaler:
     the closed loop scrapes the fleet, patches the InferenceService's
     ``spec.replicas``, and applies the target back to the fleet. Returns
@@ -386,6 +426,9 @@ def run_autoscale_trace(args, cfg, params, max_len, *,
     )
 
     vclock = _VirtualClock()
+    # one tracer for fleet AND autoscaler: request spans and
+    # autoscale.tick spans interleave on one virtual-clock timeline
+    tracer = _make_tracer(args, vclock) if trace else None
 
     def factory(name):
         return ContinuousBatchingEngine(cfg, params, n_slots=args.n_slots,
@@ -397,7 +440,7 @@ def run_autoscale_trace(args, cfg, params, max_len, *,
         admission=AdmissionConfig(max_queue_depth=args.queue_bound),
         probe=ProbeConfig(slow_start_steps=1),
         router=Router(prefix_bucket_len=args.prefix_bucket),
-        clock=vclock)
+        clock=vclock, tracer=tracer)
 
     cluster = InMemoryCluster()
     cluster.create(InferenceService(
@@ -419,7 +462,7 @@ def run_autoscale_trace(args, cfg, params, max_len, *,
         cluster,
         config=JobControllerConfig(autoscale_window_scrapes=3,
                                    autoscale_stale_scrapes=3),
-        metrics=AutoscaleMetrics(), clock=vclock)
+        metrics=AutoscaleMetrics(), clock=vclock, tracer=tracer)
     autoscaler.attach_fleet("default", "load", fleet)
 
     rng = np.random.default_rng(args.seed)
@@ -520,6 +563,7 @@ def run_autoscale_trace(args, cfg, params, max_len, *,
         "ttft_ms_p95_post_scale": _pctl(post, 0.95),
         "decisions": list(autoscaler.decision_log),
     }
+    _dump_trace(tracer, args, summary)
     return summary
 
 
@@ -533,7 +577,7 @@ def _autoscale_main(args, cfg, params, max_len) -> dict:
     ``AUTOSCALE_SOAK_FAILED seed=N`` on violation."""
     baseline = run_autoscale_trace(args, cfg, params, max_len,
                                    enabled=False)
-    summary = run_autoscale_trace(args, cfg, params, max_len)
+    summary = run_autoscale_trace(args, cfg, params, max_len, trace=True)
     summary["ttft_ms_p95_static_baseline"] = baseline["ttft_ms_p95"]
     summary["ttft_ms_p50_static_baseline"] = baseline["ttft_ms_p50"]
     summary["baseline_driver_steps"] = baseline["driver_steps"]
@@ -571,7 +615,7 @@ _DISAGG_PREFILL_COST = 0.05
 
 
 def run_disagg_trace(args, cfg, params, max_len, *,
-                     disagg: bool = True) -> dict:
+                     disagg: bool = True, trace: bool = False) -> dict:
     """One seeded shared-prefix bursty trace through a ``DisaggFleet``
     (or, with ``disagg=False``, the monolithic ``ServingFleet`` control
     arm with the same engine count) on a virtual clock. Returns outcome
@@ -588,6 +632,7 @@ def run_disagg_trace(args, cfg, params, max_len, *,
     )
 
     vclock = _VirtualClock()
+    tracer = _make_tracer(args, vclock) if trace else None
 
     def factory(name):
         return ContinuousBatchingEngine(cfg, params, n_slots=args.n_slots,
@@ -600,7 +645,8 @@ def run_disagg_trace(args, cfg, params, max_len, *,
             decode_replicas=args.decode_replicas,
             prefix_bucket_len=args.prefix_bucket,
             handoff_capacity=args.handoff_capacity,
-            max_queue_depth=args.queue_bound, clock=vclock)
+            max_queue_depth=args.queue_bound, clock=vclock,
+            tracer=tracer)
         decode_names = {n for n, r in fleet.replicas.items()
                         if r.pool == "decode"}
     else:
@@ -719,6 +765,7 @@ def run_disagg_trace(args, cfg, params, max_len, *,
         "prefix_prefill_recompute": recompute,
         "per_pool": breakdown,
     }
+    _dump_trace(tracer, args, summary)
     if disagg:
         summary.update(
             handoffs_enqueued=fleet.stats["handoffs_enqueued"],
@@ -741,7 +788,7 @@ def _disagg_main(args, cfg, params, max_len) -> dict:
     must win both headline comparisons — ``DISAGG_SOAK_FAILED seed=N``
     on any violation so a red run replays verbatim."""
     control = run_disagg_trace(args, cfg, params, max_len, disagg=False)
-    summary = run_disagg_trace(args, cfg, params, max_len)
+    summary = run_disagg_trace(args, cfg, params, max_len, trace=True)
     event_log = summary.pop("event_log")
     summary["control"] = {
         k: control[k] for k in ("decode_tpot_cost_p50",
@@ -820,6 +867,13 @@ def main(argv=None) -> dict:
     p.add_argument("--shared-fraction", type=float, default=0.6,
                    help="fraction of fleet requests carrying a shared "
                         "prefix")
+    p.add_argument("--trace-out", default="",
+                   help="write the request-span dump "
+                        "(tpu_on_k8s/obs format) here and fold the TTFT "
+                        "critical-path segment breakdown into the "
+                        "summary — works in every mode; virtual-clock "
+                        "modes (--disagg/--autoscale) produce "
+                        "byte-identical dumps for a given seed")
     p.add_argument("--soak", action="store_true",
                    help="assert zero-silent-loss accounting; print "
                         "FLEET_SOAK_FAILED seed=N and exit 1 on violation "
@@ -910,12 +964,13 @@ def main(argv=None) -> dict:
         return _fleet_main(args, cfg, params, max_len)
 
     metrics = ServingMetrics()
+    tracer = _make_tracer(args, time.monotonic)
     engine = ContinuousBatchingEngine(cfg, params, n_slots=args.n_slots,
                                       max_len=max_len,
                                       step_horizon=args.horizon)
     gateway = ServingGateway(
         engine, AdmissionConfig(max_queue_depth=args.queue_bound),
-        metrics=metrics)
+        metrics=metrics, tracer=tracer)
     rng = np.random.default_rng(args.seed)
     arrivals = build_workload(
         rng, args.n_requests, rate=args.rate,
@@ -939,7 +994,12 @@ def main(argv=None) -> dict:
                                         size=lp).astype(np.int32), 2)
         gateway.run()
     metrics.histograms.clear()
+    if tracer is not None:
+        # warmup requests are not the measured trace (same rationale as
+        # the histogram clear); ids keep counting — only spans drop
+        tracer.spans.clear()
     summary = run_load(gateway, arrivals)
+    _dump_trace(tracer, args, summary)
     print(json.dumps(summary))
     return summary
 

@@ -119,6 +119,13 @@ LIBTPU_PERF_ARGS = (
     "--xla_tpu_overlap_compute_collective_tc=true "
     "--xla_enable_async_all_gather=true"
 )
+# Profiling hooks (`tpu_on_k8s/utils/profiling.py`, consumed by
+# `train/loop.py`): the operator's ``--profile-dir``/``--profiler-port``
+# flags land in slice pods as these env vars, so XLA trace capture and the
+# live profiler server need no per-trainer plumbing. Unset (the default)
+# keeps both hooks dormant.
+ENV_PROFILE_DIR = "TPU_ON_K8S_PROFILE_DIR"
+ENV_PROFILER_PORT = "TPU_ON_K8S_PROFILER_PORT"
 
 # ---- GKE TPU scheduling surface ------------------------------------------------
 RESOURCE_TPU = "google.com/tpu"                     # chips per host

@@ -23,6 +23,13 @@ class JobControllerConfig:
     quota_assume_ttl_seconds: float = 60.0         # plugins/quota.go:48
     elastic_loop_period_seconds: float = 30.0      # elastictorchjob_controller.go:60
     elastic_metric_count: int = 5
+    # Profiling hooks (tpu_on_k8s/utils/profiling.py): when set, the TPUJob
+    # reconciler injects TPU_ON_K8S_PROFILE_DIR / TPU_ON_K8S_PROFILER_PORT
+    # into every slice-host pod and `train/loop.py` activates XLA trace
+    # capture / the live profiler server. Empty/zero (the default) injects
+    # nothing — behavior-neutral.
+    profile_dir: str = ""
+    profiler_port: int = 0
     # Serving autoscaler (controller/fleetautoscaler.py): tick period,
     # scrapes aggregated per observation window, consecutive dead scrapes
     # before the signal is stale (hold, don't scale), and the pod-log tail

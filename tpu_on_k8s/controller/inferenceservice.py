@@ -48,6 +48,7 @@ from tpu_on_k8s.api.inference_types import (
     RolloutPolicy,
     ServicePhase,
 )
+from tpu_on_k8s.obs.trace import ensure as ensure_tracer
 from tpu_on_k8s.api.model_types import Model
 from tpu_on_k8s.client.cluster import (
     AlreadyExistsError,
@@ -117,13 +118,26 @@ class InferenceServiceReconciler:
 
     def __init__(self, cluster: InMemoryCluster,
                  config: Optional[JobControllerConfig] = None,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 tracer=None) -> None:
         self.cluster = cluster
         self.config = config or JobControllerConfig()
         self.clock = clock
+        # one ``reconcile.inferenceservice`` span per pass
+        # (`tpu_on_k8s/obs/trace.py`) — control-plane convergence on the
+        # same timeline as the serve-plane request spans
+        self._tracer = ensure_tracer(tracer)
 
     # ------------------------------------------------------------- reconcile
     def reconcile(self, request: Request) -> Result:
+        with self._tracer.span("reconcile.inferenceservice",
+                               namespace=request.namespace,
+                               name=request.name) as sp:
+            res = self._reconcile(request, sp)
+            sp.set(requeue_after=res.requeue_after)
+            return res
+
+    def _reconcile(self, request: Request, sp) -> Result:
         svc = self.cluster.try_get(InferenceService, request.namespace,
                                    request.name)
         if svc is None:
@@ -141,6 +155,7 @@ class InferenceServiceReconciler:
         hosts = topology.hosts_per_slice(svc.spec.tpu_policy.accelerator,
                                          svc.spec.tpu_policy.topology)
         groups = self._observed_groups(svc, hosts)
+        sp.set(desired=desired, observed=len(groups))
         target_hash = image_hash(image)
         new = [g for g in groups if g.hash == target_hash]
         old = [g for g in groups if g.hash != target_hash]
@@ -422,11 +437,12 @@ def setup_inferenceservice_controller(
     manager: Manager,
     config: Optional[JobControllerConfig] = None,
     clock: Callable[[], float] = time.monotonic,
+    tracer=None,
 ) -> InferenceServiceReconciler:
     """Wire the controller: watch InferenceServices, their replica pods,
     and Models (a new ``latest_image`` is what starts a rollout)."""
     reconciler = InferenceServiceReconciler(cluster, config=config,
-                                            clock=clock)
+                                            clock=clock, tracer=tracer)
     # the workqueue shares the reconciler's clock so drain deadlines and
     # requeue delays advance together under an injected test clock
     controller = Controller("inferenceservice", reconciler.reconcile,

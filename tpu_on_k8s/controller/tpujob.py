@@ -227,6 +227,17 @@ class TPUJobHooks:
             if constants.ENV_LIBTPU_INIT_ARGS not in env:
                 container.set_env(constants.ENV_LIBTPU_INIT_ARGS,
                                   constants.LIBTPU_PERF_ARGS)
+            # profiling hooks (`utils/profiling.py` via `train/loop.py`):
+            # only when the operator asked — both default off, and user
+            # pod-template values still win
+            if (self.config.profile_dir
+                    and constants.ENV_PROFILE_DIR not in env):
+                container.set_env(constants.ENV_PROFILE_DIR,
+                                  self.config.profile_dir)
+            if (self.config.profiler_port
+                    and constants.ENV_PROFILER_PORT not in env):
+                container.set_env(constants.ENV_PROFILER_PORT,
+                                  str(self.config.profiler_port))
 
     def _add_elastic_init_containers(self, job: TPUJob, pod: Pod, coordinator: str) -> None:
         """Image-warmup + master-waiter init containers for elastic workers

@@ -64,6 +64,14 @@ def build_parser() -> argparse.ArgumentParser:
     # tunables the reference hard-coded (SURVEY §5.6)
     p.add_argument("--coordinator-period-seconds", type=float, default=0.1)
     p.add_argument("--elastic-loop-period-seconds", type=float, default=30.0)
+    p.add_argument("--profile-dir", default="",
+                   help="inject TPU_ON_K8S_PROFILE_DIR into slice pods: "
+                        "train loops capture an XLA trace there "
+                        "(utils/profiling.py; empty = off)")
+    p.add_argument("--profiler-port", type=int, default=0,
+                   help="inject TPU_ON_K8S_PROFILER_PORT into slice pods: "
+                        "train loops serve the live JAX profiler on it "
+                        "(0 = off)")
     p.add_argument("--serving-autoscale-period-seconds", type=float,
                    default=15.0,
                    help="Tick period of the serving SLO autoscaler "
@@ -252,6 +260,8 @@ class Operator:
             elastic_loop_period_seconds=args.elastic_loop_period_seconds,
             serving_autoscale_period_seconds=getattr(
                 args, "serving_autoscale_period_seconds", 15.0),
+            profile_dir=getattr(args, "profile_dir", ""),
+            profiler_port=getattr(args, "profiler_port", 0),
         )
 
         gang = None

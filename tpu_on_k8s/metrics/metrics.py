@@ -8,9 +8,11 @@ plain dicts so tests and the local driver can read without a scrape.
 """
 from __future__ import annotations
 
+import bisect
 import threading
 from collections import defaultdict, deque
-from typing import Dict, Tuple
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
 
 try:  # prometheus_client ships in the image; degrade gracefully anyway
     import prometheus_client as _prom
@@ -18,6 +20,20 @@ except ImportError:  # pragma: no cover
     _prom = None
 
 _BUCKETS = (0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
+
+
+@dataclass(frozen=True)
+class _Family:
+    """Declarative schema of one exported metric family — registered by
+    every metrics class regardless of prometheus availability, so the
+    pure-Python `render_text` fallback exports the identical families
+    the prometheus backend would."""
+
+    full: str                      # exported family name (with namespace)
+    kind: str                      # "counter" | "gauge" | "histogram"
+    labels: Tuple[str, ...]        # label names ((), or exactly one)
+    help: str
+    buckets: Optional[Tuple[float, ...]] = None
 
 
 class _MetricsBase:
@@ -30,6 +46,8 @@ class _MetricsBase:
 
     #: raw observations retained per histogram (newest win)
     MIRROR_CAP = 10_000
+    #: (value, trace_id) exemplars retained per histogram
+    EXEMPLAR_CAP = 64
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -42,10 +60,48 @@ class _MetricsBase:
         # rotates at cap (len() freezes), so delta readers (the
         # autoscaler's FleetScraper) position by THIS, never by len()
         self.histogram_counts: Dict[str, int] = defaultdict(int)
+        # trace-id exemplars per histogram (newest win): the join key from
+        # a latency observation back to its request's span tree
+        # (`tpu_on_k8s/obs/trace.py`) — "which request was the p95 TTFT"
+        # answered by trace id, not by guesswork
+        self.exemplars: Dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=self.EXEMPLAR_CAP))
+        # the exposition schema (mirror name -> _Family), populated by
+        # the subclass via _declare whether or not prometheus imported —
+        # `exposition()`'s fallback renderer walks this
+        self._families: Dict[str, _Family] = {}
+        # running histogram sums + per-bucket increments for the fallback
+        # renderer (the bounded mirror deque rotates, so sums/buckets
+        # must accrue incrementally, never be recomputed from it)
+        self.histogram_sums: Dict[str, float] = defaultdict(float)
+        self._bucket_counts: Dict[str, list] = {}
         self._prom_counters = {}
         self._prom_hists = {}
         self._prom_gauges = {}
         self.registry = None
+
+    def _declare(self, name: str, full: str, kind: str, help: str,
+                 labels: Tuple[str, ...] = (),
+                 buckets: Optional[Tuple[float, ...]] = None) -> None:
+        """Register one family: schema always, prometheus twin when the
+        client imported. Subclasses call this for every exported metric,
+        which is what makes ``exposition()`` backend-independent."""
+        self._families[name] = _Family(full, kind, tuple(labels), help,
+                                       tuple(buckets) if buckets else None)
+        if kind == "histogram":
+            self._bucket_counts[name] = [0] * (len(buckets or ()) + 1)
+        if _prom is None or self.registry is None:
+            return
+        if kind == "counter":
+            self._prom_counters[name] = _prom.Counter(
+                full, help, list(labels), registry=self.registry)
+        elif kind == "gauge":
+            self._prom_gauges[name] = _prom.Gauge(
+                full, help, list(labels), registry=self.registry)
+        else:
+            self._prom_hists[name] = _prom.Histogram(
+                full, help, list(labels), buckets=buckets,
+                registry=self.registry)
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
@@ -61,10 +117,23 @@ class _MetricsBase:
         if c is not None:
             c.inc(n)
 
-    def observe(self, name: str, seconds: float) -> None:
+    def observe(self, name: str, seconds: float,
+                exemplar=None) -> None:
+        """Record one histogram sample. ``exemplar`` (a trace id) rides
+        along in a bounded mirror-side deque — the Prometheus client's
+        exemplar support requires OpenMetrics negotiation, so the join
+        key lives in the mirror where `tools/trace_report.py` and the
+        scrape-free consumers already read."""
         with self._lock:
             self.histograms[name].append(seconds)
             self.histogram_counts[name] += 1
+            self.histogram_sums[name] += seconds
+            slots = self._bucket_counts.get(name)
+            if slots is not None:
+                fam = self._families[name]
+                slots[bisect.bisect_left(fam.buckets, seconds)] += 1
+            if exemplar is not None:
+                self.exemplars[name].append((seconds, exemplar))
         h = self._prom_hists.get(name)
         if h is not None:
             h.observe(seconds)
@@ -78,34 +147,30 @@ class JobMetrics(_MetricsBase):
         self.kind = kind
         self.gauges: Dict[Tuple[str, str], float] = {}
         if _prom is not None:
-            registry = registry or _prom.CollectorRegistry()
-            self.registry = registry
-            ns = "tpu_on_k8s"
-            for name in ("created", "deleted", "successful", "failed", "restarted"):
-                self._prom_counters[name] = _prom.Counter(
-                    f"{ns}_jobs_{name}", f"Jobs {name} for kind {kind}",
-                    registry=registry)
-            self._prom_counters["errors"] = _prom.Counter(
-                f"{ns}_controller_errors_total",
-                "Exceptions caught in controller run loops", registry=registry)
-            # optimistic-concurrency health: every retried 409 in a
-            # read-modify-write loop (client update_with_retry/patch_meta).
-            # A climbing rate means writers are fighting — the precursor of
-            # ConflictRetriesExhausted livelocks.
-            self._prom_counters["conflict_retries"] = _prom.Counter(
-                f"{ns}_conflict_retries_total",
-                "Conflict (409) retries across client write loops",
-                registry=registry)
-            for name in ("first_pod_launch_delay_seconds", "all_pods_launch_delay_seconds"):
-                self._prom_hists[name] = _prom.Histogram(
-                    f"{ns}_jobs_{name}", f"Job {name}", buckets=_BUCKETS,
-                    registry=registry)
-            for name in ("running", "pending"):
-                self._prom_gauges[name] = _prom.Gauge(
-                    f"{ns}_jobs_{name}", f"Jobs currently {name}", registry=registry)
-            self._prom_gauges["queue_pending"] = _prom.Gauge(
-                f"{ns}_tenant_queue_jobs_pending_count", "Pending jobs per tenant queue",
-                ["queue"], registry=registry)
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s"
+        for name in ("created", "deleted", "successful", "failed", "restarted"):
+            self._declare(name, f"{ns}_jobs_{name}", "counter",
+                          f"Jobs {name} for kind {kind}")
+        self._declare("errors", f"{ns}_controller_errors_total", "counter",
+                      "Exceptions caught in controller run loops")
+        # optimistic-concurrency health: every retried 409 in a
+        # read-modify-write loop (client update_with_retry/patch_meta).
+        # A climbing rate means writers are fighting — the precursor of
+        # ConflictRetriesExhausted livelocks.
+        self._declare("conflict_retries", f"{ns}_conflict_retries_total",
+                      "counter",
+                      "Conflict (409) retries across client write loops")
+        for name in ("first_pod_launch_delay_seconds",
+                     "all_pods_launch_delay_seconds"):
+            self._declare(name, f"{ns}_jobs_{name}", "histogram",
+                          f"Job {name}", buckets=_BUCKETS)
+        for name in ("running", "pending"):
+            self._declare(name, f"{ns}_jobs_{name}", "gauge",
+                          f"Jobs currently {name}")
+        self._declare("queue_pending",
+                      f"{ns}_tenant_queue_jobs_pending_count", "gauge",
+                      "Pending jobs per tenant queue", labels=("queue",))
 
     def set_gauge(self, name: str, value: float, label: str = "") -> None:
         with self._lock:
@@ -155,42 +220,39 @@ class ServingMetrics(_MetricsBase):
     def __init__(self, registry=None) -> None:
         super().__init__()
         if _prom is not None:
-            registry = registry or _prom.CollectorRegistry()
-            self.registry = registry
-            ns = "tpu_on_k8s_serving"
-            for name in ("requests_submitted", "requests_finished",
-                         "tokens_emitted",
-                         # gateway lifecycle (tpu_on_k8s/serve/gateway.py):
-                         # explicit rejection, client cancel, deadline abort
-                         "requests_rejected", "requests_cancelled",
-                         "deadline_exceeded",
-                         # per-reason rejection breakdown — an operator
-                         # must be able to tell quota exhaustion from
-                         # queue overflow off the scrape alone (reasons
-                         # from tpu_on_k8s/serve/admission.py)
-                         "rejected_queue_full", "rejected_load_shed",
-                         "rejected_quota", "rejected_deadline",
-                         "rejected_draining",
-                         # crash recovery (tpu_on_k8s/serve/gateway.py):
-                         # engine deaths, in-flight requests re-admitted
-                         # through the fair queue, and requests whose
-                         # replay budget ran out — together these prove
-                         # no request is ever silently lost to a crash
-                         "engine_crashes", "requests_replayed",
-                         "retry_exhausted"):
-                self._prom_counters[name] = _prom.Counter(
-                    f"{ns}_{name}", f"Serving {name}", registry=registry)
-            for name in ("time_to_first_token_seconds",
-                         "queue_wait_seconds", "request_latency_seconds",
-                         # inter-token latency (TPOT) — the streaming-felt
-                         # speed, distinct from TTFT
-                         "time_per_output_token_seconds"):
-                self._prom_hists[name] = _prom.Histogram(
-                    f"{ns}_{name}", f"Serving {name}",
-                    buckets=_SERVING_BUCKETS, registry=registry)
-            for name in ("slots_active", "queue_depth"):
-                self._prom_gauges[name] = _prom.Gauge(
-                    f"{ns}_{name}", f"Serving {name}", registry=registry)
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_serving"
+        for name in ("requests_submitted", "requests_finished",
+                     "tokens_emitted",
+                     # gateway lifecycle (tpu_on_k8s/serve/gateway.py):
+                     # explicit rejection, client cancel, deadline abort
+                     "requests_rejected", "requests_cancelled",
+                     "deadline_exceeded",
+                     # per-reason rejection breakdown — an operator
+                     # must be able to tell quota exhaustion from
+                     # queue overflow off the scrape alone (reasons
+                     # from tpu_on_k8s/serve/admission.py)
+                     "rejected_queue_full", "rejected_load_shed",
+                     "rejected_quota", "rejected_deadline",
+                     "rejected_draining",
+                     # crash recovery (tpu_on_k8s/serve/gateway.py):
+                     # engine deaths, in-flight requests re-admitted
+                     # through the fair queue, and requests whose
+                     # replay budget ran out — together these prove
+                     # no request is ever silently lost to a crash
+                     "engine_crashes", "requests_replayed",
+                     "retry_exhausted"):
+            self._declare(name, f"{ns}_{name}", "counter",
+                          f"Serving {name}")
+        for name in ("time_to_first_token_seconds",
+                     "queue_wait_seconds", "request_latency_seconds",
+                     # inter-token latency (TPOT) — the streaming-felt
+                     # speed, distinct from TTFT
+                     "time_per_output_token_seconds"):
+            self._declare(name, f"{ns}_{name}", "histogram",
+                          f"Serving {name}", buckets=_SERVING_BUCKETS)
+        for name in ("slots_active", "queue_depth"):
+            self._declare(name, f"{ns}_{name}", "gauge", f"Serving {name}")
 
 
 class TrainMetrics(_MetricsBase):
@@ -205,19 +267,16 @@ class TrainMetrics(_MetricsBase):
     def __init__(self, registry=None) -> None:
         super().__init__()
         if _prom is not None:
-            registry = registry or _prom.CollectorRegistry()
-            self.registry = registry
-            ns = "tpu_on_k8s_train"
-            for name in ("host_syncs", "checkpoints_enqueued",
-                         "checkpoint_failures", "stalled_steps"):
-                self._prom_counters[name] = _prom.Counter(
-                    f"{ns}_{name}", f"Training loop {name}",
-                    registry=registry)
-            for name in ("step_seconds", "tokens_per_sec", "mfu",
-                         "steps_inflight"):
-                self._prom_gauges[name] = _prom.Gauge(
-                    f"{ns}_{name}", f"Training loop {name}",
-                    registry=registry)
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_train"
+        for name in ("host_syncs", "checkpoints_enqueued",
+                     "checkpoint_failures", "stalled_steps"):
+            self._declare(name, f"{ns}_{name}", "counter",
+                          f"Training loop {name}")
+        for name in ("step_seconds", "tokens_per_sec", "mfu",
+                     "steps_inflight"):
+            self._declare(name, f"{ns}_{name}", "gauge",
+                          f"Training loop {name}")
 
 
 class FleetMetrics(_MetricsBase):
@@ -266,32 +325,26 @@ class FleetMetrics(_MetricsBase):
         self.counters: Dict[Tuple[str, str], int] = defaultdict(int)
         self.gauges: Dict[Tuple[str, str], float] = {}
         if _prom is not None:
-            registry = registry or _prom.CollectorRegistry()
-            self.registry = registry
-            ns = "tpu_on_k8s_fleet"
-            for name in self._LABELED_COUNTERS:
-                self._prom_counters[name] = _prom.Counter(
-                    f"{ns}_{name}", f"Fleet {name}", ["replica"],
-                    registry=registry)
-            for name in self._PLAIN_COUNTERS:
-                self._prom_counters[name] = _prom.Counter(
-                    f"{ns}_{name}", f"Fleet {name}", registry=registry)
-            for name in self._LABELED_GAUGES:
-                self._prom_gauges[name] = _prom.Gauge(
-                    f"{ns}_{name}", f"Fleet {name}", ["replica"],
-                    registry=registry)
-            for name in self._PLAIN_GAUGES:
-                self._prom_gauges[name] = _prom.Gauge(
-                    f"{ns}_{name}", f"Fleet {name}", registry=registry)
-            for name in self._POOL_GAUGES:
-                self._prom_gauges[name] = _prom.Gauge(
-                    f"{ns}_{name}", f"Fleet {name}", ["pool"],
-                    registry=registry)
-            # handoff queue wait: enqueue → adoption on a decode replica
-            # (the latency the handoff link adds to TTFT)
-            self._prom_hists["handoff_wait_seconds"] = _prom.Histogram(
-                f"{ns}_handoff_wait_seconds", "Fleet handoff_wait_seconds",
-                buckets=_SERVING_BUCKETS, registry=registry)
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_fleet"
+        for name in self._LABELED_COUNTERS:
+            self._declare(name, f"{ns}_{name}", "counter", f"Fleet {name}",
+                          labels=("replica",))
+        for name in self._PLAIN_COUNTERS:
+            self._declare(name, f"{ns}_{name}", "counter", f"Fleet {name}")
+        for name in self._LABELED_GAUGES:
+            self._declare(name, f"{ns}_{name}", "gauge", f"Fleet {name}",
+                          labels=("replica",))
+        for name in self._PLAIN_GAUGES:
+            self._declare(name, f"{ns}_{name}", "gauge", f"Fleet {name}")
+        for name in self._POOL_GAUGES:
+            self._declare(name, f"{ns}_{name}", "gauge", f"Fleet {name}",
+                          labels=("pool",))
+        # handoff queue wait: enqueue → adoption on a decode replica
+        # (the latency the handoff link adds to TTFT)
+        self._declare("handoff_wait_seconds", f"{ns}_handoff_wait_seconds",
+                      "histogram", "Fleet handoff_wait_seconds",
+                      buckets=_SERVING_BUCKETS)
 
     def inc(self, name: str, n: int = 1, replica: str = "") -> None:
         with self._lock:
@@ -344,20 +397,17 @@ class AutoscaleMetrics(_MetricsBase):
         self.counters: Dict[Tuple[str, str], int] = defaultdict(int)
         self.gauges: Dict[Tuple[str, str], float] = {}
         if _prom is not None:
-            registry = registry or _prom.CollectorRegistry()
-            self.registry = registry
-            ns = "tpu_on_k8s_autoscale"
-            for name in self._ACTION_COUNTERS:
-                self._prom_counters[name] = _prom.Counter(
-                    f"{ns}_{name}", f"Autoscale {name}", ["action"],
-                    registry=registry)
-            for name in self._PLAIN_COUNTERS:
-                self._prom_counters[name] = _prom.Counter(
-                    f"{ns}_{name}", f"Autoscale {name}", registry=registry)
-            for name in self._SERVICE_GAUGES:
-                self._prom_gauges[name] = _prom.Gauge(
-                    f"{ns}_{name}", f"Autoscale {name}", ["service"],
-                    registry=registry)
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_autoscale"
+        for name in self._ACTION_COUNTERS:
+            self._declare(name, f"{ns}_{name}", "counter",
+                          f"Autoscale {name}", labels=("action",))
+        for name in self._PLAIN_COUNTERS:
+            self._declare(name, f"{ns}_{name}", "counter",
+                          f"Autoscale {name}")
+        for name in self._SERVICE_GAUGES:
+            self._declare(name, f"{ns}_{name}", "gauge",
+                          f"Autoscale {name}", labels=("service",))
 
     def inc(self, name: str, n: int = 1, label: str = "") -> None:
         with self._lock:
@@ -378,13 +428,105 @@ class AutoscaleMetrics(_MetricsBase):
         self.inc("decisions", label=action)
 
 
+def _escape_label(v: str) -> str:
+    """Label-value escaping per the exposition format: backslash first
+    (escaping the escapes), then double-quote, then newline."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping: backslash and newline (quotes are legal)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    """Sample-value rendering matching prometheus_client's float style
+    (integers carry a trailing ``.0``)."""
+    return repr(float(v))
+
+
+def _mirror_entries(mirror: dict, name: str):
+    """All (label_value, value) pairs of family ``name`` in a mirror dict
+    whose keys are either plain names or ``(name, label)`` tuples —
+    sorted by label for deterministic output."""
+    out = []
+    for key, val in mirror.items():
+        mname, label = key if isinstance(key, tuple) else (key, "")
+        if mname == name:
+            out.append((label, val))
+    return sorted(out, key=lambda kv: str(kv[0]))
+
+
+def render_text(metrics) -> str:
+    """Pure-Python Prometheus text-format renderer over the mirror dicts
+    + declared family schema — what ``exposition()`` falls back to when
+    prometheus_client is absent, so a scrape body exists on any image.
+    Conformant: counter families carry the ``_total`` suffix, histograms
+    render cumulative ``le`` buckets / ``_sum`` / ``_count``, and label
+    values escape backslash, double-quote, and newline."""
+    with metrics._lock:
+        counters = dict(metrics.counters)
+        gauges = dict(metrics.gauges)
+        hist_counts = dict(metrics.histogram_counts)
+        hist_sums = dict(metrics.histogram_sums)
+        bucket_counts = {k: list(v)
+                         for k, v in metrics._bucket_counts.items()}
+    lines = []
+
+    def sample(fname: str, fam: _Family, label, value) -> None:
+        lbl = ""
+        if fam.labels and label is not None:
+            lbl = f'{{{fam.labels[0]}="{_escape_label(str(label))}"}}'
+        lines.append(f"{fname}{lbl} {_fmt(value)}")
+
+    for name, fam in metrics._families.items():
+        if fam.kind == "counter":
+            fname = (fam.full if fam.full.endswith("_total")
+                     else fam.full + "_total")
+            lines.append(f"# HELP {fname} {_escape_help(fam.help)}")
+            lines.append(f"# TYPE {fname} counter")
+            entries = _mirror_entries(counters, name)
+            if not entries and not fam.labels:
+                entries = [("", 0)]       # prom exports unlabeled at 0
+            for label, val in entries:
+                sample(fname, fam, label if fam.labels else None, val)
+        elif fam.kind == "gauge":
+            lines.append(f"# HELP {fam.full} {_escape_help(fam.help)}")
+            lines.append(f"# TYPE {fam.full} gauge")
+            entries = _mirror_entries(gauges, name)
+            if not entries and not fam.labels:
+                entries = [("", 0.0)]
+            for label, val in entries:
+                sample(fam.full, fam, label if fam.labels else None, val)
+        else:
+            lines.append(f"# HELP {fam.full} {_escape_help(fam.help)}")
+            lines.append(f"# TYPE {fam.full} histogram")
+            slots = bucket_counts.get(name, [0])
+            cum = 0
+            for bound, n in zip(fam.buckets or (), slots):
+                cum += n
+                lines.append(f'{fam.full}_bucket{{le="{_fmt(bound)}"}} '
+                             f"{_fmt(cum)}")
+            cum += slots[-1]
+            lines.append(f'{fam.full}_bucket{{le="+Inf"}} {_fmt(cum)}')
+            lines.append(f"{fam.full}_count "
+                         f"{_fmt(hist_counts.get(name, 0))}")
+            lines.append(f"{fam.full}_sum "
+                         f"{_fmt(hist_sums.get(name, 0.0))}")
+    return "\n".join(lines) + "\n"
+
+
 def exposition(metrics) -> str:
     """The Prometheus text-format scrape body for any metrics instance
     (what ``serve()``'s endpoint returns) — separated out so tests and
-    push-style exporters can render without binding a port."""
-    if _prom is None or metrics.registry is None:
-        raise RuntimeError("prometheus_client unavailable")
-    return _prom.generate_latest(metrics.registry).decode()
+    push-style exporters can render without binding a port. With
+    prometheus_client importable this is its canonical rendering; without
+    it, the `render_text` fallback over the mirrors + declared schema —
+    never a RuntimeError, an image without the client still scrapes."""
+    if _prom is not None and metrics.registry is not None:
+        return _prom.generate_latest(metrics.registry).decode()
+    return render_text(metrics)
 
 
 def serve(metrics, port: int = 8443):  # pragma: no cover - live mode

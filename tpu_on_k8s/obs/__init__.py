@@ -1,0 +1,46 @@
+"""Observability: the deterministic span/trace layer joining every plane.
+
+* `trace`  — ``Tracer`` / ``Span``: counter-derived ids, injectable-clock
+  timestamps, the ``NOOP`` disabled tracer (bit-for-bit behavior-neutral);
+* `export` — Chrome trace-event / Perfetto rendering + the
+  ``FlightRecorder`` crash ring buffer.
+
+Span producers: `serve/gateway.py`, `serve/fleet.py`, `serve/disagg.py`
+(per-request lifecycle), `controller/fleetautoscaler.py` +
+`controller/inferenceservice.py` (control-loop ticks), `train/loop.py`
+(sync windows). Consumers: `tools/trace_report.py` (TTFT critical path),
+``--trace-out`` on `tools/serve_load.py`, the flight recorder.
+
+Stdlib-only, like `chaos/` — importable from any layer.
+"""
+from tpu_on_k8s.obs.export import (
+    FlightRecorder,
+    dump_chrome_trace,
+    load_trace,
+    to_chrome_trace,
+)
+from tpu_on_k8s.obs.trace import (
+    NOOP,
+    NOOP_SPAN,
+    STATUS_ERROR,
+    STATUS_OK,
+    TRACE_FORMAT,
+    Span,
+    Tracer,
+    ensure,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "NOOP",
+    "NOOP_SPAN",
+    "STATUS_ERROR",
+    "STATUS_OK",
+    "Span",
+    "TRACE_FORMAT",
+    "Tracer",
+    "dump_chrome_trace",
+    "ensure",
+    "load_trace",
+    "to_chrome_trace",
+]

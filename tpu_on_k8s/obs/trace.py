@@ -1,0 +1,314 @@
+"""Deterministic span/trace substrate: one timeline from gateway admission
+to decoded token.
+
+Every plane of the stack already records *fragments* of a request's life —
+lifecycle states in `serve/lifecycle.py`, autoscaler ``decision_log``
+lines, chaos event logs, Prometheus histograms — but nothing joins them
+per request: a TTFT regression cannot be attributed to queue-wait vs
+prefill vs handoff vs decode from any one of them. This module is the
+joining substrate:
+
+* **``Span``** — one named interval on one timeline: counter-derived ids
+  (no uuids), injectable-clock timestamps (the serving plane's virtual
+  clocks flow straight through), ordered attrs, and point-in-time
+  ``event``s (first token, chaos injections, replays).
+* **``Tracer``** — mints spans under a lock from a single monotone
+  counter, collects them as they finish, and feeds an optional
+  ``FlightRecorder`` (`obs/export.py`). Because ids come from a counter
+  and timestamps from the injected clock, two runs of the same seeded
+  trace produce **byte-identical dumps** — the property
+  ``make trace-demo`` asserts and the digital-twin roadmap item
+  (VirtualFlow, PAPERS.md) will replay.
+* **``NOOP``** — the disabled tracer. Every instrumented call site holds
+  a tracer unconditionally (``tracer or NOOP``); the noop mints one
+  shared inert span, reads no clock, takes no lock, allocates nothing
+  per call — tracing disabled is bit-for-bit behavior-neutral, so every
+  existing determinism proof (autoscale decision logs, disagg event
+  logs, chaos soaks) survives unchanged.
+
+Span taxonomy (see `docs/observability.md` for the full catalog): a
+request's root span is ``request``; its sequential phase children are
+``queue`` → (``decode`` | ``prefill`` → ``handoff`` → ``decode``); the
+root carries the ``first_token`` event `tools/trace_report.py` anchors
+the TTFT critical path on. Control loops emit ``autoscale.tick`` /
+``reconcile.inferenceservice`` spans; the train loop emits
+``train.window`` spans bridged to the XLA timeline via
+`utils/profiling.annotate`.
+
+Stdlib-only: any layer may import this without dragging in jax or the
+client stack (the same import discipline as `chaos/faults.py`).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+#: terminal statuses a span may carry; anything else is treated as a
+#: domain-specific status string (e.g. a RequestState value)
+STATUS_OK = "ok"
+STATUS_ERROR = "error"
+
+
+class Span:
+    """One interval on the trace timeline. Mutate only through ``set`` /
+    ``event`` / ``finish`` — the exporter reads the fields directly."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start",
+                 "end", "status", "attrs", "events", "_tracer")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: int,
+                 span_id: int, parent_id: Optional[int], start: float,
+                 attrs: Dict[str, Any]) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = start
+        self.end: Optional[float] = None
+        self.status: str = STATUS_OK
+        self.attrs = attrs
+        self.events: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------------- recording
+    def set(self, **attrs: Any) -> "Span":
+        """Attach/overwrite attributes (insertion-ordered)."""
+        self.attrs.update(attrs)
+        return self
+
+    def event(self, name: str, /, **attrs: Any) -> "Span":
+        """A point-in-time marker on this span's timeline (first token,
+        chaos injection, replay decision)."""
+        ev: Dict[str, Any] = {"name": name, "t": self._tracer.clock()}
+        if attrs:
+            ev["attrs"] = attrs
+        self.events.append(ev)
+        return self
+
+    def finish(self, status: str = STATUS_OK,
+               at: Optional[float] = None) -> "Span":
+        """End the span exactly once (idempotent — a finalize racing a
+        crash sweep keeps the first verdict, mirroring
+        `serve/lifecycle.finalize`)."""
+        if self.end is not None:
+            return self
+        self.end = self._tracer.clock() if at is None else at
+        self.status = status
+        self._tracer._collect(self)
+        return self
+
+    # -------------------------------------------------------------- plumbing
+    @property
+    def finished(self) -> bool:
+        return self.end is not None
+
+    @property
+    def duration(self) -> Optional[float]:
+        return None if self.end is None else self.end - self.start
+
+    def to_dict(self) -> Dict[str, Any]:
+        """The canonical export form (what ``--trace-out`` files hold and
+        `tools/trace_report.py` consumes)."""
+        d: Dict[str, Any] = {
+            "name": self.name, "trace": self.trace_id,
+            "span": self.span_id, "parent": self.parent_id,
+            "start": self.start, "end": self.end, "status": self.status,
+        }
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        if self.events:
+            d["events"] = list(self.events)
+        return d
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.finish(STATUS_ERROR if exc_type is not None else STATUS_OK)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Span({self.name!r} trace={self.trace_id} "
+                f"span={self.span_id} status={self.status})")
+
+
+class _NoopSpan:
+    """The inert span the disabled tracer hands out: every method no-ops
+    and returns self, so instrumented call sites never branch."""
+
+    __slots__ = ()
+    name = ""
+    trace_id = 0
+    span_id = 0
+    parent_id = None
+    start = 0.0
+    end = 0.0
+    status = STATUS_OK
+    attrs: Dict[str, Any] = {}
+    events: List[Dict[str, Any]] = []
+    finished = True
+    duration = 0.0
+
+    def set(self, **attrs: Any) -> "_NoopSpan":
+        return self
+
+    def event(self, name: str, /, **attrs: Any) -> "_NoopSpan":
+        return self
+
+    def finish(self, status: str = STATUS_OK,
+               at: Optional[float] = None) -> "_NoopSpan":
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {}
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _NoopTracer:
+    """Tracing disabled: no clock reads, no locks, no allocation per
+    call — bit-for-bit behavior-neutral (the property every existing
+    determinism proof depends on)."""
+
+    __slots__ = ()
+    enabled = False
+    recorder = None
+
+    def clock(self) -> float:
+        return 0.0
+
+    def start(self, name: str, /, parent: Any = None, **attrs: Any
+              ) -> _NoopSpan:
+        return NOOP_SPAN
+
+    @contextlib.contextmanager
+    def span(self, name: str, /, parent: Any = None, **attrs: Any
+             ) -> Iterator[_NoopSpan]:
+        yield NOOP_SPAN
+
+    def crash_dump(self, reason: str) -> Optional[str]:
+        return None
+
+    def export(self) -> List[Dict[str, Any]]:
+        return []
+
+    def dump(self, path: str) -> None:
+        raise RuntimeError("tracing is disabled (NOOP tracer has no spans)")
+
+
+NOOP = _NoopTracer()
+
+
+def ensure(tracer: Optional["Tracer"]):
+    """The one idiom every instrumented constructor uses:
+    ``self._tracer = ensure(tracer)`` — None means disabled."""
+    return NOOP if tracer is None else tracer
+
+
+class Tracer:
+    """Mints and collects spans. ``clock`` is injectable (pass the same
+    virtual clock the fleet runs on and the whole dump becomes a pure
+    function of the seed); span/trace ids come from one monotone counter
+    under the tracer lock, so id assignment is deterministic whenever the
+    call sequence is (every seeded closed-loop driver is single-threaded).
+
+    ``max_spans`` bounds retention: a long-lived server must not grow an
+    unbounded span list — past the cap, finished spans still feed the
+    flight recorder's ring (which is the crash artifact) but are dropped
+    from the export list, and ``dropped`` counts them."""
+
+    enabled = True
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic, *,
+                 recorder=None, service: str = "tpu-on-k8s",
+                 max_spans: int = 200_000) -> None:
+        if max_spans < 1:
+            raise ValueError(f"max_spans must be >= 1, got {max_spans}")
+        self.clock = clock
+        self.service = service
+        self.recorder = recorder
+        self.max_spans = max_spans
+        self.spans: List[Span] = []       # finished spans, in finish order
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._next_id = 1
+
+    # ---------------------------------------------------------------- spans
+    def start(self, name: str, /, parent: Optional[Span] = None,
+              **attrs: Any) -> Span:
+        """Begin a span. With ``parent`` the new span joins its trace;
+        without, it roots a new trace whose id IS the span id (counter-
+        derived — no uuid, no wall clock)."""
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        if parent is not None and parent.trace_id:
+            tid, pid = parent.trace_id, parent.span_id
+        else:
+            tid, pid = sid, None
+        return Span(self, name, tid, sid, pid, self.clock(), dict(attrs))
+
+    @contextlib.contextmanager
+    def span(self, name: str, /, parent: Optional[Span] = None,
+             **attrs: Any) -> Iterator[Span]:
+        """``with tracer.span("autoscale.tick", svc=key) as sp: ...`` —
+        finishes ``error`` if the body raises."""
+        sp = self.start(name, parent, **attrs)
+        try:
+            yield sp
+        except BaseException:
+            sp.finish(STATUS_ERROR)
+            raise
+        sp.finish()
+
+    def _collect(self, span: Span) -> None:
+        with self._lock:
+            if len(self.spans) < self.max_spans:
+                self.spans.append(span)
+            else:
+                self.dropped += 1
+        if self.recorder is not None:
+            self.recorder.record(span)
+
+    # --------------------------------------------------------------- export
+    def export(self) -> List[Dict[str, Any]]:
+        """Finished spans as dicts, sorted by (trace, span) id — the
+        deterministic order, independent of finish-order ties."""
+        with self._lock:
+            spans = list(self.spans)
+        return [s.to_dict()
+                for s in sorted(spans, key=lambda s: (s.trace_id,
+                                                      s.span_id))]
+
+    def dump(self, path: str) -> None:
+        """Write the canonical trace file. ``sort_keys`` + fixed
+        separators + no wall-clock metadata: two seeded runs produce
+        byte-identical files (`make trace-demo` byte-compares them)."""
+        doc = {"format": TRACE_FORMAT, "service": self.service,
+               "dropped": self.dropped, "spans": self.export()}
+        with open(path, "w") as f:
+            json.dump(doc, f, sort_keys=True, separators=(",", ":"))
+            f.write("\n")
+
+    def crash_dump(self, reason: str) -> Optional[str]:
+        """Flight-recorder dump hook (engine crash, retry exhaustion):
+        persists the ring of recent spans if a recorder with a directory
+        is attached; returns the written path (None otherwise). Sequence
+        allocation belongs to the recorder — it is the one counter all
+        dump paths share, so filenames never collide."""
+        if self.recorder is None:
+            return None
+        return self.recorder.dump(reason)
+
+
+#: the trace-file format tag `tools/trace_report.py` checks
+TRACE_FORMAT = "tpu-on-k8s-trace/v1"

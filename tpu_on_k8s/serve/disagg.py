@@ -69,6 +69,7 @@ import numpy as np
 
 from tpu_on_k8s import chaos
 from tpu_on_k8s.metrics.metrics import ServingMetrics
+from tpu_on_k8s.obs.trace import STATUS_ERROR, ensure as ensure_tracer
 from tpu_on_k8s.serve.admission import (
     REASON_DRAINING,
     REASON_QUEUE_FULL,
@@ -170,6 +171,11 @@ class _DisaggRequest:
     decode_t0: Optional[float] = None  # first DECODE-pool token time
     last_token_at: Optional[float] = None
     n_decode_tokens: int = 0
+    # tracing (`tpu_on_k8s/obs/trace.py`): the root span plus the open
+    # lifecycle child — queue → prefill → handoff → decode, exactly the
+    # four TTFT critical-path segments `tools/trace_report.py` sums
+    span: object = None
+    phase_span: object = None
 
 
 @dataclasses.dataclass
@@ -219,7 +225,8 @@ class DisaggFleet:
                  max_queue_depth: Optional[int] = None,
                  replica_metrics: bool = True,
                  metrics=None,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 tracer=None) -> None:
         if prefill_replicas < 1 or decode_replicas < 1:
             raise ValueError("each pool needs >= 1 replica, got "
                              f"prefill={prefill_replicas} "
@@ -230,6 +237,7 @@ class DisaggFleet:
         self._factory = engine_factory
         self._replay = replay or ReplayPolicy()
         self._clock = clock
+        self._tracer = ensure_tracer(tracer)
         self.metrics = metrics              # optional FleetMetrics
         self._replica_metrics = replica_metrics
         self.handoff_capacity = handoff_capacity
@@ -250,6 +258,9 @@ class DisaggFleet:
         self._jobs: Dict[int, object] = {}       # rid → PrefillJob
         self._staged: Dict[int, _Handoff] = {}   # rid → backpressured handoff
         self._newly_terminal: List[int] = []
+        # flight-recorder dump reasons noted under the fleet lock,
+        # written (file I/O) outside it at the end of step()
+        self._deferred_dumps: List[str] = []
         self._next_rid = 0
         self._accepting = True
         self._scaledown: set = set()
@@ -455,6 +466,14 @@ class DisaggFleet:
                 on_token=on_token,
                 cost=int(prompt.size) + max_new_tokens,
                 submitted_at=now)
+            req = self._requests[rid]
+            req.span = self._tracer.start(
+                "request", rid=rid, prompt_tokens=int(prompt.size),
+                suffix_tokens=int(suffix.size),
+                max_new_tokens=max_new_tokens,
+                prefix_warm=h is not None)
+            req.phase_span = self._tracer.start("queue", parent=req.span,
+                                                attempt=0)
             self._pending.append(rid)
             self.stats["routed"] += 1
         return rid
@@ -505,6 +524,11 @@ class DisaggFleet:
         if req.pinned and req.prefix_hash is not None:
             self.store.unpin(req.prefix_hash)
             req.pinned = False
+        if req.phase_span is not None:
+            req.phase_span.finish(state.value)
+            req.phase_span = None
+        if req.span is not None:
+            req.span.finish(state.value)
         self._newly_terminal.append(req.rid)
 
     def _replay_or_exhaust_locked(self, req: _DisaggRequest,
@@ -516,6 +540,11 @@ class DisaggFleet:
         if req.pinned and req.prefix_hash is not None:
             self.store.unpin(req.prefix_hash)
             req.pinned = False
+        if req.phase_span is not None:
+            # whatever phase held the KV when it died — the error end
+            # keeps the attempt's wall time on the timeline
+            req.phase_span.finish(STATUS_ERROR)
+            req.phase_span = None
         if req.cancel_requested:
             self._finalize_locked(req, RequestState.CANCELLED)
             return
@@ -526,6 +555,9 @@ class DisaggFleet:
             self.stats["retry_exhausted"] += 1
             self.event_log.append(f"exhausted rid={req.rid}")
             self._finalize_locked(req, RequestState.RETRY_EXHAUSTED)
+            # defer the flight dump: this runs under the fleet lock, and
+            # recorder file I/O must not block every submit()/step()
+            self._deferred_dumps.append("retry_exhausted")
             return
         req.replays += 1
         req.state = RequestState.QUEUED
@@ -533,6 +565,10 @@ class DisaggFleet:
         req.first_token_at = None
         req.decode_t0 = None
         req.n_decode_tokens = 0
+        if req.span is not None:
+            req.span.event("replay", n=req.replays)
+        req.phase_span = self._tracer.start("queue", parent=req.span,
+                                            attempt=req.replays)
         self.stats["replayed"] += 1
         if self.metrics is not None:
             self.metrics.inc("requests_replayed")
@@ -615,6 +651,11 @@ class DisaggFleet:
             free.remove(rep)
             req.state = RequestState.PREFILLING
             req.prefill_replica = rep.name
+            if req.phase_span is not None:
+                req.phase_span.finish()
+                req.phase_span = self._tracer.start(
+                    "prefill", parent=req.span, replica=rep.name,
+                    attempt=req.replays)
             rep.job = rid
             rep.routed += 1
             rep.outstanding += req.cost
@@ -657,15 +698,26 @@ class DisaggFleet:
                     rep.outstanding -= req.cost
                     continue               # cancelled while prefilling
                 req.first_token_at = now
+                # once per REQUEST, not per attempt: a replayed prefill
+                # measures from the original submitted_at, and
+                # double-counting the largest sample would skew ttft_p95
+                # toward spurious pool scale-ups. The span event shares
+                # the flag — the trace's first_token anchor is the
+                # client's first token, not a replay's re-emission.
+                first = not req.ttft_observed
+                req.ttft_observed = True
+                if first and req.span is not None:
+                    req.span.event("first_token")
+                if req.phase_span is not None:
+                    req.phase_span.finish()
+                    req.phase_span = None
                 if rep.metrics is not None:
-                    # once per REQUEST, not per attempt: a replayed
-                    # prefill measures from the original submitted_at,
-                    # and double-counting the largest sample would skew
-                    # ttft_p95 toward spurious pool scale-ups
-                    if not req.ttft_observed:
-                        req.ttft_observed = True
-                        rep.metrics.observe("time_to_first_token_seconds",
-                                            now - req.submitted_at)
+                    if first:
+                        rep.metrics.observe(
+                            "time_to_first_token_seconds",
+                            now - req.submitted_at,
+                            exemplar=(req.span.trace_id or None)
+                            if req.span is not None else None)
                     rep.metrics.inc("tokens_emitted")
             self._fire_token(req, job.first_token)
             payload = job.handoff(
@@ -690,6 +742,8 @@ class DisaggFleet:
                     if self.metrics is not None:
                         self.metrics.inc("handoffs_lost")
                     self.event_log.append(f"handoff_lost rid={rid}")
+                    if req.span is not None:
+                        req.span.event("chaos", fault=fault.kind)
                     self._replay_or_exhaust_locked(req, now)
                     continue
                 if isinstance(fault, chaos.HandoffCorrupt):
@@ -698,9 +752,13 @@ class DisaggFleet:
                     # defense under test
                     _flip_first_leaf(payload.cache)
                     self.event_log.append(f"handoff_corrupt rid={rid}")
+                    if req.span is not None:
+                        req.span.event("chaos", fault=fault.kind)
                 if req.prefix_hash is not None and not req.pinned:
                     self.store.pin(req.prefix_hash)
                     req.pinned = True
+                req.phase_span = self._tracer.start(
+                    "handoff", parent=req.span, attempt=req.replays)
                 ho = _Handoff(rid, payload, now)
                 if len(self._handoffs) >= self.handoff_capacity:
                     # bounded queue: stage on the replica (which takes no
@@ -709,6 +767,7 @@ class DisaggFleet:
                     rep.staged = rid
                     self._staged[rid] = ho
                     req.state = RequestState.HANDOFF
+                    req.phase_span.set(staged=True)
                     continue
                 rep.outstanding -= req.cost
                 self._enqueue_handoff_locked(ho, req)
@@ -773,6 +832,9 @@ class DisaggFleet:
                         self.metrics.inc("handoffs_corrupt")
                     self.event_log.append(
                         f"handoff_rejected rid={ho.rid} checksum")
+                    if req.span is not None:
+                        req.span.event("handoff_rejected",
+                                       reason="checksum")
                     self._replay_or_exhaust_locked(req, now)
                     continue
                 if req.prefix_hash is not None:
@@ -807,11 +869,19 @@ class DisaggFleet:
                     self.event_log.append(
                         f"adopt_deferred rid={req.rid} "
                         f"replica={rep.name} {type(e).__name__}")
+                    if req.span is not None:
+                        req.span.event("adopt_deferred", replica=rep.name,
+                                       error=type(e).__name__)
                 return
             with self._lock:
                 req.state = RequestState.DECODING
                 req.decode_replica = rep.name
                 req.engine_rid = erid
+                if req.phase_span is not None:
+                    req.phase_span.finish()
+                    req.phase_span = self._tracer.start(
+                        "decode", parent=req.span, replica=rep.name,
+                        attempt=req.replays)
                 rep.routed += 1
                 rep.outstanding += req.cost
                 self._by_engine[(rep.name, erid)] = req.rid
@@ -829,6 +899,11 @@ class DisaggFleet:
             with self._lock:
                 if req.decode_t0 is None:
                     req.decode_t0 = now
+                    if req.phase_span is not None:
+                        # the decode pool's first emission: with the
+                        # first_token (prefill) event, this bounds the
+                        # handoff's full latency contribution
+                        req.phase_span.event("first_decode_token")
                 req.last_token_at = now
                 req.n_decode_tokens += 1
             rep = (self.replicas.get(req.decode_replica)
@@ -870,7 +945,11 @@ class DisaggFleet:
                         req = self._requests[rid]
                         rep.outstanding -= req.cost
                         self.event_log.append(f"decode_crash rid={rid}")
+                        if req.span is not None:
+                            req.span.event("engine_crash",
+                                           replica=rep.name)
                         self._replay_or_exhaust_locked(req, now)
+                self._tracer.crash_dump("engine_crash")
                 continue
             for erid in finished:
                 tokens = rep.engine.result(erid)
@@ -891,7 +970,9 @@ class DisaggFleet:
                             rep.metrics.observe(
                                 "time_per_output_token_seconds",
                                 (req.last_token_at - req.decode_t0)
-                                / (req.n_decode_tokens - 1))
+                                / (req.n_decode_tokens - 1),
+                                exemplar=(req.span.trace_id or None)
+                                if req.span is not None else None)
                     self._finalize_locked(req, RequestState.DONE, tokens)
 
     # --------------------------------------------------------------- driver
@@ -917,7 +998,12 @@ class DisaggFleet:
             self._drain_staged_locked()
             self.stats["steps"] += 1
             out, self._newly_terminal = self._newly_terminal, []
+            dumps, self._deferred_dumps = self._deferred_dumps, []
             self._refresh_gauges_locked()
+        # one dump per distinct reason per step, outside the lock (a
+        # burst of exhaustions shares one ring snapshot anyway)
+        for reason in dict.fromkeys(dumps):
+            self._tracer.crash_dump(reason)
         return out
 
     def _refresh_gauges_locked(self) -> None:

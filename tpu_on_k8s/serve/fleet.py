@@ -45,6 +45,7 @@ import numpy as np
 
 from tpu_on_k8s import chaos
 from tpu_on_k8s.metrics.metrics import ServingMetrics
+from tpu_on_k8s.obs.trace import ensure as ensure_tracer
 from tpu_on_k8s.serve.admission import (
     REASON_DRAINING,
     REASON_UNAVAILABLE,
@@ -148,6 +149,10 @@ class _FleetRequest:
     replays: int = 0                   # cross-replica re-dispatches
     tokens: Optional[np.ndarray] = None
     cancel_requested: bool = False
+    # the request's root span (`tpu_on_k8s/obs/trace.py`) — owned by the
+    # fleet (gateways attach their queue/decode children to it via
+    # ``trace_parent`` and never finish it); None when tracing is off
+    span: object = None
 
 
 class ServingFleet:
@@ -167,7 +172,8 @@ class ServingFleet:
                  max_prefixes_per_replica: int = 16,
                  replica_metrics: bool = True,
                  metrics=None,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 tracer=None) -> None:
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         self._factory = engine_factory
@@ -176,6 +182,10 @@ class ServingFleet:
         self._replay = replay or ReplayPolicy()
         self._probe = probe or ProbeConfig()
         self._clock = clock
+        # one tracer for the fleet AND every replica gateway it mints:
+        # a request's routing, queue waits, decode attempts, and
+        # re-routes all land on one counter-coherent span tree
+        self._tracer = ensure_tracer(tracer)
         #: optional ``FleetMetrics`` (per-replica labelled gauges/counters)
         self.metrics = metrics
         self._replica_metrics = replica_metrics
@@ -189,6 +199,10 @@ class ServingFleet:
         self._by_sub: Dict[Tuple[str, int], int] = {}
         self._pending: List[int] = []     # rids waiting for a ready replica
         self._newly_terminal: List[int] = []
+        # flight-recorder dump reasons noted under the fleet lock,
+        # written (file I/O) outside it at the end of step() — same
+        # deferral as DisaggFleet._deferred_dumps
+        self._deferred_dumps: List[str] = []
         self._next_rid = 0
         self._next_ordinal = 0
         self._accepting = True
@@ -218,7 +232,8 @@ class ServingFleet:
         rmetrics = ServingMetrics() if self._replica_metrics else None
         gateway = ServingGateway(
             engine, self._admission, tenant_weights=self._tenant_weights,
-            metrics=rmetrics, clock=self._clock, replay=self._replay)
+            metrics=rmetrics, clock=self._clock, replay=self._replay,
+            tracer=self._tracer if self._tracer.enabled else None)
         rep = Replica(name, version, engine, gateway, rmetrics,
                       HealthMonitor(self._probe))
         self.replicas[name] = rep
@@ -387,11 +402,17 @@ class ServingFleet:
                           else None),
                 on_token=on_token,
                 cost=int(prompt.size) + max_new_tokens)
+            req.span = self._tracer.start(
+                "request", rid=rid, tenant=tenant, priority=priority,
+                prompt_tokens=int(prompt.size),
+                max_new_tokens=max_new_tokens)
             send, pid, key, reg = self._prefix_plan_locked(
                 prompt, rep, allow_register=True, match=pmatch)
             if reg is None:
                 r = self._dispatch_locked(req, rep, send, pid)
                 if isinstance(r, Rejected):
+                    if req.span is not None:
+                        req.span.finish(RequestState.REJECTED.value)
                     return r
                 self._requests[rid] = req
                 return rid
@@ -430,6 +451,8 @@ class ServingFleet:
             r = self._dispatch_locked(req, rep, send, pid)
             if isinstance(r, Rejected):
                 del self._requests[rid]
+                if req.span is not None:
+                    req.span.finish(RequestState.REJECTED.value)
                 return r
             return rid
 
@@ -504,9 +527,15 @@ class ServingFleet:
         r = rep.gateway.submit(send, req.max_new_tokens, tenant=req.tenant,
                                priority=req.priority, deadline_s=deadline_s,
                                eos_id=req.eos_id, prefix_id=prefix_id,
-                               on_token=on_token)
+                               on_token=on_token, trace_parent=req.span)
         if isinstance(r, Rejected):
             return r
+        if req.span is not None:
+            # one event per placement — first route, crash re-route,
+            # rebalance all read off the same timeline
+            req.span.event("routed", replica=rep.name,
+                           attempt=req.replays,
+                           prefix_warm=prefix_id is not None)
         req.replica = rep.name
         req.sub_rid = r
         req.state = RequestState.QUEUED
@@ -643,6 +672,8 @@ class ServingFleet:
             self._by_sub.pop((rep.name, req.sub_rid), None)
             req.replica = None
             req.sub_rid = None
+            if req.span is not None:
+                req.span.event("ejected", replica=rep.name, reason=reason)
             if req.cancel_requested:
                 # the client's cancel died with the ejected gateway —
                 # honor it here instead of re-dispatching the request
@@ -659,6 +690,11 @@ class ServingFleet:
             if self.metrics is not None:
                 self.metrics.inc("requests_rerouted", replica=rep.name)
             self._route_pending_locked(req)
+        # the ejected gateway's open spans die with it (they never
+        # finish); the flight ring still holds the recent finished ones.
+        # Dump deferred: this runs under the fleet lock, and recorder
+        # file I/O must not block every submit()/step()
+        self._deferred_dumps.append("replica_ejected")
 
     def _route_pending_locked(self, req: _FleetRequest) -> None:
         """Re-dispatch a homeless request now if a ready replica exists;
@@ -684,6 +720,8 @@ class ServingFleet:
         req.state = state
         if tokens is not None:
             req.tokens = np.asarray(tokens, np.int32)
+        if req.span is not None:
+            req.span.finish(state.value)
         self._newly_terminal.append(req.rid)
 
     def _collect_replica_terminals_locked(self, rep: Replica,
@@ -766,7 +804,11 @@ class ServingFleet:
                     self._pending.remove(rid)
             self.stats["steps"] += 1
             out, self._newly_terminal = self._newly_terminal, []
+            dumps, self._deferred_dumps = self._deferred_dumps, []
             self._refresh_gauges_locked()
+        # one dump per distinct reason per step, outside the lock
+        for reason in dict.fromkeys(dumps):
+            self._tracer.crash_dump(reason)
         return out
 
     def _refresh_gauges_locked(self) -> None:

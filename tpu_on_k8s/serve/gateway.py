@@ -17,8 +17,15 @@ slot pool); the gateway owns everything a *service* needs around it:
   graceful drain for preemption (``stop_accepting()`` + finish in-flight,
   the serving analog of `controller/failover.py` recovery semantics).
 * **Observability**: queue-depth / reject / cancel / deadline counters and
-  TTFT / TPOT / queue-wait histograms through ``ServingMetrics``, plus
-  streaming via the engine's existing ``on_token`` hook.
+  TTFT / TPOT / queue-wait histograms through ``ServingMetrics`` (TTFT /
+  TPOT observations carry trace-id exemplars when tracing is on), plus
+  streaming via the engine's existing ``on_token`` hook — and, with a
+  ``tracer`` (`tpu_on_k8s/obs/trace.py`), a per-request span tree:
+  ``request`` root (or the fleet's, passed via ``trace_parent``) with
+  sequential ``queue`` → ``decode`` phase children, a ``first_token``
+  event anchoring the TTFT critical path, ``engine_crash`` events on
+  replayed attempts, and a flight-recorder dump on every crash. Tracing
+  off (the default) is bit-for-bit behavior-neutral.
 * **Crash recovery / request replay** (``ReplayPolicy``): when the engine
   dies mid-decode (``EngineCrashError`` out of ``engine.step()``) the
   gateway resets the engine and re-admits every surviving in-flight
@@ -48,6 +55,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Union
 
+from tpu_on_k8s.obs.trace import STATUS_ERROR, ensure as ensure_tracer
 from tpu_on_k8s.serve.admission import (
     REASON_DEADLINE,
     REASON_DRAINING,
@@ -99,13 +107,17 @@ class ServingGateway:
                  tenant_weights: Optional[Dict[str, float]] = None,
                  metrics=None,
                  clock: Callable[[], float] = time.monotonic,
-                 replay: Optional[ReplayPolicy] = None) -> None:
+                 replay: Optional[ReplayPolicy] = None,
+                 tracer=None) -> None:
         if getattr(engine, "_on_retire", None) is not None:
             raise ValueError("engine already has an on_retire consumer — "
                              "one gateway per engine")
         self.engine = engine
         self.metrics = metrics
         self._clock = clock
+        # span producer (`tpu_on_k8s/obs/trace.py`): None → the NOOP
+        # tracer — no clock reads, no allocation, bit-for-bit neutral
+        self._tracer = ensure_tracer(tracer)
         self._admission = AdmissionController(admission)
         self._sched = FairScheduler(tenant_weights)
         self._lock = threading.Lock()
@@ -126,13 +138,15 @@ class ServingGateway:
     def submit(self, prompt, max_new_tokens: int, *, tenant: str = "default",
                priority: int = 0, deadline_s: Optional[float] = None,
                eos_id: Optional[int] = None, prefix_id: Optional[int] = None,
-               on_token=None) -> Union[int, Rejected]:
+               on_token=None, trace_parent=None) -> Union[int, Rejected]:
         """Admit a request: returns its id, or a ``Rejected`` (check with
         ``isinstance``) when the bounded queue / load shedding / tenant
         quota / drain refuses it. ``deadline_s`` is relative seconds: past
         it the request is expired wherever it is. Malformed requests
         (empty prompt, impossible lengths) raise ``ValueError`` — caller
-        bugs, not load conditions."""
+        bugs, not load conditions. ``trace_parent`` joins this request to
+        an existing trace (the fleet passes its root span in and keeps
+        ownership of it; standalone submits root their own)."""
         # the engine owns its request invariants (empty prompt, length vs
         # max_len, prefix existence) — validate through it so a request
         # that would fail at dispatch never reserves budget
@@ -160,6 +174,15 @@ class ServingGateway:
                 deadline=(now + deadline_s if deadline_s is not None
                           else None),
                 submitted_at=now, on_token=on_token)
+            if trace_parent is not None:
+                req.span, req.span_owned = trace_parent, False
+            else:
+                req.span = self._tracer.start(
+                    "request", rid=rid, tenant=tenant, priority=priority,
+                    prompt_tokens=int(prompt.size),
+                    max_new_tokens=max_new_tokens)
+            req.phase_span = self._tracer.start("queue", parent=req.span,
+                                                attempt=0)
             self._requests[rid] = req
             self._sched.push(req)
             depth = len(self._sched)
@@ -221,6 +244,12 @@ class ServingGateway:
                 self._sched.remove(req)
                 self._admission.release(req.tenant, req.cost)
                 del self._requests[req.rid]
+                if req.phase_span is not None:
+                    # the request leaves this gateway; the fleet's
+                    # re-dispatch opens a fresh queue span on the same
+                    # trace, so the two segments sum to the true wait
+                    req.phase_span.finish("rebalanced")
+                    req.phase_span = None
                 evicted.append(req.rid)
             return evicted
 
@@ -253,6 +282,11 @@ class ServingGateway:
         finalize(req, state, tokens)
         self._admission.release(req.tenant, req.cost)
         self._newly_terminal.append(req.rid)
+        if req.phase_span is not None:
+            req.phase_span.finish(state.value)
+            req.phase_span = None
+        if req.span is not None and req.span_owned:
+            req.span.finish(state.value)
         if self.metrics is None:
             return
         now = self._clock()
@@ -264,7 +298,9 @@ class ServingGateway:
                 self.metrics.observe(
                     "time_per_output_token_seconds",
                     (req.last_token_at - req.first_token_at)
-                    / (req.n_tokens - 1))
+                    / (req.n_tokens - 1),
+                    exemplar=(req.span.trace_id or None)
+                    if req.span is not None else None)
         elif state is RequestState.CANCELLED:
             self.metrics.inc("requests_cancelled")
         elif state is RequestState.DEADLINE_EXCEEDED:
@@ -306,11 +342,18 @@ class ServingGateway:
                 observe_ttft = first and not req.ttft_observed
                 if observe_ttft:
                     req.ttft_observed = True
+                    if req.span is not None:
+                        # the anchor `tools/trace_report.py` decomposes
+                        # the TTFT critical path against
+                        req.span.event("first_token")
             if self.metrics is not None:
                 self.metrics.inc("tokens_emitted")
                 if observe_ttft:
-                    self.metrics.observe("time_to_first_token_seconds",
-                                         now - req.submitted_at)
+                    self.metrics.observe(
+                        "time_to_first_token_seconds",
+                        now - req.submitted_at,
+                        exemplar=(req.span.trace_id or None)
+                        if req.span is not None else None)
             if req.on_token is not None:
                 # isolate the user's callback ourselves: if the engine saw
                 # it raise it would detach this whole hook, and the
@@ -394,6 +437,14 @@ class ServingGateway:
                 break
             req.state = RequestState.ADMITTED
             req.dispatched_at = now
+            if req.phase_span is not None:
+                # queue phase ends; the decode attempt (chunked prefill
+                # included — the engine admits and prefills in-slot)
+                # begins
+                req.phase_span.finish()
+                req.phase_span = self._tracer.start(
+                    "decode", parent=req.span, attempt=req.replays,
+                    engine_rid=req.engine_rid)
             self._by_engine[req.engine_rid] = req.rid
             self._in_engine += 1
             if self.metrics is not None and not req.queue_wait_observed:
@@ -434,16 +485,26 @@ class ServingGateway:
             for req in victims:
                 if req.state not in LIVE_STATES:
                     continue
+                if req.span is not None:
+                    req.span.event("engine_crash", replays=req.replays)
                 if req.replays >= self._replay.max_replays:
                     # the crash ate this attempt's partial tokens with the
                     # engine; an empty terminal result that SAYS so beats a
                     # silent loss
                     self._finalize_locked(req, RequestState.RETRY_EXHAUSTED)
                     continue
+                if req.phase_span is not None:
+                    req.phase_span.finish(STATUS_ERROR)
                 req.reset_for_replay(
                     now, self._replay.backoff_for(req.replays + 1))
+                req.phase_span = self._tracer.start(
+                    "queue", parent=req.span, attempt=req.replays)
                 self._replay_pending.append(req)
                 replayed += 1
+        # flight recorder: persist the ring of recent spans — the context
+        # an operator needs for "what was the engine doing when it died"
+        # (covers the RETRY_EXHAUSTED finalizations above too)
+        self._tracer.crash_dump("engine_crash")
         if self.metrics is not None:
             self.metrics.inc("engine_crashes")
             if replayed:

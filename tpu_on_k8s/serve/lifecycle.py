@@ -103,6 +103,14 @@ class GatewayRequest:
     # queue-wait/TTFT (counts must stay comparable to requests_submitted)
     queue_wait_observed: bool = False
     ttft_observed: bool = False
+    # tracing (`tpu_on_k8s/obs/trace.py`): ``span`` is the request's root
+    # span — minted by this gateway, or passed in by the fleet that routed
+    # here (``span_owned`` False: the fleet finishes it); ``phase_span``
+    # is the currently open lifecycle child (queue / decode attempt).
+    # None when tracing is off — every consumer guards.
+    span: Any = None
+    span_owned: bool = True
+    phase_span: Any = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline

@@ -41,15 +41,21 @@ batch N+1 overlaps step N, completing the pipeline: disk → host queue → HBM
 from __future__ import annotations
 
 import collections
+import contextlib
 import logging
+import os
 import threading
 import time
+import warnings
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import jax
 
 from tpu_on_k8s import chaos
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.obs.trace import ensure as ensure_tracer
+from tpu_on_k8s.utils import profiling
 from tpu_on_k8s.utils.logging import get_logger, kv
 
 log = get_logger("train.loop")
@@ -124,6 +130,17 @@ class TrainLoop:
         gauges and sync/stall counters, fed at each window.
       tokens_per_step / flops_per_step / peak_flops: throughput/MFU gauge
         inputs (``flops_per_step`` from ``compile.train_step_flops``).
+      tracer: optional ``obs.Tracer`` — one ``train.window`` span per
+        host-sync window (step range, loss, step time).
+      profile_dir / profiler_port / annotate_steps: the
+        `utils/profiling.py` hooks — capture an XLA trace of the run
+        into ``profile_dir``, serve the live profiler on
+        ``profiler_port``, and wrap each dispatched step in a named
+        ``train.step`` TraceAnnotation so the XLA timeline is
+        attributable. Defaults come from the ``TPU_ON_K8S_PROFILE_DIR``
+        / ``TPU_ON_K8S_PROFILER_PORT`` env the operator's
+        ``--profile-dir``/``--profiler-port`` flags inject into slice
+        pods; unset (the default) is behavior-neutral.
     """
 
     def __init__(self, step_fn: Callable[[Any, Any], Tuple[Any, Dict]],
@@ -140,7 +157,11 @@ class TrainLoop:
                  metrics: Any = None,
                  tokens_per_step: int = 0,
                  flops_per_step: float = 0.0,
-                 peak_flops: float = 0.0):
+                 peak_flops: float = 0.0,
+                 tracer: Any = None,
+                 profile_dir: Optional[str] = None,
+                 profiler_port: Optional[int] = None,
+                 annotate_steps: Optional[bool] = None):
         if log_every < 1:
             raise ValueError(f"log_every must be >= 1, got {log_every}")
         self.step_fn = step_fn
@@ -163,6 +184,32 @@ class TrainLoop:
         self.tokens_per_step = tokens_per_step
         self.flops_per_step = flops_per_step
         self.peak_flops = peak_flops
+        # observability: one ``train.window`` span per host-sync window
+        # (`tpu_on_k8s/obs/trace.py`); the per-step XLA-timeline bridge
+        # is `utils/profiling.annotate` below, not host-side spans — a
+        # span per dispatched step would put host work on the zero-stall
+        # hot path the loop exists to keep empty
+        self._tracer = ensure_tracer(tracer)
+        self._window_span: Any = None
+        # profiling hooks (`tpu_on_k8s/utils/profiling.py`), previously
+        # dead code: the operator's ``--profile-dir``/``--profiler-port``
+        # flags inject ENV_PROFILE_DIR / ENV_PROFILER_PORT into slice
+        # pods (`controller/tpujob.py` _inject_perf_env), and the loop —
+        # the one code path every production trainer drives — reads them
+        # here, so XLA trace capture needs no per-caller plumbing.
+        if profile_dir is None:
+            profile_dir = os.environ.get(constants.ENV_PROFILE_DIR) or None
+        if profiler_port is None:
+            raw = os.environ.get(constants.ENV_PROFILER_PORT, "")
+            profiler_port = int(raw) if raw.strip().isdigit() else None
+        self.profile_dir = profile_dir
+        self.profiler_port = profiler_port or None
+        # step annotation rides along whenever a trace is captured (the
+        # named regions are what make the XLA timeline attributable);
+        # explicit True forces it for an externally-started trace
+        self.annotate_steps = (annotate_steps if annotate_steps is not None
+                               else profile_dir is not None)
+        self._profiler_started = False
 
         self._should_stop = False
         self._running = False
@@ -205,6 +252,48 @@ class TrainLoop:
             if self.on_stall is not None:
                 self.on_stall(event)
 
+    # ----------------------------------------------------------- profiling
+    @contextlib.contextmanager
+    def _profiling_session(self):
+        """Activate the `utils/profiling.py` hooks for one ``run``: the
+        live profiler server (bound once per loop, ever) and XLA trace
+        capture into ``profile_dir``. Either hook failing degrades to a
+        warning — profiling must never take down training — and with
+        neither configured this is a pass-through."""
+        if self.profiler_port is not None and not self._profiler_started:
+            self._profiler_started = True
+            try:
+                profiling.start_server(self.profiler_port)
+            except Exception as e:  # noqa: BLE001 — port taken, no backend
+                warnings.warn(f"profiler server on :{self.profiler_port} "
+                              f"unavailable: {e}")
+        if self.profile_dir is None:
+            yield
+            return
+        capture = contextlib.ExitStack()
+        try:
+            capture.enter_context(profiling.trace(self.profile_dir))
+        except Exception as e:  # noqa: BLE001
+            warnings.warn(f"XLA trace capture into {self.profile_dir} "
+                          f"unavailable: {e}")
+        try:
+            yield
+        finally:
+            # the trace WRITES at stop — a full disk here must not eat a
+            # run whose every training step succeeded
+            try:
+                capture.close()
+            except Exception as e:  # noqa: BLE001
+                warnings.warn(f"XLA trace capture into {self.profile_dir} "
+                              f"failed to finalize: {e}")
+
+    def _annotate_step(self):
+        """Per-dispatch XLA-timeline region (``train.step``): the bridge
+        that makes a captured trace attributable to loop steps. A plain
+        nullcontext when annotation is off — nothing on the hot path."""
+        return (profiling.annotate("train.step") if self.annotate_steps
+                else contextlib.nullcontext())
+
     # ----------------------------------------------------------------- run
     def run(self, steps: int) -> LoopResult:
         """Drive ``steps`` training steps; returns the :class:`LoopResult`.
@@ -217,7 +306,9 @@ class TrainLoop:
         self._touch()
         t0 = time.perf_counter()
         t_window = t0
+        hooks = contextlib.ExitStack()
         try:
+            hooks.enter_context(self._profiling_session())
             for i in range(1, steps + 1):
                 # the chaos site is a second preemption source: a scheduled
                 # PreemptNotice lands exactly like a SIGTERM-handler flag
@@ -234,7 +325,16 @@ class TrainLoop:
                 step_fault = chaos.fire(chaos.SITE_TRAIN_STEP, step=i)
                 if step_fault is not None:
                     raise step_fault.to_exception()
-                self.state, step_metrics = self.step_fn(self.state, batch)
+                if self._window_span is None:
+                    # one span per host-sync window, closed by
+                    # _sync_window — per-step host spans would put work
+                    # on the zero-stall path; the XLA timeline carries
+                    # the per-step story via _annotate_step
+                    self._window_span = self._tracer.start(
+                        "train.window", start_step=i)
+                with self._annotate_step():
+                    self.state, step_metrics = self.step_fn(self.state,
+                                                            batch)
                 pending.append(step_metrics)
                 self._dispatched = result.steps = i
                 self._inflight = len(pending)
@@ -290,6 +390,13 @@ class TrainLoop:
                     if self.metrics is not None:
                         self.metrics.inc("checkpoint_failures")
         finally:
+            hooks.close()
+            if self._window_span is not None:
+                # an aborted run (chaos StepFailure, preemption between
+                # dispatch and sync) leaves a window open — close it so
+                # the dump shows where training stopped
+                self._window_span.finish("aborted")
+                self._window_span = None
             self._running = False
             if self._watchdog is not None:
                 self._watchdog_stop.set()
@@ -330,6 +437,14 @@ class TrainLoop:
         kv(log, logging.INFO, "train_window", step=step,
            loss=(round(loss, 4) if isinstance(loss, float) else loss),
            step_ms=round(step_seconds * 1e3, 1))
+        if self._window_span is not None:
+            self._window_span.set(
+                step=step, steps=window_steps,
+                step_seconds=round(step_seconds, 6),
+                **({"loss": round(loss, 6)}
+                   if isinstance(loss, float) else {}))
+            self._window_span.finish()
+            self._window_span = None
         if self.metrics is not None:
             m = self.metrics
             m.inc("host_syncs")
