@@ -6,9 +6,9 @@
 # docker-build produces.
 IMG ?= tpu-on-k8s/manager:latest
 
-.PHONY: test test-fast chaos-soak fleet-soak autoscale-soak disagg-soak \
-        trace-demo native bench dryrun manager samples clean docker-build \
-        docker-push deploy undeploy
+.PHONY: test test-fast analyze lint chaos-soak fleet-soak autoscale-soak \
+        disagg-soak trace-demo native bench dryrun manager samples clean \
+        docker-build docker-push deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
 # CHAOS_SOAK_FAILED seed=... on any failure
@@ -21,11 +21,21 @@ TRACE_FLAGS = --disagg --n-requests 24 --prefix-bucket 8 --prompt-min 4 \
     --prompt-max 12 --new-min 4 --new-max 8 --decode-replicas 2 \
     --shared-prefixes 2 --shared-fraction 0.8 --seed $(TRACE_SEED)
 
-test:
+test: analyze lint  ## invariant gate + lint first — they fail in seconds
 	python -m pytest tests/ -q
 
 test-fast:  ## skip the slow sharded-compile suites
 	python -m pytest tests/ -q -k "not decode and not ring and not moe"
+
+analyze:  ## the five invariant passes (docs/static-analysis.md); exit 0 iff clean
+	python -m tools.analyze
+
+lint:  ## ruff over production+tools (real-bug rules only, [tool.ruff] in pyproject.toml); skipped when ruff is not installed
+	@if command -v ruff >/dev/null 2>&1; then \
+	    ruff check tpu_on_k8s tools tests; \
+	else \
+	    echo "lint: ruff not installed — skipping (the tools/analyze gate still ran)"; \
+	fi
 
 chaos-soak:  ## the end-to-end failure-recovery scenario suite, twice, logs compared
 	JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed $(CHAOS_SEED) --repeat 2

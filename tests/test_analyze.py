@@ -1,0 +1,693 @@
+"""The invariant analyzer suite (`tools/analyze`) — tier-1 gate tests.
+
+Three layers:
+
+1. **Pass self-tests** — known-bad / known-good fixture snippets per
+   pass, including the five seeded synthetic violations the acceptance
+   criteria name (wall-clock in a hot path, dump under a lock, swallowed
+   exception, unraised ``SITE_*``, unobserved metric family).
+2. **Mechanism tests** — suppression-comment round-trips, baseline
+   add / justify / expire, fingerprint line-stability.
+3. **The repo gate** — every pass over the real tree with zero
+   unsuppressed findings: the check that makes the invariants permanent.
+"""
+import json
+import os
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                "..")))
+
+from tools.analyze import run_passes  # noqa: E402
+from tools.analyze.core import (BaselineEntry, Finding, RepoIndex,  # noqa: E402
+                                check, fix_baseline, load_baseline,
+                                save_baseline)
+from tools.analyze.passes import (chaoscov, determinism, locks,  # noqa: E402
+                                  metricsschema, silentloss)
+
+
+# --------------------------------------------------------------------------
+# fixture scaffolding
+# --------------------------------------------------------------------------
+def make_repo(tmp_path, files):
+    """A throwaway production tree: {relpath: source} -> RepoIndex."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    (tmp_path / "tests").mkdir(exist_ok=True)
+    return RepoIndex(root=tmp_path)
+
+
+def fingerprints(findings):
+    return {f.fingerprint for f in findings}
+
+
+def codes(findings):
+    return {f.code for f in findings}
+
+
+# --------------------------------------------------------------------------
+# determinism pass
+# --------------------------------------------------------------------------
+class TestDeterminismPass:
+    def test_flags_wall_clock_in_hot_path(self, tmp_path):
+        # the seeded synthetic violation: a decode-loop timestamp
+        repo = make_repo(tmp_path, {"tpu_on_k8s/engine.py": """
+            import time
+
+            class Engine:
+                def step(self):
+                    t0 = time.monotonic()
+                    return t0
+        """})
+        found = determinism.run(repo)
+        assert "wall-clock:time.monotonic" in codes(found)
+        assert found[0].qualname == "Engine.step"
+
+    def test_flags_every_wall_clock_variant(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import time
+            from datetime import datetime
+
+            def f():
+                return time.time(), time.perf_counter(), datetime.now()
+        """})
+        assert codes(determinism.run(repo)) == {
+            "wall-clock:time.time", "wall-clock:time.perf_counter",
+            "wall-clock:datetime.now"}
+
+    def test_flags_ambient_entropy(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import random
+            import uuid
+
+            def f():
+                random.shuffle([1])
+                unseeded = random.Random()
+                return uuid.uuid4()
+        """})
+        got = codes(determinism.run(repo))
+        assert {"entropy:random.shuffle", "entropy:random.Random()",
+                "entropy:uuid.uuid4"} == got
+
+    def test_flags_np_random_global_draws_including_random(self, tmp_path):
+        """`np.random.random()` must flag like rand/randint — the leaf
+        name colliding with the submodule name is not an exemption."""
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import numpy as np
+
+            def f():
+                a = np.random.random(3)
+                b = np.random.rand(3)
+                c = np.random.randint(0, 5)
+                ok = np.random.default_rng(0)
+                return a, b, c, ok
+        """})
+        got = codes(determinism.run(repo))
+        assert got == {"entropy:np.random.random", "entropy:np.random.rand",
+                       "entropy:np.random.randint"}
+
+    def test_seeded_rng_and_injected_clock_are_clean(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import random
+            import time
+
+            class Engine:
+                def __init__(self, clock=time.monotonic, seed=0):
+                    self._clock = clock          # reference, not a call
+                    self._rng = random.Random(seed)
+
+                def step(self):
+                    return self._clock(), self._rng.random()
+        """})
+        assert determinism.run(repo) == []
+
+    def test_flags_unsorted_listing_and_set_iteration(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import os
+
+            def f(xs):
+                for name in os.listdir("."):
+                    pass
+                for x in set(xs):
+                    pass
+        """})
+        assert codes(determinism.run(repo)) == {"order:os.listdir",
+                                                "order:set-iteration"}
+
+    def test_sorted_wrapping_is_clean(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import os
+
+            def f(xs):
+                for name in sorted(os.listdir(".")):
+                    pass
+                for x in sorted(set(xs)):
+                    pass
+        """})
+        assert determinism.run(repo) == []
+
+
+# --------------------------------------------------------------------------
+# lock-discipline pass
+# --------------------------------------------------------------------------
+class TestLockDisciplinePass:
+    def test_flags_dump_under_lock(self, tmp_path):
+        # the seeded synthetic violation: recorder dump inside _lock —
+        # the exact shape PR 7's _deferred_dumps fixed by hand
+        repo = make_repo(tmp_path, {"tpu_on_k8s/fleet.py": """
+            class Fleet:
+                def step(self):
+                    with self._lock:
+                        self._recorder.dump("crash")
+        """})
+        found = locks.run(repo)
+        assert codes(found) == {"io-under-lock:.dump"}
+        assert found[0].qualname == "Fleet.step"
+
+    def test_flags_io_callback_sleep_and_injector(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import time
+            from tpu_on_k8s import chaos
+
+            class C:
+                def f(self, on_token):
+                    with self._lock:
+                        open("/tmp/x", "w")
+                        time.sleep(1)
+                        on_token(1, 2)
+                        chaos.fire("site")
+        """})
+        got = codes(locks.run(repo))
+        assert got == {"io-under-lock:open", "sleep-under-lock:time.sleep",
+                       "callback-under-lock:on_token",
+                       "chaos-under-lock:.fire"}
+
+    def test_deferred_work_pattern_is_clean(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            class C:
+                def f(self, on_token):
+                    with self._lock:
+                        pending = list(self._deferred_dumps)
+                        self._deferred_dumps.clear()
+                    for reason in pending:
+                        self._recorder.dump(reason)   # outside the region
+                    on_token(1, 2)
+        """})
+        assert locks.run(repo) == []
+
+    def test_nested_def_bodies_are_deferred_execution(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            class C:
+                def f(self):
+                    with self._lock:
+                        def later():
+                            open("/tmp/x", "w")
+                        self._todo = later
+        """})
+        assert locks.run(repo) == []
+
+    def test_nested_with_still_holds_outer_lock(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            class C:
+                def f(self, ctx):
+                    with self._lock:
+                        with ctx:
+                            open("/tmp/x", "w")
+        """})
+        assert codes(locks.run(repo)) == {"io-under-lock:open"}
+
+
+# --------------------------------------------------------------------------
+# silent-loss pass
+# --------------------------------------------------------------------------
+class TestSilentLossPass:
+    def test_flags_swallowed_exception(self, tmp_path):
+        # the seeded synthetic violation
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """})
+        found = silentloss.run(repo)
+        assert len(found) == 1 and found[0].code == "swallow"
+
+    def test_log_only_handler_still_flags(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            def f(log):
+                try:
+                    work()
+                except Exception as e:
+                    log.error("boom %s", e)
+        """})
+        assert len(silentloss.run(repo)) == 1
+
+    def test_reraise_typed_return_and_counter_are_clean(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            def a():
+                try:
+                    work()
+                except Exception:
+                    raise TypedError()
+
+            def b():
+                try:
+                    work()
+                except Exception as e:
+                    return Failure(e)
+
+            def c(self):
+                try:
+                    work()
+                except Exception:
+                    self.metrics.inc("errors")
+        """})
+        assert silentloss.run(repo) == []
+
+    def test_narrow_handlers_never_flag(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            def f():
+                try:
+                    work()
+                except (ValueError, KeyError):
+                    pass
+        """})
+        assert silentloss.run(repo) == []
+
+    def test_two_swallows_in_one_scope_get_distinct_fingerprints(
+            self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            def f():
+                try:
+                    a()
+                except Exception:
+                    pass
+                try:
+                    b()
+                except Exception:
+                    pass
+        """})
+        found = silentloss.run(repo)
+        assert len(fingerprints(found)) == 2
+
+
+# --------------------------------------------------------------------------
+# chaos-coverage pass
+# --------------------------------------------------------------------------
+_FAULTS_FIXTURE = """
+    import dataclasses
+    from typing import ClassVar
+
+    SITE_A = "a.site"
+    {extra_const}
+
+    @dataclasses.dataclass(frozen=True)
+    class Fault:
+        kind: ClassVar[str] = "fault"
+
+    @dataclasses.dataclass(frozen=True)
+    class Boom(Fault):
+        kind: ClassVar[str] = "boom"
+
+    SITE_REGISTRY = {{
+        SITE_A: ("`prod.py` hot path", ("Boom",), "recovers"),
+        {extra_row}
+    }}
+"""
+
+
+def chaos_fixture(tmp_path, *, extra_const="", extra_row="",
+                  fire_site="SITE_A", test_ref="SITE_A", doc=None):
+    files = {
+        "tpu_on_k8s/chaos/faults.py": _FAULTS_FIXTURE.format(
+            extra_const=extra_const, extra_row=extra_row),
+        "tpu_on_k8s/prod.py": f"""
+            from tpu_on_k8s.chaos import faults
+
+            def f():
+                return faults.{fire_site}
+        """,
+    }
+    repo = make_repo(tmp_path, files)
+    (tmp_path / "tests" / "test_x.py").write_text(
+        f"from tpu_on_k8s.chaos.faults import {test_ref}\n")
+    if doc is None:
+        doc = ("# resilience\n\n"
+               + chaoscov.render_site_table(repo) + "\nrest of doc\n")
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "resilience.md").write_text(doc)
+    return RepoIndex(root=tmp_path)
+
+
+class TestChaosCoveragePass:
+    def test_complete_site_is_clean(self, tmp_path):
+        repo = chaos_fixture(tmp_path)
+        assert chaoscov.run(repo) == []
+
+    def test_unraised_site_flags(self, tmp_path):
+        # the seeded synthetic violation: a SITE_* constant no production
+        # code ever fires (and no test exercises)
+        repo = chaos_fixture(
+            tmp_path, extra_const='SITE_DEAD = "dead.site"',
+            extra_row='SITE_DEAD: ("`nowhere`", ("Boom",), "n/a"),')
+        got = codes(chaoscov.run(repo))
+        assert "never-fired:dead.site" in got
+        assert "never-exercised:dead.site" in got
+
+    def test_unregistered_site_flags(self, tmp_path):
+        repo = chaos_fixture(tmp_path,
+                             extra_const='SITE_B = "b.site"',
+                             fire_site="SITE_B", test_ref="SITE_B")
+        assert "unregistered:b.site" in codes(chaoscov.run(repo))
+
+    def test_unknown_fault_name_flags(self, tmp_path):
+        repo = chaos_fixture(
+            tmp_path, extra_const='SITE_B = "b.site"',
+            extra_row='SITE_B: ("`x`", ("NoSuchFault",), "n/a"),',
+            fire_site="SITE_B", test_ref="SITE_B")
+        assert ("registry-unknown-fault:b.site:NoSuchFault"
+                in codes(chaoscov.run(repo)))
+
+    def test_stale_doc_table_flags(self, tmp_path):
+        repo = chaos_fixture(tmp_path)
+        doc_path = tmp_path / "docs" / "resilience.md"
+        doc_path.write_text(doc_path.read_text().replace(
+            "recovers", "hand-edited lie"))
+        assert "doc-table-stale" in codes(chaoscov.run(repo))
+
+    def test_write_site_table_heals_the_doc(self, tmp_path):
+        repo = chaos_fixture(tmp_path)
+        doc_path = tmp_path / "docs" / "resilience.md"
+        doc_path.write_text(doc_path.read_text().replace(
+            "recovers", "hand-edited lie"))
+        assert chaoscov.write_site_table(repo) is True
+        assert chaoscov.run(RepoIndex(root=tmp_path)) == []
+
+
+# --------------------------------------------------------------------------
+# metrics-schema pass
+# --------------------------------------------------------------------------
+_METRICS_FIXTURE = """
+    class _Family:
+        def __init__(self, full, kind, labels, help, buckets=None):
+            self.full, self.kind, self.labels = full, kind, labels
+            self.help, self.buckets = help, buckets
+
+    class _MetricsBase:
+        def __init__(self):
+            self._families = {{}}
+
+        def _declare(self, name, full, kind, help, labels=(),
+                     buckets=None):
+            self._families[name] = _Family(full, kind, tuple(labels),
+                                           help, buckets)
+
+        def inc(self, name, n=1):
+            pass
+
+    class M(_MetricsBase):
+        def __init__(self):
+            super().__init__()
+            {declares}
+
+    def render_text(metrics):
+        return ""
+
+    def exposition(metrics):
+        return ""
+"""
+
+
+def metrics_fixture(tmp_path, declares, prod="self.metrics.inc('used')"):
+    return make_repo(tmp_path, {
+        "tpu_on_k8s/metrics/metrics.py": _METRICS_FIXTURE.format(
+            declares=declares),
+        "tpu_on_k8s/prod.py": f"""
+            class P:
+                def f(self):
+                    {prod}
+        """,
+    })
+
+
+class TestMetricsSchemaPass:
+    def test_observed_family_is_clean(self, tmp_path):
+        repo = metrics_fixture(
+            tmp_path, "self._declare('used', 'ns_used', 'counter', 'h')")
+        assert metricsschema.run(repo) == []
+
+    def test_unobserved_family_flags(self, tmp_path):
+        # the seeded synthetic violation: declared, rendered on every
+        # scrape, observed nowhere
+        repo = metrics_fixture(
+            tmp_path,
+            "self._declare('used', 'ns_used', 'counter', 'h')\n"
+            "            self._declare('dead', 'ns_dead', 'counter', 'h')")
+        assert "unobserved-family:dead" in codes(metricsschema.run(repo))
+
+    def test_undeclared_observation_flags(self, tmp_path):
+        repo = metrics_fixture(
+            tmp_path, "self._declare('used', 'ns_used', 'counter', 'h')",
+            prod="self.metrics.inc('used'); self.metrics.inc('ghost')")
+        found = metricsschema.run(repo)
+        assert "undeclared-metric:ghost" in codes(found)
+
+    def test_fstring_observation_matches_family(self, tmp_path):
+        repo = metrics_fixture(
+            tmp_path,
+            "self._declare('rejected_quota', 'ns_rq', 'counter', 'h')",
+            prod="self.metrics.inc(f'rejected_{reason}')")
+        assert metricsschema.run(repo) == []
+
+    def test_histogram_without_buckets_flags(self, tmp_path):
+        repo = metrics_fixture(
+            tmp_path, "self._declare('used', 'ns_used', 'histogram', 'h')")
+        assert ("histogram-no-buckets:used"
+                in codes(metricsschema.run(repo)))
+
+
+# --------------------------------------------------------------------------
+# suppression comments
+# --------------------------------------------------------------------------
+class TestSuppressions:
+    def test_allow_comment_with_justification_suppresses(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import time
+
+            def f():
+                # analyze: allow[determinism] hardware deadline — wall time is the point
+                return time.monotonic()
+        """})
+        findings = run_passes(repo, only=["determinism"])
+        result = check(findings, repo, [])
+        assert result.ok
+        assert len(result.inline) == 1
+        assert "hardware deadline" in result.inline[0][1]
+
+    def test_same_line_allow_works(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import time
+
+            def f():
+                return time.monotonic()  # analyze: allow[determinism] why not
+        """})
+        assert check(run_passes(repo, only=["determinism"]), repo, []).ok
+
+    def test_blank_justification_is_its_own_finding(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import time
+
+            def f():
+                # analyze: allow[determinism]
+                return time.monotonic()
+        """})
+        result = check(run_passes(repo, only=["determinism"]), repo, [])
+        assert not result.ok
+        assert len(result.new) == 1          # the allow didn't match
+        assert len(result.blank_allows) == 1  # and is reported itself
+
+    def test_inline_allow_does_not_strand_a_baseline_entry(self, tmp_path):
+        """A justified baseline entry whose finding is ALSO inline-allowed
+        is redundant but matched — it must not read as stale and fail the
+        gate (--fix-baseline is the explicit way to drop it)."""
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import time
+
+            def f():
+                return time.monotonic()  # analyze: allow[determinism] hw wait
+        """})
+        findings = run_passes(repo, only=["determinism"])
+        entry = BaselineEntry(
+            "determinism:tpu_on_k8s/m.py:f:wall-clock:time.monotonic",
+            "hardware wait")
+        result = check(findings, repo, [entry])
+        assert result.ok and result.stale == []
+        assert len(result.inline) == 1
+
+    def test_blank_allow_outside_pass_subset_is_out_of_scope(self, tmp_path):
+        """`--pass determinism` must not condemn a blank silent-loss
+        allow-comment — that pass did not run."""
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            def f():
+                try:
+                    work()
+                # analyze: allow[silent-loss]
+                except Exception:
+                    pass
+        """})
+        findings = run_passes(repo, only=["determinism"])
+        assert check(findings, repo, [], passes=["determinism"]).ok
+        result = check(run_passes(repo, only=["silent-loss"]), repo, [],
+                       passes=["silent-loss"])
+        assert not result.ok and len(result.blank_allows) == 1
+
+    def test_wrong_pass_id_does_not_suppress(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import time
+
+            def f():
+                # analyze: allow[silent-loss] wrong pass entirely
+                return time.monotonic()
+        """})
+        result = check(run_passes(repo, only=["determinism"]), repo, [])
+        assert len(result.new) == 1
+
+
+# --------------------------------------------------------------------------
+# baseline add / justify / expire
+# --------------------------------------------------------------------------
+_BASELINE_SRC = {"tpu_on_k8s/m.py": """
+    import time
+
+    def f():
+        return time.monotonic()
+"""}
+
+
+class TestBaseline:
+    def test_add_then_justify_round_trip(self, tmp_path):
+        repo = make_repo(tmp_path, _BASELINE_SRC)
+        findings = run_passes(repo, only=["determinism"])
+        assert not check(findings, repo, []).ok
+
+        # --fix-baseline adds a TODO entry ...
+        entries = fix_baseline(findings, repo, [])
+        assert len(entries) == 1
+        assert entries[0].justification == "TODO: justify"
+        # ... which the checker itself rejects until a human justifies
+        result = check(findings, repo, entries)
+        assert not result.ok and len(result.unjustified) == 1
+
+        entries[0].justification = "hardware wait — wall time is the point"
+        result = check(findings, repo, entries)
+        assert result.ok and len(result.baselined) == 1
+
+    def test_stale_entry_fails_and_fix_expires_it(self, tmp_path):
+        repo = make_repo(tmp_path, _BASELINE_SRC)
+        findings = run_passes(repo, only=["determinism"])
+        entries = fix_baseline(findings, repo, [])
+        entries[0].justification = "justified"
+
+        # the violation gets FIXED: the entry goes stale and fails the run
+        (tmp_path / "tpu_on_k8s" / "m.py").write_text(
+            "def f(clock):\n    return clock()\n")
+        repo2 = RepoIndex(root=tmp_path)
+        findings2 = run_passes(repo2, only=["determinism"])
+        result = check(findings2, repo2, entries)
+        assert not result.ok and len(result.stale) == 1
+
+        # --fix-baseline expires it
+        assert fix_baseline(findings2, repo2, entries) == []
+
+    def test_fix_baseline_keeps_existing_justifications(self, tmp_path):
+        repo = make_repo(tmp_path, _BASELINE_SRC)
+        findings = run_passes(repo, only=["determinism"])
+        entries = fix_baseline(findings, repo, [])
+        entries[0].justification = "the original why"
+        again = fix_baseline(findings, repo, entries)
+        assert again[0].justification == "the original why"
+
+    def test_pass_subset_does_not_condemn_other_entries(self, tmp_path):
+        """`--pass lock-discipline` must not mark determinism baseline
+        entries stale (and --fix-baseline must carry them through)."""
+        repo = make_repo(tmp_path, _BASELINE_SRC)
+        findings = run_passes(repo, only=["determinism"])
+        entries = fix_baseline(findings, repo, [])
+        entries[0].justification = "justified"
+
+        lock_only = run_passes(repo, only=["lock-discipline"])
+        result = check(lock_only, repo, entries,
+                       passes=["lock-discipline"])
+        assert result.ok and result.stale == []
+        kept = fix_baseline(lock_only, repo, entries,
+                            passes=["lock-discipline"])
+        assert kept == entries
+
+    def test_save_load_round_trip(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        save_baseline([BaselineEntry("a:b:c:d", "why")], path)
+        assert json.loads(path.read_text())["version"] == 1
+        loaded = load_baseline(path)
+        assert loaded == [BaselineEntry("a:b:c:d", "why")]
+
+    def test_fingerprint_is_line_stable(self, tmp_path):
+        repo = make_repo(tmp_path, _BASELINE_SRC)
+        fp1 = fingerprints(run_passes(repo, only=["determinism"]))
+        src = (tmp_path / "tpu_on_k8s" / "m.py").read_text()
+        (tmp_path / "tpu_on_k8s" / "m.py").write_text(
+            "# a new leading comment shifts every line\n" + src)
+        repo2 = RepoIndex(root=tmp_path)
+        fp2 = fingerprints(run_passes(repo2, only=["determinism"]))
+        assert fp1 == fp2
+
+
+# --------------------------------------------------------------------------
+# the repo gate: the whole production tree is clean
+# --------------------------------------------------------------------------
+def test_repo_has_zero_unsuppressed_findings():
+    """`make analyze` semantics in-process: all five passes over the real
+    tree reconcile to zero new findings, zero stale baseline entries,
+    zero unjustified suppressions. THE permanent gate."""
+    repo = RepoIndex()
+    findings = run_passes(repo)
+    result = check(findings, repo, load_baseline())
+    msg = "\n".join(f.render() for f in result.new)
+    assert result.ok, (
+        f"analyzer gate broken:\n{msg}\n"
+        f"stale={[e.fingerprint for e in result.stale]} "
+        f"unjustified={[e.fingerprint for e in result.unjustified]}")
+
+
+def test_disagg_injector_fires_outside_fleet_lock():
+    """Regression for the lock-discipline fix this suite shipped with:
+    `chaos.fire(SITE_KV_HANDOFF)` in DisaggFleet._advance_prefills used
+    to run inside the fleet lock — an injected fault's bookkeeping (or a
+    raising trigger) executed with every frontend thread blocked."""
+    repo = RepoIndex()
+    offenders = [f for f in locks.run(repo)
+                 if f.path == "tpu_on_k8s/serve/disagg.py"]
+    assert offenders == [], "\n".join(f.render() for f in offenders)
+
+
+def test_every_baseline_entry_is_justified():
+    for e in load_baseline():
+        assert e.justification and e.justification != "TODO: justify", (
+            f"baseline entry lacks a justification: {e.fingerprint}")
+
+
+def test_cli_emit_site_table_matches_doc(capsys):
+    from tools.analyze.__main__ import main
+    assert main(["--emit-site-table"]) == 0
+    out = capsys.readouterr().out
+    doc = RepoIndex().read(chaoscov.DOC_REL)
+    assert out.strip() in doc
+
+
+def test_cli_clean_run_exits_zero(capsys):
+    from tools.analyze.__main__ import main
+    assert main([]) == 0
+    assert "clean" in capsys.readouterr().out

@@ -147,6 +147,35 @@ def test_apiserver_side_injection(rest_pair):
         assert rest.list(TPUJob) == []
 
 
+def test_apiserver_watch_drop_resumes(rest_pair):
+    """Server-side stream drop (`SITE_APISERVER_WATCH`): the apiserver
+    breaks the chunked watch stream after a delivered event; the client
+    must redial and resume from its last delivered revision — every
+    object still arrives (duplicates allowed: level-triggered consumers
+    treat them as no-ops)."""
+    from tpu_on_k8s.api.core import Container, ObjectMeta, Pod, PodSpec
+
+    _, rest = rest_pair
+    seen = []
+    rest.watch(lambda ev: seen.append(ev.obj.metadata.name), kinds=["Pod"])
+
+    def mk(i):
+        return Pod(metadata=ObjectMeta(name=f"w{i}"),
+                   spec=PodSpec(containers=[Container(name="c", image="i")]))
+
+    inj = chaos.FaultInjector([chaos.FaultRule(
+        chaos.SITE_APISERVER_WATCH, chaos.on_call(1), chaos.WatchDrop())])
+    with inj:
+        for i in range(3):
+            rest.create(mk(i))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and (
+                inj.fired_total() < 1 or len(set(seen)) < 3):
+            time.sleep(0.05)
+    assert inj.fired_total() >= 1, inj.counts()
+    assert {f"w{i}" for i in range(3)} <= set(seen)
+
+
 def test_watch_drop_reconnects_and_delivers(rest_pair):
     from tpu_on_k8s.api.core import Container, ObjectMeta, Pod, PodSpec
 

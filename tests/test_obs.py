@@ -613,18 +613,25 @@ class TestExposition:
 # --------------------------------------------------------------------------
 # docs stay honest
 # --------------------------------------------------------------------------
-def test_every_chaos_site_in_resilience_site_table():
-    from tpu_on_k8s.chaos import faults
-
-    doc = open(os.path.join(os.path.dirname(__file__), "..", "docs",
-                            "resilience.md")).read()
-    sites = {v for k, v in vars(faults).items()
-             if k.startswith("SITE_") and isinstance(v, str)}
-    assert sites, "no SITE_* constants found"
-    missing = {s for s in sites if f"`{s}`" not in doc}
-    assert not missing, (
-        f"chaos sites missing from docs/resilience.md site table: "
-        f"{sorted(missing)}")
+def test_resilience_site_table_matches_generated():
+    """The chaos-site table in docs/resilience.md is GENERATED from
+    `chaos.faults.SITE_REGISTRY` — the shipped chaos-coverage analyzer
+    pass byte-compares doc against render (superseding the old substring
+    check); this runs exactly that pass so the two can never drift."""
+    import sys
+    repo_root = os.path.join(os.path.dirname(__file__), "..")
+    sys.path.insert(0, os.path.abspath(repo_root))
+    try:
+        from tools.analyze.core import RepoIndex
+        from tools.analyze.passes import chaoscov
+    finally:
+        sys.path.pop(0)
+    doc_findings = [f for f in chaoscov.run(RepoIndex())
+                    if f.path == chaoscov.DOC_REL]
+    assert doc_findings == [], (
+        "docs/resilience.md site table is stale — run "
+        "`python -m tools.analyze --write-site-table`:\n"
+        + "\n".join(f.render() for f in doc_findings))
 
 
 def test_observability_doc_exists_and_covers_span_taxonomy():

@@ -1,0 +1,53 @@
+"""Repo-native invariant analyzers — the tier-1 static-analysis gate.
+
+Five passes over the production tree (``tpu_on_k8s/``), each enforcing
+an invariant the replay/zero-loss proofs depend on:
+
+=================  =====================================================
+pass id            invariant
+=================  =====================================================
+determinism        time flows through injectable clocks, randomness
+                   through seeded RNGs, iteration order is pinned
+lock-discipline    no I/O, dumps, callbacks, sleeps, or chaos-injector
+                   fire points inside ``self._lock`` regions
+silent-loss        broad ``except Exception`` handlers re-raise, return
+                   a typed error, or touch a metrics counter
+chaos-coverage     every ``SITE_*`` fault site is registered, fired,
+                   exercised by a scenario/test, and documented by the
+                   generated `docs/resilience.md` table
+metrics-schema     every declared metric family is observed somewhere
+                   and renders under both exposition backends
+=================  =====================================================
+
+Run ``python -m tools.analyze`` (or ``make analyze``). Accepted findings
+live in ``tools/analyze/baseline.json`` — every entry justified, stale
+entries fail the gate. See `docs/static-analysis.md`.
+"""
+from __future__ import annotations
+
+from tools.analyze.core import (Finding, RepoIndex, check, fix_baseline,
+                                load_baseline, save_baseline)
+from tools.analyze.passes import PASSES
+
+__all__ = ["Finding", "RepoIndex", "PASSES", "check", "fix_baseline",
+           "load_baseline", "save_baseline", "run_passes"]
+
+
+def run_passes(repo: RepoIndex, only=None):
+    """All findings from the selected passes (default: all), in stable
+    (pass, path, line) order, deduplicated — nested lock regions can
+    surface one call twice."""
+    findings = []
+    for pass_id, run in PASSES.items():
+        if only and pass_id not in only:
+            continue
+        findings.extend(run(repo))
+    seen = set()
+    out = []
+    for f in sorted(findings, key=lambda f: (f.pass_id, f.path, f.line,
+                                             f.code)):
+        key = (f.fingerprint, f.line)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out

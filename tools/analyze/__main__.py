@@ -1,0 +1,105 @@
+"""CLI for the invariant analyzer suite.
+
+Usage::
+
+    python -m tools.analyze                  # run all passes, check baseline
+    python -m tools.analyze --pass determinism --pass silent-loss
+    python -m tools.analyze --fix-baseline   # accept current findings (TODO
+                                             # justifications — fill them in)
+    python -m tools.analyze --emit-site-table   # print the generated
+                                                # resilience.md chaos table
+    python -m tools.analyze --write-site-table  # splice it into the doc
+    python -m tools.analyze -v               # also list suppressed findings
+
+Exit code 0 iff there are no unsuppressed findings, no stale baseline
+entries, and no unjustified suppressions.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.analyze import (PASSES, RepoIndex, check, fix_baseline,
+                           load_baseline, run_passes, save_baseline)
+from tools.analyze.core import BASELINE_PATH
+from tools.analyze.passes import chaoscov
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.analyze",
+                                 description=__doc__)
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=sorted(PASSES), metavar="PASS",
+                    help="run only this pass (repeatable); default: all")
+    ap.add_argument("--baseline", type=Path, default=BASELINE_PATH,
+                    help="baseline file (default: tools/analyze/"
+                         "baseline.json)")
+    ap.add_argument("--fix-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings: "
+                         "keep matched justifications, add new entries as "
+                         "TODO, expire stale ones")
+    ap.add_argument("--emit-site-table", action="store_true",
+                    help="print the generated chaos-site table and exit")
+    ap.add_argument("--write-site-table", action="store_true",
+                    help="splice the generated chaos-site table into "
+                         "docs/resilience.md and exit")
+    ap.add_argument("--root", type=Path, default=None,
+                    help="repo root to analyze (default: this repo)")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also list baselined/inline-suppressed findings")
+    args = ap.parse_args(argv)
+
+    repo = RepoIndex(args.root) if args.root else RepoIndex()
+    if args.emit_site_table:
+        sys.stdout.write(chaoscov.render_site_table(repo))
+        return 0
+    if args.write_site_table:
+        changed = chaoscov.write_site_table(repo)
+        print("site table " + ("updated" if changed else "already current"))
+        return 0
+
+    findings = run_passes(repo, only=args.passes)
+    baseline = load_baseline(args.baseline)
+    if args.fix_baseline:
+        entries = fix_baseline(findings, repo, baseline,
+                               passes=args.passes or list(PASSES))
+        save_baseline(entries, args.baseline)
+        todo = sum(1 for e in entries if e.justification == "TODO: justify")
+        print(f"baseline rewritten: {len(entries)} entries "
+              f"({todo} needing justification)")
+        return 0
+
+    result = check(findings, repo, baseline,
+                   passes=args.passes or list(PASSES))
+    if args.verbose:
+        for f, why in result.inline:
+            print(f"allowed  {f.path}:{f.line} [{f.pass_id}] {f.code} — "
+                  f"{why}")
+        for f, why in result.baselined:
+            print(f"baseline {f.path}:{f.line} [{f.pass_id}] {f.code} — "
+                  f"{why}")
+    for f in result.new:
+        print(f.render())
+    for f in result.blank_allows:
+        print(f.render())
+    for e in result.unjustified:
+        print(f"baseline entry needs a real justification "
+              f"(currently {e.justification!r}):\n    {e.fingerprint}")
+    for e in result.stale:
+        print(f"stale baseline entry (matches no current finding — "
+              f"run --fix-baseline to expire):\n    {e.fingerprint}")
+    n_suppressed = len(result.inline) + len(result.baselined)
+    if result.ok:
+        print(f"analyze: clean — {len(PASSES) if not args.passes else len(args.passes)} "
+              f"pass(es), {n_suppressed} suppressed finding(s), 0 new")
+        return 0
+    print(f"analyze: FAILED — {len(result.new)} new, {len(result.stale)} "
+          f"stale, {len(result.unjustified)} unjustified, "
+          f"{len(result.blank_allows)} blank allow(s) "
+          f"({n_suppressed} suppressed)")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

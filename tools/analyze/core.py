@@ -1,0 +1,318 @@
+"""Shared substrate for the invariant analyzers (`tools/analyze`).
+
+The suite's contract, shared by every pass:
+
+* A **finding** is a violation of one of the stack's machine-checkable
+  invariants (wall-clock read in a deterministic path, file I/O under a
+  fleet lock, a swallowed exception, ...). Findings carry a **stable
+  fingerprint** — ``pass:path:qualname:code`` — deliberately excluding
+  the line number, so baseline entries survive unrelated edits to the
+  same file.
+* A finding is silenced one of two ways, both requiring a human-written
+  justification:
+  - an **inline suppression** comment on the finding's line or the line
+    directly above::
+
+        # analyze: allow[determinism] hardware deadline — wall time is the point
+
+  - a **baseline entry** in ``tools/analyze/baseline.json`` keyed by
+    fingerprint. ``--fix-baseline`` adds new entries with a
+    ``TODO: justify`` placeholder that the checker itself rejects —
+    an un-justified suppression is a finding of its own.
+* Baseline entries that no longer match any finding are **stale** and
+  fail the run (``--fix-baseline`` expires them): the baseline only ever
+  shrinks or is consciously grown, it never accretes dead weight.
+
+Pure stdlib + ``ast`` — the analyzers must run on any image that can
+run the repo's tests, with no linter dependencies.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+import tokenize
+from io import StringIO
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+#: the production tree every code pass scans by default
+PRODUCTION_ROOT = "tpu_on_k8s"
+
+_ALLOW_RE = re.compile(
+    r"#\s*analyze:\s*allow\[(?P<pass_id>[a-z-]+)\]\s*(?P<why>.*)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One invariant violation, anchored to source but fingerprinted
+    without line numbers (see module docstring)."""
+
+    pass_id: str      # "determinism" | "lock-discipline" | ...
+    path: str         # repo-relative, posix separators
+    line: int         # 1-based anchor (for humans; not in the fingerprint)
+    qualname: str     # enclosing def/class chain, or "<module>"
+    code: str         # machine-readable violation code, e.g. "wall-clock:time.monotonic"
+    message: str      # one-line human explanation
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.pass_id}:{self.path}:{self.qualname}:{self.code}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+                f"\n    fingerprint: {self.fingerprint}")
+
+
+class SourceFile:
+    """One parsed production file: text, AST, parent links, qualname map,
+    and the inline-suppression table."""
+
+    def __init__(self, path: Path, rel: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.text = path.read_text()
+        self.tree = ast.parse(self.text, filename=rel)
+        # parent links + enclosing-scope qualnames, one walk
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        self._qualnames: Dict[ast.AST, str] = {}
+        self._index(self.tree, "<module>")
+        self.suppressions = _parse_suppressions(self.text)
+
+    def _index(self, node: ast.AST, qual: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            self._parents[child] = node
+            cq = qual
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                cq = (child.name if qual == "<module>"
+                      else f"{qual}.{child.name}")
+            self._qualnames[child] = cq
+            self._index(child, cq)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def qualname(self, node: ast.AST) -> str:
+        return self._qualnames.get(node, "<module>")
+
+    def suppressed(self, finding: Finding) -> Optional[str]:
+        """The justification if an inline allow-comment covers this
+        finding (same line or the line above), else None. An allow with
+        an EMPTY justification never matches — it is reported instead."""
+        for line in (finding.line, finding.line - 1):
+            entry = self.suppressions.get(line)
+            if entry and entry[0] == finding.pass_id and entry[1]:
+                return entry[1]
+        return None
+
+    def blank_suppressions(self) -> List[Tuple[int, str]]:
+        """(line, pass_id) of allow-comments with no justification text —
+        each is itself reported as a finding."""
+        return [(ln, p) for ln, (p, why) in sorted(self.suppressions.items())
+                if not why]
+
+
+def _parse_suppressions(text: str) -> Dict[int, Tuple[str, str]]:
+    """line -> (pass_id, justification) for every ``# analyze: allow[...]``
+    comment, via tokenize so strings containing the pattern don't match."""
+    out: Dict[int, Tuple[str, str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(StringIO(text).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _ALLOW_RE.search(tok.string)
+            if m:
+                out[tok.start[0]] = (m.group("pass_id"),
+                                     m.group("why").strip())
+    except tokenize.TokenError:  # analyze: allow[silent-loss] unparseable file — the ast parse will raise the real error
+        pass
+    return out
+
+
+class RepoIndex:
+    """Parsed view of the production tree plus the repo paths the
+    cross-checking passes (chaos-coverage, metrics-schema) read."""
+
+    def __init__(self, root: Path = REPO_ROOT,
+                 production: str = PRODUCTION_ROOT) -> None:
+        self.root = root
+        self.files: List[SourceFile] = []
+        prod = root / production
+        for path in sorted(prod.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            rel = path.relative_to(root).as_posix()
+            self.files.append(SourceFile(path, rel))
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        for f in self.files:
+            if f.rel == rel:
+                return f
+        return None
+
+    def read(self, rel: str) -> str:
+        return (self.root / rel).read_text()
+
+    def exists(self, rel: str) -> bool:
+        return (self.root / rel).exists()
+
+    def test_text(self) -> str:
+        """Concatenated test + scenario sources — the reference corpus the
+        chaos-coverage pass checks scenario/test coverage against."""
+        chunks = []
+        for path in sorted((self.root / "tests").rglob("*.py")):
+            if "__pycache__" not in path.parts:
+                chunks.append(path.read_text())
+        return "\n".join(chunks)
+
+
+# ---------------------------------------------------------------- baseline
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+_TODO = "TODO: justify"
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    fingerprint: str
+    justification: str
+
+
+def load_baseline(path: Path = BASELINE_PATH) -> List[BaselineEntry]:
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text())
+    return [BaselineEntry(e["fingerprint"], e.get("justification", ""))
+            for e in data.get("entries", [])]
+
+
+def save_baseline(entries: Iterable[BaselineEntry],
+                  path: Path = BASELINE_PATH) -> None:
+    data = {
+        "version": 1,
+        "_comment": ("Accepted invariant findings. Every entry MUST carry "
+                     "a one-line justification; 'TODO: justify' placeholders "
+                     "(written by --fix-baseline) fail the check until a "
+                     "human replaces them. Stale entries fail the check too "
+                     "— re-run --fix-baseline to expire them."),
+        "entries": [{"fingerprint": e.fingerprint,
+                     "justification": e.justification}
+                    for e in sorted(entries, key=lambda e: e.fingerprint)],
+    }
+    path.write_text(json.dumps(data, indent=2) + "\n")
+
+
+@dataclasses.dataclass
+class CheckResult:
+    """The reconciliation of current findings against the baseline."""
+
+    new: List[Finding]                     # violations with no suppression
+    baselined: List[Tuple[Finding, str]]   # suppressed by baseline entry
+    inline: List[Tuple[Finding, str]]      # suppressed by allow-comment
+    stale: List[BaselineEntry]             # baseline entries matching nothing
+    unjustified: List[BaselineEntry]       # matched entries with no real why
+    blank_allows: List[Finding]            # allow-comments with no why
+
+    @property
+    def ok(self) -> bool:
+        return not (self.new or self.stale or self.unjustified
+                    or self.blank_allows)
+
+
+def check(findings: List[Finding], repo: RepoIndex,
+          baseline: List[BaselineEntry],
+          passes: Optional[Iterable[str]] = None) -> CheckResult:
+    """Reconcile findings against the baseline. ``passes`` names the
+    pass ids that actually ran — baseline entries belonging to passes
+    that did NOT run are out of scope, not stale (a ``--pass`` subset
+    must not condemn the other passes' entries)."""
+    if passes is not None:
+        scope = set(passes)
+        baseline = [e for e in baseline
+                    if e.fingerprint.split(":", 1)[0] in scope]
+    by_fp: Dict[str, BaselineEntry] = {e.fingerprint: e for e in baseline}
+    matched_fps = set()
+    new: List[Finding] = []
+    baselined: List[Tuple[Finding, str]] = []
+    inline: List[Tuple[Finding, str]] = []
+    unjustified_fps = set()
+    for f in findings:
+        src = repo.file(f.path)
+        why = src.suppressed(f) if src is not None else None
+        if why is not None:
+            inline.append((f, why))
+            # a baseline entry covering the same fingerprint is redundant
+            # but matched — it must not read as stale (``--fix-baseline``
+            # is the explicit way to drop it)
+            if f.fingerprint in by_fp:
+                matched_fps.add(f.fingerprint)
+            continue
+        entry = by_fp.get(f.fingerprint)
+        if entry is not None:
+            matched_fps.add(entry.fingerprint)
+            if not entry.justification or entry.justification == _TODO:
+                unjustified_fps.add(entry.fingerprint)
+            else:
+                baselined.append((f, entry.justification))
+            continue
+        new.append(f)
+    stale = [e for e in baseline if e.fingerprint not in matched_fps]
+    unjustified = [by_fp[fp] for fp in sorted(unjustified_fps)]
+    blank = []
+    scope = set(passes) if passes is not None else None
+    for src in repo.files:
+        for line, pass_id in src.blank_suppressions():
+            if scope is not None and pass_id not in scope:
+                continue          # that pass didn't run — out of scope
+            blank.append(Finding(
+                pass_id, src.rel, line, "<comment>", "blank-suppression",
+                "allow-comment carries no justification — write why, or "
+                "remove it"))
+    return CheckResult(new, baselined, inline, stale, unjustified, blank)
+
+
+def fix_baseline(findings: List[Finding], repo: RepoIndex,
+                 baseline: List[BaselineEntry],
+                 passes: Optional[Iterable[str]] = None
+                 ) -> List[BaselineEntry]:
+    """The --fix-baseline rewrite: keep matched entries (and their
+    justifications), add unmatched findings as TODO entries, drop stale.
+    With a ``passes`` subset, entries of passes that did not run are
+    carried through untouched."""
+    by_fp = {e.fingerprint: e for e in baseline}
+    out: Dict[str, BaselineEntry] = {}
+    if passes is not None:
+        scope = set(passes)
+        for e in baseline:
+            if e.fingerprint.split(":", 1)[0] not in scope:
+                out[e.fingerprint] = e
+    for f in findings:
+        src = repo.file(f.path)
+        if src is not None and src.suppressed(f) is not None:
+            continue                       # inline allow already covers it
+        fp = f.fingerprint
+        if fp not in out:
+            prior = by_fp.get(fp)
+            out[fp] = prior if prior is not None else BaselineEntry(fp, _TODO)
+    return list(out.values())
+
+
+# ---------------------------------------------------------------- ast helpers
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)

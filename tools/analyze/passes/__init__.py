@@ -1,0 +1,14 @@
+"""The five invariant passes, keyed by their stable pass ids."""
+from __future__ import annotations
+
+from tools.analyze.passes import (chaoscov, determinism, locks,
+                                  metricsschema, silentloss)
+
+#: pass id -> run(repo) callable, in report order
+PASSES = {
+    determinism.PASS_ID: determinism.run,
+    locks.PASS_ID: locks.run,
+    silentloss.PASS_ID: silentloss.run,
+    chaoscov.PASS_ID: chaoscov.run,
+    metricsschema.PASS_ID: metricsschema.run,
+}

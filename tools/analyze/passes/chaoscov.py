@@ -1,0 +1,192 @@
+"""Chaos-coverage pass: every fault site is injected, tested, documented.
+
+A recovery path that is never exercised is a rumor — so every ``SITE_*``
+constant in `tpu_on_k8s/chaos/faults.py` must be:
+
+1. **registered** — a row in ``faults.SITE_REGISTRY`` (fires-in /
+   faults / recovery — the machine-readable source of the
+   `docs/resilience.md` site table);
+2. **fired** — referenced at ≥ 1 injection point in production code
+   outside ``tpu_on_k8s/chaos/`` itself;
+3. **exercised** — referenced by a prebuilt scenario
+   (``chaos/scenarios.py``) or a test under ``tests/``;
+4. **documented** — the generated site table in ``docs/resilience.md``
+   (between the ``BEGIN/END GENERATED: chaos-site-table`` markers) is
+   byte-identical to what ``python -m tools.analyze --emit-site-table``
+   renders from the registry.
+
+Registry rows must also be *honest*: every fault name listed must be a
+``Fault`` subclass defined in ``faults.py``, and every registered site
+must still exist as a constant.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import sys
+from typing import Dict, List, Tuple
+
+from tools.analyze.core import Finding, RepoIndex
+
+PASS_ID = "chaos-coverage"
+
+FAULTS_REL = "tpu_on_k8s/chaos/faults.py"
+DOC_REL = "docs/resilience.md"
+MARK_BEGIN = ("<!-- BEGIN GENERATED: chaos-site-table "
+              "(python -m tools.analyze --emit-site-table) -->")
+MARK_END = "<!-- END GENERATED: chaos-site-table -->"
+
+
+def _load_faults(repo: RepoIndex):
+    """Load faults.py standalone (it imports only the stdlib at module
+    level — by documented contract) so the registry/constants are live
+    objects, not re-parsed literals."""
+    path = repo.root / FAULTS_REL
+    spec = importlib.util.spec_from_file_location("_analyze_faults", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves string annotations via sys.modules[__module__]
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(spec.name, None)
+    return mod
+
+
+def _sites(mod) -> Dict[str, str]:
+    """const name -> site string, in definition order."""
+    return {k: v for k, v in vars(mod).items()
+            if k.startswith("SITE_") and isinstance(v, str)}
+
+
+def render_site_table(repo: RepoIndex) -> str:
+    """The generated markdown site table, markers included — the exact
+    bytes `docs/resilience.md` must carry."""
+    mod = _load_faults(repo)
+    sites = _sites(mod)
+    registry = getattr(mod, "SITE_REGISTRY", {})
+    lines = [MARK_BEGIN,
+             "| site | fires in | faults | recovery under test |",
+             "|---|---|---|---|"]
+    for site in sites.values():
+        row = registry.get(site)
+        if row is None:
+            continue
+        fires_in, fault_names, recovery = row
+        faults = ", ".join(f"`{f}`" for f in fault_names)
+        lines.append(f"| `{site}` | {fires_in} | {faults} | {recovery} |")
+    lines.append(MARK_END)
+    return "\n".join(lines) + "\n"
+
+
+def _referenced_consts(repo: RepoIndex,
+                       names: set) -> Tuple[set, set]:
+    """(fired, exercised): const names referenced in production outside
+    chaos/, and const names referenced in scenarios or tests."""
+    fired = set()
+    for src in repo.files:
+        if src.rel.startswith("tpu_on_k8s/chaos/"):
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Name) and node.id in names:
+                fired.add(node.id)
+            elif isinstance(node, ast.Attribute) and node.attr in names:
+                fired.add(node.attr)
+    corpus = repo.test_text()
+    if repo.exists("tpu_on_k8s/chaos/scenarios.py"):
+        corpus += repo.read("tpu_on_k8s/chaos/scenarios.py")
+    exercised = {n for n in names if n in corpus}
+    return fired, exercised
+
+
+def run(repo: RepoIndex) -> List[Finding]:
+    out: List[Finding] = []
+    if not repo.exists(FAULTS_REL):
+        return out
+    mod = _load_faults(repo)
+    sites = _sites(mod)
+    registry = getattr(mod, "SITE_REGISTRY", None)
+    qual = "SITE_REGISTRY"
+
+    def finding(code: str, message: str, line: int = 1) -> Finding:
+        return Finding(PASS_ID, FAULTS_REL, line, qual, code, message)
+
+    if registry is None:
+        out.append(finding("registry-missing",
+                           "faults.py has no SITE_REGISTRY — the site "
+                           "table cannot be generated"))
+        return out
+    fault_base = getattr(mod, "Fault")
+    fault_classes = {k for k, v in vars(mod).items()
+                     if isinstance(v, type) and issubclass(v, fault_base)
+                     and v is not fault_base}
+    by_value = {v: k for k, v in sites.items()}
+    for site, (fires_in, fault_names, recovery) in registry.items():
+        if site not in by_value:
+            out.append(finding(f"registry-unknown-site:{site}",
+                               f"SITE_REGISTRY row {site!r} matches no "
+                               f"SITE_* constant"))
+            continue
+        for fname in fault_names:
+            if fname not in fault_classes:
+                out.append(finding(
+                    f"registry-unknown-fault:{site}:{fname}",
+                    f"SITE_REGISTRY[{site!r}] lists fault {fname!r} which "
+                    f"is not a Fault subclass in faults.py"))
+    for cname, site in sites.items():
+        if site not in registry:
+            out.append(finding(f"unregistered:{site}",
+                               f"{cname} ({site!r}) has no SITE_REGISTRY "
+                               f"row — fires-in/faults/recovery unknown"))
+    fired, exercised = _referenced_consts(repo, set(sites))
+    for cname, site in sites.items():
+        if cname not in fired:
+            out.append(finding(
+                f"never-fired:{site}",
+                f"{cname} ({site!r}) is referenced at no injection point "
+                f"in production code — the site is dead"))
+        if cname not in exercised:
+            out.append(finding(
+                f"never-exercised:{site}",
+                f"{cname} ({site!r}) appears in no scenario or test — "
+                f"the recovery under test is a rumor"))
+    # the generated doc table must be present and byte-identical
+    doc_qual = "<site-table>"
+    if not repo.exists(DOC_REL):
+        out.append(Finding(PASS_ID, DOC_REL, 1, doc_qual, "doc-missing",
+                           f"{DOC_REL} does not exist"))
+        return out
+    doc = repo.read(DOC_REL)
+    want = render_site_table(repo)
+    begin, end = doc.find(MARK_BEGIN), doc.find(MARK_END)
+    if begin < 0 or end < 0:
+        out.append(Finding(
+            PASS_ID, DOC_REL, 1, doc_qual, "doc-markers-missing",
+            f"{DOC_REL} lacks the generated site-table markers — run "
+            f"`python -m tools.analyze --write-site-table`"))
+        return out
+    have = doc[begin:end + len(MARK_END)] + "\n"
+    if have != want:
+        line = doc[:begin].count("\n") + 1
+        out.append(Finding(
+            PASS_ID, DOC_REL, line, doc_qual, "doc-table-stale",
+            f"the {DOC_REL} site table differs from the generated one — "
+            f"run `python -m tools.analyze --write-site-table`"))
+    return out
+
+
+def write_site_table(repo: RepoIndex) -> bool:
+    """Splice the generated table into docs/resilience.md between the
+    markers (replacing the current block). Returns True on change."""
+    doc = repo.read(DOC_REL)
+    want = render_site_table(repo)
+    begin, end = doc.find(MARK_BEGIN), doc.find(MARK_END)
+    if begin < 0 or end < 0:
+        raise SystemExit(f"{DOC_REL} lacks the site-table markers; add\n"
+                         f"{MARK_BEGIN}\n{MARK_END}\nwhere the table "
+                         f"belongs, then re-run")
+    new = doc[:begin] + want.rstrip("\n") + doc[end + len(MARK_END):]
+    if new == doc:
+        return False
+    (repo.root / DOC_REL).write_text(new)
+    return True

@@ -1,0 +1,143 @@
+"""Determinism pass: nondeterminism sources in production paths.
+
+The stack's replay proofs (`make chaos-soak fleet-soak autoscale-soak
+disagg-soak trace-demo`) all rest on one substrate rule: production code
+reads time through an **injectable clock** and randomness through a
+**seeded RNG**. This pass flags the constructs that break that rule:
+
+* wall-clock reads — ``time.time()`` / ``time.monotonic()`` /
+  ``time.perf_counter()`` (and the ``_ns`` variants), ``datetime.now()``
+  / ``utcnow()`` / ``today()``;
+* ambient randomness — module-level ``random.*`` draws, an *unseeded*
+  ``random.Random()``, global ``np.random.*`` draws (seeded
+  ``default_rng`` / ``RandomState`` / ``Generator`` construction is
+  fine), ``uuid.uuid1/uuid4``, ``os.urandom``, ``secrets.*``;
+* iteration-order hazards — ``for`` over a set expression and
+  ``os.listdir`` / ``glob.glob`` / ``os.scandir`` / ``Path.iterdir``
+  results consumed without ``sorted(...)`` (set/filesystem order is the
+  one ordering Python does not pin).
+
+Hardware-facing deadlines (CRI waits, profiling) are real wall time by
+*intent* — those sites carry justified baseline entries instead of
+rewrites.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.analyze.core import Finding, RepoIndex, SourceFile, call_name
+
+PASS_ID = "determinism"
+
+_WALL_CLOCK = {"time.time", "time.monotonic", "time.perf_counter",
+               "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns"}
+#: attribute calls on a datetime/date object that read the host clock
+_DATETIME_ATTRS = {"now", "utcnow", "today"}
+_RANDOM_DRAWS = {"random", "randint", "randrange", "choice", "choices",
+                 "shuffle", "sample", "uniform", "gauss", "betavariate",
+                 "expovariate", "getrandbits", "randbytes", "triangular",
+                 "normalvariate", "vonmisesvariate"}
+_NP_RANDOM_OK = {"default_rng", "RandomState", "Generator", "SeedSequence",
+                 "PCG64", "Philox"}
+_UUID_HAZARDS = {"uuid.uuid1", "uuid.uuid4"}
+_LISTING_CALLS = {"os.listdir", "os.scandir", "glob.glob", "glob.iglob"}
+
+
+def _finding(src: SourceFile, node: ast.AST, code: str,
+             message: str) -> Finding:
+    return Finding(PASS_ID, src.rel, node.lineno, src.qualname(node),
+                   code, message)
+
+
+def _is_sorted_wrapped(src: SourceFile, node: ast.AST) -> bool:
+    """True when ``node`` is (transitively, through list()/tuple())
+    an argument of ``sorted(...)`` — ordering is pinned."""
+    cur = node
+    parent = src.parent(cur)
+    while isinstance(parent, ast.Call) and cur in parent.args:
+        name = call_name(parent)
+        if name == "sorted":
+            return True
+        if name not in ("list", "tuple"):
+            return False
+        cur, parent = parent, src.parent(parent)
+    return False
+
+
+def _check_call(src: SourceFile, node: ast.Call) -> Optional[Finding]:
+    name = call_name(node)
+    if name is None:
+        return None
+    if name in _WALL_CLOCK:
+        return _finding(src, node, f"wall-clock:{name}",
+                        f"wall-clock read `{name}()` in a production path "
+                        f"— thread the injectable clock instead")
+    root = name.split(".", 1)[0]
+    leaf = name.rsplit(".", 1)[-1]
+    if root in ("datetime", "date") and leaf in _DATETIME_ATTRS:
+        return _finding(src, node, f"wall-clock:{name}",
+                        f"wall-clock read `{name}()` — inject the clock")
+    if name in _UUID_HAZARDS:
+        return _finding(src, node, f"entropy:{name}",
+                        f"`{name}()` draws ambient entropy — derive ids "
+                        f"from a seeded counter/RNG")
+    if root == "random" and "." in name:
+        if leaf in _RANDOM_DRAWS:
+            return _finding(src, node, f"entropy:{name}",
+                            f"module-level `{name}()` uses the shared "
+                            f"unseeded RNG — use an injected "
+                            f"random.Random(seed)")
+        if leaf == "Random" and not node.args and not node.keywords:
+            return _finding(src, node, "entropy:random.Random()",
+                            "`random.Random()` without a seed is ambient "
+                            "entropy — pass a seed or accept an injected "
+                            "RNG")
+    parts = name.split(".")
+    if (root in ("np", "numpy") and len(parts) >= 3
+            and parts[1] == "random" and leaf not in _NP_RANDOM_OK):
+        # len >= 3 keeps a bare `np.random` module reference out while
+        # still catching `np.random.random()` itself
+        return _finding(src, node, f"entropy:{name}",
+                        f"global `{name}()` draw — use a seeded "
+                        f"np.random.default_rng / Generator")
+    if name == "os.urandom" or root == "secrets":
+        return _finding(src, node, f"entropy:{name}",
+                        f"`{name}` is non-reproducible entropy")
+    if name in _LISTING_CALLS and not _is_sorted_wrapped(src, node):
+        return _finding(src, node, f"order:{name}",
+                        f"`{name}()` order is filesystem-dependent — wrap "
+                        f"in sorted(...)")
+    if (isinstance(node.func, ast.Attribute) and node.func.attr == "iterdir"
+            and not _is_sorted_wrapped(src, node)):
+        return _finding(src, node, "order:iterdir",
+                        "`.iterdir()` order is filesystem-dependent — wrap "
+                        "in sorted(...)")
+    return None
+
+
+def _check_for(src: SourceFile, node: ast.For) -> Optional[Finding]:
+    it = node.iter
+    if isinstance(it, (ast.Set, ast.SetComp)):
+        return _finding(src, it, "order:set-iteration",
+                        "iterating a set expression — set order is "
+                        "unpinned; sort it")
+    if (isinstance(it, ast.Call) and call_name(it) in ("set", "frozenset")):
+        return _finding(src, it, "order:set-iteration",
+                        "iterating set(...) — set order is unpinned; "
+                        "sort it")
+    return None
+
+
+def run(repo: RepoIndex) -> List[Finding]:
+    out: List[Finding] = []
+    for src in repo.files:
+        for node in ast.walk(src.tree):
+            f = None
+            if isinstance(node, ast.Call):
+                f = _check_call(src, node)
+            elif isinstance(node, ast.For):
+                f = _check_for(src, node)
+            if f is not None:
+                out.append(f)
+    return out

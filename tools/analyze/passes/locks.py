@@ -1,0 +1,112 @@
+"""Lock-discipline pass: no blocking or re-entrant work under a lock.
+
+The serve/controller planes follow one locking rule: a ``self._lock``
+region protects *bookkeeping* — it must never contain file I/O, recorder
+dumps, user callbacks, sleeps, or chaos-injector fire points. Each of
+those either blocks every other thread contending the lock (I/O, sleep)
+or re-enters arbitrary code while holding it (callbacks, injected
+faults) — the deadlock/latency bug class PR 7's ``_deferred_dumps``
+fixed by hand in the fleet planes.
+
+The pass builds per-function "holds the lock" region maps from ``with
+self._lock:`` statements (any name/attribute containing ``lock``) and
+flags the forbidden work inside. Nested ``def``/``lambda`` bodies are
+*not* flagged — they execute later, usually after the region exits;
+*calling* one inside the region is flagged when its name is
+callback-shaped (``on_*`` / ``*callback``).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from tools.analyze.core import Finding, RepoIndex, SourceFile, call_name
+
+PASS_ID = "lock-discipline"
+
+#: direct file/console I/O entry points (dotted prefixes match whole names)
+_IO_CALLS = {"open", "os.makedirs", "os.mkdir", "os.replace", "os.rename",
+             "os.remove", "os.unlink", "os.rmdir", "json.dump",
+             "pickle.dump", "np.save", "np.savez", "print"}
+_IO_PREFIXES = ("shutil.",)
+#: attribute calls that are writes/dumps regardless of receiver
+_IO_ATTRS = {"write_text", "write_bytes", "dump", "dump_to"}
+_CALLBACK_RE = re.compile(r"^_?(on_[a-z0-9_]+|.*callback|cb)$")
+_INJECTOR_ATTRS = {"fire", "inject"}
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute):
+        return "lock" in node.attr.lower()
+    if isinstance(node, ast.Name):
+        return "lock" in node.id.lower()
+    return False
+
+
+def _classify_call(node: ast.Call) -> Optional[tuple]:
+    """(code, message) when this call is forbidden under a lock."""
+    name = call_name(node) or ""
+    leaf = name.rsplit(".", 1)[-1] if name else ""
+    if name in _IO_CALLS or any(name.startswith(p) for p in _IO_PREFIXES):
+        return (f"io-under-lock:{name}",
+                f"`{name}(...)` performs I/O while holding the lock — "
+                f"defer it out of the region (the _deferred_dumps pattern)")
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr in _IO_ATTRS:
+            return (f"io-under-lock:.{attr}",
+                    f"`.{attr}(...)` writes while holding the lock — "
+                    f"defer it out of the region")
+        if attr in _INJECTOR_ATTRS:
+            return (f"chaos-under-lock:.{attr}",
+                    f"chaos-injector `.{attr}(...)` under the lock — an "
+                    f"injected fault would unwind with the lock held / "
+                    f"re-enter arbitrary code")
+    if name == "time.sleep":
+        return ("sleep-under-lock:time.sleep",
+                "`time.sleep` stalls every thread contending this lock")
+    if _CALLBACK_RE.match(leaf):
+        return (f"callback-under-lock:{leaf}",
+                f"callback `{leaf}(...)` invoked under the lock — user "
+                f"code re-enters with the lock held (deadlock bait); "
+                f"capture under the lock, fire after release")
+    return None
+
+
+def _scan_region(src: SourceFile, body: List[ast.stmt],
+                 out: List[Finding]) -> None:
+    """Flag forbidden work in a lock-held region, skipping deferred
+    bodies (nested defs/lambdas) but recursing into nested control flow
+    — including nested ``with`` blocks (still holding the outer lock)."""
+    for stmt in body:
+        for node in _walk_live(stmt):
+            if isinstance(node, ast.Call):
+                hit = _classify_call(node)
+                if hit is not None:
+                    code, message = hit
+                    out.append(Finding(PASS_ID, src.rel, node.lineno,
+                                       src.qualname(node), code, message))
+
+
+def _walk_live(node: ast.AST):
+    """ast.walk that does not descend into deferred-execution bodies —
+    a def/lambda/class defined under the lock runs later (usually after
+    release), so its body is not lock-held code."""
+    yield node
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda, ast.ClassDef)):
+        return
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_live(child)
+
+
+def run(repo: RepoIndex) -> List[Finding]:
+    out: List[Finding] = []
+    for src in repo.files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.With):
+                continue
+            if any(_is_lock_expr(item.context_expr) for item in node.items):
+                _scan_region(src, node.body, out)
+    return out

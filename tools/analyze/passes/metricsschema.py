@@ -1,0 +1,161 @@
+"""Metrics-schema pass: every declared family is observed and renderable.
+
+The metrics layer declares its exposition schema explicitly — every
+family a class exports goes through ``_declare`` into ``_families`` —
+which makes two failure modes machine-checkable:
+
+* a **dead family**: declared (it renders on every scrape, dashboards
+  chart it) but never observed anywhere in production — its value is a
+  constant lie;
+* a **ghost observation**: ``inc``/``observe``/``set_gauge`` called with
+  a name no class declares — the prometheus twin silently doesn't
+  exist, so the signal vanishes from scrapes (the mirror dict accepts
+  anything, which is exactly why this needs a lint).
+
+The pass instantiates each metrics class (the module is stdlib-only by
+contract, so this is cheap and exact — no literal-tracking heuristics
+for loop-declared families) and then AST-scans production for
+observation sites. F-string metric names count as patterns: the declared
+name must match one. Finally it renders every class through **both**
+exposition backends — the prometheus registry when the client is
+importable, and the pure-Python ``render_text`` fallback always — so a
+family that breaks either renderer fails tier-1, not the first scrape
+in production.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+from tools.analyze.core import Finding, RepoIndex
+
+PASS_ID = "metrics-schema"
+
+METRICS_REL = "tpu_on_k8s/metrics/metrics.py"
+#: observation entry points — the public trio plus the `_`-prefixed
+#: forwarding wrappers layers like `serve/kvstore.py` define over them
+_OBSERVE_ATTRS = {"inc", "observe", "set_gauge",
+                  "_inc", "_observe", "_set_gauge"}
+_VALID_KINDS = {"counter", "gauge", "histogram"}
+
+
+def _load_metrics(repo: RepoIndex):
+    path = repo.root / METRICS_REL
+    spec = importlib.util.spec_from_file_location("_analyze_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves string annotations via sys.modules[__module__]
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(spec.name, None)
+    return mod
+
+
+def _metrics_classes(mod) -> List[type]:
+    base = getattr(mod, "_MetricsBase", None)
+    if base is None:
+        return []
+    return [v for v in vars(mod).values()
+            if isinstance(v, type) and issubclass(v, base) and v is not base]
+
+
+def _observation_sites(repo: RepoIndex) -> Tuple[Set[str], List[re.Pattern],
+                                                 Dict[str, Tuple[str, int]]]:
+    """(literal names, f-string patterns, name -> (path, line)) for every
+    ``.inc/.observe/.set_gauge`` first argument in production."""
+    literals: Set[str] = set()
+    patterns: List[re.Pattern] = []
+    where: Dict[str, Tuple[str, int]] = {}
+    for src in repo.files:
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _OBSERVE_ATTRS
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                literals.add(arg.value)
+                where.setdefault(arg.value, (src.rel, node.lineno))
+            elif isinstance(arg, ast.JoinedStr):
+                parts = []
+                for v in arg.values:
+                    if isinstance(v, ast.Constant):
+                        parts.append(re.escape(str(v.value)))
+                    else:
+                        parts.append(r"[A-Za-z0-9_]+")
+                patterns.append(re.compile("^" + "".join(parts) + "$"))
+    return literals, patterns, where
+
+
+def run(repo: RepoIndex) -> List[Finding]:
+    out: List[Finding] = []
+    if not repo.exists(METRICS_REL):
+        return out
+    mod = _load_metrics(repo)
+    classes = _metrics_classes(mod)
+    literals, patterns, where = _observation_sites(repo)
+    declared: Set[str] = set()
+    for cls in classes:
+        inst = cls()
+        qual = cls.__name__
+        for name, fam in inst._families.items():
+            declared.add(name)
+            if fam.kind not in _VALID_KINDS:
+                out.append(Finding(
+                    PASS_ID, METRICS_REL, 1, qual,
+                    f"bad-kind:{name}:{fam.kind}",
+                    f"{qual} family {name!r} has kind {fam.kind!r} — "
+                    f"neither backend can render it"))
+            if fam.kind == "histogram" and not fam.buckets:
+                out.append(Finding(
+                    PASS_ID, METRICS_REL, 1, qual,
+                    f"histogram-no-buckets:{name}",
+                    f"{qual} histogram {name!r} declares no buckets — the "
+                    f"fallback renderer would emit an empty bucket ladder"))
+            if len(fam.labels) > 1:
+                out.append(Finding(
+                    PASS_ID, METRICS_REL, 1, qual,
+                    f"too-many-labels:{name}",
+                    f"{qual} family {name!r} declares {len(fam.labels)} "
+                    f"labels — the mirror/fallback schema supports at "
+                    f"most one"))
+            observed = (name in literals
+                        or any(p.match(name) for p in patterns))
+            if not observed:
+                out.append(Finding(
+                    PASS_ID, METRICS_REL, 1, qual,
+                    f"unobserved-family:{name}",
+                    f"{qual} declares family {name!r} but nothing in "
+                    f"production observes it — a dead series on every "
+                    f"scrape"))
+        # both exposition backends must render this class's schema
+        for backend, render in (
+                ("fallback", lambda i=inst: mod.render_text(i)),
+                ("exposition", lambda i=inst: mod.exposition(i))):
+            try:
+                render()
+            except Exception as e:  # analyze: allow[silent-loss] converted to a finding below — nothing is swallowed
+                out.append(Finding(
+                    PASS_ID, METRICS_REL, 1, qual,
+                    f"render-failure:{backend}:{cls.__name__}",
+                    f"{qual} fails to render under the {backend} backend: "
+                    f"{type(e).__name__}: {e}"))
+    # ghost observations: literal names observed but declared nowhere.
+    # The scan filters on ATTRIBUTE NAME only (inc/observe/set_gauge and
+    # the `_`-prefixed wrappers) — any receiver qualifies, so a
+    # non-metrics object growing an `.inc("name")`-shaped API would
+    # surface here and need a declaration or a rename.
+    for name in sorted(literals - declared):
+        path, line = where[name]
+        out.append(Finding(
+            PASS_ID, path, line, "<observation>",
+            f"undeclared-metric:{name}",
+            f"observation of {name!r} matches no declared family in any "
+            f"metrics class — the prometheus twin does not exist, the "
+            f"signal never reaches a scrape"))
+    return out

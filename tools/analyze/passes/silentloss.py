@@ -1,0 +1,101 @@
+"""Silent-loss pass: broad exception handlers must leave a trace.
+
+Zero silent request loss is the serving plane's headline guarantee, and
+its cheapest violation is ``except Exception: pass``. This pass flags
+every broad handler (``except Exception`` / ``except BaseException`` /
+bare ``except:``) that does **none** of:
+
+* re-raise (any ``raise`` in the handler body),
+* return/yield a typed value (a ``return``/``yield`` carrying a value —
+  the typed-error-result shape),
+* touch a metrics counter (a call on a ``metrics``-named receiver, or an
+  ``inc`` / ``observe`` / ``set_gauge`` / ``decision`` / ``error`` /
+  ``failure`` method).
+
+A handler that only logs still swallows the event from the *machines'*
+point of view — dashboards and the zero-loss accounting never see it —
+so logging alone does not count. Intentional swallows (best-effort
+cleanup, probe paths) carry the suppression comment::
+
+    except Exception:  # analyze: allow[silent-loss] why this may vanish
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.analyze.core import Finding, RepoIndex, SourceFile, dotted_name
+
+PASS_ID = "silent-loss"
+
+_BROAD = {"Exception", "BaseException"}
+_COUNTER_ATTRS = {"inc", "observe", "set_gauge", "decision", "error",
+                  "failure"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True                       # bare except:
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Attribute):
+        return t.attr in _BROAD
+    return False
+
+
+def _touches_counter(node: ast.Call) -> bool:
+    if isinstance(node.func, ast.Attribute):
+        if node.func.attr in _COUNTER_ATTRS:
+            chain = dotted_name(node.func) or ""
+            # `.error(...)`/`.failure(...)` only count on a metrics-named
+            # receiver — `log.error(...)` is logging, not accounting
+            if node.func.attr in ("error", "failure"):
+                return "metrics" in chain
+            return True
+        chain = dotted_name(node.func) or ""
+        if "metrics" in chain.rsplit(".", 1)[0]:
+            return True
+    # a helper HANDED the metrics sink (count_detached_callback and kin)
+    # is accounting by proxy: the failure reaches a counter through it
+    for arg in node.args:
+        chain = dotted_name(arg) or ""
+        if "metrics" in chain.split("."):
+            return True
+    return False
+
+
+def _leaves_trace(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Return) and node.value is not None:
+            return True
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            return True
+        if isinstance(node, ast.Call) and _touches_counter(node):
+            return True
+    return False
+
+
+def run(repo: RepoIndex) -> List[Finding]:
+    out: List[Finding] = []
+    for src in repo.files:
+        counters: dict = {}
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or _leaves_trace(node):
+                continue
+            qual = src.qualname(node)
+            # one function can hold several swallowing handlers — keep
+            # their fingerprints distinct with a per-scope ordinal
+            n = counters.get(qual, 0)
+            counters[qual] = n + 1
+            code = "swallow" if n == 0 else f"swallow#{n + 1}"
+            out.append(Finding(
+                PASS_ID, src.rel, node.lineno, qual, code,
+                "broad except swallows the exception — re-raise, return "
+                "a typed error, or count it in metrics (or annotate "
+                "`# analyze: allow[silent-loss] <why>`)"))
+    return out

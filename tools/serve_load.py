@@ -431,9 +431,12 @@ def run_autoscale_trace(args, cfg, params, max_len, *,
     tracer = _make_tracer(args, vclock) if trace else None
 
     def factory(name):
+        # the engine's queue/slot timestamps read the SAME virtual clock
+        # as the fleet — no wall time anywhere on the trace's timeline
         return ContinuousBatchingEngine(cfg, params, n_slots=args.n_slots,
                                         max_len=max_len,
-                                        step_horizon=args.horizon)
+                                        step_horizon=args.horizon,
+                                        clock=vclock)
 
     fleet = ServingFleet(
         factory, args.min_replicas,
@@ -635,9 +638,12 @@ def run_disagg_trace(args, cfg, params, max_len, *,
     tracer = _make_tracer(args, vclock) if trace else None
 
     def factory(name):
+        # engine timestamps ride the trace's virtual clock (see
+        # _autoscale factory note)
         return ContinuousBatchingEngine(cfg, params, n_slots=args.n_slots,
                                         max_len=max_len,
-                                        step_horizon=args.horizon)
+                                        step_horizon=args.horizon,
+                                        clock=vclock)
 
     if disagg:
         fleet = DisaggFleet(

@@ -51,6 +51,76 @@ SITE_TRAIN_PREEMPT = "train.preempt"
 SITE_AUTOSCALE_SIGNAL = "autoscale.signal"
 SITE_AUTOSCALE_PATCH = "autoscale.patch"
 
+#: Machine-readable site catalog: site -> (fires in, fault class names,
+#: recovery under test). The single source of the `docs/resilience.md`
+#: chaos-site table (`python -m tools.analyze --emit-site-table` renders
+#: it; the chaos-coverage analyzer pass byte-compares the doc against the
+#: render and cross-checks every fault name against the classes below).
+#: Adding a SITE_* constant without a row here fails tier-1.
+SITE_REGISTRY = {
+    SITE_REST_REQUEST: (
+        "`client/rest.py` request path",
+        ("HttpError", "Conflict", "TimeoutFault", "ConnectionResetFault"),
+        "bounded `update_with_retry` / `patch_meta`, typed "
+        "`ConflictRetriesExhausted`"),
+    SITE_REST_WATCH_CONNECT: (
+        "`client/rest.py` watch (re)connect",
+        ("ConnectionResetFault", "HttpError"),
+        "decorrelated-jitter reconnect backoff"),
+    SITE_REST_WATCH_EVENT: (
+        "`client/rest.py` watch frame delivery",
+        ("WatchDrop",),
+        "reconnect + list resync, no missed state"),
+    SITE_APISERVER_REQUEST: (
+        "`client/apiserver.py` server side",
+        ("HttpError", "Conflict", "ConnectionResetFault"),
+        "same client retry ladder, server-originated"),
+    SITE_APISERVER_WATCH: (
+        "`client/apiserver.py` watch stream",
+        ("WatchDrop",),
+        "reconnect + resync"),
+    SITE_RECONCILE: (
+        "`controller/engine.py` reconcile",
+        ("PodFail", "SlicePreempt"),
+        "failover policy: slice-atomic restart / recreate"),
+    SITE_SERVE_STEP: (
+        "`models/serving.py` engine step",
+        ("EngineCrash", "EngineStall"),
+        "gateway `ReplayPolicy` re-admission, zero silent loss"),
+    SITE_FLEET_REPLICA: (
+        "`serve/fleet.py` replica step",
+        ("ReplicaCrash", "ReadinessFlap"),
+        "ejection + cross-replica replay"),
+    SITE_FLEET_ROLLOUT: (
+        "`serve/fleet.py` rollout FSM",
+        ("RolloutInterrupt",),
+        "rollout resumes / drains clean"),
+    SITE_KV_HANDOFF: (
+        "`serve/disagg.py` prefill→decode transfer",
+        ("HandoffLoss", "HandoffCorrupt"),
+        "checksum reject + replay; token-identical oracle"),
+    SITE_TRAIN_STEP: (
+        "`train/loop.py` dispatched step",
+        ("StepFailure",),
+        "surfaced failure; checkpoint-resume trajectory"),
+    SITE_TRAIN_SAVE: (
+        "`train/loop.py` async save",
+        ("SaveFailure",),
+        "survivable: counted, next cadence save retries"),
+    SITE_TRAIN_PREEMPT: (
+        "`train/loop.py` loop head",
+        ("PreemptNotice",),
+        "final save + drain, bit-exact resume"),
+    SITE_AUTOSCALE_SIGNAL: (
+        "`controller/fleetautoscaler.py` scrape",
+        ("SignalOutage",),
+        'staleness hold — never "no data" as "zero load"'),
+    SITE_AUTOSCALE_PATCH: (
+        "`controller/fleetautoscaler.py` patch",
+        ("Conflict", "HttpError"),
+        "failed patch burns no cooldown"),
+}
+
 
 class ChaosStepError(RuntimeError):
     """An injected training-step failure (``StepFailure``)."""

@@ -327,6 +327,7 @@ class _Handler(BaseHTTPRequestHandler):
             obj = self.cluster.get(route.rt.cls, route.store_namespace,
                                    route.name)
             self._send_json(200, encode_obj(obj))
+        # analyze: allow[silent-loss] exc becomes a typed HTTP Status response (_send_error_status)
         except Exception as exc:  # noqa: BLE001 — mapped to Status codes
             self._send_error_status(exc)
 
@@ -355,6 +356,7 @@ class _Handler(BaseHTTPRequestHandler):
                 obj.metadata.namespace = ""
             created = self.cluster.create(obj)
             self._send_json(201, encode_obj(created))
+        # analyze: allow[silent-loss] exc becomes a typed HTTP Status response (_send_error_status)
         except Exception as exc:  # noqa: BLE001
             self._send_error_status(exc)
 
@@ -374,6 +376,7 @@ class _Handler(BaseHTTPRequestHandler):
             sub = "status" if route.subresource == "status" else ""
             updated = self.cluster.update(obj, subresource=sub)
             self._send_json(200, encode_obj(updated))
+        # analyze: allow[silent-loss] exc becomes a typed HTTP Status response (_send_error_status)
         except Exception as exc:  # noqa: BLE001
             self._send_error_status(exc)
 
@@ -399,6 +402,7 @@ class _Handler(BaseHTTPRequestHandler):
                 route.rt.cls, route.store_namespace, route.name,
                 self._read_body())
             self._send_json(200, encode_obj(patched))
+        # analyze: allow[silent-loss] exc becomes a typed HTTP Status response (_send_error_status)
         except Exception as exc:  # noqa: BLE001
             self._send_error_status(exc)
 
@@ -415,6 +419,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.cluster.delete(route.rt.cls, route.store_namespace,
                                 route.name)
             self._send_json(200, {"kind": "Status", "status": "Success"})
+        # analyze: allow[silent-loss] exc becomes a typed HTTP Status response (_send_error_status)
         except Exception as exc:  # noqa: BLE001
             self._send_error_status(exc)
 

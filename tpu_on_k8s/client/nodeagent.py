@@ -196,6 +196,7 @@ class NodeAgentLoop:
         # replay, and CRRs posted before start() must not wait for a resync.
         try:
             self.sync_once()
+        # analyze: allow[silent-loss] startup pre-pass; the 5-min resync re-runs sync_once and CRR status surfaces real failures
         except Exception:  # noqa: BLE001 — the daemon must survive blips
             pass
         while not self._stop.is_set():
@@ -211,6 +212,7 @@ class NodeAgentLoop:
                 # event; NOT the steady-state path
                 try:
                     self.sync_once()
+                # analyze: allow[silent-loss] resync heartbeat blip; next heartbeat retries, CRR status is the durable signal
                 except Exception:  # noqa: BLE001
                     pass
                 continue
@@ -221,5 +223,6 @@ class NodeAgentLoop:
                         self._handle(req)
                 except (ConflictError, NotFoundError):
                     pass  # racing the operator's collect — resync settles it
+                # analyze: allow[silent-loss] per-key blip; the key is re-queued by the next watch event or resync
                 except Exception:  # noqa: BLE001 — the daemon must survive
                     pass

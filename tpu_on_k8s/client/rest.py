@@ -400,6 +400,8 @@ class RestCluster:
                 try:
                     callback(WatchEvent("ADDED", obj.kind, obj))
                 except Exception:
+                    if self.metrics is not None:
+                        self.metrics.error()
                     _log.exception("watch callback failed on sync replay")
         for ev in ready:
             if not ev.wait(timeout=30):
@@ -418,6 +420,8 @@ class RestCluster:
             try:
                 cb(event)
             except Exception:
+                if self.metrics is not None:
+                    self.metrics.error()
                 _log.exception("watch callback failed",
                                extra={"kv": {"kind": event.kind}})
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict
 
 
@@ -24,22 +24,28 @@ def expectation_key(job_key: str, task_type: str, resource: str) -> str:
 class _Entry:
     adds: int = 0
     deletes: int = 0
-    timestamp: float = field(default_factory=time.monotonic)
+    timestamp: float = 0.0
 
 
 class Expectations:
-    def __init__(self, ttl_seconds: float = 300.0) -> None:
+    def __init__(self, ttl_seconds: float = 300.0,
+                 clock=time.monotonic) -> None:
         self._lock = threading.Lock()
         self._entries: Dict[str, _Entry] = {}
         self.ttl = ttl_seconds
+        # TTL expiry reads an injectable clock so expectation timeouts are
+        # steerable under the simulator's virtual time (ROADMAP item 5)
+        self._clock = clock
 
     def expect_creations(self, key: str, count: int) -> None:
         with self._lock:
-            self._entries[key] = _Entry(adds=count)
+            self._entries[key] = _Entry(adds=count,
+                                        timestamp=self._clock())
 
     def expect_deletions(self, key: str, count: int) -> None:
         with self._lock:
-            self._entries[key] = _Entry(deletes=count)
+            self._entries[key] = _Entry(deletes=count,
+                                        timestamp=self._clock())
 
     def creation_observed(self, key: str) -> None:
         self._observe(key, d_adds=-1)
@@ -62,7 +68,7 @@ class Expectations:
                 return True
             if e.adds <= 0 and e.deletes <= 0:
                 return True
-            if time.monotonic() - e.timestamp > self.ttl:
+            if self._clock() - e.timestamp > self.ttl:
                 # Expired expectations are treated as satisfied so a lost watch
                 # event cannot wedge the job forever.
                 return True

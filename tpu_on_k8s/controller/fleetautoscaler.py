@@ -309,6 +309,7 @@ class FleetAutoscaler:
             try:
                 return ps.scraper.scrape(state.fleet.pool(pool),
                                          seq=ps.seq)
+            # analyze: allow[silent-loss] falls through to the stale_scrapes counter + dead_sample — the outage IS counted
             except Exception:  # noqa: BLE001 — a dying fleet is an outage
                 pass
         if self.metrics is not None:
@@ -427,6 +428,7 @@ class FleetAutoscaler:
         if state.fleet is not None:
             try:
                 return state.scraper.scrape(state.fleet, seq=state.seq)
+            # analyze: allow[silent-loss] falls through to the stale_scrapes counter + dead_sample — the outage IS counted
             except Exception:  # noqa: BLE001 — a dying fleet is an outage
                 if self.metrics is not None:
                     self.metrics.inc("stale_scrapes")

@@ -187,6 +187,7 @@ class LeaderElector:
         try:
             self.cluster.update_with_retry(Lease, self.namespace,
                                            self.lease_name, mutate)
+        # analyze: allow[silent-loss] best-effort lease release — expiry is the fallback, and the failure is logged
         except Exception:
             # best-effort: the lease expires on its own if the release write
             # loses a race or the server is gone — but say so

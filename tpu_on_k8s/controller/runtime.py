@@ -216,6 +216,7 @@ class Manager:
                 if not c.process_one():
                     due = c.queue.next_due_in()
                     self._stop.wait(min(due, 0.05) if due is not None else 0.05)
+            # analyze: allow[silent-loss] process_one already re-queued the item with rate-limited backoff; logged here
             except Exception:  # reconcile errors are retried via backoff
                 _log.exception("reconcile failed (will retry with backoff)",
                                extra={"kv": {"controller": c.name}})

@@ -61,6 +61,7 @@ class Coordinator:
         self._uid_to_tenant: Dict[str, str] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._phase_sweep_countdown = 0    # 0 ⇒ next cycle sweeps
 
     # ------------------------------------------------------------------- intake
     def enqueue_or_update(self, job: TPUJob, owner) -> None:
@@ -125,9 +126,14 @@ class Coordinator:
             self._release_reservations(job.metadata.uid)
 
     # ------------------------------------------------------------------ cycle
+    #: scheduling cycles between job-phase gauge sweeps (~5 s at the
+    #: 100 ms loop period) — the sweep LISTs every TPUJob
+    PHASE_GAUGE_SWEEP_CYCLES = 50
+
     def schedule_once(self) -> Optional[str]:
         """One scheduling cycle (coordinator.go:310-374). Returns the dequeued
         job key, or None if nothing was schedulable."""
+        self._maybe_sweep_phase_gauges()
         with self._lock:
             queues = list(self._queues.values())
         queue = self.selector.next(queues)
@@ -254,6 +260,42 @@ class Coordinator:
         with self._lock:
             for name, queue in self._queues.items():
                 self.metrics.set_gauge("queue_pending", float(len(queue)), label=name)
+
+    def _update_phase_gauges(self) -> None:
+        """Cluster-wide job-phase gauges (reference metrics.go:33-124
+        keeps running/pending next to the queue depths): unfinished jobs
+        split by the Running condition. A full LIST — O(jobs) against
+        the API server in CRR mode — so it runs on the slow sweep
+        cadence below, never per enqueue/dequeue."""
+        running = pending = 0
+        for job in self.cluster.list(TPUJob):
+            if conditions.is_finished(job.status):
+                continue
+            if conditions.is_running(job.status):
+                running += 1
+            else:
+                pending += 1
+        self.metrics.set_gauge("running", float(running))
+        self.metrics.set_gauge("pending", float(pending))
+
+    def _maybe_sweep_phase_gauges(self) -> None:
+        """Every PHASE_GAUGE_SWEEP_CYCLES scheduling cycles (~5 s at the
+        100 ms loop period); counter-based so no wall clock enters the
+        scheduling path. The first cycle sweeps immediately. A failed
+        LIST (an API-server blip in CRR mode) must not abort the
+        scheduling cycle — it is counted, and the sweep retries next
+        cycle instead of waiting out a full period."""
+        if self._phase_sweep_countdown > 0:
+            self._phase_sweep_countdown -= 1
+            return
+        try:
+            self._update_phase_gauges()
+        except Exception:
+            self.metrics.error()
+            _log.warning("job-phase gauge sweep failed; retrying next "
+                         "cycle", exc_info=True)
+            return               # countdown stays 0 → next cycle retries
+        self._phase_sweep_countdown = self.PHASE_GAUGE_SWEEP_CYCLES - 1
 
     # --------------------------------------------------------------- run loop
     def run(self) -> None:

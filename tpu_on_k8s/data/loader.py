@@ -265,5 +265,6 @@ class DataLoader:
     def __del__(self):
         try:
             self.close()
+        # analyze: allow[silent-loss] __del__ at interpreter teardown — raising would print unraisable noise over a closed stream
         except Exception:
             pass

@@ -382,6 +382,7 @@ class SliceGangAdmission:
         if self.pools:
             try:
                 self._ensure_recovered()
+            # analyze: allow[silent-loss] startup recovery warns with exc_info and retries on every sync() tick
             except Exception:
                 from tpu_on_k8s.utils.logging import get_logger
                 get_logger("slicescheduler").warning(
@@ -627,6 +628,7 @@ class SliceSchedulerLoop:
         while not self._stop.is_set():
             try:
                 self.admission.sync()
+            # analyze: allow[silent-loss] scheduler loop survival; the failure is logged and the next tick retries
             except Exception:  # noqa: BLE001 — the loop must survive blips
                 from tpu_on_k8s.utils.logging import get_logger
                 get_logger("slicescheduler").exception("admission sync failed")

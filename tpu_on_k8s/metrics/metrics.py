@@ -241,7 +241,12 @@ class ServingMetrics(_MetricsBase):
                      # replay budget ran out — together these prove
                      # no request is ever silently lost to a crash
                      "engine_crashes", "requests_replayed",
-                     "retry_exhausted"):
+                     "retry_exhausted",
+                     # streaming callbacks that raised and were detached
+                     # (engine on_token/on_retire, gateway token hook):
+                     # each detach warns AND counts, so a misbehaving
+                     # frontend is visible on a scrape, not only in logs
+                     "callback_errors"):
             self._declare(name, f"{ns}_{name}", "counter",
                           f"Serving {name}")
         for name in ("time_to_first_token_seconds",
@@ -270,7 +275,11 @@ class TrainMetrics(_MetricsBase):
             self.registry = registry or _prom.CollectorRegistry()
         ns = "tpu_on_k8s_train"
         for name in ("host_syncs", "checkpoints_enqueued",
-                     "checkpoint_failures", "stalled_steps"):
+                     "checkpoint_failures", "stalled_steps",
+                     # profiling hooks that failed and degraded to
+                     # warnings (server bind, trace start/finalize) —
+                     # best-effort, but never silent
+                     "profiling_failures"):
             self._declare(name, f"{ns}_{name}", "counter",
                           f"Training loop {name}")
         for name in ("step_seconds", "tokens_per_sec", "mfu",
@@ -310,7 +319,11 @@ class FleetMetrics(_MetricsBase):
                        # count the disagg acceptance test compares
                        "prefix_store_hits", "prefix_store_misses",
                        "prefix_store_promotes", "prefix_store_evictions",
-                       "prefix_store_demotes")
+                       "prefix_store_demotes",
+                       # streaming callbacks that raised and were
+                       # detached (disagg token hook) — warned AND
+                       # counted, mirroring ServingMetrics
+                       "callback_errors")
     _LABELED_GAUGES = ("in_flight", "queue_depth", "outstanding_tokens")
     _PLAIN_GAUGES = ("replicas_ready", "replicas_total", "rollout_phase",
                      "handoff_queue_depth", "prefix_store_overflow_bytes")
@@ -426,6 +439,19 @@ class AutoscaleMetrics(_MetricsBase):
 
     def decision(self, action: str) -> None:
         self.inc("decisions", label=action)
+
+
+def count_detached_callback(metrics, message: str) -> None:
+    """The count-and-warn tail shared by every streaming-callback
+    isolation site (engine ``on_token``/``on_retire``, gateway and
+    disagg token hooks): the CALLER has already detached the raising
+    callback — which attribute to clear is site-specific — and this
+    records it on the ``callback_errors`` counter (when a metrics sink
+    is attached) plus a warning carrying the site's message."""
+    if metrics is not None:
+        metrics.inc("callback_errors")
+    import warnings
+    warnings.warn(message, stacklevel=3)
 
 
 def _escape_label(v: str) -> str:

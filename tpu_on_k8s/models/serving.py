@@ -251,7 +251,8 @@ class ContinuousBatchingEngine:
                  rng: Optional[jax.Array] = None, mesh=None, rules=None,
                  step_horizon: int = 1, metrics=None,
                  int8_weights: bool = False, prefill_chunk: int = 0,
-                 queue_cap: Optional[int] = None, on_retire=None):
+                 queue_cap: Optional[int] = None, on_retire=None,
+                 clock=time.monotonic):
         if step_horizon < 1:
             raise ValueError(f"step_horizon must be >= 1, got {step_horizon}")
         if queue_cap is not None and queue_cap < 1:
@@ -272,6 +273,11 @@ class ContinuousBatchingEngine:
         #: counters, TTFT/queue-wait/latency histograms, slot/queue gauges,
         #: scrapeable via the same metrics.serve() path the operator uses.
         self.metrics = metrics
+        #: every queue/slot timestamp (submitted_at, dequeued_at, the
+        #: TTFT/queue-wait/latency observations) reads THIS clock — the
+        #: gateway/fleet/serve_load thread their injectable (virtual)
+        #: clock through, so seeded replays are wall-time-free end to end
+        self._clock = clock
         max_len = max_len or cfg.max_seq_len
         if max_len > cfg.max_seq_len and cfg.pos_emb != "rope":
             raise ValueError("max_len beyond the trained table needs rope")
@@ -574,7 +580,7 @@ class ContinuousBatchingEngine:
             rid = self._next_id
             self._next_id += 1
             self._queue.append(_Pending(rid, prompt, max_new_tokens,
-                                        eos_id, time.monotonic(),
+                                        eos_id, self._clock(),
                                         prefix_id, on_token))
             depth = len(self._queue)
         if self.metrics is not None:
@@ -632,7 +638,7 @@ class ContinuousBatchingEngine:
             self._next_id += 1
             self._kv_queue.append(_KVPending(
                 rid, handoff, max_new_tokens, eos_id, prefix_id,
-                time.monotonic(), on_token))
+                self._clock(), on_token))
         if self.metrics is not None:
             self.metrics.inc("requests_submitted")
         return rid
@@ -855,12 +861,12 @@ class ContinuousBatchingEngine:
                              else init_cache(self._prefill_model, 1))
                 self._prefilling = _Prefilling(
                     req, pre_cache, plen, plen,
-                    plen + int(req.prompt.size), time.monotonic())
+                    plen + int(req.prompt.size), self._clock())
                 self._advance_prefill()
                 continue
             try:
                 if prefix_cache is not None:
-                    dequeued_at = time.monotonic()
+                    dequeued_at = self._clock()
                     slen = int(req.prompt.size)
                     self._rng, key = jax.random.split(self._rng)
                     # the suffix bucket may not spill past max_len:
@@ -878,7 +884,7 @@ class ContinuousBatchingEngine:
                                            plen + slen, dequeued_at)
                     continue
                 b = len(group)
-                dequeued_at = time.monotonic()
+                dequeued_at = self._clock()
                 lps = np.asarray([r.prompt.size for r in group], np.int32)
                 padded = np.zeros((b, bucket), np.int32)
                 for j, r in enumerate(group):
@@ -950,27 +956,28 @@ class ContinuousBatchingEngine:
             self.metrics.observe("queue_wait_seconds",
                                  dequeued_at - req.submitted_at)
             self.metrics.observe("time_to_first_token_seconds",
-                                 time.monotonic() - req.submitted_at)
+                                 self._clock() - req.submitted_at)
             self.metrics.inc("tokens_emitted")
             self.metrics.set_gauge("queue_depth", len(self._queue))
         self._retire_if_done(i)
 
-    @staticmethod
-    def _fire_on_token(slot: _Slot, token: int) -> None:
+    def _fire_on_token(self, slot: _Slot, token: int) -> None:
         """Streaming callbacks run between device steps — a raising
         callback (e.g. a disconnected SSE client) must not unwind the
         engine loop mid-horizon, or OTHER slots' host state desyncs from
-        the already-advanced device cache. Detach it and keep serving."""
+        the already-advanced device cache. Detach it, count it, keep
+        serving."""
         if slot.on_token is None:
             return
         try:
             slot.on_token(slot.request_id, token)
         except Exception as e:  # noqa: BLE001 — isolate per-request faults
             slot.on_token = None
-            import warnings
-            warnings.warn(f"on_token callback for request "
-                          f"{slot.request_id} raised {type(e).__name__}: "
-                          f"{e}; streaming detached", stacklevel=2)
+            from tpu_on_k8s.metrics.metrics import count_detached_callback
+            count_detached_callback(
+                self.metrics,
+                f"on_token callback for request {slot.request_id} raised "
+                f"{type(e).__name__}: {e}; streaming detached")
 
     def _retire_if_done(self, i: int) -> bool:
         slot = self._slots[i]
@@ -985,16 +992,19 @@ class ContinuousBatchingEngine:
             if self.metrics is not None:
                 self.metrics.inc("requests_finished")
                 self.metrics.observe("request_latency_seconds",
-                                     time.monotonic() - slot.submitted_at)
+                                     self._clock() - slot.submitted_at)
             if self._on_retire is not None:
                 try:
                     self._on_retire(slot.request_id, tokens)
                 except Exception as e:  # noqa: BLE001 — isolate like on_token
                     self._on_retire = None
-                    import warnings
-                    warnings.warn(f"on_retire callback raised "
-                                  f"{type(e).__name__}: {e}; detached",
-                                  stacklevel=2)
+                    from tpu_on_k8s.metrics.metrics import (
+                        count_detached_callback,
+                    )
+                    count_detached_callback(
+                        self.metrics,
+                        f"on_retire callback raised {type(e).__name__}: "
+                        f"{e}; detached")
         return done
 
     def abort(self, request_id: int) -> Optional[np.ndarray]:

@@ -68,7 +68,10 @@ from typing import Callable, Deque, Dict, List, Optional, Union
 import numpy as np
 
 from tpu_on_k8s import chaos
-from tpu_on_k8s.metrics.metrics import ServingMetrics
+from tpu_on_k8s.metrics.metrics import (
+    ServingMetrics,
+    count_detached_callback,
+)
 from tpu_on_k8s.obs.trace import STATUS_ERROR, ensure as ensure_tracer
 from tpu_on_k8s.serve.admission import (
     REASON_DRAINING,
@@ -726,6 +729,14 @@ class DisaggFleet:
             done = (len(payload.emitted) >= req.max_new_tokens
                     or (req.eos_id is not None
                         and payload.first_token == req.eos_id))
+            # the injector runs OUTSIDE the fleet lock: an injected
+            # fault's trigger/event bookkeeping must never execute (or
+            # raise) while holding it. Same call cadence — once per
+            # non-done prefill completion — so seeded schedules land on
+            # the same requests as before.
+            fault = (None if done else
+                     chaos.fire(chaos.SITE_KV_HANDOFF, rid=rid,
+                                replica=rep.name))
             with self._lock:
                 if done:
                     # the prefill's own sampled token already satisfied
@@ -734,8 +745,6 @@ class DisaggFleet:
                     self._finalize_locked(req, RequestState.DONE,
                                           payload.emitted)
                     continue
-                fault = chaos.fire(chaos.SITE_KV_HANDOFF, rid=rid,
-                                   replica=rep.name)
                 if isinstance(fault, chaos.HandoffLoss):
                     rep.outstanding -= req.cost
                     self.stats["handoffs_lost"] += 1
@@ -855,6 +864,7 @@ class DisaggFleet:
                     ho.payload, req.max_new_tokens, eos_id=req.eos_id,
                     prefix_id=pid if ho.payload.base > 0 else None,
                     on_token=self._wrap_on_token(req))
+            # analyze: allow[silent-loss] handoff is re-queued at head below — deferral IS the handling, nothing terminal here
             except Exception as e:  # noqa: BLE001 — engine refusal/crash
                 # the popped handoff must NOT be stranded (it lives in no
                 # scanned container — the request could never reach a
@@ -920,10 +930,10 @@ class DisaggFleet:
             req.on_token(req.rid, int(token))
         except Exception as e:  # noqa: BLE001 — isolate per-request faults
             req.on_token = None
-            import warnings
-            warnings.warn(f"on_token callback for request {req.rid} "
-                          f"raised {type(e).__name__}: {e}; streaming "
-                          f"detached", stacklevel=2)
+            count_detached_callback(
+                self.metrics,
+                f"on_token callback for request {req.rid} raised "
+                f"{type(e).__name__}: {e}; streaming detached")
 
     def _step_decode(self, now: float) -> None:
         # local import (gateway.py convention): serve stays importable

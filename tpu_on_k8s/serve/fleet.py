@@ -425,6 +425,7 @@ class ServingFleet:
         # same-bucket submit serves cold instead of double-registering.
         try:
             new_pid = rep.engine.register_prefix(reg)
+        # analyze: allow[silent-loss] typed fallback: serve cold; a dead replica is ejected by the next fleet step
         except Exception:                  # noqa: BLE001 — replica died
             new_pid = None                 # under us; serve cold instead
         with self._lock:

@@ -55,6 +55,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Union
 
+from tpu_on_k8s.metrics.metrics import count_detached_callback
 from tpu_on_k8s.obs.trace import STATUS_ERROR, ensure as ensure_tracer
 from tpu_on_k8s.serve.admission import (
     REASON_DEADLINE,
@@ -362,11 +363,10 @@ class ServingGateway:
                     req.on_token(req.rid, token)
                 except Exception as e:  # noqa: BLE001
                     req.on_token = None
-                    import warnings
-                    warnings.warn(
+                    count_detached_callback(
+                        self.metrics,
                         f"on_token callback for request {req.rid} raised "
-                        f"{type(e).__name__}: {e}; streaming detached",
-                        stacklevel=2)
+                        f"{type(e).__name__}: {e}; streaming detached")
         return hook
 
     def _release_replays_locked(self, now: float) -> None:

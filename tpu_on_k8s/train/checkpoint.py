@@ -62,7 +62,7 @@ class CheckpointManager:
     # ------------------------------------------------------------- discovery
     def generations(self) -> Sequence[int]:
         out = []
-        for child in self.root.iterdir() if self.root.exists() else []:
+        for child in sorted(self.root.iterdir()) if self.root.exists() else []:
             m = _GEN_RE.match(child.name)
             if m:
                 out.append(int(m.group(1)))

@@ -265,6 +265,8 @@ class TrainLoop:
             try:
                 profiling.start_server(self.profiler_port)
             except Exception as e:  # noqa: BLE001 — port taken, no backend
+                if self.metrics is not None:
+                    self.metrics.inc("profiling_failures")
                 warnings.warn(f"profiler server on :{self.profiler_port} "
                               f"unavailable: {e}")
         if self.profile_dir is None:
@@ -274,6 +276,8 @@ class TrainLoop:
         try:
             capture.enter_context(profiling.trace(self.profile_dir))
         except Exception as e:  # noqa: BLE001
+            if self.metrics is not None:
+                self.metrics.inc("profiling_failures")
             warnings.warn(f"XLA trace capture into {self.profile_dir} "
                           f"unavailable: {e}")
         try:
@@ -284,6 +288,8 @@ class TrainLoop:
             try:
                 capture.close()
             except Exception as e:  # noqa: BLE001
+                if self.metrics is not None:
+                    self.metrics.inc("profiling_failures")
                 warnings.warn(f"XLA trace capture into {self.profile_dir} "
                               f"failed to finalize: {e}")
 

@@ -57,6 +57,7 @@ def bounded_map(fn: Callable[[T], R], items: Iterable[T], width: int,
             i = futures[fut]
             try:
                 out[i] = (fut.result(), None)
+            # analyze: allow[silent-loss] the exception is returned to the caller in the (result, error) tuple
             except BaseException as e:  # noqa: BLE001 — collected, not raised
                 out[i] = (None, e)
     return out
