@@ -7,7 +7,7 @@
 IMG ?= tpu-on-k8s/manager:latest
 
 .PHONY: test test-fast analyze lint chaos-soak fleet-soak autoscale-soak \
-        disagg-soak trace-demo native bench dryrun manager samples clean \
+        disagg-soak spec-soak trace-demo native bench dryrun manager samples clean \
         docker-build docker-push deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
@@ -16,6 +16,7 @@ CHAOS_SEED ?= 1234
 FLEET_SEED ?= 4321
 AUTOSCALE_SEED ?= 2468
 DISAGG_SEED ?= 8642
+SPEC_SEED ?= 7531
 TRACE_SEED ?= 8642
 TRACE_FLAGS = --disagg --n-requests 24 --prefix-bucket 8 --prompt-min 4 \
     --prompt-max 12 --new-min 4 --new-max 8 --decode-replicas 2 \
@@ -56,6 +57,11 @@ disagg-soak:  ## disagg fleet vs monolithic control, disagg arm twice: byte-iden
 	    --n-requests 24 --prefix-bucket 8 --prompt-min 4 --prompt-max 12 \
 	    --new-min 4 --new-max 8 --decode-replicas 2 \
 	    --shared-prefixes 2 --shared-fraction 0.8 --seed $(DISAGG_SEED)
+
+spec-soak:  ## speculative vs plain decode on the seeded cost-model trace, spec arm twice: byte-identical event logs + token identity + acceptance >= 0.7 + TPOT p95 win
+	JAX_PLATFORMS=cpu python tools/serve_load.py --spec --soak \
+	    --n-requests 32 --rate 2.0 --prompt-min 4 --prompt-max 12 \
+	    --new-min 6 --new-max 16 --seed $(SPEC_SEED)
 
 trace-demo:  ## seeded disagg trace dumped twice: byte-identical span dumps + the TTFT critical-path report
 	JAX_PLATFORMS=cpu python tools/serve_load.py $(TRACE_FLAGS) \

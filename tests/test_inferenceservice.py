@@ -308,3 +308,62 @@ def test_scale_down_drains_surplus():
     clock.advance(6.0)
     pump(manager, clock)
     assert len(svc_pods(cluster)) == 1
+
+
+def test_decode_policy_change_rolls_the_fleet():
+    """Flipping `DecodePolicy` (int8 weights, a speculative draft) is a
+    ROLLOUT, not a hot swap: the policy folds into the replica identity
+    hash (`decode_variant`), so the reconciler surges new-variant pods
+    carrying --serve-int8/--spec-draft args, canaries them, drains the
+    old — the exact machinery a new image rides."""
+    from tpu_on_k8s.api.inference_types import DecodePolicy
+    from tpu_on_k8s.controller.inferenceservice import decode_variant
+
+    policy = DecodePolicy(int8_weights=True, draft_model="gpt2-draft",
+                          spec_k=3)
+    cluster, manager, sim, clock = make_env()
+    make_model(cluster)
+    make_svc(cluster, replicas=2,
+             rollout=RolloutPolicy(max_surge=1, max_unavailable=0,
+                                   drain_seconds=5.0))
+    manager.run_until_idle()
+    sim.run_all("default")
+    manager.run_until_idle()
+    h_plain = image_hash("reg.local/m1:v1")
+    for p in svc_pods(cluster):
+        assert "--serve-int8" not in p.spec.containers[0].args
+
+    def set_decode(s: InferenceService) -> None:
+        s.spec.decode = policy
+    cluster.update_with_retry(InferenceService, "default", "svc",
+                              set_decode)
+    manager.run_until_idle()
+    h_int8 = image_hash(decode_variant("reg.local/m1:v1", policy))
+    assert h_int8 != h_plain
+    by_hash = {}
+    for p in svc_pods(cluster):
+        by_hash.setdefault(
+            p.metadata.labels[constants.LABEL_SERVING_IMAGE_HASH],
+            []).append(p)
+    # surge: ONE new-variant replica; both old still serving
+    assert len(by_hash[h_int8]) == 1 and len(by_hash[h_plain]) == 2
+    args = by_hash[h_int8][0].spec.containers[0].args
+    assert "--serve-int8" in args
+    assert "--spec-draft=gpt2-draft" in args and "--spec-k=3" in args
+
+    sim.run_all("default")
+    manager.run_until_idle()
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.canary_weight > 0     # canary split granted
+
+    for _ in range(8):                      # drain grace -> reap -> surge
+        clock.advance(6.0)
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.phase is ServicePhase.READY
+    assert svc.status.canary_weight == 1.0
+    hashes = {p.metadata.labels[constants.LABEL_SERVING_IMAGE_HASH]
+              for p in svc_pods(cluster)}
+    assert hashes == {h_int8}               # promoted: old variant gone

@@ -13,7 +13,7 @@ Pins the ISSUE 7 contracts:
   sum to the measured TTFT exactly under a virtual clock;
 * `metrics.exposition` — never a RuntimeError without prometheus_client:
   the pure-Python fallback renders a parseable, correctly escaped
-  text-format body for all five metrics classes;
+  text-format body for all six metrics classes;
 * the resilience.md chaos-site table stays complete against `SITE_*`.
 """
 from __future__ import annotations
@@ -37,6 +37,7 @@ from tpu_on_k8s.metrics.metrics import (
     FleetMetrics,
     JobMetrics,
     ServingMetrics,
+    SpecMetrics,
     TrainMetrics,
     exposition,
     render_text,
@@ -487,6 +488,10 @@ def _populate(m):
         m.inc("requests_submitted", 4)
         m.observe("time_to_first_token_seconds", 0.02, exemplar=9)
         m.set_gauge("queue_depth", 1.0)
+    elif isinstance(m, SpecMetrics):
+        m.inc("spec_tokens_proposed", 8)
+        m.inc("spec_tokens_accepted", 6)
+        m.set_gauge("spec_acceptance_rate", 0.75)
     elif isinstance(m, TrainMetrics):
         m.inc("host_syncs")
         m.set_gauge("mfu", 0.42)
@@ -500,8 +505,8 @@ def _populate(m):
         m.set_gauge("desired_replicas", 3.0, label="default/svc")
 
 
-_ALL_CLASSES = (JobMetrics, ServingMetrics, TrainMetrics, FleetMetrics,
-                AutoscaleMetrics)
+_ALL_CLASSES = (JobMetrics, ServingMetrics, SpecMetrics, TrainMetrics,
+                FleetMetrics, AutoscaleMetrics)
 
 
 class TestExposition:

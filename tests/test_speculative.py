@@ -1,0 +1,561 @@
+"""Production speculative decoding + int8 serving.
+
+The correctness contract is greedy TOKEN IDENTITY: the batched
+speculative engine (`models/serving.py` — per-slot drafts, one batched
+verify forward, per-row accept/rollback by position bookkeeping) must
+reproduce plain ``generate()`` exactly through every feature it
+composes with — staggered admission, slot reuse, prefix caching,
+chunked prefill, mid-decode abort, mid-speculation ``export_kv``, KV
+adoption, and degrade-on-draft-crash. The int8 half pins the
+``convert.quantize_serving_tree`` emit path (logits tolerance vs the
+source tree) and the fleet canary: convert → two versions → router
+split → rollout promote.
+"""
+import dataclasses
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import yaml
+
+from tpu_on_k8s.chaos import scenarios
+from tpu_on_k8s.metrics.metrics import SpecMetrics, exposition
+from tpu_on_k8s.models.decode import generate, truncated_draft
+from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = dataclasses.replace(TransformerConfig.tiny(), dtype=jnp.float32,
+                              max_seq_len=64)
+    tok = jax.random.randint(jax.random.key(0), (1, 8), 0, cfg.vocab_size,
+                             jnp.int32)
+    params = Transformer(cfg).init(jax.random.key(1), tok)["params"]
+    dcfg, dparams = truncated_draft(cfg, params, 1)
+    return cfg, params, dcfg, dparams
+
+
+def _want(cfg, params, prompt, n):
+    """Oracle: the single-request greedy continuation."""
+    return np.asarray(generate(cfg, params,
+                               jnp.asarray(prompt, jnp.int32)[None, :],
+                               max_new_tokens=n))[0]
+
+
+def _engine(setup, **kw):
+    cfg, params, dcfg, dparams = setup
+    kw.setdefault("n_slots", 2)
+    return ContinuousBatchingEngine(cfg, params, draft_cfg=dcfg,
+                                    draft_params=dparams, spec_k=3, **kw)
+
+
+def _prompts(cfg, rng, sizes):
+    return [rng.integers(0, cfg.vocab_size, size=n).astype(np.int32)
+            for n in sizes]
+
+
+# ---------------------------------------------------------------- oracles
+def test_staggered_spec_decode_matches_generate(setup):
+    """Ragged requests admitted at different times through the
+    speculative engine — each continuation equals its solo generate()
+    output, with the truncated draft forcing BOTH accept and rollback
+    paths to fire."""
+    cfg, params, _, _ = setup
+    rng = np.random.default_rng(3)
+    prompts = _prompts(cfg, rng, (5, 11, 3))
+    news = [10, 6, 12]
+    sm = SpecMetrics()
+    eng = _engine(setup, spec_metrics=sm)
+    r0 = eng.submit(prompts[0], news[0])
+    eng.step()
+    eng.step()
+    r1 = eng.submit(prompts[1], news[1])
+    eng.step()
+    r2 = eng.submit(prompts[2], news[2])    # queued: both slots busy
+    out = eng.run()
+    for rid, prompt, n in zip((r0, r1, r2), prompts, news):
+        np.testing.assert_array_equal(out[rid],
+                                      _want(cfg, params, prompt, n),
+                                      err_msg=f"request {rid}")
+    st = eng.stats
+    assert st["spec_rounds"] > 0 and st["spec_proposed"] > 0
+    assert st["spec_rollbacks"] > 0     # the 1-layer draft does miss
+    assert sm.counters["spec_tokens_proposed"] == st["spec_proposed"]
+    assert sm.gauges["spec_acceptance_rate"] == pytest.approx(
+        st["spec_accepted"] / st["spec_proposed"])
+
+
+def test_self_draft_accepts_everything(setup):
+    """draft == target: every proposal is accepted (the mechanism upper
+    bound), each round emits k+1 tokens, and output stays exact."""
+    cfg, params, _, _ = setup
+    rng = np.random.default_rng(4)
+    prompt = _prompts(cfg, rng, (6,))[0]
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2, draft_cfg=cfg,
+                                   draft_params=params, spec_k=3)
+    r = eng.submit(prompt, 11)
+    out = eng.run()
+    np.testing.assert_array_equal(out[r], _want(cfg, params, prompt, 11))
+    assert eng.stats["spec_accepted"] == eng.stats["spec_proposed"] > 0
+    assert eng.stats["spec_rollbacks"] == 0
+    # k=3 accepted + correction: 4 tokens per round after the prefill's
+    # first — 11 tokens in ceil(10/4) = 3 rounds
+    assert eng.stats["spec_rounds"] == 3
+
+
+def test_spec_slot_reuse_after_retirement(setup):
+    """A slot freed by a finished request serves a new one — stale
+    target AND draft cache rows must never leak into attention."""
+    cfg, params, _, _ = setup
+    rng = np.random.default_rng(5)
+    long_p, short_p = _prompts(cfg, rng, (20, 4))
+    eng = _engine(setup, n_slots=1)
+    ra = eng.submit(long_p, 8)
+    out_a = eng.run()[ra]
+    rb = eng.submit(short_p, 16)
+    out_b = eng.run()[rb]
+    np.testing.assert_array_equal(out_a, _want(cfg, params, long_p, 8))
+    np.testing.assert_array_equal(out_b, _want(cfg, params, short_p, 16))
+
+
+def test_spec_prefix_caching_matches_full_prompt(setup):
+    """register_prefix mirrors through the draft: a prefix-seeded
+    request drafts AND matches the full-prompt oracle."""
+    cfg, params, _, _ = setup
+    rng = np.random.default_rng(6)
+    pre, suf = _prompts(cfg, rng, (7, 5))
+    eng = _engine(setup)
+    pid = eng.register_prefix(pre)
+    r = eng.submit(suf, 9, prefix_id=pid)
+    out = eng.run()
+    np.testing.assert_array_equal(
+        out[r], _want(cfg, params, np.concatenate([pre, suf]), 9))
+    assert eng.stats["spec_rounds"] > 0
+
+
+def test_spec_chunked_prefill_matches_whole_prompt(setup):
+    """Chunked prefill + speculation: the draft seeds from the full
+    prompt in one call regardless of the target's chunk boundaries."""
+    cfg, params, dcfg, dparams = setup
+    rng = np.random.default_rng(7)
+    long_p = _prompts(cfg, rng, (30,))[0]
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2,
+                                   prefill_chunk=8, draft_cfg=dcfg,
+                                   draft_params=dparams, spec_k=3)
+    r = eng.submit(long_p, 8)
+    out = eng.run()
+    np.testing.assert_array_equal(out[r], _want(cfg, params, long_p, 8))
+
+
+def test_spec_mid_decode_abort(setup):
+    """Aborting one speculating slot mid-flight frees it and leaves the
+    other slot's output token-identical."""
+    cfg, params, _, _ = setup
+    rng = np.random.default_rng(8)
+    pa, pb = _prompts(cfg, rng, (6, 9))
+    eng = _engine(setup)
+    ra = eng.submit(pa, 14)
+    rb = eng.submit(pb, 10)
+    eng.step()
+    partial = eng.abort(ra)
+    assert partial is not None and partial.size >= 1
+    # the aborted prefix is itself oracle-exact
+    np.testing.assert_array_equal(
+        partial, _want(cfg, params, pa, 14)[:partial.size])
+    out = eng.run()
+    assert ra not in out
+    np.testing.assert_array_equal(out[rb], _want(cfg, params, pb, 10))
+
+
+def test_export_kv_mid_speculation_adopts_exactly(setup):
+    """`export_kv` during speculation: `pos` counts only ACCEPTED
+    tokens, the payload trims to their 128-bucket, and a plain engine
+    adopting the handoff continues token-identically — migration works
+    mid-spec."""
+    cfg, params, _, _ = setup
+    rng = np.random.default_rng(9)
+    p = _prompts(cfg, rng, (6,))[0]
+    eng = _engine(setup)
+    r = eng.submit(p, 14)
+    eng.step()
+    eng.step()
+    h = eng.export_kv(r)
+    assert h is not None and h.verify()
+    assert len(h.emitted) == h.pos - p.size + 1
+    eng.abort(r)
+    plain = ContinuousBatchingEngine(cfg, params, n_slots=2)
+    r2 = plain.submit_kv(h, 14)
+    np.testing.assert_array_equal(plain.run()[r2],
+                                  _want(cfg, params, p, 14))
+
+
+def test_adopted_handoff_decodes_plain_beside_spec_slots(setup):
+    """A `submit_kv` adoption carries no prompt tokens, so its slot
+    cannot be drafted — it decodes plain INSIDE the same spec rounds,
+    token-identically, while drafted slots keep speculating."""
+    cfg, params, _, _ = setup
+    rng = np.random.default_rng(10)
+    pa, pb = _prompts(cfg, rng, (4, 9))
+    src = ContinuousBatchingEngine(cfg, params, n_slots=1)
+    ra = src.submit(pa, 12)
+    src.step()
+    h = src.export_kv(ra)
+    src.abort(ra)
+    eng = _engine(setup)
+    rk = eng.submit_kv(h, 12)
+    rb = eng.submit(pb, 10)
+    out = eng.run()
+    np.testing.assert_array_equal(out[rk], _want(cfg, params, pa, 12))
+    np.testing.assert_array_equal(out[rb], _want(cfg, params, pb, 10))
+    assert eng.stats["spec_rounds"] > 0
+
+
+def test_imported_prefix_slot_degrades_to_plain(setup):
+    """An `import_prefix` id never saw token content, so the draft
+    cannot mirror it: requests under it decode plain — exact, just
+    unaccelerated — while plain-prompt slots still draft."""
+    cfg, params, _, _ = setup
+    rng = np.random.default_rng(11)
+    pre, suf = _prompts(cfg, rng, (7, 5))
+    donor = ContinuousBatchingEngine(cfg, params, n_slots=1)
+    pid0 = donor.register_prefix(pre)
+    host, lp = donor.export_prefix(pid0)
+    eng = _engine(setup)
+    pid = eng.import_prefix(host, lp)
+    r = eng.submit(suf, 9, prefix_id=pid)
+    out = eng.run()
+    np.testing.assert_array_equal(
+        out[r], _want(cfg, params, np.concatenate([pre, suf]), 9))
+    # an all-undrafted pool takes the PLAIN step — no spec rounds, no
+    # (k+1)-wide verify paid to emit one token per slot
+    assert eng.stats["spec_proposed"] == 0
+    assert eng.stats["spec_rounds"] == 0
+
+
+# ------------------------------------------------------- chaos / degrade
+def test_draft_crash_degrades_to_plain_zero_loss(setup):
+    """SITE_SPEC_DRAFT DraftCrash mid-stream: the engine drops the
+    draft, finishes every in-flight request on the plain path
+    token-identically, and counts the crash — zero silent loss."""
+    cfg, params, _, _ = setup
+    rng = np.random.default_rng(12)
+    pa, pb = _prompts(cfg, rng, (6, 9))
+    sm = SpecMetrics()
+    scenario = scenarios.spec_draft_crash(at_round=2)
+    with scenario.injector():
+        eng = _engine(setup, spec_metrics=sm)
+        ra = eng.submit(pa, 14)
+        rb = eng.submit(pb, 10)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = eng.run()
+    np.testing.assert_array_equal(out[ra], _want(cfg, params, pa, 14))
+    np.testing.assert_array_equal(out[rb], _want(cfg, params, pb, 10))
+    assert eng.stats["draft_crashes"] == 1
+    assert eng._draft is None                     # degraded for good
+    assert 1 <= eng.stats["spec_rounds"] <= 2     # crashed on round 2
+    assert sm.counters["spec_draft_crashes"] == 1
+    body = exposition(sm)
+    assert "tpu_on_k8s_spec_draft_crashes_total 1.0" in body
+
+
+def test_spec_validation(setup):
+    cfg, params, dcfg, dparams = setup
+    with pytest.raises(ValueError, match="step_horizon"):
+        ContinuousBatchingEngine(cfg, params, draft_cfg=dcfg,
+                                 draft_params=dparams, step_horizon=4)
+    with pytest.raises(ValueError, match="greedy"):
+        ContinuousBatchingEngine(cfg, params, draft_cfg=dcfg,
+                                 draft_params=dparams, temperature=0.7)
+    with pytest.raises(ValueError, match="vocab"):
+        bad = dataclasses.replace(dcfg, vocab_size=cfg.vocab_size * 2)
+        ContinuousBatchingEngine(cfg, params, draft_cfg=bad,
+                                 draft_params=dparams)
+    with pytest.raises(ValueError, match="spec_k"):
+        ContinuousBatchingEngine(cfg, params, draft_cfg=dcfg,
+                                 draft_params=dparams, spec_k=0)
+    with pytest.raises(ValueError, match="come together"):
+        ContinuousBatchingEngine(cfg, params, draft_cfg=dcfg)
+    with pytest.raises(ValueError, match="draft layers"):
+        truncated_draft(cfg, params, cfg.n_layers)
+
+
+# ------------------------------------------------------------ int8 emit
+def test_quantize_serving_tree_logits_tolerance(setup):
+    """convert → serve round trip: the emitted int8 tree's decode-mode
+    logits stay within int8-rounding tolerance of the source tree, and
+    the engine serves it directly."""
+    from tpu_on_k8s.models.convert import quantize_serving_tree
+    from tpu_on_k8s.models.decode import cache_shapes, decode_model
+
+    cfg, params, _, _ = setup
+    icfg, iparams = quantize_serving_tree(cfg, params)
+    assert icfg.serve_int8_weights
+    tok = jax.random.randint(jax.random.key(2), (1, 8), 0,
+                             cfg.vocab_size, jnp.int32)
+    pos = jnp.arange(8)[None, :]
+
+    def logits(c, p):
+        m = decode_model(c)
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                             cache_shapes(m, 1))
+        out, _ = m.apply({"params": p, "cache": cache}, tok, pos,
+                         mutable=["cache"])
+        return out
+
+    ref, got = logits(cfg, params), logits(icfg, iparams)
+    rel = float(jnp.max(jnp.abs(got - ref))
+                / (jnp.max(jnp.abs(ref)) + 1e-9))
+    assert rel < 0.05, f"int8 logits diverge: rel max err {rel}"
+    # the engine serves the emitted tree as-is (pre-quantized config)
+    eng = ContinuousBatchingEngine(icfg, iparams, n_slots=2)
+    rng = np.random.default_rng(13)
+    r = eng.submit(rng.integers(0, cfg.vocab_size, 6).astype(np.int32), 5)
+    out = eng.run()[r]
+    assert out.shape == (5,) and (out >= 0).all()
+    # re-quantizing an int8 tree is an error, not silent double rounding
+    with pytest.raises(ValueError, match="already int8"):
+        quantize_serving_tree(icfg, iparams)
+
+
+def test_quantize_serving_tree_stochastic(setup):
+    """The Pallas stochastic-rounding emit path (ops/quantization.py):
+    same tree structure, same tolerance. Skipped where the TPU-flavored
+    interpreter is unavailable (the same environments where
+    tests/test_quantization.py cannot run the kernel)."""
+    from tpu_on_k8s.models.convert import quantize_serving_tree
+
+    cfg, params, _, _ = setup
+    try:
+        icfg, iparams = quantize_serving_tree(cfg, params,
+                                              stochastic=True, seed=7)
+    except Exception as e:  # pragma: no cover - env-dependent kernel
+        pytest.skip(f"pallas interpret unavailable: {type(e).__name__}")
+    det_cfg, det = quantize_serving_tree(cfg, params)
+    same = jax.tree.structure(iparams) == jax.tree.structure(det)
+    assert same, "stochastic tree structure diverged from deterministic"
+    q = iparams["blocks"]["attn"]["wq"]["kernel_q"]
+    s = iparams["blocks"]["attn"]["wq"]["kernel_scale"]
+    assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+    assert s.shape == q.shape[:-2] + q.shape[-1:]
+    # unbiased rounding still reconstructs the kernel closely
+    w = np.asarray(params["blocks"]["attn"]["wq"]["kernel"], np.float32)
+    back = np.asarray(q, np.float32) * np.asarray(s)[..., None, :]
+    assert float(np.max(np.abs(back - w))) <= float(np.max(np.abs(w))) / 60
+
+
+def test_speculation_composes_with_int8_target(setup):
+    """int8 target + bf16-ish draft: the greedy oracle holds against the
+    INT8 tree's own plain decode (int8 changes logits, so the reference
+    is the quantized model, not the source)."""
+    from tpu_on_k8s.models.convert import quantize_serving_tree
+
+    cfg, params, dcfg, dparams = setup
+    icfg, iparams = quantize_serving_tree(cfg, params)
+    rng = np.random.default_rng(14)
+    p = rng.integers(0, cfg.vocab_size, 7).astype(np.int32)
+    eng = ContinuousBatchingEngine(icfg, iparams, n_slots=2,
+                                   draft_cfg=dcfg, draft_params=dparams,
+                                   spec_k=3)
+    r = eng.submit(p, 10)
+    out = eng.run()
+    np.testing.assert_array_equal(out[r], _want(icfg, iparams, p, 10))
+
+
+# -------------------------------------------------------- CRD + canary
+def test_decode_policy_yaml_and_wire_roundtrip():
+    from tpu_on_k8s.api.inference_types import (
+        DecodePolicy,
+        InferenceService,
+        InferenceServiceSpec,
+    )
+    from tpu_on_k8s.utils import serde
+
+    svc = InferenceService(spec=InferenceServiceSpec(
+        image="reg.local/m:v1",
+        decode=DecodePolicy(draft_model="gpt2-draft", spec_k=3,
+                            int8_weights=True)))
+    for drop_none in (False, True):
+        wire = serde.to_dict(svc, drop_none=drop_none, wire=True)
+        text = yaml.safe_dump(wire)
+        back = serde.from_dict(InferenceService, yaml.safe_load(text))
+        assert back.spec.decode == svc.spec.decode
+    # absent block stays absent (monolithic fleets untouched)
+    bare = serde.from_dict(InferenceService, serde.to_dict(
+        InferenceService(), drop_none=True, wire=True))
+    assert bare.spec.decode is None
+    # normalization clamps the window
+    assert DecodePolicy(spec_k=0).normalized().spec_k == 1
+
+    # rollout identity: only knobs that change the serve args enter the
+    # hash — a present-but-disabled block (or spec_k with no draft) must
+    # NOT trigger a full no-op fleet rollout
+    from tpu_on_k8s.controller.inferenceservice import decode_variant
+    img = "reg.local/m:v1"
+    assert decode_variant(img, None) == img
+    assert decode_variant(img, DecodePolicy()) == img
+    assert decode_variant(img, DecodePolicy(spec_k=8)) == img
+    assert decode_variant(img, DecodePolicy(int8_weights=True)) != img
+    assert decode_variant(img, DecodePolicy(draft_model="d")) != img
+    assert (decode_variant(img, DecodePolicy(draft_model="d", spec_k=2))
+            != decode_variant(img, DecodePolicy(draft_model="d",
+                                                spec_k=4)))
+
+
+def test_int8_canary_end_to_end(setup):
+    """The acceptance loop: convert (quantize_serving_tree) → deploy two
+    versions through a live ServingFleet rollout → router canary split →
+    promote — with traffic flowing the whole way and every request
+    reaching a typed terminal state."""
+    from tpu_on_k8s.models.convert import quantize_serving_tree
+    from tpu_on_k8s.serve import (
+        FleetRolloutPolicy,
+        ProbeConfig,
+        Rejected,
+        ServingFleet,
+    )
+
+    cfg, params, _, _ = setup
+    icfg, iparams = quantize_serving_tree(cfg, params)
+
+    def bf16_factory(name):
+        return ContinuousBatchingEngine(cfg, params, n_slots=2)
+
+    def int8_factory(name):
+        return ContinuousBatchingEngine(icfg, iparams, n_slots=2)
+
+    fleet = ServingFleet(bf16_factory, 2, version="bf16",
+                         probe=ProbeConfig(slow_start_steps=1),
+                         prefix_bucket_len=8)
+    rng = np.random.default_rng(15)
+    rids = []
+    for _ in range(2):
+        fleet.step()
+
+    def pump_traffic(n=2):
+        for _ in range(n):
+            r = fleet.submit(
+                rng.integers(0, cfg.vocab_size, 5).astype(np.int32), 4)
+            if not isinstance(r, Rejected):
+                rids.append(r)
+
+    pump_traffic(4)
+    fleet.start_rollout(int8_factory, "int8-v2",
+                        FleetRolloutPolicy(max_surge=1, canary_weight=0.25,
+                                           drain_timeout_s=None))
+    saw_canary = False
+    for _ in range(60):
+        pump_traffic(1)
+        fleet.step()
+        w = fleet.router.weights
+        if 0 < w.get("int8-v2", 0) < 1:
+            # the canary split: the int8 variant holds exactly its
+            # granted share while both versions serve
+            assert w["int8-v2"] >= 0.25
+            saw_canary = True
+        if fleet.rollout_phase.value == "complete":
+            break
+    assert saw_canary, "rollout finished without a canary split window"
+    results = fleet.run()
+    assert fleet.rollout_phase.value == "complete"
+    assert fleet.version == "int8-v2"               # promoted
+    assert fleet.router.weights == {"int8-v2": 1.0}
+    assert all(rep["drained_clean"] for rep in fleet.retired
+               if rep["reason"] == "rollout drain complete")
+    # zero silent loss: every submitted request reached a terminal state
+    states = {}
+    for rid in rids:
+        res = results.get(rid)
+        assert res is not None, f"request {rid} vanished in the rollout"
+        states[rid] = res.state.value
+    assert set(states.values()) <= {"done"}
+    # post-promote traffic is served by int8 replicas
+    r = fleet.submit(rng.integers(0, cfg.vocab_size, 5).astype(np.int32),
+                     4)
+    assert not isinstance(r, Rejected)
+    fleet.run()
+
+
+# ------------------------------------------------------------- tooling
+def test_driver_bench_flag_exclusivity(monkeypatch):
+    """--speculative now combines with --serve-int8 (both are real
+    paths); --continuous/--cache-int8 still conflict, and --draft-layers
+    requires --speculative."""
+    import tools.driver_bench as db
+
+    def parse(argv):
+        monkeypatch.setattr("sys.argv", ["driver_bench.py", *argv,
+                                         "--skip-resnet", "--skip-submit",
+                                         "--skip-decode"])
+        db.main()
+
+    parse(["--speculative", "--serve-int8"])        # allowed: no error
+    parse(["--speculative", "--draft-layers", "2"])
+    with pytest.raises(SystemExit):
+        parse(["--speculative", "--continuous"])
+    with pytest.raises(SystemExit):
+        parse(["--speculative", "--cache-int8"])
+    with pytest.raises(SystemExit):
+        parse(["--draft-layers", "2"])
+
+
+def test_serve_load_spec_trace(setup):
+    """The --spec arm end to end on the tiny config: token identity vs
+    the plain control arm, the cost-model TPOT win, acceptance=1 for the
+    default self-draft, and span-level draft attribution."""
+    from tools import serve_load
+
+    summary = serve_load.main([
+        "--spec", "--n-requests", "10", "--rate", "2.0",
+        "--prompt-min", "4", "--prompt-max", "10", "--new-min", "6",
+        "--new-max", "12", "--seed", "21",
+        "--trace-out", "/tmp/test_spec_trace.json"])
+    assert summary["token_identical"] is True
+    assert summary["tpot_p95_win"] is True
+    assert summary["acceptance_rate"] == 1.0
+    assert 0 < summary["draft_overhead_share"] < 1
+    assert summary["served"] == 10 and summary["rejected"] == 0
+    assert summary["spec_rounds"] > 0
+    # the folded trace report attributes the spec rounds
+    spec = summary["ttft_critical_path"]
+    assert spec["decomposed"] == 10
+
+    from tools.trace_report import build_report
+    from tpu_on_k8s.obs.export import load_trace
+    report = build_report(load_trace("/tmp/test_spec_trace.json"))
+    spec_block = report["speculative"]
+    assert spec_block is not None and spec_block["requests"] > 0
+    # per-request stats only: no request can see more rounds than ran
+    assert spec_block["rounds_per_request_p50"] <= summary["spec_rounds"]
+
+
+def test_gateway_marks_spec_rounds_on_decode_spans(setup):
+    """Under a live tracer the gateway turns each engine spec round into
+    spec.draft/spec.verify events on the live requests' decode spans;
+    with tracing off nothing is installed (behavior neutrality)."""
+    from tpu_on_k8s.obs import Tracer
+    from tpu_on_k8s.serve import AdmissionConfig, ServingGateway
+
+    cfg, params, dcfg, dparams = setup
+    rng = np.random.default_rng(22)
+    tracer = Tracer()
+    eng = _engine(setup)
+    gw = ServingGateway(eng, AdmissionConfig(max_queue_depth=8),
+                        tracer=tracer)
+    assert eng._on_spec_round is not None
+    rid = gw.submit(rng.integers(0, cfg.vocab_size, 5).astype(np.int32),
+                    8)
+    gw.run()
+    decode_spans = [s for s in tracer.export() if s["name"] == "decode"]
+    assert decode_spans
+    names = [ev["name"] for s in decode_spans
+             for ev in s.get("events", ())]
+    assert "spec.draft" in names and "spec.verify" in names
+    del rid
+
+    plain = _engine(setup)
+    ServingGateway(plain, AdmissionConfig(max_queue_depth=8))
+    assert plain._on_spec_round is None     # tracing off: not installed

@@ -461,6 +461,22 @@ def stage_serve_trace(timeout):
                        "serve_trace", timeout)
 
 
+def stage_serve_spec(timeout):
+    """Production speculative decoding through the continuous-batching
+    engine on the seeded cost-model trace (serve_load --spec): records
+    acceptance rate, TPOT p50/p95 for BOTH arms (the TPOT delta is the
+    headline decode lever ROADMAP item 4 stages), rollbacks, and the
+    draft-overhead share — so the next chip window lands the number.
+    Skips cleanly when the tunnel is down: the chip probe failure is
+    recorded as a retryable error like every other stage."""
+    return _json_stage([sys.executable, "tools/serve_load.py", "--bench",
+                        "--spec", "--spec-draft-layers", "4",
+                        "--n-slots", "4", "--n-requests", "48",
+                        "--rate", "1.5", "--prompt-min", "8",
+                        "--prompt-max", "64", "--new-min", "16",
+                        "--new-max", "64"], "serve_spec", timeout)
+
+
 def stage_serve_fleet(timeout):
     """The fleet headline (round-5 '#2 missed' decode/serving gap):
     router + 2 replicas on the same seeded trace — aggregate tok/s plus
@@ -487,6 +503,7 @@ STAGES = [
     ("bench_data", stage_bench_data, 900, ()),
     ("continuous", stage_continuous, 1200, ("continuous_h8",)),
     ("serve_ttft", stage_serve_ttft, 1200, ()),
+    ("serve_spec", stage_serve_spec, 1200, ()),
     ("serve_fleet", stage_serve_fleet, 1200, ()),
     ("serve_autoscale", stage_serve_autoscale, 1200, ()),
     ("serve_disagg", stage_serve_disagg, 1200, ()),

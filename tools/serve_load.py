@@ -607,6 +607,215 @@ def _autoscale_main(args, cfg, params, max_len) -> dict:
     return summary
 
 
+def run_spec_trace(args, cfg, params, max_len, *, spec: bool = True,
+                   trace: bool = False) -> dict:
+    """One seeded virtual-clock trace through a ``ServingGateway`` whose
+    engine decodes speculatively (``spec=True``: batched drafts in the
+    continuous-batching engine, `tpu_on_k8s/models/serving.py`) or plain
+    (the control arm — same arrivals, same engine config, no draft).
+
+    Device time follows an explicit cost model, mirroring the disagg
+    trace's: a plain engine step costs ``--step-dt`` virtual seconds; a
+    speculative round costs ``step_dt * (1 + (k+1) * draft_frac)`` —
+    the target verify reads the weights once like a plain step
+    (bandwidth-bound), plus ``k+1`` draft forwards each charged
+    ``--spec-draft-frac`` of a target forward. TPOT then measures real
+    structure: the spec arm pays a costlier step but emits
+    ``1 + acceptance*k`` tokens from it. Deterministic per seed — the
+    event log byte-compares across runs (``--soak``; no timestamps in
+    the log, so this holds on any clock), and greedy makes the two
+    arms' OUTPUT TOKENS identical (the oracle the soak also asserts).
+
+    ``--bench`` swaps the cost model for the WALL clock (with an
+    off-trace compile warmup): the chip window's ``serve_spec`` stage
+    records the hardware TPOT delta, not the modeled one."""
+    from tpu_on_k8s.metrics.metrics import ServingMetrics, SpecMetrics
+    from tpu_on_k8s.models.decode import truncated_draft
+    from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+    from tpu_on_k8s.serve import AdmissionConfig, Rejected, ServingGateway
+
+    wall = bool(args.bench)
+    vclock = _VirtualClock()
+    clock = time.monotonic if wall else vclock
+    tracer = _make_tracer(args, clock) if trace else None
+    spec_metrics = SpecMetrics() if spec else None
+    draft_cfg = draft_params = None
+    if spec:
+        if args.spec_draft_layers > 0:
+            draft_cfg, draft_params = truncated_draft(
+                cfg, params, args.spec_draft_layers)
+        else:
+            # self-draft: the deterministic acceptance=1 upper bound —
+            # the cost model still charges every draft forward, so the
+            # TPOT comparison stays honest about overhead
+            draft_cfg, draft_params = cfg, params
+    engine = ContinuousBatchingEngine(
+        cfg, params, n_slots=args.n_slots, max_len=max_len, clock=clock,
+        draft_cfg=draft_cfg, draft_params=draft_params,
+        spec_k=args.spec_k, spec_metrics=spec_metrics)
+    metrics = ServingMetrics()
+    gateway = ServingGateway(
+        engine, AdmissionConfig(max_queue_depth=args.queue_bound),
+        metrics=metrics, clock=clock, tracer=tracer)
+
+    rng = np.random.default_rng(args.seed)
+    # deadlines thread through like the monolithic gateway mode (the
+    # shared-prefix flags stay fleet-only, as documented on their help).
+    # NB deadlines make the two arms legitimately divergeable — a slow
+    # control arm can expire a request the spec arm completes — so the
+    # soak's token-identity gate is meant for deadline-free traces
+    # (the default).
+    arrivals = build_workload(
+        rng, args.n_requests, rate=args.rate,
+        prompt_lens=(args.prompt_min, args.prompt_max),
+        new_tokens=(args.new_min, args.new_max),
+        vocab_size=cfg.vocab_size,
+        deadline_s=args.deadline_s or None,
+        deadline_fraction=args.deadline_fraction)
+    by_step: dict = {}
+    for a in arrivals:
+        by_step.setdefault(a.step, []).append(a)
+    if wall:
+        # hardware run: compile the prefill/draft/verify programs for
+        # every bucket the trace can hit OFF the measured trace (same
+        # guard as the monolithic --bench path)
+        from tpu_on_k8s.models.decode import _bucket_len
+        buckets = sorted({_bucket_len(int(a.prompt.size), engine.max_len)
+                          for a in arrivals})
+        for bucket in buckets:
+            lp = min(bucket, engine.max_len - 2)
+            for _ in range(7):
+                gateway.submit(rng.integers(
+                    0, cfg.vocab_size, size=lp).astype(np.int32), 8)
+            gateway.run()
+        metrics.histograms.clear()
+        for key in ("spec_rounds", "spec_proposed", "spec_accepted",
+                    "spec_rollbacks", "spec_draft_s", "spec_verify_s"):
+            engine.stats[key] = type(engine.stats[key])()
+
+    # per-step device cost (virtual seconds) under the model above
+    step_cost = args.step_dt * (
+        1.0 + (args.spec_k + 1) * args.spec_draft_frac) if spec \
+        else args.step_dt
+    outcomes: dict = {}
+    event_log: List[str] = []
+    rejected = 0
+    step = 0
+    live = True
+    while by_step or live:
+        due = by_step.pop(step, [])
+        for a in due:
+            r = gateway.submit(a.prompt, a.max_new_tokens, tenant=a.tenant,
+                               priority=a.priority, deadline_s=a.deadline_s)
+            if isinstance(r, Rejected):
+                rejected += 1
+        done = gateway.step()
+        for rid in done:
+            res = gateway.result(rid)
+            if res is not None:
+                outcomes[rid] = res
+        if not wall:
+            vclock.advance(step_cost)
+        event_log.append(
+            f"step={step} arrivals={len(due)} "
+            f"finished={','.join(map(str, sorted(done)))} "
+            f"emitted={engine.stats['emitted']} "
+            f"spec={engine.stats['spec_accepted']}"
+            f"/{engine.stats['spec_proposed']}")
+        live = gateway.queue_depth > 0 or gateway._live()
+        step += 1
+
+    states = [r.state.value for r in outcomes.values()]
+    tpot = list(metrics.histograms["time_per_output_token_seconds"])
+    ttft = list(metrics.histograms["time_to_first_token_seconds"])
+    st = engine.stats
+    acceptance = (st["spec_accepted"] / st["spec_proposed"]
+                  if st["spec_proposed"] else None)
+    summary = {
+        "metric": "spec_trace" if spec else "spec_control_trace",
+        "requests": len(arrivals),
+        "served": states.count("done"),
+        "rejected": rejected,
+        "deadline_exceeded": states.count("deadline_exceeded"),
+        "cancelled": states.count("cancelled"),
+        "retry_exhausted": states.count("retry_exhausted"),
+        "tokens": sum(len(r.tokens) for r in outcomes.values()),
+        "driver_steps": step,
+        "clock": "wall" if wall else "cost-model",
+        "virtual_s": None if wall else round(vclock.t, 6),
+        "spec_draft_s": round(st["spec_draft_s"], 6),
+        "spec_verify_s": round(st["spec_verify_s"], 6),
+        "tpot_ms_p50": _pctl(tpot, 0.50),
+        "tpot_ms_p95": _pctl(tpot, 0.95),
+        "ttft_ms_p50": _pctl(ttft, 0.50),
+        "ttft_ms_p95": _pctl(ttft, 0.95),
+        "spec_rounds": st["spec_rounds"],
+        "acceptance_rate": (round(acceptance, 4)
+                            if acceptance is not None else None),
+        "rollbacks": st["spec_rollbacks"],
+        # the modeled share of device time the draft consumes — what the
+        # win has to amortize ((k+1) draft forwards per round)
+        "draft_overhead_share": round(
+            (args.spec_k + 1) * args.spec_draft_frac
+            / (1.0 + (args.spec_k + 1) * args.spec_draft_frac), 4)
+        if spec else 0.0,
+        "outputs": {rid: tuple(int(t) for t in r.tokens)
+                    for rid, r in sorted(outcomes.items())},
+        "event_log": event_log,
+    }
+    _dump_trace(tracer, args, summary)
+    return summary
+
+
+def _spec_main(args, cfg, params, max_len) -> dict:
+    """``--spec``: speculative vs plain decode on the same seeded
+    cost-model trace. With ``--soak`` the spec arm runs TWICE from
+    scratch and the event logs must byte-compare, the outputs must be
+    token-identical to the plain arm (the greedy oracle), acceptance
+    must reach 0.7, and spec must win TPOT p95 —
+    ``SPEC_SOAK_FAILED seed=N`` on any violation so a red run replays
+    verbatim."""
+    control = run_spec_trace(args, cfg, params, max_len, spec=False)
+    summary = run_spec_trace(args, cfg, params, max_len,
+                             trace=bool(args.trace_out))
+    event_log = summary.pop("event_log")
+    outputs = summary.pop("outputs")
+    control_outputs = control.pop("outputs")
+    summary["control"] = {k: control[k] for k in
+                          ("tpot_ms_p50", "tpot_ms_p95", "ttft_ms_p95",
+                           "served", "driver_steps", "virtual_s")}
+    summary["token_identical"] = outputs == control_outputs
+    summary["tpot_p95_win"] = (
+        summary["tpot_ms_p95"] is not None
+        and control["tpot_ms_p95"] is not None
+        and summary["tpot_ms_p95"] < control["tpot_ms_p95"])
+    if args.soak:
+        rerun = run_spec_trace(args, cfg, params, max_len)
+        accounted = (summary["served"] + summary["rejected"]
+                     + summary["deadline_exceeded"] + summary["cancelled"]
+                     + summary["retry_exhausted"])
+        replayed = event_log == rerun["event_log"]
+        acceptance_ok = (summary["acceptance_rate"] is not None
+                         and summary["acceptance_rate"] >= 0.7)
+        ok = (accounted == args.n_requests and replayed
+              and summary["token_identical"] and acceptance_ok
+              and summary["tpot_p95_win"])
+        summary["soak_ok"] = ok
+        summary["event_log_replayed"] = replayed
+        if not ok:
+            print(json.dumps(summary))
+            print(f"SPEC_SOAK_FAILED seed={args.seed} "
+                  f"accounted={accounted}/{args.n_requests} "
+                  f"replayed={replayed} "
+                  f"token_identical={summary['token_identical']} "
+                  f"acceptance={summary['acceptance_rate']} "
+                  f"tpot_win={summary['tpot_p95_win']}")
+            raise SystemExit(1)
+        print(f"SPEC_SOAK_OK seed={args.seed}", file=sys.stderr)
+    print(json.dumps(summary))
+    return summary
+
+
 #: explicit device-time cost model for the disagg comparison: an
 #: engine's step costs BASE plus PREFILL_COST per padded prefill
 #: position it executed that step — a monolithic engine's co-resident
@@ -903,6 +1112,23 @@ def main(argv=None) -> dict:
                         "(--disagg): a bursty shared prefix spills past "
                         "its affinity replica and recomputes there — the "
                         "monolithic cost the fleet store eliminates")
+    # --- speculative decoding mode (models/serving.py batched drafts) ---
+    p.add_argument("--spec", action="store_true",
+                   help="drive the trace through a speculative-decoding "
+                        "engine AND a plain control arm on the seeded "
+                        "cost-model virtual clock: TPOT p50/p95 both "
+                        "arms, acceptance rate, draft-overhead share, "
+                        "greedy token-identity")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft proposals per speculative round (--spec)")
+    p.add_argument("--spec-draft-frac", type=float, default=0.15,
+                   help="cost-model price of one draft forward as a "
+                        "fraction of a target forward (--spec); a spec "
+                        "round costs step_dt*(1+(k+1)*frac)")
+    p.add_argument("--spec-draft-layers", type=int, default=0,
+                   help="draft with the target's first N layers instead "
+                        "of the self-draft (--spec): measured acceptance "
+                        "instead of the =1 upper bound")
     # --- SLO autoscaler mode (tpu_on_k8s/autoscale/ closed loop) ---
     p.add_argument("--autoscale", action="store_true",
                    help="drive a bursty trace through ServingFleet + "
@@ -962,6 +1188,8 @@ def main(argv=None) -> dict:
     if args.bench:
         params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
 
+    if args.spec:
+        return _spec_main(args, cfg, params, max_len)
     if args.disagg:
         return _disagg_main(args, cfg, params, max_len)
     if args.autoscale:

@@ -82,6 +82,24 @@ def decompose(spans: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
         segments[s["name"]] += max(0.0, hi - s["start"])
     ttft = anchor - t0
     first_token = _event_time(spans, "first_token")
+    # speculative-decoding attribution: each spec round marks every live
+    # request's decode span with `spec.draft`/`spec.verify` events whose
+    # `dt` attr is the round's device seconds on the engine's clock.
+    # The per-request sums below are therefore SHARED batch time "this
+    # request's decode overlapped" (concurrent requests each carry the
+    # full round cost — correct per-request attribution, but summing
+    # across requests would multiply device time by the live count;
+    # build_report's aggregate sticks to per-request percentiles and
+    # the draft/verify RATIO, where the sharing cancels)
+    spec_draft = spec_verify = 0.0
+    spec_rounds = 0
+    for s in spans:
+        for ev in s.get("events", ()):
+            if ev["name"] == "spec.draft":
+                spec_draft += (ev.get("attrs") or {}).get("dt", 0.0)
+                spec_rounds += 1
+            elif ev["name"] == "spec.verify":
+                spec_verify += (ev.get("attrs") or {}).get("dt", 0.0)
     return {
         "trace": root["trace"],
         "rid": (root.get("attrs") or {}).get("rid"),
@@ -91,6 +109,9 @@ def decompose(spans: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
         "segments": segments,
         "residual": ttft - sum(segments.values()),
         "replays": sum(1 for s in spans if s["name"] == "queue") - 1,
+        "spec_rounds": spec_rounds,
+        "spec_draft_s": spec_draft,
+        "spec_verify_s": spec_verify,
         "events": sorted({ev["name"] for s in spans
                           for ev in s.get("events", ())}),
     }
@@ -141,6 +162,30 @@ def build_report(spans: List[Dict[str, Any]], *, top: int = 3
 
     ttft_p95 = percentile(ttfts, 0.95)
     slowest = sorted(requests, key=lambda r: -r["ttft"])[:max(top, 0)]
+    # draft-overhead attribution across the dump: per-REQUEST stats
+    # only, never cross-request sums — each round's device time lands on
+    # every concurrently live request's span (shared batch time), so a
+    # sum across requests would multiply it by the live count. The
+    # draft/verify ratio is exact (the sharing cancels); None when the
+    # trace carries no spec events — a plain-decode dump reports
+    # nothing rather than a fake zero.
+    spec_reqs = [r for r in requests if r["spec_rounds"] > 0]
+    spec_total = (sum(r["spec_draft_s"] for r in spec_reqs)
+                  + sum(r["spec_verify_s"] for r in spec_reqs))
+    speculative = None
+    if spec_reqs:
+        speculative = {
+            "requests": len(spec_reqs),
+            "rounds_per_request_p50": percentile(
+                [r["spec_rounds"] for r in spec_reqs], 0.50),
+            "draft_ms_per_request_p50": _ms(percentile(
+                [r["spec_draft_s"] for r in spec_reqs], 0.50)),
+            "draft_ms_per_request_p95": _ms(percentile(
+                [r["spec_draft_s"] for r in spec_reqs], 0.95)),
+            "draft_overhead_share": (
+                round(sum(r["spec_draft_s"] for r in spec_reqs)
+                      / spec_total, 4) if spec_total > 0 else None),
+        }
     return {
         "metric": "trace_report",
         "spans": len(spans),
@@ -160,6 +205,7 @@ def build_report(spans: List[Dict[str, Any]], *, top: int = 3
         "residual_ms_max": _ms(max((abs(r["residual"]) for r in requests),
                                    default=None)),
         "replayed_requests": sum(1 for r in requests if r["replays"] > 0),
+        "speculative": speculative,
         "slowest": [{
             "trace": r["trace"], "rid": r["rid"], "status": r["status"],
             "ttft_ms": _ms(r["ttft"]),
@@ -190,6 +236,16 @@ def render(report: Dict[str, Any]) -> str:
             f"{s['p95_exemplar_trace']})")
     lines.append(f"residual |ttft - sum(segments)| max: "
                  f"{report['residual_ms_max']}ms")
+    spec = report.get("speculative")
+    if spec:
+        share = ("-" if spec["draft_overhead_share"] is None
+                 else f"{100 * spec['draft_overhead_share']:.1f}%")
+        lines.append(
+            f"speculative: {spec['requests']} requests, "
+            f"{spec['rounds_per_request_p50']} rounds/request p50, "
+            f"draft-wait p50={spec['draft_ms_per_request_p50']}ms "
+            f"p95={spec['draft_ms_per_request_p95']}ms (draft overhead "
+            f"{share} of spec device time)")
     if report["slowest"]:
         lines.append("slowest requests:")
         for r in report["slowest"]:

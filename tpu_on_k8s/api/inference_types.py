@@ -71,6 +71,40 @@ class RolloutPolicy:
 
 
 @dataclass
+class DecodePolicy:
+    """Decode-path acceleration for a serving replica — the knobs behind
+    the tokens-per-chip headline (`tpu_on_k8s/models/serving.py`):
+
+    * ``draft_model`` names the small draft checkpoint (a ``Model``
+      ref, e.g. a GPT-2 draft loaded via the HF interop layer in
+      `models/convert.py`) for batched speculative decoding; ``""``
+      disables speculation. ``spec_k`` is the proposals-per-round
+      window.
+    * ``int8_weights`` serves W8A16 int8 weights
+      (`models/convert.quantize_serving_tree`) instead of bf16 —
+      ~half the weight bytes in the bandwidth-bound decode loop.
+
+    A DecodePolicy change is part of what a replica RUNS: the
+    reconciler folds it into the replica-group identity hash, so
+    flipping int8 (or the draft) rolls the fleet through the SAME
+    surge/drain/canary machinery a new image does — the router's canary
+    split A/Bs the variant under live traffic before the fleet commits
+    (`controller/inferenceservice.py`, `serve/router.py`)."""
+
+    draft_model: str = ""
+    spec_k: int = 4
+    int8_weights: bool = False
+
+    def normalized(self) -> "DecodePolicy":
+        """Defaulted-and-clamped copy (same passive-record shape as
+        ``RolloutPolicy``): the speculation window floors at 1."""
+        return DecodePolicy(
+            draft_model=str(self.draft_model),
+            spec_k=max(int(self.spec_k), 1),
+            int8_weights=bool(self.int8_weights))
+
+
+@dataclass
 class AutoscalePolicy:
     """SLO-driven replica autoscaling for the serving fleet (consumed by
     `controller/fleetautoscaler.py`; decision core in
@@ -198,6 +232,10 @@ class InferenceServiceSpec:
     #: pool carries an ``autoscale`` block, by the fleet autoscaler's
     #: per-pool loop). Absent ⇒ monolithic serving, unchanged.
     pools: Optional[PoolsSpec] = None
+    #: present = decode acceleration (speculative drafts and/or int8
+    #: serving weights). Part of the replica-group identity: changing it
+    #: rolls the fleet (surge/drain/canary) like a new image would.
+    decode: Optional[DecodePolicy] = None
 
 
 class ServicePhase(str, enum.Enum):

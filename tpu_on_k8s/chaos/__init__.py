@@ -38,6 +38,7 @@ from tpu_on_k8s.chaos.faults import (
     SITE_REST_WATCH_CONNECT,
     SITE_REST_WATCH_EVENT,
     SITE_SERVE_STEP,
+    SITE_SPEC_DRAFT,
     SITE_TRAIN_PREEMPT,
     SITE_TRAIN_SAVE,
     SITE_TRAIN_STEP,
@@ -45,6 +46,7 @@ from tpu_on_k8s.chaos.faults import (
     ChaosStepError,
     Conflict,
     ConnectionResetFault,
+    DraftCrash,
     EngineCrash,
     EngineStall,
     Fault,
@@ -89,6 +91,7 @@ __all__ = [
     "SITE_REST_WATCH_CONNECT",
     "SITE_REST_WATCH_EVENT",
     "SITE_SERVE_STEP",
+    "SITE_SPEC_DRAFT",
     "SITE_TRAIN_PREEMPT",
     "SITE_TRAIN_SAVE",
     "SITE_TRAIN_STEP",
@@ -96,6 +99,7 @@ __all__ = [
     "ChaosStepError",
     "Conflict",
     "ConnectionResetFault",
+    "DraftCrash",
     "EngineCrash",
     "EngineStall",
     "Fault",

@@ -16,6 +16,7 @@ API.
 | apiserver.watch        | apiserver._stream_watch (per frame) | WatchDrop |
 | controller.reconcile   | JobEngine.reconcile                 | PodFail, SlicePreempt |
 | serve.engine.step      | ContinuousBatchingEngine.step       | EngineCrash, EngineStall |
+| serve.engine.spec_draft| ContinuousBatchingEngine spec round | DraftCrash |
 | serve.fleet.replica    | ServingFleet.step (per replica)     | ReplicaCrash, ReadinessFlap |
 | serve.fleet.rollout    | ServingFleet rollout transitions    | RolloutInterrupt |
 | serve.kv.handoff       | DisaggFleet prefill→decode transfer | HandoffLoss, HandoffCorrupt |
@@ -42,6 +43,7 @@ SITE_APISERVER_REQUEST = "apiserver.request"
 SITE_APISERVER_WATCH = "apiserver.watch"
 SITE_RECONCILE = "controller.reconcile"
 SITE_SERVE_STEP = "serve.engine.step"
+SITE_SPEC_DRAFT = "serve.engine.spec_draft"
 SITE_FLEET_REPLICA = "serve.fleet.replica"
 SITE_FLEET_ROLLOUT = "serve.fleet.rollout"
 SITE_KV_HANDOFF = "serve.kv.handoff"
@@ -87,6 +89,10 @@ SITE_REGISTRY = {
         "`models/serving.py` engine step",
         ("EngineCrash", "EngineStall"),
         "gateway `ReplayPolicy` re-admission, zero silent loss"),
+    SITE_SPEC_DRAFT: (
+        "`models/serving.py` speculative round",
+        ("DraftCrash",),
+        "engine degrades to plain decode, counted, token-identical"),
     SITE_FLEET_REPLICA: (
         "`serve/fleet.py` replica step",
         ("ReplicaCrash", "ReadinessFlap"),
@@ -244,6 +250,20 @@ class EngineStall(Fault):
     a hung collective. Drain timeouts are the recovery under test."""
 
     kind: ClassVar[str] = "engine_stall"
+
+
+@dataclasses.dataclass(frozen=True)
+class DraftCrash(Fault):
+    """The draft model of a speculative-decoding engine dies (OOM, a
+    corrupt draft checkpoint, a wedged draft program). The draft is an
+    ACCELERATOR, never a correctness dependency — so the recovery under
+    test is graceful degradation: the engine drops the draft and
+    continues every in-flight request through the plain decode path,
+    token-identically (greedy), with the crash counted
+    (``stats["draft_crashes"]`` / ``SpecMetrics.spec_draft_crashes``).
+    Zero silent loss: no request is replayed, aborted, or re-queued."""
+
+    kind: ClassVar[str] = "draft_crash"
 
 
 @dataclasses.dataclass(frozen=True)

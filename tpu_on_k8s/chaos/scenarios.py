@@ -102,6 +102,20 @@ def engine_crash_mid_decode(at_steps: Tuple[int, ...] = (3,), *,
     ), seed)
 
 
+def spec_draft_crash(at_round: int = 2, *, seed: int = 0) -> Scenario:
+    """Kill the speculative-decoding draft model on its ``at_round``-th
+    round (counted per spec round of ``engine.step()``). Recovery under
+    test: the engine DEGRADES — it drops the draft and finishes every
+    in-flight request through the plain decode path, token-identically
+    (greedy makes the draft an accelerator, never a correctness
+    dependency), with the crash counted and zero silent loss."""
+    return Scenario("spec-draft-crash", (
+        FaultRule(faults.SITE_SPEC_DRAFT, on_call(at_round),
+                  faults.DraftCrash(),
+                  note="draft dies mid-speculation"),
+    ), seed)
+
+
 def replica_crash_mid_decode(replica: str = "replica-1", *,
                              at_steps: Tuple[int, ...] = (3,),
                              seed: int = 0) -> Scenario:

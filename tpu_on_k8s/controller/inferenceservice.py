@@ -23,6 +23,11 @@ controller closes the loop: an ``InferenceService`` names a ``Model``
   replica is ready, growing with the replaced fraction, 1.0 at
   completion. Controller rollout position and router traffic split can
   therefore never disagree.
+* a ``spec.decode`` change (`DecodePolicy`: int8 serving weights,
+  speculative draft) folds into the replica-group identity hash
+  (``decode_variant``), so flipping int8 or the draft rides the SAME
+  rollout machinery — the int8 variant is canaried under live traffic,
+  never hot-swapped into running pods.
 
 The in-process twin of this state machine — same phases, same
 surge/drain ordering, driven per engine step instead of per reconcile —
@@ -74,6 +79,28 @@ def image_hash(image: str) -> str:
     """Label-safe short content hash of an image ref (image refs carry
     '/' and ':', which label values forbid)."""
     return hashlib.sha1(image.encode()).hexdigest()[:8]
+
+
+def decode_variant(image: str, decode) -> str:
+    """The rollout identity of (image, DecodePolicy): the decode policy
+    is part of what a replica RUNS (int8 weights, a speculative draft),
+    so flipping it must roll the fleet — surge, drain, canary split —
+    exactly like a new image, never mutate pods in place. Only knobs
+    that actually change the replica's serve args enter the identity:
+    ``None``, an all-defaults block, and a ``spec_k`` with no draft all
+    map to the bare image ref — applying ``decode: {}`` to a running
+    fleet must not trigger a full no-op rollout."""
+    if decode is None:
+        return image
+    d = decode.normalized()
+    tags = []
+    if d.draft_model:
+        tags.append(f"draft={d.draft_model},k={d.spec_k}")
+    if d.int8_weights:
+        tags.append("int8=1")
+    if not tags:
+        return image
+    return image + "#" + ";".join(tags)
 
 
 class _ReplicaGroup:
@@ -156,7 +183,7 @@ class InferenceServiceReconciler:
                                          svc.spec.tpu_policy.topology)
         groups = self._observed_groups(svc, hosts)
         sp.set(desired=desired, observed=len(groups))
-        target_hash = image_hash(image)
+        target_hash = image_hash(decode_variant(image, svc.spec.decode))
         new = [g for g in groups if g.hash == target_hash]
         old = [g for g in groups if g.hash != target_hash]
 
@@ -275,12 +302,24 @@ class InferenceServiceReconciler:
         tpu = svc.spec.tpu_policy
         chips = topology.chips_per_host(tpu.accelerator)
         gang = self._gang_name(svc, hash_, index)
+        serve_args = ["--serve", f"--n-slots={svc.spec.n_slots}",
+                      f"--prefix-bucket-len={svc.spec.prefix_bucket_len}"]
+        if svc.spec.decode is not None:
+            # thread the decode policy to the replica runtime as args —
+            # the serving image's declared contract, like --serve and
+            # --n-slots above (the in-process plane consumes the same
+            # policy through its engine factory)
+            d = svc.spec.decode.normalized()
+            if d.int8_weights:
+                serve_args.append("--serve-int8")
+            if d.draft_model:
+                serve_args += [f"--spec-draft={d.draft_model}",
+                               f"--spec-k={d.spec_k}"]
         for host in range(hosts):
             name = f"{gang}-h{host}" if hosts > 1 else gang
             container = Container(
                 name=constants.DEFAULT_CONTAINER_NAME, image=image,
-                args=["--serve", f"--n-slots={svc.spec.n_slots}",
-                      f"--prefix-bucket-len={svc.spec.prefix_bucket_len}"])
+                args=list(serve_args))
             container.resources.requests[constants.RESOURCE_TPU] = chips
             container.resources.limits[constants.RESOURCE_TPU] = chips
             container.set_env(constants.ENV_PJRT_DEVICE, "TPU")

@@ -260,6 +260,32 @@ class ServingMetrics(_MetricsBase):
             self._declare(name, f"{ns}_{name}", "gauge", f"Serving {name}")
 
 
+class SpecMetrics(_MetricsBase):
+    """Speculative-decoding observability
+    (`tpu_on_k8s/models/serving.py` spec rounds): proposed vs accepted
+    draft tokens (their ratio IS the acceptance rate — the one number
+    that decides whether speculation pays), rollbacks (a slot-round
+    where the target rejected at least one proposal), draft crashes
+    (the engine degraded to plain decode), and the running
+    acceptance-rate gauge an operator reads off one scrape. Same
+    prometheus + plain-dict mirror pattern as ``ServingMetrics``; give
+    the instance to the engine's ``spec_metrics=`` and scrape it beside
+    the gateway's serving metrics."""
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        if _prom is not None:
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_spec"
+        for name in ("spec_tokens_proposed", "spec_tokens_accepted",
+                     "spec_rollbacks", "spec_draft_crashes"):
+            self._declare(name, f"{ns}_{name[5:]}", "counter",
+                          f"Speculative decoding {name[5:]}")
+        self._declare("spec_acceptance_rate", f"{ns}_acceptance_rate",
+                      "gauge", "Running draft-token acceptance rate "
+                      "(accepted / proposed over the engine's lifetime)")
+
+
 class TrainMetrics(_MetricsBase):
     """Training-loop observability, fed by `tpu_on_k8s/train/loop.py`'s
     ``TrainLoop`` at every host-sync window (same prometheus + plain-dict

@@ -298,6 +298,79 @@ def from_hf_bert(hf_model, dtype=jnp.float32, compute_dtype=None):
     return cfg, params
 
 
+def quantize_serving_tree(cfg: TransformerConfig, params, *,
+                          stochastic: bool = False, seed: int = 0
+                          ) -> Tuple[TransformerConfig, dict]:
+    """Emit the W8A16 int8 SERVING variant of a bf16/fp32 param tree:
+    ``(config with serve_int8_weights=True, quantized params)`` — the
+    tree ``decode.generate`` and the continuous-batching engine serve
+    directly, and the variant an ``InferenceService``'s ``DecodePolicy``
+    canaries against the bf16 fleet (`controller/inferenceservice.py`
+    rolls it out exactly like a new image; `serve/router.py` splits the
+    traffic). The int8 tree itself still has no HF state-dict form —
+    ``to_hf_llama``/``to_hf_gpt2`` keep rejecting it; export the source
+    checkpoint instead.
+
+    Default rounding is the deterministic per-out-channel absmax
+    round-to-nearest (`decode.quantize_weights_for_serving`).
+    ``stochastic=True`` rounds through the Pallas stochastic-rounding
+    kernel (`ops/quantization.py`, TPU PRNG; interpret-mode on CPU):
+    unbiased in expectation, so quantization noise averages across
+    channels instead of biasing them — at the price of a ``seed``
+    entering the artifact."""
+    import dataclasses
+
+    from tpu_on_k8s.models.decode import quantize_weights_for_serving
+
+    if cfg.serve_int8_weights:
+        raise ValueError("param tree is already int8-serving")
+    if cfg.fused_qkv or cfg.n_experts or cfg.use_bias:
+        raise ValueError("int8 serving covers the unfused, bias-free, "
+                         "dense layouts only (migrate the checkpoint "
+                         "layout first)")
+    out_cfg = dataclasses.replace(cfg, serve_int8_weights=True)
+    quantizer = None
+    if stochastic:
+        from tpu_on_k8s.ops.quantization import quantize_int8
+
+        def quantizer(w):
+            # per-OUT-CHANNEL scales via the row-wise kernel: transpose
+            # each [.., D, F] kernel to rows of length D, quantize, and
+            # transpose back — kernel_q [.., D, F] + kernel_scale [.., F],
+            # the exact _W8Dense param contract
+            w = np.asarray(w, np.float32)
+            lead, (d, f) = w.shape[:-2], w.shape[-2:]
+            n = 1
+            for dim in lead:
+                n *= dim
+            rows = w.reshape(n, d, f).transpose(0, 2, 1).reshape(n * f, d)
+            vals, scales = quantize_int8(jnp.asarray(rows), seed=seed)
+            q = np.asarray(vals).reshape(n, f, d).transpose(0, 2, 1)
+            s = np.asarray(scales).reshape(n, f)
+            return (jnp.asarray(q.reshape(*lead, d, f)),
+                    jnp.asarray(s.reshape(*lead, f)))
+
+    return out_cfg, quantize_weights_for_serving(params, quantizer)
+
+
+def draft_from_hf_gpt2(hf_model, target_cfg: TransformerConfig,
+                       dtype=jnp.float32, compute_dtype=None
+                       ) -> Tuple[TransformerConfig, dict]:
+    """(draft_cfg, draft_params) for speculative decoding beside
+    ``target_cfg``: a small GPT-2 loaded through the HF interop layer
+    (`from_hf_gpt2`), validated to share the target's vocabulary — the
+    one property batched draft/verify needs (proposals and target
+    logits index the same token space). Pass the pair straight to
+    ``ContinuousBatchingEngine(draft_cfg=..., draft_params=...)``."""
+    cfg, params = from_hf_gpt2(hf_model, dtype, compute_dtype)
+    if cfg.vocab_size != target_cfg.vocab_size:
+        raise ValueError(
+            f"draft vocab {cfg.vocab_size} != target vocab "
+            f"{target_cfg.vocab_size}: a speculative draft must share "
+            f"the target's tokenizer")
+    return cfg, params
+
+
 def to_hf_llama(cfg: TransformerConfig, params) -> dict:
     """HF Llama ``state_dict`` (torch tensors) from our param tree — the
     inverse of ``params_from_hf_llama``, so a model fine-tuned here ships

@@ -30,7 +30,7 @@ _W8_TARGETS = frozenset({"wq", "wk", "wv", "wo",
                          "w_gate", "w_up", "w_down", "w_gateup"})
 
 
-def quantize_weights_for_serving(params) -> dict:
+def quantize_weights_for_serving(params, quantize=None) -> dict:
     """W8A16 weight conversion for ``cfg.serve_int8_weights`` serving: each
     targeted matmul kernel becomes an int8 ``kernel_q`` plus a
     per-out-channel fp32 absmax ``kernel_scale`` (the layer-scanned leading
@@ -38,14 +38,22 @@ def quantize_weights_for_serving(params) -> dict:
     ``serve_int8_weights`` modules declare (`transformer._W8Dense`, the
     ``lm_head_q``/``lm_head_scale`` head); embeddings (and the tied head)
     stay full precision. Exactness: the module rescales the matmul
-    product, so the only error is the int8 rounding of the kernel."""
-    def quantize(w):
+    product, so the only error is the int8 rounding of the kernel.
+
+    ``quantize`` swaps the rounding scheme: it maps one kernel
+    ``[..., D, F]`` to ``(int8 values [..., D, F], fp32 per-out-channel
+    scales [..., F])``. Default: deterministic absmax round-to-nearest;
+    `models/convert.quantize_serving_tree` passes the Pallas
+    stochastic-rounding quantizer (`ops/quantization.py`) through here."""
+    def absmax(w):
         w = np.asarray(w, np.float32)                   # [..., D, F]
         s = np.max(np.abs(w), axis=-2) / 127.0          # [..., F]
         s = np.maximum(s, 1e-9)
         q = np.clip(np.round(w / s[..., None, :]), -127, 127)
         return (jnp.asarray(q.astype(np.int8)),
                 jnp.asarray(s.astype(np.float32)))
+
+    quantize = quantize or absmax
 
     def rec(tree):
         out = {}
@@ -64,6 +72,26 @@ def quantize_weights_for_serving(params) -> dict:
         return out
 
     return rec(params)
+
+
+def truncated_draft(cfg: TransformerConfig, params,
+                    n_layers: int) -> Tuple[TransformerConfig, dict]:
+    """A layer-truncated self-draft for speculative decoding: the
+    target's first ``n_layers`` blocks plus its own embeddings / norms /
+    head (the Draft&Verify "self-speculative" shape — no second trained
+    checkpoint needed, the draft is a shallow copy of the target).
+    Params are layer-scanned (leading layer axis), so truncation is one
+    leaf slice — no new memory beyond the views. Acceptance depends on
+    how much of the target's prediction the early layers carry; the
+    mechanism (and the greedy token-identity guarantee) does not."""
+    if not 1 <= n_layers < cfg.n_layers:
+        raise ValueError(f"draft layers must be in [1, {cfg.n_layers}), "
+                         f"got {n_layers}")
+    dcfg = dataclasses.replace(cfg, n_layers=n_layers)
+    dparams = dict(params)
+    dparams["blocks"] = jax.tree.map(lambda leaf: leaf[:n_layers],
+                                     params["blocks"])
+    return dcfg, dparams
 
 
 def decode_model(cfg: TransformerConfig) -> Transformer:

@@ -38,6 +38,20 @@ step program for the engine's lifetime:
 The host loop (``step()``) is plain Python: admit from the queue into free
 slots, run one device horizon, collect finished requests. One H2D transfer
 of two ``[n_slots]`` int vectors per horizon; the cache lives on device.
+
+**Speculative decoding** (``draft_cfg``/``draft_params``): a second, small
+model drafts ``spec_k`` greedy tokens per slot per round (one scanned
+program over its own slot-pool cache — `_DraftRunner`), and ONE batched
+target forward verifies every slot's ``k+1`` chunk; each row emits its
+longest agreeing prefix plus the target's correction token — up to
+``k+1`` tokens per target forward, token-identical to plain greedy
+decode (the oracle `tests/test_speculative.py` pins). Rollback is pure
+position bookkeeping: multislot queries attend only ``k_pos <=
+position``, so rejected proposals' stale K/V is never attended and the
+next round's appends overwrite it. Slots the draft cannot seed (adopted
+``KVHandoff``s, imported prefixes) decode plain inside the same
+programs; a draft crash (`chaos.SITE_SPEC_DRAFT`) degrades the whole
+engine to plain decode — counted, zero silent loss.
 """
 from __future__ import annotations
 
@@ -101,6 +115,10 @@ class _Slot:
     eos_id: Optional[int]
     submitted_at: float = 0.0     # monotonic submit time (metrics)
     on_token: Optional[Any] = None   # streaming callback (rid, token)
+    draft: bool = False           # the draft runner holds this slot's
+                                  # context KV → the spec rounds may
+                                  # propose for it (False: plain decode —
+                                  # adopted handoffs, imported prefixes)
 
 
 @dataclasses.dataclass
@@ -236,6 +254,179 @@ class KVHandoff:
         return _cache_nbytes(self.cache)
 
 
+class _DraftRunner:
+    """The draft half of batched speculative decoding: a second (small)
+    model kept position-synchronized with the engine's slot pool.
+
+    The draft owns its OWN ``[n_slots, max_len, ...]`` multislot cache.
+    Every admission seeds the admitted slot's draft row with a draft
+    prefill of the request's context (prefix + prompt — one cheap
+    prefill; the draft never chunks), and each spec round scans ``k+1``
+    greedy draft steps over ALL slots in one compiled program
+    (``propose``). Rows the draft cannot seed (adopted ``KVHandoff``s —
+    no prompt tokens travel with a handoff — or ``import_prefix`` ids
+    the draft never saw) ride the rounds at the out-of-bounds sentinel
+    position: their appends drop and their proposals are ignored, so one
+    program serves a mixed pool.
+
+    Rollback is free in multislot mode: a query attends only
+    ``k_pos <= position`` and every append lands at the position of the
+    token being fed — so rejected proposals' stale K/V (in BOTH caches)
+    is never attended and is overwritten by the next round's appends
+    before any query could reach it. No cursor rebuild, no host-side
+    cache surgery — exactly the invariant slot retirement already
+    relies on.
+
+    Greedy only (argmax): token identity with plain decode is the
+    correctness contract, and sampled speculation needs rejection
+    sampling this engine does not implement."""
+
+    def __init__(self, cfg: TransformerConfig, params, n_slots: int,
+                 max_len: int, k: int) -> None:
+        if cfg.pos_emb == "rope":
+            cfg = dataclasses.replace(cfg, max_seq_len=max_len)
+        elif cfg.max_seq_len < max_len:
+            # learned positional tables cannot reach the engine's length
+            raise ValueError(
+                f"draft max_seq_len {cfg.max_seq_len} < engine max_len "
+                f"{max_len} (learned positions cannot extrapolate)")
+        base = dataclasses.replace(cfg, decode=True, remat=False,
+                                   attn_impl="xla")
+        self.cfg = base
+        self.params = params
+        self.k = k
+        self.max_len = max_len
+        self._step_model = Transformer(
+            dataclasses.replace(base, decode_multislot=True))
+        self._prefill_model = Transformer(base)
+        self.cache = init_cache(self._step_model, n_slots)
+        self.prefixes: Dict[int, Tuple[Any, int]] = {}   # engine pid → KV
+        self._prefill_progs: Dict[int, Any] = {}
+        self._suffix_progs: Dict[int, Any] = {}
+        model = self._step_model
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def propose(params, cache, toks, pos):
+            """``k+1`` scanned greedy draft steps; returns the cache and
+            the first k proposals [k, n_slots] (the k+1-th feed exists
+            only to cache d_k so a fully-accepted round's next draft
+            appends right after it — same shape as the batch-1
+            ``draft_k`` program in `models/decode.py`)."""
+            def body(carry, _):
+                cache, tok, p = carry
+                logits, upd = model.apply(
+                    {"params": params, "cache": cache}, tok[:, None],
+                    p[:, None], mutable=["cache"])
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (upd["cache"], nxt, p + 1), nxt
+
+            (cache, _, _), toks_out = jax.lax.scan(
+                body, (cache, toks, pos), None, length=self.k + 1)
+            return cache, toks_out[:self.k]
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def admit(cache, pre_cache, slot, lp, row):
+            """Identical write to the engine's admit program, over the
+            draft's cache shapes."""
+            def write(shared, pre):
+                keep = jnp.arange(shared.shape[2]) < lp
+                keep = keep.reshape((1, -1) + (1,) * (pre.ndim - 3))
+                return shared.at[:, slot].set(
+                    jnp.where(keep, pre[:, row], shared[:, slot]))
+            return jax.tree.map(write, cache, _strip_index(pre_cache))
+
+        self._propose_fn = propose
+        self._admit = admit
+
+    def propose(self, toks: np.ndarray, pos: np.ndarray) -> np.ndarray:
+        """One draft phase over the whole slot pool → proposals
+        [k, n_slots] (host). Rows at the sentinel position produce
+        garbage the caller ignores."""
+        self.cache, out = self._propose_fn(
+            self.params, self.cache, jnp.asarray(toks), jnp.asarray(pos))
+        return np.asarray(out)
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_progs.get(bucket)
+        if fn is None:
+            model = self._prefill_model
+            shapes = cache_shapes(model, 1)
+
+            @jax.jit
+            def prefill(params, prompt):
+                cache = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+                positions = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+                _, upd = model.apply(
+                    {"params": params, "cache": cache}, prompt, positions,
+                    mutable=["cache"])
+                return upd["cache"]
+
+            fn = self._prefill_progs[bucket] = prefill
+        return fn
+
+    def _suffix_fn(self, bucket: int):
+        fn = self._suffix_progs.get(bucket)
+        if fn is None:
+            from tpu_on_k8s.models.decode import _set_cursor
+            model = self._prefill_model
+
+            @jax.jit
+            def prefill(params, pre_cache, suffix, plen):
+                cache = _set_cursor(pre_cache, plen)
+                positions = plen + jnp.arange(bucket,
+                                              dtype=jnp.int32)[None, :]
+                _, upd = model.apply(
+                    {"params": params, "cache": cache}, suffix, positions,
+                    mutable=["cache"])
+                return upd["cache"]
+
+            fn = self._suffix_progs[bucket] = prefill
+        return fn
+
+    def register_prefix(self, pid: int, tokens: np.ndarray) -> None:
+        """Draft-prefill a shared prefix under the ENGINE's prefix id, so
+        prefix-seeded admissions can seed their draft rows too."""
+        lp = int(tokens.size)
+        bucket = _bucket_len(lp, self.cfg.max_seq_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :lp] = tokens
+        cache = self._prefill_fn(bucket)(self.params, jnp.asarray(padded))
+        self.prefixes[pid] = (cache, lp)
+
+    def drop_prefix(self, pid: int) -> None:
+        self.prefixes.pop(pid, None)
+
+    def seed(self, slot: int, prompt: np.ndarray,
+             prefix_id: Optional[int]) -> bool:
+        """Prefill ``prompt`` (the suffix, with ``prefix_id``) through the
+        draft and splice it into the draft cache's row ``slot``. False
+        when the row cannot be drafted — an ``import_prefix`` id the
+        draft never saw prefilled; the slot then decodes plain."""
+        if prefix_id is not None:
+            entry = self.prefixes.get(prefix_id)
+            if entry is None:
+                return False
+            pre, plen = entry
+            slen = int(prompt.size)
+            bucket = _bucket_len(slen, self.cfg.max_seq_len - plen)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :slen] = prompt
+            cache = self._suffix_fn(bucket)(
+                self.params, pre, jnp.asarray(padded), jnp.int32(plen))
+            lp = plen + slen
+        else:
+            lp = int(prompt.size)
+            bucket = _bucket_len(lp, self.cfg.max_seq_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :lp] = prompt
+            cache = self._prefill_fn(bucket)(self.params,
+                                             jnp.asarray(padded))
+        self.cache = self._admit(self.cache, cache, jnp.int32(slot),
+                                 jnp.int32(lp), jnp.int32(0))
+        return True
+
+
 class ContinuousBatchingEngine:
     """Slot-pool continuous batching over one model + parameter set.
 
@@ -252,7 +443,10 @@ class ContinuousBatchingEngine:
                  step_horizon: int = 1, metrics=None,
                  int8_weights: bool = False, prefill_chunk: int = 0,
                  queue_cap: Optional[int] = None, on_retire=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 draft_cfg: Optional[TransformerConfig] = None,
+                 draft_params=None, spec_k: int = 4, spec_metrics=None,
+                 on_spec_round=None):
         if step_horizon < 1:
             raise ValueError(f"step_horizon must be >= 1, got {step_horizon}")
         if queue_cap is not None and queue_cap < 1:
@@ -430,6 +624,60 @@ class ContinuousBatchingEngine:
         self._prefixes: Dict[int, Any] = {}   # id → (cache pytree, length)
         self._next_prefix_id = 0
 
+        # ---- speculative decoding (batched drafts over the slot pool) ----
+        #: optional ``metrics.SpecMetrics`` — proposed/accepted counters,
+        #: the acceptance-rate gauge, rollback + draft-crash counters
+        self.spec_metrics = spec_metrics
+        #: ``on_spec_round(request_ids, draft_s, verify_s, proposed,
+        #: accepted)`` fires after each spec round (outside the lock) —
+        #: the gateway turns it into ``spec.draft``/``spec.verify`` span
+        #: events on the live requests' decode spans so `trace_report`
+        #: can attribute draft overhead. Like ``on_retire``, a raising
+        #: callback detaches with a warning.
+        self._on_spec_round = on_spec_round
+        self._spec_k = spec_k
+        self._draft: Optional[_DraftRunner] = None
+        if draft_cfg is not None or draft_params is not None:
+            if draft_cfg is None or draft_params is None:
+                raise ValueError("draft_cfg and draft_params come together")
+            if mesh is not None:
+                raise NotImplementedError(
+                    "speculative decoding + mesh are not supported "
+                    "together (the draft pool is single-device)")
+            if step_horizon != 1:
+                raise ValueError(
+                    "speculative decoding replaces the step horizon "
+                    "(each round already scans k draft steps); use "
+                    "step_horizon=1")
+            if not self.sampling.is_greedy:
+                raise ValueError(
+                    "speculative decoding is greedy-only: token identity "
+                    "with plain decode is the correctness contract, and "
+                    "sampled acceptance needs rejection sampling")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError("draft and target must share a vocabulary")
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            self._draft = _DraftRunner(draft_cfg, draft_params, n_slots,
+                                       max_len, spec_k)
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def spec_verify(params, cache, chunk, positions):
+                """ONE batched target forward verifying every slot's
+                ``k+1`` chunk ``[last_token, d_1..d_k]`` at its own
+                positions; ``greedy[i, j]`` is row i's target token after
+                its chunk prefix of length j+1. Rows without proposals
+                (plain slots, free slots) carry the sentinel position
+                past column 0 — their appends drop and only
+                ``greedy[i, 0]`` (the ordinary next token) is read."""
+                logits, upd = self._step_model.apply(
+                    {"params": params, "cache": cache}, chunk, positions,
+                    mutable=["cache"])
+                return upd["cache"], jnp.argmax(
+                    logits, axis=-1).astype(jnp.int32)
+
+            self._spec_verify = spec_verify
+
         self._slots: List[Optional[_Slot]] = [None] * n_slots
         self._queue: deque[_Pending] = deque()
         self._kv_queue: deque[_KVPending] = deque()
@@ -445,7 +693,17 @@ class ContinuousBatchingEngine:
                       # padded positions run through prefill programs, and
                       # how many of those were shared-prefix registrations
                       "prefill_positions": 0, "prefix_prefills": 0,
-                      "kv_adopted": 0, "kv_exported": 0}
+                      "kv_adopted": 0, "kv_exported": 0,
+                      # speculative decoding: rounds run, draft tokens
+                      # proposed/accepted (their ratio is the acceptance
+                      # rate), slot-rounds with >= 1 rejection, draft
+                      # crashes
+                      # (degrade-to-plain events), and device seconds in
+                      # the draft/verify phases on this engine's clock
+                      "spec_rounds": 0, "spec_proposed": 0,
+                      "spec_accepted": 0, "spec_rollbacks": 0,
+                      "draft_crashes": 0,
+                      "spec_draft_s": 0.0, "spec_verify_s": 0.0}
         #: hard bound on requests in flight (queued + prefilling + slots);
         #: ``submit`` past it raises ``EngineOverloadedError``. None keeps
         #: the historical unbounded queue (library use; the gateway bounds
@@ -495,6 +753,10 @@ class ContinuousBatchingEngine:
             pid = self._next_prefix_id
             self._next_prefix_id += 1
             self._prefixes[pid] = (cache, lp)
+        if self._draft is not None:
+            # mirror the prefix through the draft so prefix-seeded
+            # admissions can seed their draft rows too
+            self._draft.register_prefix(pid, tokens)
         return pid
 
     def export_prefix(self, prefix_id: int):
@@ -515,7 +777,10 @@ class ContinuousBatchingEngine:
         """Register an already-computed prefix KV (an ``export_prefix``
         host copy from a same-config engine) without running any prefill
         — a host→device copy instead of compute. Returns the new
-        prefix id."""
+        prefix id. No token content travels with an export, so a
+        speculative engine cannot mirror it through the draft: requests
+        using an imported prefix decode on the plain path (exact, just
+        unaccelerated)."""
         lp = int(lp)
         if lp < 1 or lp > self.max_len - 2:
             raise ValueError(f"prefix length {lp} does not fit under "
@@ -532,6 +797,8 @@ class ContinuousBatchingEngine:
         path — its host copy lives on in the overflow tier). The caller
         owns the invariant that no queued/in-flight request still
         references the id."""
+        if self._draft is not None:
+            self._draft.drop_prefix(prefix_id)
         with self._lock:
             return self._prefixes.pop(prefix_id, None) is not None
 
@@ -944,10 +1211,18 @@ class ContinuousBatchingEngine:
                                   jnp.int32(i), jnp.int32(lp),
                                   jnp.int32(row))
         first = int(first)   # host sync: the first token IS emitted now
+        drafted = False
+        if self._draft is not None:
+            # seed the slot's draft row from the request's own tokens —
+            # one cheap draft prefill (the draft never chunks; its whole
+            # prompt fits one bucketed call). False (an imported-prefix
+            # id the draft never saw) leaves the slot on plain decode.
+            drafted = self._draft.seed(i, req.prompt, req.prefix_id)
         with self._lock:
             self._slots[i] = _Slot(req.request_id, lp, first, [first],
                                    req.max_new_tokens, req.eos_id,
-                                   req.submitted_at, req.on_token)
+                                   req.submitted_at, req.on_token,
+                                   draft=drafted)
             self._admitting.discard(i)
         self._fire_on_token(self._slots[i], first)
         self.stats["admitted"] += 1
@@ -1105,7 +1380,9 @@ class ContinuousBatchingEngine:
             before = set(self._finished)
         self._admit_pending()
         active = [i for i, s in enumerate(self._slots) if s is not None]
-        if active:
+        if active and self._use_spec_round(active):
+            self._spec_round(active)
+        elif active:
             toks = np.zeros(self.n_slots, np.int32)
             pos = np.full(self.n_slots, self.max_len, np.int32)  # sentinel
             for i in active:
@@ -1119,16 +1396,7 @@ class ContinuousBatchingEngine:
             self.stats["steps"] += self.step_horizon
             emitted_now = 0
             for i in active:
-                for j in range(self.step_horizon):
-                    slot = self._slots[i]
-                    slot.pos += 1
-                    slot.last_token = int(out[j, i])
-                    slot.emitted.append(slot.last_token)
-                    self.stats["emitted"] += 1
-                    emitted_now += 1
-                    self._fire_on_token(slot, slot.last_token)
-                    if self._retire_if_done(i):
-                        break  # surplus horizon tokens are discarded
+                emitted_now += self._emit_tokens(i, out[:, i])
             if self.metrics is not None:
                 self.metrics.inc("tokens_emitted", emitted_now)
         if self.metrics is not None:
@@ -1137,6 +1405,150 @@ class ContinuousBatchingEngine:
                 sum(s is not None for s in self._slots))
         with self._lock:
             return sorted(set(self._finished) - before)
+
+    def _emit_tokens(self, i: int, tokens) -> int:
+        """Append host-side ``tokens`` to slot ``i`` in order: position,
+        bookkeeping, streaming, and retirement are ONE sequence shared by
+        the plain horizon loop and the speculative rounds — the two
+        decode paths cannot diverge on emission semantics. Stops at
+        retirement (surplus tokens are discarded, greedy output is
+        unchanged); returns the count actually emitted."""
+        n = 0
+        for tok in tokens:
+            slot = self._slots[i]
+            slot.pos += 1
+            slot.last_token = int(tok)
+            slot.emitted.append(slot.last_token)
+            self.stats["emitted"] += 1
+            n += 1
+            self._fire_on_token(slot, slot.last_token)
+            if self._retire_if_done(i):
+                break
+        return n
+
+    def _use_spec_round(self, active: List[int]) -> bool:
+        """True when this step should run a speculative round: a draft is
+        attached, at least one active slot is drafted (an all-undrafted
+        pool — e.g. a disagg decode replica serving only adopted
+        handoffs — takes the plain step rather than paying the
+        (k+1)-wide verify to emit one token per slot), and the draft
+        survives this round's chaos injection."""
+        if self._draft is None:
+            return False
+        if not any(self._slots[i].draft for i in active):
+            return False
+        fault = chaos.fire(chaos.SITE_SPEC_DRAFT,
+                           rounds=self.stats["spec_rounds"])
+        if isinstance(fault, chaos.DraftCrash):
+            self.degrade_draft()
+            return False
+        return True
+
+    def degrade_draft(self) -> None:
+        """Drop a dead draft model and keep serving: every in-flight
+        request continues on the plain decode path from this very step,
+        token-identically (greedy — the draft is an accelerator, never a
+        correctness dependency). Counted, never silent. Raised by chaos
+        (``DraftCrash``); an external supervisor translating a real
+        draft-worker death should call it too so recovery stays typed."""
+        self._draft = None
+        self.stats["draft_crashes"] += 1
+        if self.spec_metrics is not None:
+            self.spec_metrics.inc("spec_draft_crashes")
+        import warnings
+        warnings.warn("speculative draft crashed; engine degraded to "
+                      "plain decode (token-identical, nothing lost)",
+                      stacklevel=3)
+
+    def _spec_round(self, active: List[int]) -> None:
+        """One speculative round over the whole slot pool: the draft
+        proposes ``k`` greedy tokens per drafted slot in one scanned
+        program, ONE batched target forward verifies every slot's
+        ``[last_token, d_1..d_k]`` chunk, and each row emits its longest
+        agreeing prefix plus the target's correction/bonus token — 1 to
+        ``k+1`` tokens per row per round, token-identical to plain greedy
+        decode. Undrafted rows (adopted handoffs, imported prefixes)
+        ride the same programs at the sentinel position and emit exactly
+        their ordinary next token (``_use_spec_round`` guarantees at
+        least one drafted row — an all-undrafted pool takes the plain
+        step instead). Rollback is position bookkeeping only — see
+        ``_DraftRunner``."""
+        k = self._spec_k
+        t0 = self._clock()
+        toks = np.zeros(self.n_slots, np.int32)
+        pos = np.full(self.n_slots, self.max_len, np.int32)   # sentinel
+        for i in active:
+            s = self._slots[i]
+            toks[i] = s.last_token
+            if s.draft:
+                pos[i] = s.pos
+        proposals = self._draft.propose(toks, pos)
+        t1 = self._clock()
+        chunk = np.zeros((self.n_slots, k + 1), np.int32)
+        cpos = np.full((self.n_slots, k + 1), self.max_len, np.int32)
+        for i in active:
+            s = self._slots[i]
+            chunk[i, 0] = s.last_token
+            cpos[i, 0] = s.pos
+            if s.draft:
+                chunk[i, 1:] = proposals[:, i]
+                cpos[i] = s.pos + np.arange(k + 1, dtype=np.int32)
+        # no rng split: spec mode is greedy-only by construction, so no
+        # key is ever consumed (and degrade-to-plain stays greedy too)
+        self._cache, greedy = self._spec_verify(
+            self._params, self._cache, jnp.asarray(chunk),
+            jnp.asarray(cpos))
+        greedy = np.asarray(greedy)                    # [n_slots, k+1]
+        t2 = self._clock()
+        self.stats["steps"] += 1
+        rids = sorted(self._slots[i].request_id for i in active)
+        emitted_now = proposed = accepted_n = rollbacks = 0
+        for i in active:
+            s = self._slots[i]
+            if s.draft:
+                j = 0
+                while j < k and proposals[j, i] == greedy[i, j]:
+                    j += 1
+                out = [int(proposals[x, i]) for x in range(j)]
+                out.append(int(greedy[i, j]))   # correction (bonus at j=k)
+                proposed += k
+                accepted_n += j
+                if j < k:
+                    rollbacks += 1
+            else:
+                out = [int(greedy[i, 0])]
+            emitted_now += self._emit_tokens(i, out)
+        self.stats["spec_rounds"] += 1
+        self.stats["spec_proposed"] += proposed
+        self.stats["spec_accepted"] += accepted_n
+        self.stats["spec_rollbacks"] += rollbacks
+        self.stats["spec_draft_s"] += t1 - t0
+        self.stats["spec_verify_s"] += t2 - t1
+        if self.spec_metrics is not None and proposed:
+            self.spec_metrics.inc("spec_tokens_proposed", proposed)
+            if accepted_n:
+                self.spec_metrics.inc("spec_tokens_accepted", accepted_n)
+            if rollbacks:
+                self.spec_metrics.inc("spec_rollbacks", rollbacks)
+            self.spec_metrics.set_gauge(
+                "spec_acceptance_rate",
+                self.stats["spec_accepted"]
+                / max(self.stats["spec_proposed"], 1))
+        if self.metrics is not None:
+            self.metrics.inc("tokens_emitted", emitted_now)
+        if self._on_spec_round is not None:
+            try:
+                self._on_spec_round(rids, t1 - t0, t2 - t1, proposed,
+                                    accepted_n)
+            except Exception as e:  # noqa: BLE001 — isolate like on_retire
+                self._on_spec_round = None
+                from tpu_on_k8s.metrics.metrics import (
+                    count_detached_callback,
+                )
+                count_detached_callback(
+                    self.metrics,
+                    f"on_spec_round callback raised {type(e).__name__}: "
+                    f"{e}; detached")
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drain the queue and every active slot; returns {id: tokens}."""

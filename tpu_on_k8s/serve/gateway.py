@@ -134,6 +134,16 @@ class ServingGateway:
         # fair queue, in original-admission (rid) order
         self._replay_pending: List[GatewayRequest] = []
         engine._on_retire = self._on_engine_retire
+        if (self._tracer.enabled
+                and getattr(engine, "_draft", None) is not None
+                and getattr(engine, "_on_spec_round", None) is None):
+            # speculative engine under a live tracer: turn each spec
+            # round into `spec.draft`/`spec.verify` events on the live
+            # requests' decode spans, so `tools/trace_report.py` can
+            # attribute draft overhead per request. Tracing off installs
+            # nothing — spec serving stays bit-for-bit on its
+            # pre-tracing behavior (the determinism contract).
+            engine._on_spec_round = self._note_spec_round
 
     # ---- frontend API ------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, *, tenant: str = "default",
@@ -323,6 +333,25 @@ class ServingGateway:
             self._in_engine -= 1
             self._finalize_locked(self._requests[rid], RequestState.DONE,
                                   tokens)
+
+    def _note_spec_round(self, engine_rids, draft_s: float, verify_s: float,
+                         proposed: int, accepted: int) -> None:
+        """Engine hook (fires once per speculative round, outside the
+        engine lock): mark the round on every live request's decode span.
+        ``dt`` rides the engine's own injectable clock — virtual-clock
+        runs stay byte-identical (dt=0), hardware runs carry real device
+        seconds for the report's draft-overhead attribution."""
+        with self._lock:
+            for erid in engine_rids:
+                rid = self._by_engine.get(erid)
+                if rid is None:
+                    continue          # retired this very round
+                req = self._requests.get(rid)
+                if req is None or req.phase_span is None:
+                    continue
+                req.phase_span.event("spec.draft", dt=draft_s)
+                req.phase_span.event("spec.verify", dt=verify_s,
+                                     proposed=proposed, accepted=accepted)
 
     def _wrap_on_token(self, req: GatewayRequest):
         def hook(engine_rid: int, token: int) -> None:
