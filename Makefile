@@ -7,7 +7,7 @@
 IMG ?= tpu-on-k8s/manager:latest
 
 .PHONY: test test-fast analyze lint chaos-soak fleet-soak autoscale-soak \
-        disagg-soak spec-soak trace-demo native bench dryrun manager samples clean \
+        disagg-soak spec-soak shard-soak trace-demo native bench dryrun manager samples clean \
         docker-build docker-push deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
@@ -17,6 +17,7 @@ FLEET_SEED ?= 4321
 AUTOSCALE_SEED ?= 2468
 DISAGG_SEED ?= 8642
 SPEC_SEED ?= 7531
+SHARD_SEED ?= 1357
 TRACE_SEED ?= 8642
 TRACE_FLAGS = --disagg --n-requests 24 --prefix-bucket 8 --prompt-min 4 \
     --prompt-max 12 --new-min 4 --new-max 8 --decode-replicas 2 \
@@ -62,6 +63,11 @@ spec-soak:  ## speculative vs plain decode on the seeded cost-model trace, spec 
 	JAX_PLATFORMS=cpu python tools/serve_load.py --spec --soak \
 	    --n-requests 32 --rate 2.0 --prompt-min 4 --prompt-max 12 \
 	    --new-min 6 --new-max 16 --seed $(SPEC_SEED)
+
+shard-soak:  ## mesh-sharded vs single-program decode on the seeded cost-model trace across CPU meshes 1/2/4: byte-identical event logs + token identity + ~linear per-chip memory
+	JAX_PLATFORMS=cpu python tools/serve_load.py --shard --soak \
+	    --n-requests 24 --prompt-min 4 --prompt-max 12 \
+	    --new-min 4 --new-max 10 --seed $(SHARD_SEED)
 
 trace-demo:  ## seeded disagg trace dumped twice: byte-identical span dumps + the TTFT critical-path report
 	JAX_PLATFORMS=cpu python tools/serve_load.py $(TRACE_FLAGS) \

@@ -367,3 +367,90 @@ def test_decode_policy_change_rolls_the_fleet():
     hashes = {p.metadata.labels[constants.LABEL_SERVING_IMAGE_HASH]
               for p in svc_pods(cluster)}
     assert hashes == {h_int8}               # promoted: old variant gone
+
+
+def test_sharding_policy_change_rolls_the_fleet():
+    """Flipping `ShardingPolicy` (the replica mesh shape) is a ROLLOUT,
+    not a live relayout: the mesh folds into the replica identity hash
+    beside `DecodePolicy`, so the reconciler surges new pods carrying
+    --mesh-*/--shard-rules args, canaries them under traffic, drains
+    the old single-program replicas, and converges with zero capacity
+    dip — the CRD-plane half of the reshard acceptance (the in-process
+    zero-request-loss half is tests/test_serve_shard.py)."""
+    from tpu_on_k8s.api.inference_types import ShardingPolicy
+    from tpu_on_k8s.controller.inferenceservice import decode_variant
+
+    policy = ShardingPolicy(model=4)
+    cluster, manager, sim, clock = make_env()
+    make_model(cluster)
+    make_svc(cluster, replicas=2,
+             rollout=RolloutPolicy(max_surge=1, max_unavailable=0,
+                                   drain_seconds=5.0))
+    manager.run_until_idle()
+    sim.run_all("default")
+    manager.run_until_idle()
+    h_plain = image_hash("reg.local/m1:v1")
+    for p in svc_pods(cluster):
+        assert not any(a.startswith("--mesh-")
+                       for a in p.spec.containers[0].args)
+
+    def set_sharding(s: InferenceService) -> None:
+        s.spec.sharding = policy
+    cluster.update_with_retry(InferenceService, "default", "svc",
+                              set_sharding)
+    manager.run_until_idle()
+    h_mesh = image_hash(decode_variant("reg.local/m1:v1", None, policy))
+    assert h_mesh != h_plain
+    by_hash = {}
+    for p in svc_pods(cluster):
+        by_hash.setdefault(
+            p.metadata.labels[constants.LABEL_SERVING_IMAGE_HASH],
+            []).append(p)
+    # surge: ONE new-mesh replica; both old still serving (ready floor)
+    assert len(by_hash[h_mesh]) == 1 and len(by_hash[h_plain]) == 2
+    args = by_hash[h_mesh][0].spec.containers[0].args
+    assert "--mesh-model=4" in args and "--shard-rules=serving" in args
+    assert "--mesh-data=1" in args and "--mesh-expert=1" in args
+
+    sim.run_all("default")
+    manager.run_until_idle()
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.canary_weight > 0     # canary split granted
+
+    for _ in range(8):                      # drain grace -> reap -> surge
+        clock.advance(6.0)
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+    svc = cluster.get(InferenceService, "default", "svc")
+    assert svc.status.phase is ServicePhase.READY
+    assert svc.status.canary_weight == 1.0
+    hashes = {p.metadata.labels[constants.LABEL_SERVING_IMAGE_HASH]
+              for p in svc_pods(cluster)}
+    assert hashes == {h_mesh}               # promoted: old shape gone
+
+
+def test_trivial_sharding_policy_is_not_a_rollout():
+    """`sharding: {}` (all-1 axes) maps to the bare image identity —
+    applying it to a running fleet must not trigger a no-op rollout."""
+    from tpu_on_k8s.api.inference_types import ShardingPolicy
+    from tpu_on_k8s.controller.inferenceservice import decode_variant
+
+    cluster, manager, sim, clock = make_env()
+    make_model(cluster)
+    make_svc(cluster, replicas=2)
+    manager.run_until_idle()
+    sim.run_all("default")
+    manager.run_until_idle()
+    assert decode_variant("reg.local/m1:v1", None,
+                          ShardingPolicy()) == "reg.local/m1:v1"
+
+    def set_sharding(s: InferenceService) -> None:
+        s.spec.sharding = ShardingPolicy()
+    cluster.update_with_retry(InferenceService, "default", "svc",
+                              set_sharding)
+    manager.run_until_idle()
+    hashes = {p.metadata.labels[constants.LABEL_SERVING_IMAGE_HASH]
+              for p in svc_pods(cluster)}
+    assert hashes == {image_hash("reg.local/m1:v1")}
+    assert len(svc_pods(cluster)) == 2      # no surge minted

@@ -37,6 +37,7 @@ from tpu_on_k8s.metrics.metrics import (
     FleetMetrics,
     JobMetrics,
     ServingMetrics,
+    ShardMetrics,
     SpecMetrics,
     TrainMetrics,
     exposition,
@@ -503,10 +504,16 @@ def _populate(m):
     elif isinstance(m, AutoscaleMetrics):
         m.decision("scale_up")
         m.set_gauge("desired_replicas", 3.0, label="default/svc")
+    elif isinstance(m, ShardMetrics):
+        m.set_gauge("mesh_axis_size", 4.0, label="model")
+        m.set_gauge("param_bytes_per_chip", 1024.0)
+        m.set_gauge("kv_bytes_per_chip", 512.0)
+        m.inc("reshard_rollouts")
+        m.inc("export_gather_bytes", 4096)
 
 
 _ALL_CLASSES = (JobMetrics, ServingMetrics, SpecMetrics, TrainMetrics,
-                FleetMetrics, AutoscaleMetrics)
+                FleetMetrics, AutoscaleMetrics, ShardMetrics)
 
 
 class TestExposition:

@@ -1,0 +1,404 @@
+"""Mesh-sharded serving (`models/serving.py` over `parallel/mesh`):
+token-identity oracles vs the unsharded engine on forced-multi-device
+CPU meshes, through every engine feature — prefix cache, chunked
+prefill, mid-decode ``export_kv`` across UNLIKE meshes, speculative
+rounds, int8 trees — plus the divisibility validation, the
+``ShardMetrics``/shard-report surface, the ``ShardingPolicy`` identity
+hash, and the in-process reshard rollout (zero request loss).
+
+The conftest forces 8 CPU devices, so 2- and 4-way meshes are real
+SPMD programs here, not mocks.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_on_k8s.models.decode import generate
+from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+from tpu_on_k8s.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    serving_partition_rules,
+)
+from tpu_on_k8s.parallel.mesh import mesh_axes, serving_mesh
+
+
+@pytest.fixture(scope="module")
+def setup():
+    # four kv heads so the KV pool shards on `model` up to the 4-way
+    # mesh (tiny's GQA 2 would cap KV sharding at 2)
+    cfg = dataclasses.replace(TransformerConfig.tiny(), dtype=jnp.float32,
+                              max_seq_len=64, n_kv_heads=4)
+    tok = jax.random.randint(jax.random.key(0), (1, 8), 0, cfg.vocab_size,
+                             jnp.int32)
+    params = Transformer(cfg).init(jax.random.key(1), tok)["params"]
+    return cfg, params
+
+
+def _want(cfg, params, prompt, n):
+    """Oracle: the single-request greedy continuation, unsharded."""
+    return np.asarray(generate(cfg, params,
+                               jnp.asarray(prompt, jnp.int32)[None, :],
+                               n)[0])
+
+
+def _mesh(n):
+    return serving_mesh(model=n, devices=jax.devices()[:n])
+
+
+def _prompts(cfg, seed, sizes):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab_size, size=s).astype(np.int32)
+            for s in sizes]
+
+
+# ---------------------------------------------------------------- oracles
+@pytest.mark.parametrize("n_model", [2, 4])
+def test_staggered_decode_matches_unsharded(setup, n_model):
+    """Ragged staggered requests on a model-parallel mesh reproduce the
+    unsharded greedy outputs exactly; the KV pool really is sharded."""
+    cfg, params = setup
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2,
+                                   mesh=_mesh(n_model))
+    assert eng.mesh_axes == {"model": n_model}
+    kv = eng._cache["blocks"]["attn"]["k"]
+    assert kv.sharding.spec == jax.sharding.PartitionSpec(
+        None, None, None, "model")
+    prompts = _prompts(cfg, 9, (6, 13, 4))
+    ids = [eng.submit(p, n) for p, n in zip(prompts, (8, 5, 7))]
+    eng.step()                     # two in flight, one queued
+    out = eng.run()
+    for rid, p, n in zip(ids, prompts, (8, 5, 7)):
+        np.testing.assert_array_equal(out[rid], _want(cfg, params, p, n),
+                                      err_msg=f"request {rid}")
+
+
+def test_data_axis_shards_slot_pool(setup):
+    """A {data: 2, model: 2} mesh — the slot pool split on `data` on
+    top of tensor-parallel `model` — stays token-identical through
+    staggered admission, slot reuse, and a mid-decode export: the
+    admit/splice programs' dynamic slot writes cross data shards."""
+    cfg, params = setup
+    mesh = serving_mesh(data=2, model=2, devices=jax.devices()[:4])
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=4, mesh=mesh)
+    assert eng.mesh_axes == {"data": 2, "model": 2}
+    kv = eng._cache["blocks"]["attn"]["k"]
+    assert kv.sharding.spec == jax.sharding.PartitionSpec(
+        None, "data", None, "model")
+    prompts = _prompts(cfg, 45, (6, 13, 4, 9, 5))
+    news = (8, 5, 7, 6, 9)
+    ids = [eng.submit(p, n) for p, n in zip(prompts, news)]
+    eng.step()                       # 4 in flight, 1 queued: slot reuse
+    out = eng.run()
+    for rid, p, n in zip(ids, prompts, news):
+        np.testing.assert_array_equal(out[rid], _want(cfg, params, p, n),
+                                      err_msg=f"request {rid}")
+    # export off a data-sharded slot row adopts exactly elsewhere
+    r = eng.submit(prompts[0], 10)
+    eng.step()
+    h = eng.export_kv(r)
+    assert h is not None and h.verify()
+    eng.abort(r)
+    dst = ContinuousBatchingEngine(cfg, params, n_slots=2)
+    r2 = dst.submit_kv(h, 10)
+    np.testing.assert_array_equal(dst.run()[r2],
+                                  _want(cfg, params, prompts[0], 10))
+
+
+def test_prefix_cache_sharded(setup):
+    """Registered-prefix admissions on a mesh match the full-prompt
+    unsharded oracle (prefix KV sharded, suffix prefill sharded)."""
+    cfg, params = setup
+    rng = np.random.default_rng(21)
+    prefix = rng.integers(0, cfg.vocab_size, size=11).astype(np.int32)
+    suffixes = _prompts(cfg, 22, (4, 9))
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2, mesh=_mesh(2))
+    pid = eng.register_prefix(prefix)
+    ids = [eng.submit(s, n, prefix_id=pid)
+           for s, n in zip(suffixes, (8, 5))]
+    out = eng.run()
+    for rid, s, n in zip(ids, suffixes, (8, 5)):
+        full = np.concatenate([prefix, s])
+        np.testing.assert_array_equal(out[rid],
+                                      _want(cfg, params, full, n))
+
+
+def test_chunked_prefill_sharded(setup):
+    """A long prompt admitted chunk-by-chunk on a mesh matches the
+    whole-prompt unsharded oracle."""
+    cfg, params = setup
+    prompt = _prompts(cfg, 31, (23,))[0]
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2, mesh=_mesh(2),
+                                   prefill_chunk=8)
+    r = eng.submit(prompt, 9)
+    np.testing.assert_array_equal(eng.run()[r],
+                                  _want(cfg, params, prompt, 9))
+
+
+def test_export_kv_across_unlike_meshes(setup):
+    """Mid-decode ``export_kv`` on a 2-way mesh adopts token-identically
+    on a 4-way mesh AND a single-program engine (gather-on-export,
+    reshard-on-import), and the handoff carries its source layout."""
+    cfg, params = setup
+    prompt = _prompts(cfg, 40, (7,))[0]
+    src = ContinuousBatchingEngine(cfg, params, n_slots=2, mesh=_mesh(2))
+    r = src.submit(prompt, 12)
+    src.step()
+    src.step()
+    h = src.export_kv(r)
+    assert h is not None and h.verify()
+    assert h.layout is not None
+    assert h.layout.mesh_axes == {"model": 2}
+    assert h.layout.gathered_bytes > 0
+    assert src.stats["export_gather_bytes"] == h.layout.gathered_bytes
+    src.abort(r)
+    full = _want(cfg, params, prompt, 12)
+    for target in (ContinuousBatchingEngine(cfg, params, n_slots=2,
+                                            mesh=_mesh(4)),
+                   ContinuousBatchingEngine(cfg, params, n_slots=2)):
+        r2 = target.submit_kv(h, 12)
+        np.testing.assert_array_equal(target.run()[r2], full)
+
+
+def test_prefix_export_import_across_meshes(setup):
+    """``export_prefix`` from a sharded engine imports onto an unlike
+    mesh and an unsharded engine — the fleet prefix store's cross-mesh
+    reuse path — with exact continuations either way."""
+    cfg, params = setup
+    rng = np.random.default_rng(51)
+    prefix = rng.integers(0, cfg.vocab_size, size=9).astype(np.int32)
+    suffix = _prompts(cfg, 52, (5,))[0]
+    full = np.concatenate([prefix, suffix])
+    src = ContinuousBatchingEngine(cfg, params, n_slots=2, mesh=_mesh(2))
+    host, lp = src.export_prefix(src.register_prefix(prefix))
+    assert src.stats["export_gather_bytes"] > 0
+    for target in (ContinuousBatchingEngine(cfg, params, n_slots=2,
+                                            mesh=_mesh(4)),
+                   ContinuousBatchingEngine(cfg, params, n_slots=2)):
+        pid = target.import_prefix(host, lp)
+        r = target.submit(suffix, 8, prefix_id=pid)
+        np.testing.assert_array_equal(target.run()[r],
+                                      _want(cfg, params, full, 8))
+
+
+def test_speculative_rounds_sharded(setup):
+    """Speculative decoding composes with the mesh: a replicated
+    (self-)draft proposing for the sharded target stays greedy
+    token-identical, and rounds actually run."""
+    cfg, params = setup
+    prompts = _prompts(cfg, 60, (6, 11))
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2, mesh=_mesh(2),
+                                   draft_cfg=cfg, draft_params=params,
+                                   spec_k=3)
+    ids = [eng.submit(p, n) for p, n in zip(prompts, (10, 7))]
+    out = eng.run()
+    assert eng.stats["spec_rounds"] > 0
+    for rid, p, n in zip(ids, prompts, (10, 7)):
+        np.testing.assert_array_equal(out[rid], _want(cfg, params, p, n))
+
+
+def test_int8_tree_sharded(setup):
+    """int8 serving trees compose with the mesh (the scale-aware rules):
+    sharded W8A16 decode matches unsharded W8A16 decode exactly."""
+    cfg, params = setup
+    prompt = _prompts(cfg, 70, (8,))[0]
+    plain = ContinuousBatchingEngine(cfg, params, n_slots=2,
+                                     int8_weights=True)
+    r = plain.submit(prompt, 9)
+    want = plain.run()[r]
+    sharded = ContinuousBatchingEngine(cfg, params, n_slots=2,
+                                       int8_weights=True, mesh=_mesh(2))
+    r = sharded.submit(prompt, 9)
+    np.testing.assert_array_equal(sharded.run()[r], want)
+
+
+def test_int8_plus_speculative_sharded(setup):
+    """The full production stack at once: model-sharded W8A16 target,
+    replicated bf16 self-draft — token-identical to the unsharded int8
+    engine (the acceptance shape the ISSUE names)."""
+    cfg, params = setup
+    prompt = _prompts(cfg, 80, (7,))[0]
+    plain = ContinuousBatchingEngine(cfg, params, n_slots=2,
+                                     int8_weights=True)
+    r = plain.submit(prompt, 10)
+    want = plain.run()[r]
+    icfg = dataclasses.replace(cfg, serve_int8_weights=False)
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2,
+                                   int8_weights=True, mesh=_mesh(2),
+                                   draft_cfg=icfg, draft_params=params,
+                                   spec_k=3)
+    r = eng.submit(prompt, 10)
+    out = eng.run()[r]
+    assert eng.stats["spec_rounds"] > 0
+    np.testing.assert_array_equal(out, want)
+
+
+# ------------------------------------------------- validation + metrics
+def test_uneven_rule_raises_actionable_error(setup):
+    """An uneven partition rule fails at engine construction with a
+    typed error naming the param path, dim, and mesh axis — never an
+    opaque XLA error deep in compile."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_on_k8s.parallel.partition import (
+        PartitionRule,
+        ShardingValidationError,
+    )
+    cfg, params = setup
+    # rule spec with more dims than the leaf: named, not an XLA error
+    toolong = [PartitionRule(r"norm/scale", P("model", None, None))] \
+        + serving_partition_rules()
+    with pytest.raises(ShardingValidationError, match=r"names 3 dims"):
+        ContinuousBatchingEngine(cfg, params, n_slots=2, mesh=_mesh(2),
+                                 rules=toolong)
+    # non-dividing dim: the layer dim (2) cannot split over model=4 —
+    # the error names the param path, the dim, and the axis size
+    uneven = [PartitionRule(r"attn/wq/kernel", P("model"))] \
+        + serving_partition_rules()
+    with pytest.raises(ShardingValidationError,
+                       match=r"attn/wq/kernel.*dim 0.*model=4"):
+        ContinuousBatchingEngine(cfg, params, n_slots=2, mesh=_mesh(4),
+                                 rules=uneven)
+
+
+def test_shard_metrics_and_report(setup):
+    """`ShardMetrics` publishes the mesh shape and per-chip bytes;
+    `shard_report` shows param+KV per-chip bytes halving on a 2-way
+    mesh; export gathers count bytes on the counter."""
+    from tpu_on_k8s.metrics.metrics import ShardMetrics, exposition
+
+    cfg, params = setup
+    m = ShardMetrics()
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2, mesh=_mesh(2),
+                                   shard_metrics=m)
+    assert m.gauges[("mesh_axis_size", "model")] == 2
+    rep = eng.shard_report()
+    assert rep["n_chips"] == 2
+    assert rep["param_bytes_per_chip"] <= rep["param_bytes_total"] * 0.55
+    assert rep["kv_bytes_per_chip"] * 2 == rep["kv_bytes_total"]
+    assert m.gauges[("param_bytes_per_chip", "")] == \
+        rep["param_bytes_per_chip"]
+    r = eng.submit(_prompts(cfg, 90, (6,))[0], 6)
+    eng.step()
+    h = eng.export_kv(r)
+    assert m.counters[("export_gather_bytes", "")] == h.layout.gathered_bytes
+    assert "tpu_on_k8s_shard_mesh_axis_size" in exposition(m)
+
+
+def test_unsharded_engine_has_trivial_shard_surface(setup):
+    """The single-program engine reports the single-chip identity —
+    mesh_axes {}, per-chip == total — so fleets can read one surface
+    for both shapes."""
+    cfg, params = setup
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2)
+    assert eng.mesh_axes == {} and eng.n_chips == 1
+    rep = eng.shard_report()
+    assert rep["param_bytes_per_chip"] == rep["param_bytes_total"]
+    assert rep["kv_bytes_per_chip"] == rep["kv_bytes_total"]
+
+
+# ------------------------------------------------------ control plane
+def test_sharding_policy_identity_and_normalization():
+    """`ShardingPolicy` folds into the replica identity hash only when
+    non-trivial — `sharding: {}` on a running fleet must not roll it —
+    and composes with `DecodePolicy` tags."""
+    from tpu_on_k8s.api.inference_types import DecodePolicy, ShardingPolicy
+    from tpu_on_k8s.controller.inferenceservice import decode_variant
+
+    img = "reg.local/m:v1"
+    assert decode_variant(img, None, None) == img
+    assert decode_variant(img, None, ShardingPolicy()) == img
+    v = decode_variant(img, None, ShardingPolicy(model=4, expert=2))
+    assert v == img + "#mesh=d1m4e2,rules=serving"
+    both = decode_variant(img, DecodePolicy(int8_weights=True),
+                          ShardingPolicy(model=2))
+    assert "int8=1" in both and "mesh=d1m2e1" in both
+    p = ShardingPolicy(data=0, model=-3, rules="bogus").normalized()
+    assert (p.data, p.model, p.expert, p.rules) == (1, 1, 1, "serving")
+    assert ShardingPolicy(model=4).chips == 4
+
+
+def test_router_capacity_normalizes_load():
+    """A 4-chip replica legitimately holds 4x a 1-chip replica's
+    outstanding tokens before least-load prefers the small one; all-1
+    capacities keep today's behavior bit-for-bit."""
+    from tpu_on_k8s.serve.router import Router
+
+    r = Router(prefix_bucket_len=4, spill_tokens=0)
+    r.add_replica("big", "v1")
+    r.add_replica("small", "v1")
+    r.set_capacity("big", 4)
+    prompt = np.arange(16, dtype=np.int32)
+    # raw tokens: big=100 small=40 -> per chip big=25 small=40
+    got = r.route(prompt, ["big", "small"],
+                  {"big": 100, "small": 40})
+    assert got == "big"
+    with pytest.raises(ValueError):
+        r.set_capacity("big", 0)
+
+
+def test_reshard_rollout_zero_loss(setup):
+    """The in-process half of the ShardingPolicy-flip acceptance: a
+    fleet serving live traffic rolls from single-program replicas to
+    2-way-mesh replicas — every request reaches a typed terminal state
+    (zero loss), old replicas drain clean, and the reshard is counted
+    on stats and ShardMetrics."""
+    from tpu_on_k8s.metrics.metrics import ShardMetrics
+    from tpu_on_k8s.serve import (
+        FleetRolloutPolicy,
+        ProbeConfig,
+        Rejected,
+        ServingFleet,
+    )
+
+    cfg, params = setup
+
+    def plain_factory(name):
+        return ContinuousBatchingEngine(cfg, params, n_slots=2)
+
+    def sharded_factory(name):
+        return ContinuousBatchingEngine(cfg, params, n_slots=2,
+                                        mesh=_mesh(2))
+
+    sm = ShardMetrics()
+    fleet = ServingFleet(plain_factory, 2,
+                         probe=ProbeConfig(slow_start_steps=1),
+                         shard_metrics=sm)
+    rng = np.random.default_rng(7)
+    rids = []
+    for _ in range(3):
+        fleet.step()
+    for i in range(6):
+        r = fleet.submit(rng.integers(0, cfg.vocab_size,
+                                      size=5 + i).astype(np.int32), 6)
+        assert not isinstance(r, Rejected)
+        rids.append(r)
+    fleet.start_rollout(sharded_factory, "v2-sharded",
+                        FleetRolloutPolicy(max_surge=1, drain_timeout_s=None))
+    # keep traffic flowing mid-rollout
+    for i in range(4):
+        fleet.step()
+        r = fleet.submit(rng.integers(0, cfg.vocab_size,
+                                      size=4 + i).astype(np.int32), 5)
+        if not isinstance(r, Rejected):
+            rids.append(r)
+    out = fleet.run()
+    assert fleet.rollout_phase.value == "complete"
+    assert fleet.stats["rollouts_completed"] == 1
+    assert fleet.stats["reshard_rollouts"] == 1
+    assert sm.counters[("reshard_rollouts", "")] == 1
+    # zero request loss: every submitted rid reached DONE and is claimed
+    states = {rid: out[rid].state.value for rid in rids if rid in out}
+    assert len(states) == len(rids)
+    assert set(states.values()) == {"done"}
+    # every retired old replica drained clean
+    old = [rec for rec in fleet.retired if rec["version"] == "v1"]
+    assert old and all(rec["drained_clean"] for rec in old)
+    # the surviving replicas really are mesh-sharded
+    live = [rep for rep in fleet.replicas.values()
+            if rep.engine is not None]
+    assert live and all(rep.engine.mesh_axes == {"model": 2}
+                        for rep in live)

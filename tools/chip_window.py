@@ -477,6 +477,23 @@ def stage_serve_spec(timeout):
                         "--new-max", "64"], "serve_spec", timeout)
 
 
+def stage_serve_shard(timeout):
+    """Mesh-sharded serving on the chip's own devices: the seeded
+    cost-model trace across `model`-axis sizes 1/2/4 with the flagship
+    config — TPOT p50/p95 per arm, measured per-chip param+KV bytes
+    (the model-size headroom the mesh buys), and greedy token identity
+    across arms. Mesh sizes beyond the visible device count are
+    recorded as skipped, so a 1-chip window still lands the control
+    arm. Skips cleanly when the tunnel is down: the chip probe failure
+    is recorded as a retryable error like every other stage."""
+    return _json_stage([sys.executable, "tools/serve_load.py", "--bench",
+                        "--shard", "--shard-meshes", "1,2,4",
+                        "--n-slots", "4", "--n-requests", "32",
+                        "--rate", "1.5", "--prompt-min", "8",
+                        "--prompt-max", "64", "--new-min", "16",
+                        "--new-max", "64"], "serve_shard", timeout)
+
+
 def stage_serve_fleet(timeout):
     """The fleet headline (round-5 '#2 missed' decode/serving gap):
     router + 2 replicas on the same seeded trace — aggregate tok/s plus
@@ -504,6 +521,7 @@ STAGES = [
     ("continuous", stage_continuous, 1200, ("continuous_h8",)),
     ("serve_ttft", stage_serve_ttft, 1200, ()),
     ("serve_spec", stage_serve_spec, 1200, ()),
+    ("serve_shard", stage_serve_shard, 1200, ()),
     ("serve_fleet", stage_serve_fleet, 1200, ()),
     ("serve_autoscale", stage_serve_autoscale, 1200, ()),
     ("serve_disagg", stage_serve_disagg, 1200, ()),

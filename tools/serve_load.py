@@ -17,6 +17,7 @@ Usage:
     python tools/serve_load.py                        # tiny config, CPU-ok
     python tools/serve_load.py --bench --n-slots 8    # 350M flagship
     python tools/serve_load.py --replicas 2           # fleet + router
+    python tools/serve_load.py --shard                # mesh sizes 1/2/4
     python tools/serve_load.py --replicas 2 --soak \
         --crash-replica 1 --crash-step 5              # `make fleet-soak`
 Prints one JSON summary line (throughput, outcome counts, TTFT/TPOT
@@ -1040,15 +1041,229 @@ def _disagg_main(args, cfg, params, max_len) -> dict:
     return summary
 
 
-def main(argv=None) -> dict:
+def run_shard_trace(args, cfg, params, max_len, *, model_axis: int,
+                    baseline_bytes: Optional[int] = None,
+                    trace: bool = False) -> dict:
+    """One seeded virtual-clock trace through a ``ServingGateway`` whose
+    engine is mesh-sharded with ``model=model_axis`` over the first
+    ``model_axis`` devices (``model_axis=1`` is the single-program
+    control arm — plain ``mesh=None`` engine, bit-for-bit today's
+    serving path).
+
+    Device time follows an explicit cost model, mirroring the
+    spec/disagg arms': decode is HBM-bandwidth-bound, so one engine
+    step costs ``step_dt`` scaled by the fraction of param+KV bytes
+    each chip actually reads (measured off the REAL sharded arrays'
+    shard shapes — `engine.shard_report`), plus ``--shard-comm-dt``
+    per step for the `model`-axis collectives when sharded. TPOT then
+    shows the real structure: per-chip bytes shrink ~linearly with the
+    ``model`` axis, so steps get proportionally cheaper, minus the
+    collective tax. Deterministic per seed — the event log
+    byte-compares across runs and greedy makes every arm's OUTPUT
+    TOKENS identical (the oracle the soak asserts).
+
+    ``--bench`` swaps the cost model for the WALL clock (with an
+    off-trace compile warmup), same contract as the spec arm: the chip
+    window's ``serve_shard`` stage records the hardware TPOT delta
+    across real-chip meshes, not the modeled one (per-chip bytes are
+    measured off the real shard shapes either way)."""
     import jax
-    import jax.numpy as jnp
 
-    from tpu_on_k8s.metrics.metrics import ServingMetrics
+    from tpu_on_k8s.metrics.metrics import ServingMetrics, ShardMetrics
     from tpu_on_k8s.models.serving import ContinuousBatchingEngine
-    from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
-    from tpu_on_k8s.serve import AdmissionConfig, ServingGateway
+    from tpu_on_k8s.parallel.mesh import serving_mesh
+    from tpu_on_k8s.serve import AdmissionConfig, Rejected, ServingGateway
 
+    wall = bool(args.bench)
+    vclock = _VirtualClock()
+    clock = time.monotonic if wall else vclock
+    tracer = _make_tracer(args, clock) if trace else None
+    mesh = None
+    if model_axis > 1:
+        mesh = serving_mesh(model=model_axis,
+                            devices=jax.devices()[:model_axis])
+    shard_metrics = ShardMetrics()
+    engine = ContinuousBatchingEngine(
+        cfg, params, n_slots=args.n_slots, max_len=max_len, clock=clock,
+        mesh=mesh, shard_metrics=shard_metrics)
+    report = engine.shard_report()
+    my_bytes = (report["param_bytes_per_chip"]
+                + report["kv_bytes_per_chip"])
+    total = report["param_bytes_total"] + report["kv_bytes_total"]
+    base = baseline_bytes if baseline_bytes is not None else total
+    bytes_frac = my_bytes / base
+    step_cost = args.step_dt * bytes_frac + (
+        args.shard_comm_dt if model_axis > 1 else 0.0)
+    metrics = ServingMetrics()
+    gateway = ServingGateway(
+        engine, AdmissionConfig(max_queue_depth=args.queue_bound),
+        metrics=metrics, clock=clock, tracer=tracer)
+
+    rng = np.random.default_rng(args.seed)
+    arrivals = build_workload(
+        rng, args.n_requests, rate=args.rate,
+        prompt_lens=(args.prompt_min, args.prompt_max),
+        new_tokens=(args.new_min, args.new_max),
+        vocab_size=cfg.vocab_size,
+        deadline_s=args.deadline_s or None,
+        deadline_fraction=args.deadline_fraction)
+    by_step: dict = {}
+    for a in arrivals:
+        by_step.setdefault(a.step, []).append(a)
+    if wall:
+        # hardware run: compile this mesh's prefill/step programs for
+        # every bucket the trace can hit OFF the measured trace (same
+        # guard as the --spec and monolithic --bench paths)
+        from tpu_on_k8s.models.decode import _bucket_len
+        buckets = sorted({_bucket_len(int(a.prompt.size), engine.max_len)
+                          for a in arrivals})
+        for bucket in buckets:
+            lp = min(bucket, engine.max_len - 2)
+            for _ in range(7):
+                gateway.submit(rng.integers(
+                    0, cfg.vocab_size, size=lp).astype(np.int32), 8)
+            gateway.run()
+        metrics.histograms.clear()
+    outcomes: dict = {}
+    event_log: List[str] = []
+    rejected = 0
+    step = 0
+    live = True
+    while by_step or live:
+        due = by_step.pop(step, [])
+        for a in due:
+            r = gateway.submit(a.prompt, a.max_new_tokens, tenant=a.tenant,
+                               priority=a.priority, deadline_s=a.deadline_s)
+            if isinstance(r, Rejected):
+                rejected += 1
+        done = gateway.step()
+        for rid in done:
+            res = gateway.result(rid)
+            if res is not None:
+                outcomes[rid] = res
+        if not wall:
+            vclock.advance(step_cost)
+        event_log.append(
+            f"step={step} arrivals={len(due)} "
+            f"finished={','.join(map(str, sorted(done)))} "
+            f"emitted={engine.stats['emitted']}")
+        live = gateway.queue_depth > 0 or gateway._live()
+        step += 1
+
+    states = [r.state.value for r in outcomes.values()]
+    tpot = list(metrics.histograms["time_per_output_token_seconds"])
+    ttft = list(metrics.histograms["time_to_first_token_seconds"])
+    summary = {
+        "metric": "shard_trace",
+        "mesh_model": model_axis,
+        "mesh_axes": report["mesh_axes"],
+        "n_chips": report["n_chips"],
+        "requests": len(arrivals),
+        "served": states.count("done"),
+        "rejected": rejected,
+        "deadline_exceeded": states.count("deadline_exceeded"),
+        "cancelled": states.count("cancelled"),
+        "retry_exhausted": states.count("retry_exhausted"),
+        "tokens": sum(len(r.tokens) for r in outcomes.values()),
+        "driver_steps": step,
+        "clock": "wall" if wall else "cost-model",
+        "virtual_s": None if wall else round(vclock.t, 6),
+        "param_bytes_per_chip": report["param_bytes_per_chip"],
+        "kv_bytes_per_chip": report["kv_bytes_per_chip"],
+        "bytes_frac": round(bytes_frac, 6),
+        "step_cost": None if wall else round(step_cost, 6),
+        "tpot_ms_p50": _pctl(tpot, 0.50),
+        "tpot_ms_p95": _pctl(tpot, 0.95),
+        "ttft_ms_p50": _pctl(ttft, 0.50),
+        "ttft_ms_p95": _pctl(ttft, 0.95),
+        "outputs": {rid: tuple(int(t) for t in r.tokens)
+                    for rid, r in sorted(outcomes.items())},
+        "event_log": event_log,
+    }
+    _dump_trace(tracer, args, summary)
+    return summary
+
+
+def _shard_main(args, cfg, params, max_len) -> dict:
+    """``--shard``: the same seeded cost-model trace across mesh sizes
+    (``--shard-meshes``, default 1,2,4 — CPU devices via the forced
+    host platform device count; on hardware, real chips), reporting
+    TPOT p50/p95 and per-chip param+KV bytes per arm, with greedy
+    token identity across every arm. With ``--soak`` the largest arm
+    runs TWICE from scratch and the event logs must byte-compare, the
+    accounting must balance, every arm must be token-identical to the
+    unsharded arm, and per-chip bytes must shrink ~linearly with the
+    `model` axis — ``SHARD_SOAK_FAILED seed=N`` on any violation so a
+    red run replays verbatim."""
+    import jax
+
+    meshes = sorted({int(m) for m in str(args.shard_meshes).split(",")})
+    if meshes[0] != 1:
+        meshes = [1] + meshes
+    n_dev = len(jax.devices())
+    skipped = [m for m in meshes if m > n_dev]
+    if skipped:
+        # never silently shrink coverage: the summary says what was cut
+        print(f"[serve_load] skipping mesh sizes {skipped}: only "
+              f"{n_dev} devices visible", file=sys.stderr)
+    meshes = [m for m in meshes if m <= n_dev]
+    arms = {}
+    baseline_bytes = None
+    for m in meshes:
+        arm = run_shard_trace(args, cfg, params, max_len, model_axis=m,
+                              baseline_bytes=baseline_bytes,
+                              trace=bool(args.trace_out) and m == meshes[-1])
+        if m == 1:
+            baseline_bytes = (arm["param_bytes_per_chip"]
+                              + arm["kv_bytes_per_chip"])
+        arms[m] = arm
+    outputs = {m: arm.pop("outputs") for m, arm in arms.items()}
+    event_logs = {m: arm.pop("event_log") for m, arm in arms.items()}
+    top = meshes[-1]
+    summary = {
+        "metric": "shard_trace",
+        "meshes": meshes,
+        "skipped_meshes": skipped,
+        "token_identical": all(outputs[m] == outputs[1] for m in meshes),
+        "tpot_ms_p95_mesh1": arms[1]["tpot_ms_p95"],
+        f"tpot_ms_p95_mesh{top}": arms[top]["tpot_ms_p95"],
+        "arms": {str(m): arms[m] for m in meshes},
+    }
+    if args.soak:
+        rerun = run_shard_trace(args, cfg, params, max_len, model_axis=top,
+                                baseline_bytes=baseline_bytes)
+        a = arms[top]
+        accounted = (a["served"] + a["rejected"] + a["deadline_exceeded"]
+                     + a["cancelled"] + a["retry_exhausted"])
+        replayed = event_logs[top] == rerun["event_log"]
+        # per-chip param+KV memory shrinks ~linearly with the model
+        # axis: replicated leaves (norms, non-dividing dims) keep it
+        # from exact 1/m, so allow 35% slack over the ideal
+        linear_ok = all(
+            (arms[m]["param_bytes_per_chip"] + arms[m]["kv_bytes_per_chip"])
+            <= baseline_bytes / m * 1.35 for m in meshes)
+        ok = (accounted == args.n_requests and replayed
+              and summary["token_identical"] and linear_ok)
+        summary["soak_ok"] = ok
+        summary["event_log_replayed"] = replayed
+        summary["per_chip_bytes_linear"] = linear_ok
+        if not ok:
+            print(json.dumps(summary))
+            print(f"SHARD_SOAK_FAILED seed={args.seed} "
+                  f"accounted={accounted}/{args.n_requests} "
+                  f"replayed={replayed} "
+                  f"token_identical={summary['token_identical']} "
+                  f"linear={linear_ok}")
+            raise SystemExit(1)
+        print(f"SHARD_SOAK_OK seed={args.seed}", file=sys.stderr)
+    print(json.dumps(summary))
+    return summary
+
+
+def main(argv=None) -> dict:
+    # args parse BEFORE the jax import: the --shard arm compares CPU
+    # mesh sizes and must force the host-platform device count before
+    # the backend initializes (a no-op for real TPU backends)
     p = argparse.ArgumentParser(description="gateway load generator")
     p.add_argument("--bench", action="store_true",
                    help="350M flagship (bench.py config) instead of tiny — "
@@ -1112,6 +1327,20 @@ def main(argv=None) -> dict:
                         "(--disagg): a bursty shared prefix spills past "
                         "its affinity replica and recomputes there — the "
                         "monolithic cost the fleet store eliminates")
+    # --- mesh-sharded serving mode (models/serving.py mesh path) ---
+    p.add_argument("--shard", action="store_true",
+                   help="drive the same seeded cost-model trace across "
+                        "mesh sizes (--shard-meshes) on forced CPU "
+                        "devices (or real chips): TPOT p50/p95 + "
+                        "per-chip param+KV bytes per arm, greedy token "
+                        "identity across arms")
+    p.add_argument("--shard-meshes", default="1,2,4",
+                   help="comma-separated `model`-axis sizes to compare "
+                        "(--shard); 1 is always included as the control")
+    p.add_argument("--shard-comm-dt", type=float, default=0.004,
+                   help="cost-model price of one step's model-axis "
+                        "collectives in virtual seconds (--shard); "
+                        "charged only on sharded arms")
     # --- speculative decoding mode (models/serving.py batched drafts) ---
     p.add_argument("--spec", action="store_true",
                    help="drive the trace through a speculative-decoding "
@@ -1173,6 +1402,21 @@ def main(argv=None) -> dict:
                         "fires on")
     args = p.parse_args(argv)
 
+    if args.shard and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        want = max(int(m) for m in str(args.shard_meshes).split(","))
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + f" --xla_force_host_platform_"
+                                     f"device_count={want}").strip()
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_on_k8s.metrics.metrics import ServingMetrics
+    from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+    from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+    from tpu_on_k8s.serve import AdmissionConfig, ServingGateway
+
     if args.bench:
         from bench import bench_config
         cfg = bench_config()
@@ -1180,6 +1424,10 @@ def main(argv=None) -> dict:
     else:
         cfg = dataclasses.replace(TransformerConfig.tiny(),
                                   dtype=jnp.float32, max_seq_len=64)
+        if args.shard:
+            # all four kv heads: the KV pool then shards on `model` up
+            # to a 4-way mesh (tiny's GQA 2 would cap KV sharding at 2)
+            cfg = dataclasses.replace(cfg, n_kv_heads=4)
         max_len = None
     model = Transformer(cfg)
     probe = jax.random.randint(jax.random.key(1), (1, 8), 0,
@@ -1188,6 +1436,8 @@ def main(argv=None) -> dict:
     if args.bench:
         params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
 
+    if args.shard:
+        return _shard_main(args, cfg, params, max_len)
     if args.spec:
         return _spec_main(args, cfg, params, max_len)
     if args.disagg:

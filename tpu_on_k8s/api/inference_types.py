@@ -105,6 +105,57 @@ class DecodePolicy:
 
 
 @dataclass
+class ShardingPolicy:
+    """How each serving replica shards its engine over its slice's
+    chips (`tpu_on_k8s/models/serving.py` mesh path over
+    `parallel/mesh.serving_mesh`): ``data`` × ``model`` × ``expert``
+    must equal the replica's chip count. ``model`` carries
+    tensor-parallel decode (attention heads / MLP dims split, per-layer
+    collectives on ICI — the axis that lets one replica serve a model
+    bigger than one chip's HBM), ``expert`` shards MoE expert tables,
+    ``data`` splits the slot pool. ``rules`` names the partition-rule
+    preset (``"serving"`` — `transformer.serving_partition_rules`, the
+    int8-aware Megatron layout; ``"flagship"`` — the raw training
+    rules).
+
+    Like ``DecodePolicy``, the sharding is part of what a replica RUNS:
+    the reconciler folds it into the replica identity hash, so changing
+    the mesh shape ROLLS the fleet (surge → canary → drain) — params
+    cannot be relaid out under a live engine's compiled programs. An
+    absent block (or the all-1 default) is the single-program engine,
+    bit-for-bit."""
+
+    data: int = 1
+    model: int = 1
+    expert: int = 1
+    rules: str = "serving"
+
+    def normalized(self) -> "ShardingPolicy":
+        """Defaulted-and-clamped copy (passive record, like
+        ``RolloutPolicy``): axis sizes floor at 1; unknown rule presets
+        fall back to "serving"."""
+        rules = str(self.rules or "serving")
+        if rules not in ("serving", "flagship"):
+            rules = "serving"
+        return ShardingPolicy(
+            data=max(int(self.data), 1), model=max(int(self.model), 1),
+            expert=max(int(self.expert), 1), rules=rules)
+
+    @property
+    def chips(self) -> int:
+        """Chips one replica's mesh spans."""
+        n = self.normalized()
+        return n.data * n.model * n.expert
+
+    def is_trivial(self) -> bool:
+        """All-1 axes = the single-program engine: applying
+        ``sharding: {}`` to a running fleet must not trigger a no-op
+        rollout (same principle as ``decode: {}``)."""
+        n = self.normalized()
+        return n.data == n.model == n.expert == 1
+
+
+@dataclass
 class AutoscalePolicy:
     """SLO-driven replica autoscaling for the serving fleet (consumed by
     `controller/fleetautoscaler.py`; decision core in
@@ -236,6 +287,12 @@ class InferenceServiceSpec:
     #: serving weights). Part of the replica-group identity: changing it
     #: rolls the fleet (surge/drain/canary) like a new image would.
     decode: Optional[DecodePolicy] = None
+    #: present = mesh-sharded replicas: each engine runs
+    #: tensor/expert-parallel over a {data, model, expert} mesh of its
+    #: slice's chips. Part of the replica-group identity like
+    #: ``decode``: a resharding ROLLS the fleet through the same
+    #: surge/canary/drain machinery — never a live relayout.
+    sharding: Optional[ShardingPolicy] = None
 
 
 class ServicePhase(str, enum.Enum):

@@ -28,6 +28,13 @@ controller closes the loop: an ``InferenceService`` names a ``Model``
   (``decode_variant``), so flipping int8 or the draft rides the SAME
   rollout machinery — the int8 variant is canaried under live traffic,
   never hot-swapped into running pods.
+* a ``spec.sharding`` change (`ShardingPolicy`: the replica's
+  ``{data, model, expert}`` mesh shape + rule preset) folds into the
+  same identity hash and threads ``--mesh-*``/``--shard-rules`` args to
+  the replica pods — a RESHARDING rolls the fleet exactly like a new
+  image (params cannot be relaid out under a live engine's compiled
+  programs), and the canary split A/Bs the new mesh under live traffic
+  before the fleet commits.
 
 The in-process twin of this state machine — same phases, same
 surge/drain ordering, driven per engine step instead of per reconcile —
@@ -81,23 +88,29 @@ def image_hash(image: str) -> str:
     return hashlib.sha1(image.encode()).hexdigest()[:8]
 
 
-def decode_variant(image: str, decode) -> str:
-    """The rollout identity of (image, DecodePolicy): the decode policy
-    is part of what a replica RUNS (int8 weights, a speculative draft),
-    so flipping it must roll the fleet — surge, drain, canary split —
-    exactly like a new image, never mutate pods in place. Only knobs
-    that actually change the replica's serve args enter the identity:
-    ``None``, an all-defaults block, and a ``spec_k`` with no draft all
-    map to the bare image ref — applying ``decode: {}`` to a running
-    fleet must not trigger a full no-op rollout."""
-    if decode is None:
-        return image
-    d = decode.normalized()
+def decode_variant(image: str, decode, sharding=None) -> str:
+    """The rollout identity of (image, DecodePolicy, ShardingPolicy):
+    the decode policy and the mesh shape are part of what a replica
+    RUNS (int8 weights, a speculative draft, the parallelism its
+    compiled programs were laid out for), so flipping either must roll
+    the fleet — surge, drain, canary split — exactly like a new image,
+    never mutate pods in place. Only knobs that actually change the
+    replica's serve args enter the identity: ``None``, an all-defaults
+    block, and a ``spec_k`` with no draft all map to the bare image ref
+    — applying ``decode: {}`` or ``sharding: {}`` to a running fleet
+    must not trigger a full no-op rollout."""
     tags = []
-    if d.draft_model:
-        tags.append(f"draft={d.draft_model},k={d.spec_k}")
-    if d.int8_weights:
-        tags.append("int8=1")
+    if decode is not None:
+        d = decode.normalized()
+        if d.draft_model:
+            tags.append(f"draft={d.draft_model},k={d.spec_k}")
+        if d.int8_weights:
+            tags.append("int8=1")
+    if sharding is not None:
+        s = sharding.normalized()
+        if not s.is_trivial():
+            tags.append(f"mesh=d{s.data}m{s.model}e{s.expert}"
+                        f",rules={s.rules}")
     if not tags:
         return image
     return image + "#" + ";".join(tags)
@@ -183,7 +196,8 @@ class InferenceServiceReconciler:
                                          svc.spec.tpu_policy.topology)
         groups = self._observed_groups(svc, hosts)
         sp.set(desired=desired, observed=len(groups))
-        target_hash = image_hash(decode_variant(image, svc.spec.decode))
+        target_hash = image_hash(decode_variant(image, svc.spec.decode,
+                                                svc.spec.sharding))
         new = [g for g in groups if g.hash == target_hash]
         old = [g for g in groups if g.hash != target_hash]
 
@@ -315,6 +329,15 @@ class InferenceServiceReconciler:
             if d.draft_model:
                 serve_args += [f"--spec-draft={d.draft_model}",
                                f"--spec-k={d.spec_k}"]
+        if svc.spec.sharding is not None:
+            s = svc.spec.sharding.normalized()
+            if not s.is_trivial():
+                # the replica runtime builds its serving mesh from these
+                # (parallel/mesh.serving_mesh over the gang's chips)
+                serve_args += [f"--mesh-data={s.data}",
+                               f"--mesh-model={s.model}",
+                               f"--mesh-expert={s.expert}",
+                               f"--shard-rules={s.rules}"]
         for host in range(hosts):
             name = f"{gang}-h{host}" if hosts > 1 else gang
             container = Container(

@@ -286,6 +286,70 @@ class SpecMetrics(_MetricsBase):
                       "(accepted / proposed over the engine's lifetime)")
 
 
+class ShardMetrics(_MetricsBase):
+    """Mesh-sharded serving observability (`tpu_on_k8s/models/serving.py`
+    engine ``shard_metrics=`` + `serve/fleet.py` reshard rollouts): the
+    per-replica mesh shape as axis-labelled gauges (one scrape answers
+    "what parallelism is this replica actually running"), per-chip
+    param/KV byte gauges (the model-size headroom the ``model`` axis
+    buys — the number `serve_load --shard` charts shrinking), the
+    export-gather byte counter (device→host gather cost of every
+    KV-handoff/prefix export — what cross-mesh portability costs), and
+    the reshard-rollout counter (a ``ShardingPolicy`` flip rolling the
+    fleet through surge/drain/canary). Same prometheus + plain-dict
+    mirror pattern as the other classes; mirror dicts key by
+    ``(name, label)`` like ``AutoscaleMetrics``."""
+
+    _AXIS_GAUGES = ("mesh_axis_size",)
+    _PLAIN_GAUGES = ("param_bytes_per_chip", "kv_bytes_per_chip")
+    _PLAIN_COUNTERS = ("reshard_rollouts", "export_gather_bytes")
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        self.counters: Dict[Tuple[str, str], int] = defaultdict(int)
+        self.gauges: Dict[Tuple[str, str], float] = {}
+        if _prom is not None:
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_shard"
+        for name in self._AXIS_GAUGES:
+            self._declare(name, f"{ns}_{name}", "gauge", f"Shard {name}",
+                          labels=("axis",))
+        for name in self._PLAIN_GAUGES:
+            self._declare(name, f"{ns}_{name}", "gauge", f"Shard {name}")
+        for name in self._PLAIN_COUNTERS:
+            self._declare(name, f"{ns}_{name}", "counter", f"Shard {name}")
+
+    def inc(self, name: str, n: int = 1, label: str = "") -> None:
+        with self._lock:
+            self.counters[(name, label)] += n
+        c = self._prom_counters.get(name)
+        if c is not None:
+            c.inc(n)
+
+    def set_gauge(self, name: str, value: float, label: str = "") -> None:
+        with self._lock:
+            self.gauges[(name, label)] = value
+        g = self._prom_gauges.get(name)
+        if g is not None:
+            (g.labels(label) if name in self._AXIS_GAUGES else g).set(value)
+
+    #: the serving mesh's standard axes — every ``set_mesh_axes`` write
+    #: covers at least these, so a reshard that DROPS an axis overwrites
+    #: its old gauge (absent = 1) instead of leaving it stale
+    MESH_AXES = ("data", "model", "expert")
+
+    def set_mesh_axes(self, mesh_axes) -> None:
+        """Publish a replica's mesh shape: every standard axis written
+        (absent = 1) plus any extra non-trivial axes. The ONE writer
+        both the engine and the fleet call — last caller wins by
+        design (a fleet converges to one shape; the definitive
+        per-replica view is ``engine.shard_report()``)."""
+        axes = {a: 1 for a in self.MESH_AXES}
+        axes.update(mesh_axes or {})
+        for axis, size in sorted(axes.items()):
+            self.set_gauge("mesh_axis_size", size, label=axis)
+
+
 class TrainMetrics(_MetricsBase):
     """Training-loop observability, fed by `tpu_on_k8s/train/loop.py`'s
     ``TrainLoop`` at every host-sync window (same prometheus + plain-dict

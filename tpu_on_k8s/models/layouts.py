@@ -1,11 +1,57 @@
-"""Param-tree layout conversions (pure numpy; no checkpoint/orbax
-dependency — compute-plane callers like the HF exporter use this without
-dragging the training/orchestration stack in)."""
+"""Param-tree and cache layout descriptors/conversions (pure numpy; no
+checkpoint/orbax dependency — compute-plane callers like the HF exporter
+and the stdlib-only serve plane use this without dragging the
+training/orchestration stack in)."""
 from __future__ import annotations
 
-from typing import Any, Optional
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
 
 import numpy as np
+
+
+@dataclass(frozen=True)
+class CacheLayout:
+    """The sharding layout a KV payload (``models/serving.KVHandoff``, a
+    prefix export) CARRIES across engines — the contract that makes
+    disagg prefill→decode handoff and fleet prefix reuse work across
+    UNLIKE meshes:
+
+    * **gather-on-export**: every export is host-gathered to the full
+      logical array (numpy leaves hold all positions/heads, whatever
+      mesh computed them), so any engine can adopt it;
+    * **reshard-on-import**: the adopting engine lays the full payload
+      back out under its OWN mesh (`submit_kv` / `import_prefix`) —
+      the source mesh never constrains the destination.
+
+    ``mesh_axes`` records the SOURCE engine's non-trivial mesh axes
+    ({} = single-program engine) and ``gathered_bytes`` what the export
+    gather moved device→host — the cross-mesh observability
+    (``ShardMetrics`` export-gather accounting, the kvstore's
+    cross-mesh promote counter) that says how much a reshard hop
+    actually cost. Frozen/hashable: safe as a payload field and in
+    event metadata."""
+
+    mesh_axes: Dict[str, int] = field(default_factory=dict)
+    gathered_bytes: int = 0
+
+    def __post_init__(self) -> None:
+        # dict fields defeat frozen hashing; store a plain dict but
+        # compare/signature on the sorted items
+        object.__setattr__(self, "mesh_axes", dict(self.mesh_axes))
+
+    @property
+    def sharded(self) -> bool:
+        return bool(self.mesh_axes)
+
+    def signature(self) -> str:
+        """Stable string form ("" for single-device) — what unlike-mesh
+        detection compares."""
+        return ",".join(f"{a}={s}"
+                        for a, s in sorted(self.mesh_axes.items()))
+
+    def __hash__(self) -> int:  # dict field — hash the stable form
+        return hash((self.signature(), self.gathered_bytes))
 
 
 def migrate_param_layout(params: Any, *, fused_qkv: Optional[bool] = None,

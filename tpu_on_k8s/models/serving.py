@@ -52,6 +52,25 @@ next round's appends overwrite it. Slots the draft cannot seed (adopted
 ``KVHandoff``s, imported prefixes) decode plain inside the same
 programs; a draft crash (`chaos.SITE_SPEC_DRAFT`) degrades the whole
 engine to plain decode — counted, zero silent loss.
+
+**Mesh-sharded serving** (``mesh=``): the engine runs tensor/expert-
+parallel over a named ``{data, model, expert}`` mesh
+(`parallel/mesh.serving_mesh`) — params shard by `PartitionRule`
+(attention heads and MLP/expert dims on ``model``/``expert``,
+layernorms replicated; int8 q/scale trees via
+`transformer.serving_partition_rules`), the ``[n_slots, max_len, ...]``
+KV pool splits kv-heads on ``model`` and slots on ``data``, and every
+program — step, spec_verify, prefill (whole, suffix, chunked), admit,
+the KV splice — is jitted with explicit shardings (`_ShardPlan`) so the
+decode math runs sharded while the host bookkeeping stays position-only.
+Speculative decoding composes as the classic big-model shape (replicated
+small draft proposing, sharded target verifying); int8 composes via the
+scale-aware rules. KV handoffs and prefix exports carry a
+`models/layouts.CacheLayout`: gather-on-export, reshard-on-import, so
+disagg prefill→decode and fleet prefix reuse work across UNLIKE meshes.
+A replica's model-size ceiling is therefore per-chip bytes × chips per
+replica, not per-chip bytes alone — the v5e-16 gang serves one big
+model once instead of the same small model 16×.
 """
 from __future__ import annotations
 
@@ -75,8 +94,14 @@ from tpu_on_k8s.models.decode import (
     init_cache,
     quantize_weights_for_serving,
 )
+from tpu_on_k8s.models.layouts import CacheLayout
 from tpu_on_k8s.models.sampling import SamplingParams, sample as _pick
 from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+from tpu_on_k8s.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    mesh_axes as _axes_of,
+)
 
 
 class EngineOverloadedError(RuntimeError):
@@ -212,6 +237,90 @@ def _cache_checksum(cache: Any, *meta) -> str:
     return h.hexdigest()
 
 
+class _ShardPlan:
+    """The engine's explicit sharding layout over a named serving mesh
+    (`tpu_on_k8s/parallel/mesh.serving_mesh`): params by partition rule
+    (attention heads and MLP/expert dims on ``model``/``expert``,
+    layernorms replicated — validated for divisibility at construction,
+    so a bad rule is a typed ``ShardingValidationError`` naming the
+    param path, dim, and axis instead of an XLA error deep in compile),
+    the ``[n_slots, max_len, ...]`` KV pool with its kv-head dim on
+    ``model`` and the slot dim on ``data``, per-request prefill caches
+    kv-head-sharded only (batch 1 cannot split on ``data``), and every
+    per-slot token/position vector replicated — the bookkeeping stays
+    position-only while the decode math runs tensor-parallel. Every
+    engine program is jitted against these shardings explicitly; XLA's
+    SPMD partitioner inserts the collectives."""
+
+    def __init__(self, mesh, params, rules, n_slots: int) -> None:
+        from tpu_on_k8s.parallel.partition import named_sharding
+        self.mesh = mesh
+        self.axes = _axes_of(mesh)
+        self.n_chips = int(mesh.devices.size)
+        self.n_model = int(mesh.shape.get(AXIS_MODEL, 1))
+        self.n_data = int(mesh.shape.get(AXIS_DATA, 1))
+        self.n_slots = n_slots
+        self.replicated = NamedSharding(mesh, PartitionSpec())
+        # validates every (rule, param dim, axis size) triple up front
+        self.params = named_sharding(params, mesh, rules)
+
+    def kv_sharding(self, shape, *, slots_on_data: bool) -> NamedSharding:
+        """Sharding for one cache leaf: k/v ``[L, S, max_len, Hkv, Dh]``
+        and cache-int8 scales ``[L, S, max_len, Hkv]`` split their
+        kv-head dim over ``model`` (each chip holds only its heads'
+        cache bytes) and — for the slot pool — the slot dim over
+        ``data`` when it divides; cursor/index leaves and non-dividing
+        dims replicate."""
+        spec = [None] * len(shape)
+        if len(shape) >= 4 and shape[3] % self.n_model == 0:
+            spec[3] = AXIS_MODEL
+        if (slots_on_data and self.n_data > 1 and len(shape) >= 2
+                and shape[1] % self.n_data == 0):
+            spec[1] = AXIS_DATA
+        while spec and spec[-1] is None:   # canonical short form
+            spec.pop()
+        if not spec:
+            return self.replicated
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def cache_shardings(self, tree, *, slots_on_data: bool = False):
+        """Sharding pytree for a cache (arrays or ShapeDtypeStructs)."""
+        return jax.tree.map(
+            lambda leaf: self.kv_sharding(tuple(leaf.shape),
+                                          slots_on_data=slots_on_data),
+            tree)
+
+    def put_params(self, params):
+        from tpu_on_k8s.parallel.mesh import put_global
+        return jax.tree.map(put_global, params, self.params)
+
+    def put_cache(self, tree, *, slots_on_data: bool = False):
+        """Lay a host/device cache pytree out under this plan — the
+        reshard-on-import half of the cross-mesh KV contract (the
+        export half gathers to host numpy, so any source mesh lands
+        here identically)."""
+        return jax.tree.map(
+            lambda leaf: jax.device_put(
+                leaf, self.kv_sharding(tuple(leaf.shape),
+                                       slots_on_data=slots_on_data)),
+            tree)
+
+    def bytes_per_chip(self, tree) -> int:
+        """Per-chip bytes of a sharded pytree (each leaf's shard shape
+        times its itemsize) — the number the serve_load ``--shard`` arm
+        charts shrinking with the ``model`` axis."""
+        total = 0
+        for leaf in jax.tree.leaves(tree):
+            shape = tuple(leaf.shape)
+            shard = (leaf.sharding.shard_shape(shape)
+                     if isinstance(leaf, jax.Array) else shape)
+            n = 1
+            for d in shard:
+                n *= int(d)
+            total += n * leaf.dtype.itemsize
+        return total
+
+
 @dataclasses.dataclass
 class KVHandoff:
     """A completed prefill's KV, host-resident and engine-portable — the
@@ -230,7 +339,17 @@ class KVHandoff:
     holds the tokens already produced (≥ 1: the prefill's first token),
     so an adopted request resumes mid-stream with its budget intact.
     ``verify()`` recomputes the transfer checksum — a corrupted payload
-    must be rejected, never decoded."""
+    must be rejected, never decoded.
+
+    ``layout`` (`models/layouts.CacheLayout`) records the SOURCE
+    engine's mesh and the device→host gather bytes the export paid:
+    every export is gathered to the full logical array and every import
+    reshards under the adopting engine's own mesh, so a handoff crosses
+    UNLIKE meshes (sharded prefill → differently-sharded decode, or
+    either way to a single-program engine) without either side knowing
+    the other's shape. The layout is metadata, not payload — it stays
+    outside the checksum, which covers exactly the transferred KV
+    bytes."""
 
     cache: Any
     pos: int
@@ -239,6 +358,7 @@ class KVHandoff:
     base: int = 0
     prefix_hash: Optional[str] = None
     checksum: str = ""
+    layout: Optional[CacheLayout] = None
 
     def seal(self) -> "KVHandoff":
         self.checksum = _cache_checksum(self.cache, self.pos, self.base,
@@ -279,10 +399,18 @@ class _DraftRunner:
 
     Greedy only (argmax): token identity with plain decode is the
     correctness contract, and sampled speculation needs rejection
-    sampling this engine does not implement."""
+    sampling this engine does not implement.
+
+    **Mesh composition** (the classic big-model serving shape): on a
+    mesh-sharded engine the draft REPLICATES — its params and slot-pool
+    cache are device_put replicated and its programs jit with explicit
+    replicated in/out shardings, so every chip runs the whole small
+    draft locally (zero collectives) while the sharded target's one
+    batched verify runs tensor-parallel. A draft small enough to be
+    worth speculating with is small enough to replicate."""
 
     def __init__(self, cfg: TransformerConfig, params, n_slots: int,
-                 max_len: int, k: int) -> None:
+                 max_len: int, k: int, mesh=None) -> None:
         if cfg.pos_emb == "rope":
             cfg = dataclasses.replace(cfg, max_seq_len=max_len)
         elif cfg.max_seq_len < max_len:
@@ -293,6 +421,10 @@ class _DraftRunner:
         base = dataclasses.replace(cfg, decode=True, remat=False,
                                    attn_impl="xla")
         self.cfg = base
+        self._rep = (NamedSharding(mesh, PartitionSpec())
+                     if mesh is not None else None)
+        if self._rep is not None:
+            params = jax.device_put(params, self._rep)
         self.params = params
         self.k = k
         self.max_len = max_len
@@ -300,12 +432,17 @@ class _DraftRunner:
             dataclasses.replace(base, decode_multislot=True))
         self._prefill_model = Transformer(base)
         self.cache = init_cache(self._step_model, n_slots)
+        if self._rep is not None:
+            self.cache = jax.device_put(self.cache, self._rep)
         self.prefixes: Dict[int, Tuple[Any, int]] = {}   # engine pid → KV
         self._prefill_progs: Dict[int, Any] = {}
         self._suffix_progs: Dict[int, Any] = {}
         model = self._step_model
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
+        @functools.partial(
+            jax.jit, donate_argnums=(1,),
+            out_shardings=((self._rep, self._rep)
+                           if self._rep is not None else None))
         def propose(params, cache, toks, pos):
             """``k+1`` scanned greedy draft steps; returns the cache and
             the first k proposals [k, n_slots] (the k+1-th feed exists
@@ -324,7 +461,9 @@ class _DraftRunner:
                 body, (cache, toks, pos), None, length=self.k + 1)
             return cache, toks_out[:self.k]
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(
+            jax.jit, donate_argnums=(0,),
+            out_shardings=self._rep if self._rep is not None else None)
         def admit(cache, pre_cache, slot, lp, row):
             """Identical write to the engine's admit program, over the
             draft's cache shapes."""
@@ -352,7 +491,9 @@ class _DraftRunner:
             model = self._prefill_model
             shapes = cache_shapes(model, 1)
 
-            @jax.jit
+            @functools.partial(
+                jax.jit,
+                out_shardings=self._rep if self._rep is not None else None)
             def prefill(params, prompt):
                 cache = jax.tree.map(
                     lambda s: jnp.zeros(s.shape, s.dtype), shapes)
@@ -371,7 +512,9 @@ class _DraftRunner:
             from tpu_on_k8s.models.decode import _set_cursor
             model = self._prefill_model
 
-            @jax.jit
+            @functools.partial(
+                jax.jit,
+                out_shardings=self._rep if self._rep is not None else None)
             def prefill(params, pre_cache, suffix, plen):
                 cache = _set_cursor(pre_cache, plen)
                 positions = plen + jnp.arange(bucket,
@@ -446,7 +589,7 @@ class ContinuousBatchingEngine:
                  clock=time.monotonic,
                  draft_cfg: Optional[TransformerConfig] = None,
                  draft_params=None, spec_k: int = 4, spec_metrics=None,
-                 on_spec_round=None):
+                 on_spec_round=None, shard_metrics=None):
         if step_horizon < 1:
             raise ValueError(f"step_horizon must be >= 1, got {step_horizon}")
         if queue_cap is not None and queue_cap < 1:
@@ -454,12 +597,6 @@ class ContinuousBatchingEngine:
         if prefill_chunk < 0:
             raise ValueError(f"prefill_chunk must be >= 0, got "
                              f"{prefill_chunk}")
-        if (int8_weights or cfg.serve_int8_weights) and mesh is not None:
-            # pre-quantized configs hit this too, not just the kwarg path —
-            # the partition rules target bf16 kernel shapes, and their
-            # regexes would mis-spec the q/scale split leaves
-            raise NotImplementedError(
-                "int8 serving weights + mesh are not supported together")
         if int8_weights:
             cfg = dataclasses.replace(cfg, serve_int8_weights=True)
             params = quantize_weights_for_serving(params)
@@ -505,45 +642,59 @@ class ContinuousBatchingEngine:
 
         self._cache = init_cache(self._step_model, n_slots)
         cache_shardings = token_shardings = None
+        plan: Optional[_ShardPlan] = None
         if mesh is not None:
-            # Tensor-parallel serving: params shard by the training rules
-            # (Megatron layout — per-layer all-gather/reduce-scatter over
-            # the `model` axis ride ICI), the KV cache shards its kv-head
-            # dim on `model` so each chip holds only its heads' cache, and
-            # the per-slot token/position vectors replicate. Same compiled
-            # programs, just sharded — XLA inserts the collectives.
-            from tpu_on_k8s.parallel.mesh import AXIS_MODEL, put_global, \
-                replicated
-            from tpu_on_k8s.parallel.partition import named_sharding
+            # Tensor-parallel / expert-parallel serving: params shard by
+            # the serving partition rules (Megatron layout, int8 q/scale
+            # aware — per-layer all-gather/reduce-scatter over the
+            # `model` axis ride ICI, MoE expert tables split on
+            # `expert`), the KV pool shards kv-heads on `model` and
+            # slots on `data`, and the per-slot token/position vectors
+            # replicate. Same compiled programs, just sharded — XLA
+            # inserts the collectives; `_ShardPlan` holds every layout.
             if rules is None:
                 from tpu_on_k8s.models.transformer import (
-                    flagship_partition_rules,
+                    serving_partition_rules,
                 )
-                rules = flagship_partition_rules()
-            params = jax.tree.map(
-                put_global, params, named_sharding(params, mesh, rules))
-            n_model = mesh.shape.get(AXIS_MODEL, 1)
-
-            def cache_spec(leaf):
-                # k/v leaves [L, S, max_len, Hkv, Dh]; scales [L, S,
-                # max_len, Hkv] — shard Hkv on `model` when it divides
-                shard = leaf.ndim >= 4 and leaf.shape[3] % n_model == 0
-                spec = (PartitionSpec(None, None, None, AXIS_MODEL)
-                        if shard else PartitionSpec())
-                return NamedSharding(mesh, spec)
-
-            cache_shardings = jax.tree.map(cache_spec, self._cache)
+                rules = serving_partition_rules(
+                    int8=cfg.serve_int8_weights)
+            plan = _ShardPlan(mesh, params, rules, n_slots)
+            params = plan.put_params(params)
+            cache_shardings = plan.cache_shardings(self._cache,
+                                                   slots_on_data=True)
             self._cache = jax.tree.map(jax.device_put, self._cache,
                                        cache_shardings)
-            token_shardings = replicated(mesh)
+            token_shardings = plan.replicated
         self.mesh = mesh
+        self._plan = plan
+        #: {axis: size} of the mesh's non-trivial axes ({} = single
+        #: program) — the replica's sharding signature (identity checks,
+        #: ShardMetrics gauges, the layout block exports carry)
+        self.mesh_axes = plan.axes if plan is not None else {}
+        self.n_chips = plan.n_chips if plan is not None else 1
         self._params = params
+        #: optional ``metrics.ShardMetrics`` — mesh-shape gauges,
+        #: per-chip param/KV byte gauges, export-gather accounting
+        self.shard_metrics = shard_metrics
+        if shard_metrics is not None:
+            shard_metrics.set_mesh_axes(self.mesh_axes)
+            shard_metrics.set_gauge("param_bytes_per_chip",
+                                    self.param_bytes_per_chip)
+            shard_metrics.set_gauge("kv_bytes_per_chip",
+                                    self.kv_bytes_per_chip)
 
         sp = self.sampling
         self.step_horizon = horizon = step_horizon
+        # explicit in/out shardings for every program: decode math runs
+        # tensor-parallel (params/cache sharded) while the bookkeeping
+        # stays position-only (token/position vectors replicated)
+        _rep = token_shardings
+        step_in = ((plan.params, cache_shardings, _rep, _rep, _rep)
+                   if plan is not None else None)
 
         @functools.partial(
             jax.jit, donate_argnums=(1,),
+            in_shardings=step_in,
             out_shardings=((cache_shardings, token_shardings)
                            if mesh is not None else None))
         def step(params, cache, toks, pos, key):
@@ -640,10 +791,6 @@ class ContinuousBatchingEngine:
         if draft_cfg is not None or draft_params is not None:
             if draft_cfg is None or draft_params is None:
                 raise ValueError("draft_cfg and draft_params come together")
-            if mesh is not None:
-                raise NotImplementedError(
-                    "speculative decoding + mesh are not supported "
-                    "together (the draft pool is single-device)")
             if step_horizon != 1:
                 raise ValueError(
                     "speculative decoding replaces the step horizon "
@@ -658,10 +805,18 @@ class ContinuousBatchingEngine:
                 raise ValueError("draft and target must share a vocabulary")
             if spec_k < 1:
                 raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            # on a mesh the draft replicates (every chip runs the whole
+            # small model) while the sharded target verifies
+            # tensor-parallel — the classic big-model serving shape
             self._draft = _DraftRunner(draft_cfg, draft_params, n_slots,
-                                       max_len, spec_k)
+                                       max_len, spec_k, mesh=mesh)
 
-            @functools.partial(jax.jit, donate_argnums=(1,))
+            @functools.partial(
+                jax.jit, donate_argnums=(1,),
+                in_shardings=((plan.params, cache_shardings, _rep, _rep)
+                              if plan is not None else None),
+                out_shardings=((cache_shardings, token_shardings)
+                               if plan is not None else None))
             def spec_verify(params, cache, chunk, positions):
                 """ONE batched target forward verifying every slot's
                 ``k+1`` chunk ``[last_token, d_1..d_k]`` at its own
@@ -694,6 +849,10 @@ class ContinuousBatchingEngine:
                       # how many of those were shared-prefix registrations
                       "prefill_positions": 0, "prefix_prefills": 0,
                       "kv_adopted": 0, "kv_exported": 0,
+                      # sharded serving: device→host bytes the KV/prefix
+                      # export gathers moved (gather-on-export — the
+                      # cross-mesh handoff cost)
+                      "export_gather_bytes": 0,
                       # speculative decoding: rounds run, draft tokens
                       # proposed/accepted (their ratio is the acceptance
                       # rate), slot-rounds with >= 1 rejection, draft
@@ -770,8 +929,12 @@ class ContinuousBatchingEngine:
         # position-trimmed like export_kv: the overflow tier's host-RAM
         # budget charges for the prefix's bucket, not max_len
         pb = _bucket_len(lp, self.max_len)
-        return _host_leaves(jax.tree.map(
-            lambda leaf: leaf[:, :, :pb], _strip_index(cache))), lp
+        host = _host_leaves(jax.tree.map(
+            lambda leaf: leaf[:, :, :pb], _strip_index(cache)))
+        # gather-on-export: the host copy is the FULL logical array
+        # whatever mesh computed it; account the gathered bytes
+        self._export_layout(_cache_nbytes(host))
+        return host, lp
 
     def import_prefix(self, cache, lp: int) -> int:
         """Register an already-computed prefix KV (an ``export_prefix``
@@ -786,6 +949,11 @@ class ContinuousBatchingEngine:
             raise ValueError(f"prefix length {lp} does not fit under "
                              f"max_len {self.max_len}")
         device = _graft_cursorless(init_cache(self._prefill_model, 1), cache)
+        if self._plan is not None:
+            # reshard-on-import: the export was gathered to the full
+            # logical array, so ANY source mesh lands here — lay it out
+            # under THIS engine's plan
+            device = self._plan.put_cache(device)
         with self._lock:
             pid = self._next_prefix_id
             self._next_prefix_id += 1
@@ -937,8 +1105,9 @@ class ContinuousBatchingEngine:
         row = jax.tree.map(
             lambda leaf: np.asarray(leaf[:, i:i + 1, :pb]), self._cache)
         self.stats["kv_exported"] += 1
+        layout = self._export_layout(_cache_nbytes(row))
         return KVHandoff(cache=row, pos=pos, first_token=emitted[0],
-                         emitted=emitted).seal()
+                         emitted=emitted, layout=layout).seal()
 
     def start_prefill(self, prompt, prefix_id: Optional[int] = None
                       ) -> "PrefillJob":
@@ -957,8 +1126,15 @@ class ContinuousBatchingEngine:
             model = self._prefill_model
             shapes = cache_shapes(model, b)   # length set by max_len, not lp
             sp = self.sampling
+            # per-request prefill caches shard kv-heads on `model` (the
+            # admit splice into the pool is then shard-local); sampled
+            # first tokens replicate like every per-slot vector
+            out_sh = None
+            if self._plan is not None:
+                out_sh = (self._plan.cache_shardings(shapes),
+                          self._plan.replicated)
 
-            @jax.jit
+            @functools.partial(jax.jit, out_shardings=out_sh)
             def prefill(params, prompts, lps, key):
                 cache = jax.tree.map(
                     lambda s: jnp.zeros(s.shape, s.dtype), shapes)
@@ -983,8 +1159,12 @@ class ContinuousBatchingEngine:
             from tpu_on_k8s.models.decode import _set_cursor
             model = self._prefill_model
             sp = self.sampling
+            out_sh = None
+            if self._plan is not None:
+                out_sh = (self._plan.cache_shardings(
+                    cache_shapes(model, 1)), self._plan.replicated)
 
-            @jax.jit
+            @functools.partial(jax.jit, out_shardings=out_sh)
             def prefill(params, pre_cache, suffix, plen, slen, key):
                 cache = _set_cursor(pre_cache, plen)
                 positions = plen + jnp.arange(bucket,
@@ -1031,7 +1211,11 @@ class ContinuousBatchingEngine:
         prefix's (identical bytes to what the prefill replica attended —
         same params, same tokens, same compiled programs)."""
         h = req.handoff
-        device = jax.tree.map(jnp.asarray, h.cache)
+        # reshard-on-import: a handoff from an UNLIKE mesh (or a
+        # single-program prefill engine) carries the gathered full
+        # array; this engine lays it out under its own plan
+        device = (self._plan.put_cache(h.cache) if self._plan is not None
+                  else jax.tree.map(jnp.asarray, h.cache))
         pb = jax.tree.leaves(device)[0].shape[2]
         if h.base > 0:
             prefix_cache = self._prefixes[req.prefix_id][0]
@@ -1573,6 +1757,54 @@ class ContinuousBatchingEngine:
             return (free - len(self._admitting)
                     - (1 if self._reserved_slot is not None else 0))
 
+    # ---- sharded-serving observability --------------------------------------
+    def _export_layout(self, nbytes: int) -> CacheLayout:
+        """The layout block every KV/prefix export carries, plus the
+        gather-on-export accounting: the device→host copy materializes
+        the FULL logical array (all heads, all positions) whatever this
+        engine's mesh — that is what makes the payload adoptable on any
+        unlike mesh, and these are the bytes that cost."""
+        self.stats["export_gather_bytes"] += nbytes
+        if self.shard_metrics is not None:
+            self.shard_metrics.inc("export_gather_bytes", nbytes)
+        return CacheLayout(mesh_axes=dict(self.mesh_axes),
+                           gathered_bytes=nbytes)
+
+    @property
+    def param_bytes_per_chip(self) -> int:
+        """Serving-tree bytes each chip holds (= total bytes on a
+        single-program engine; shrinks with the `model`/`expert` axes on
+        a mesh) — the headroom number that says how big a model THIS
+        replica shape can hold."""
+        if self._plan is not None:
+            return self._plan.bytes_per_chip(self._params)
+        return sum(int(leaf.nbytes)
+                   for leaf in jax.tree.leaves(self._params))
+
+    @property
+    def kv_bytes_per_chip(self) -> int:
+        """Slot-pool KV bytes per chip (kv-heads split over `model`,
+        slots over `data`); registered prefixes are charged separately
+        by the prefix store."""
+        if self._plan is not None:
+            return self._plan.bytes_per_chip(self._cache)
+        return _cache_nbytes(self._cache)
+
+    def shard_report(self) -> Dict[str, Any]:
+        """One-line shard accounting for tools (`serve_load --shard`)
+        and tests: mesh axes, chip count, and per-chip vs total
+        param/KV bytes."""
+        total_params = sum(int(leaf.nbytes)
+                           for leaf in jax.tree.leaves(self._params))
+        return {
+            "mesh_axes": dict(self.mesh_axes),
+            "n_chips": self.n_chips,
+            "param_bytes_per_chip": self.param_bytes_per_chip,
+            "param_bytes_total": total_params,
+            "kv_bytes_per_chip": self.kv_bytes_per_chip,
+            "kv_bytes_total": _cache_nbytes(self._cache),
+        }
+
 
 def _zero_below(leaf: np.ndarray, base: int) -> np.ndarray:
     """Zero a cache leaf's positions < ``base`` (axis 2 — the same axis
@@ -1692,7 +1924,8 @@ class PrefillJob:
         if suffix_only and self.base > 0:
             base = self.base
             host = jax.tree.map(lambda leaf: _zero_below(leaf, base), host)
+        layout = self._engine._export_layout(_cache_nbytes(host))
         return KVHandoff(cache=host, pos=self.total,
                          first_token=self.first_token,
                          emitted=(self.first_token,), base=base,
-                         prefix_hash=prefix_hash).seal()
+                         prefix_hash=prefix_hash, layout=layout).seal()

@@ -928,3 +928,35 @@ def flagship_partition_rules() -> List[PartitionRule]:
         # norms and everything else: replicated (default, listed for clarity)
         PartitionRule(r"norm/scale", P()),
     ]
+
+
+def serving_partition_rules(int8: bool = False) -> List[PartitionRule]:
+    """The serving engine's default rule set
+    (`tpu_on_k8s/models/serving.py` mesh path): the flagship Megatron
+    layout, extended for W8A16 int8 serving trees when ``int8``.
+
+    A quantized kernel splits into ``kernel_q`` (same shape/layout as
+    the bf16 kernel — the flagship ``.../kernel`` regexes already match
+    it via re.search) and a per-OUT-channel ``kernel_scale`` one dim
+    shorter, which the kernel rules would mis-spec (a 3-dim spec on a
+    2-dim leaf). The scale rules therefore come FIRST (first match
+    wins) and shard each scale exactly like its kernel's output dim:
+    ``model`` for column-parallel projections, ``fsdp`` for
+    row-parallel ones — so the in-shard rescale of a sharded matmul
+    product never needs a gather."""
+    rules: List[PartitionRule] = []
+    if int8:
+        rules += [
+            # column-parallel kernels [L, D, F(model)] → scales [L, F]
+            PartitionRule(r"attn/w[qkv]/kernel_scale", P(None, AXIS_MODEL)),
+            PartitionRule(r"attn/wqkv/kernel_scale", P(None, AXIS_MODEL)),
+            PartitionRule(r"mlp/w_(gate|up|gateup)/kernel_scale",
+                          P(None, AXIS_MODEL)),
+            # row-parallel kernels [L, F(model), D(fsdp)] → scales [L, D]
+            PartitionRule(r"attn/wo/kernel_scale", P(None, AXIS_FSDP)),
+            PartitionRule(r"mlp/w_down/kernel_scale", P(None, AXIS_FSDP)),
+            # vocab-parallel head: lm_head_q [D, V] rides the lm_head
+            # rule below; its scale [V] shards with the vocab dim
+            PartitionRule(r"lm_head_scale", P(AXIS_MODEL)),
+        ]
+    return rules + flagship_partition_rules()

@@ -71,6 +71,15 @@ class MeshConfig:
                    AXIS_EXPERT: self.expert}
         return tuple(by_name[a] for a in AXIS_ORDER)
 
+    def describe(self) -> str:
+        """Compact stable signature ("data=1,fsdp=1,expert=1,seq=1,
+        model=2") — what the serving plane folds into replica identity
+        hashes and mesh-shape gauges."""
+        by_name = {AXIS_DATA: self.data, AXIS_FSDP: self.fsdp,
+                   AXIS_MODEL: self.model, AXIS_SEQ: self.seq,
+                   AXIS_EXPERT: self.expert}
+        return ",".join(f"{a}={by_name[a]}" for a in AXIS_ORDER)
+
 
 def create_mesh(config: Optional[MeshConfig] = None,
                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -84,6 +93,30 @@ def create_mesh(config: Optional[MeshConfig] = None,
     cfg = (config or MeshConfig()).resolve(len(devs))
     grid = np.asarray(devs, dtype=object).reshape(cfg.axis_sizes())
     return Mesh(grid, AXIS_ORDER)
+
+
+def serving_mesh(data: int = 1, model: int = 1, expert: int = 1,
+                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The serving plane's named ``{data, model, expert}`` mesh
+    (`tpu_on_k8s/models/serving.py`): ``model`` carries the per-layer
+    tensor-parallel collectives (innermost — ICI-adjacent chips),
+    ``expert`` shards MoE expert tables, ``data`` shards the engine's
+    slot pool. fsdp/seq are training-only concerns and stay at 1 — a
+    decode step has no gradient to shard and no sequence axis to split.
+    ``data * model * expert`` must equal the device count (the same
+    legal-quanta rule ``MeshConfig.resolve`` enforces)."""
+    return create_mesh(MeshConfig(data=data, fsdp=1, model=model,
+                                  seq=1, expert=expert), devices)
+
+
+def mesh_axes(mesh: Optional[Mesh]) -> dict:
+    """``{axis: size}`` for the mesh's non-trivial axes ({} for None /
+    all-1 meshes) — the engine's stable sharding signature, shared by
+    replica identity checks, ``ShardMetrics`` gauges, and the layout
+    block KV exports carry."""
+    if mesh is None:
+        return {}
+    return {a: int(s) for a, s in mesh.shape.items() if int(s) > 1}
 
 
 def put_global(x, sharding: NamedSharding):

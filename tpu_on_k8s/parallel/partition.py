@@ -9,6 +9,7 @@ insertion, fusion) per the scaling-book recipe.
 """
 from __future__ import annotations
 
+import math
 import re
 from dataclasses import dataclass
 from typing import Any, List, Optional, Sequence, Tuple
@@ -45,11 +46,19 @@ def path_str(key_path: Tuple[Any, ...]) -> str:
     return "/".join(parts)
 
 
-def spec_for_path(path: str, rules: Sequence[PartitionRule]) -> PartitionSpec:
+def rule_for_path(path: str, rules: Sequence[PartitionRule]
+                  ) -> Tuple[Optional[PartitionRule], PartitionSpec]:
+    """First matching rule (None when the path falls through to the
+    replicate-by-default spec) and the spec it yields — callers that
+    report errors name the rule that produced the bad spec."""
     for rule in rules:
         if rule.matches(path):
-            return rule.spec
-    return PartitionSpec()  # replicate by default
+            return rule, rule.spec
+    return None, PartitionSpec()  # replicate by default
+
+
+def spec_for_path(path: str, rules: Sequence[PartitionRule]) -> PartitionSpec:
+    return rule_for_path(path, rules)[1]
 
 
 def specs_for_pytree(tree: Any, rules: Sequence[PartitionRule]) -> Any:
@@ -59,33 +68,54 @@ def specs_for_pytree(tree: Any, rules: Sequence[PartitionRule]) -> Any:
     return tree_unflatten(treedef, specs)
 
 
-def _validate(path: str, leaf: Any, spec: PartitionSpec, mesh: Mesh) -> None:
-    shape = getattr(leaf, "shape", ())
+class ShardingValidationError(ValueError):
+    """A partition rule produced a spec a parameter cannot absorb on
+    this mesh. Raised at ``named_sharding`` time — BEFORE any program
+    compiles — with the param path, the offending dim, the mesh axis
+    sizes, and the rule that matched, so an uneven rule is a one-line
+    fix instead of an opaque XLA partitioner error deep in compile."""
+
+
+def _validate(path: str, leaf: Any, spec: PartitionSpec, mesh: Mesh,
+              rule: Optional[PartitionRule] = None) -> None:
+    shape = tuple(getattr(leaf, "shape", ()))
+    src = (f"rule {rule.pattern!r}" if rule is not None
+           else "the replicate-by-default fallback")
     if len(spec) > len(shape):
-        raise ValueError(f"{path}: spec {spec} has more dims than shape {shape}")
+        raise ShardingValidationError(
+            f"param {path!r}: spec {spec} (from {src}) names "
+            f"{len(spec)} dims but the leaf has shape {shape} "
+            f"({len(shape)} dims) — the rule matched a leaf it was not "
+            f"written for; tighten its regex or add a preceding rule "
+            f"for this leaf")
     for d, axes in enumerate(spec):
         if axes is None:
             continue
         names = axes if isinstance(axes, tuple) else (axes,)
-        total = 1
-        for name in names:
-            total *= mesh.shape[name]
+        sizes = {name: mesh.shape[name] for name in names}
+        total = math.prod(sizes.values())
         if shape[d] % total != 0:
-            raise ValueError(
-                f"{path}: dim {d} of shape {shape} not divisible by mesh axes "
-                f"{names} (size {total})")
+            detail = ", ".join(f"{n}={s}" for n, s in sizes.items())
+            raise ShardingValidationError(
+                f"param {path!r}: dim {d} (size {shape[d]} of shape "
+                f"{shape}) is not divisible by mesh axis(es) {detail} "
+                f"(product {total}), from {src} — pick a mesh where "
+                f"{'x'.join(names)} divides {shape[d]}, or change the "
+                f"rule's spec for dim {d}")
 
 
 def named_sharding(tree: Any, mesh: Mesh,
                    rules: Sequence[PartitionRule]) -> Any:
     """Pytree of NamedShardings for ``tree`` under ``rules``; validates
-    divisibility so a bad rule fails loudly at setup, not inside pjit."""
+    divisibility so a bad rule fails loudly at setup
+    (``ShardingValidationError`` naming the param path, dim, mesh axis,
+    and matched rule), not inside pjit."""
     leaves, treedef = tree_flatten_with_path(tree)
     out = []
     for kp, leaf in leaves:
         path = path_str(kp)
-        spec = spec_for_path(path, rules)
-        _validate(path, leaf, spec, mesh)
+        rule, spec = rule_for_path(path, rules)
+        _validate(path, leaf, spec, mesh, rule)
         out.append(NamedSharding(mesh, spec))
     return tree_unflatten(treedef, out)
 

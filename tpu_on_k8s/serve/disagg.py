@@ -274,7 +274,15 @@ class DisaggFleet:
                       "handoffs_enqueued": 0, "handoffs_adopted": 0,
                       "handoffs_lost": 0, "handoffs_corrupt": 0,
                       "replayed": 0, "retry_exhausted": 0,
-                      "engine_crashes": 0, "scale_ups": 0, "scale_downs": 0}
+                      "engine_crashes": 0, "scale_ups": 0, "scale_downs": 0,
+                      # adoptions whose handoff came from an UNLIKE mesh
+                      # (the payload's CacheLayout vs the adopting
+                      # engine's axes): the splice reshards on import —
+                      # exact either way, counted so a cross-mesh pool
+                      # pairing is visible on the stats, and the bytes
+                      # the export gathers for it are visible on the
+                      # prefill engines' export_gather_bytes
+                      "handoffs_cross_mesh": 0}
         self._lock = threading.Lock()
         for _ in range(prefill_replicas):
             self._add_replica(POOL_PREFILL)
@@ -896,6 +904,13 @@ class DisaggFleet:
                 rep.outstanding += req.cost
                 self._by_engine[(rep.name, erid)] = req.rid
                 self.stats["handoffs_adopted"] += 1
+                src = (dict(ho.payload.layout.mesh_axes)
+                       if ho.payload.layout is not None else {})
+                if src != dict(getattr(rep.engine, "mesh_axes", {}) or {}):
+                    # unlike meshes: reshard-on-import did real layout
+                    # work (not in the event log — the count is new, the
+                    # prior seeded soaks' logs must stay byte-identical)
+                    self.stats["handoffs_cross_mesh"] += 1
                 if self.metrics is not None:
                     self.metrics.inc("handoffs_adopted")
                     self.metrics.observe("handoff_wait_seconds",

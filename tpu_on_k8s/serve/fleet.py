@@ -171,7 +171,7 @@ class ServingFleet:
                  auto_register_prefixes: bool = True,
                  max_prefixes_per_replica: int = 16,
                  replica_metrics: bool = True,
-                 metrics=None,
+                 metrics=None, shard_metrics=None,
                  clock: Callable[[], float] = time.monotonic,
                  tracer=None) -> None:
         if n_replicas < 1:
@@ -188,6 +188,10 @@ class ServingFleet:
         self._tracer = ensure_tracer(tracer)
         #: optional ``FleetMetrics`` (per-replica labelled gauges/counters)
         self.metrics = metrics
+        #: optional ``ShardMetrics`` — the fleet's mesh-shape gauges and
+        #: the reshard-rollouts counter (a rollout whose new replicas
+        #: run a different mesh than the old ones)
+        self.shard_metrics = shard_metrics
         self._replica_metrics = replica_metrics
         self._auto_prefix = auto_register_prefixes
         self._max_prefixes = max_prefixes_per_replica
@@ -217,7 +221,11 @@ class ServingFleet:
                       "ejected": 0, "prefix_hits": 0, "prefix_misses": 0,
                       "readiness_flaps": 0, "rollout_interrupts": 0,
                       "rollouts_completed": 0, "scale_ups": 0,
-                      "scale_downs": 0, "rebalanced": 0}
+                      "scale_downs": 0, "rebalanced": 0,
+                      # rollouts whose winning replicas ran a different
+                      # mesh than the replaced ones (a ShardingPolicy
+                      # flip riding the ordinary rollout machinery)
+                      "reshard_rollouts": 0}
         self._lock = threading.Lock()
         for _ in range(n_replicas):
             self._add_replica(engine_factory, version)
@@ -229,6 +237,17 @@ class ServingFleet:
         name = f"replica-{self._next_ordinal}"
         self._next_ordinal += 1
         engine = factory(name)
+        # a mesh-sharded replica spans several chips: the router's
+        # bounded-load balance normalizes outstanding tokens by chip
+        # count, and the shard gauges publish the mesh shape
+        self.router.set_capacity(name,
+                                 int(getattr(engine, "n_chips", 1) or 1))
+        if self.shard_metrics is not None:
+            # mid-rollout in a mixed-mesh fleet the LAST replica added
+            # wins — the gauge reports the shape the fleet is converging
+            # to; the definitive per-replica view is engine.shard_report
+            self.shard_metrics.set_mesh_axes(
+                getattr(engine, "mesh_axes", {}) or {})
         rmetrics = ServingMetrics() if self._replica_metrics else None
         gateway = ServingGateway(
             engine, self._admission, tenant_weights=self._tenant_weights,
@@ -260,6 +279,17 @@ class ServingFleet:
             # frozen at its last value reads as phantom load forever
             for name in ("in_flight", "queue_depth", "outstanding_tokens"):
                 self.metrics.set_gauge(name, 0, replica=rep.name)
+
+    def _mesh_signature_locked(self) -> Tuple:
+        """Stable mesh signature of the fleet's active replicas (the
+        first live engine's non-trivial axes, as sorted items) — what
+        reshard-rollout detection compares. Lock held (or init)."""
+        for rep in self.replicas.values():
+            if rep.state in ACTIVE_STATES and rep.engine is not None:
+                return tuple(sorted(
+                    dict(getattr(rep.engine, "mesh_axes", {}) or {})
+                    .items()))
+        return ()
 
     def _ready_names(self) -> List[str]:
         return [r.name for r in self.replicas.values() if r.routable]
@@ -908,6 +938,10 @@ class ServingFleet:
                 raise RuntimeError("a rollout is already in progress")
             self._rollout = _Rollout(engine_factory, version,
                                      policy or FleetRolloutPolicy())
+            # snapshot the incumbent mesh signature: at completion the
+            # winner's signature decides whether this was a RESHARD
+            # (mesh-shape flip riding the ordinary rollout machinery)
+            self._rollout.from_mesh = self._mesh_signature_locked()
             # the new version starts at weight 0 (no traffic until its
             # first replica is ready and the canary share is granted)
             self.router.set_weights({**self.router.weights, version: 0.0})
@@ -959,6 +993,10 @@ class ServingFleet:
             self.stats["rollouts_completed"] += 1
             if self.metrics is not None:
                 self.metrics.inc("rollouts_completed")
+            if self._mesh_signature_locked() != ro.from_mesh:
+                self.stats["reshard_rollouts"] += 1
+                if self.shard_metrics is not None:
+                    self.shard_metrics.inc("reshard_rollouts")
             self._rollout = None
             return
 
@@ -1073,3 +1111,4 @@ class _Rollout:
         self.replaced = 0
         self.drain_deadlines: Dict[str, Optional[float]] = {}
         self.forced: set = set()   # replicas whose drain was cut short
+        self.from_mesh: Tuple = ()  # incumbent mesh signature at start

@@ -48,6 +48,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from tpu_on_k8s.models.layouts import CacheLayout
+
 
 def prefix_hash(tokens) -> str:
     """Content address of a prefix: blake2b over its int32 token bytes."""
@@ -71,6 +73,11 @@ class _Entry:
     pins: int = 0
     last_used: int = 0
     registered_at: float = 0.0
+    #: source layout of the host copy (`models/layouts.CacheLayout`):
+    #: the exporting engine's mesh axes — exports gather to the full
+    #: logical array, so ANY engine can promote this copy; a promote
+    #: onto a different mesh reshards on import (counted)
+    layout: Optional[CacheLayout] = None
 
 
 class FleetPrefixStore:
@@ -101,7 +108,10 @@ class FleetPrefixStore:
         self._op = 0                       # monotone recency counter
         self.stats = {"hits": 0, "promotes": 0, "misses": 0,
                       "evictions": 0, "demotes": 0, "overflow_bytes": 0,
-                      "pinned_eviction_skips": 0}
+                      "pinned_eviction_skips": 0,
+                      # promotes onto a mesh unlike the exporter's (the
+                      # host copy is gathered, the import reshards)
+                      "cross_mesh_promotes": 0}
 
     # ------------------------------------------------------------ registry
     def register(self, tokens) -> str:
@@ -195,12 +205,20 @@ class FleetPrefixStore:
                 self._inc("prefix_store_hits")
                 return pid
             host = e.host
+        engine_axes = dict(getattr(engine, "mesh_axes", {}) or {})
         if host is not None:
             pid = engine.import_prefix(host, self._entries[h].length)
             with self._lock:
                 e.residency[replica] = pid
                 e.replica_used[replica] = self._op
                 self.stats["promotes"] += 1
+                if (e.layout is not None
+                        and dict(e.layout.mesh_axes) != engine_axes):
+                    # the host copy is the gathered full array, so a
+                    # promote onto an UNLIKE mesh is just an import that
+                    # reshards — exact, but worth counting: it is the
+                    # fleet-prefix-reuse-across-meshes path working
+                    self.stats["cross_mesh_promotes"] += 1
                 self._inc("prefix_store_promotes")
         else:
             pid = engine.register_prefix(self._entries[h].tokens)
@@ -216,6 +234,8 @@ class FleetPrefixStore:
                 if e.host is None:
                     e.host = cache
                     e.host_nbytes = nbytes
+                    e.layout = CacheLayout(mesh_axes=engine_axes,
+                                           gathered_bytes=nbytes)
                     self.stats["overflow_bytes"] += nbytes
                 self.stats["misses"] += 1
                 self._inc("prefix_store_misses")
@@ -251,6 +271,7 @@ class FleetPrefixStore:
             self.stats["overflow_bytes"] -= e.host_nbytes
             e.host = None
             e.host_nbytes = 0
+            e.layout = None
             self.stats["evictions"] += 1
             self._inc("prefix_store_evictions")
 
@@ -298,7 +319,9 @@ class FleetPrefixStore:
         with self._lock:
             return {h: {"length": e.length, "pins": e.pins,
                         "in_overflow": e.host is not None,
-                        "residency": sorted(e.residency)}
+                        "residency": sorted(e.residency),
+                        "layout": (e.layout.signature()
+                                   if e.layout is not None else None)}
                     for h, e in sorted(self._entries.items())}
 
 

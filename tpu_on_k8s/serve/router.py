@@ -78,6 +78,11 @@ class Router:
         self._replicas: Dict[str, str] = {}        # name → version
         self._ring: List[Tuple[int, str]] = []     # (point, name), sorted
         self._weights: Dict[str, float] = {}       # version → weight
+        #: name → chip count: a mesh-sharded replica spans several chips
+        #: and absorbs proportionally more outstanding tokens, so the
+        #: bounded-load comparison runs on tokens PER CHIP. Default 1
+        #: everywhere keeps single-chip fleets bit-for-bit unchanged.
+        self._capacity: Dict[str, float] = {}
         self._wrr = SmoothWRR()
         #: registered shared-prefix contents, keyed by length: the
         #: affinity key prefers these over the raw head bucket
@@ -93,7 +98,20 @@ class Router:
             bisect.insort(self._ring, (point, name))
         self._weights.setdefault(version, 1.0)
 
+    def set_capacity(self, name: str, chips: int) -> None:
+        """Declare ``name``'s chip count (a mesh-sharded replica's mesh
+        size). Load balancing then compares outstanding tokens per chip,
+        so during a reshard rollout a 4-chip replica legitimately holds
+        4× a 1-chip replica's tokens before the ring spills."""
+        if chips < 1:
+            raise ValueError(f"chips must be >= 1, got {chips}")
+        self._capacity[name] = float(chips)
+
+    def _load(self, name: str, outstanding: Mapping[str, int]) -> float:
+        return outstanding.get(name, 0) / self._capacity.get(name, 1.0)
+
     def remove_replica(self, name: str) -> None:
+        self._capacity.pop(name, None)
         if self._replicas.pop(name, None) is None:
             return
         self._ring = [(p, n) for p, n in self._ring if n != name]
@@ -196,13 +214,16 @@ class Router:
             pool = candidates
         if self.mode == "random":
             return pool[self._rng.randrange(len(pool))]
-        least = min(pool, key=lambda r: (outstanding.get(r, 0), r))
+        # per-chip load: outstanding tokens normalized by replica chip
+        # count (``set_capacity``); all-1 capacities reduce to the raw
+        # token comparison bit-for-bit
+        least = min(pool, key=lambda r: (self._load(r, outstanding), r))
         aff = self._ring_lookup(
             self.bucket_key(prompt) if key is None else key, pool)
         if aff is None:
             return least
-        if (outstanding.get(aff, 0)
-                > outstanding.get(least, 0) + self.spill_tokens):
+        if (self._load(aff, outstanding)
+                > self._load(least, outstanding) + self.spill_tokens):
             return least                      # bounded load: spill
         return aff
 
