@@ -7,7 +7,7 @@
 IMG ?= tpu-on-k8s/manager:latest
 
 .PHONY: test test-fast analyze lint chaos-soak fleet-soak autoscale-soak \
-        disagg-soak spec-soak shard-soak trace-demo native bench dryrun manager samples clean \
+        disagg-soak spec-soak shard-soak slo-soak trace-demo native bench dryrun manager samples clean \
         docker-build docker-push deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
@@ -18,6 +18,7 @@ AUTOSCALE_SEED ?= 2468
 DISAGG_SEED ?= 8642
 SPEC_SEED ?= 7531
 SHARD_SEED ?= 1357
+SLO_SEED ?= 9753
 TRACE_SEED ?= 8642
 TRACE_FLAGS = --disagg --n-requests 24 --prefix-bucket 8 --prompt-min 4 \
     --prompt-max 12 --new-min 4 --new-max 8 --decode-replicas 2 \
@@ -68,6 +69,16 @@ shard-soak:  ## mesh-sharded vs single-program decode on the seeded cost-model t
 	JAX_PLATFORMS=cpu python tools/serve_load.py --shard --soak \
 	    --n-requests 24 --prompt-min 4 --prompt-max 12 \
 	    --new-min 4 --new-max 10 --seed $(SHARD_SEED)
+
+slo-soak:  ## burn-rate SLO engine vs static-threshold control on a seeded regression trace, twice: byte-identical budget event logs + earlier detection + page resolves to exemplar traces
+	JAX_PLATFORMS=cpu python tools/serve_load.py --slo --soak \
+	    --n-requests 160 --rate 0.4 --n-slots 8 \
+	    --prompt-min 4 --prompt-max 12 --new-min 4 --new-max 10 \
+	    --slo-target-ttft 0.2 --slo-regress-step 300 --slo-window 60 \
+	    --trace-out /tmp/tpu_on_k8s_slo_trace.json \
+	    --slo-out /tmp/tpu_on_k8s_slo_budget.json --seed $(SLO_SEED)
+	python tools/slo_report.py /tmp/tpu_on_k8s_slo_budget.json \
+	    /tmp/tpu_on_k8s_slo_trace.json --check
 
 trace-demo:  ## seeded disagg trace dumped twice: byte-identical span dumps + the TTFT critical-path report
 	JAX_PLATFORMS=cpu python tools/serve_load.py $(TRACE_FLAGS) \

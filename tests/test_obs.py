@@ -38,6 +38,7 @@ from tpu_on_k8s.metrics.metrics import (
     JobMetrics,
     ServingMetrics,
     ShardMetrics,
+    SLOMetrics,
     SpecMetrics,
     TrainMetrics,
     exposition,
@@ -510,10 +511,16 @@ def _populate(m):
         m.set_gauge("kv_bytes_per_chip", 512.0)
         m.inc("reshard_rollouts")
         m.inc("export_gather_bytes", 4096)
+    elif isinstance(m, SLOMetrics):
+        m.set_gauge("burn_rate_fast", 2.5, label="svc/ttft")
+        m.set_gauge("budget_state", 2.0, label="svc/ttft")
+        m.inc("budget_transitions", label="page")
+        m.inc("good_tokens", 64, label="tenant-a")
+        m.inc("chip_seconds", 3.5, label="tenant-a")
 
 
 _ALL_CLASSES = (JobMetrics, ServingMetrics, SpecMetrics, TrainMetrics,
-                FleetMetrics, AutoscaleMetrics, ShardMetrics)
+                FleetMetrics, AutoscaleMetrics, ShardMetrics, SLOMetrics)
 
 
 class TestExposition:
@@ -596,6 +603,76 @@ class TestExposition:
         for m in (a, b):
             _populate(m)
         assert render_text(a) == render_text(b)
+
+
+# --------------------------------------------------------------------------
+# OpenMetrics exemplar exposition: the retained (value, trace_id) pairs
+# are scrape-visible on histogram buckets under BOTH backends
+# --------------------------------------------------------------------------
+_EXEMPLAR_RE = re.compile(
+    r'_bucket\{le="(?P<le>[^"]+)"\} (?P<cum>[0-9.]+) '
+    r'# \{trace_id="(?P<tid>[^"]*)"\} (?P<val>[0-9.eE+\-]+)$')
+
+
+class TestOpenMetricsExemplars:
+    def _observed(self):
+        m = ServingMetrics()
+        m.observe("time_to_first_token_seconds", 0.02, exemplar=9)
+        m.observe("time_to_first_token_seconds", 0.7, exemplar=12)
+        # two exemplars landing in the same bucket: the NEWEST wins
+        m.observe("time_to_first_token_seconds", 0.021, exemplar=13)
+        return m
+
+    def test_fallback_emits_exemplars_on_buckets(self, monkeypatch):
+        monkeypatch.setattr(metrics_mod, "_prom", None)
+        m = self._observed()
+        body = exposition(m, openmetrics=True)
+        assert body.rstrip().endswith("# EOF")
+        hits = {mt["le"]: (mt["tid"], float(mt["val"]))
+                for mt in (_EXEMPLAR_RE.search(l)
+                           for l in body.splitlines()) if mt}
+        # 0.02/0.021 share the 0.025 bucket — newest (13) wins; 0.7
+        # lands in the 1.0 bucket; the exemplar value sits IN its bucket
+        assert hits["0.025"] == ("13", 0.021)
+        assert hits["1.0"] == ("12", 0.7)
+        for le, (_, val) in hits.items():
+            assert val <= float(le)
+        # OpenMetrics counter TYPE lines use the bare family name;
+        # samples keep the _total suffix
+        assert "# TYPE tpu_on_k8s_serving_requests_submitted counter" \
+            in body
+        assert "tpu_on_k8s_serving_requests_submitted_total 0" in body
+
+    def test_prometheus_backend_emits_exemplars(self):
+        if metrics_mod._prom is None:
+            pytest.skip("prometheus_client not installed")
+        m = self._observed()
+        body = exposition(m, openmetrics=True)
+        assert 'trace_id="12"' in body
+        assert body.rstrip().endswith("# EOF")
+
+    def test_classic_exposition_stays_exemplar_free(self, monkeypatch):
+        # the classic text format has no legal exemplar syntax: the
+        # default rendering must stay byte-compatible with strict
+        # text-format parsers
+        monkeypatch.setattr(metrics_mod, "_prom", None)
+        m = self._observed()
+        body = exposition(m)
+        assert "# {" not in body
+        _parse_body(body)                   # every line still parses
+
+    @pytest.mark.parametrize("cls", _ALL_CLASSES)
+    def test_openmetrics_renders_every_class_both_backends(self, cls,
+                                                           monkeypatch):
+        if metrics_mod._prom is not None:
+            m = cls()
+            _populate(m)
+            assert exposition(m, openmetrics=True)
+        monkeypatch.setattr(metrics_mod, "_prom", None)
+        m = cls()
+        _populate(m)
+        body = exposition(m, openmetrics=True)
+        assert body.rstrip().endswith("# EOF")
 
     def test_observation_line_round_trip(self):
         sample = FleetSample(seq=0, ttft=(0.1, 0.4), queue_wait=(0.02,),

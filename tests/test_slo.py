@@ -1,0 +1,786 @@
+"""The SLO engine + goodput/cost accounting plane (`tpu_on_k8s/obs/slo.py`,
+`obs/account.py`, `metrics.SLOMetrics`, and the fleet-autoscaler wiring):
+
+* burn-rate math against hand-computed fixtures (latency-percentile and
+  availability objectives, the multi-window min rule, budget remaining);
+* window-boundary determinism (half-open windows, identical feeds →
+  identical event logs);
+* budget-state hysteresis — no flapping at the page threshold, dead band
+  on budget refill;
+* staleness: a signal that goes dark surfaces ``stale`` with burn rates
+  ``None``, never a frozen last-known burn (and the `autoscale/signals`
+  max-age regression: a clock jump past the window reads stale);
+* goodput accounting — serving (good/degraded tokens, router-weighted
+  chip-seconds) and training (the scripted preemption trace from
+  `tools/chaos_soak.py`'s train stage: replayed steps are waste);
+* `SLOMetrics` exposition conformance beside the other seven classes;
+* the CRD plane: ``spec.slo`` → ``status.slo`` via the FleetAutoscaler
+  tick, page-urgency bypassing the up-cooldown exactly once, and the
+  disabled path staying decision-neutral.
+"""
+import dataclasses
+import tempfile
+import threading
+
+import pytest
+
+from tpu_on_k8s.api.core import ObjectMeta
+from tpu_on_k8s.api.inference_types import (
+    AutoscalePolicy,
+    InferenceService,
+    InferenceServiceSpec,
+    PoolsSpec,
+    SLOObjective,
+    SLOPolicy,
+)
+from tpu_on_k8s.api.types import TPUPolicy
+from tpu_on_k8s.autoscale.signals import SignalAggregator, dead_sample
+from tpu_on_k8s.autoscale.signals import FleetSample
+from tpu_on_k8s.client import InMemoryCluster
+from tpu_on_k8s.controller.config import JobControllerConfig
+from tpu_on_k8s.controller.fleetautoscaler import FleetAutoscaler
+from tpu_on_k8s.metrics.metrics import (
+    ServingMetrics,
+    SLOMetrics,
+    TrainMetrics,
+    exposition,
+    render_text,
+)
+from tpu_on_k8s.obs.account import (
+    ServingAccountant,
+    TrainingAccountant,
+    goodput_from_spans,
+)
+from tpu_on_k8s.obs.slo import (
+    BUDGET_EXHAUSTED,
+    BUDGET_OK,
+    BUDGET_PAGE,
+    SLOEngine,
+    SLOEvaluator,
+    SLOSpec,
+    objective_kind,
+)
+from tpu_on_k8s.serve.router import Router
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _spec(**kw):
+    base = dict(name="ttft", objective="ttft_p95", target=0.2,
+                window_s=600.0, fast_short_s=10.0, fast_long_s=30.0,
+                slow_short_s=60.0, slow_long_s=120.0,
+                page_burn=14.4, warn_burn=1.0, hysteresis=0.2,
+                stale_after_s=50.0)
+    base.update(kw)
+    return SLOSpec(**base)
+
+
+# ------------------------------------------------------------- burn math
+class TestBurnMath:
+    def test_objective_kinds_and_budgets(self):
+        assert objective_kind("ttft_p95") == ("ttft", 0.05)
+        assert objective_kind("tpot_p99") == ("tpot", 0.01)
+        assert objective_kind("queue_wait_p90") == ("queue_wait", 0.10)
+        assert objective_kind("availability")[0] == "availability"
+        with pytest.raises(ValueError):
+            objective_kind("latency_p95")
+        with pytest.raises(ValueError):
+            objective_kind("ttft")
+
+    def test_burn_rate_hand_computed(self):
+        # 20 events at t=1..20, the two at t=19,20 breaching. At t=20:
+        #   fast_short (10s, half-open (10,20]) holds events 11..20 ->
+        #     2 bad / 10 total = 0.2 breach; budget 5% -> burn 4.0
+        #   fast_long (30s) holds all 20 -> 2/20 = 0.1 -> burn 2.0
+        #   pair burn = min(4.0, 2.0) = 2.0
+        clock = FakeClock()
+        ev = SLOEvaluator(_spec(), clock=clock)
+        for i in range(1, 21):
+            clock.t = float(i)
+            ev.observe(value=0.5 if i >= 19 else 0.1)
+        st = ev.evaluate()
+        assert st.burn_fast == pytest.approx(2.0)
+        assert st.burn_slow == pytest.approx(2.0)   # both slow windows: all
+        assert st.good == 18 and st.bad == 2
+        # budget remaining: 1 - (2/20)/0.05 = -1.0 -> exhausted
+        assert st.budget_remaining == pytest.approx(-1.0)
+        assert st.state == BUDGET_EXHAUSTED
+
+    def test_availability_budget(self):
+        clock = FakeClock()
+        ev = SLOEvaluator(_spec(name="avail", objective="availability",
+                                target=0.9), clock=clock)
+        # 95 ok + 5 failed -> bad fraction 0.05, budget 0.1 -> burn 0.5,
+        # remaining 0.5
+        for i in range(100):
+            clock.t = 1.0 + i * 0.01
+            ev.observe(ok=i % 20 != 0)
+        st = ev.evaluate()
+        assert st.burn_fast == pytest.approx(0.5)
+        assert st.budget_remaining == pytest.approx(0.5)
+        assert st.state == BUDGET_OK
+
+    def test_empty_window_burn_is_none_not_zero(self):
+        clock = FakeClock()
+        ev = SLOEvaluator(_spec(stale_after_s=1000.0), clock=clock)
+        clock.t = 1.0
+        ev.observe(value=0.1)
+        # jump past the fast windows (but not stale_after): the fast
+        # pair has no events -> None, never 0.0
+        clock.t = 100.0
+        st = ev.evaluate()
+        assert st.burn_fast is None
+        assert not st.stale
+
+    def test_window_boundary_is_half_open(self):
+        clock = FakeClock()
+        ev = SLOEvaluator(_spec(stale_after_s=1000.0), clock=clock)
+        clock.t = 5.0
+        ev.observe(value=0.5)              # one bad event at exactly t=5
+        # at t=15 the 10s fast_short window is (5, 15]: the event is OUT
+        clock.t = 15.0
+        assert ev._burn(15.0, 10.0) is None
+        # one tick earlier it is IN
+        assert ev._burn(14.999, 10.0) == pytest.approx(20.0)
+
+    def test_identical_feeds_identical_event_logs(self):
+        def run():
+            clock = FakeClock()
+            eng = SLOEngine([_spec(window_s=2000.0)], clock=clock)
+            for i in range(60):
+                clock.advance(1.0)
+                eng.observe_latency("ttft", 0.5 if 20 <= i < 30 else 0.1)
+                eng.evaluate()
+            return list(eng.event_log)
+
+        a, b = run(), run()
+        assert a == b and a            # deterministic AND non-trivial
+
+
+# ------------------------------------------------- state machine/hysteresis
+class TestBudgetStates:
+    def _avail_ev(self, clock, **kw):
+        # availability with a 50% budget: burn == 2 * bad_fraction, and
+        # the full-window exhaustion stays far away — lets the test walk
+        # the page threshold without tripping EXHAUSTED
+        base = dict(name="a", objective="availability", target=0.5,
+                    window_s=100000.0, fast_short_s=10.0, fast_long_s=10.0,
+                    slow_short_s=20.0, slow_long_s=20.0,
+                    page_burn=1.6, warn_burn=0.0, hysteresis=0.25,
+                    stale_after_s=100000.0)
+        base.update(kw)
+        return SLOEvaluator(SLOSpec(**base), clock=clock)
+
+    def _feed(self, ev, clock, bad, good):
+        for _ in range(bad):
+            clock.advance(0.1)
+            ev.observe(ok=False)
+        for _ in range(good):
+            clock.advance(0.1)
+            ev.observe(ok=True)
+
+    def test_page_hysteresis_no_flap(self):
+        clock = FakeClock()
+        ev = self._avail_ev(clock)
+        self._feed(ev, clock, 0, 100)          # clean history
+        assert ev.evaluate().state == BUDGET_OK
+        # window (10s) now holds only what we feed per phase (advance
+        # 11s between phases to age the previous phase out)
+        clock.advance(11.0)
+        self._feed(ev, clock, 9, 1)            # frac .9 -> burn 1.8 >= 1.6
+        assert ev.evaluate().state == BUDGET_PAGE
+        clock.advance(11.0)
+        self._feed(ev, clock, 7, 3)            # burn 1.4: inside the dead
+        assert ev.evaluate().state == BUDGET_PAGE   # band (>= 1.2): holds
+        clock.advance(11.0)
+        self._feed(ev, clock, 2, 8)            # burn 0.4 < 1.2: releases
+        assert ev.evaluate().state == BUDGET_OK
+        # exactly the transitions above — no flapping inside the band
+        assert [line.split("state=")[1].split(" ")[0]
+                for line in ev.event_log] == ["ok->page", "page->ok"]
+
+    def test_exhausted_refill_dead_band(self):
+        clock = FakeClock()
+        ev = SLOEvaluator(_spec(window_s=40.0, stale_after_s=1000.0),
+                          clock=clock)
+        self._feed_latency(ev, clock, [0.1] * 10 + [0.5] * 2)
+        st = ev.evaluate()                     # 2/12 = 16.7% >> 5%
+        assert st.state == BUDGET_EXHAUSTED
+        # refill by good traffic: remaining climbs, but inside the
+        # hysteresis band (0 < remaining < 0.2) the state holds
+        self._feed_latency(ev, clock, [0.1] * 27)   # 2/39 -> rem ~-0.026
+        assert ev.evaluate().state == BUDGET_EXHAUSTED
+        self._feed_latency(ev, clock, [0.1] * 3)    # 2/42 -> rem ~0.048
+        assert ev.evaluate().state == BUDGET_EXHAUSTED   # dead band
+        # age the bad events out of the 40s compliance window entirely
+        clock.advance(41.0)
+        self._feed_latency(ev, clock, [0.1] * 5)
+        assert ev.evaluate().state == BUDGET_OK
+
+    @staticmethod
+    def _feed_latency(ev, clock, values):
+        for v in values:
+            clock.advance(0.01)
+            ev.observe(value=v)
+
+    def test_stale_surfaces_not_freezes(self):
+        clock = FakeClock()
+        ev = SLOEvaluator(_spec(stale_after_s=30.0), clock=clock)
+        self._feed_latency(ev, clock, [0.5] * 10)
+        st = ev.evaluate()
+        assert st.state == BUDGET_EXHAUSTED and not st.stale
+        clock.advance(100.0)                   # the signal went dark
+        st = ev.evaluate()
+        assert st.stale
+        assert st.burn_fast is None and st.burn_slow is None
+        assert st.state == BUDGET_EXHAUSTED    # held, flagged — not frozen
+        # ...and a recovering signal clears staleness
+        self._feed_latency(ev, clock, [0.1])
+        assert not ev.evaluate().stale
+
+
+# ------------------------------------------------------------------ engine
+class TestEngine:
+    def test_latency_routing_by_kind(self):
+        clock = FakeClock()
+        eng = SLOEngine(
+            [_spec(name="ttft", objective="ttft_p95"),
+             _spec(name="tpot", objective="tpot_p95", target=0.05),
+             _spec(name="avail", objective="availability", target=0.99)],
+            clock=clock)
+        clock.t = 1.0
+        eng.observe_latency("ttft", 0.5)
+        eng.observe_latency("tpot", 0.01)
+        eng.observe_outcome(True)
+        st = eng.evaluate()
+        assert st["ttft"].bad == 1 and st["ttft"].good == 0
+        assert st["tpot"].good == 1 and st["tpot"].bad == 0
+        assert st["avail"].good == 1
+
+    def test_duplicate_names_raise(self):
+        with pytest.raises(ValueError):
+            SLOEngine([_spec(), _spec()], clock=FakeClock())
+
+    def test_metrics_plane(self):
+        clock = FakeClock()
+        m = SLOMetrics()
+        eng = SLOEngine([_spec()], clock=clock, metrics=m, service="ns/s")
+        for _ in range(10):
+            clock.advance(0.5)
+            eng.observe_latency("ttft", 0.5)
+        eng.evaluate()
+        assert m.gauges[("budget_state", "ns/s/ttft")] == 3.0   # exhausted
+        assert m.counters[("budget_transitions", "exhausted")] == 1
+        assert m.gauges[("burn_rate_fast", "ns/s/ttft")] > 0
+        body = exposition(m)
+        assert "tpu_on_k8s_slo_budget_state" in body
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            SLOSpec(name="x", objective="nope", target=1.0).normalized()
+        with pytest.raises(ValueError):
+            SLOSpec(name="x", objective="ttft_p95",
+                    target=0.0).normalized()
+        # SRE defaults derive from window_s
+        n = SLOSpec(name="x", objective="ttft_p95", target=0.2,
+                    window_s=2_592_000.0).normalized()
+        assert n.fast_short_s == pytest.approx(300.0)     # 5m
+        assert n.fast_long_s == pytest.approx(3600.0)     # 1h
+        assert n.slow_short_s == pytest.approx(21600.0)   # 6h
+        assert n.slow_long_s == pytest.approx(259200.0)   # 3d
+
+
+# ------------------------------------------------------------- accountants
+class TestServingAccountant:
+    def test_classification_and_token_conservation(self):
+        acct = ServingAccountant(ttft_slo_s=0.2)
+        assert acct.observe_request(tenant="a", state="done", tokens=10,
+                                    ttft=0.1) == "good"
+        assert acct.observe_request(tenant="a", state="done", tokens=5,
+                                    ttft=0.5) == "degraded"
+        # missing sample for a configured target is NOT good
+        assert acct.observe_request(tenant="b", state="done", tokens=3,
+                                    ttft=None) == "degraded"
+        assert acct.observe_request(tenant="b", state="deadline_exceeded",
+                                    tokens=2, ttft=0.1) == "degraded"
+        assert acct.observe_request(tenant="b", state="rejected",
+                                    tokens=0) == "rejected"
+        s = acct.summary()
+        assert s["good_tokens"] == 10 and s["degraded_tokens"] == 10
+        assert s["rejected"] == 1
+        assert s["good_tokens"] + s["degraded_tokens"] == 20
+        assert s["per_tenant"]["a"]["good_tokens"] == 10
+        assert s["goodput_token_fraction"] == pytest.approx(0.5)
+
+    def test_chip_seconds_use_router_capacity_weights(self):
+        router = Router()
+        router.add_replica("replica-0", "v1")
+        router.add_replica("replica-1", "v1")
+        router.set_capacity("replica-1", 4)     # mesh-sharded: 4 chips
+        m = SLOMetrics()
+        acct = ServingAccountant(ttft_slo_s=0.2, metrics=m, router=router)
+        acct.observe_request(tenant="a", state="done", tokens=4, ttft=0.1,
+                             duration_s=2.0, replica="replica-0")
+        acct.observe_request(tenant="a", state="done", tokens=4, ttft=0.1,
+                             duration_s=2.0, replica="replica-1")
+        # 1 chip * 2s + 4 chips * 2s
+        assert acct.summary()["chip_seconds"] == pytest.approx(10.0)
+        assert m.counters[("chip_seconds", "a")] == pytest.approx(10.0)
+        # explicit note_capacity wins over the router
+        acct.note_capacity("replica-1", 2)
+        assert acct.chips_of("replica-1") == 2.0
+
+    def test_replays_counted(self):
+        acct = ServingAccountant()
+        acct.observe_request(tenant="a", state="done", tokens=1, replays=2)
+        assert acct.summary()["replayed"] == 2
+
+
+class TestTrainingAccountant:
+    def test_scripted_preemption_trace_hand_computed(self):
+        # the chaos_soak train-stage scenario (tools/chaos_soak.py):
+        # 14 steps, preempt at 9 (so 8 complete), checkpoint every 3,
+        # preemption save FAILS -> resume falls back to checkpoint 6 and
+        # re-executes steps 7..8 before novel work resumes.
+        m = TrainMetrics()
+        acct = TrainingAccountant(metrics=m)
+        for step in range(1, 9):               # first incarnation, 1s/step
+            acct.window(step, 1, 1.0)
+        acct.run_complete(9.0, preempted=True)  # 1s preemption drain
+        acct.resume(6)
+        for step in range(1, 9):               # resumed: local 1..8 ->
+            acct.window(step, 1, 1.0)          # global 7..14
+        acct.run_complete(8.5)                  # 0.5s restart overhead
+        s = acct.summary()
+        assert s["productive_s"] == pytest.approx(14.0)   # 14 novel steps
+        assert s["waste_s"]["replay"] == pytest.approx(2.0)   # steps 7,8
+        assert s["waste_s"]["preempt"] == pytest.approx(1.0)
+        assert s["waste_s"]["overhead"] == pytest.approx(0.5)
+        assert s["preemptions"] == 1
+        assert s["goodput_fraction"] == pytest.approx(14.0 / 17.5)
+        assert m.gauges["goodput_fraction"] == pytest.approx(
+            s["goodput_fraction"])
+
+    def test_train_loop_integration_preempt_resume(self):
+        # the live twin of the hand-computed trace: run the actual
+        # TrainLoop through the chaos train_preemption scenario and
+        # assert the accountant sees replayed steps as waste
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_on_k8s.chaos import scenarios
+        from tpu_on_k8s.train.checkpoint import CheckpointManager
+        from tpu_on_k8s.train.loop import TrainLoop
+
+        @jax.jit
+        def step_fn(state, batch):
+            x, y = batch
+            loss, grad = jax.value_and_grad(
+                lambda w: jnp.mean((x @ w - y) ** 2))(state["w"])
+            return ({"w": state["w"] - 0.1 * grad,
+                     "step": state["step"] + 1}, {"loss": loss})
+
+        def init_state():
+            return {"w": jnp.zeros((4, 2), jnp.float32),
+                    "step": jnp.zeros((), jnp.int32)}
+
+        def batches_from(start):
+            i = start
+            while True:
+                rng = np.random.default_rng((7, i))
+                yield (jnp.asarray(rng.normal(size=(8, 4)), jnp.float32),
+                       jnp.asarray(rng.normal(size=(8, 2)), jnp.float32))
+                i += 1
+
+        steps, preempt_at, every = 14, 9, 3
+        metrics = TrainMetrics()
+        acct = TrainingAccountant(metrics=metrics)
+        with tempfile.TemporaryDirectory() as d:
+            mgr = CheckpointManager(d)
+            inj = scenarios.train_preemption(preempt_at, fail_save=True,
+                                             seed=7).injector()
+            loop = TrainLoop(step_fn, init_state(), batches_from(1),
+                             log_every=1, checkpoint_manager=mgr,
+                             checkpoint_every=every, accountant=acct)
+            with inj:
+                first = loop.run(steps)
+            assert first.preempted and first.steps == preempt_at - 1
+            restored, _gen, step = mgr.restore(init_state())
+            assert step == 6
+            acct.resume(step)
+            TrainLoop(step_fn, restored, batches_from(step + 1),
+                      log_every=1, checkpoint_manager=mgr,
+                      checkpoint_every=every,
+                      accountant=acct).run(steps - step)
+            mgr.close()
+        s = acct.summary()
+        assert s["preemptions"] == 1
+        # steps 7,8 re-executed after the fallback resume: replay waste
+        assert s["waste_s"]["replay"] > 0
+        assert s["steps_accounted"] == steps
+        assert 0 < s["goodput_fraction"] < 1
+        assert metrics.gauges["goodput_fraction"] == pytest.approx(
+            s["goodput_fraction"])
+
+    def test_goodput_from_spans(self):
+        spans = [
+            {"name": "train.window", "start": 0.0, "end": 4.0,
+             "attrs": {"steps": 4, "step_seconds": 1.0}},
+            {"name": "train.window", "start": 6.0, "end": 10.0,
+             "attrs": {"steps": 4, "step_seconds": 1.0}},
+            {"name": "request", "start": 0.0, "end": 1.0},   # ignored
+        ]
+        g = goodput_from_spans(spans)
+        assert g["windows"] == 2
+        assert g["productive_s"] == pytest.approx(8.0)
+        assert g["gap_s"] == pytest.approx(2.0)
+        assert g["goodput_fraction"] == pytest.approx(0.8)
+        assert goodput_from_spans([])["goodput_fraction"] is None
+
+
+# ------------------------------------------- signals max-age (regression)
+class TestSignalStaleWindow:
+    def test_clock_jump_past_window_surfaces_stale(self):
+        # regression: without max_age_s, a clock jump past the whole
+        # scrape window left ancient samples reading as fresh — the
+        # policy (and now the SLO status) kept acting on a frozen p95
+        agg = SignalAggregator(window=4, stale_after=3, max_age_s=1.0)
+        obs = agg.record(FleetSample(seq=1, ttft=(0.4,), slots=4,
+                                     ready_replicas=1), now=0.0)
+        assert not obs.stale and obs.ttft_p95 == 0.4
+        # the virtual clock jumps past the window; the next scrape dies
+        obs = agg.record(dead_sample(2), now=50.0)
+        assert obs.stale                       # aged out, NOT frozen
+        assert obs.ttft_p95 is None
+        # a fresh live sample recovers immediately
+        obs = agg.record(FleetSample(seq=3, ttft=(0.2,), slots=4,
+                                     ready_replicas=1), now=50.5)
+        assert not obs.stale and obs.ttft_p95 == 0.2
+
+    def test_aging_disabled_by_default(self):
+        agg = SignalAggregator(window=4, stale_after=3)
+        agg.record(FleetSample(seq=1, ttft=(0.4,), slots=4,
+                               ready_replicas=1), now=0.0)
+        obs = agg.record(dead_sample(2), now=50.0)
+        assert not obs.stale and obs.ttft_p95 == 0.4   # legacy behavior
+
+    def test_bad_max_age_rejected(self):
+        with pytest.raises(ValueError):
+            SignalAggregator(max_age_s=0.0)
+
+
+# --------------------------------------------------- CRD plane (autoscaler)
+class _FakeReplica:
+    def __init__(self):
+        self.metrics = ServingMetrics()
+        self.engine = type("E", (), {"n_slots": 8})()
+        self.outstanding = 0
+        self.routable = True
+        self.state = type("S", (), {"value": "ready"})()
+
+
+class _FakeFleet:
+    def __init__(self, n=1):
+        self.replicas = {f"replica-{i}": _FakeReplica() for i in range(n)}
+        self.queue_depth = 0
+        self.scaled = []
+
+    def scale_to(self, n):
+        self.scaled.append(n)
+
+
+def _slo_policy(target=0.25):
+    return SLOPolicy(objectives=[SLOObjective(
+        name="ttft", objective="ttft_p95", target=target, window_s=600.0,
+        fast_short_s=2.0, fast_long_s=4.0, slow_short_s=10.0,
+        slow_long_s=20.0, page_burn=10.0, warn_burn=1.0)])
+
+
+def _slo_svc(*, autoscale, slo, replicas=1):
+    return InferenceService(
+        metadata=ObjectMeta(name="svc"),
+        spec=InferenceServiceSpec(
+            image="inproc", replicas=replicas,
+            tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice",
+                                 topology="2x2"),
+            autoscale=autoscale, slo=slo))
+
+
+def _scaler(cluster, clock, slo_metrics=None):
+    return FleetAutoscaler(
+        cluster, config=JobControllerConfig(autoscale_window_scrapes=3,
+                                            autoscale_stale_scrapes=3),
+        clock=clock, slo_metrics=slo_metrics)
+
+
+class TestFleetAutoscalerSLO:
+    def _drive(self, scaler, fleet, clock, ticks, ttft):
+        for _ in range(ticks):
+            for rep in fleet.replicas.values():
+                rep.metrics.observe("time_to_first_token_seconds", ttft)
+            clock.advance(0.5)
+            scaler.run_once()
+
+    def test_status_slo_written_and_pages(self):
+        clock = FakeClock()
+        cluster = InMemoryCluster()
+        cluster.create(_slo_svc(
+            autoscale=AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                      target_ttft_s=0.3,
+                                      scale_up_cooldown_s=0.1),
+            slo=_slo_policy()))
+        fleet = _FakeFleet()
+        m = SLOMetrics()
+        scaler = _scaler(cluster, clock, slo_metrics=m)
+        scaler.attach_fleet("default", "svc", fleet)
+        self._drive(scaler, fleet, clock, 4, ttft=0.1)
+        svc = cluster.get(InferenceService, "default", "svc")
+        assert "ttft" in svc.status.slo
+        assert svc.status.slo["ttft"].state == "ok"
+        assert svc.status.slo["ttft"].burn_fast == 0.0
+        self._drive(scaler, fleet, clock, 8, ttft=0.9)
+        svc = cluster.get(InferenceService, "default", "svc")
+        assert svc.status.slo["ttft"].state in ("page", "exhausted")
+        assert m.counters[("budget_transitions",
+                           svc.status.slo["ttft"].state)] >= 1
+
+    def test_page_bypasses_up_cooldown_once(self):
+        clock = FakeClock()
+        cluster = InMemoryCluster()
+        cluster.create(_slo_svc(
+            autoscale=AutoscalePolicy(
+                min_replicas=1, max_replicas=8, target_ttft_s=0.3,
+                slice_legal=False, max_step=1,
+                scale_up_cooldown_s=10_000.0),   # effectively infinite
+            slo=_slo_policy()))
+        fleet = _FakeFleet()
+        scaler = _scaler(cluster, clock)
+        scaler.attach_fleet("default", "svc", fleet)
+        self._drive(scaler, fleet, clock, 10, ttft=0.9)
+        log = list(scaler.decision_log)
+        ups = [l for l in log if "action=up" in l]
+        # first up is cooldown-free; the page grants exactly ONE bypass
+        # of the infinite cooldown; after that the loop holds
+        assert len(ups) == 2
+        assert "slo_page" in ups[1]
+        assert any("up_cooldown" in l for l in log[log.index(ups[1]) + 1:])
+
+    def test_non_paging_slo_is_decision_neutral(self):
+        def run(slo):
+            clock = FakeClock()
+            cluster = InMemoryCluster()
+            cluster.create(_slo_svc(
+                autoscale=AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                          target_ttft_s=0.3),
+                slo=slo))
+            fleet = _FakeFleet()
+            scaler = _scaler(cluster, clock)
+            scaler.attach_fleet("default", "svc", fleet)
+            self._drive(scaler, fleet, clock, 6, ttft=0.1)
+            return list(scaler.decision_log)
+
+        assert run(None) == run(_slo_policy())   # healthy SLO: no effect
+
+    def test_slo_only_service_writes_status_without_decisions(self):
+        clock = FakeClock()
+        cluster = InMemoryCluster()
+        cluster.create(_slo_svc(autoscale=None, slo=_slo_policy()))
+        fleet = _FakeFleet()
+        scaler = _scaler(cluster, clock)
+        scaler.attach_fleet("default", "svc", fleet)
+        assert scaler.registered() == ["default/svc"]
+        self._drive(scaler, fleet, clock, 3, ttft=0.1)
+        svc = cluster.get(InferenceService, "default", "svc")
+        assert svc.status.slo["ttft"].state == "ok"
+        assert not scaler.decision_log
+
+    def test_removing_slo_block_clears_status(self):
+        # regression: tearing the engine down must not leave a frozen
+        # budget state on the CRD — a months-old "page" nobody updates
+        clock = FakeClock()
+        cluster = InMemoryCluster()
+        cluster.create(_slo_svc(
+            autoscale=AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                      target_ttft_s=0.3),
+            slo=_slo_policy()))
+        fleet = _FakeFleet()
+        scaler = _scaler(cluster, clock)
+        scaler.attach_fleet("default", "svc", fleet)
+        self._drive(scaler, fleet, clock, 6, ttft=0.9)
+        assert cluster.get(InferenceService, "default",
+                           "svc").status.slo["ttft"].state != "ok"
+
+        def drop_slo(s):
+            s.spec.slo = None
+        cluster.update_with_retry(InferenceService, "default", "svc",
+                                  drop_slo)
+        scaler.run_once()
+        assert cluster.get(InferenceService, "default",
+                           "svc").status.slo == {}
+
+    def test_full_deregistration_clears_status(self):
+        # slo-only service loses its slo block: it leaves the
+        # autoscaler's care entirely — status.slo must blank on the way
+        clock = FakeClock()
+        cluster = InMemoryCluster()
+        cluster.create(_slo_svc(autoscale=None, slo=_slo_policy()))
+        fleet = _FakeFleet()
+        scaler = _scaler(cluster, clock)
+        scaler.attach_fleet("default", "svc", fleet)
+        self._drive(scaler, fleet, clock, 3, ttft=0.9)
+        assert cluster.get(InferenceService, "default",
+                           "svc").status.slo
+
+        def drop_slo(s):
+            s.spec.slo = None
+        cluster.update_with_retry(InferenceService, "default", "svc",
+                                  drop_slo)
+        scaler.run_once()
+        assert cluster.get(InferenceService, "default",
+                           "svc").status.slo == {}
+        assert scaler.registered() == []
+
+    def test_pooled_slo_only_service_is_fed_not_stale(self):
+        # regression: a disagg service whose pools carry NO autoscale
+        # block still declares service SLOs — the tick must scrape the
+        # pools for the engine, not report permanently-stale status.slo
+        class _FakeDisagg:
+            def __init__(self):
+                self._pools = {"prefill": _FakeFleet(),
+                               "decode": _FakeFleet()}
+
+            def pool(self, name):
+                return self._pools[name]
+
+        clock = FakeClock()
+        cluster = InMemoryCluster()
+        svc = _slo_svc(autoscale=None, slo=_slo_policy())
+        svc.spec.pools = PoolsSpec()
+        cluster.create(svc)
+        fleet = _FakeDisagg()
+        scaler = _scaler(cluster, clock)
+        scaler.attach_fleet("default", "svc", fleet)
+        for _ in range(4):
+            for pool in fleet._pools.values():
+                for rep in pool.replicas.values():
+                    rep.metrics.observe("time_to_first_token_seconds",
+                                        0.9)
+            clock.advance(0.5)
+            scaler.run_once()
+        st = cluster.get(InferenceService, "default", "svc").status.slo
+        assert not st["ttft"].stale
+        assert st["ttft"].state in ("page", "exhausted")
+
+    def test_stale_signal_surfaces_in_status_slo(self):
+        clock = FakeClock()
+        cluster = InMemoryCluster()
+        pol = SLOPolicy(objectives=[SLOObjective(
+            name="ttft", objective="ttft_p95", target=0.25,
+            window_s=600.0, fast_short_s=2.0, fast_long_s=4.0,
+            slow_short_s=10.0, slow_long_s=20.0)])
+        cluster.create(_slo_svc(autoscale=None, slo=pol))
+        fleet = _FakeFleet()
+        scaler = _scaler(cluster, clock)
+        scaler.attach_fleet("default", "svc", fleet)
+        self._drive(scaler, fleet, clock, 3, ttft=0.9)
+        svc = cluster.get(InferenceService, "default", "svc")
+        assert not svc.status.slo["ttft"].stale
+        # the clock jumps past fast_long (the default stale_after):
+        # burn rates must read "unknown", never the frozen last value
+        clock.advance(100.0)
+        scaler.run_once()
+        svc = cluster.get(InferenceService, "default", "svc")
+        assert svc.status.slo["ttft"].stale
+        assert svc.status.slo["ttft"].burn_fast == -1.0
+
+
+# ----------------------------------------------------------------- API/serde
+class TestAPI:
+    def test_slo_policy_normalized_drops_junk_and_dupes(self):
+        pol = SLOPolicy(objectives=[
+            SLOObjective(name="a", objective="ttft_p95", target=0.2),
+            SLOObjective(name="a", objective="tpot_p95", target=0.1),
+            SLOObjective(name="bad", objective="nope", target=0.2),
+            SLOObjective(name="zero", objective="ttft_p95", target=0.0),
+        ])
+        n = pol.normalized()
+        assert [o.name for o in n.objectives] == ["a"]
+        assert n.objectives[0].objective == "ttft_p95"
+        # unnamed objectives key by their objective string
+        n2 = SLOPolicy(objectives=[SLOObjective(
+            objective="availability", target=0.99)]).normalized()
+        assert n2.objectives[0].name == "availability"
+
+    def test_serde_round_trip(self):
+        from tpu_on_k8s.utils.serde import deep_copy
+
+        svc = _slo_svc(autoscale=None, slo=_slo_policy())
+        svc.status.slo = {"ttft": __import__(
+            "tpu_on_k8s.api.inference_types",
+            fromlist=["SLOObjectiveStatus"]).SLOObjectiveStatus(
+            objective="ttft_p95", target=0.25, state="page",
+            burn_fast=12.5, burn_slow=-1.0, budget_remaining=0.4,
+            stale=False)}
+        copy = deep_copy(svc)
+        assert copy.spec.slo.objectives[0].target == 0.25
+        assert copy.status.slo["ttft"].state == "page"
+        assert copy.status.slo["ttft"].burn_slow == -1.0
+
+
+# ----------------------------------------------------- exposition conformance
+class TestSLOMetricsExposition:
+    def _populate(self, m):
+        m.set_gauge("burn_rate_fast", 2.5, label="svc/ttft")
+        m.set_gauge("burn_rate_slow", 1.1, label="svc/ttft")
+        m.set_gauge("budget_remaining", 0.4, label="svc/ttft")
+        m.set_gauge("budget_state", 2.0, label="svc/ttft")
+        m.set_gauge("slo_stale", 0.0, label="svc/ttft")
+        m.inc("budget_transitions", label="page")
+        m.inc("good_tokens", 100, label="tenant-a")
+        m.inc("degraded_tokens", 7, label="tenant-a")
+        m.inc("rejected_requests", label="tenant-a")
+        m.inc("replayed_requests", label="tenant-a")
+        m.inc("chip_seconds", 12.5, label="tenant-a")
+
+    def test_prometheus_backend(self):
+        import tpu_on_k8s.metrics.metrics as mm
+        if mm._prom is None:
+            pytest.skip("prometheus_client not installed")
+        m = SLOMetrics()
+        self._populate(m)
+        body = exposition(m)
+        assert 'tpu_on_k8s_slo_good_tokens_total{tenant="tenant-a"}' \
+            in body
+        assert 'tpu_on_k8s_slo_burn_rate_fast{slo="svc/ttft"}' in body
+
+    def test_fallback_backend(self, monkeypatch):
+        import tpu_on_k8s.metrics.metrics as mm
+        monkeypatch.setattr(mm, "_prom", None)
+        m = SLOMetrics()
+        assert m.registry is None
+        self._populate(m)
+        body = exposition(m)
+        for fam in m._families.values():
+            full = (fam.full + "_total" if fam.kind == "counter"
+                    and not fam.full.endswith("_total") else fam.full)
+            assert f"# TYPE {full} {fam.kind}" in body
+        assert 'tpu_on_k8s_slo_chip_seconds_total{tenant="tenant-a"} 12.5' \
+            in body
+
+    def test_render_text_deterministic(self, monkeypatch):
+        import tpu_on_k8s.metrics.metrics as mm
+        monkeypatch.setattr(mm, "_prom", None)
+        a, b = SLOMetrics(), SLOMetrics()
+        for m in (a, b):
+            self._populate(m)
+        assert render_text(a) == render_text(b)

@@ -494,6 +494,21 @@ def stage_serve_shard(timeout):
                         "--new-max", "64"], "serve_shard", timeout)
 
 
+def stage_serve_slo(timeout):
+    """The SLO engine's detection race on the flagship config: the
+    seeded regression trace (serve_load --slo) with the burn-rate
+    engine vs the static-threshold control arm — recording detection
+    steps for both, the budget transitions, and the per-tenant
+    good/degraded-token + chip-second accounting (virtual-clock
+    decisions, deterministic regardless of chip speed)."""
+    return _json_stage([sys.executable, "tools/serve_load.py", "--bench",
+                        "--slo", "--n-slots", "8", "--n-requests", "96",
+                        "--rate", "0.4", "--prompt-min", "8",
+                        "--prompt-max", "64", "--slo-target-ttft", "0.2",
+                        "--slo-regress-step", "180",
+                        "--slo-window", "60"], "serve_slo", timeout)
+
+
 def stage_serve_fleet(timeout):
     """The fleet headline (round-5 '#2 missed' decode/serving gap):
     router + 2 replicas on the same seeded trace — aggregate tok/s plus
@@ -526,6 +541,7 @@ STAGES = [
     ("serve_autoscale", stage_serve_autoscale, 1200, ()),
     ("serve_disagg", stage_serve_disagg, 1200, ()),
     ("serve_trace", stage_serve_trace, 1200, ()),
+    ("serve_slo", stage_serve_slo, 1200, ()),
 ]
 
 

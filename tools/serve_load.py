@@ -608,6 +608,271 @@ def _autoscale_main(args, cfg, params, max_len) -> dict:
     return summary
 
 
+def run_slo_trace(args, cfg, params, max_len, *, trace: bool = False) -> dict:
+    """One seeded virtual-clock trace through a ``ServingGateway`` with a
+    latency regression injected mid-run (step costs multiply by
+    ``--slo-regress-factor`` from ``--slo-regress-step`` on), watched by
+    TWO detectors over the same requests:
+
+    * the **burn-rate arm** — the error-budget engine
+      (`tpu_on_k8s/obs/slo.py`): TTFT observations feed sliding windows;
+      the fast 5m/1h-shaped window pair pages when both burn ≥ 14.4× the
+      budget rate (detection = the first ``page``/``exhausted``
+      transition);
+    * the **static-threshold control arm** — what a naive alert does:
+      p95 over the full trailing window crosses the target, sustained
+      ``--slo-static-sustain`` evaluations (the sustain is what keeps a
+      naive alert from flapping — and exactly what makes it slow; the
+      multi-window burn construction gets its flap-resistance for free).
+
+    The ``ServingAccountant`` rides along: per-tenant good vs degraded
+    tokens (served within the TTFT SLO or not) and chip-seconds, folded
+    into the summary. Deterministic per seed: the budget event log
+    byte-compares across runs (``--soak``), and with ``--trace-out`` the
+    page snapshot captures the breaching ``(ttft, trace_id)`` exemplars
+    `tools/slo_report.py` joins back to span trees."""
+    from tpu_on_k8s.metrics.metrics import ServingMetrics, SLOMetrics
+    from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+    from tpu_on_k8s.obs.account import ServingAccountant
+    from tpu_on_k8s.obs.slo import (
+        BUDGET_EXHAUSTED,
+        BUDGET_PAGE,
+        SLOEngine,
+        SLOSpec,
+    )
+    from tpu_on_k8s.serve import AdmissionConfig, Rejected, ServingGateway
+
+    vclock = _VirtualClock()
+    tracer = _make_tracer(args, vclock) if trace else None
+    engine = ContinuousBatchingEngine(cfg, params, n_slots=args.n_slots,
+                                      max_len=max_len,
+                                      step_horizon=args.horizon,
+                                      clock=vclock)
+    metrics = ServingMetrics()
+    gateway = ServingGateway(
+        engine, AdmissionConfig(max_queue_depth=args.queue_bound),
+        metrics=metrics, clock=vclock, tracer=tracer)
+
+    target = args.slo_target_ttft
+    w = args.slo_window
+    slo_metrics = SLOMetrics()
+    # burn windows scaled to the virtual trace: the SRE 5m/1h + 6h/3d
+    # ratios assume a 30-day window — at trace scale the fast-short
+    # window must still cover a few engine steps, or it empties between
+    # arrivals and reads as no-data
+    windows = dict(fast_short_s=w / 60, fast_long_s=w / 20,
+                   slow_short_s=w / 12, slow_long_s=w / 4,
+                   stale_after_s=w)
+    slo = SLOEngine(
+        [SLOSpec(name="ttft", objective="ttft_p95", target=target,
+                 window_s=w, **windows),
+         SLOSpec(name="availability", objective="availability",
+                 target=0.99, window_s=w, **windows)],
+        clock=vclock, metrics=slo_metrics)
+    acct = ServingAccountant(ttft_slo_s=target, metrics=slo_metrics)
+
+    rng = np.random.default_rng(args.seed)
+    arrivals = build_workload(
+        rng, args.n_requests, rate=args.rate,
+        prompt_lens=(args.prompt_min, args.prompt_max),
+        new_tokens=(args.new_min, args.new_max),
+        vocab_size=cfg.vocab_size)
+    by_step: dict = {}
+    for a in arrivals:
+        by_step.setdefault(a.step, []).append(a)
+
+    submit_t: dict = {}
+    tenant_of: dict = {}
+    first_token_t: dict = {}
+    outcomes: dict = {}
+    rejected = 0
+    # static-threshold control arm state: (t, ttft) samples + sustain
+    static_samples: List = []
+    static_streak = 0
+    static_alarm_step = None
+    static_alarm_t = None
+    page_step = None
+    page_t = None
+    page_exemplars: List = []
+    step = 0
+    live = True
+
+    def on_token(rid, _tok):
+        if rid in first_token_t:
+            return
+        first_token_t[rid] = vclock.t
+        ttft = vclock.t - submit_t[rid]
+        slo.observe_latency("ttft", ttft)
+        static_samples.append((vclock.t, ttft))
+
+    while by_step or live:
+        for a in by_step.pop(step, []):
+            r = gateway.submit(a.prompt, a.max_new_tokens, tenant=a.tenant,
+                               priority=a.priority, deadline_s=a.deadline_s,
+                               on_token=on_token)
+            if isinstance(r, Rejected):
+                rejected += 1
+                slo.observe_outcome(False)
+                acct.observe_request(tenant=a.tenant, state="rejected",
+                                     tokens=0)
+            else:
+                submit_t[r] = vclock.t
+                tenant_of[r] = a.tenant
+        # the cost model charges a step's device time BEFORE the step
+        # retires its tokens: a token produced this step has waited this
+        # step's cost, so the injected regression (slower decode steps)
+        # shows up in TTFT exactly as a slower device would
+        vclock.advance(args.step_dt * (args.slo_regress_factor
+                                       if step >= args.slo_regress_step
+                                       else 1.0))
+        for rid in gateway.step():
+            res = gateway.result(rid)
+            if res is None:
+                continue
+            outcomes[rid] = res
+            slo.observe_outcome(res.state.value == "done")
+            acct.observe_request(
+                tenant=tenant_of.get(rid, "default"),
+                state=res.state.value, tokens=len(res.tokens),
+                ttft=(first_token_t[rid] - submit_t[rid]
+                      if rid in first_token_t else None),
+                duration_s=vclock.t - submit_t.get(rid, vclock.t))
+        if step % args.slo_eval_every == 0:
+            statuses = slo.evaluate()
+            st = statuses["ttft"]
+            if page_step is None and st.state in (BUDGET_PAGE,
+                                                  BUDGET_EXHAUSTED):
+                page_step, page_t = step, vclock.t
+                # the page's join key: the retained breaching exemplars
+                # (value, trace_id) at the moment the budget blew —
+                # what `tools/slo_report.py` dereferences to span trees
+                page_exemplars = [
+                    (v, tid) for v, tid in
+                    metrics.exemplars["time_to_first_token_seconds"]
+                    if v > target][-8:]
+            if static_alarm_step is None:
+                recent = [v for t, v in static_samples
+                          if vclock.t - t <= w]
+                from tpu_on_k8s.autoscale.signals import percentile
+                p95 = percentile(recent, 0.95)
+                static_streak = (static_streak + 1
+                                 if p95 is not None and p95 > target
+                                 else 0)
+                if static_streak >= args.slo_static_sustain:
+                    static_alarm_step, static_alarm_t = step, vclock.t
+        live = gateway.queue_depth > 0 or gateway._live()
+        step += 1
+
+    states = [r.state.value for r in outcomes.values()]
+    final = slo.evaluate()
+    summary = {
+        "metric": "slo_trace",
+        "requests": len(arrivals),
+        "served": states.count("done"),
+        "rejected": rejected,
+        "deadline_exceeded": states.count("deadline_exceeded"),
+        "cancelled": states.count("cancelled"),
+        "retry_exhausted": states.count("retry_exhausted"),
+        "tokens": sum(len(r.tokens) for r in outcomes.values()),
+        "driver_steps": step,
+        "virtual_s": round(vclock.t, 6),
+        "slo_target_ttft_s": target,
+        "regress_step": args.slo_regress_step,
+        "burn_page_step": page_step,
+        "burn_page_t": None if page_t is None else round(page_t, 6),
+        "static_alarm_step": static_alarm_step,
+        "static_alarm_t": (None if static_alarm_t is None
+                           else round(static_alarm_t, 6)),
+        "detection_lead_steps": (
+            static_alarm_step - page_step
+            if page_step is not None and static_alarm_step is not None
+            else None),
+        "final_state": {name: st.state for name, st in final.items()},
+        "budget_remaining": {
+            name: round(st.budget_remaining, 6)
+            for name, st in final.items()},
+        "transitions": len(slo.event_log),
+        "accounting": acct.summary(),
+        "page_exemplars": [[round(v, 6), tid]
+                           for v, tid in page_exemplars],
+        "event_log": list(slo.event_log),
+    }
+    _dump_trace(tracer, args, summary)
+    return summary
+
+
+def _slo_main(args, cfg, params, max_len) -> dict:
+    """``--slo``: the burn-rate engine vs the static-threshold control
+    on one seeded regression trace. With ``--soak`` the trace runs TWICE
+    from scratch and the budget event logs must byte-compare, the
+    accounting must balance (every request good/degraded/rejected —
+    token conservation), the burn arm must page BEFORE the static arm,
+    and (with ``--trace-out``) the page must resolve to ≥1 exemplar
+    trace id present in the span dump — ``SLO_SOAK_FAILED seed=N`` on
+    any violation so a red run replays verbatim. ``--slo-out`` writes
+    the budget timeline + page exemplars for `tools/slo_report.py`."""
+    summary = run_slo_trace(args, cfg, params, max_len,
+                            trace=bool(args.trace_out))
+    event_log = summary["event_log"]
+    if args.slo_out:
+        doc = {
+            "format": "tpu-on-k8s-slo/v1",
+            "seed": args.seed,
+            "slo_target_ttft_s": summary["slo_target_ttft_s"],
+            "event_log": event_log,
+            "pages": ([] if summary["burn_page_step"] is None else [{
+                "t": summary["burn_page_t"],
+                "slo": "ttft",
+                "step": summary["burn_page_step"],
+                "exemplars": summary["page_exemplars"],
+            }]),
+            "final_state": summary["final_state"],
+            "budget_remaining": summary["budget_remaining"],
+            "trace_file": args.trace_out or None,
+        }
+        with open(args.slo_out, "w") as f:
+            json.dump(doc, f, sort_keys=True, separators=(",", ":"))
+            f.write("\n")
+        summary["slo_out"] = args.slo_out
+    if args.soak:
+        rerun = run_slo_trace(args, cfg, params, max_len)
+        accounting = summary["accounting"]
+        accounted = (summary["served"] + summary["rejected"]
+                     + summary["deadline_exceeded"] + summary["cancelled"]
+                     + summary["retry_exhausted"])
+        tokens_accounted = (accounting["good_tokens"]
+                            + accounting["degraded_tokens"])
+        replayed = event_log == rerun["event_log"]
+        paged = summary["burn_page_step"] is not None
+        beat_static = (paged and summary["static_alarm_step"] is not None
+                       and summary["burn_page_step"]
+                       < summary["static_alarm_step"])
+        exemplar_ok = True
+        if args.trace_out:
+            from tpu_on_k8s.obs.export import load_trace
+            trace_ids = {s["trace"] for s in load_trace(args.trace_out)}
+            exemplar_ok = any(tid in trace_ids
+                              for _, tid in summary["page_exemplars"]
+                              if tid is not None)
+        ok = (accounted == args.n_requests
+              and tokens_accounted == summary["tokens"]
+              and replayed and paged and beat_static and exemplar_ok)
+        summary["soak_ok"] = ok
+        summary["event_log_replayed"] = replayed
+        summary["page_resolves_exemplar"] = exemplar_ok
+        if not ok:
+            print(json.dumps(summary))
+            print(f"SLO_SOAK_FAILED seed={args.seed} "
+                  f"accounted={accounted}/{args.n_requests} "
+                  f"tokens={tokens_accounted}/{summary['tokens']} "
+                  f"replayed={replayed} paged={paged} "
+                  f"beat_static={beat_static} exemplar={exemplar_ok}")
+            raise SystemExit(1)
+        print(f"SLO_SOAK_OK seed={args.seed}", file=sys.stderr)
+    print(json.dumps(summary))
+    return summary
+
+
 def run_spec_trace(args, cfg, params, max_len, *, spec: bool = True,
                    trace: bool = False) -> dict:
     """One seeded virtual-clock trace through a ``ServingGateway`` whose
@@ -1358,6 +1623,32 @@ def main(argv=None) -> dict:
                    help="draft with the target's first N layers instead "
                         "of the self-draft (--spec): measured acceptance "
                         "instead of the =1 upper bound")
+    # --- SLO burn-rate mode (tpu_on_k8s/obs/slo.py engine) ---
+    p.add_argument("--slo", action="store_true",
+                   help="drive a seeded virtual-clock trace with a "
+                        "latency regression injected mid-run, watched by "
+                        "the error-budget burn-rate engine AND a "
+                        "static-threshold control arm: detection steps "
+                        "both arms, budget event log, per-tenant "
+                        "good/degraded tokens + chip-seconds")
+    p.add_argument("--slo-target-ttft", type=float, default=0.3,
+                   help="TTFT p95 SLO target in virtual seconds (--slo)")
+    p.add_argument("--slo-window", type=float, default=60.0,
+                   help="error-budget compliance window, virtual seconds "
+                        "(--slo); burn windows derive from it")
+    p.add_argument("--slo-regress-step", type=int, default=60,
+                   help="driver step the latency regression begins at")
+    p.add_argument("--slo-regress-factor", type=float, default=6.0,
+                   help="step-cost multiplier once the regression is on")
+    p.add_argument("--slo-eval-every", type=int, default=2,
+                   help="evaluate both detectors every N driver steps")
+    p.add_argument("--slo-static-sustain", type=int, default=3,
+                   help="consecutive breached evaluations the naive "
+                        "static-threshold arm requires before alarming "
+                        "(its flap protection — and its lag)")
+    p.add_argument("--slo-out", default="",
+                   help="write the budget timeline + page exemplars "
+                        "(tools/slo_report.py input) here (--slo)")
     # --- SLO autoscaler mode (tpu_on_k8s/autoscale/ closed loop) ---
     p.add_argument("--autoscale", action="store_true",
                    help="drive a bursty trace through ServingFleet + "
@@ -1438,6 +1729,8 @@ def main(argv=None) -> dict:
 
     if args.shard:
         return _shard_main(args, cfg, params, max_len)
+    if args.slo:
+        return _slo_main(args, cfg, params, max_len)
     if args.spec:
         return _spec_main(args, cfg, params, max_len)
     if args.disagg:

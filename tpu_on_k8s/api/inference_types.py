@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from tpu_on_k8s.api import constants
 from tpu_on_k8s.api.core import ObjectMeta
@@ -219,6 +219,108 @@ class AutoscalePolicy:
             slice_legal=bool(self.slice_legal))
 
 
+#: latency-percentile objectives an ``SLOObjective`` may target (the
+#: ``availability`` objective rides beside them); the catalog matches
+#: `tpu_on_k8s/obs/slo.py` — the engine that evaluates these specs
+SLO_OBJECTIVES = ("ttft_p95", "tpot_p95", "queue_wait_p95",
+                  "availability")
+
+
+@dataclass
+class SLOObjective:
+    """One declarative service-level objective: *what* is measured
+    (``objective``), the ``target`` (seconds for latency percentiles; a
+    fraction like 0.999 for availability), and the compliance
+    ``window_s`` the error budget covers. The four burn windows default
+    to the SRE ratios of ``window_s`` (5m/1h fast pair pages at
+    ``page_burn``; 6h/3d slow pair warns at ``warn_burn`` — at the
+    30-day default) and may be pinned explicitly. ``name`` keys the
+    objective in ``status.slo`` and the metric labels."""
+
+    name: str = ""
+    objective: str = "ttft_p95"
+    target: float = 0.0
+    window_s: float = 2_592_000.0          # 30 days
+    fast_short_s: float = 0.0              # 0 → window_s/8640
+    fast_long_s: float = 0.0               # 0 → window_s/720
+    slow_short_s: float = 0.0              # 0 → window_s/120
+    slow_long_s: float = 0.0               # 0 → window_s/10
+    page_burn: float = 14.4
+    warn_burn: float = 1.0
+    hysteresis: float = 0.2
+
+    def normalized(self) -> Optional["SLOObjective"]:
+        """Defaulted-and-clamped copy, or None when the objective can
+        never evaluate (unknown objective name, non-positive target) —
+        the API layer drops dead objectives rather than raising, the
+        same passive-record posture as the other policies (the engine
+        itself raises; a CRD must tolerate junk)."""
+        if self.objective not in SLO_OBJECTIVES:
+            return None
+        if float(self.target) <= 0 or float(self.window_s) <= 0:
+            return None
+        return SLOObjective(
+            name=str(self.name) or str(self.objective),
+            objective=str(self.objective),
+            target=float(self.target),
+            window_s=float(self.window_s),
+            fast_short_s=max(float(self.fast_short_s), 0.0),
+            fast_long_s=max(float(self.fast_long_s), 0.0),
+            slow_short_s=max(float(self.slow_short_s), 0.0),
+            slow_long_s=max(float(self.slow_long_s), 0.0),
+            page_burn=max(float(self.page_burn), 1.0),
+            warn_burn=max(float(self.warn_burn), 0.0),
+            hysteresis=min(max(float(self.hysteresis), 0.0), 0.9))
+
+
+@dataclass
+class SLOPolicy:
+    """Service-level objectives for a serving fleet, evaluated by the
+    fleet autoscaler's tick (`controller/fleetautoscaler.py` →
+    `tpu_on_k8s/obs/slo.py`): every tick feeds the scraped latency
+    signals into sliding windows, computes multi-window error-budget
+    burn rates per objective, writes the result to ``status.slo``, and
+    — when an objective reaches ``page`` — lets one scale-up bypass the
+    up-cooldown (dead-banded by the budget-state hysteresis, so a burn
+    oscillating at the threshold cannot pump the fleet). Absent, none
+    of this runs and the autoscaler's decision logs are byte-identical
+    to the pre-SLO behavior."""
+
+    objectives: List[SLOObjective] = field(default_factory=list)
+
+    def normalized(self) -> "SLOPolicy":
+        """Drops dead objectives and de-duplicates names (first wins —
+        a duplicate would make ``status.slo`` ambiguous)."""
+        out: List[SLOObjective] = []
+        seen = set()
+        for obj in self.objectives:
+            norm = obj.normalized()
+            if norm is None or norm.name in seen:
+                continue
+            seen.add(norm.name)
+            out.append(norm)
+        return SLOPolicy(objectives=out)
+
+
+@dataclass
+class SLOObjectiveStatus:
+    """One objective's evaluated budget state in ``status.slo``:
+    ``state`` is ``ok``/``warn``/``page``/``exhausted``; burn rates are
+    the multi-window pair burns (-1 = no data in the window — JSON has
+    no NaN, and absent-vs-zero must stay distinguishable on the wire);
+    ``budget_remaining`` is the fraction of the window's error budget
+    left (negative = overdrawn). ``stale`` means the signal source went
+    dark — the burn rates are unknowable, NOT whatever they last were."""
+
+    objective: str = ""
+    target: float = 0.0
+    state: str = "ok"
+    burn_fast: float = -1.0
+    burn_slow: float = -1.0
+    budget_remaining: float = 1.0
+    stale: bool = False
+
+
 @dataclass
 class PoolSpec:
     """One pool of a disaggregated service (`tpu_on_k8s/serve/disagg.py`).
@@ -293,6 +395,11 @@ class InferenceServiceSpec:
     #: ``decode``: a resharding ROLLS the fleet through the same
     #: surge/canary/drain machinery — never a live relayout.
     sharding: Optional[ShardingPolicy] = None
+    #: present = SLO evaluation: the fleet autoscaler's tick runs the
+    #: error-budget burn-rate engine (`tpu_on_k8s/obs/slo.py`) over the
+    #: scraped signals, writes ``status.slo``, and treats a paging
+    #: objective as a scale-up severity hint. Absent ⇒ behavior-neutral.
+    slo: Optional[SLOPolicy] = None
 
 
 class ServicePhase(str, enum.Enum):
@@ -326,6 +433,10 @@ class InferenceServiceStatus:
     #: per-pool committed targets for disaggregated services
     #: (``spec.pools.<pool>.autoscale`` loops) — {"prefill": n, ...}
     pool_desired_replicas: Dict[str, int] = field(default_factory=dict)
+    #: per-objective error-budget state (``spec.slo`` present), written
+    #: by the fleet autoscaler's tick — objective name → burn rates,
+    #: budget remaining, typed state, staleness
+    slo: Dict[str, SLOObjectiveStatus] = field(default_factory=dict)
 
 
 @dataclass

@@ -114,7 +114,14 @@ class Recommender:
                                               direction=-1)
 
     # ------------------------------------------------------------ decision
-    def decide(self, obs: FleetObservation, cur: int, now: float) -> Decision:
+    def decide(self, obs: FleetObservation, cur: int, now: float, *,
+               urgent: bool = False) -> Decision:
+        """``urgent`` is the SLO engine's severity hint (an error-budget
+        objective is PAGING — `tpu_on_k8s/obs/slo.py`): a scale-up that
+        would otherwise sit out the up-cooldown executes immediately,
+        marked ``slo_page`` in the reason. Nothing else changes — the
+        flap guard, max bound, and slice legality all still apply, and
+        the default (False) is byte-for-byte the pre-SLO decision path."""
         p = self.policy
         floor = max(p.min_replicas, p.min_warm)
 
@@ -137,7 +144,7 @@ class Recommender:
 
         up = self._up_reasons(obs)
         if up:
-            return self._scale_up(obs, cur, now, up)
+            return self._scale_up(obs, cur, now, up, urgent=urgent)
         if self._down_ok(obs, cur):
             return self._scale_down(obs, cur, now)
         return Decision(obs.seq, ACTION_HOLD, cur, cur, "steady")
@@ -196,16 +203,21 @@ class Recommender:
         return worst
 
     def _scale_up(self, obs: FleetObservation, cur: int, now: float,
-                  reasons: List[str]) -> Decision:
+                  reasons: List[str], *, urgent: bool = False) -> Decision:
         p = self.policy
         reason = ",".join(reasons)
         if cur >= p.max_replicas:
             return Decision(obs.seq, ACTION_HOLD, cur, cur,
                             f"at_max {reason}")
-        if self._last_up_t is not None \
-                and now - self._last_up_t < p.scale_up_cooldown_s:
+        in_cooldown = (self._last_up_t is not None
+                       and now - self._last_up_t < p.scale_up_cooldown_s)
+        if in_cooldown and not urgent:
             return Decision(obs.seq, ACTION_HOLD, cur, cur,
                             f"up_cooldown {reason}")
+        if in_cooldown:
+            # paged through the cooldown: the reason says so, so the
+            # decision log attributes the early move to the budget burn
+            reason = f"slo_page {reason}"
         if self._last_down_t is not None \
                 and now - self._last_down_t < p.flap_guard_s:
             return Decision(obs.seq, ACTION_HOLD, cur, cur,

@@ -268,33 +268,62 @@ class SignalAggregator:
     consecutive dead scrapes mark the observation **stale** — the
     policy's cue to hold last-known-good. Dead scrapes never evict live
     data from the window (a one-tick outage must not blank the
-    picture); they only advance the staleness streak."""
+    picture); they only advance the staleness streak.
 
-    def __init__(self, window: int = 4, stale_after: int = 3) -> None:
+    ``max_age_s`` adds TIME-based staleness on top of the count-based
+    streak: samples are stamped with the ``now`` the caller passes to
+    ``record``, and samples older than ``max_age_s`` stop contributing.
+    Without it, a clock that jumps past the whole window (a wedged
+    controller thread, a long GC pause, a virtual clock skipping ahead)
+    leaves ancient samples masquerading as fresh — the burn-rate /
+    policy layers would keep acting on a picture that is entirely
+    history. A window that ages out completely is **stale**, never a
+    frozen last-known-good. ``None`` (the default) disables aging —
+    byte-for-byte the previous behavior."""
+
+    def __init__(self, window: int = 4, stale_after: int = 3,
+                 max_age_s: Optional[float] = None) -> None:
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
         if stale_after < 1:
             raise ValueError(f"stale_after must be >= 1, got {stale_after}")
+        if max_age_s is not None and max_age_s <= 0:
+            raise ValueError(f"max_age_s must be > 0, got {max_age_s}")
         self.window = window
         self.stale_after = stale_after
-        self._samples: Deque[FleetSample] = deque(maxlen=window)
+        self.max_age_s = max_age_s
+        # (sample, recorded-at) — the stamp is the caller's clock, None
+        # when the caller never passes one (aging then can't apply)
+        self._samples: Deque[Tuple[FleetSample, Optional[float]]] = deque(
+            maxlen=window)
         self._dead_streak = 0
         self._seq = 0
+        self._now: Optional[float] = None
 
-    def record(self, sample: FleetSample) -> FleetObservation:
+    def record(self, sample: FleetSample,
+               now: Optional[float] = None) -> FleetObservation:
         self._seq = sample.seq
+        if now is not None:
+            self._now = now
         if sample.ok:
             self._dead_streak = 0
-            self._samples.append(sample)
+            self._samples.append((sample, now))
         else:
             self._dead_streak += 1
         return self.observation()
 
+    def _live_samples(self):
+        if self.max_age_s is None or self._now is None:
+            return [s for s, _ in self._samples]
+        return [s for s, t in self._samples
+                if t is None or self._now - t <= self.max_age_s]
+
     def observation(self) -> FleetObservation:
-        ttft = [v for s in self._samples for v in s.ttft]
-        qwait = [v for s in self._samples for v in s.queue_wait]
-        tpot = [v for s in self._samples for v in s.tpot]
-        latest = self._samples[-1] if self._samples else None
+        live = self._live_samples()
+        ttft = [v for s in live for v in s.ttft]
+        qwait = [v for s in live for v in s.queue_wait]
+        tpot = [v for s in live for v in s.tpot]
+        latest = live[-1] if live else None
         stale = self._dead_streak >= self.stale_after or latest is None
         return FleetObservation(
             seq=self._seq,

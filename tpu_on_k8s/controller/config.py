@@ -38,6 +38,12 @@ class JobControllerConfig:
     autoscale_window_scrapes: int = 4
     autoscale_stale_scrapes: int = 3
     autoscale_log_tail: int = 20
+    # Time-based staleness on the scrape window (autoscale/signals.py
+    # SignalAggregator max_age_s): samples older than this stop
+    # contributing, so a clock jump past the whole window surfaces as
+    # STALE instead of acting on ancient data. 0 derives the default —
+    # stale_scrapes worth of tick periods; negative disables aging.
+    autoscale_signal_max_age_s: float = 0.0
     # Consecutive autoscaler ticks tolerating Pending pods at a grown size
     # before reverting (the reference polls up to 1min, elastic_scale.go:440).
     elastic_pending_grace_ticks: int = 2

@@ -44,8 +44,11 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 from tpu_on_k8s import chaos
 from tpu_on_k8s.api import constants
 from tpu_on_k8s.api.core import Pod
-from tpu_on_k8s.api.inference_types import InferenceService
-from tpu_on_k8s.autoscale.policy import ACTION_HOLD, Recommender
+from tpu_on_k8s.api.inference_types import (
+    InferenceService,
+    SLOObjectiveStatus,
+)
+from tpu_on_k8s.autoscale.policy import ACTION_HOLD, ACTION_UP, Recommender
 from tpu_on_k8s.autoscale.signals import (
     FleetSample,
     FleetScraper,
@@ -57,6 +60,7 @@ from tpu_on_k8s.autoscale.signals import (
 from tpu_on_k8s.client.cluster import InMemoryCluster, NotFoundError
 from tpu_on_k8s.controller.config import JobControllerConfig
 from tpu_on_k8s.metrics.metrics import AutoscaleMetrics
+from tpu_on_k8s.obs.slo import SLOEngine, SLOSpec
 from tpu_on_k8s.obs.trace import ensure as ensure_tracer
 from tpu_on_k8s.utils.logging import get_logger
 
@@ -98,6 +102,16 @@ class _ServiceState:
         #: fleet runs its own step counter, so one shared watermark would
         #: permanently blind the scrape to any pod that started later
         self.watermark: Dict[str, int] = {}
+        # --- SLO evaluation (``spec.slo`` present; `obs/slo.py`) ---
+        self.slo_engine: Optional[SLOEngine] = None
+        self.slo_key: Optional[Tuple] = None
+        #: one cooldown bypass per page episode: set when a paging
+        #: objective's urgency executed a scale-up, cleared when no
+        #: objective pages — "bypass the up-cooldown ONCE", dead-banded
+        #: by the budget-state hysteresis
+        self.slo_bypass_used = False
+        #: last rendered status.slo (avoids a status write per tick)
+        self.slo_written: Optional[Dict] = None
 
 
 class FleetAutoscaler:
@@ -108,10 +122,15 @@ class FleetAutoscaler:
                  config: Optional[JobControllerConfig] = None,
                  metrics: Optional[AutoscaleMetrics] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 tracer=None) -> None:
+                 tracer=None, slo_metrics=None) -> None:
         self.cluster = cluster
         self.config = config or JobControllerConfig()
         self.metrics = metrics
+        # the SLO telemetry plane (`metrics.SLOMetrics`): burn-rate /
+        # budget gauges + transition counters for every service whose
+        # spec carries an ``slo`` block. None → mirror-free evaluation
+        # (status.slo still gets written).
+        self.slo_metrics = slo_metrics
         self.clock = clock
         # span producer (`tpu_on_k8s/obs/trace.py`): one
         # ``autoscale.tick`` span per (service|pool) decision, carrying
@@ -133,8 +152,10 @@ class FleetAutoscaler:
     @staticmethod
     def _autoscaled(svc: InferenceService) -> bool:
         """A service participates when its service-level autoscale block
-        is set, or — disaggregated — when either pool carries one."""
-        if svc.spec.autoscale is not None:
+        is set, when it declares SLOs (``spec.slo`` — the tick is what
+        evaluates them and writes ``status.slo``, scaling or not), or —
+        disaggregated — when either pool carries an autoscale block."""
+        if svc.spec.autoscale is not None or svc.spec.slo is not None:
             return True
         pools = svc.spec.pools
         return pools is not None and (
@@ -188,6 +209,12 @@ class FleetAutoscaler:
             ns, name = key.split("/", 1)
             svc = self.cluster.try_get(InferenceService, ns, name)
             if svc is None or not self._autoscaled(svc):
+                if svc is not None:
+                    # the service left the autoscaler's care entirely
+                    # (autoscale AND slo blocks gone): a lingering
+                    # status.slo would be a frozen budget state nobody
+                    # will ever update again
+                    self._clear_slo_status(svc)
                 with self._lock:
                     self._services.pop(key, None)
                 continue
@@ -201,23 +228,166 @@ class FleetAutoscaler:
 
     def _tick(self, key: str, svc: InferenceService,
               state: _ServiceState) -> None:
+        if svc.spec.autoscale is None:
+            # SLO-only service (``spec.slo`` without ``spec.autoscale``):
+            # the tick still scrapes and evaluates — status.slo is the
+            # product — but no scaling decision exists to make
+            with self._tracer.span("autoscale.tick", svc=key) as sp:
+                sample = self._collect(key, svc, state)
+                self._tick_slo(key, svc, state, sample, sp)
+            return
         self._ensure_policy(svc, state)
         if self.metrics is not None:
             self.metrics.inc("ticks")
 
         with self._tracer.span("autoscale.tick", svc=key) as sp:
             sample = self._collect(key, svc, state)
-            obs = state.aggregator.record(sample)
-            cur = max(int(svc.spec.replicas), 0)
             now = self.clock()
-            decision = state.recommender.decide(obs, cur, now)
+            obs = state.aggregator.record(sample, now=now)
+            cur = max(int(svc.spec.replicas), 0)
+            # SLO evaluation rides the same tick: feed the fresh scrape,
+            # evaluate burn rates, publish status.slo, and derive the
+            # severity hint. ``spec.slo`` absent → all of this is a
+            # no-op and the decision path below is byte-identical.
+            urgent = self._tick_slo(key, svc, state, sample, sp)
+            decision = state.recommender.decide(obs, cur, now,
+                                                urgent=urgent)
             sp.set(action=decision.action, current=cur,
                    target=decision.target, stale=obs.stale,
                    queue_depth=obs.queue_depth)
             self._record(key, svc, obs, decision)
             if decision.action == ACTION_HOLD or decision.target == cur:
                 return
+            if urgent and decision.action == ACTION_UP \
+                    and decision.reason.startswith("slo_page"):
+                # the bypass is spent only when it actually pierced a
+                # cooldown (the policy marks those ``slo_page``) — a
+                # scale-up that was free anyway must not burn the one
+                # escape hatch; it re-arms after the page episode clears
+                state.slo_bypass_used = True
             self._execute(key, svc, state, state.recommender, decision, now)
+
+    # ------------------------------------------------------------- SLO plane
+    @staticmethod
+    def _slo_specs(pol) -> List[SLOSpec]:
+        """``spec.slo`` (api ``SLOPolicy``) → engine ``SLOSpec``s. The
+        api layer's ``normalized()`` already dropped dead objectives, so
+        this conversion cannot raise."""
+        return [SLOSpec(
+            name=o.name, objective=o.objective, target=o.target,
+            window_s=o.window_s, fast_short_s=o.fast_short_s,
+            fast_long_s=o.fast_long_s, slow_short_s=o.slow_short_s,
+            slow_long_s=o.slow_long_s, page_burn=o.page_burn,
+            warn_burn=o.warn_burn, hysteresis=o.hysteresis)
+            for o in pol.objectives]
+
+    def _clear_slo_status(self, svc: InferenceService) -> None:
+        """Blank ``status.slo``: a removed (or normalized-to-nothing)
+        policy must not leave a frozen budget state on the CRD — a
+        dashboard reading a months-old ``page`` is the exact
+        frozen-last-known failure mode the engine's staleness bit
+        exists to prevent."""
+        if not svc.status.slo:
+            return
+
+        def mutate(s: InferenceService) -> None:
+            s.status.slo = {}
+        try:
+            self.cluster.update_with_retry(
+                InferenceService, svc.metadata.namespace,
+                svc.metadata.name, mutate, subresource="status")
+        except NotFoundError:
+            pass
+
+    def _ensure_slo(self, key: str, svc: InferenceService,
+                    state: _ServiceState) -> bool:
+        """(Re)build the service's SLO engine when its ``spec.slo``
+        block changes; tear it down — and clear ``status.slo`` — when
+        the block is removed or normalizes to zero live objectives.
+        Returns whether an engine is live. Window contents do not
+        survive a policy edit — stale thresholds interpreting old
+        windows would manufacture transitions no event caused."""
+        pol = svc.spec.slo
+        if pol is None:
+            if state.slo_engine is not None or svc.status.slo:
+                self._clear_slo_status(svc)
+                state.slo_engine = None
+                state.slo_key = None
+                state.slo_bypass_used = False
+                state.slo_written = None
+            return False
+        norm = pol.normalized()
+        skey = tuple(tuple(sorted(vars(o).items()))
+                     for o in norm.objectives)
+        if state.slo_key != skey:
+            state.slo_key = skey
+            state.slo_engine = SLOEngine(
+                self._slo_specs(norm), clock=self.clock,
+                metrics=self.slo_metrics, service=key)
+            state.slo_bypass_used = False
+            state.slo_written = None
+        if not state.slo_engine.evaluators:
+            # every objective was junk: nothing will ever evaluate, so
+            # any previously-published budget state is dead — clear it
+            self._clear_slo_status(svc)
+            return False
+        return True
+
+    def _feed_slo(self, state: _ServiceState, sample: FleetSample) -> None:
+        """One scrape's fresh latency observations into the windows (a
+        dead scrape feeds nothing — its absence is what ages the
+        windows into staleness)."""
+        engine = state.slo_engine
+        if engine is None or not sample.ok:
+            return
+        for kind, values in (("ttft", sample.ttft),
+                             ("queue_wait", sample.queue_wait),
+                             ("tpot", sample.tpot)):
+            for v in values:
+                engine.observe_latency(kind, v)
+
+    def _tick_slo(self, key: str, svc: InferenceService,
+                  state: _ServiceState, sample: FleetSample,
+                  span) -> bool:
+        """The SLO half of a tick: feed → evaluate → publish status.slo
+        → derive the severity hint. Returns True when a non-stale
+        objective is paging AND this page episode has not yet spent its
+        one cooldown bypass."""
+        if not self._ensure_slo(key, svc, state):
+            return False
+        self._feed_slo(state, sample)
+        return self._evaluate_slo(key, svc, state, span)
+
+    def _evaluate_slo(self, key: str, svc: InferenceService,
+                      state: _ServiceState, span) -> bool:
+        """Evaluate every objective, publish ``status.slo`` when it
+        changed, and return the severity hint (see ``_tick_slo``)."""
+        statuses = state.slo_engine.evaluate(span=span)
+        rendered = {
+            name: SLOObjectiveStatus(
+                objective=st.objective, target=st.target, state=st.state,
+                burn_fast=(-1.0 if st.burn_fast is None
+                           else round(st.burn_fast, 4)),
+                burn_slow=(-1.0 if st.burn_slow is None
+                           else round(st.burn_slow, 4)),
+                budget_remaining=round(st.budget_remaining, 4),
+                stale=st.stale)
+            for name, st in statuses.items()}
+        if rendered != state.slo_written:
+            def mutate(s: InferenceService) -> None:
+                s.status.slo = rendered
+            try:
+                self.cluster.update_with_retry(
+                    InferenceService, svc.metadata.namespace,
+                    svc.metadata.name, mutate, subresource="status")
+                state.slo_written = rendered
+            except NotFoundError:
+                pass
+        paging = state.slo_engine.paging(statuses)
+        if not paging:
+            state.slo_bypass_used = False   # episode over: re-arm
+            return False
+        return not state.slo_bypass_used
 
     # ------------------------------------------------------------ pool loops
     def _tick_pools(self, key: str, svc: InferenceService,
@@ -240,9 +410,29 @@ class FleetAutoscaler:
             # per pool, which would make the counter mean different
             # things for pooled vs monolithic services
             self.metrics.inc("ticks")
+        # SLO evaluation in pools mode: EVERY pool's scrape feeds the
+        # ONE service-level engine (the objectives are service SLOs — a
+        # request's TTFT doesn't care which pool served it), evaluated
+        # once per pass below. Pools without an autoscale block are
+        # scraped too — an SLO-only disagg service must not read as
+        # permanently stale just because nothing scales its pools. The
+        # page-urgency hint stays a service-loop concern; pool
+        # recommenders keep their own SLO targets.
+        slo_live = self._ensure_slo(key, svc, state)
         for pool in pools:
             self._tick_one_pool(key, svc, state, pool,
                                 getattr(spec_pools, pool))
+        if slo_live:
+            for pool in ("prefill", "decode"):
+                if pool in pools:
+                    continue        # its decision tick already fed us
+                ps = state.pools.get(pool)
+                if ps is None:
+                    ps = state.pools[pool] = _PoolState()
+                self._feed_slo(state,
+                               self._collect_pool(key, state, pool, ps))
+            with self._tracer.span("slo.evaluate", svc=key) as sp:
+                self._evaluate_slo(key, svc, state, sp)
         if not pools and svc.spec.autoscale is not None:
             # the service registered on its service-level autoscale block,
             # but pools: present hands scaling to the per-pool loops — and
@@ -277,13 +467,15 @@ class FleetAutoscaler:
                 ap, accelerator=svc.spec.tpu_policy.accelerator)
             ps.aggregator = SignalAggregator(
                 window=self.config.autoscale_window_scrapes,
-                stale_after=self.config.autoscale_stale_scrapes)
+                stale_after=self.config.autoscale_stale_scrapes,
+                max_age_s=self._signal_max_age())
 
         with self._tracer.span("autoscale.tick", svc=key, pool=pool) as sp:
             sample = self._collect_pool(key, state, pool, ps)
-            obs = ps.aggregator.record(sample)
-            cur = max(int(pspec.replicas), 1)
+            self._feed_slo(state, sample)
             now = self.clock()
+            obs = ps.aggregator.record(sample, now=now)
+            cur = max(int(pspec.replicas), 1)
             decision = ps.recommender.decide(obs, cur, now)
             sp.set(action=decision.action, current=cur,
                    target=decision.target, stale=obs.stale,
@@ -400,6 +592,20 @@ class FleetAutoscaler:
                              label, decision.target, e)
 
     # --------------------------------------------------------------- signals
+    def _signal_max_age(self) -> Optional[float]:
+        """Scrape-sample age bound for the aggregators: the configured
+        value, a derived default (stale_scrapes worth of tick periods —
+        time-staleness engages exactly when count-staleness would have,
+        had the ticks kept coming), or None (negative config) to
+        disable aging."""
+        cfg = self.config.autoscale_signal_max_age_s
+        if cfg < 0:
+            return None
+        if cfg > 0:
+            return cfg
+        return (self.config.autoscale_stale_scrapes
+                * self.config.serving_autoscale_period_seconds)
+
     def _ensure_policy(self, svc: InferenceService,
                        state: _ServiceState) -> None:
         """(Re)build the recommender/aggregator when the service's
@@ -415,7 +621,8 @@ class FleetAutoscaler:
             ap, accelerator=svc.spec.tpu_policy.accelerator)
         state.aggregator = SignalAggregator(
             window=self.config.autoscale_window_scrapes,
-            stale_after=self.config.autoscale_stale_scrapes)
+            stale_after=self.config.autoscale_stale_scrapes,
+            max_age_s=self._signal_max_age())
 
     def _collect(self, key: str, svc: InferenceService,
                  state: _ServiceState) -> FleetSample:
@@ -571,10 +778,12 @@ def setup_fleet_autoscaler(cluster: InMemoryCluster,
                            config: Optional[JobControllerConfig] = None,
                            metrics: Optional[AutoscaleMetrics] = None,
                            clock: Callable[[], float] = time.monotonic,
-                           tracer=None) -> FleetAutoscaler:
+                           tracer=None,
+                           slo_metrics=None) -> FleetAutoscaler:
     """Wire the autoscaler's service registry to the cluster watch (the
     serving twin of ``setup_elastic_autoscaler``)."""
     scaler = FleetAutoscaler(cluster, config=config, metrics=metrics,
-                             clock=clock, tracer=tracer)
+                             clock=clock, tracer=tracer,
+                             slo_metrics=slo_metrics)
     cluster.watch(scaler.observe_event)
     return scaler

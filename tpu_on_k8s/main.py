@@ -38,7 +38,12 @@ from tpu_on_k8s.controller.tpujob import setup_tpujob_controller
 from tpu_on_k8s.coordinator.core import Coordinator
 from tpu_on_k8s.features import features
 from tpu_on_k8s.gang.scheduler import GANG_SCHEDULER_NAME, default_registry
-from tpu_on_k8s.metrics.metrics import AutoscaleMetrics, JobMetrics, serve
+from tpu_on_k8s.metrics.metrics import (
+    AutoscaleMetrics,
+    JobMetrics,
+    SLOMetrics,
+    serve,
+)
 
 
 def parse_port_range(spec: str) -> Tuple[int, int]:
@@ -292,9 +297,14 @@ class Operator:
         # autoscale series alongside the job series.
         self.autoscale_metrics = AutoscaleMetrics(
             registry=self.metrics.registry)
+        # SLO telemetry plane (obs/slo.py, spec.slo services): burn-rate
+        # / error-budget gauges + per-tenant accounting counters on the
+        # same scrape endpoint
+        self.slo_metrics = SLOMetrics(registry=self.metrics.registry)
         self.fleetautoscaler = setup_fleet_autoscaler(
             self.cluster, config=self.config,
-            metrics=self.autoscale_metrics)
+            metrics=self.autoscale_metrics,
+            slo_metrics=self.slo_metrics)
         self.scheduler_loop = None
         if getattr(args, "enable_slice_scheduler", False):
             from tpu_on_k8s.gang.scheduler import (

@@ -19,6 +19,17 @@ try:  # prometheus_client ships in the image; degrade gracefully anyway
 except ImportError:  # pragma: no cover
     _prom = None
 
+# exemplar-capable Histogram.observe (prometheus_client >= 0.9): detected
+# once — the exemplar rides into the client's bucket storage, so an
+# OpenMetrics-negotiated scrape carries it (classic text-format scrapes
+# ignore it, per the spec)
+if _prom is not None:
+    import inspect as _inspect
+    _PROM_EXEMPLARS = "exemplar" in _inspect.signature(
+        _prom.Histogram.observe).parameters
+else:  # pragma: no cover
+    _PROM_EXEMPLARS = False
+
 _BUCKETS = (0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
 
 
@@ -136,7 +147,19 @@ class _MetricsBase:
                 self.exemplars[name].append((seconds, exemplar))
         h = self._prom_hists.get(name)
         if h is not None:
-            h.observe(seconds)
+            if exemplar is not None and _PROM_EXEMPLARS:
+                # attach the trace id to the client's bucket storage so
+                # an OpenMetrics scrape renders it; an over-long label
+                # value (the client caps exemplars at 128 runes) falls
+                # back to the plain observation — the sample itself must
+                # never be lost to its annotation
+                try:
+                    h.observe(seconds,
+                              exemplar={"trace_id": str(exemplar)})
+                except ValueError:
+                    h.observe(seconds)
+            else:
+                h.observe(seconds)
 
 
 class JobMetrics(_MetricsBase):
@@ -373,7 +396,13 @@ class TrainMetrics(_MetricsBase):
             self._declare(name, f"{ns}_{name}", "counter",
                           f"Training loop {name}")
         for name in ("step_seconds", "tokens_per_sec", "mfu",
-                     "steps_inflight"):
+                     "steps_inflight",
+                     # goodput: productive (novel) step seconds over
+                     # productive + waste (replayed steps, restart/
+                     # recompile gaps, preemption drains) — fed by the
+                     # TrainingAccountant (`tpu_on_k8s/obs/account.py`)
+                     # the TrainLoop carries
+                     "goodput_fraction"):
             self._declare(name, f"{ns}_{name}", "gauge",
                           f"Training loop {name}")
 
@@ -531,6 +560,60 @@ class AutoscaleMetrics(_MetricsBase):
         self.inc("decisions", label=action)
 
 
+class SLOMetrics(_MetricsBase):
+    """The SLO/error-budget telemetry plane (`tpu_on_k8s/obs/slo.py`
+    engine + `obs/account.py` accountants): per-objective multi-window
+    burn-rate gauges (fast pair pages, slow pair warns), the remaining
+    error-budget fraction, the encoded budget state, and the staleness
+    bit — plus the goodput/cost ledger: per-tenant good vs degraded
+    tokens (served within SLO or not), rejected/replayed requests, and
+    chip-seconds attributed through router capacity weights. Same
+    prometheus + plain-dict mirror pattern as the other classes; mirror
+    dicts key by ``(name, label)`` like ``AutoscaleMetrics``."""
+
+    #: budget-state gauge encoding (stable — lands in dashboards);
+    #: mirrors `obs/slo.BUDGET_STATE_CODES`
+    BUDGET_STATE_CODES = {"ok": 0, "warn": 1, "page": 2, "exhausted": 3}
+
+    _SLO_GAUGES = ("burn_rate_fast", "burn_rate_slow", "budget_remaining",
+                   "budget_state", "slo_stale")
+    _STATE_COUNTERS = ("budget_transitions",)
+    _TENANT_COUNTERS = ("good_tokens", "degraded_tokens",
+                        "rejected_requests", "replayed_requests",
+                        "chip_seconds")
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        self.counters: Dict[Tuple[str, str], int] = defaultdict(int)
+        self.gauges: Dict[Tuple[str, str], float] = {}
+        if _prom is not None:
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_slo"
+        for name in self._SLO_GAUGES:
+            self._declare(name, f"{ns}_{name}", "gauge", f"SLO {name}",
+                          labels=("slo",))
+        for name in self._STATE_COUNTERS:
+            self._declare(name, f"{ns}_{name}", "counter", f"SLO {name}",
+                          labels=("state",))
+        for name in self._TENANT_COUNTERS:
+            self._declare(name, f"{ns}_{name}", "counter", f"SLO {name}",
+                          labels=("tenant",))
+
+    def inc(self, name: str, n=1, label: str = "") -> None:
+        with self._lock:
+            self.counters[(name, label)] += n
+        c = self._prom_counters.get(name)
+        if c is not None:
+            c.labels(label).inc(n)
+
+    def set_gauge(self, name: str, value: float, label: str = "") -> None:
+        with self._lock:
+            self.gauges[(name, label)] = value
+        g = self._prom_gauges.get(name)
+        if g is not None:
+            g.labels(label).set(value)
+
+
 def count_detached_callback(metrics, message: str) -> None:
     """The count-and-warn tail shared by every streaming-callback
     isolation site (engine ``on_token``/``on_retire``, gateway and
@@ -574,13 +657,44 @@ def _mirror_entries(mirror: dict, name: str):
     return sorted(out, key=lambda kv: str(kv[0]))
 
 
-def render_text(metrics) -> str:
+def _bucket_exemplars(fam: _Family, exemplars) -> dict:
+    """Bucket index → newest retained ``(value, trace_id)`` exemplar
+    whose value falls inside that bucket's ``(prev, bound]`` range (the
+    OpenMetrics rule: a bucket's exemplar must lie within it). Index
+    ``len(buckets)`` is the ``+Inf`` bucket."""
+    out: dict = {}
+    bounds = fam.buckets or ()
+    for value, trace_id in exemplars:     # oldest → newest: newest wins
+        out[bisect.bisect_left(bounds, value)] = (value, trace_id)
+    return out
+
+
+def _exemplar_suffix(ex) -> str:
+    """The OpenMetrics exemplar clause appended to a bucket sample:
+    ``# {trace_id="..."} value`` (no timestamp — the retained exemplars
+    are value+trace-id pairs, and a wall stamp would break the
+    byte-identical-exposition property deterministic runs rely on)."""
+    if ex is None:
+        return ""
+    value, trace_id = ex
+    return f' # {{trace_id="{_escape_label(str(trace_id))}"}} {_fmt(value)}'
+
+
+def render_text(metrics, *, openmetrics: bool = False) -> str:
     """Pure-Python Prometheus text-format renderer over the mirror dicts
     + declared family schema — what ``exposition()`` falls back to when
     prometheus_client is absent, so a scrape body exists on any image.
     Conformant: counter families carry the ``_total`` suffix, histograms
     render cumulative ``le`` buckets / ``_sum`` / ``_count``, and label
-    values escape backslash, double-quote, and newline."""
+    values escape backslash, double-quote, and newline.
+
+    ``openmetrics=True`` renders the OpenMetrics dialect instead:
+    counter ``# TYPE`` lines use the bare family name (samples keep the
+    ``_total`` suffix), the body ends with ``# EOF``, and histogram
+    bucket samples carry the retained ``(value, trace_id)`` exemplars —
+    the mirror-side deque `observe()` fills is exposition-visible, not a
+    private side channel (exemplars are an OpenMetrics-only construct;
+    the classic format has no legal syntax for them)."""
     with metrics._lock:
         counters = dict(metrics.counters)
         gauges = dict(metrics.gauges)
@@ -588,6 +702,7 @@ def render_text(metrics) -> str:
         hist_sums = dict(metrics.histogram_sums)
         bucket_counts = {k: list(v)
                          for k, v in metrics._bucket_counts.items()}
+        exemplars = {k: list(v) for k, v in metrics.exemplars.items()}
     lines = []
 
     def sample(fname: str, fam: _Family, label, value) -> None:
@@ -600,8 +715,10 @@ def render_text(metrics) -> str:
         if fam.kind == "counter":
             fname = (fam.full if fam.full.endswith("_total")
                      else fam.full + "_total")
-            lines.append(f"# HELP {fname} {_escape_help(fam.help)}")
-            lines.append(f"# TYPE {fname} counter")
+            # OpenMetrics declares the FAMILY (no _total); samples keep it
+            tname = fname[:-len("_total")] if openmetrics else fname
+            lines.append(f"# HELP {tname} {_escape_help(fam.help)}")
+            lines.append(f"# TYPE {tname} counter")
             entries = _mirror_entries(counters, name)
             if not entries and not fam.labels:
                 entries = [("", 0)]       # prom exports unlabeled at 0
@@ -619,30 +736,50 @@ def render_text(metrics) -> str:
             lines.append(f"# HELP {fam.full} {_escape_help(fam.help)}")
             lines.append(f"# TYPE {fam.full} histogram")
             slots = bucket_counts.get(name, [0])
+            by_bucket = (_bucket_exemplars(fam, exemplars.get(name, ()))
+                         if openmetrics else {})
             cum = 0
-            for bound, n in zip(fam.buckets or (), slots):
+            for i, (bound, n) in enumerate(zip(fam.buckets or (), slots)):
                 cum += n
                 lines.append(f'{fam.full}_bucket{{le="{_fmt(bound)}"}} '
-                             f"{_fmt(cum)}")
+                             f"{_fmt(cum)}"
+                             f"{_exemplar_suffix(by_bucket.get(i))}")
             cum += slots[-1]
-            lines.append(f'{fam.full}_bucket{{le="+Inf"}} {_fmt(cum)}')
+            lines.append(f'{fam.full}_bucket{{le="+Inf"}} {_fmt(cum)}'
+                         f"{_exemplar_suffix(by_bucket.get(len(fam.buckets or ())))}")
             lines.append(f"{fam.full}_count "
                          f"{_fmt(hist_counts.get(name, 0))}")
             lines.append(f"{fam.full}_sum "
                          f"{_fmt(hist_sums.get(name, 0.0))}")
+    if openmetrics:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
 
-def exposition(metrics) -> str:
+def exposition(metrics, *, openmetrics: bool = False) -> str:
     """The Prometheus text-format scrape body for any metrics instance
     (what ``serve()``'s endpoint returns) — separated out so tests and
     push-style exporters can render without binding a port. With
     prometheus_client importable this is its canonical rendering; without
     it, the `render_text` fallback over the mirrors + declared schema —
-    never a RuntimeError, an image without the client still scrapes."""
+    never a RuntimeError, an image without the client still scrapes.
+
+    ``openmetrics=True`` is the exemplar-carrying dialect (what a scrape
+    negotiating ``application/openmetrics-text`` gets): the prometheus
+    backend renders through the client's OpenMetrics exposition (the
+    exemplars `observe()` attached ride its bucket storage), the
+    fallback through ``render_text(openmetrics=True)`` over the
+    mirror-side exemplar deques — BOTH backends surface the retained
+    ``(value, trace_id)`` pairs on histogram buckets."""
     if _prom is not None and metrics.registry is not None:
+        if openmetrics:
+            from prometheus_client.openmetrics import (
+                exposition as _om_exposition,
+            )
+            return _om_exposition.generate_latest(
+                metrics.registry).decode()
         return _prom.generate_latest(metrics.registry).decode()
-    return render_text(metrics)
+    return render_text(metrics, openmetrics=openmetrics)
 
 
 def serve(metrics, port: int = 8443):  # pragma: no cover - live mode

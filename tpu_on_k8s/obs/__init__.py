@@ -3,7 +3,12 @@
 * `trace`  — ``Tracer`` / ``Span``: counter-derived ids, injectable-clock
   timestamps, the ``NOOP`` disabled tracer (bit-for-bit behavior-neutral);
 * `export` — Chrome trace-event / Perfetto rendering + the
-  ``FlightRecorder`` crash ring buffer.
+  ``FlightRecorder`` crash ring buffer;
+* `slo`    — the SLO engine: declarative ``SLOSpec`` objectives evaluated
+  over sliding windows into multi-window error-budget burn rates and
+  typed ``ok/warn/page/exhausted`` budget states;
+* `account` — goodput + cost accounting: per-tenant good/degraded tokens
+  and chip-seconds (serving), productive-vs-waste step time (training).
 
 Span producers: `serve/gateway.py`, `serve/fleet.py`, `serve/disagg.py`
 (per-request lifecycle), `controller/fleetautoscaler.py` +
@@ -13,11 +18,26 @@ Span producers: `serve/gateway.py`, `serve/fleet.py`, `serve/disagg.py`
 
 Stdlib-only, like `chaos/` — importable from any layer.
 """
+from tpu_on_k8s.obs.account import (
+    ServingAccountant,
+    TrainingAccountant,
+    goodput_from_spans,
+)
 from tpu_on_k8s.obs.export import (
     FlightRecorder,
     dump_chrome_trace,
     load_trace,
     to_chrome_trace,
+)
+from tpu_on_k8s.obs.slo import (
+    BUDGET_EXHAUSTED,
+    BUDGET_OK,
+    BUDGET_PAGE,
+    BUDGET_WARN,
+    SLOEngine,
+    SLOEvaluator,
+    SLOSpec,
+    SLOStatus,
 )
 from tpu_on_k8s.obs.trace import (
     NOOP,
@@ -31,16 +51,27 @@ from tpu_on_k8s.obs.trace import (
 )
 
 __all__ = [
+    "BUDGET_EXHAUSTED",
+    "BUDGET_OK",
+    "BUDGET_PAGE",
+    "BUDGET_WARN",
     "FlightRecorder",
     "NOOP",
     "NOOP_SPAN",
     "STATUS_ERROR",
     "STATUS_OK",
+    "SLOEngine",
+    "SLOEvaluator",
+    "SLOSpec",
+    "SLOStatus",
+    "ServingAccountant",
     "Span",
     "TRACE_FORMAT",
     "Tracer",
+    "TrainingAccountant",
     "dump_chrome_trace",
     "ensure",
+    "goodput_from_spans",
     "load_trace",
     "to_chrome_trace",
 ]

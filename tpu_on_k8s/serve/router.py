@@ -107,6 +107,14 @@ class Router:
             raise ValueError(f"chips must be >= 1, got {chips}")
         self._capacity[name] = float(chips)
 
+    def capacity_of(self, name: str) -> float:
+        """``name``'s declared chip count (1.0 when never declared) —
+        the capacity weight the cost accountant
+        (`tpu_on_k8s/obs/account.ServingAccountant`) attributes
+        chip-seconds with, read from the same source the bounded-load
+        comparison uses."""
+        return self._capacity.get(name, 1.0)
+
     def _load(self, name: str, outstanding: Mapping[str, int]) -> float:
         return outstanding.get(name, 0) / self._capacity.get(name, 1.0)
 

@@ -132,6 +132,14 @@ class TrainLoop:
         inputs (``flops_per_step`` from ``compile.train_step_flops``).
       tracer: optional ``obs.Tracer`` — one ``train.window`` span per
         host-sync window (step range, loss, step time).
+      accountant: optional ``obs.account.TrainingAccountant`` — the
+        goodput ledger: each sync window reports its (novel vs
+        replayed) step time, and the run's close attributes the
+        residual wall time as preempt/overhead waste; the accountant
+        publishes the ``TrainMetrics`` ``goodput_fraction`` gauge. An
+        orchestrator that restarts a preempted job calls
+        ``accountant.resume(checkpoint_step)`` between incarnations so
+        re-executed steps count as replay waste, not progress.
       profile_dir / profiler_port / annotate_steps: the
         `utils/profiling.py` hooks — capture an XLA trace of the run
         into ``profile_dir``, serve the live profiler on
@@ -159,6 +167,7 @@ class TrainLoop:
                  flops_per_step: float = 0.0,
                  peak_flops: float = 0.0,
                  tracer: Any = None,
+                 accountant: Any = None,
                  profile_dir: Optional[str] = None,
                  profiler_port: Optional[int] = None,
                  annotate_steps: Optional[bool] = None):
@@ -191,6 +200,10 @@ class TrainLoop:
         # hot path the loop exists to keep empty
         self._tracer = ensure_tracer(tracer)
         self._window_span: Any = None
+        # goodput ledger (`tpu_on_k8s/obs/account.py`): fed from the
+        # quantities the loop already measures — no new clock reads on
+        # the hot path, and None is a strict no-op
+        self.accountant = accountant
         # profiling hooks (`tpu_on_k8s/utils/profiling.py`), previously
         # dead code: the operator's ``--profile-dir``/``--profiler-port``
         # flags inject ENV_PROFILE_DIR / ENV_PROFILER_PORT into slice
@@ -410,6 +423,12 @@ class TrainLoop:
                 self._watchdog = None
         result.state = self.state
         result.seconds = time.perf_counter() - t0
+        if self.accountant is not None:
+            # close the goodput ledger for this run: wall time the
+            # windows didn't account (compile, checkpoint drains, the
+            # preemption save) is waste, attributed by how the run ended
+            self.accountant.run_complete(result.seconds,
+                                         preempted=result.preempted)
         return result
 
     # ------------------------------------------------------------- windows
@@ -451,6 +470,11 @@ class TrainLoop:
                    if isinstance(loss, float) else {}))
             self._window_span.finish()
             self._window_span = None
+        if self.accountant is not None:
+            # novel steps are productive, re-executed ones (a resume
+            # replaying past the last checkpoint) are replay waste —
+            # the accountant tells them apart by the global step
+            self.accountant.window(step, window_steps, step_seconds)
         if self.metrics is not None:
             m = self.metrics
             m.inc("host_syncs")
