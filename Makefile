@@ -7,7 +7,7 @@
 IMG ?= tpu-on-k8s/manager:latest
 
 .PHONY: test test-fast analyze lint chaos-soak fleet-soak autoscale-soak \
-        disagg-soak spec-soak shard-soak slo-soak trace-demo native bench dryrun manager samples clean \
+        disagg-soak spec-soak shard-soak slo-soak reshard-soak trace-demo native bench dryrun manager samples clean \
         docker-build docker-push deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
@@ -19,6 +19,7 @@ DISAGG_SEED ?= 8642
 SPEC_SEED ?= 7531
 SHARD_SEED ?= 1357
 SLO_SEED ?= 9753
+RESHARD_SEED ?= 6172
 TRACE_SEED ?= 8642
 TRACE_FLAGS = --disagg --n-requests 24 --prefix-bucket 8 --prompt-min 4 \
     --prompt-max 12 --new-min 4 --new-max 8 --decode-replicas 2 \
@@ -79,6 +80,10 @@ slo-soak:  ## burn-rate SLO engine vs static-threshold control on a seeded regre
 	    --slo-out /tmp/tpu_on_k8s_slo_budget.json --seed $(SLO_SEED)
 	python tools/slo_report.py /tmp/tpu_on_k8s_slo_budget.json \
 	    /tmp/tpu_on_k8s_slo_trace.json --check
+
+reshard-soak:  ## live mesh reshard vs checkpoint-restart on the seeded cost model, twice: byte-identical event logs + pause & goodput wins
+	JAX_PLATFORMS=cpu python tools/reshard_soak.py --seed $(RESHARD_SEED) \
+	    --repeat 2
 
 trace-demo:  ## seeded disagg trace dumped twice: byte-identical span dumps + the TTFT critical-path report
 	JAX_PLATFORMS=cpu python tools/serve_load.py $(TRACE_FLAGS) \

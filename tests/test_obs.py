@@ -13,7 +13,7 @@ Pins the ISSUE 7 contracts:
   sum to the measured TTFT exactly under a virtual clock;
 * `metrics.exposition` — never a RuntimeError without prometheus_client:
   the pure-Python fallback renders a parseable, correctly escaped
-  text-format body for all six metrics classes;
+  text-format body for every metrics class;
 * the resilience.md chaos-site table stays complete against `SITE_*`.
 """
 from __future__ import annotations
@@ -36,6 +36,7 @@ from tpu_on_k8s.metrics.metrics import (
     AutoscaleMetrics,
     FleetMetrics,
     JobMetrics,
+    ReshardMetrics,
     ServingMetrics,
     ShardMetrics,
     SLOMetrics,
@@ -517,10 +518,17 @@ def _populate(m):
         m.inc("budget_transitions", label="page")
         m.inc("good_tokens", 64, label="tenant-a")
         m.inc("chip_seconds", 3.5, label="tenant-a")
+    elif isinstance(m, ReshardMetrics):
+        m.inc("reshards")
+        m.inc("bytes_moved", 4096)
+        m.inc("reshard_fallbacks")
+        m.inc("reshard_ack_failures")
+        m.set_gauge("transform_seconds", 0.8)
 
 
 _ALL_CLASSES = (JobMetrics, ServingMetrics, SpecMetrics, TrainMetrics,
-                FleetMetrics, AutoscaleMetrics, ShardMetrics, SLOMetrics)
+                FleetMetrics, AutoscaleMetrics, ShardMetrics, SLOMetrics,
+                ReshardMetrics)
 
 
 class TestExposition:

@@ -1,0 +1,873 @@
+"""Live mesh reconfiguration (`tpu_on_k8s/parallel/reshard.py`) — ISSUE 13.
+
+The acceptance oracle is Tenplex's consistency claim: a mid-run 2→4→2
+reshard of params + optimizer state (including a {data, fsdp}→{data,
+model} rule change) yields a loss trajectory BIT-IDENTICAL to an
+uninterrupted fixed-mesh run on CPU meshes.
+
+The oracle harness shards state STORAGE and gathers for compute
+(ZeRO-style: gather → identical replicated step → scatter), which makes
+the per-step math mesh-shape-independent bitwise — so the oracle
+isolates exactly what the reshard layer owns (the state transform) from
+what it does not (XLA cross-device reduction order, which legitimately
+differs between mesh shapes; the sharded-compute case is covered by the
+existing restore-onto-different-mesh test at allclose tolerance).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_on_k8s import chaos
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.chaos import scenarios
+from tpu_on_k8s.gang import topology
+from tpu_on_k8s.metrics.metrics import ReshardMetrics, TrainMetrics
+from tpu_on_k8s.obs.account import TrainingAccountant
+from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+from tpu_on_k8s.parallel.partition import (
+    PartitionRule,
+    ShardingValidationError,
+    named_sharding,
+    shard_pytree,
+)
+from tpu_on_k8s.parallel.reshard import (
+    ReshardAgent,
+    ReshardNotice,
+    plan_reshard,
+    reshard_state,
+    restore_resharded,
+)
+from tpu_on_k8s.train.checkpoint import CheckpointManager
+from tpu_on_k8s.train.loop import TrainLoop
+
+# ---------------------------------------------------------------- harness
+RULES_FSDP = [PartitionRule(r"w1$", P(("data", "fsdp"), None)),
+              PartitionRule(r"w2$", P("fsdp", None))]
+RULES_MODEL = [PartitionRule(r"w1$", P(None, "model")),
+               PartitionRule(r"w2$", P(None, "model"))]
+
+_OPT = optax.adam(1e-2)
+
+
+def mesh_of(n, **axes):
+    return create_mesh(MeshConfig(**{**dict(data=1, fsdp=1, model=1, seq=1),
+                                     **axes}), jax.devices()[:n])
+
+
+def init_state(seed=0):
+    r = np.random.default_rng(seed)
+    params = {"w1": jnp.asarray(r.normal(size=(8, 16)), jnp.float32),
+              "w2": jnp.asarray(r.normal(size=(16, 4)), jnp.float32)}
+    return {"params": params, "opt": _OPT.init(params)}
+
+
+def _loss(params, batch):
+    x, y = batch
+    h = jnp.tanh(x @ params["w1"])
+    return jnp.mean((h @ params["w2"] - y) ** 2)
+
+
+@jax.jit
+def _compute(state, batch):
+    """The replicated step body: identical on every mesh because its
+    inputs and outputs carry no sharding — gather/scatter live outside."""
+    loss, grads = jax.value_and_grad(_loss)(state["params"], batch)
+    updates, opt = _OPT.update(grads, state["opt"], state["params"])
+    return ({"params": optax.apply_updates(state["params"], updates),
+             "opt": opt}, {"loss": loss})
+
+
+def make_step(mesh, rules, state_tree):
+    """Storage-sharded / compute-gathered step: gather(state) →
+    replicated compute → scatter back onto (mesh, rules)."""
+    shardings = named_sharding(state_tree, mesh, rules)
+    repl = jax.tree.map(lambda _: NamedSharding(mesh, P()), state_tree)
+    gather = jax.jit(lambda s: s, out_shardings=repl)
+    scatter = jax.jit(lambda s: s, out_shardings=shardings, donate_argnums=0)
+
+    def step(state, batch):
+        out, m = _compute(gather(state), batch)
+        return scatter(out), m
+
+    return step
+
+
+def batch_at(i, seed=7):
+    r = np.random.default_rng((seed, i))
+    return (jnp.asarray(r.normal(size=(8, 8)), jnp.float32),
+            jnp.asarray(r.normal(size=(8, 4)), jnp.float32))
+
+
+def run_fixed(n_dev, rules, steps, *, seed=0, **axes):
+    mesh = mesh_of(n_dev, **axes)
+    state = shard_pytree(init_state(seed), mesh, rules)
+    step = make_step(mesh, rules, state)
+    losses = []
+    for i in range(steps):
+        state, m = step(state, batch_at(i))
+        losses.append(float(m["loss"]))
+    return losses, jax.device_get(state)
+
+
+# ------------------------------------------------------------------- plans
+class TestPlan:
+    def test_plan_counts_moved_leaves_and_bytes(self):
+        mesh2 = mesh_of(2, fsdp=2)
+        mesh4 = mesh_of(4, data=2, model=2)
+        state = shard_pytree(init_state(), mesh2, RULES_FSDP)
+        plan = plan_reshard(state, mesh2, RULES_FSDP, mesh4, RULES_MODEL)
+        n_leaves = len(jax.tree.leaves(state))
+        assert len(plan.moves) == n_leaves
+        # every leaf moves: the device set changed
+        assert plan.n_moved == n_leaves
+        assert plan.bytes_moved == sum(l.nbytes
+                                       for l in jax.tree.leaves(state))
+        assert "reshard fsdp=2 -> data=2,model=2" in plan.describe()
+
+    def test_identity_plan_moves_nothing(self):
+        mesh2 = mesh_of(2, fsdp=2)
+        state = shard_pytree(init_state(), mesh2, RULES_FSDP)
+        plan = plan_reshard(state, mesh2, RULES_FSDP, mesh2, RULES_FSDP)
+        assert plan.n_moved == 0 and plan.bytes_moved == 0
+
+    def test_axis_size_swap_on_same_devices_counts_as_moved(self):
+        """Same device set, same spec NAMES, different axis sizes
+        ({data:2, fsdp:4} -> {data:4, fsdp:2}): the shards relay, so the
+        plan must price it — sharding equivalence, not spec-string
+        equality, decides ``moved``."""
+        mesh_a = mesh_of(8, data=2, fsdp=4)
+        mesh_b = mesh_of(8, data=4, fsdp=2)
+        rules = [PartitionRule(r"w1$|w2$", P("fsdp", None))]
+        state = shard_pytree(init_state(), mesh_a, rules)
+        plan = plan_reshard(state, mesh_a, rules, mesh_b, rules)
+        sharded = [m for m in plan.moves if "w" in m.path]
+        assert sharded and all(m.moved for m in sharded)
+        assert plan.bytes_moved > 0
+
+    def test_illegal_destination_fails_before_any_move(self):
+        """An indivisible dst shape raises ShardingValidationError naming
+        the param path and mesh axis — at PLAN time, before a byte
+        moves (the state keeps its source sharding untouched)."""
+        mesh2 = mesh_of(2, fsdp=2)
+        mesh3 = create_mesh(MeshConfig(data=1, fsdp=1, model=3, seq=1),
+                            jax.devices()[:3])
+        bad = [PartitionRule(r"w1$|w2$", P("model", None))]  # 8 % 3 != 0
+        state = shard_pytree(init_state(), mesh2, RULES_FSDP)
+        with pytest.raises(ShardingValidationError) as ei:
+            plan_reshard(state, mesh2, RULES_FSDP, mesh3, bad)
+        msg = str(ei.value)
+        assert "w2" in msg or "w1" in msg
+        assert "model" in msg and "not divisible" in msg
+        # untouched: still the source layout on the source mesh
+        assert state["params"]["w1"].sharding.spec == P(("data", "fsdp"),
+                                                        None)
+
+    def test_reshard_state_round_trip_is_bit_exact(self):
+        mesh2 = mesh_of(2, fsdp=2)
+        mesh4 = mesh_of(4, data=2, model=2)
+        state = shard_pytree(init_state(), mesh2, RULES_FSDP)
+        host_before = jax.device_get(state)
+        moved, plan = reshard_state(state, mesh2, RULES_FSDP,
+                                    mesh4, RULES_MODEL, donate=False)
+        assert plan.n_moved > 0
+        assert moved["params"]["w1"].sharding.spec == P(None, "model")
+        assert len(moved["params"]["w1"].sharding.device_set) == 4
+        back, _ = reshard_state(moved, mesh4, RULES_MODEL,
+                                mesh2, RULES_FSDP, donate=False)
+        for a, b in zip(jax.tree.leaves(host_before),
+                        jax.tree.leaves(jax.device_get(back))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------------------ oracle
+class TestBitExactOracle:
+    """ISSUE 13 acceptance: 2→4→2 mid-run reshard (params + optimizer
+    state, {data,fsdp}→{data,model} rule change included) == the
+    uninterrupted fixed-mesh trajectory, bit for bit."""
+
+    STEPS = 9
+    UP_AT = 3     # before step index 3: 2 -> 4 devices, rule change
+    DOWN_AT = 6   # before step index 6: 4 -> 2 devices, rules back
+
+    def _resharded_run(self, via_checkpoint, tmp_path=None):
+        mesh2, mesh4 = mesh_of(2, fsdp=2), mesh_of(4, data=2, model=2)
+        state = shard_pytree(init_state(), mesh2, RULES_FSDP)
+        step = make_step(mesh2, RULES_FSDP, state)
+        losses = []
+        schedule = {self.UP_AT: (mesh2, RULES_FSDP, mesh4, RULES_MODEL),
+                    self.DOWN_AT: (mesh4, RULES_MODEL, mesh2, RULES_FSDP)}
+        for i in range(self.STEPS):
+            hop = schedule.get(i)
+            if hop is not None:
+                src_mesh, src_rules, dst_mesh, dst_rules = hop
+                if via_checkpoint:
+                    # the across-restarts arm: save under the source
+                    # layout, restore DIRECTLY into the target sharding
+                    mgr = CheckpointManager(str(tmp_path / f"gen{i}"))
+                    mgr.save(state, step=i, generation=i)
+                    state, _, _ = restore_resharded(mgr, state, dst_mesh,
+                                                    dst_rules)
+                    mgr.close()
+                else:
+                    state, plan = reshard_state(state, src_mesh, src_rules,
+                                                dst_mesh, dst_rules)
+                    assert plan.n_moved > 0
+                step = make_step(dst_mesh, dst_rules, state)
+            state, m = step(state, batch_at(i))
+            losses.append(float(m["loss"]))
+        return losses, jax.device_get(state)
+
+    def test_live_reshard_trajectory_bit_identical(self):
+        fixed_losses, fixed_state = run_fixed(2, RULES_FSDP, self.STEPS,
+                                              fsdp=2)
+        live_losses, live_state = self._resharded_run(via_checkpoint=False)
+        assert live_losses == fixed_losses, (
+            f"live-reshard trajectory diverged:\n{live_losses}\nvs fixed\n"
+            f"{fixed_losses}")
+        # optimizer state included: every leaf (params, mu, nu, count)
+        # bit-equal at the end
+        for a, b in zip(jax.tree.leaves(fixed_state),
+                        jax.tree.leaves(live_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_checkpoint_restart_reshard_trajectory_bit_identical(self,
+                                                                 tmp_path):
+        """The same oracle through the restart arm: CheckpointManager
+        restoring directly into the target NamedSharding."""
+        fixed_losses, _ = run_fixed(2, RULES_FSDP, self.STEPS, fsdp=2)
+        ckpt_losses, _ = self._resharded_run(via_checkpoint=True,
+                                             tmp_path=tmp_path)
+        assert ckpt_losses == fixed_losses
+
+
+# ------------------------------------------------------------- train loop
+class TestTrainLoopReshard:
+    def _notices(self, generation=None):
+        mesh2, mesh4 = mesh_of(2, fsdp=2), mesh_of(4, data=2, model=2)
+        builder4 = lambda mesh, st: make_step(mesh, RULES_MODEL, st)  # noqa: E731
+        builder2 = lambda mesh, st: make_step(mesh, RULES_FSDP, st)  # noqa: E731
+        return (mesh2, mesh4,
+                [ReshardNotice(mesh2, RULES_FSDP, mesh4, RULES_MODEL,
+                               step_builder=builder4, generation=generation,
+                               tag="up"),
+                 ReshardNotice(mesh4, RULES_MODEL, mesh2, RULES_FSDP,
+                               step_builder=builder2, tag="down")])
+
+    def _run_loop(self, steps=9, up_at=4, down_at=7, **loop_kwargs):
+        """A TrainLoop whose reshard_signal delivers 2→4 before step
+        ``up_at`` and 4→2 before ``down_at`` (1-based loop steps)."""
+        mesh2, mesh4, notices = self._notices(
+            generation=loop_kwargs.pop("reshard_generation", None))
+        if loop_kwargs.pop("use_up_only", False):
+            notices = notices[:1]
+        state = shard_pytree(init_state(), mesh2, RULES_FSDP)
+        step = make_step(mesh2, RULES_FSDP, state)
+        fired = {"n": 0}
+
+        def signal():
+            fired["n"] += 1
+            if fired["n"] == up_at:
+                return notices[0]
+            if len(notices) > 1 and fired["n"] == down_at:
+                return notices[1]
+            return None
+
+        batches = (batch_at(i) for i in range(steps))
+        loop = TrainLoop(step, state, batches, reshard_signal=signal,
+                         **loop_kwargs)
+        return loop.run(steps)
+
+    def test_run_never_exits_and_counts_global_steps(self):
+        result = self._run_loop(log_every=1)
+        assert result.steps == 9 and not result.preempted
+        assert result.reshards == 2 and not result.reshard_fallback
+        assert [s for s, _ in result.history] == list(range(1, 10))
+        # the loss trajectory equals the uninterrupted fixed-mesh run —
+        # the loop-integrated version of the oracle
+        fixed_losses, _ = run_fixed(2, RULES_FSDP, 9, fsdp=2)
+        assert [h["loss"] for _, h in result.history] == fixed_losses
+
+    def test_pause_attributed_to_reshard_not_restart(self):
+        tmetrics = TrainMetrics(registry=None)
+        rmetrics = ReshardMetrics(registry=None)
+        acct = TrainingAccountant(metrics=tmetrics)
+        result = self._run_loop(log_every=3, accountant=acct,
+                                metrics=tmetrics, reshard_metrics=rmetrics)
+        assert result.reshards == 2
+        assert acct.waste_s["reshard"] > 0
+        assert acct.waste_s["restart"] == 0 and acct.waste_s["preempt"] == 0
+        assert 0 < acct.goodput_fraction() < 1
+        assert tmetrics.gauges["goodput_fraction"] == pytest.approx(
+            acct.goodput_fraction())
+        assert rmetrics.counters["reshards"] == 2
+        assert rmetrics.counters["bytes_moved"] > 0
+        assert rmetrics.gauges["transform_seconds"] > 0
+        assert rmetrics.counters.get("reshard_fallbacks", 0) == 0
+
+    def test_reshard_span_on_the_trace_timeline(self):
+        import time as _time
+
+        from tpu_on_k8s.obs import Tracer
+        tracer = Tracer(_time.monotonic)
+        self._run_loop(log_every=2, tracer=tracer)
+        spans = [s for s in tracer.export() if s["name"] == "train.reshard"]
+        assert len(spans) == 2
+        assert [s["attrs"]["tag"] for s in spans] == ["up", "down"]
+        assert all(s["status"] == "ok" for s in spans)
+        assert all(s["attrs"]["bytes_moved"] > 0 for s in spans)
+        # windows keep flowing around the reshard — one timeline (the
+        # partial window the first reshard drained adds a sixth)
+        assert [s["name"] for s in tracer.export()].count("train.window") \
+            == 6
+
+    def test_pending_window_and_saves_drain_before_transform(self):
+        drains = []
+
+        class Mgr:
+            def save(self, state, *, step, generation=0, wait=True):
+                drains.append(("save", step, generation, wait))
+
+            def wait_until_finished(self):
+                drains.append(("drain",))
+
+        result = self._run_loop(log_every=10, checkpoint_manager=Mgr(),
+                                checkpoint_every=2, use_up_only=True,
+                                up_at=4, reshard_generation=5)
+        # the reshard (before loop step 4) synced the 3-step partial
+        # window and drained pending saves BEFORE transforming
+        assert drains[0] == ("save", 2, 0, False)
+        assert drains[1] == ("drain",)
+        assert [s for s, _ in result.history][0] == 3
+        # post-reshard saves land in the notice's generation
+        assert ("save", 4, 5, False) in drains
+
+    def test_abort_falls_back_to_checkpoint_restart_uncorrupted(
+            self, tmp_path):
+        """Chaos ReshardAbort mid-transform: the loop counts the
+        fallback, exits via the preemption path with the INTACT source
+        state, and checkpoint-resume reproduces the no-fault trajectory
+        bit-for-bit — zero state corruption."""
+        fixed_losses, _ = run_fixed(2, RULES_FSDP, 9, fsdp=2)
+        mgr = CheckpointManager(str(tmp_path))
+        rmetrics = ReshardMetrics(registry=None)
+        mesh2, _, notices = self._notices()
+        state = shard_pytree(init_state(), mesh2, RULES_FSDP)
+        step = make_step(mesh2, RULES_FSDP, state)
+        fired = {"n": 0}
+
+        def signal():
+            fired["n"] += 1
+            return notices[0] if fired["n"] == 4 else None
+
+        failed = []
+        notices[0].on_failed = lambda: failed.append(True)
+        scenario = scenarios.live_reshard_abort(at_transform=1)
+        batches = (batch_at(i) for i in range(9))
+        loop = TrainLoop(step, state, batches, log_every=1,
+                         reshard_signal=signal, reshard_metrics=rmetrics,
+                         checkpoint_manager=mgr)
+        inj = scenario.injector()
+        with inj:
+            result = loop.run(9)
+        assert inj.fired_total() == 1
+        assert "reshard_abort" in inj.events[0]
+        assert result.reshard_fallback and result.preempted
+        assert result.steps == 3 and result.reshards == 0
+        assert rmetrics.counters["reshard_fallbacks"] == 1
+        assert failed == [True]
+        # the preemption path saved the intact pre-transform state:
+        # resume reproduces the no-fault trajectory exactly
+        restored, gen, at = restore_resharded(
+            mgr, init_state(), mesh_of(2, fsdp=2), RULES_FSDP)
+        assert at == 3
+        resumed_step = make_step(mesh_of(2, fsdp=2), RULES_FSDP, restored)
+        resumed = TrainLoop(resumed_step, restored,
+                            (batch_at(i) for i in range(3, 9)),
+                            log_every=1).run(6)
+        stitched = [h["loss"] for _, h in result.history] + \
+            [h["loss"] for _, h in resumed.history]
+        assert stitched == fixed_losses
+        mgr.close()
+
+    def test_failed_ack_does_not_kill_the_run(self):
+        """The ack is a control-plane write: a transform that succeeded
+        must survive its ack raising — warned and counted
+        (``reshard_ack_failures``), run completes normally."""
+        rmetrics = ReshardMetrics(registry=None)
+        mesh2, _, notices = self._notices()
+        notices[0].on_applied = lambda: (_ for _ in ()).throw(
+            ConnectionResetError("apiserver blipped"))
+        state = shard_pytree(init_state(), mesh2, RULES_FSDP)
+        step = make_step(mesh2, RULES_FSDP, state)
+        fired = {"n": 0}
+
+        def signal():
+            fired["n"] += 1
+            return notices[0] if fired["n"] == 3 else None
+
+        result = TrainLoop(step, state,
+                           (batch_at(i) for i in range(6)),
+                           log_every=2, reshard_signal=signal,
+                           reshard_metrics=rmetrics).run(6)
+        assert result.steps == 6 and result.reshards == 1
+        assert not result.preempted
+        assert rmetrics.counters["reshard_ack_failures"] == 1
+
+    def test_aot_warm_via_compile_cache(self):
+        """A notice with ``warm_batch`` AOT-compiles the rebuilt step
+        through train/compile.py: the loop drives the compiled
+        executable directly and the trajectory stays exact."""
+        mesh2, mesh4 = mesh_of(2, fsdp=2), mesh_of(4, data=2, model=2)
+        state = shard_pytree(init_state(), mesh2, RULES_FSDP)
+
+        # a single-jit step (gather + compute + scatter in one program
+        # is NOT mesh-independent — so only pin warm-compile mechanics,
+        # not the oracle, with it)
+        def builder(mesh, st):
+            shardings = named_sharding(st, mesh, RULES_MODEL)
+
+            def whole(s, b):
+                return _compute(s, b)
+
+            return jax.jit(whole, out_shardings=(shardings, None),
+                           donate_argnums=0)
+
+        notice = ReshardNotice(mesh2, RULES_FSDP, mesh4, RULES_MODEL,
+                               step_builder=builder,
+                               warm_batch=batch_at(0))
+        new_state, new_step, plan = notice.apply(
+            state, make_step(mesh2, RULES_FSDP, state))
+        # aot_compile returns the compiled executable, not the jit
+        assert hasattr(new_step, "cost_analysis") or not hasattr(new_step,
+                                                                 "lower")
+        out, m = new_step(new_state, batch_at(0))
+        assert np.isfinite(float(m["loss"]))
+
+
+# -------------------------------------------------- checkpoint restore arm
+class TestRestoreIntoDifferentLayout:
+    def test_restore_accepts_target_sharding_differing_from_saved(
+            self, tmp_path):
+        """Regression for the layout-equality assumption: a checkpoint
+        saved under (mesh2, fsdp rules) restores DIRECTLY into (mesh4,
+        model rules) — per-shard reads into the new layout, values
+        bit-equal, no full-replica host materialization."""
+        mesh2, mesh4 = mesh_of(2, fsdp=2), mesh_of(4, data=2, model=2)
+        state = shard_pytree(init_state(), mesh2, RULES_FSDP)
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(state, step=7, generation=2)
+
+        restored, gen, at = mgr.restore(jax.tree.map(jnp.zeros_like, state),
+                                        mesh=mesh4, rules=RULES_MODEL)
+        assert (gen, at) == (2, 7)
+        w1 = restored["params"]["w1"]
+        assert w1.sharding.spec == P(None, "model")
+        assert len(w1.sharding.device_set) == 4
+        # per-shard read: each device holds a strict slice of the leaf
+        assert w1.addressable_shards[0].data.shape == (8, 8)
+        for a, b in zip(jax.tree.leaves(jax.device_get(state)),
+                        jax.tree.leaves(jax.device_get(restored))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        mgr.close()
+
+    def test_restore_rejects_half_specified_target(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        with pytest.raises(ValueError, match="mesh and rules together"):
+            mgr.restore(init_state(), mesh=mesh_of(2, fsdp=2))
+        mgr.close()
+
+    def test_restore_validates_target_layout_before_reading(self, tmp_path):
+        mesh2 = mesh_of(2, fsdp=2)
+        state = shard_pytree(init_state(), mesh2, RULES_FSDP)
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(state, step=1)
+        mesh3 = create_mesh(MeshConfig(data=1, fsdp=1, model=3, seq=1),
+                            jax.devices()[:3])
+        bad = [PartitionRule(r"w1$|w2$", P("model", None))]
+        with pytest.raises(ShardingValidationError):
+            mgr.restore(state, mesh=mesh3, rules=bad)
+        mgr.close()
+
+
+# ------------------------------------------------------------ control plane
+class TestTopologyMeshShapes:
+    def test_mesh_shape_for_slice_fsdp_absorbs_chips(self):
+        shape = topology.mesh_shape_for_slice("tpu-v5-lite-podslice", "4x4")
+        assert shape == {"data": 1, "fsdp": 16, "model": 1, "expert": 1}
+        shape = topology.mesh_shape_for_slice("tpu-v5-lite-podslice", "2x4",
+                                              model=4)
+        assert shape["fsdp"] == 2 and shape["model"] == 4
+
+    def test_mesh_legality_is_the_chip_product(self):
+        topology.validate_mesh_for_slice(
+            "tpu-v5-lite-podslice", "2x4", {"data": 2, "fsdp": 4})
+        with pytest.raises(ValueError, match="must multiply to the chip"):
+            topology.validate_mesh_for_slice(
+                "tpu-v5-lite-podslice", "2x4", {"data": 3, "fsdp": 4})
+        with pytest.raises(ValueError, match="do not divide"):
+            topology.mesh_shape_for_slice("tpu-v5-lite-podslice", "2x4",
+                                          model=3)
+
+    def test_reshard_spec_round_trip(self):
+        spec = topology.format_reshard_spec(3, 4, {"data": 2, "fsdp": 8,
+                                                   "model": 1})
+        assert spec == "gen=3;hosts=4;mesh=data=2,fsdp=8"
+        assert topology.parse_reshard_spec(spec) == (3, 4, {"data": 2,
+                                                            "fsdp": 8})
+        assert topology.parse_reshard_spec("garbage") is None
+        assert topology.parse_reshard_spec("gen=x;hosts=2;mesh=") is None
+        assert topology.parse_reshard_spec("gen=1;hosts=0;mesh=") is None
+
+    def test_mesh_axes_wire_form(self):
+        assert topology.format_mesh_axes({"fsdp": 4, "data": 2,
+                                          "model": 1}) == "data=2,fsdp=4"
+        assert topology.parse_mesh_axes("data=2,fsdp=4") == {"data": 2,
+                                                             "fsdp": 4}
+        assert topology.parse_mesh_axes("") == {}
+        with pytest.raises(ValueError):
+            topology.parse_mesh_axes("data=two")
+
+
+class TestReshardAgent:
+    def _cluster_with_job(self, annotations=None):
+        from tpu_on_k8s.api.core import (
+            Container,
+            ObjectMeta,
+            PodSpec,
+            PodTemplateSpec,
+        )
+        from tpu_on_k8s.api.types import TaskSpec, TaskType, TPUJob, TPUJobSpec
+        from tpu_on_k8s.client import InMemoryCluster
+
+        cluster = InMemoryCluster()
+        template = PodTemplateSpec(
+            spec=PodSpec(containers=[Container(name="t", image="i")]))
+        job = TPUJob(metadata=ObjectMeta(name="rj",
+                                         annotations=annotations or {}),
+                     spec=TPUJobSpec(tasks={TaskType.MASTER: TaskSpec(
+                         num_tasks=1, template=template)}))
+        cluster.create(job)
+        return cluster
+
+    def _factory_recording(self, seen):
+        mesh1 = mesh_of(1)
+
+        def factory(mesh_shape, generation):
+            seen.append((mesh_shape, generation))
+            return ReshardNotice(mesh1, [], mesh1, [])
+
+        return factory
+
+    def test_request_becomes_notice_and_ack_closes_protocol(self):
+        from tpu_on_k8s.api.types import TPUJob
+
+        cluster = self._cluster_with_job({
+            constants.ANNOTATION_RESHARD_REQUESTED_SPEC:
+                "gen=4;hosts=2;mesh=data=2,fsdp=4"})
+        seen = []
+        agent = ReshardAgent(cluster, "default", "rj",
+                             self._factory_recording(seen),
+                             min_poll_interval_s=0)
+        notice = agent.poll()
+        assert notice is not None and notice.generation == 4
+        assert seen == [({"data": 2, "fsdp": 4}, 4)]
+        notice.on_applied()
+        got = cluster.get(TPUJob, "default", "rj")
+        assert got.metadata.annotations[
+            constants.ANNOTATION_RESHARD_COMPLETED_SPEC] == "4"
+        # acknowledged request is not re-delivered
+        assert agent.poll() is None
+
+    def test_failed_transform_clears_the_request(self):
+        from tpu_on_k8s.api.types import TPUJob
+
+        cluster = self._cluster_with_job({
+            constants.ANNOTATION_RESHARD_REQUESTED_SPEC:
+                "gen=4;hosts=2;mesh=fsdp=8"})
+        agent = ReshardAgent(cluster, "default", "rj",
+                             self._factory_recording([]),
+                             min_poll_interval_s=0)
+        notice = agent.poll()
+        notice.on_failed()
+        got = cluster.get(TPUJob, "default", "rj")
+        assert constants.ANNOTATION_RESHARD_REQUESTED_SPEC \
+            not in got.metadata.annotations
+        assert agent.poll() is None
+
+    def test_malformed_or_absent_request_is_no_request(self):
+        cluster = self._cluster_with_job()
+        agent = ReshardAgent(cluster, "default", "rj",
+                             self._factory_recording([]),
+                             min_poll_interval_s=0)
+        assert agent.poll() is None
+        cluster2 = self._cluster_with_job({
+            constants.ANNOTATION_RESHARD_REQUESTED_SPEC: "not-a-spec"})
+        agent2 = ReshardAgent(cluster2, "default", "rj",
+                              self._factory_recording([]),
+                              min_poll_interval_s=0)
+        assert agent2.poll() is None
+
+    def test_factory_decline_withdraws_the_request(self):
+        """A factory returning None means the requested mesh is not
+        constructible on this pod (scale-up whose hosts haven't joined):
+        the agent must CLEAR the request so the controller's hold
+        releases and the cold path executes the rescale — not leave it
+        pending forever."""
+        from tpu_on_k8s.api.types import TPUJob
+
+        cluster = self._cluster_with_job({
+            constants.ANNOTATION_RESHARD_REQUESTED_SPEC:
+                "gen=4;hosts=8;mesh=fsdp=32"})
+        agent = ReshardAgent(cluster, "default", "rj",
+                             lambda shape, gen: None,
+                             min_poll_interval_s=0)
+        assert agent.poll() is None
+        got = cluster.get(TPUJob, "default", "rj")
+        assert constants.ANNOTATION_RESHARD_REQUESTED_SPEC \
+            not in got.metadata.annotations
+
+    def test_poll_is_rate_limited_off_the_hot_loop(self):
+        """``poll`` rides TrainLoop's per-step signal: between interval
+        expiries it must not touch the cluster at all (a real API server
+        would otherwise eat one GET per training step)."""
+        cluster = self._cluster_with_job({
+            constants.ANNOTATION_RESHARD_REQUESTED_SPEC:
+                "gen=4;hosts=2;mesh=fsdp=8"})
+        gets = {"n": 0}
+        real_try_get = cluster.try_get
+
+        def counting_try_get(*a, **k):
+            gets["n"] += 1
+            return real_try_get(*a, **k)
+
+        cluster.try_get = counting_try_get
+        clock = {"t": 0.0}
+        agent = ReshardAgent(cluster, "default", "rj",
+                             self._factory_recording([]),
+                             min_poll_interval_s=5.0,
+                             clock=lambda: clock["t"])
+        assert agent.poll() is not None
+        for _ in range(50):                 # 50 "steps" inside the window
+            assert agent.poll() is None
+        assert gets["n"] == 1
+        clock["t"] = 6.0
+        assert agent.poll() is not None
+        assert gets["n"] == 2
+
+    def test_factory_hooks_chain_before_the_agent_ack(self):
+        from tpu_on_k8s.api.types import TPUJob
+
+        cluster = self._cluster_with_job({
+            constants.ANNOTATION_RESHARD_REQUESTED_SPEC:
+                "gen=4;hosts=2;mesh=fsdp=8"})
+        order = []
+        mesh1 = mesh_of(1)
+
+        def factory(mesh_shape, generation):
+            return ReshardNotice(mesh1, [], mesh1, [],
+                                 on_applied=lambda: order.append("factory"))
+
+        agent = ReshardAgent(cluster, "default", "rj", factory,
+                             min_poll_interval_s=0)
+        notice = agent.poll()
+        notice.on_applied()
+        got = cluster.get(TPUJob, "default", "rj")
+        assert order == ["factory"]         # the factory's hook still ran
+        assert got.metadata.annotations[
+            constants.ANNOTATION_RESHARD_COMPLETED_SPEC] == "4"
+
+    def test_ack_survives_a_deleted_job(self):
+        from tpu_on_k8s.api.types import TPUJob
+
+        cluster = self._cluster_with_job({
+            constants.ANNOTATION_RESHARD_REQUESTED_SPEC:
+                "gen=4;hosts=2;mesh=fsdp=8"})
+        agent = ReshardAgent(cluster, "default", "rj",
+                             self._factory_recording([]),
+                             min_poll_interval_s=0)
+        notice = agent.poll()
+        cluster.delete(TPUJob, "default", "rj")
+        notice.on_applied()                 # must not raise
+        notice.on_failed()                  # must not raise
+
+
+class TestElasticLiveReshard:
+    """The (hosts, mesh shape) decision delivered as a reshard request,
+    adopted by the elastic controller without a restart."""
+
+    def _env(self):
+        from tpu_on_k8s.api.core import (
+            Container,
+            ObjectMeta,
+            PodSpec,
+            PodTemplateSpec,
+        )
+        from tpu_on_k8s.api.types import (
+            ElasticPolicy,
+            TaskSpec,
+            TaskType,
+            TPUJob,
+            TPUJobSpec,
+            TPUPolicy,
+        )
+        from tpu_on_k8s.client import InMemoryCluster, KubeletSim
+        from tpu_on_k8s.controller.autoscaler import setup_elastic_autoscaler
+        from tpu_on_k8s.controller.elastic import ElasticController
+        from tpu_on_k8s.controller.failover import InMemoryRestarter
+        from tpu_on_k8s.controller.runtime import Manager
+        from tpu_on_k8s.controller.tpujob import (
+            setup_tpujob_controller,
+            submit_job,
+        )
+
+        cluster = InMemoryCluster()
+        manager = Manager()
+        self.elastic = ElasticController(cluster,
+                                         restarter=InMemoryRestarter())
+        setup_tpujob_controller(cluster, manager,
+                                elastic_controller=self.elastic)
+        scaler = setup_elastic_autoscaler(cluster)
+        template = PodTemplateSpec(
+            spec=PodSpec(containers=[Container(name="tpu", image="i")]))
+        job = TPUJob(
+            metadata=ObjectMeta(name="lr"),
+            spec=TPUJobSpec(
+                tasks={TaskType.WORKER: TaskSpec(num_tasks=2,
+                                                 template=template)},
+                elastic_policy=ElasticPolicy(min_replicas=2, max_replicas=8,
+                                             live_reshard=True),
+                tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice",
+                                     topology="2x4")))
+        submit_job(cluster, job)
+        sim = KubeletSim(cluster)
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        return cluster, manager, scaler, sim
+
+    def _emit(self, sim, n, latency, start=0):
+        for i in range(n):
+            sim.log_line("default", "lr-worker-0",
+                         f"[elastic-metrics] epoch=1 batch={start + i} "
+                         f"latency={latency} accuracy=0.9")
+
+    def test_decision_is_hosts_plus_slice_legal_mesh(self):
+        from tpu_on_k8s.api.types import TPUJob
+
+        cluster, manager, scaler, sim = self._env()
+        self._emit(sim, 5, latency=1.0)
+        scaler.run_once()
+        job = cluster.get(TPUJob, "default", "lr")
+        from tpu_on_k8s.api.types import TaskType
+        assert job.spec.tasks[TaskType.WORKER].num_tasks == 4
+        raw = job.metadata.annotations[
+            constants.ANNOTATION_RESHARD_REQUESTED_SPEC]
+        gen, hosts, mesh = topology.parse_reshard_spec(raw)
+        assert gen == job.metadata.generation and hosts == 4
+        # slice legality: the mesh multiplies to the NEW topology's chips
+        topology.validate_mesh_for_slice(
+            job.spec.tpu_policy.accelerator, job.spec.tpu_policy.topology,
+            mesh, job.spec.tpu_policy.num_slices)
+
+    def test_ack_adopts_running_pods_without_restart(self):
+        from tpu_on_k8s.api.core import Pod
+        from tpu_on_k8s.api.types import TPUJob
+
+        cluster, manager, scaler, sim = self._env()
+        before = {p.metadata.name: p.metadata.uid
+                  for p in cluster.list(Pod, "default")}
+        self._emit(sim, 5, latency=1.0)
+        scaler.run_once()
+        # transform still pending: the controller HOLDS — no restarts,
+        # no recreates, pods keep their old generation label
+        manager.run_until_idle()
+        held = cluster.list(Pod, "default")
+        assert {p.metadata.name: p.metadata.uid
+                for p in held} == before
+        # the pod-side agent acks (what ReshardAgent.on_applied does)
+        job = cluster.get(TPUJob, "default", "lr")
+        cluster.patch_meta(TPUJob, "default", "lr", annotations={
+            constants.ANNOTATION_RESHARD_COMPLETED_SPEC:
+                str(job.metadata.generation)})
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        pods = cluster.list(Pod, "default")
+        workers = [p for p in pods if "worker" in p.metadata.name]
+        assert len(workers) == 4            # scale-out indices created
+        survivors = [p for p in workers if p.metadata.name in before]
+        assert len(survivors) == 2
+        for p in survivors:
+            # adopted, not restarted: same uid, generation label
+            # advanced, and no elastic in-place restart was counted
+            assert p.metadata.uid == before[p.metadata.name]
+            assert int(p.metadata.labels[
+                constants.LABEL_JOB_GENERATION]) == \
+                cluster.get(TPUJob, "default", "lr").metadata.generation
+            assert constants.ANNOTATION_ELASTIC_RESTARTS \
+                not in p.metadata.annotations
+        reasons = [reason for _, _, reason, _ in cluster.events]
+        assert "LiveReshardRequested" in reasons
+        assert "LiveReshardAdopted" in reasons
+
+    def test_hold_is_bounded_dead_agent_falls_back_cold(self):
+        """An agent that dies mid-transform never acks and never clears:
+        the controller's hold must be BOUNDED — past
+        ``reshard_hold_max_passes`` the request is withdrawn
+        (LiveReshardTimedOut) and the cold restart path runs instead of
+        wedging the job forever."""
+        from tpu_on_k8s.api.core import Pod
+        from tpu_on_k8s.api.types import TPUJob
+
+        cluster, manager, scaler, sim = self._env()
+        self.elastic.config.reshard_hold_max_passes = 3
+        before = {p.metadata.uid for p in cluster.list(Pod, "default")}
+        self._emit(sim, 5, latency=1.0)
+        scaler.run_once()
+        # no ack ever arrives; each poke stands in for one sync-period
+        # requeue — drive passes until the hold bound trips and the
+        # cold path replaces the stale pods
+        for i in range(6):
+            manager.run_until_idle()
+            cluster.patch_meta(TPUJob, "default", "lr",
+                               annotations={"test/poke": str(i)})
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        got = cluster.get(TPUJob, "default", "lr")
+        assert constants.ANNOTATION_RESHARD_REQUESTED_SPEC \
+            not in got.metadata.annotations
+        reasons = [reason for _, _, reason, _ in cluster.events]
+        assert "LiveReshardTimedOut" in reasons
+        workers = [p for p in cluster.list(Pod, "default")
+                   if "worker" in p.metadata.name]
+        assert len(workers) == 4
+        assert not ({p.metadata.uid for p in workers} & before)
+
+    def test_cleared_request_falls_back_to_cold_path(self):
+        from tpu_on_k8s.api.core import Pod
+        from tpu_on_k8s.api.types import TPUJob
+
+        cluster, manager, scaler, sim = self._env()
+        before = {p.metadata.uid for p in cluster.list(Pod, "default")}
+        self._emit(sim, 5, latency=1.0)
+        scaler.run_once()
+        # the transform failed: the agent clears the request
+        cluster.patch_meta(TPUJob, "default", "lr", annotations={
+            constants.ANNOTATION_RESHARD_REQUESTED_SPEC: None})
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        # cold path ran: the 2x4->4x4 topology change forces recreation,
+        # so the surviving indices carry NEW uids
+        workers = [p for p in cluster.list(Pod, "default")
+                   if "worker" in p.metadata.name]
+        assert len(workers) == 4
+        assert not ({p.metadata.uid for p in workers} & before)

@@ -1,0 +1,338 @@
+"""Reshard soak: live mesh reconfiguration vs checkpoint-restart, raced
+on the seeded virtual-clock cost model — twice, byte-compared.
+
+One fixed seed drives the same elastic-training timeline through two
+arms:
+
+* **live arm** — the mid-run 2→4→2 rescale lands as a state transform
+  (`tpu_on_k8s/parallel/reshard.py`): the transfer plan is computed by
+  the REAL planner over an abstract flagship-shaped state (so bytes
+  moved come from the actual per-leaf layout diff, not a guess), the
+  pause is plan bytes over interconnect bandwidth plus a cache-warm AOT
+  compile, and the `TrainingAccountant` books it in the ``reshard``
+  bucket while global steps keep counting.
+* **restart arm** — the same rescale as today's cold path: final
+  checkpoint, teardown, reschedule, cold recompile, restore, and replay
+  of every step since the last periodic checkpoint — each booked in its
+  own waste bucket by the same accountant.
+
+Both arms feed the real `TrainingAccountant` + `ReshardMetrics`, emit
+deterministic event-log lines (no wall clock — the virtual clock is the
+cost model), and ``--repeat 2`` (default) asserts the logs replay
+byte-identically. The headline assertions: the live arm's pause seconds
+beat the restart arm's, and its ``goodput_fraction`` ends higher — the
+number `obs/account.py` now attributes distinctly.
+
+``--bench`` swaps the cost model for the real thing: an in-process
+2→4→2 reshard of a real (tiny) train state on forced CPU devices (or
+whatever accelerator is attached), recording measured transform pause
+seconds and bytes — the `tools/chip_window.py` ``train_reshard`` stage.
+
+Usage:
+    python tools/reshard_soak.py                 # seed 6172, repeat 2
+    python tools/reshard_soak.py --seed 7 --repeat 1
+    python tools/reshard_soak.py --bench
+    make reshard-soak
+
+On failure the seed is printed (``RESHARD_SOAK_FAILED seed=...``) so the
+exact run can be replayed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import zlib
+from typing import Dict, List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# force a multi-device CPU world BEFORE jax initializes (conftest's trick:
+# the planner and the --bench arm need 2- and 4-chip meshes)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+DEFAULT_SEED = 6172
+
+# ---- the cost model (seconds; stated once so both arms price identically)
+STEP_DT_2 = 0.050            # per-step seconds on the 2-chip mesh
+EFFICIENCY = 0.85            # scaling efficiency going 2 -> 4 chips
+RESHARD_BW = 10e9            # bytes/s the transform moves shards at
+WARM_COMPILE_S = 2.0         # AOT warm through the persistent cache
+SAVE_BW = 2e9                # checkpoint write bandwidth
+COLD_COMPILE_S = 120.0       # the cold-restart recompile
+TEARDOWN_S = 10.0            # SIGTERM -> pods gone
+RESCHEDULE_S = 20.0          # gang rescheduling + image pull (warm node)
+INIT_S = 30.0                # process boot + backend init + restore read
+
+
+def _abstract_state(n_layers: int = 12, d_model: int = 768,
+                    d_ff: int = 3072):
+    """Flagship-shaped abstract params + Adam moments (ShapeDtypeStructs —
+    the planner needs shapes and dtypes, never data)."""
+    import jax
+    import numpy as np
+
+    def leaf(*shape):
+        return jax.ShapeDtypeStruct(tuple(shape), np.dtype("float32"))
+
+    params = {f"layers_{i}": {"attn": {"wqkv": {"kernel": leaf(d_model, 3 * d_model)},
+                                       "wo": {"kernel": leaf(d_model, d_model)}},
+                              "mlp": {"w_gateup": {"kernel": leaf(d_model, 2 * d_ff)},
+                                      "w_down": {"kernel": leaf(d_ff, d_model)}}}
+              for i in range(n_layers)}
+    params["embed"] = leaf(32768, d_model)
+    return {"params": params,
+            "mu": jax.tree.map(lambda x: x, params),
+            "nu": jax.tree.map(lambda x: x, params)}
+
+
+def _plans() -> Tuple[object, object]:
+    """(2→4 plan, 4→2 plan) from the real planner over CPU meshes — the
+    bytes-moved numbers the live arm prices."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+    from tpu_on_k8s.parallel.partition import PartitionRule
+    from tpu_on_k8s.parallel.reshard import plan_reshard
+
+    rules_fsdp = [PartitionRule(r"kernel$|embed$", P("fsdp", None))]
+    rules_model = [PartitionRule(r"kernel$|embed$", P(None, "model"))]
+    mesh2 = create_mesh(MeshConfig(data=1, fsdp=2, model=1, seq=1),
+                        jax.devices()[:2])
+    mesh4 = create_mesh(MeshConfig(data=2, fsdp=1, model=2, seq=1),
+                        jax.devices()[:4])
+    state = _abstract_state()
+    up = plan_reshard(state, mesh2, rules_fsdp, mesh4, rules_model)
+    down = plan_reshard(state, mesh4, rules_model, mesh2, rules_fsdp)
+    return up, down
+
+
+def _step_dt(rng, chips: int) -> float:
+    """Seeded per-step time on a ``chips``-chip mesh: the 2-chip baseline
+    scaled by chips with the stated efficiency, plus bounded seeded
+    jitter (the realism that makes the byte-identical replay a real
+    determinism check, not a constant-folding one)."""
+    base = STEP_DT_2 * 2.0 / (chips * (EFFICIENCY if chips > 2 else 1.0))
+    return round(base * (1.0 + 0.02 * float(rng.random())), 9)
+
+
+def run_arm(seed: int, live: bool, *, steps_total: int = 600,
+            rescale_up_at: int = 210, rescale_down_at: int = 410,
+            ckpt_every: int = 50) -> Tuple[List[str], Dict]:
+    """One arm of the race: the same timeline (2 chips → 4 before step
+    ``rescale_up_at`` → back to 2 before ``rescale_down_at``), rescales
+    executed live or via checkpoint-restart. The rescale points sit OFF
+    the checkpoint cadence on purpose: the restart arm must replay the
+    steps since its last periodic save, which is exactly the replay
+    waste the accountant's high-water mark books. Returns (event log,
+    summary)."""
+    import numpy as np
+
+    from tpu_on_k8s.metrics.metrics import ReshardMetrics, TrainMetrics
+    from tpu_on_k8s.obs.account import TrainingAccountant
+
+    up, down = _plans()
+    tmetrics = TrainMetrics(registry=None)
+    rmetrics = ReshardMetrics(registry=None)
+    acct = TrainingAccountant(metrics=tmetrics)
+    events: List[str] = []
+    arm = "live" if live else "restart"
+    chips = 2
+    vclock = 0.0
+    pause_total = 0.0
+    step = 0
+    pending = {rescale_up_at: (4, up), rescale_down_at: (2, down)}
+    while step < steps_total:
+        target = pending.pop(step, None)
+        if target is not None:
+            to_chips, plan = target
+            if live:
+                pause = plan.bytes_moved / RESHARD_BW + WARM_COMPILE_S
+                acct.pause("reshard", pause)
+                rmetrics.inc("reshards")
+                rmetrics.inc("bytes_moved", plan.bytes_moved)
+                rmetrics.set_gauge("transform_seconds", pause)
+                events.append(f"{arm}: step={step} {plan.describe()} "
+                              f"pause={pause:.6f}")
+            else:
+                save_s = plan.bytes_total / SAVE_BW
+                # pause(), not waste(): these are in-run measured pauses
+                # too — the arms differ in WHICH bucket eats the rescale
+                # (reshard vs checkpoint/restart/recompile), never in
+                # whether the residual re-books it as overhead
+                acct.pause("checkpoint", save_s)
+                acct.pause("restart", TEARDOWN_S + RESCHEDULE_S + INIT_S)
+                acct.pause("recompile", COLD_COMPILE_S)
+                pause = (save_s + TEARDOWN_S + RESCHEDULE_S + INIT_S
+                         + COLD_COMPILE_S)
+                # resume from the last periodic checkpoint: the steps
+                # since it re-execute, and the accountant's high-water
+                # mark books them as replay — no hand accounting
+                replay_from = (step // ckpt_every) * ckpt_every
+                events.append(f"{arm}: step={step} cold restart -> "
+                              f"{to_chips} chips pause={pause:.6f} "
+                              f"replay_from={replay_from}")
+                step = replay_from
+            chips = to_chips
+            vclock += pause
+            pause_total += pause
+        rng = np.random.default_rng((seed, step))
+        dt = _step_dt(rng, chips)
+        step += 1
+        vclock += dt
+        acct.window(step, 1, dt)
+    acct.run_complete(vclock)
+    summary = {
+        "arm": arm,
+        "steps": steps_total,
+        "pause_s": round(pause_total, 6),
+        "virtual_seconds": round(vclock, 6),
+        "goodput_fraction": acct.summary()["goodput_fraction"],
+        "waste_s": acct.summary()["waste_s"],
+        "reshards": rmetrics.counters.get("reshards", 0),
+        "bytes_moved": rmetrics.counters.get("bytes_moved", 0),
+    }
+    events.append(f"{arm}: done steps={steps_total} "
+                  f"pause={pause_total:.6f} "
+                  f"goodput={summary['goodput_fraction']}")
+    return events, summary
+
+
+# ------------------------------------------------------------- bench mode
+def run_bench(seed: int) -> Dict:
+    """The real thing, measured: a tiny train state reshards in-process
+    2→4→2 (fsdp rules → model rules and back) through the live
+    machinery — `plan_reshard` + donated `device_put` driven by a real
+    `TrainLoop` via `ReshardNotice` — recording measured pause seconds
+    and bytes. What the chip_window ``train_reshard`` stage runs."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_on_k8s.metrics.metrics import ReshardMetrics
+    from tpu_on_k8s.obs.account import TrainingAccountant
+    from tpu_on_k8s.parallel.mesh import MeshConfig, create_mesh
+    from tpu_on_k8s.parallel.partition import PartitionRule, shard_pytree
+    from tpu_on_k8s.parallel.reshard import ReshardNotice
+    from tpu_on_k8s.train.loop import TrainLoop
+
+    rules_fsdp = [PartitionRule(r"w$", P("fsdp", None))]
+    rules_model = [PartitionRule(r"w$", P(None, "model"))]
+    mesh2 = create_mesh(MeshConfig(data=1, fsdp=2, model=1, seq=1),
+                        jax.devices()[:2])
+    mesh4 = create_mesh(MeshConfig(data=2, fsdp=1, model=2, seq=1),
+                        jax.devices()[:min(4, len(jax.devices()))])
+
+    rng = np.random.default_rng(seed)
+    state = {"w": jnp.asarray(rng.normal(size=(256, 256)), jnp.float32),
+             "m": jnp.zeros((256, 256), jnp.float32)}
+    state = shard_pytree(state, mesh2, rules_fsdp)
+
+    def step_fn(s, batch):
+        g = s["w"] * 0.0 + batch
+        return ({"w": s["w"] - 0.01 * g, "m": s["m"] * 0.9 + g},
+                {"loss": jnp.mean(g)})
+
+    def batches():
+        while True:
+            yield jnp.ones((), jnp.float32)
+
+    schedule = [
+        ReshardNotice(mesh2, rules_fsdp, mesh4, rules_model, tag="up"),
+        ReshardNotice(mesh4, rules_model, mesh2, rules_fsdp, tag="down"),
+    ]
+
+    def signal():
+        return schedule.pop(0) if schedule else None
+
+    rmetrics = ReshardMetrics(registry=None)
+    acct = TrainingAccountant()
+    t0 = time.perf_counter()
+    result = TrainLoop(step_fn, state, batches(), log_every=2,
+                       reshard_signal=signal, reshard_metrics=rmetrics,
+                       accountant=acct).run(6)
+    wall = time.perf_counter() - t0
+    return {
+        "mode": "bench",
+        "seed": seed,
+        "steps": result.steps,
+        "reshards": result.reshards,
+        "bytes_moved": rmetrics.counters.get("bytes_moved", 0),
+        "transform_seconds_last": rmetrics.gauges.get("transform_seconds"),
+        "reshard_pause_s": round(acct.waste_s.get("reshard", 0.0), 6),
+        "goodput_fraction": acct.goodput_fraction(),
+        "wall_seconds": round(wall, 3),
+        "devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+# --------------------------------------------------------------------- main
+def run_all(seed: int) -> Dict:
+    live_events, live = run_arm(seed, live=True)
+    restart_events, restart = run_arm(seed, live=False)
+    events = live_events + restart_events
+    assert live["pause_s"] < restart["pause_s"], (
+        f"live reshard must beat checkpoint-restart on pause seconds: "
+        f"{live['pause_s']} vs {restart['pause_s']}")
+    assert live["goodput_fraction"] > restart["goodput_fraction"], (
+        f"live reshard must beat checkpoint-restart on goodput_fraction: "
+        f"{live['goodput_fraction']} vs {restart['goodput_fraction']}")
+    assert live["reshards"] == 2, "both rescales must run live"
+    assert "reshard" in live["waste_s"] and \
+        "reshard" not in restart["waste_s"], (
+        "the pause must be attributed to the reshard bucket on the live "
+        "arm only")
+    return {
+        "seed": seed,
+        "live": live,
+        "restart": restart,
+        "pause_win_s": round(restart["pause_s"] - live["pause_s"], 6),
+        "goodput_win": round(live["goodput_fraction"]
+                             - restart["goodput_fraction"], 6),
+        "events": events,
+        "events_crc": f"{zlib.crc32(chr(10).join(events).encode()):08x}",
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="live-reshard vs checkpoint-restart soak")
+    p.add_argument("--seed", type=int, default=DEFAULT_SEED)
+    p.add_argument("--repeat", type=int, default=2,
+                   help="run the race this many times and assert "
+                        "identical event logs (default 2)")
+    p.add_argument("--bench", action="store_true",
+                   help="measure a real in-process 2->4->2 reshard "
+                        "instead of the cost model (chip_window stage)")
+    args = p.parse_args(argv)
+    try:
+        if args.bench:
+            print(json.dumps(run_bench(args.seed), indent=2))
+            return 0
+        runs = [run_all(args.seed) for _ in range(max(args.repeat, 1))]
+        for later in runs[1:]:
+            assert later["events"] == runs[0]["events"], (
+                "event logs diverged across repeats:\n"
+                f"run 1: {runs[0]['events']}\nrun n: {later['events']}")
+        out = dict(runs[0])
+        out["repeats"] = len(runs)
+        out["identical_logs"] = len(runs) > 1
+        print(json.dumps(out, indent=2))
+        return 0
+    except Exception as e:  # noqa: BLE001 — the seed line is the contract
+        print(f"RESHARD_SOAK_FAILED seed={args.seed}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        raise
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,14 @@ SCALE_STATE_DONE = "done"
 # 2-phase checkpoint transaction (operator <-> AIMaster), SURVEY §3.3 / §5.4:
 ANNOTATION_CKPT_REQUESTED_VERSION = "distributed.tpu.io/ckpt-requested-version"
 ANNOTATION_CKPT_COMPLETED_VERSION = "distributed.tpu.io/ckpt-completed-version"
+# live mesh reconfiguration (tpu_on_k8s/parallel/reshard.py): the elastic
+# autoscaler's (hosts, mesh shape) decision delivered to the pod as a
+# reshard REQUEST ("gen=G;hosts=H;mesh=data=2,fsdp=8") instead of a
+# delete; the in-pod ReshardAgent transforms training state live and
+# acks with the generation, which lets the elastic controller adopt the
+# running pods at the new generation without restarting them.
+ANNOTATION_RESHARD_REQUESTED_SPEC = "distributed.tpu.io/reshard-requested-spec"
+ANNOTATION_RESHARD_COMPLETED_SPEC = "distributed.tpu.io/reshard-completed-spec"
 ANNOTATION_READY_TO_START_WORKER = "distributed.tpu.io/ready-to-start-worker"
 ANNOTATION_IMMEDIATELY_START_WORKER = "distributed.tpu.io/immediately-start-worker"
 ANNOTATION_WORLD_SIZE = "distributed.tpu.io/world-size"

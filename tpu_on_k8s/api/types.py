@@ -130,6 +130,11 @@ class ElasticPolicy:
     rendezvous_endpoint: str = ""
     nproc_per_node: int = 1
     max_restarts: Optional[int] = None
+    # live mesh reconfiguration (tpu_on_k8s/parallel/reshard.py): rescale
+    # decisions are delivered to the pods as (hosts, mesh shape) reshard
+    # requests — training state transforms in place and the run never
+    # exits; a failed transform falls back to the checkpoint-restart path
+    live_reshard: bool = False
 
 
 @dataclass

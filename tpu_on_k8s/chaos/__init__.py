@@ -34,6 +34,7 @@ from tpu_on_k8s.chaos.faults import (
     SITE_FLEET_ROLLOUT,
     SITE_KV_HANDOFF,
     SITE_RECONCILE,
+    SITE_RESHARD,
     SITE_REST_REQUEST,
     SITE_REST_WATCH_CONNECT,
     SITE_REST_WATCH_EVENT,
@@ -42,6 +43,7 @@ from tpu_on_k8s.chaos.faults import (
     SITE_TRAIN_PREEMPT,
     SITE_TRAIN_SAVE,
     SITE_TRAIN_STEP,
+    ChaosReshardError,
     ChaosSaveError,
     ChaosStepError,
     Conflict,
@@ -56,6 +58,7 @@ from tpu_on_k8s.chaos.faults import (
     PodFail,
     PreemptNotice,
     ReadinessFlap,
+    ReshardAbort,
     ReplicaCrash,
     RolloutInterrupt,
     SaveFailure,
@@ -87,6 +90,7 @@ __all__ = [
     "SITE_FLEET_ROLLOUT",
     "SITE_KV_HANDOFF",
     "SITE_RECONCILE",
+    "SITE_RESHARD",
     "SITE_REST_REQUEST",
     "SITE_REST_WATCH_CONNECT",
     "SITE_REST_WATCH_EVENT",
@@ -95,6 +99,7 @@ __all__ = [
     "SITE_TRAIN_PREEMPT",
     "SITE_TRAIN_SAVE",
     "SITE_TRAIN_STEP",
+    "ChaosReshardError",
     "ChaosSaveError",
     "ChaosStepError",
     "Conflict",
@@ -111,6 +116,7 @@ __all__ = [
     "PodFail",
     "PreemptNotice",
     "ReadinessFlap",
+    "ReshardAbort",
     "ReplicaCrash",
     "RolloutInterrupt",
     "SaveFailure",

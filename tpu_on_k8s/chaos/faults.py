@@ -25,6 +25,7 @@ API.
 | train.step             | TrainLoop.run (per dispatch)        | StepFailure |
 | train.save             | TrainLoop._enqueue_save             | SaveFailure |
 | train.preempt          | TrainLoop.run (per iteration)       | PreemptNotice |
+| train.reshard          | parallel/reshard plan execution     | ReshardAbort |
 
 This module imports only the stdlib — any layer may import it without
 dragging in jax or the client stack (exception mapping imports lazily).
@@ -50,6 +51,7 @@ SITE_KV_HANDOFF = "serve.kv.handoff"
 SITE_TRAIN_STEP = "train.step"
 SITE_TRAIN_SAVE = "train.save"
 SITE_TRAIN_PREEMPT = "train.preempt"
+SITE_RESHARD = "train.reshard"
 SITE_AUTOSCALE_SIGNAL = "autoscale.signal"
 SITE_AUTOSCALE_PATCH = "autoscale.patch"
 
@@ -117,6 +119,10 @@ SITE_REGISTRY = {
         "`train/loop.py` loop head",
         ("PreemptNotice",),
         "final save + drain, bit-exact resume"),
+    SITE_RESHARD: (
+        "`parallel/reshard.py` transfer-plan execution",
+        ("ReshardAbort",),
+        "fallback to checkpoint-restart, zero state corruption"),
     SITE_AUTOSCALE_SIGNAL: (
         "`controller/fleetautoscaler.py` scrape",
         ("SignalOutage",),
@@ -135,6 +141,10 @@ class ChaosStepError(RuntimeError):
 class ChaosSaveError(OSError):
     """An injected checkpoint-save failure (``SaveFailure``) — an OSError
     because that is what a full disk / revoked GCS token raises."""
+
+
+class ChaosReshardError(RuntimeError):
+    """An injected live-reshard abort (``ReshardAbort``)."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -354,6 +364,23 @@ class SaveFailure(Fault):
 
     def to_exception(self) -> Exception:
         return ChaosSaveError("chaos injected checkpoint-save failure")
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardAbort(Fault):
+    """A live mesh reshard dies mid-transform (a target device lost, an
+    OOM during the transfer, a wedged collective in the resharding
+    dispatch). Fired BEFORE the donating transfer dispatches — the one
+    atomic step — so the source state is still intact by construction.
+    Recovery under test: the train loop abandons the live path, counts
+    ``reshard_fallbacks``, and falls back to the existing
+    checkpoint-restart rescale with zero state corruption (the resumed
+    trajectory stays bit-exact)."""
+
+    kind: ClassVar[str] = "reshard_abort"
+
+    def to_exception(self) -> Exception:
+        return ChaosReshardError("chaos injected reshard abort")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -213,6 +213,22 @@ def autoscale_under_crash(replica: str = "replica-1", *,
     return Scenario("autoscale-under-crash", tuple(rules), seed)
 
 
+def live_reshard_abort(at_transform: int = 1, *, seed: int = 0) -> Scenario:
+    """Abort the ``at_transform``-th live mesh reshard mid-transform
+    (counted per transfer-plan execution, `parallel/reshard.py`). The
+    abort fires BEFORE the plan's single donating dispatch, so the
+    source state is intact by construction. Recovery under test: the
+    train loop counts the fallback, exits via the preemption path (final
+    save + drain from the uncorrupted state), and the orchestrator's
+    checkpoint-restart rescale reproduces the no-fault loss trajectory
+    bit-for-bit — zero state corruption."""
+    return Scenario("live-reshard-abort", (
+        FaultRule(faults.SITE_RESHARD, on_call(at_transform),
+                  faults.ReshardAbort(),
+                  note=f"abort live reshard #{at_transform}"),
+    ), seed)
+
+
 def train_preemption(at_step: int, *, fail_save: bool = False,
                      seed: int = 0) -> Scenario:
     """Deliver a SIGTERM-style preemption notice before training step

@@ -304,18 +304,54 @@ class ElasticAutoscaler:
             state.watermark = max(keys)
         state.observations.clear()
 
+        worker = job.spec.tasks.get(TaskType.WORKER)
+        prev_hosts = worker.num_tasks if worker is not None else 0
         applied = [0]
 
         def mutate(j: TPUJob) -> None:
             applied[0] = apply_host_count(j, hosts)
 
-        self.cluster.update_with_retry(
+        updated = self.cluster.update_with_retry(
             TPUJob, job.metadata.namespace, job.metadata.name, mutate)
         status.replicas = applied[0]
+        ep = job.spec.elastic_policy
+        if (ep is not None and ep.live_reshard and applied[0] > 0
+                and applied[0] != prev_hosts):
+            # the decision is a (hosts, mesh shape) PAIR: deliver it to
+            # the pods as a live-reshard request (`parallel/reshard.py`)
+            # instead of leaving the cold restart as the only executor
+            self._request_live_reshard(updated, applied[0])
         self._write_status(job)
         self.cluster.record_event(
             job, "Normal", "ElasticRescale",
             f"autoscaler: {status.message or f'scale to {applied[0]} hosts'}")
+
+    def _request_live_reshard(self, job: TPUJob, hosts: int) -> None:
+        """Stamp the post-respec job with the (hosts, mesh shape) reshard
+        request. The mesh shape is derived from the new slice
+        configuration under `gang/topology` legality (axis product ==
+        chip count); a configuration with no legal default shape leaves
+        the cold checkpoint-restart path in charge, with the reason on
+        the event stream."""
+        tpu = job.spec.tpu_policy
+        try:
+            mesh = topology.mesh_shape_for_slice(
+                tpu.accelerator, tpu.topology, tpu.num_slices)
+        except (KeyError, ValueError) as e:
+            self.cluster.record_event(job, "Warning", "LiveReshardSkipped",
+                                      f"no slice-legal mesh shape: {e}")
+            return
+        spec = topology.format_reshard_spec(
+            job.metadata.generation, hosts, mesh)
+        try:
+            self.cluster.patch_meta(
+                TPUJob, job.metadata.namespace, job.metadata.name,
+                annotations={
+                    constants.ANNOTATION_RESHARD_REQUESTED_SPEC: spec})
+        except NotFoundError:
+            return
+        self.cluster.record_event(job, "Normal", "LiveReshardRequested",
+                                  f"reshard request: {spec}")
 
     def _elastic_status(self, job: TPUJob) -> ElasticStatus:
         status = job.status.elastic_statuses.get(TaskType.WORKER)

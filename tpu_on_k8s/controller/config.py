@@ -47,6 +47,12 @@ class JobControllerConfig:
     # Consecutive autoscaler ticks tolerating Pending pods at a grown size
     # before reverting (the reference polls up to 1min, elastic_scale.go:440).
     elastic_pending_grace_ticks: int = 2
+    # Reconcile passes the elastic controller HOLDS the world for a
+    # pending live-reshard ack before giving up (the pod-side agent died
+    # mid-transform without clearing the request): past this, the
+    # request is withdrawn and the cold checkpoint-restart path runs.
+    # Pass-counted in controller memory, not clock-based — deterministic.
+    reshard_hold_max_passes: int = 40
     failover_concurrency: int = 50                 # failover.go semaphore widths
     # TPU-first: one dead host kills its slice's SPMD program — restart the
     # slice's surviving workers together (SURVEY §5.3 TPU note).

@@ -107,6 +107,13 @@ class ElasticController:
         self.restarter = restarter
         self.config = config or JobControllerConfig()
         self.hooks = hooks
+        # live-reshard hold bookkeeping: ("ns/name", requested generation)
+        # -> reconcile passes spent holding for the pod's ack. In-memory
+        # (a controller restart restarts the wait, which is safe — the
+        # bound is a dead-agent safety valve, not a deadline contract);
+        # an annotation-based count would self-trigger reconciles on
+        # every increment and burn the budget in one watch storm.
+        self._reshard_holds: dict = {}
 
     # --------------------------------------------------------------- utilities
     @staticmethod
@@ -225,6 +232,9 @@ class ElasticController:
     def _scale(self, job: TPUJob, pods: List[Pod], stale: List[Pod]) -> Optional[Result]:
         """Step 4: the scale workflow (scale(), elastic_scale.go:210-297)."""
         ann = job.metadata.annotations
+        outcome = self._adopt_live_reshard(job, stale)
+        if outcome is not None:
+            return outcome
         ready = ann.get(constants.ANNOTATION_READY_TO_START_WORKER) == "true"
         immediate = ann.get(constants.ANNOTATION_IMMEDIATELY_START_WORKER) == "true"
         ckpt_requested = self._ann_int(job, constants.ANNOTATION_CKPT_REQUESTED_VERSION)
@@ -262,6 +272,74 @@ class ElasticController:
         # Fall through to the engine: it creates missing indices with the new
         # generation label and prunes out-of-range ones.
         return None
+
+    def _adopt_live_reshard(self, job: TPUJob,
+                            stale: List[Pod]) -> Optional[Result]:
+        """The live-rescale seam (`tpu_on_k8s/parallel/reshard.py`): when
+        the autoscaler delivered this generation's rescale as a reshard
+        REQUEST, the running pods transform their training state in
+        place instead of being restarted. While the transform is pending
+        the world is held steady (a restart now would race the
+        transform); once the pod acks (``reshard-completed-spec`` >= the
+        requested generation) the in-range pods are ADOPTED at the new
+        generation — no delete, no in-place restart, no recompile — and
+        only out-of-range pods (scale-in victims) are removed. A failed
+        transform clears the request (``ReshardAgent.on_failed``), which
+        releases the hold and lets the cold checkpoint-restart path run.
+        Returns None when no live reshard is in play."""
+        raw = job.metadata.annotations.get(
+            constants.ANNOTATION_RESHARD_REQUESTED_SPEC)
+        if raw is None:
+            return None
+        parsed = topology.parse_reshard_spec(raw)
+        if parsed is None or parsed[0] < job.metadata.generation:
+            # malformed or stale request (a later spec change superseded
+            # it): the cold path is in charge
+            return None
+        key = (f"{job.metadata.namespace}/{job.metadata.name}", parsed[0])
+        completed = self._ann_int(
+            job, constants.ANNOTATION_RESHARD_COMPLETED_SPEC)
+        if completed is None or completed < parsed[0]:
+            # the hold is BOUNDED: an agent that died mid-transform
+            # (without reaching on_failed's clear) must not wedge the
+            # job forever — count held reconcile passes and past the
+            # bound withdraw the request so the cold path runs
+            held = self._reshard_holds.get(key, 0)
+            if held >= self.config.reshard_hold_max_passes:
+                self._reshard_holds.pop(key, None)
+                self._patch_job_annotations(job, {
+                    constants.ANNOTATION_RESHARD_REQUESTED_SPEC: None})
+                self.cluster.record_event(
+                    job, "Warning", "LiveReshardTimedOut",
+                    f"no reshard ack after {held} held passes; falling "
+                    f"back to checkpoint-restart")
+                return None
+            self._reshard_holds[key] = held + 1
+            return Result(requeue_after=self.config.sync_period_seconds)
+        self._reshard_holds.pop(key, None)
+        gen = str(job.metadata.generation)
+        adopted = 0
+        for pod in stale:
+            if self._in_range(job, pod):
+                self._mark_current(pod, gen)
+                adopted += 1
+            else:
+                # scale-in: out-of-range pods still go away — the live
+                # transform only saves the SURVIVORS from a restart
+                try:
+                    self.cluster.patch_meta(
+                        Pod, pod.metadata.namespace, pod.metadata.name,
+                        remove_finalizers=[
+                            constants.FINALIZER_PREEMPT_PROTECTOR])
+                    self.cluster.delete(Pod, pod.metadata.namespace,
+                                        pod.metadata.name)
+                except NotFoundError:
+                    pass
+        self.cluster.record_event(
+            job, "Normal", "LiveReshardAdopted",
+            f"adopted {adopted} running pod(s) at generation {gen} after "
+            f"live reshard — no restart")
+        return Result(requeue_after=0.0)
 
     def _restart_stale_pod(self, job: TPUJob, pod: Pod, world: int) -> bool:
         """restartStalePod → restartPodInKruiseProtocol

@@ -163,3 +163,112 @@ def validate_slice(accelerator: str, topology: str) -> None:
             f"topology {topology!r} is not legal for {accelerator!r}; "
             f"legal: {legal_topologies(accelerator)}"
         )
+
+
+# --------------------------------------------------------------- mesh shapes
+# The elastic decision is no longer just a host count: a live reshard
+# (tpu_on_k8s/parallel/reshard.py) needs the *(hosts, mesh shape)* pair,
+# where the mesh shape is the logical axis layout the training state is
+# repartitioned onto. The legality constraint is chips, not hosts: the
+# axis sizes must multiply to the slice configuration's chip count —
+# the same quanta rule `parallel/mesh.MeshConfig.resolve` enforces on
+# the compute plane, expressed here dependency-free so the controller
+# can validate a decision without importing jax.
+
+def format_mesh_axes(mesh: Dict[str, int]) -> str:
+    """Stable wire form of a mesh shape ("data=2,fsdp=8"): sorted,
+    trivial (size-1) axes dropped — two writers of the same shape
+    produce identical strings. "" is the single-chip/trivial mesh."""
+    return ",".join(f"{a}={int(s)}" for a, s in sorted(mesh.items())
+                    if int(s) > 1)
+
+
+def parse_mesh_axes(raw: str) -> Dict[str, int]:
+    """Inverse of ``format_mesh_axes``. Raises ValueError on malformed
+    input (non-numeric or non-positive sizes) — callers on annotation
+    paths catch and treat as "no request"."""
+    out: Dict[str, int] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        axis, _, size = part.partition("=")
+        if not axis or not size:
+            raise ValueError(f"malformed mesh axes {raw!r}")
+        n = int(size)
+        if n < 1:
+            raise ValueError(f"non-positive axis size in {raw!r}")
+        out[axis] = n
+    return out
+
+
+def slice_chips(accelerator: str, topology: str, num_slices: int = 1) -> int:
+    """Total chips of a slice configuration — the budget a mesh shape
+    must multiply to."""
+    validate_slice(accelerator, topology)
+    return chips_in_topology(topology) * max(int(num_slices), 1)
+
+
+def validate_mesh_for_slice(accelerator: str, topology: str,
+                            mesh: Dict[str, int],
+                            num_slices: int = 1) -> None:
+    """A mesh shape is slice-legal iff its axis product equals the slice
+    configuration's chip count. Raises ValueError naming both numbers —
+    the decision-side guard matching the compute plane's
+    ``MeshConfig.resolve`` check."""
+    chips = slice_chips(accelerator, topology, num_slices)
+    product = math.prod(max(int(s), 1) for s in mesh.values()) if mesh else 1
+    if product != chips:
+        raise ValueError(
+            f"mesh shape {format_mesh_axes(mesh) or 'single'} has axis "
+            f"product {product} but {accelerator}/{topology}"
+            f"{f' x{num_slices}' if num_slices > 1 else ''} provides "
+            f"{chips} chips — axis sizes must multiply to the chip count")
+
+
+def mesh_shape_for_slice(accelerator: str, topology: str,
+                         num_slices: int = 1, *, data: int = 1,
+                         model: int = 1, expert: int = 1,
+                         ) -> Dict[str, int]:
+    """The default (hosts, mesh shape) second half for a slice
+    configuration: fixed axes as given, ``fsdp`` absorbing the remaining
+    chips (the training plane's default parallelism). Raises ValueError
+    when the fixed axes do not divide the chip count."""
+    chips = slice_chips(accelerator, topology, num_slices)
+    fixed = max(int(data), 1) * max(int(model), 1) * max(int(expert), 1)
+    if chips % fixed != 0:
+        raise ValueError(
+            f"fixed axes data={data},model={model},expert={expert} "
+            f"(product {fixed}) do not divide the {chips} chips of "
+            f"{accelerator}/{topology}"
+            f"{f' x{num_slices}' if num_slices > 1 else ''}")
+    shape = {"data": int(data), "fsdp": chips // fixed,
+             "model": int(model), "expert": int(expert)}
+    validate_mesh_for_slice(accelerator, topology, shape, num_slices)
+    return shape
+
+
+def format_reshard_spec(generation: int, hosts: int,
+                        mesh: Dict[str, int]) -> str:
+    """The annotation wire form of a (hosts, mesh shape) rescale
+    decision: ``gen=3;hosts=4;mesh=data=2,fsdp=8``
+    (``ANNOTATION_RESHARD_REQUESTED_SPEC``). Order-normalized so two
+    writers of the same decision produce identical strings."""
+    return (f"gen={int(generation)};hosts={int(hosts)};"
+            f"mesh={format_mesh_axes(mesh)}")
+
+
+def parse_reshard_spec(raw: str) -> Optional[Tuple[int, int, Dict[str, int]]]:
+    """Inverse of ``format_reshard_spec``: (generation, hosts,
+    mesh_axes), or None on malformed input — a garbled annotation must
+    read as "no request", never crash a poll loop."""
+    try:
+        fields = dict(part.split("=", 1) for part in raw.split(";") if part)
+        gen = int(fields["gen"])
+        hosts = int(fields["hosts"])
+        mesh = parse_mesh_axes(fields.get("mesh", ""))
+    except (KeyError, ValueError):
+        return None
+    if gen < 0 or hosts < 1:
+        return None
+    return gen, hosts, mesh

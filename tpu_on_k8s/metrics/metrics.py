@@ -407,6 +407,39 @@ class TrainMetrics(_MetricsBase):
                           f"Training loop {name}")
 
 
+class ReshardMetrics(_MetricsBase):
+    """Live mesh-reconfiguration observability
+    (`tpu_on_k8s/parallel/reshard.py` transforms driven through
+    `train/loop.py`): how many live reshards ran, how many fell back to
+    the checkpoint-restart path (the fallback counter is the health
+    signal — a climbing rate means live rescale is not paying), the
+    bytes the transfer plans actually moved (leaves whose layout
+    changed; unmoved leaves cost nothing), and the last transform's
+    pause seconds — the number the goodput ledger's ``reshard`` bucket
+    accumulates and `tools/reshard_soak.py` races against the
+    checkpoint-restart arm. Same prometheus + plain-dict mirror pattern
+    as the other classes."""
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        if _prom is not None:
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_reshard"
+        for name, help in (("reshards", "Live mesh reshards applied"),
+                           ("reshard_fallbacks",
+                            "Live reshards aborted and fallen back to "
+                            "checkpoint-restart"),
+                           ("reshard_ack_failures",
+                            "Reshard ack callbacks that raised (the "
+                            "transform outcome stands; the control-plane "
+                            "write did not land)"),
+                           ("bytes_moved",
+                            "Bytes moved by reshard transfer plans")):
+            self._declare(name, f"{ns}_{name}", "counter", help)
+        self._declare("transform_seconds", f"{ns}_transform_seconds",
+                      "gauge", "Last reshard transform pause in seconds")
+
+
 class FleetMetrics(_MetricsBase):
     """Serving-fleet observability (`tpu_on_k8s/serve/fleet.py`): the
     router/rollout layer above per-replica ``ServingMetrics``. Counters

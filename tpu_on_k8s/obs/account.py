@@ -36,9 +36,14 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
-#: waste attribution buckets the training ledger recognizes
+#: waste attribution buckets the training ledger recognizes. ``reshard``
+#: is the live mesh-reconfiguration pause (`parallel/reshard.py` via
+#: `train/loop.py`) — attributed distinctly so a live rescale's cost is
+#: never misclassified as a restart or preemption, and the
+#: ``goodput_fraction`` gauge prices the live path against the
+#: checkpoint-restart path honestly.
 WASTE_KINDS = ("replay", "restart", "recompile", "preempt", "checkpoint",
-               "overhead")
+               "reshard", "overhead")
 
 
 class ServingAccountant:
@@ -204,6 +209,15 @@ class TrainingAccountant:
         if self.metrics is not None:
             self.metrics.set_gauge("goodput_fraction",
                                    self.goodput_fraction())
+
+    def pause(self, kind: str, seconds: float) -> None:
+        """An in-run measured pause (the live-reshard transform): lands
+        in its waste bucket AND counts as run-accounted time, so
+        ``run_complete`` does not re-classify the same seconds as
+        overhead/preempt residual — the pause is attributed exactly
+        once, under its own name."""
+        self.waste(kind, seconds)
+        self._run_accounted += max(float(seconds), 0.0)
 
     def run_complete(self, run_seconds: float, *,
                      preempted: bool = False) -> None:

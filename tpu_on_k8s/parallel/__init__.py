@@ -29,6 +29,14 @@ from tpu_on_k8s.parallel.partition import (
     spec_for_path,
     specs_for_pytree,
 )
+from tpu_on_k8s.parallel.reshard import (
+    ReshardAgent,
+    ReshardNotice,
+    ReshardPlan,
+    plan_reshard,
+    reshard_state,
+    restore_resharded,
+)
 
 __all__ = [
     "AXIS_DATA",
@@ -39,7 +47,13 @@ __all__ = [
     "create_mesh",
     "batch_sharding",
     "PartitionRule",
+    "ReshardAgent",
+    "ReshardNotice",
+    "ReshardPlan",
     "named_sharding",
+    "plan_reshard",
+    "reshard_state",
+    "restore_resharded",
     "shard_pytree",
     "spec_for_path",
     "specs_for_pytree",

@@ -1,0 +1,425 @@
+"""Live mesh reconfiguration: an elastic rescale as a sharded state
+transform, not a cold restart.
+
+Today's rescale path is checkpoint → die → recompile → resume on the new
+fixed mesh — minutes of dead step cadence that PR 11's
+``goodput_fraction`` gauge prices exactly. This module makes the scale
+event a state *transform* (Tenplex, PAPERS.md): model + optimizer state
+are parallelizable tensor collections, and moving a run from
+``(src_mesh, src_rules)`` to ``(dst_mesh, dst_rules)`` is a validated
+per-leaf transfer plan executed without the run ever exiting.
+
+Three layers, smallest first:
+
+* **``plan_reshard``** — walk the params + optimizer-state pytree once,
+  resolving each leaf's source and destination ``PartitionSpec`` through
+  `parallel/partition.rule_for_path` and validating the destination
+  shardings up front (``named_sharding`` raises
+  ``ShardingValidationError`` naming the param path, dim, mesh axis
+  sizes, and matched rule) — an illegal destination shape fails BEFORE
+  any byte moves. The plan knows which leaves actually change layout and
+  how many bytes ride the transfer — the ``ReshardMetrics`` feed.
+
+* **``ReshardPlan.execute``** — the in-process transform: ONE
+  sharding-aware ``jax.device_put`` over the whole tree with donated
+  source buffers (XLA turns it into the minimal shard-to-shard copies;
+  donation means peak memory is src + moved, not 2×state). The chaos
+  site ``SITE_RESHARD`` fires immediately before the donating dispatch —
+  the one atomic step — so an injected ``ReshardAbort`` (or any
+  validation failure) leaves the source state untouched by construction:
+  the fallback to the checkpoint-restart path starts from uncorrupted
+  state.
+
+* **``ReshardNotice``** — the `train/loop.py` integration: the
+  ``reshard_signal`` sibling of ``preemption_signal`` returns one of
+  these; the loop drains its window + pending saves, calls ``apply``,
+  and continues counting global steps on the new mesh. ``apply``
+  transforms the state, rebuilds the step via ``step_builder``, and
+  (when a ``warm_batch`` is provided) AOT-compiles the new program
+  through `train/compile.py` — with the persistent compilation cache
+  mounted, a shape the cluster has seen before warms in milliseconds.
+
+Across restarts the same transform runs through orbax:
+``abstract_resharded`` builds the target-layout abstract tree and
+``CheckpointManager.restore`` lands every shard directly on its new home
+device (per-shard reads — no full-replica host materialization; see
+`train/checkpoint.py`).
+
+The control plane speaks the transform through annotations
+(``ReshardAgent`` below + `controller/autoscaler.py`): the
+ElasticAutoscaler's decision is a *(hosts, mesh shape)* pair constrained
+by `gang/topology` slice legality, delivered to the pod as a reshard
+request rather than a delete — the 2-phase checkpoint protocol's shape,
+but the job never dies.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from tpu_on_k8s import chaos
+from tpu_on_k8s.api import constants
+from tpu_on_k8s.gang import topology
+from tpu_on_k8s.parallel.mesh import mesh_axes
+from tpu_on_k8s.parallel.partition import (
+    PartitionRule,
+    ShardingValidationError,
+    named_sharding,
+    path_str,
+    rule_for_path,
+)
+from tpu_on_k8s.utils.logging import get_logger, kv
+
+__all__ = [
+    "LeafMove", "ReshardPlan", "ReshardNotice", "ReshardAgent",
+    "plan_reshard", "reshard_state", "abstract_resharded",
+    "restore_resharded", "ShardingValidationError",
+]
+
+log = get_logger("parallel.reshard")
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafMove:
+    """One leaf's transfer: where it lives, where it goes, what it costs.
+    ``moved`` is False when source and destination layouts coincide (same
+    spec on the same device set) — those leaves ride the same device_put
+    but transfer nothing."""
+
+    path: str
+    shape: Tuple[int, ...]
+    dtype: str
+    src_spec: str
+    dst_spec: str
+    nbytes: int
+    moved: bool
+
+
+class ReshardPlan:
+    """A validated transfer plan over one state pytree. Built by
+    ``plan_reshard``; ``execute`` runs it in-process. The plan is data —
+    ``describe()`` renders the stable event-log form the soak
+    byte-compares."""
+
+    def __init__(self, moves: List[LeafMove], dst_shardings: Any,
+                 src_axes: Dict[str, int], dst_axes: Dict[str, int]) -> None:
+        self.moves = moves
+        self.dst_shardings = dst_shardings
+        self.src_axes = src_axes
+        self.dst_axes = dst_axes
+
+    # ------------------------------------------------------------- readouts
+    @property
+    def bytes_total(self) -> int:
+        return sum(m.nbytes for m in self.moves)
+
+    @property
+    def bytes_moved(self) -> int:
+        return sum(m.nbytes for m in self.moves if m.moved)
+
+    @property
+    def n_moved(self) -> int:
+        return sum(1 for m in self.moves if m.moved)
+
+    def describe(self) -> str:
+        """Stable one-line form (no timestamps, no device ids) — what the
+        reshard soak's event log carries."""
+        src = ",".join(f"{a}={s}" for a, s in sorted(self.src_axes.items()))
+        dst = ",".join(f"{a}={s}" for a, s in sorted(self.dst_axes.items()))
+        return (f"reshard {src or 'single'} -> {dst or 'single'} "
+                f"leaves={len(self.moves)} moved={self.n_moved} "
+                f"bytes={self.bytes_moved}")
+
+    # -------------------------------------------------------------- execute
+    def execute(self, state: Any, *, donate: bool = True) -> Any:
+        """The in-process transform: one sharding-aware ``device_put``
+        over the whole tree, source buffers donated. The chaos site fires
+        BEFORE the dispatch — an abort here (the injected mid-transform
+        fault) leaves ``state`` untouched, which is the zero-corruption
+        guarantee the checkpoint-restart fallback rests on."""
+        fault = chaos.fire(chaos.SITE_RESHARD, leaves=len(self.moves))
+        if fault is not None:
+            raise fault.to_exception()
+        return jax.device_put(state, self.dst_shardings, donate=donate)
+
+
+def _spec_str(spec: Any) -> str:
+    return str(tuple(spec)) if len(tuple(spec)) else "()"
+
+
+def plan_reshard(state: Any, src_mesh: Any, src_rules: Sequence[PartitionRule],
+                 dst_mesh: Any, dst_rules: Sequence[PartitionRule],
+                 ) -> ReshardPlan:
+    """Compute the validated transfer plan for ``state`` from
+    ``(src_mesh, src_rules)`` to ``(dst_mesh, dst_rules)``.
+
+    Destination shardings are validated leaf-by-leaf up front
+    (``ShardingValidationError`` with the param path, offending dim, mesh
+    axis sizes, and the rule that matched) — illegal destinations fail
+    before any data moves. A leaf whose source and destination layouts
+    coincide (same spec, same device set) is marked unmoved; everything
+    else counts toward ``bytes_moved``.
+    """
+    # validates every destination leaf; raises with path+dim+axis+rule
+    dst_shardings = named_sharding(state, dst_mesh, dst_rules)
+    src_shardings = named_sharding(state, src_mesh, src_rules)
+    from jax.tree_util import tree_flatten_with_path
+    leaves, _ = tree_flatten_with_path(state)
+    dst_leaves = jax.tree.leaves(dst_shardings)
+    src_leaves = jax.tree.leaves(src_shardings)
+    moves: List[LeafMove] = []
+    for (kp, leaf), src_sh, dst_sh in zip(leaves, src_leaves, dst_leaves):
+        path = path_str(kp)
+        _, src_spec = rule_for_path(path, src_rules)
+        dst_spec = dst_sh.spec
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        nbytes = int(getattr(
+            leaf, "nbytes",
+            math.prod(shape) * getattr(dtype, "itemsize", 4) if shape else 4))
+        # layout-identity by SHARDING equivalence, not spec-string
+        # equality: the same ('data','fsdp') spec on a mesh whose axis
+        # sizes changed lays shards out differently and must count as
+        # moved; conversely different specs that happen to place every
+        # shard identically do not.
+        moved = not src_sh.is_equivalent_to(dst_sh, len(shape))
+        moves.append(LeafMove(path=path, shape=shape, dtype=str(dtype),
+                              src_spec=_spec_str(src_spec),
+                              dst_spec=_spec_str(dst_spec),
+                              nbytes=nbytes, moved=moved))
+    return ReshardPlan(moves, dst_shardings,
+                       mesh_axes(src_mesh), mesh_axes(dst_mesh))
+
+
+def reshard_state(state: Any, src_mesh: Any,
+                  src_rules: Sequence[PartitionRule], dst_mesh: Any,
+                  dst_rules: Sequence[PartitionRule], *,
+                  donate: bool = True) -> Tuple[Any, ReshardPlan]:
+    """Plan + execute in one call: (resharded state, the plan that moved
+    it). The convenience form for callers outside the train loop (tools,
+    tests, the serving plane's future weight hot-swap)."""
+    plan = plan_reshard(state, src_mesh, src_rules, dst_mesh, dst_rules)
+    return plan.execute(state, donate=donate), plan
+
+
+def abstract_resharded(state: Any, mesh: Any,
+                       rules: Sequence[PartitionRule]) -> Any:
+    """Target-layout abstract tree (ShapeDtypeStruct + NamedSharding
+    leaves) for a LIVE or abstract state — what
+    ``CheckpointManager.restore`` needs to land a checkpoint written
+    under one layout directly into another (the across-restarts half of
+    the reshard story; no model/optimizer re-init required)."""
+    shardings = named_sharding(state, mesh, rules)
+    return jax.tree.map(
+        lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                              sharding=sh),
+        state, shardings)
+
+
+def restore_resharded(manager: Any, state: Any, mesh: Any,
+                      rules: Sequence[PartitionRule], *,
+                      generation: Optional[int] = None,
+                      step: Optional[int] = None) -> Tuple[Any, int, int]:
+    """Restore the newest checkpoint directly into ``(mesh, rules)`` —
+    the checkpoint-restart arm of a rescale, sharing the layout
+    vocabulary with the live arm. Returns (state, generation, step)."""
+    abstract = abstract_resharded(state, mesh, rules)
+    return manager.restore(abstract, generation=generation, step=step)
+
+
+class ReshardNotice:
+    """What `train/loop.py`'s ``reshard_signal`` returns — the
+    ``PreemptNotice`` sibling that transforms instead of stopping.
+
+    Self-contained: carries the (src, dst) layout pair, an optional
+    ``step_builder(dst_mesh, state) -> step_fn`` that rebuilds the step
+    program for the new mesh (``None`` keeps the current step — valid
+    when the step propagates shardings from its inputs), an optional
+    ``warm_batch`` to AOT-compile the new program through
+    `train/compile.py`'s persistent cache before the first post-reshard
+    dispatch, and an optional ``generation`` so subsequent checkpoints
+    land in the rescale's new generation directory. ``on_applied`` /
+    ``on_failed`` are the control-plane acks (``ReshardAgent`` wires
+    them to the completion annotation)."""
+
+    def __init__(self, src_mesh: Any, src_rules: Sequence[PartitionRule],
+                 dst_mesh: Any, dst_rules: Sequence[PartitionRule], *,
+                 step_builder: Optional[Callable[[Any, Any], Any]] = None,
+                 warm_batch: Any = None,
+                 generation: Optional[int] = None,
+                 tag: str = "",
+                 on_applied: Optional[Callable[[], None]] = None,
+                 on_failed: Optional[Callable[[], None]] = None) -> None:
+        self.src_mesh = src_mesh
+        self.src_rules = list(src_rules)
+        self.dst_mesh = dst_mesh
+        self.dst_rules = list(dst_rules)
+        self.step_builder = step_builder
+        self.warm_batch = warm_batch
+        self.generation = generation
+        self.tag = tag
+        self.on_applied = on_applied
+        self.on_failed = on_failed
+
+    def apply(self, state: Any, step_fn: Any) -> Tuple[Any, Any, ReshardPlan]:
+        """Transform ``state`` and rebuild/warm the step program. Raises
+        before any byte moves on an illegal destination
+        (``ShardingValidationError``) or an injected ``ReshardAbort`` —
+        the caller's ``state`` is still the intact source state then."""
+        plan = plan_reshard(state, self.src_mesh, self.src_rules,
+                            self.dst_mesh, self.dst_rules)
+        new_state = plan.execute(state)
+        new_step = step_fn
+        if self.step_builder is not None:
+            new_step = self.step_builder(self.dst_mesh, new_state)
+        if self.warm_batch is not None and hasattr(new_step, "lower"):
+            # AOT warmup via the persistent compilation cache
+            # (train/compile.py): the compile happens HERE, inside the
+            # accounted reshard pause, not lazily inside the first
+            # post-reshard step — and a cluster-warm cache makes it
+            # near-instant. The compiled executable keeps the jit's
+            # donation/sharding semantics, so it replaces the step 1:1.
+            from tpu_on_k8s.train.compile import aot_compile
+            new_step = aot_compile(new_step, new_state, self.warm_batch)
+        return new_state, new_step, plan
+
+
+# ------------------------------------------------------------ control plane
+# the (hosts, mesh shape) wire form lives jax-free in `gang/topology.py`
+# (the controller formats decisions without importing jax); re-exported
+# here so the compute-plane side of the protocol reads from one module
+format_reshard_spec = topology.format_reshard_spec
+parse_reshard_spec = topology.parse_reshard_spec
+
+
+class ReshardAgent:
+    """Pod-side poll step of the live-reshard protocol — the
+    ``CheckpointAgent`` analog that transforms instead of dying.
+
+    The controller (ElasticAutoscaler with ``elastic_policy.live_reshard``)
+    stamps ``reshard-requested-spec = gen=G;hosts=H;mesh=...``;
+    this agent observes it, asks ``notice_factory(mesh_axes, generation)``
+    for a ``ReshardNotice`` (the factory owns mesh construction and step
+    rebuilding — it knows the model), and hands the notice to the train
+    loop via ``poll`` (wire it as ``TrainLoop(reshard_signal=agent.poll)``).
+    The notice acks on apply (``reshard-completed-spec = G``), which lets
+    `controller/elastic.py` adopt the running pods at the new generation
+    WITHOUT restarting them; a failed transform clears the request so the
+    controller falls back to the cold checkpoint-restart path.
+    """
+
+    def __init__(self, cluster: Any, namespace: str, job_name: str,
+                 notice_factory: Callable[[Dict[str, int], int],
+                                          Optional[ReshardNotice]],
+                 job_cls: Optional[type] = None, *,
+                 min_poll_interval_s: float = 5.0,
+                 clock: Callable[[], float] = None) -> None:
+        if job_cls is None:
+            from tpu_on_k8s.api.types import TPUJob
+            job_cls = TPUJob
+        import time as _time
+        self.cluster = cluster
+        self.namespace = namespace
+        self.job_name = job_name
+        self.notice_factory = notice_factory
+        self.job_cls = job_cls
+        # ``poll`` is wired as TrainLoop's per-step reshard_signal; an
+        # unthrottled poll would pay one TPUJob GET per training step
+        # against a real API server. Requests are rare by construction,
+        # so re-check at most every ``min_poll_interval_s`` (0 disables;
+        # ``clock`` injectable for deterministic tests).
+        self.min_poll_interval_s = max(float(min_poll_interval_s), 0.0)
+        self._clock = clock if clock is not None else _time.monotonic
+        self._last_poll: Optional[float] = None
+
+    def pending_request(self) -> Optional[Tuple[int, int, Dict[str, int]]]:
+        job = self.cluster.try_get(self.job_cls, self.namespace, self.job_name)
+        if job is None:
+            return None
+        ann = job.metadata.annotations or {}
+        raw = ann.get(constants.ANNOTATION_RESHARD_REQUESTED_SPEC)
+        if raw is None:
+            return None
+        parsed = parse_reshard_spec(raw)
+        if parsed is None:
+            return None
+        done = ann.get(constants.ANNOTATION_RESHARD_COMPLETED_SPEC)
+        if done is not None and done.strip().isdigit() \
+                and int(done) >= parsed[0]:
+            return None
+        return parsed
+
+    def poll(self) -> Optional[ReshardNotice]:
+        """The ``TrainLoop.reshard_signal`` callable: a pending request
+        becomes a ``ReshardNotice`` whose acks close the protocol. The
+        factory's own ``on_applied``/``on_failed`` hooks (and an
+        explicit ``generation``) are preserved — the agent CHAINS its
+        acks after them. Rate-limited to ``min_poll_interval_s``."""
+        if self.min_poll_interval_s > 0:
+            now = self._clock()
+            if self._last_poll is not None and \
+                    now - self._last_poll < self.min_poll_interval_s:
+                return None
+            self._last_poll = now
+        pending = self.pending_request()
+        if pending is None:
+            return None
+        gen, hosts, mesh_shape = pending
+        notice = self.notice_factory(mesh_shape, gen)
+        if notice is None:
+            # the factory DECLINED — the requested mesh is not
+            # constructible on this pod's surviving device set (e.g. a
+            # scale-up whose new hosts haven't joined). Withdraw the
+            # request so the controller's hold releases and the cold
+            # checkpoint-restart path executes the rescale instead of
+            # waiting on an ack that can never come.
+            self._clear(gen)
+            return None
+        notice.generation = gen if notice.generation is None \
+            else notice.generation
+        factory_applied, factory_failed = notice.on_applied, notice.on_failed
+
+        def applied() -> None:
+            if factory_applied is not None:
+                factory_applied()
+            self._ack(gen)
+
+        def failed() -> None:
+            if factory_failed is not None:
+                factory_failed()
+            self._clear(gen)
+
+        notice.on_applied = applied
+        notice.on_failed = failed
+        return notice
+
+    def _ack(self, generation: int) -> None:
+        from tpu_on_k8s.client.cluster import NotFoundError
+        try:
+            self.cluster.patch_meta(
+                self.job_cls, self.namespace, self.job_name,
+                annotations={constants.ANNOTATION_RESHARD_COMPLETED_SPEC:
+                             str(generation)})
+        except NotFoundError:
+            # job deleted mid-protocol: the ack is moot — the transform
+            # already succeeded and the run must not die over it
+            pass
+
+    def _clear(self, generation: int) -> None:
+        """A failed transform: withdraw the request so the controller's
+        hold releases and the cold checkpoint-restart path proceeds."""
+        from tpu_on_k8s.client.cluster import NotFoundError
+        kv(log, logging.WARNING, "reshard_request_cleared",
+           generation=generation,
+           job=f"{self.namespace}/{self.job_name}")
+        try:
+            self.cluster.patch_meta(
+                self.job_cls, self.namespace, self.job_name,
+                annotations={constants.ANNOTATION_RESHARD_REQUESTED_SPEC:
+                             None})
+        except NotFoundError:
+            pass

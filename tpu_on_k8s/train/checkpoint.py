@@ -87,10 +87,30 @@ class CheckpointManager:
             mgr.wait_until_finished()
 
     def restore(self, abstract_state: Any, *, generation: Optional[int] = None,
-                step: Optional[int] = None) -> Tuple[Any, int, int]:
+                step: Optional[int] = None, mesh: Any = None,
+                rules: Optional[Sequence[Any]] = None) -> Tuple[Any, int, int]:
         """Restore into the shardings carried by ``abstract_state`` (a pytree
-        of sharded ShapeDtypeStructs — see ``abstract_train_state``). Defaults
-        to the newest generation/step. Returns (state, generation, step)."""
+        of sharded ShapeDtypeStructs — see ``abstract_train_state`` — or a
+        live state used as a template). Defaults to the newest
+        generation/step. Returns (state, generation, step).
+
+        The target sharding may DIFFER from the one the checkpoint was
+        saved under: orbax reads per-shard into the new layout, so each
+        host/device receives exactly its slice of the target
+        ``NamedSharding`` — no full-replica host materialization. That is
+        the restart arm of an elastic rescale
+        (`tpu_on_k8s/parallel/reshard.py`): a checkpoint written on the
+        old (mesh, rules) lands directly on the new one. Passing
+        ``mesh`` + ``rules`` re-lays ``abstract_state``'s shardings onto
+        that target first (validated via ``parallel/partition`` — an
+        illegal layout raises ``ShardingValidationError`` naming the
+        param path and axis before any read starts)."""
+        if (mesh is None) != (rules is None):
+            raise ValueError("pass mesh and rules together (or neither)")
+        if mesh is not None:
+            from tpu_on_k8s.parallel.reshard import abstract_resharded
+
+            abstract_state = abstract_resharded(abstract_state, mesh, rules)
         if generation is None:
             latest = self.latest()
             if latest is None:

@@ -97,6 +97,8 @@ class LoopResult:
     checkpoint_failures: int = 0
     seconds: float = 0.0
     preempted: bool = False
+    reshards: int = 0
+    reshard_fallback: bool = False
 
     @property
     def last_metrics(self) -> Dict[str, Any]:
@@ -122,6 +124,18 @@ class TrainLoop:
         N steps; drained at exit and on preemption.
       preemption_signal: polled once per step; returning True triggers
         final save + drain + clean stop (``LoopResult.preempted``).
+      reshard_signal: the live-rescale sibling of ``preemption_signal``
+        (`tpu_on_k8s/parallel/reshard.py`): polled once per step; a
+        returned ``ReshardNotice`` makes the loop drain its window and
+        pending saves, transform params + optimizer state onto the
+        notice's (mesh, rules), swap in the rebuilt (AOT-warmed) step
+        program, and CONTINUE counting global steps — the run never
+        exits. A transform that fails before its one donating dispatch
+        (validation, injected ``ReshardAbort``) leaves state intact; the
+        loop counts the fallback and exits via the preemption path so
+        the orchestrator's checkpoint-restart rescale takes over.
+      reshard_metrics: optional ``ReshardMetrics`` — reshards/fallback
+        counters, bytes-moved counter, transform-seconds gauge.
       on_metrics: ``(step, metrics_dict, step_seconds)`` per sync window.
       on_stall / stall_timeout: watchdog — with ``stall_timeout > 0`` a
         daemon thread emits one structured stall event per episode when no
@@ -159,6 +173,8 @@ class TrainLoop:
                  checkpoint_every: int = 0,
                  generation: int = 0,
                  preemption_signal: Optional[Callable[[], bool]] = None,
+                 reshard_signal: Optional[Callable[[], Optional[Any]]] = None,
+                 reshard_metrics: Any = None,
                  on_metrics: Optional[Callable[[int, Dict, float], None]] = None,
                  on_stall: Optional[Callable[[Dict], None]] = None,
                  stall_timeout: float = 0.0,
@@ -186,6 +202,8 @@ class TrainLoop:
         self.checkpoint_every = checkpoint_every
         self.generation = generation
         self.preemption_signal = preemption_signal
+        self.reshard_signal = reshard_signal
+        self.reshard_metrics = reshard_metrics
         self.on_metrics = on_metrics
         self.on_stall = on_stall
         self.stall_timeout = stall_timeout
@@ -337,6 +355,19 @@ class TrainLoop:
                         is not None):
                     result.preempted = True
                     break
+                if self.reshard_signal is not None:
+                    notice = self.reshard_signal()
+                    if notice is not None:
+                        t_window = self._do_reshard(result, pending, notice,
+                                                    t_window)
+                        if result.reshard_fallback:
+                            # transform aborted before its donating
+                            # dispatch: state is the intact source —
+                            # exit via the preemption path (final save +
+                            # drain) and let the orchestrator's
+                            # checkpoint-restart rescale take over
+                            result.preempted = True
+                            break
                 try:
                     batch = next(batches)
                 except StopIteration:
@@ -430,6 +461,93 @@ class TrainLoop:
             self.accountant.run_complete(result.seconds,
                                          preempted=result.preempted)
         return result
+
+    # ------------------------------------------------------------- reshard
+    def _do_reshard(self, result: LoopResult, pending: collections.deque,
+                    notice: Any, t_window: float) -> float:
+        """Apply one live-reshard notice: drain the in-flight window and
+        pending saves, transform state + step program, continue. Returns
+        the new window clock (the pause is accounted as ``reshard``
+        waste, never charged to the next window's step time). On a
+        failed transform sets ``result.reshard_fallback`` — state is the
+        intact source (the transform's only mutating step is one atomic
+        donating dispatch, and every failure path fires before it)."""
+        if pending:
+            # surface the partial window first: pre-reshard steps are
+            # attributed at pre-reshard cadence
+            t_window = self._sync_window(result, pending, result.steps,
+                                         t_window)
+        if self.checkpoint_manager is not None:
+            # a save writing the OLD layout must finish before the
+            # buffers it references are donated away
+            try:
+                self.checkpoint_manager.wait_until_finished()
+            except Exception as e:  # noqa: BLE001 — same as the exit drain
+                result.checkpoint_failures += 1
+                kv(log, logging.WARNING, "checkpoint_drain_failed",
+                   error=f"{type(e).__name__}: {e}")
+                if self.metrics is not None:
+                    self.metrics.inc("checkpoint_failures")
+        span = self._tracer.start("train.reshard", step=result.steps,
+                                  **({"tag": notice.tag}
+                                     if getattr(notice, "tag", "") else {}))
+        # the reshard pause is hardware wall time — the measured datum
+        # itself, like the loop's step timing
+        t0 = time.perf_counter()  # analyze: allow[determinism] hardware pause timing is the datum
+        try:
+            new_state, new_step, plan = notice.apply(self.state, self.step_fn)
+        except Exception as e:  # noqa: BLE001 — fall back, never corrupt
+            result.reshard_fallback = True
+            if self.reshard_metrics is not None:
+                self.reshard_metrics.inc("reshard_fallbacks")
+            kv(log, logging.WARNING, "reshard_fallback", step=result.steps,
+               error=f"{type(e).__name__}: {e}")
+            span.set(error=f"{type(e).__name__}: {e}")
+            span.finish("aborted")
+            self._notify_reshard(notice, "on_failed")
+            return t_window
+        # analyze: allow[determinism] hardware pause timing (see above)
+        dt = time.perf_counter() - t0
+        self.state = new_state
+        self.step_fn = new_step
+        if getattr(notice, "generation", None) is not None:
+            # subsequent checkpoints land in the rescale's generation
+            self.generation = notice.generation
+        result.reshards += 1
+        if self.accountant is not None:
+            # attributed DISTINCTLY: a live-reshard pause is neither a
+            # restart nor a preemption (obs/account.py "reshard" bucket)
+            self.accountant.pause("reshard", dt)
+        if self.reshard_metrics is not None:
+            self.reshard_metrics.inc("reshards")
+            self.reshard_metrics.inc("bytes_moved", plan.bytes_moved)
+            self.reshard_metrics.set_gauge("transform_seconds", dt)
+        span.set(bytes_moved=plan.bytes_moved, leaves_moved=plan.n_moved,
+                 seconds=round(dt, 6))
+        span.finish()
+        kv(log, logging.INFO, "reshard", step=result.steps,
+           plan=plan.describe(), seconds=round(dt, 3))
+        self._notify_reshard(notice, "on_applied")
+        self._touch()
+        # analyze: allow[determinism] window clock reset after the pause
+        return time.perf_counter()
+
+    def _notify_reshard(self, notice: Any, hook: str) -> None:
+        """Fire a notice's ack callback (``on_applied``/``on_failed``).
+        The acks are control-plane writes (the ReshardAgent patches the
+        completion annotation): a transient API failure there must not
+        kill a run whose transform already reached its outcome — warned
+        AND counted, never silent, never fatal."""
+        cb = getattr(notice, hook, None)
+        if cb is None:
+            return
+        try:
+            cb()
+        except Exception as e:  # noqa: BLE001 — the run outlives its ack
+            if self.reshard_metrics is not None:
+                self.reshard_metrics.inc("reshard_ack_failures")
+            kv(log, logging.WARNING, "reshard_ack_failed", hook=hook,
+               error=f"{type(e).__name__}: {e}")
 
     # ------------------------------------------------------------- windows
     def _sync_window(self, result: LoopResult, pending: collections.deque,
