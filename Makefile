@@ -6,7 +6,7 @@
 # docker-build produces.
 IMG ?= tpu-on-k8s/manager:latest
 
-.PHONY: test test-fast analyze lint chaos-soak fleet-soak autoscale-soak \
+.PHONY: test test-fast analyze analyze-concurrency lint chaos-soak fleet-soak autoscale-soak \
         disagg-soak spec-soak shard-soak slo-soak reshard-soak trace-demo native bench dryrun manager samples clean \
         docker-build docker-push deploy undeploy
 
@@ -31,8 +31,11 @@ test: analyze lint  ## invariant gate + lint first — they fail in seconds
 test-fast:  ## skip the slow sharded-compile suites
 	python -m pytest tests/ -q -k "not decode and not ring and not moe"
 
-analyze:  ## the five invariant passes (docs/static-analysis.md); exit 0 iff clean
+analyze:  ## the eight invariant passes (docs/static-analysis.md); prints per-pass wall time; exit 0 iff clean
 	python -m tools.analyze
+
+analyze-concurrency:  ## just the three whole-program concurrency passes (iterating on a threading change)
+	python -m tools.analyze --pass thread-roots --pass lockset --pass lock-order
 
 lint:  ## ruff over production+tools (real-bug rules only, [tool.ruff] in pyproject.toml); skipped when ruff is not installed
 	@if command -v ruff >/dev/null 2>&1; then \

@@ -1,0 +1,782 @@
+"""The whole-program concurrency passes (`tools/analyze`) — tier-1 gate.
+
+Four layers, mirroring `test_analyze.py`:
+
+1. **Pass self-tests** — known-bad / known-good fixtures per pass,
+   including the three seeded synthetic violations the acceptance
+   criteria name: the PR 6 DisaggPool.replicas race shape, a two-lock
+   deadlock cycle, and a blocking call under a lock (plus relock and
+   unresolved-spawn shapes).
+2. **Mechanism tests** — suppression round-trips for the new pass ids,
+   the stale-allow (`--prune`) sweep, the content-hash finding cache,
+   and `--diff` scoping.
+3. **The doc gate** — `docs/concurrency.md`'s generated thread-root ×
+   shared-state map byte-compares against the renderer, exactly like
+   the resilience site table.
+4. **Forced-fix regressions** — the races PR 14's passes surfaced stay
+   fixed (analyzer-clean files + behavioral checks).
+"""
+import os
+import sys
+import textwrap
+import threading
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                "..")))
+
+from tools.analyze import run_passes  # noqa: E402
+from tools.analyze.core import RepoIndex, check, load_baseline  # noqa: E402
+from tools.analyze.cache import run_passes_timed  # noqa: E402
+from tools.analyze.passes import (lockorder, locksets,  # noqa: E402
+                                  threadroots)
+from tools.analyze.program import get_program  # noqa: E402
+
+
+def make_repo(tmp_path, files):
+    """A throwaway production tree: {relpath: source} -> RepoIndex."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    (tmp_path / "tests").mkdir(exist_ok=True)
+    return RepoIndex(root=tmp_path)
+
+
+def codes(findings):
+    return {f.code for f in findings}
+
+
+# --------------------------------------------------------------------------
+# the seeded synthetic race: the PR 6 DisaggPool.replicas shape
+# --------------------------------------------------------------------------
+_DISAGG_RACE = {"tpu_on_k8s/pool.py": """
+    import threading
+
+    class DisaggPool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.replicas = []
+
+        def scale_to(self, n):
+            with self._lock:
+                self.replicas = self.replicas[:n]
+    """, "tpu_on_k8s/scaler.py": """
+    import threading
+
+    from tpu_on_k8s.pool import DisaggPool
+
+    class Autoscaler:
+        def __init__(self, pool: DisaggPool):
+            self.pool = pool
+
+        def run(self):
+            t = threading.Thread(target=self._loop, daemon=True,
+                                 name="pool-autoscaler")
+            t.start()
+
+        def _loop(self):
+            while True:
+                self._scrape()
+
+        def _scrape(self):
+            return len(self.pool.replicas)   # no lock: the PR 6 bug
+    """}
+
+
+class TestLocksetPass:
+    def test_refinds_the_disagg_replicas_race(self, tmp_path):
+        repo = make_repo(tmp_path, _DISAGG_RACE)
+        found = locksets.run(repo)
+        assert "unguarded-shared-attr:DisaggPool.replicas" in codes(found)
+
+    def test_common_lock_on_every_access_is_clean(self, tmp_path):
+        files = dict(_DISAGG_RACE)
+        files["tpu_on_k8s/scaler.py"] = files["tpu_on_k8s/scaler.py"].replace(
+            "return len(self.pool.replicas)   # no lock: the PR 6 bug",
+            "with self.pool._lock:\n"
+            "                return len(self.pool.replicas)")
+        repo = make_repo(tmp_path, files)
+        assert locksets.run(repo) == []
+
+    def test_init_only_state_is_clean(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class C:
+                def __init__(self, cfg):
+                    self.cfg = cfg          # written once, pre-spawn
+
+                def run(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    return self.cfg
+        """})
+        assert locksets.run(repo) == []
+
+    def test_threadsafe_container_attr_is_exempt(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import queue
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._q = queue.Queue()
+
+                def run(self):
+                    threading.Thread(target=self._loop).start()
+
+                def feed(self, x):
+                    self._q.put(x)
+
+                def _loop(self):
+                    return self._q.get(timeout=1)
+        """})
+        assert locksets.run(repo) == []
+
+    def test_multi_root_self_race_flags(self, tmp_path):
+        """One function, many threads: a worker pool incrementing an
+        unguarded counter races itself."""
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.done = 0
+
+                def run(self):
+                    for i in range(4):
+                        threading.Thread(target=self._work).start()
+
+                def _work(self):
+                    self.done += 1
+        """})
+        assert "unguarded-shared-attr:C.done" in codes(locksets.run(repo))
+
+    def test_clock_attr_is_state_not_a_lock(self, tmp_path):
+        """Word-boundary lock naming: `_clock` must stay ANALYZED (a
+        substring match would silently exempt it) — here it is rebound
+        across threads with no guard and must flag."""
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._clock = None
+
+                def run(self):
+                    threading.Thread(target=self._loop).start()
+
+                def set_clock(self, fn):
+                    self._clock = fn
+
+                def _loop(self):
+                    return self._clock
+        """})
+        assert "unguarded-shared-attr:C._clock" in codes(locksets.run(repo))
+
+    def test_lambda_body_is_deferred_not_lock_held(self, tmp_path):
+        """Code inside a lambda defined under a lock runs LATER — it
+        must not inherit the definition-site lockset (which would both
+        fabricate blocking-under-lock findings and mask real races)."""
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class C:
+                def __init__(self, q):
+                    self._lock = threading.Lock()
+                    self._q = q
+                    self._cb = None
+
+                def arm(self):
+                    with self._lock:
+                        self._cb = lambda: self._q.get()
+        """})
+        assert lockorder.run(repo) == []
+
+    def test_thread_confined_loop_state_is_clean(self, tmp_path):
+        """The repo convention: run_once() is driven by the loop thread
+        OR the test driver, never both — tick-local state reachable
+        only through the loop does not flag."""
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class Loop:
+                def __init__(self):
+                    self.seq = 0
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self.run_once()
+
+                def run_once(self):
+                    self.seq += 1
+        """})
+        assert locksets.run(repo) == []
+
+
+# --------------------------------------------------------------------------
+# lock-order pass
+# --------------------------------------------------------------------------
+class TestLockOrderPass:
+    def test_two_lock_cycle_flags(self, tmp_path):
+        # the seeded synthetic deadlock: AB in one method, BA in another
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock_a = threading.Lock()
+                    self._lock_b = threading.Lock()
+
+                def ab(self):
+                    with self._lock_a:
+                        with self._lock_b:
+                            pass
+
+                def ba(self):
+                    with self._lock_b:
+                        with self._lock_a:
+                            pass
+        """})
+        found = lockorder.run(repo)
+        assert any(c.startswith("lock-cycle:") for c in codes(found))
+
+    def test_interprocedural_cycle_flags(self, tmp_path):
+        """The cycle's second edge hides in a callee."""
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock_a = threading.Lock()
+                    self._lock_b = threading.Lock()
+
+                def ab(self):
+                    with self._lock_a:
+                        self._take_b()
+
+                def _take_b(self):
+                    with self._lock_b:
+                        pass
+
+                def ba(self):
+                    with self._lock_b:
+                        with self._lock_a:
+                            pass
+        """})
+        found = lockorder.run(repo)
+        assert any(c.startswith("lock-cycle:") for c in codes(found))
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock_a = threading.Lock()
+                    self._lock_b = threading.Lock()
+
+                def ab1(self):
+                    with self._lock_a:
+                        with self._lock_b:
+                            pass
+
+                def ab2(self):
+                    with self._lock_a:
+                        self._take_b()
+
+                def _take_b(self):
+                    with self._lock_b:
+                        pass
+        """})
+        assert lockorder.run(repo) == []
+
+    def test_blocking_call_under_lock_flags(self, tmp_path):
+        # the seeded synthetic: a no-timeout queue.get while a CALLER
+        # holds the lock (the shape region maps cannot see), plus a
+        # bare join directly inside the region
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class C:
+                def __init__(self, q, t):
+                    self._lock = threading.Lock()
+                    self._q = q
+                    self._t = t
+
+                def drain(self):
+                    with self._lock:
+                        self._pull()
+                        self._t.join()
+
+                def _pull(self):
+                    return self._q.get()
+        """})
+        got = codes(lockorder.run(repo))
+        assert "blocking-under-lock:self._q.get" in got
+        assert "blocking-under-lock:self._t.join" in got
+
+    def test_bounded_waits_are_clean(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class C:
+                def __init__(self, q, t):
+                    self._lock = threading.Lock()
+                    self._q = q
+                    self._t = t
+
+                def drain(self):
+                    with self._lock:
+                        x = self._q.get(timeout=1.0)
+                        self._t.join(timeout=2)
+                        return x
+        """})
+        assert lockorder.run(repo) == []
+
+    def test_condition_wait_on_held_lock_is_the_pattern(self, tmp_path):
+        """`self._cond.wait()` inside `with self._cond:` RELEASES the
+        lock — the standard condition pattern must not flag."""
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._ready = []
+
+                def take(self):
+                    with self._cond:
+                        while not self._ready:
+                            self._cond.wait()
+                        return self._ready.pop()
+        """})
+        assert lockorder.run(repo) == []
+
+    def test_relock_on_same_instance_flags(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        pass
+        """})
+        assert "relock:C._lock" in codes(lockorder.run(repo))
+
+    def test_rlock_reacquire_is_clean(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        pass
+        """})
+        assert lockorder.run(repo) == []
+
+
+# --------------------------------------------------------------------------
+# thread-roots pass
+# --------------------------------------------------------------------------
+class TestThreadRootsPass:
+    def _doc(self, repo, tmp_path):
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "concurrency.md").write_text(
+            "# map\n\n" + threadroots.render_concurrency_map(repo)
+            + "\nrest\n")
+        return RepoIndex(root=tmp_path)
+
+    def test_discovers_roots_and_reachability(self, tmp_path):
+        repo = make_repo(tmp_path, _DISAGG_RACE)
+        p = get_program(repo)
+        roots = {r.root_id for r in p.spawns}
+        assert "pool-autoscaler" in roots
+        scrape = "tpu_on_k8s/scaler.py::Autoscaler._scrape"
+        assert p.roots_of[scrape] == frozenset({"pool-autoscaler"})
+
+    def test_unresolved_target_flags(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            def go(fns):
+                threading.Thread(target=fns[0]).start()
+        """})
+        self._doc(repo, tmp_path)
+        got = codes(threadroots.run(RepoIndex(root=tmp_path)))
+        assert "unresolved-thread-target:thread" in got
+
+    def test_positional_target_resolves(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            class C:
+                def go(self):
+                    threading.Thread(None, self._pump).start()
+
+                def _pump(self):
+                    pass
+        """})
+        p = get_program(repo)
+        assert any(r.target.endswith("C._pump") for r in p.spawns)
+        assert p.unresolved_spawns == []
+
+    def test_targetless_thread_is_unresolved_not_invisible(self, tmp_path):
+        """A Thread() with no target (run()-override subclass shape)
+        must surface as a finding, never vanish from the map."""
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import threading
+
+            def go():
+                threading.Thread(daemon=True).start()
+        """})
+        self._doc(repo, tmp_path)
+        got = codes(threadroots.run(RepoIndex(root=tmp_path)))
+        assert "unresolved-thread-target:thread" in got
+
+    def test_current_doc_is_clean_and_stale_doc_flags(self, tmp_path):
+        repo = make_repo(tmp_path, _DISAGG_RACE)
+        repo = self._doc(repo, tmp_path)
+        assert threadroots.run(repo) == []
+        doc = tmp_path / "docs" / "concurrency.md"
+        doc.write_text(doc.read_text().replace("pool-autoscaler",
+                                               "hand-edited"))
+        got = codes(threadroots.run(RepoIndex(root=tmp_path)))
+        assert "doc-map-stale" in got
+
+    def test_write_concurrency_map_heals_the_doc(self, tmp_path):
+        repo = make_repo(tmp_path, _DISAGG_RACE)
+        repo = self._doc(repo, tmp_path)
+        doc = tmp_path / "docs" / "concurrency.md"
+        doc.write_text(doc.read_text().replace("pool-autoscaler",
+                                               "hand-edited"))
+        assert threadroots.write_concurrency_map(
+            RepoIndex(root=tmp_path)) is True
+        assert threadroots.run(RepoIndex(root=tmp_path)) == []
+
+    def test_missing_doc_flags(self, tmp_path):
+        repo = make_repo(tmp_path, _DISAGG_RACE)
+        assert "doc-missing" in codes(threadroots.run(repo))
+
+
+# --------------------------------------------------------------------------
+# suppression round-trips + the stale-allow sweep
+# --------------------------------------------------------------------------
+class TestSuppressionAndPrune:
+    def test_inline_allow_suppresses_lockset_finding(self, tmp_path):
+        """The finding anchors in the file DEFINING the class — the
+        allow lives beside the state, not beside one of N readers."""
+        files = dict(_DISAGG_RACE)
+        files["tpu_on_k8s/pool.py"] = files["tpu_on_k8s/pool.py"].replace(
+            "self.replicas = self.replicas[:n]",
+            "# analyze: allow[lockset] scaler reads a snapshot — worst case one stale tick\n"
+            "                self.replicas = self.replicas[:n]")
+        repo = make_repo(tmp_path, files)
+        findings = run_passes(repo, only=["lockset"])
+        result = check(findings, repo, [], passes=["lockset"])
+        assert result.ok and len(result.inline) == 1
+
+    def test_stale_allow_fails_the_gate(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            def f():
+                # analyze: allow[lockset] nothing here fires
+                return 1
+        """})
+        findings = run_passes(repo, only=["lockset"])
+        result = check(findings, repo, [], passes=["lockset"])
+        assert not result.ok
+        assert [f.code for f in result.stale_allows] == ["stale-allow"]
+
+    def test_stale_allow_outside_pass_subset_is_out_of_scope(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            def f():
+                # analyze: allow[lockset] nothing here fires
+                return 1
+        """})
+        findings = run_passes(repo, only=["determinism"])
+        assert check(findings, repo, [], passes=["determinism"]).ok
+
+
+# --------------------------------------------------------------------------
+# the content-hash finding cache
+# --------------------------------------------------------------------------
+class TestFindingCache:
+    def test_second_run_is_all_hits_and_identical(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": """
+            import time
+
+            def f():
+                return time.time()
+        """})
+        cache = tmp_path / "cache.json"
+        r1 = run_passes_timed(repo, only=["determinism"], cache_path=cache)
+        assert r1.cached["determinism"] == "miss"
+        r2 = run_passes_timed(RepoIndex(root=tmp_path),
+                              only=["determinism"], cache_path=cache)
+        assert r2.cached["determinism"] == "hit"
+        assert [f.fingerprint for f in r1.findings] == \
+            [f.fingerprint for f in r2.findings]
+
+    def test_edit_invalidates_only_the_changed_file(self, tmp_path):
+        repo = make_repo(tmp_path, {
+            "tpu_on_k8s/a.py": "import time\n\ndef fa():\n"
+                               "    return time.time()\n",
+            "tpu_on_k8s/b.py": "def fb():\n    return 1\n"})
+        cache = tmp_path / "cache.json"
+        run_passes_timed(repo, only=["determinism"], cache_path=cache)
+        (tmp_path / "tpu_on_k8s" / "b.py").write_text(
+            "import time\n\ndef fb():\n    return time.monotonic()\n")
+        r2 = run_passes_timed(RepoIndex(root=tmp_path),
+                              only=["determinism"], cache_path=cache)
+        assert r2.cached["determinism"] == "partial"
+        assert "wall-clock:time.monotonic" in codes(r2.findings)
+        assert "wall-clock:time.time" in codes(r2.findings)
+
+    def test_corrupt_cache_is_a_cold_run(self, tmp_path):
+        repo = make_repo(tmp_path, {"tpu_on_k8s/m.py": "def f():\n"
+                                                       "    return 1\n"})
+        cache = tmp_path / "cache.json"
+        cache.write_text("{not json")
+        r = run_passes_timed(repo, only=["determinism"], cache_path=cache)
+        assert r.cached["determinism"] == "miss"
+
+
+# --------------------------------------------------------------------------
+# CLI: --prune / --diff / the map emitters
+# --------------------------------------------------------------------------
+class TestCli:
+    def test_prune_on_the_real_repo_is_clean(self, capsys):
+        from tools.analyze.__main__ import main
+        assert main(["--prune", "--no-cache"]) == 0
+        assert "nothing to prune" in capsys.readouterr().out
+
+    def test_diff_mode_exits_zero_on_clean_changes(self, capsys):
+        from tools.analyze.__main__ import main
+        assert main(["--diff", "--no-cache"]) == 0
+        assert "analyze --diff" in capsys.readouterr().out
+
+    def test_diff_without_git_falls_back_to_full_run(self, capsys,
+                                                     monkeypatch):
+        """git unavailable must NOT read as 'nothing changed' — the
+        gate degrades to the full unscoped run instead."""
+        import tools.analyze.__main__ as cli
+        monkeypatch.setattr(cli, "changed_files", lambda root: None)
+        assert cli.main(["--diff", "--no-cache"]) == 0
+        out = capsys.readouterr().out
+        assert "falling back to a full unscoped run" in out
+        assert "analyze: clean" in out
+
+    def test_emit_concurrency_map_matches_doc(self, capsys):
+        from tools.analyze.__main__ import main
+        assert main(["--emit-concurrency-map"]) == 0
+        out = capsys.readouterr().out
+        doc = RepoIndex().read(threadroots.DOC_REL)
+        assert out.strip() in doc
+
+    def test_timings_are_printed(self, capsys):
+        from tools.analyze.__main__ import main
+        assert main(["--pass", "determinism", "--no-cache"]) == 0
+        assert "timings: determinism" in capsys.readouterr().out
+
+
+# --------------------------------------------------------------------------
+# the repo gate: concurrency map current, concurrency passes clean
+# --------------------------------------------------------------------------
+def test_concurrency_map_doc_matches_generated():
+    """`docs/concurrency.md` carries the generated thread-root ×
+    shared-state map byte-for-byte — the twin of the resilience site
+    table gate."""
+    repo = RepoIndex()
+    doc = repo.read(threadroots.DOC_REL)
+    want = threadroots.render_concurrency_map(repo)
+    begin = doc.find(threadroots.MARK_BEGIN)
+    end = doc.find(threadroots.MARK_END)
+    assert begin >= 0 and end >= 0, "concurrency.md lost its markers"
+    have = doc[begin:end + len(threadroots.MARK_END)] + "\n"
+    assert have == want, (
+        "docs/concurrency.md map is stale — run "
+        "`python -m tools.analyze --write-concurrency-map`")
+
+
+def test_repo_concurrency_passes_reconcile_clean():
+    """The three whole-program passes over the real tree: zero
+    unsuppressed findings, no stale suppressions."""
+    repo = RepoIndex()
+    scope = ["thread-roots", "lockset", "lock-order"]
+    findings = run_passes(repo, only=scope)
+    result = check(findings, repo, load_baseline(), passes=scope)
+    msg = "\n".join(f.render() for f in result.new + result.stale_allows)
+    assert result.ok, f"concurrency gate broken:\n{msg}"
+
+
+# --------------------------------------------------------------------------
+# forced-fix regressions (the races PR 14 surfaced stay fixed)
+# --------------------------------------------------------------------------
+def _lockset_findings_in(rel):
+    repo = RepoIndex()
+    return [f for f in locksets.run(repo) if f.path == rel
+            and repo.file(f.path).suppressed(f) is None]
+
+
+def test_fleetautoscaler_fleet_binding_stays_guarded():
+    """Regression: attach_fleet rebinds `_ServiceState.fleet` under the
+    autoscaler lock and ticks snapshot it there — a tick must never
+    scrape fleet A and apply to fleet B."""
+    baseline_fps = {e.fingerprint for e in load_baseline()}
+    offenders = [f for f in _lockset_findings_in(
+        "tpu_on_k8s/controller/fleetautoscaler.py")
+        if f.fingerprint not in baseline_fps]
+    assert offenders == [], "\n".join(f.render() for f in offenders)
+
+
+def test_cluster_watch_registration_stays_guarded():
+    baseline_fps = {e.fingerprint for e in load_baseline()}
+    offenders = [f for f in _lockset_findings_in(
+        "tpu_on_k8s/client/cluster.py")
+        if f.fingerprint not in baseline_fps]
+    assert offenders == [], "\n".join(f.render() for f in offenders)
+
+
+def test_cluster_watch_registration_races_fanout():
+    """Behavioral: registering watchers from one thread while another
+    emits events must neither crash nor lose a registration."""
+    from tpu_on_k8s.api.core import ObjectMeta, Pod
+    from tpu_on_k8s.client.cluster import InMemoryCluster
+
+    cluster = InMemoryCluster()
+    seen = []
+    stop = threading.Event()
+
+    def register(n=64):
+        for i in range(n):
+            cluster.watch(lambda e, _i=i: seen.append(_i))
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            cluster.create(Pod(metadata=ObjectMeta(
+                name=f"p{i}", namespace="default")))
+            i += 1
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    try:
+        register()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert len(cluster._watchers) == 64
+
+
+def test_nodeagent_reap_timer_cannot_escape_stop():
+    """Behavioral: _schedule_reap racing stop() either lands in the
+    cancelled snapshot or refuses to arm — no timer survives stop()."""
+    from tpu_on_k8s.client.nodeagent import NodeAgentLoop
+
+    class _Cluster:
+        def watch(self, *a, **k):
+            pass
+
+    agent = NodeAgentLoop(_Cluster(), runtime=object())
+    agent._thread = threading.current_thread()   # pretend start() ran
+    agent._schedule_reap(("ns", "a"), delay=60.0)
+    assert len(agent._timers) == 1
+    armed = agent._timers[0]
+    agent._thread = None                         # skip the join in stop()
+    agent.stop()
+    assert agent._timers == []
+    assert armed.finished.is_set()               # cancelled, cannot fire
+    # after stop: arming refuses, nothing leaks
+    agent._thread = threading.current_thread()
+    agent._schedule_reap(("ns", "b"), delay=60.0)
+    assert agent._timers == []
+
+
+def test_coordinator_queuing_message_names_the_locked_tenant():
+    """Regression for the _mark_queuing lock-free re-read: the QUEUING
+    condition carries the tenant captured under the queue lock, even if
+    the map entry vanishes before the status write retries."""
+    import ast
+    import inspect
+
+    from tpu_on_k8s.coordinator.core import Coordinator
+    src = textwrap.dedent(inspect.getsource(Coordinator._mark_queuing))
+    reads = [n.attr for n in ast.walk(ast.parse(src))
+             if isinstance(n, ast.Attribute)]
+    assert "_uid_to_tenant" not in reads, (
+        "_mark_queuing's mutate closure must not re-read _uid_to_tenant "
+        "lock-free — pass the tenant captured under the lock")
+
+
+def test_gang_recovery_runs_exactly_once_under_race():
+    """Behavioral: the scheduler-loop tick and a leadership resync()
+    racing into _ensure_recovered must rebuild the inventory once —
+    the loser of the race must not re-run recovery over fresh state."""
+    from tpu_on_k8s.client.cluster import InMemoryCluster
+    from tpu_on_k8s.gang.scheduler import NodePool, SliceGangAdmission
+
+    adm = SliceGangAdmission(
+        InMemoryCluster(),
+        pools=[NodePool("tpu", "tpu-v5-lite-podslice", "4x4",
+                        num_slices=2)])
+    calls = []
+    gate = threading.Barrier(3, timeout=5)
+
+    def slow_recover():
+        calls.append(1)
+
+    adm._recover_allocations = slow_recover
+    adm._recovered = False
+
+    def racer():
+        gate.wait()
+        adm._ensure_recovered()
+
+    threads = [threading.Thread(target=racer) for _ in range(2)]
+    for t in threads:
+        t.start()
+    gate.wait()
+    for t in threads:
+        t.join(timeout=5)
+    assert len(calls) == 1
+    assert adm._recovered is True
+
+
+def test_kvstore_ensure_reads_entries_under_lock():
+    """Regression for the overflow-tier hygiene fix: `ensure` must not
+    index `self._entries` outside the lock (concurrent ensure/evict
+    calls mutate it under the lock)."""
+    import inspect
+    import re
+
+    from tpu_on_k8s.serve.kvstore import FleetPrefixStore
+    src = textwrap.dedent(inspect.getsource(FleetPrefixStore.ensure))
+    depth = 0
+    for line in src.splitlines():
+        stripped = line.strip()
+        indent = len(line) - len(line.lstrip())
+        if stripped.startswith("with self._lock"):
+            depth = indent
+            continue
+        if depth and stripped and indent <= depth:
+            depth = 0
+        if not depth and re.search(r"self\._entries\[", line):
+            raise AssertionError(
+                f"ensure() reads _entries outside the lock: {stripped}")

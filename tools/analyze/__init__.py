@@ -1,6 +1,6 @@
 """Repo-native invariant analyzers — the tier-1 static-analysis gate.
 
-Five passes over the production tree (``tpu_on_k8s/``), each enforcing
+Eight passes over the production tree (``tpu_on_k8s/``), each enforcing
 an invariant the replay/zero-loss proofs depend on:
 
 =================  =====================================================
@@ -17,11 +17,23 @@ chaos-coverage     every ``SITE_*`` fault site is registered, fired,
                    generated `docs/resilience.md` table
 metrics-schema     every declared metric family is observed somewhere
                    and renders under both exposition backends
+thread-roots       every thread entrypoint is statically visible; the
+                   generated `docs/concurrency.md` thread-root ×
+                   shared-state map is current (byte-compared)
+lockset            shared mutable class attributes have a lock common
+                   to every concurrent access pair (interprocedural,
+                   Eraser-style, over thread-root reachability)
+lock-order         the lock-acquisition graph is cycle-free; no
+                   same-instance relock; no unbounded wait while a
+                   lock may be held (including by a caller)
 =================  =====================================================
 
-Run ``python -m tools.analyze`` (or ``make analyze``). Accepted findings
-live in ``tools/analyze/baseline.json`` — every entry justified, stale
-entries fail the gate. See `docs/static-analysis.md`.
+Run ``python -m tools.analyze`` (or ``make analyze``;
+``make analyze-concurrency`` for just the whole-program passes).
+Accepted findings live in ``tools/analyze/baseline.json`` — every entry
+justified; stale entries AND stale inline allow-comments fail the gate.
+Findings are cached by content hash (`tools/analyze/cache.py`); see
+`docs/static-analysis.md`.
 """
 from __future__ import annotations
 

@@ -3,16 +3,23 @@
 Usage::
 
     python -m tools.analyze                  # run all passes, check baseline
-    python -m tools.analyze --pass determinism --pass silent-loss
+    python -m tools.analyze --pass lockset --pass lock-order
     python -m tools.analyze --fix-baseline   # accept current findings (TODO
                                              # justifications — fill them in)
-    python -m tools.analyze --emit-site-table   # print the generated
-                                                # resilience.md chaos table
-    python -m tools.analyze --write-site-table  # splice it into the doc
+    python -m tools.analyze --diff           # scope findings to files changed
+                                             # vs HEAD (pre-commit)
+    python -m tools.analyze --prune          # report stale allow-comments and
+                                             # stale baseline entries only
+    python -m tools.analyze --emit-site-table        # chaos table to stdout
+    python -m tools.analyze --write-site-table       # splice into resilience.md
+    python -m tools.analyze --emit-concurrency-map   # thread-root map to stdout
+    python -m tools.analyze --write-concurrency-map  # splice into concurrency.md
+    python -m tools.analyze --no-cache       # ignore .analyze-cache.json
     python -m tools.analyze -v               # also list suppressed findings
 
 Exit code 0 iff there are no unsuppressed findings, no stale baseline
-entries, and no unjustified suppressions.
+entries, no stale allow-comments, and no unjustified suppressions.
+Every run prints per-pass wall time (`make analyze` surfaces it).
 """
 from __future__ import annotations
 
@@ -21,9 +28,17 @@ import sys
 from pathlib import Path
 
 from tools.analyze import (PASSES, RepoIndex, check, fix_baseline,
-                           load_baseline, run_passes, save_baseline)
+                           load_baseline, save_baseline)
+from tools.analyze.cache import changed_files, run_passes_timed
 from tools.analyze.core import BASELINE_PATH
-from tools.analyze.passes import chaoscov
+from tools.analyze.passes import chaoscov, threadroots
+
+
+def _timings_line(report) -> str:
+    cells = [f"{pid} {secs:.2f}s[{report.cached.get(pid, '-')}]"
+             for pid, secs in report.timings]
+    total = sum(s for _, s in report.timings)
+    return f"timings: {' | '.join(cells)} | total {total:.2f}s"
 
 
 def main(argv=None) -> int:
@@ -39,11 +54,28 @@ def main(argv=None) -> int:
                     help="rewrite the baseline to the current findings: "
                          "keep matched justifications, add new entries as "
                          "TODO, expire stale ones")
+    ap.add_argument("--diff", action="store_true",
+                    help="report only findings in files changed vs HEAD "
+                         "(staged+unstaged+untracked); stale-entry "
+                         "enforcement is skipped — a partial view cannot "
+                         "judge the whole baseline")
+    ap.add_argument("--prune", action="store_true",
+                    help="report ONLY stale suppressions: allow-comments "
+                         "and baseline entries whose finding no longer "
+                         "fires (exit 1 if any — zero-grace expiry)")
     ap.add_argument("--emit-site-table", action="store_true",
                     help="print the generated chaos-site table and exit")
     ap.add_argument("--write-site-table", action="store_true",
                     help="splice the generated chaos-site table into "
                          "docs/resilience.md and exit")
+    ap.add_argument("--emit-concurrency-map", action="store_true",
+                    help="print the generated thread-root × shared-state "
+                         "map and exit")
+    ap.add_argument("--write-concurrency-map", action="store_true",
+                    help="splice the generated concurrency map into "
+                         "docs/concurrency.md and exit")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write .analyze-cache.json")
     ap.add_argument("--root", type=Path, default=None,
                     help="repo root to analyze (default: this repo)")
     ap.add_argument("-v", "--verbose", action="store_true",
@@ -58,8 +90,18 @@ def main(argv=None) -> int:
         changed = chaoscov.write_site_table(repo)
         print("site table " + ("updated" if changed else "already current"))
         return 0
+    if args.emit_concurrency_map:
+        sys.stdout.write(threadroots.render_concurrency_map(repo))
+        return 0
+    if args.write_concurrency_map:
+        changed = threadroots.write_concurrency_map(repo)
+        print("concurrency map "
+              + ("updated" if changed else "already current"))
+        return 0
 
-    findings = run_passes(repo, only=args.passes)
+    report = run_passes_timed(repo, only=args.passes,
+                              use_cache=not args.no_cache)
+    findings = report.findings
     baseline = load_baseline(args.baseline)
     if args.fix_baseline:
         entries = fix_baseline(findings, repo, baseline,
@@ -72,6 +114,37 @@ def main(argv=None) -> int:
 
     result = check(findings, repo, baseline,
                    passes=args.passes or list(PASSES))
+
+    if args.prune:
+        for f in result.stale_allows:
+            print(f.render())
+        for e in result.stale:
+            print(f"stale baseline entry (matches no current finding — "
+                  f"run --fix-baseline to expire):\n    {e.fingerprint}")
+        n = len(result.stale_allows) + len(result.stale)
+        print(f"prune: {n} stale suppression(s)"
+              + ("" if n else " — nothing to prune"))
+        return 1 if n else 0
+
+    if args.diff:
+        changed = changed_files(repo.root)
+        if changed is None:
+            # git unavailable/failed: an empty scope here would wave
+            # real findings through — degrade to the FULL gate instead
+            print("analyze --diff: git unavailable — falling back to a "
+                  "full unscoped run")
+        else:
+            scope = set(changed)
+            kept = [f for f in result.new if f.path in scope]
+            blanks = [f for f in result.blank_allows if f.path in scope]
+            for f in kept + blanks:
+                print(f.render())
+            print(_timings_line(report))
+            n = len(kept) + len(blanks)
+            print(f"analyze --diff: {n} finding(s) in {len(scope)} "
+                  f"changed file(s)" + ("" if n else " — clean"))
+            return 1 if n else 0
+
     if args.verbose:
         for f, why in result.inline:
             print(f"allowed  {f.path}:{f.line} [{f.pass_id}] {f.code} — "
@@ -83,20 +156,25 @@ def main(argv=None) -> int:
         print(f.render())
     for f in result.blank_allows:
         print(f.render())
+    for f in result.stale_allows:
+        print(f.render())
     for e in result.unjustified:
         print(f"baseline entry needs a real justification "
               f"(currently {e.justification!r}):\n    {e.fingerprint}")
     for e in result.stale:
         print(f"stale baseline entry (matches no current finding — "
               f"run --fix-baseline to expire):\n    {e.fingerprint}")
+    print(_timings_line(report))
     n_suppressed = len(result.inline) + len(result.baselined)
     if result.ok:
-        print(f"analyze: clean — {len(PASSES) if not args.passes else len(args.passes)} "
+        print(f"analyze: clean — "
+              f"{len(PASSES) if not args.passes else len(args.passes)} "
               f"pass(es), {n_suppressed} suppressed finding(s), 0 new")
         return 0
     print(f"analyze: FAILED — {len(result.new)} new, {len(result.stale)} "
           f"stale, {len(result.unjustified)} unjustified, "
-          f"{len(result.blank_allows)} blank allow(s) "
+          f"{len(result.blank_allows)} blank allow(s), "
+          f"{len(result.stale_allows)} stale allow(s) "
           f"({n_suppressed} suppressed)")
     return 1
 

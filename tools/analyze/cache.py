@@ -1,0 +1,215 @@
+"""Incremental finding cache + timed pass runner.
+
+The whole-program concurrency passes made `make analyze` do real work,
+so repeated runs cache per-pass findings keyed by **content hashes** —
+never by mtime, never by git state:
+
+* a **file-granular** pass (``GRANULARITY = "file"`` on the pass
+  module: determinism, lock-discipline, silent-loss) caches findings
+  per production file, keyed by that file's digest — editing one file
+  re-scans one file;
+* a **repo-granular** pass (everything whole-program or cross-checking)
+  caches one findings list keyed by the digest of every input it can
+  read: the production tree, ``tests/``, and the generated docs — any
+  change re-runs the pass.
+
+Every key additionally folds in the **analyzer digest** (the content of
+``tools/analyze/**.py`` itself), so changing a pass invalidates its own
+cache — version skew cannot serve stale findings. The cache file
+(``.analyze-cache.json`` at the repo root, gitignored) is disposable;
+a corrupt or missing cache is a cold run, never an error.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+import hashlib
+import json
+import time
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.analyze.core import Finding, RepoIndex
+from tools.analyze.passes import MODULES, PASSES
+
+CACHE_REL = ".analyze-cache.json"
+_CACHE_VERSION = 1
+
+
+def _digest(*chunks: str) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    for c in chunks:
+        h.update(c.encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def analyzer_digest() -> str:
+    """Digest of the analyzer's own sources — the version key that
+    invalidates every cache entry when any pass changes."""
+    root = Path(__file__).resolve().parent
+    parts = []
+    for p in sorted(root.rglob("*.py")):
+        if "__pycache__" not in p.parts:
+            parts.append(p.relative_to(root).as_posix())
+            parts.append(p.read_text())
+    return _digest(*parts)
+
+
+def repo_digest(repo: RepoIndex) -> str:
+    """Digest of everything any repo-granular pass reads: production
+    sources, tests, and the generated docs."""
+    parts: List[str] = []
+    for src in repo.files:
+        parts.append(src.rel)
+        parts.append(src.text)
+    tests_dir = repo.root / "tests"
+    if tests_dir.exists():
+        for p in sorted(tests_dir.rglob("*.py")):
+            if "__pycache__" not in p.parts:
+                parts.append(p.relative_to(repo.root).as_posix())
+                parts.append(p.read_text())
+    for rel in ("docs/resilience.md", "docs/concurrency.md"):
+        if repo.exists(rel):
+            parts.append(rel)
+            parts.append(repo.read(rel))
+    return _digest(*parts)
+
+
+def _load(path: Path) -> Dict:
+    try:
+        data = json.loads(path.read_text())
+        if data.get("version") == _CACHE_VERSION:
+            return data
+    except (OSError, ValueError):
+        pass
+    return {"version": _CACHE_VERSION, "entries": {}}
+
+
+def _finding_to_dict(f: Finding) -> Dict:
+    return dataclasses.asdict(f)
+
+
+def _finding_from_dict(d: Dict) -> Finding:
+    return Finding(**d)
+
+
+@dataclasses.dataclass
+class RunReport:
+    findings: List[Finding]
+    timings: List[Tuple[str, float]]       # (pass id, seconds) in run order
+    cached: Dict[str, str]                 # pass id -> "hit"|"miss"|"partial"
+
+
+def run_passes_timed(repo: RepoIndex, only: Optional[Iterable[str]] = None,
+                     cache_path: Optional[Path] = None,
+                     use_cache: bool = True) -> RunReport:
+    """`run_passes` with per-pass wall time and the content-hash cache.
+    Findings come back in the same stable order `run_passes` produces."""
+    cache_path = cache_path or (repo.root / CACHE_REL)
+    cache = _load(cache_path) if use_cache else {"version": _CACHE_VERSION,
+                                                 "entries": {}}
+    entries: Dict = cache["entries"]
+    aver = analyzer_digest()
+    rdigest: Optional[str] = None          # lazy: file-only runs skip it
+    findings: List[Finding] = []
+    timings: List[Tuple[str, float]] = []
+    cached: Dict[str, str] = {}
+    dirty = False
+    for pass_id, run in PASSES.items():
+        if only and pass_id not in only:
+            continue
+        t0 = time.perf_counter()
+        granularity = getattr(MODULES[pass_id], "GRANULARITY", "repo")
+        if granularity == "file":
+            hits = misses = 0
+            stale_files: List = []
+            for src in repo.files:
+                key = f"{pass_id}:file:{src.rel}"
+                want = _digest(aver, src.text)
+                ent = entries.get(key)
+                if ent is not None and ent.get("digest") == want:
+                    findings.extend(_finding_from_dict(d)
+                                    for d in ent["findings"])
+                    hits += 1
+                else:
+                    stale_files.append((src, key, want))
+                    misses += 1
+            if stale_files:
+                sub = copy.copy(repo)
+                sub.files = [s for s, _, _ in stale_files]
+                got = run(sub)
+                by_rel: Dict[str, List[Finding]] = {}
+                for f in got:
+                    by_rel.setdefault(f.path, []).append(f)
+                for src, key, want in stale_files:
+                    fs = by_rel.get(src.rel, [])
+                    entries[key] = {
+                        "digest": want,
+                        "findings": [_finding_to_dict(f) for f in fs]}
+                    findings.extend(fs)
+                    dirty = True
+            cached[pass_id] = ("hit" if not misses
+                               else "miss" if not hits else "partial")
+        else:
+            if rdigest is None:
+                rdigest = repo_digest(repo)
+            key = f"{pass_id}:repo"
+            want = _digest(aver, rdigest)
+            ent = entries.get(key)
+            if ent is not None and ent.get("digest") == want:
+                findings.extend(_finding_from_dict(d)
+                                for d in ent["findings"])
+                cached[pass_id] = "hit"
+            else:
+                got = run(repo)
+                entries[key] = {"digest": want,
+                                "findings": [_finding_to_dict(f)
+                                             for f in got]}
+                findings.extend(got)
+                cached[pass_id] = "miss"
+                dirty = True
+        timings.append((pass_id, time.perf_counter() - t0))
+    if use_cache and dirty:
+        try:
+            cache_path.write_text(json.dumps(cache))
+        except OSError:
+            pass                            # read-only checkout: cold runs
+    # same stable order + dedup as tools.analyze.run_passes
+    seen = set()
+    out: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.pass_id, f.path, f.line,
+                                             f.code)):
+        k = (f.fingerprint, f.line)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return RunReport(findings=out, timings=timings, cached=cached)
+
+
+def changed_files(root: Path) -> Optional[List[str]]:
+    """Repo-relative paths changed vs HEAD (staged + unstaged +
+    untracked) — the `--diff` scope for pre-commit runs. Returns
+    **None** when git is unavailable or fails — callers must fall back
+    to a full unscoped run, NOT treat it as "nothing changed" (that
+    would pass real findings through a green gate)."""
+    import subprocess
+    try:
+        # -uall: without it porcelain collapses an untracked directory
+        # to one 'dir/' entry, which would never match a finding's file
+        # path — a brand-new package would pass --diff silently
+        out = subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=all"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+            check=True).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None
+    rels: List[str] = []
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:                  # rename: take the new side
+            path = path.split(" -> ", 1)[1]
+        rels.append(path.strip('"'))
+    return sorted(set(rels))

@@ -103,10 +103,17 @@ class SourceFile:
         """The justification if an inline allow-comment covers this
         finding (same line or the line above), else None. An allow with
         an EMPTY justification never matches — it is reported instead."""
+        site = self.suppression_site(finding)
+        return None if site is None else site[1]
+
+    def suppression_site(self, finding: Finding) -> Optional[Tuple[int, str]]:
+        """(comment line, justification) of the allow-comment covering
+        this finding — the consumption record the stale-allow sweep
+        reconciles against."""
         for line in (finding.line, finding.line - 1):
             entry = self.suppressions.get(line)
             if entry and entry[0] == finding.pass_id and entry[1]:
-                return entry[1]
+                return line, entry[1]
         return None
 
     def blank_suppressions(self) -> List[Tuple[int, str]]:
@@ -203,7 +210,9 @@ def save_baseline(entries: Iterable[BaselineEntry],
                      "justification": e.justification}
                     for e in sorted(entries, key=lambda e: e.fingerprint)],
     }
-    path.write_text(json.dumps(data, indent=2) + "\n")
+    # ensure_ascii=False: justifications are human-written prose — the
+    # default \uXXXX escaping garbles every non-ASCII dash on rewrite
+    path.write_text(json.dumps(data, indent=2, ensure_ascii=False) + "\n")
 
 
 @dataclasses.dataclass
@@ -216,11 +225,14 @@ class CheckResult:
     stale: List[BaselineEntry]             # baseline entries matching nothing
     unjustified: List[BaselineEntry]       # matched entries with no real why
     blank_allows: List[Finding]            # allow-comments with no why
+    #: allow-comments that suppressed NOTHING this run — dead weight,
+    #: expired with the same zero-grace rule stale baseline entries get
+    stale_allows: List[Finding] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
         return not (self.new or self.stale or self.unjustified
-                    or self.blank_allows)
+                    or self.blank_allows or self.stale_allows)
 
 
 def check(findings: List[Finding], repo: RepoIndex,
@@ -240,10 +252,13 @@ def check(findings: List[Finding], repo: RepoIndex,
     baselined: List[Tuple[Finding, str]] = []
     inline: List[Tuple[Finding, str]] = []
     unjustified_fps = set()
+    used_allows = set()                   # (path, comment line) consumed
     for f in findings:
         src = repo.file(f.path)
-        why = src.suppressed(f) if src is not None else None
-        if why is not None:
+        site = src.suppression_site(f) if src is not None else None
+        if site is not None:
+            line, why = site
+            used_allows.add((f.path, line))
             inline.append((f, why))
             # a baseline entry covering the same fingerprint is redundant
             # but matched — it must not read as stale (``--fix-baseline``
@@ -263,6 +278,7 @@ def check(findings: List[Finding], repo: RepoIndex,
     stale = [e for e in baseline if e.fingerprint not in matched_fps]
     unjustified = [by_fp[fp] for fp in sorted(unjustified_fps)]
     blank = []
+    stale_allows = []
     scope = set(passes) if passes is not None else None
     for src in repo.files:
         for line, pass_id in src.blank_suppressions():
@@ -272,7 +288,20 @@ def check(findings: List[Finding], repo: RepoIndex,
                 pass_id, src.rel, line, "<comment>", "blank-suppression",
                 "allow-comment carries no justification — write why, or "
                 "remove it"))
-    return CheckResult(new, baselined, inline, stale, unjustified, blank)
+        for line, (pass_id, why) in sorted(src.suppressions.items()):
+            if not why:
+                continue                     # blank: reported above
+            if scope is not None and pass_id not in scope:
+                continue                     # that pass didn't run
+            if (src.rel, line) not in used_allows:
+                stale_allows.append(Finding(
+                    pass_id, src.rel, line, "<comment>", "stale-allow",
+                    f"allow[{pass_id}] comment suppresses nothing — the "
+                    f"finding was fixed (or never fired); remove the "
+                    f"comment (same zero-grace expiry as stale baseline "
+                    f"entries)"))
+    return CheckResult(new, baselined, inline, stale, unjustified, blank,
+                       stale_allows)
 
 
 def fix_baseline(findings: List[Finding], repo: RepoIndex,

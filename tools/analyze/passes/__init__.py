@@ -1,8 +1,17 @@
-"""The five invariant passes, keyed by their stable pass ids."""
+"""The invariant passes, keyed by their stable pass ids.
+
+Five intraprocedural passes (PR 8) plus the three whole-program
+concurrency passes (`tools/analyze/program.py` substrate). Each module
+may declare ``GRANULARITY = "file"`` when its findings for a file
+depend on that file alone — the incremental cache re-runs those only
+for changed files; everything else is whole-program and re-runs when
+any production file changes.
+"""
 from __future__ import annotations
 
-from tools.analyze.passes import (chaoscov, determinism, locks,
-                                  metricsschema, silentloss)
+from tools.analyze.passes import (chaoscov, determinism, lockorder, locks,
+                                  locksets, metricsschema, silentloss,
+                                  threadroots)
 
 #: pass id -> run(repo) callable, in report order
 PASSES = {
@@ -11,4 +20,19 @@ PASSES = {
     silentloss.PASS_ID: silentloss.run,
     chaoscov.PASS_ID: chaoscov.run,
     metricsschema.PASS_ID: metricsschema.run,
+    threadroots.PASS_ID: threadroots.run,
+    locksets.PASS_ID: locksets.run,
+    lockorder.PASS_ID: lockorder.run,
+}
+
+#: pass id -> module (granularity + doc hooks live on the module)
+MODULES = {
+    determinism.PASS_ID: determinism,
+    locks.PASS_ID: locks,
+    silentloss.PASS_ID: silentloss,
+    chaoscov.PASS_ID: chaoscov,
+    metricsschema.PASS_ID: metricsschema,
+    threadroots.PASS_ID: threadroots,
+    locksets.PASS_ID: locksets,
+    lockorder.PASS_ID: lockorder,
 }

@@ -29,6 +29,7 @@ from typing import List, Optional
 from tools.analyze.core import Finding, RepoIndex, SourceFile, call_name
 
 PASS_ID = "determinism"
+GRANULARITY = "file"  # findings depend on this file alone (cacheable per file)
 
 _WALL_CLOCK = {"time.time", "time.monotonic", "time.perf_counter",
                "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns"}

@@ -1,0 +1,217 @@
+"""Lock-order pass: no acquisition cycles, no blocking waits under a lock.
+
+Three interprocedural checks over `program.ProgramIndex`'s lock
+machinery (lock identity is ``ClassName._attr`` — per class, not per
+instance — so same-instance conclusions only flow through ``self.*``
+call chains):
+
+* **lock-cycle** — the acquisition graph has an edge ``A → B`` whenever
+  some path acquires ``B`` while possibly holding ``A`` (may-analysis:
+  local ``with`` nesting plus caller context). A strongly connected
+  component of ≥2 locks is a potential AB/BA deadlock. Self-edges are
+  excluded — two *instances* of one class locking each other is
+  hierarchy, not a cycle this analysis can rank.
+* **relock** — a ``self.m()`` chain that re-acquires a lock the caller
+  already *must* hold, on the same instance, with a non-reentrant
+  ``threading.Lock``: guaranteed self-deadlock the moment the path
+  executes. (``RLock``-built locks are exempt.)
+* **blocking-under-lock** — while a lock may be held (locally or in a
+  caller), the code reaches an unbounded wait: bare ``.join()``, a
+  no-timeout ``queue.get()``, a bare ``.wait()`` on anything other
+  than the held lock's own condition, or network/subprocess calls.
+  ``time.sleep`` and direct I/O *inside* a ``with self._lock:`` region
+  stay the intraprocedural `lock-discipline` pass's findings; this pass
+  reports them only when the lock is held by a **caller** — the case
+  region maps cannot see.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from tools.analyze.core import Finding, RepoIndex
+from tools.analyze.program import CallSite, ProgramIndex, get_program
+
+PASS_ID = "lock-order"
+
+#: dotted-name prefixes that block on external resources
+_BLOCKING_PREFIXES = ("socket.", "subprocess.", "urllib.", "requests.",
+                      "http.client.")
+
+
+def _acquisition_edges(p: ProgramIndex) -> Dict[Tuple[str, str], Tuple]:
+    """(held, acquired) -> witness acquire, excluding self-edges."""
+    edges: Dict[Tuple[str, str], Tuple] = {}
+    for a in sorted(p.acquires, key=lambda a: (a.rel, a.line, a.lock)):
+        ctx = p.may_hold_at(a.func, a.held)
+        for held in sorted(ctx):
+            if held != a.lock:
+                edges.setdefault((held, a.lock), (a.rel, a.line, a.func))
+    return edges
+
+
+def _sccs(nodes: Set[str],
+          adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan SCCs, deterministic order, only components of size ≥ 2."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strong(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in sorted(adj.get(v, ())):
+            if w not in index:
+                strong(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) >= 2:
+                out.append(sorted(comp))
+
+    for v in sorted(nodes):
+        if v not in index:
+            strong(v)
+    return out
+
+
+def _self_acquires(p: ProgramIndex) -> Dict[str, Set[str]]:
+    """Per function: locks acquired on `self` there or via transitive
+    same-instance (`self.m()`) calls — the relock reachability set."""
+    direct: Dict[str, Set[str]] = {}
+    for a in p.acquires:
+        fn = p.functions.get(a.func)
+        if fn is not None and fn.class_qual is not None \
+                and a.lock.startswith(f"{fn.class_qual}."):
+            direct.setdefault(a.func, set()).add(a.lock)
+    self_calls: Dict[str, Set[str]] = {}
+    for c in p.calls:
+        if c.same_instance and c.callee is not None:
+            self_calls.setdefault(c.caller, set()).add(c.callee)
+    result = {k: set(v) for k, v in direct.items()}
+    for _ in range(30):                       # bounded fixpoint
+        changed = False
+        for caller, callees in self_calls.items():
+            acc = result.setdefault(caller, set())
+            before = len(acc)
+            for callee in callees:
+                acc |= result.get(callee, set())
+            changed = changed or len(acc) != before
+        if not changed:
+            break
+    return result
+
+
+def _reentrant_locks(p: ProgramIndex) -> Set[str]:
+    """Lock identities built from threading.RLock (re-acquiring those
+    on one thread is legal by design)."""
+    out: Set[str] = set()
+    for infos in p.classes.values():
+        for info in infos:
+            for attr, ctor in info.attr_ctor.items():
+                if ctor.rsplit(".", 1)[-1] == "RLock":
+                    out.add(f"{info.qual}.{attr}")
+    return out
+
+
+def _blocking(c: CallSite) -> Tuple[str, str]:
+    """(code-leaf, reason) when this call can block unboundedly, else
+    ('', '')."""
+    leaf = c.name.rsplit(".", 1)[-1] if c.name else ""
+    if leaf == "join" and c.nargs == 0 and not c.has_timeout:
+        return ("join", "an unbounded `.join()`")
+    if leaf == "get" and c.nargs == 0 and not c.has_timeout:
+        return ("queue-get", "a no-timeout `.get()` (blocks forever on "
+                             "an empty queue)")
+    if leaf == "wait" and c.nargs == 0 and not c.has_timeout:
+        return ("wait", "a bare `.wait()` with no timeout")
+    if c.name == "time.sleep":
+        return ("sleep", "`time.sleep`")
+    if any(c.name.startswith(pfx) for pfx in _BLOCKING_PREFIXES):
+        return ("net", f"`{c.name}` (network/subprocess I/O)")
+    return ("", "")
+
+
+def run(repo: RepoIndex) -> List[Finding]:
+    p = get_program(repo)
+    out: List[Finding] = []
+
+    # -- lock-cycle ------------------------------------------------------
+    edges = _acquisition_edges(p)
+    adj: Dict[str, Set[str]] = {}
+    nodes: Set[str] = set()
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+        nodes.update((a, b))
+    for comp in _sccs(nodes, adj):
+        witnesses = sorted((edges[(a, b)], a, b) for a in comp
+                           for b in comp if (a, b) in edges)
+        (rel, line, func), wa, wb = witnesses[0]
+        fn = p.functions.get(func)
+        qual = fn.qualname if fn is not None else "<module>"
+        out.append(Finding(
+            PASS_ID, rel, line, qual,
+            f"lock-cycle:{'->'.join(comp)}",
+            f"locks {{{', '.join(comp)}}} are acquired in conflicting "
+            f"orders (here: `{wb}` while holding `{wa}`) — two threads "
+            f"taking opposite paths deadlock; impose one order or "
+            f"narrow one region"))
+
+    # -- relock ----------------------------------------------------------
+    self_acq = _self_acquires(p)
+    reentrant = _reentrant_locks(p)
+    seen_relock: Set[Tuple[str, str]] = set()
+    for c in sorted(p.calls, key=lambda c: (c.rel, c.line, c.name)):
+        if not c.same_instance or c.callee is None:
+            continue
+        ctx = p.held_at(c.caller, c.held)
+        hits = sorted((ctx & self_acq.get(c.callee, set())) - reentrant)
+        if not hits:
+            continue
+        key = (c.caller, hits[0])
+        if key in seen_relock:
+            continue
+        seen_relock.add(key)
+        fn = p.functions.get(c.caller)
+        qual = fn.qualname if fn is not None else "<module>"
+        out.append(Finding(
+            PASS_ID, c.rel, c.line, qual, f"relock:{hits[0]}",
+            f"`{c.name}(...)` re-acquires `{hits[0]}` already held on "
+            f"this path — threading.Lock is not reentrant: this "
+            f"deadlocks the moment it runs"))
+
+    # -- blocking-under-lock --------------------------------------------
+    for c in sorted(p.calls, key=lambda c: (c.rel, c.line, c.name)):
+        code, reason = _blocking(c)
+        if not code:
+            continue
+        ctx = p.may_hold_at(c.caller, c.held)
+        if c.receiver_lock is not None:
+            ctx = ctx - {c.receiver_lock}   # Condition.wait on the held
+        if not ctx:                         # lock itself is the pattern
+            continue
+        if code in ("sleep", "net") and c.held:
+            continue    # intraprocedural: the lock-discipline pass owns it
+        lock = sorted(ctx)[0]
+        fn = p.functions.get(c.caller)
+        qual = fn.qualname if fn is not None else "<module>"
+        where = "held here" if c.held else "held by a caller"
+        out.append(Finding(
+            PASS_ID, c.rel, c.line, qual,
+            f"blocking-under-lock:{c.name or code}",
+            f"{reason} can run while `{lock}` is {where} — every thread "
+            f"contending that lock stalls behind it; bound the wait or "
+            f"move it outside the region"))
+    return out

@@ -24,6 +24,7 @@ from typing import List, Optional
 from tools.analyze.core import Finding, RepoIndex, SourceFile, call_name
 
 PASS_ID = "lock-discipline"
+GRANULARITY = "file"  # findings depend on this file alone (cacheable per file)
 
 #: direct file/console I/O entry points (dotted prefixes match whole names)
 _IO_CALLS = {"open", "os.makedirs", "os.mkdir", "os.replace", "os.rename",

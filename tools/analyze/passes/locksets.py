@@ -1,0 +1,173 @@
+"""Lockset pass: shared mutable state is guarded by a common lock.
+
+The whole-program (Eraser-style) generalization of `passes/locks.py`'s
+per-region maps: using `program.ProgramIndex`'s thread-root
+reachability and interprocedural entry locksets, every class attribute
+that is **mutated** and **reachable from more than one thread root**
+must have a lock common to every concurrent access pair. An attribute
+with two accesses that (a) can run on different threads (different
+roots, or one multi-instance root), (b) include a write, and (c) share
+no guaranteed-held lock, is exactly the DisaggPool.replicas /
+fleet-lock-recorder bug class PRs 4-9 kept hand-fixing.
+
+Exemptions (the approximation's honest edges):
+
+* attributes whose every constructor assignment is an internally
+  synchronized type (``Lock``/``Event``/``Queue``/``deque``/...);
+* attributes never written outside the owning ``__init__`` —
+  ``Thread.start()`` publishes construction-time state safely;
+* accesses inside the owning class's ``__init__`` (no thread exists
+  yet) and lock attributes themselves;
+* classes ending in ``Metrics`` (one internal lock, checked by the
+  intraprocedural pass).
+
+Fingerprint: ``lockset:<class file>:<Class>:unguarded-shared-attr:
+Class.attr`` — one finding per racy attribute, anchored in the file
+DEFINING the class (at a witness access there, else the class def), so
+one inline allow (or baseline entry) covers the attribute beside the
+state it protects, not beside one of N touch points — and the
+fingerprint cannot drift when an unrelated edit reorders the witness
+pair.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from tools.analyze.core import Finding, RepoIndex
+from tools.analyze.program import (MAIN_ROOT, AttrAccess, ProgramIndex,
+                                   _is_lock_name, get_program)
+
+PASS_ID = "lockset"
+
+
+@dataclasses.dataclass
+class SharedAttr:
+    """One shared-state table row (also the doc renderer's input)."""
+
+    cls: str
+    cls_rel: str
+    cls_line: int                  # class def line (anchor of last resort)
+    attr: str
+    roots: FrozenSet[str]          # every root that can touch it
+    guard: FrozenSet[str]          # locks held at EVERY non-init access
+    written: bool
+    racy: Optional[Tuple[AttrAccess, AttrAccess]]  # a witness pair
+
+
+def _concurrent(p: ProgramIndex, a: AttrAccess, b: AttrAccess) -> bool:
+    """Can these two accesses run at the same time? Yes when two
+    different roots reach them, or a multi-instance root reaches both
+    (two threads of the same root)."""
+    ra, rb = p.roots_of.get(a.func, frozenset()), \
+        p.roots_of.get(b.func, frozenset())
+    if len(ra | rb) >= 2:
+        return True
+    return bool(ra & rb & p.multi_roots)
+
+
+def _is_owner_init(a: AttrAccess) -> bool:
+    return a.func.endswith(f"::{a.cls}.__init__")
+
+
+def shared_attrs(repo: RepoIndex) -> List[SharedAttr]:
+    """Every mutable class attribute reachable from ≥2 threads, with
+    its guard — the machine-readable source of `docs/concurrency.md`'s
+    shared-state table and of this pass's findings."""
+    p = get_program(repo)
+    groups: Dict[Tuple[str, str, str], List[AttrAccess]] = {}
+    for a in p.accesses:
+        # word-boundary lock-name match (shared with acquire tracking):
+        # `_clock` is state, not a lock, and must stay analyzed
+        if _is_lock_name(a.attr) or a.attr == "__dict__":
+            continue
+        groups.setdefault((a.cls_rel, a.cls, a.attr), []).append(a)
+    out: List[SharedAttr] = []
+    for (cls_rel, cls, attr), accs in sorted(groups.items()):
+        ci = p.class_at(cls_rel, cls)
+        if ci is None or cls.endswith("Metrics"):
+            continue
+        if ci.is_api:
+            # cluster-storable value objects (and their spec/status
+            # components) cross threads only as store deep-copies;
+            # mutation publishes via update_with_retry transactions
+            # under the store lock
+            continue
+        if ci.attr_safe.get(attr):
+            continue                   # internally synchronized type
+        live = [a for a in accs if not _is_owner_init(a)]
+        tinfo = p.class_info(ci.attr_types.get(attr) or "", cls_rel)
+        if tinfo is not None and tinfo.owns_lock:
+            # the attribute's class guards itself: method calls through
+            # it are its own analysis; only REBINDING the reference
+            # races here
+            if not any(a.rebind for a in live):
+                continue
+        if not any(a.write for a in live):
+            continue                   # construction-time-only state
+        roots = frozenset().union(
+            *(p.roots_of.get(a.func, frozenset({MAIN_ROOT}))
+              for a in live))
+        multi = any(p.roots_of.get(a.func, frozenset()) & p.multi_roots
+                    for a in live)
+        if len(roots) < 2 and not multi:
+            continue                   # thread-confined
+        locksets = [p.held_at(a.func, a.held) for a in live]
+        guard = frozenset.intersection(*locksets) if locksets \
+            else frozenset()
+        racy = None
+        ordered = sorted(live, key=lambda a: (a.rel, a.line))
+        for i, x in enumerate(ordered):
+            if racy is not None:
+                break
+            for y in ordered[i:]:
+                if not (x.write or y.write):
+                    continue
+                if x is y and not (
+                        x.write and p.roots_of.get(x.func, frozenset())
+                        & p.multi_roots):
+                    continue
+                if not _concurrent(p, x, y):
+                    continue
+                if p.held_at(x.func, x.held) & p.held_at(y.func, y.held):
+                    continue
+                racy = (x, y)
+                break
+        out.append(SharedAttr(cls=cls, cls_rel=cls_rel, cls_line=ci.line,
+                              attr=attr, roots=roots, guard=guard,
+                              written=True, racy=racy))
+    return out
+
+
+def run(repo: RepoIndex) -> List[Finding]:
+    p = get_program(repo)
+    out: List[Finding] = []
+    for row in shared_attrs(repo):
+        if row.racy is None:
+            continue
+        x, y = row.racy
+        # prefer the UNGUARDED side of the pair as the primary witness
+        if len(p.held_at(y.func, y.held)) < len(p.held_at(x.func, x.held)):
+            x, y = y, x
+        # anchor in the file DEFINING the class (fingerprints embed the
+        # path — a witness in another file would make the fingerprint
+        # drift whenever an unrelated edit reorders the witness pair):
+        # prefer a witness access in that file, fall back to the class
+        # def line; inline allows therefore live beside the state, not
+        # beside one of N touch points
+        in_cls = [a for a in (x, y) if a.rel == row.cls_rel]
+        if in_cls:
+            anchor_line = in_cls[0].line
+        else:
+            anchor_line = row.cls_line
+        sites = " / ".join(sorted({f"{a.rel}:{a.line}" for a in (x, y)}))
+        other = (sites if y is not x
+                 else f"{sites} (another thread of the same pool)")
+        out.append(Finding(
+            PASS_ID, row.cls_rel, anchor_line, row.cls,
+            f"unguarded-shared-attr:{row.cls}.{row.attr}",
+            f"`{row.cls}.{row.attr}` is shared across thread roots "
+            f"{{{', '.join(sorted(row.roots))}}} with no common lock "
+            f"on the access pair at {other} — guard both with one "
+            f"lock, or confine the state to one thread"))
+    return out

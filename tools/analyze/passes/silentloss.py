@@ -27,6 +27,7 @@ from typing import List
 from tools.analyze.core import Finding, RepoIndex, SourceFile, dotted_name
 
 PASS_ID = "silent-loss"
+GRANULARITY = "file"  # findings depend on this file alone (cacheable per file)
 
 _BROAD = {"Exception", "BaseException"}
 _COUNTER_ATTRS = {"inc", "observe", "set_gauge", "decision", "error",

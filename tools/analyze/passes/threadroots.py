@@ -1,0 +1,122 @@
+"""Thread-roots pass: every thread entrypoint is known and documented.
+
+`program.ProgramIndex` discovers the spawn sites — ``threading.Thread``
+/ ``Timer``, executor ``submit``, the ``bounded_map`` helper, HTTP
+handler classes — and computes, per function, the set of roots that can
+reach it. This pass enforces two things on top:
+
+1. **No invisible threads** — a spawn whose target the call graph
+   cannot resolve (a lambda, a computed callable) gets a finding: an
+   entrypoint the concurrency passes cannot see is a hole in the whole
+   map. Suppress only with a justification naming the root that models
+   it (the ApiServer's ``serve_forever`` is the canonical case: its
+   request threads are modeled by the ``http:`` handler root).
+2. **The map is published** — `docs/concurrency.md` carries the
+   generated thread-root × shared-state table between the
+   ``BEGIN/END GENERATED: concurrency-map`` markers, byte-identical to
+   what ``python -m tools.analyze --emit-concurrency-map`` renders
+   (``--write-concurrency-map`` splices it in). Same contract as the
+   chaos-site table in `docs/resilience.md`.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from tools.analyze.core import Finding, RepoIndex
+from tools.analyze.passes.locksets import shared_attrs
+from tools.analyze.program import MAIN_ROOT, get_program
+
+PASS_ID = "thread-roots"
+
+DOC_REL = "docs/concurrency.md"
+MARK_BEGIN = ("<!-- BEGIN GENERATED: concurrency-map "
+              "(python -m tools.analyze --write-concurrency-map) -->")
+MARK_END = "<!-- END GENERATED: concurrency-map -->"
+
+
+def render_concurrency_map(repo: RepoIndex) -> str:
+    """The generated thread-root × shared-state tables, markers
+    included — the exact bytes `docs/concurrency.md` must carry."""
+    p = get_program(repo)
+    lines = [MARK_BEGIN, "", "### Thread roots", "",
+             "| root | kind | spawned at | entrypoint | concurrency |",
+             "|---|---|---|---|---|"]
+    by_root = {}
+    for r in sorted(p.spawns, key=lambda r: (r.root_id, r.rel, r.target)):
+        by_root.setdefault(r.root_id, []).append(r)
+    for root_id, rows in sorted(by_root.items()):
+        r = rows[0]
+        if r.kind == "http-handler":
+            target = r.target.split("::")[-1].rsplit(".", 1)[0] + ".do_*"
+        else:
+            fn = p.functions.get(r.target)
+            target = fn.qualname if fn is not None else r.target
+        lines.append(
+            f"| `{root_id}` | {r.kind} | `{r.rel}` | `{target}` | "
+            f"{'multi' if any(x.multi for x in rows) else 'single'} |")
+    lines.append(f"| `{MAIN_ROOT}` | implicit | — | every public "
+                 f"entrypoint no spawn root reaches | single |")
+    lines += ["", "### Shared mutable state", "",
+              "| state | defined in | reached from roots | guard |",
+              "|---|---|---|---|"]
+    for row in shared_attrs(repo):
+        guard = ", ".join(f"`{g}`" for g in sorted(row.guard)) \
+            if row.guard else "**unguarded**"
+        roots = ", ".join(f"`{r}`" for r in sorted(row.roots))
+        lines.append(f"| `{row.cls}.{row.attr}` | `{row.cls_rel}` | "
+                     f"{roots} | {guard} |")
+    lines.append(MARK_END)
+    return "\n".join(lines) + "\n"
+
+
+def write_concurrency_map(repo: RepoIndex) -> bool:
+    """Splice the generated map into docs/concurrency.md between the
+    markers. Returns True on change."""
+    doc = repo.read(DOC_REL)
+    want = render_concurrency_map(repo)
+    begin, end = doc.find(MARK_BEGIN), doc.find(MARK_END)
+    if begin < 0 or end < 0:
+        raise SystemExit(f"{DOC_REL} lacks the concurrency-map markers; "
+                         f"add\n{MARK_BEGIN}\n{MARK_END}\nwhere the map "
+                         f"belongs, then re-run")
+    new = doc[:begin] + want.rstrip("\n") + doc[end + len(MARK_END):]
+    if new == doc:
+        return False
+    (repo.root / DOC_REL).write_text(new)
+    return True
+
+
+def run(repo: RepoIndex) -> List[Finding]:
+    p = get_program(repo)
+    out: List[Finding] = []
+    for func_key, rel, line, kind in p.unresolved_spawns:
+        fn = p.functions.get(func_key)
+        qual = fn.qualname if fn is not None else "<module>"
+        out.append(Finding(
+            PASS_ID, rel, line, qual, f"unresolved-thread-target:{kind}",
+            f"this {kind} spawn's entrypoint is not statically "
+            f"resolvable — the concurrency map cannot see the thread; "
+            f"name a real function, or justify which root models it"))
+    doc_qual = "<concurrency-map>"
+    if not repo.exists(DOC_REL):
+        out.append(Finding(PASS_ID, DOC_REL, 1, doc_qual, "doc-missing",
+                           f"{DOC_REL} does not exist — run `python -m "
+                           f"tools.analyze --write-concurrency-map`"))
+        return out
+    doc = repo.read(DOC_REL)
+    begin, end = doc.find(MARK_BEGIN), doc.find(MARK_END)
+    if begin < 0 or end < 0:
+        out.append(Finding(
+            PASS_ID, DOC_REL, 1, doc_qual, "doc-markers-missing",
+            f"{DOC_REL} lacks the generated concurrency-map markers — "
+            f"run `python -m tools.analyze --write-concurrency-map`"))
+        return out
+    have = doc[begin:end + len(MARK_END)] + "\n"
+    if have != render_concurrency_map(repo):
+        line = doc[:begin].count("\n") + 1
+        out.append(Finding(
+            PASS_ID, DOC_REL, line, doc_qual, "doc-map-stale",
+            f"the {DOC_REL} concurrency map differs from the generated "
+            f"one — run `python -m tools.analyze "
+            f"--write-concurrency-map`"))
+    return out

@@ -1,0 +1,876 @@
+"""Whole-program substrate for the concurrency passes.
+
+One shared analysis (`ProgramIndex`, memoized per `RepoIndex`) feeding
+the three interprocedural passes (`thread-roots`, `lockset`,
+`lock-order`):
+
+* **Call graph** — module-level, name-resolved. A call is resolved when
+  the receiver's class is statically knowable: ``self.m()``,
+  ``self.attr.m()`` with ``attr`` type-inferred from ``__init__``
+  assignments or annotations, ``param.m()`` with an annotated parameter,
+  a local assigned from a class constructor or from a ``Dict[str, X]``
+  attribute's ``[]``/``get``/``setdefault``, plain module functions, and
+  imported symbols. Unresolvable calls (duck-typed parameters, callback
+  registries) are recorded by *name* only — the approximation is
+  documented in `docs/static-analysis.md`: the graph under-approximates
+  dynamic dispatch and never guesses.
+* **Thread roots** — ``threading.Thread(target=)``, ``Timer``,
+  ``executor.submit``, the repo's ``bounded_map(fn, ...)`` helper, and
+  ``BaseHTTPRequestHandler`` subclasses (every ``do_*`` method runs on a
+  server thread). A root is **multi** when more than one thread can run
+  it at once (spawned in a loop, a pool, per-key timers, HTTP handlers).
+  Every function additionally reachable from outside the repo is owned
+  by the synthetic ``main`` root — *unless* it is already reachable from
+  a spawn root, in which case the spawn root owns it (the repo-wide
+  convention: ``run_once()`` is EITHER driven by the ``run()`` thread or
+  by the test/soak driver, never both concurrently).
+* **Attribute-access index** — every ``obj.attr`` read/write whose
+  receiver class is resolvable, with the locally-held lockset at the
+  access.
+* **Entry locksets** — per function, the set of locks *guaranteed* held
+  at entry (must: intersection over call contexts) and *possibly* held
+  (may: union), to a fixpoint over the call graph. Lock identity is
+  ``ClassName._attr`` — per class, not per instance — so the passes
+  must only draw same-instance conclusions through ``self.*`` chains.
+
+Pure stdlib + ``ast``, like the rest of the suite.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.analyze.core import RepoIndex, SourceFile, dotted_name
+
+#: the synthetic root owning everything no spawn root reaches
+MAIN_ROOT = "main"
+
+#: constructor call names whose product is internally synchronized (or
+#: effectively atomic under the GIL) — attributes built from these are
+#: not shared-state candidates
+_THREADSAFE_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Barrier", "threading.local",
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "Queue", "SimpleQueue",
+    "deque", "collections.deque",
+}
+
+#: attribute-method calls that mutate the receiver (so `self._x.append(v)`
+#: counts as a WRITE to `_x`'s contents)
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse", "put", "put_nowait",
+}
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    key: str                       # "rel.py::Qual.name"
+    rel: str
+    qualname: str
+    name: str
+    line: int
+    node: ast.AST
+    class_qual: Optional[str]      # enclosing class qualname, or None
+    public: bool                   # callable from outside the repo
+
+
+@dataclasses.dataclass
+class AttrAccess:
+    cls: str                       # owning class simple qualname
+    cls_rel: str                   # file defining the owning class
+    attr: str
+    func: str                      # FunctionInfo.key of the accessor
+    rel: str
+    line: int
+    write: bool
+    rebind: bool                   # `obj.attr = ...` (vs content mutation)
+    held: FrozenSet[str]           # locks held locally at the access
+
+
+@dataclasses.dataclass
+class CallSite:
+    caller: str                    # FunctionInfo.key
+    callee: Optional[str]          # resolved FunctionInfo.key, or None
+    name: str                      # the dotted call name as written
+    rel: str
+    line: int
+    held: FrozenSet[str]           # locks held locally at the call
+    nargs: int
+    has_timeout: bool              # any positional arg or timeout= kwarg
+    same_instance: bool            # receiver is `self` (same-object call)
+    receiver_lock: Optional[str]   # lock identity of the receiver, if any
+
+
+@dataclasses.dataclass
+class LockAcquire:
+    lock: str                      # lock identity
+    func: str
+    rel: str
+    line: int
+    held: FrozenSet[str]           # locks held locally when acquiring
+
+
+@dataclasses.dataclass
+class ThreadRoot:
+    root_id: str                   # stable display/fingerprint name
+    kind: str                      # thread | timer | executor | http-handler
+    target: str                    # FunctionInfo.key of the entrypoint
+    rel: str
+    line: int
+    multi: bool                    # >1 concurrent thread can run this root
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    qual: str                      # simple qualname, e.g. "Fleet" / "A.B"
+    rel: str
+    line: int
+    bases: List[str]
+    methods: Dict[str, str]        # method name -> FunctionInfo.key
+    attr_types: Dict[str, str]     # attr -> resolved class qual (unique)
+    attr_value_types: Dict[str, str]   # attr -> Dict[...] value class qual
+    attr_safe: Dict[str, bool]     # attr -> built only from threadsafe ctors
+    attr_ctor: Dict[str, str]      # attr -> ctor call name (e.g. RLock)
+    attr_init_only: Set[str]       # attrs written nowhere outside __init__
+    is_api: bool = False           # cluster-storable value object (or a
+    #                                component of one): crosses threads
+    #                                only as a store deep-copy
+
+    @property
+    def owns_lock(self) -> bool:
+        """The class constructs its own threading lock/condition —
+        its METHODS are presumed to guard its state (its own attrs are
+        still analyzed in its own context)."""
+        kinds = {"Lock", "RLock", "Condition", "Semaphore",
+                 "BoundedSemaphore"}
+        return any(c.rsplit(".", 1)[-1] in kinds
+                   for c in self.attr_ctor.values())
+
+
+#: word-boundary match, not substring: `_clock` and `blocked` are NOT
+#: locks, and excluding them from race analysis would be a silent hole
+_LOCK_WORD_RE = re.compile(
+    r"(^|_)(lock|mutex|cond|condition|cv|sem|semaphore)(_|$)")
+
+
+def _is_lock_name(name: str) -> bool:
+    return bool(_LOCK_WORD_RE.search(name.rsplit(".", 1)[-1].lower()))
+
+
+def _ann_class_name(node: Optional[ast.AST]) -> Optional[str]:
+    """The class name inside an annotation: ``X``, ``"X"``,
+    ``Optional[X]``. Returns None for unions/builtins/unknowns."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        # string annotation: take the trailing identifier
+        text = node.value.strip()
+        return text if text.isidentifier() else None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        head = dotted_name(node.value) or ""
+        if head.rsplit(".", 1)[-1] == "Optional":
+            return _ann_class_name(node.slice)
+    return None
+
+
+def _ann_value_class(node: Optional[ast.AST]) -> Optional[str]:
+    """The VALUE class of a container annotation: ``Dict[K, X]`` →
+    ``X``; ``List[X]``/``Deque[X]``/``Optional[Dict[K, X]]`` → ``X``."""
+    if not isinstance(node, ast.Subscript):
+        return None
+    head = (dotted_name(node.value) or "").rsplit(".", 1)[-1]
+    sl = node.slice
+    if head == "Optional":
+        return _ann_value_class(sl)
+    if head in ("Dict", "dict"):
+        if isinstance(sl, ast.Tuple) and len(sl.elts) == 2:
+            return _ann_class_name(sl.elts[1])
+        return None
+    if head in ("List", "list", "Deque", "Set", "Tuple"):
+        inner = sl.elts[0] if isinstance(sl, ast.Tuple) and sl.elts else sl
+        return _ann_class_name(inner)
+    return None
+
+
+class _ModuleView:
+    """Per-module name environment: imports and module-level defs."""
+
+    def __init__(self, src: SourceFile) -> None:
+        self.src = src
+        self.imports: Dict[str, str] = {}       # alias -> repo module rel
+        self.symbols: Dict[str, Tuple[str, str]] = {}  # name -> (rel, symbol)
+        for node in src.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    rel = _module_rel(a.name)
+                    if rel:
+                        self.imports[a.asname or a.name.split(".")[0]] = rel
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                rel = _module_rel(node.module)
+                if rel:
+                    for a in node.names:
+                        self.symbols[a.asname or a.name] = (rel, a.name)
+
+
+def _module_rel(dotted: str) -> Optional[str]:
+    if not dotted.startswith("tpu_on_k8s"):
+        return None
+    return dotted.replace(".", "/") + ".py"
+
+
+class ProgramIndex:
+    """See module doc. Built once per RepoIndex and shared by the three
+    concurrency passes (``get_program``)."""
+
+    def __init__(self, repo: RepoIndex) -> None:
+        self.repo = repo
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, List[_ClassInfo]] = {}  # simple name -> infos
+        self.accesses: List[AttrAccess] = []
+        self.calls: List[CallSite] = []
+        self.acquires: List[LockAcquire] = []
+        self.spawns: List[ThreadRoot] = []
+        #: (func_key, rel, line, kind) spawns whose target didn't resolve
+        self.unresolved_spawns: List[Tuple[str, str, int, str]] = []
+        self._views: Dict[str, _ModuleView] = {}
+        self._index_defs()
+        self._index_bodies()
+        self._resolve_spawn_roots()
+        self.roots_of: Dict[str, FrozenSet[str]] = self._reachability()
+        self.entry_must: Dict[str, FrozenSet[str]] = {}
+        self.entry_may: Dict[str, FrozenSet[str]] = {}
+        self._locksets()
+
+    # ------------------------------------------------------------ definitions
+    def _index_defs(self) -> None:
+        for src in self.repo.files:
+            self._views[src.rel] = _ModuleView(src)
+            for node in ast.walk(src.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = self._def_qual(src, node)
+                    key = f"{src.rel}::{qual}"
+                    cls, nested = self._enclosing_class(src, node)
+                    self.functions[key] = FunctionInfo(
+                        key=key, rel=src.rel, qualname=qual, name=node.name,
+                        line=node.lineno, node=node, class_qual=cls,
+                        # nested defs are closures, not addressable API
+                        public=not node.name.startswith("_") and not nested)
+                elif isinstance(node, ast.ClassDef):
+                    self._index_class(src, node)
+
+    def _def_qual(self, src: SourceFile, node: ast.AST) -> str:
+        # core's qualname map already includes the def's own name
+        return src.qualname(node)
+
+    def _enclosing_class(self, src: SourceFile,
+                         node: ast.AST) -> Tuple[Optional[str], bool]:
+        """(class qualname, nested-in-function). Walks up THROUGH
+        enclosing functions: a def nested in a method closes over that
+        method's ``self``, so it keeps the class context."""
+        nested = False
+        p = src.parent(node)
+        while p is not None:
+            if isinstance(p, ast.ClassDef):
+                return self._def_qual(src, p), nested
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested = True
+            p = src.parent(p)
+        return None, nested
+
+    def _index_class(self, src: SourceFile, node: ast.ClassDef) -> None:
+        qual = self._def_qual(src, node)
+        info = _ClassInfo(
+            qual=qual, rel=src.rel, line=node.lineno,
+            bases=[b for b in ((dotted_name(x) or "").rsplit(".", 1)[-1]
+                               for x in node.bases) if b],
+            methods={}, attr_types={}, attr_value_types={},
+            attr_safe={}, attr_ctor={}, attr_init_only=set())
+        info.is_api = src.rel.startswith("tpu_on_k8s/api/")
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[child.name] = f"{src.rel}::{qual}.{child.name}"
+            elif isinstance(child, ast.AnnAssign) and \
+                    isinstance(child.target, ast.Name):
+                if child.target.id == "kind":
+                    info.is_api = True     # cluster-storable (serde kind)
+                # dataclass-style field: `queue: Workqueue = field(...)`
+                cname = _ann_class_name(child.annotation)
+                if cname:
+                    info.attr_types.setdefault(child.target.id, cname)
+                vcls = _ann_value_class(child.annotation)
+                if vcls:
+                    info.attr_value_types.setdefault(child.target.id, vcls)
+        self._index_attr_types(src, node, info)
+        self.classes.setdefault(qual.rsplit(".", 1)[-1], []).append(info)
+
+    def _index_attr_types(self, src: SourceFile, node: ast.ClassDef,
+                          info: _ClassInfo) -> None:
+        """Infer `self.x` attribute types/safety from every assignment in
+        the class body. Conflicting inferences drop to unknown."""
+        written_outside_init: Set[str] = set()
+        written: Set[str] = set()
+        for meth in node.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            margs = meth.args
+            param_ann = {a.arg: a.annotation for a in
+                         (margs.posonlyargs + margs.args + margs.kwonlyargs)
+                         if a.annotation is not None}
+            for sub in ast.walk(meth):
+                attr, value, ann = _self_attr_assign(sub)
+                if attr is None:
+                    continue
+                written.add(attr)
+                if meth.name != "__init__":
+                    written_outside_init.add(attr)
+                if ann is None and isinstance(value, ast.Name):
+                    # `self.pool = pool` with an annotated parameter
+                    ann = param_ann.get(value.id)
+                cls = _ann_class_name(ann)
+                vcls = _ann_value_class(ann)
+                safe = False
+                if isinstance(value, ast.Call):
+                    name = dotted_name(value.func) or ""
+                    safe = name in _THREADSAFE_CTORS
+                    if name:
+                        info.attr_ctor.setdefault(attr, name)
+                    if cls is None:
+                        cls = name.rsplit(".", 1)[-1] or None
+                if cls:
+                    prior = info.attr_types.get(attr)
+                    if prior is not None and prior != cls:
+                        info.attr_types[attr] = ""     # conflict: unknown
+                    elif prior != "":
+                        info.attr_types[attr] = cls
+                if vcls:
+                    info.attr_value_types.setdefault(attr, vcls)
+                prior_safe = info.attr_safe.get(attr)
+                info.attr_safe[attr] = safe if prior_safe is None \
+                    else (prior_safe and safe)
+        info.attr_init_only = written - written_outside_init
+
+    # ----------------------------------------------------------- class lookup
+    def class_info(self, simple: str,
+                   rel: Optional[str] = None) -> Optional[_ClassInfo]:
+        """The class named ``simple`` — same-module first, else the
+        unique repo-wide definition, else None (never guesses between
+        homonyms)."""
+        infos = self.classes.get(simple.rsplit(".", 1)[-1])
+        if not infos:
+            return None
+        if rel is not None:
+            same = [i for i in infos if i.rel == rel]
+            if len(same) == 1:
+                return same[0]
+        return infos[0] if len(infos) == 1 else None
+
+    def class_at(self, rel: str, qual: str) -> Optional[_ClassInfo]:
+        """Exact class lookup by defining file + qualname."""
+        for info in self.classes.get(qual.rsplit(".", 1)[-1], []):
+            if info.rel == rel and info.qual == qual:
+                return info
+        return None
+
+    def method_key(self, cls: _ClassInfo, name: str) -> Optional[str]:
+        """Resolve a method through the class and its repo-known bases."""
+        seen: Set[str] = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop(0)
+            if c.qual in seen:
+                continue
+            seen.add(c.qual)
+            if name in c.methods:
+                return c.methods[name]
+            for b in c.bases:
+                bi = self.class_info(b, c.rel)
+                if bi is not None:
+                    stack.append(bi)
+        return None
+
+    # ------------------------------------------------------------- body walks
+    def _index_bodies(self) -> None:
+        for src in self.repo.files:
+            view = self._views[src.rel]
+            for key, fn in list(self.functions.items()):
+                if fn.rel != src.rel:
+                    continue
+                _FunctionWalker(self, view, fn).walk()
+
+    # ------------------------------------------------------------ thread roots
+    def _resolve_spawn_roots(self) -> None:
+        """http-handler roots: every ``do_*`` method of a
+        BaseHTTPRequestHandler subclass runs on a server thread."""
+        for infos in self.classes.values():
+            for info in infos:
+                if not any("HTTPRequestHandler" in b or b == "_Handler"
+                           for b in info.bases):
+                    continue
+                for name, key in sorted(info.methods.items()):
+                    if name.startswith("do_"):
+                        self.spawns.append(ThreadRoot(
+                            root_id=f"http:{info.qual}", kind="http-handler",
+                            target=key, rel=info.rel, line=info.line,
+                            multi=True))
+
+    # ------------------------------------------------------------ reachability
+    def _callee_map(self) -> Dict[str, List[str]]:
+        adj: Dict[str, List[str]] = {}
+        for c in self.calls:
+            if c.callee is not None:
+                adj.setdefault(c.caller, []).append(c.callee)
+        return adj
+
+    def _reach_from(self, starts: Set[str],
+                    adj: Dict[str, List[str]]) -> Set[str]:
+        seen = set(starts)
+        stack = list(starts)
+        while stack:
+            f = stack.pop()
+            for g in adj.get(f, ()):
+                if g not in seen:
+                    seen.add(g)
+                    stack.append(g)
+        return seen
+
+    def _reachability(self) -> Dict[str, FrozenSet[str]]:
+        adj = self._callee_map()
+        owned: Dict[str, Set[str]] = {}
+        for root in self.spawns:
+            if root.target not in self.functions:
+                continue
+            for f in self._reach_from({root.target}, adj):
+                owned.setdefault(f, set()).add(root.root_id)
+        # main owns what no spawn root reaches, starting from public defs
+        mains = {k for k, fn in self.functions.items()
+                 if fn.public and k not in owned}
+        for f in self._reach_from(mains, adj):
+            if f not in owned:
+                owned.setdefault(f, set()).add(MAIN_ROOT)
+        out: Dict[str, FrozenSet[str]] = {}
+        for k in self.functions:
+            out[k] = frozenset(owned.get(k) or {MAIN_ROOT})
+        return out
+
+    @property
+    def multi_roots(self) -> Set[str]:
+        return {r.root_id for r in self.spawns if r.multi}
+
+    # ---------------------------------------------------------- entry locksets
+    def _locksets(self) -> None:
+        """Must (intersection) and may (union) locks held at function
+        entry, to a fixpoint. Entry functions — spawn targets and public
+        defs — are pinned to the empty context: anything may call them
+        bare."""
+        entries = {r.target for r in self.spawns} | {
+            k for k, fn in self.functions.items() if fn.public}
+        TOP = None                                  # "not yet called"
+        must: Dict[str, Optional[FrozenSet[str]]] = {
+            k: (frozenset() if k in entries else TOP)
+            for k in self.functions}
+        may: Dict[str, FrozenSet[str]] = {k: frozenset()
+                                          for k in self.functions}
+        sites = [c for c in self.calls if c.callee in self.functions]
+        for _ in range(60):                         # bounded fixpoint
+            changed = False
+            for c in self.sorted_calls(sites):
+                base = must[c.caller]
+                ctx = (frozenset() if base is TOP else base) | c.held
+                cur = must[c.callee]
+                new = ctx if cur is TOP else (cur & ctx)
+                if c.callee in entries:
+                    new = frozenset()
+                if new != cur:
+                    must[c.callee] = new
+                    changed = True
+                mnew = may[c.callee] | may[c.caller] | c.held
+                if mnew != may[c.callee]:
+                    may[c.callee] = mnew
+                    changed = True
+            if not changed:
+                break
+        self.entry_must = {k: (v if v is not TOP else frozenset())
+                           for k, v in must.items()}
+        self.entry_may = may
+
+    @staticmethod
+    def sorted_calls(sites: List[CallSite]) -> List[CallSite]:
+        return sorted(sites, key=lambda c: (c.rel, c.line, c.name))
+
+    # ------------------------------------------------------------- signatures
+    def held_at(self, func: str, local: FrozenSet[str]) -> FrozenSet[str]:
+        """Locks *guaranteed* held at a site: entry-must + local."""
+        return self.entry_must.get(func, frozenset()) | local
+
+    def may_hold_at(self, func: str,
+                    local: FrozenSet[str]) -> FrozenSet[str]:
+        return self.entry_may.get(func, frozenset()) | local
+
+
+class _FunctionWalker:
+    """One function body: attribute accesses, call sites, lock acquires
+    and thread spawns, with the locally-held lockset threaded through.
+    Nested def/lambda bodies are separate functions — not walked here."""
+
+    def __init__(self, program: ProgramIndex, view: _ModuleView,
+                 fn: FunctionInfo) -> None:
+        self.p = program
+        self.view = view
+        self.fn = fn
+        self.src = view.src
+        self.cls = (program.class_info(fn.class_qual, fn.rel)
+                    if fn.class_qual else None)
+        self.param_types: Dict[str, str] = {}
+        self.local_types: Dict[str, Optional[str]] = {}
+        args = fn.node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            cname = _ann_class_name(a.annotation)
+            if cname:
+                self.param_types[a.arg] = cname
+
+    # -------------------------------------------------------------- receivers
+    def _receiver_class(self, node: ast.AST) -> Optional[_ClassInfo]:
+        """The class of an expression, when statically knowable."""
+        if isinstance(node, ast.Name):
+            if node.id == "self" and self.cls is not None:
+                return self.cls
+            t = self.local_types.get(node.id)
+            if t is None:
+                t = self.param_types.get(node.id)
+            return self.p.class_info(t, self.fn.rel) if t else None
+        if isinstance(node, ast.Attribute):
+            owner = self._receiver_class(node.value)
+            if owner is None:
+                return None
+            t = owner.attr_types.get(node.attr)
+            return self.p.class_info(t, owner.rel) if t else None
+        if isinstance(node, ast.Call):
+            # ClassName(...) or self._d.get/setdefault/[] value types
+            name = dotted_name(node.func)
+            if name:
+                ci = self._class_by_name(name)
+                if ci is not None:
+                    return ci
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("get", "setdefault", "pop"):
+                return self._container_value_class(node.func.value)
+            return None
+        if isinstance(node, ast.Subscript):
+            return self._container_value_class(node.value)
+        return None
+
+    def _container_value_class(self, node: ast.AST) -> Optional[_ClassInfo]:
+        if isinstance(node, ast.Attribute):
+            owner = self._receiver_class(node.value)
+            if owner is not None:
+                t = owner.attr_value_types.get(node.attr)
+                if t:
+                    return self.p.class_info(t, owner.rel)
+        return None
+
+    def _class_by_name(self, dotted: str) -> Optional[_ClassInfo]:
+        leaf = dotted.rsplit(".", 1)[-1]
+        if not leaf or not leaf[0].isupper():
+            return None
+        head = dotted.split(".", 1)[0]
+        if head in self.view.imports:
+            rel = self.view.imports[head]
+            ci = self.p.class_info(leaf, rel)
+            return ci if ci is not None and ci.rel == rel else None
+        if dotted in self.view.symbols:
+            rel, sym = self.view.symbols[dotted]
+            ci = self.p.class_info(sym, rel)
+            return ci if ci is not None and ci.rel == rel else None
+        if "." not in dotted:
+            ci = self.p.class_info(leaf, self.fn.rel)
+            return ci if ci is not None and ci.rel == self.fn.rel else None
+        return None
+
+    # ------------------------------------------------------------ lock naming
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        name = dotted_name(expr)
+        if name is None or not _is_lock_name(name):
+            return None
+        if isinstance(expr, ast.Attribute):
+            # resolve through the receiver's class: `self.pool._lock`
+            # and a DisaggPool method's `self._lock` are the SAME lock
+            owner = self._receiver_class(expr.value)
+            if owner is not None:
+                return f"{owner.qual}.{expr.attr}"
+            if name.startswith("self.") and self.cls is not None:
+                return f"{self.cls.qual}.{name[len('self.'):]}"
+            head = name.split(".", 1)[0]
+            t = self.param_types.get(head) or self.local_types.get(head)
+            if t and "." in name:
+                return f"{t}.{name.split('.', 1)[1]}"
+        return f"{self.fn.rel}::{name}"    # local/module lock: file-scoped
+
+    # ----------------------------------------------------------- call targets
+    def _resolve_call(self, node: ast.Call) -> Optional[str]:
+        func = node.func
+        if isinstance(func, ast.Name):
+            nid = func.id
+            # nested def in an enclosing function scope
+            nested = f"{self.fn.rel}::{self.fn.qualname}.{nid}"
+            if nested in self.p.functions:
+                return nested
+            if nid in self.view.symbols:
+                rel, sym = self.view.symbols[nid]
+                key = f"{rel}::{sym}"
+                if key in self.p.functions:
+                    return key
+                ci = self.p.class_info(sym, rel)
+                if ci is not None and ci.rel == rel:
+                    return ci.methods.get("__init__")
+                return None
+            key = f"{self.fn.rel}::{nid}"
+            if key in self.p.functions:
+                return key
+            ci = self._class_by_name(nid)
+            if ci is not None:
+                return ci.methods.get("__init__")
+            return None
+        if isinstance(func, ast.Attribute):
+            owner = self._receiver_class(func.value)
+            if owner is not None:
+                return self.p.method_key(owner, func.attr)
+            name = dotted_name(func)
+            if name:
+                head = name.split(".", 1)[0]
+                if head in self.view.imports and name.count(".") == 1:
+                    key = f"{self.view.imports[head]}::{func.attr}"
+                    if key in self.p.functions:
+                        return key
+                    ci = self.p.class_info(func.attr,
+                                           self.view.imports[head])
+                    if ci is not None \
+                            and ci.rel == self.view.imports[head]:
+                        return ci.methods.get("__init__")
+            return None
+        return None
+
+    def _spawn(self, node: ast.Call, held: FrozenSet[str],
+               in_loop: bool) -> bool:
+        """Record a thread root when this call creates one. Returns True
+        when the callable argument must not ALSO count as a direct call."""
+        name = dotted_name(node.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        target_expr = None
+        kind = None
+        multi = in_loop
+        if leaf == "Thread":
+            # Thread(group, target, ...) — positional target counts;
+            # a Thread with NO target at all (run()-override subclass
+            # shape) is an unresolved spawn, not an invisible one
+            target_expr = kw.get("target") or (
+                node.args[1] if len(node.args) > 1 else None)
+            kind = "thread"
+        elif leaf == "Timer":
+            target_expr = (node.args[1] if len(node.args) > 1
+                           else kw.get("function"))
+            kind = "timer"
+            multi = True               # one timer per arm() call
+        elif leaf == "submit" and node.args:
+            # only executor-shaped receivers: `gateway.submit(cb, ...)`
+            # runs the callback on the CALLING thread, not a pool's
+            recv = (dotted_name(node.func.value) or "" if
+                    isinstance(node.func, ast.Attribute) else "")
+            rleaf = recv.rsplit(".", 1)[-1].lstrip("_")
+            if rleaf not in ("pool", "executor", "tpe"):
+                return False
+            target_expr = node.args[0]
+            kind = "executor"
+            multi = True
+        elif leaf == "bounded_map" and node.args:
+            target_expr = node.args[0]
+            kind = "executor"
+            multi = True
+        if kind is None:
+            return False
+        target = (self._callable_key(target_expr)
+                  if target_expr is not None else None)
+        if target is None:
+            # a spawn whose entrypoint the call graph cannot see: the
+            # thread-roots pass reports it (suppress with a justification
+            # naming the root that models it, or fix the target shape)
+            self.p.unresolved_spawns.append((self.fn.key, self.fn.rel,
+                                             node.lineno, kind))
+            return False
+        root_name = None
+        nkw = kw.get("name")
+        if isinstance(nkw, ast.Constant) and isinstance(nkw.value, str):
+            root_name = nkw.value
+        self.p.spawns.append(ThreadRoot(
+            root_id=root_name or self.p.functions[target].qualname,
+            kind=kind, target=target, rel=self.fn.rel,
+            line=node.lineno, multi=multi))
+        return True
+
+    def _callable_key(self, expr: ast.AST) -> Optional[str]:
+        """Resolve a callable VALUE (not a call): `self._loop`, a nested
+        `loop`, `module.f`, a lambda (unresolvable)."""
+        if isinstance(expr, ast.Name):
+            nested = f"{self.fn.rel}::{self.fn.qualname}.{expr.id}"
+            if nested in self.p.functions:
+                return nested
+            key = f"{self.fn.rel}::{expr.id}"
+            if key in self.p.functions:
+                return key
+            if expr.id in self.view.symbols:
+                rel, sym = self.view.symbols[expr.id]
+                key = f"{rel}::{sym}"
+                if key in self.p.functions:
+                    return key
+            return None
+        if isinstance(expr, ast.Attribute):
+            owner = self._receiver_class(expr.value)
+            if owner is not None:
+                return self.p.method_key(owner, expr.attr)
+        return None
+
+    # ------------------------------------------------------------------ walk
+    def walk(self) -> None:
+        self._stmts(self.fn.node.body, frozenset(), in_loop=False)
+
+    def _stmts(self, body: List[ast.stmt], held: FrozenSet[str],
+               in_loop: bool) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue                       # separate function/scope
+            if isinstance(stmt, ast.With):
+                inner = held
+                for item in stmt.items:
+                    self._exprs(item.context_expr, held, in_loop)
+                    lock = self._lock_id(item.context_expr)
+                    if lock is not None:
+                        self.p.acquires.append(LockAcquire(
+                            lock=lock, func=self.fn.key, rel=self.fn.rel,
+                            line=stmt.lineno, held=inner))
+                        inner = inner | {lock}
+                self._stmts(stmt.body, inner, in_loop)
+                continue
+            loop_here = in_loop or isinstance(stmt, (ast.For, ast.While,
+                                                     ast.AsyncFor))
+            # simple local type inference: x = ClassName(...) / d.get(...)
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                ci = self._receiver_class(stmt.value)
+                name = stmt.targets[0].id
+                if name in self.local_types:
+                    if self.local_types[name] != (ci.qual if ci else None):
+                        self.local_types[name] = None     # conflict
+                else:
+                    self.local_types[name] = ci.qual if ci else None
+            nested: List[ast.stmt] = []
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    nested.append(child)
+                elif isinstance(child, ast.ExceptHandler):
+                    nested.extend(child.body)
+                else:
+                    self._exprs(child, held, loop_here)
+            if nested:
+                self._stmts(nested, held, loop_here)
+
+    def _exprs(self, node: ast.AST, held: FrozenSet[str],
+               in_loop: bool) -> None:
+        for sub in _walk_pruned(node):
+            if isinstance(sub, ast.Call):
+                self._record_call(sub, held, in_loop)
+            elif isinstance(sub, ast.Attribute):
+                self._record_access(sub, held)
+
+    def _record_call(self, node: ast.Call, held: FrozenSet[str],
+                     in_loop: bool) -> None:
+        if self._spawn(node, held, in_loop):
+            return
+        name = dotted_name(node.func) or ""
+        callee = self._resolve_call(node)
+        same = isinstance(node.func, ast.Attribute) and \
+            isinstance(node.func.value, ast.Name) and \
+            node.func.value.id == "self"
+        has_timeout = bool(node.args) or any(
+            k.arg == "timeout" for k in node.keywords)
+        rlock = None
+        if isinstance(node.func, ast.Attribute):
+            rlock = self._lock_id(node.func.value)
+        self.p.calls.append(CallSite(
+            caller=self.fn.key, callee=callee, name=name,
+            rel=self.fn.rel, line=node.lineno, held=held,
+            nargs=len(node.args), has_timeout=has_timeout,
+            same_instance=same, receiver_lock=rlock))
+
+    def _record_access(self, node: ast.Attribute,
+                       held: FrozenSet[str]) -> None:
+        owner = self._receiver_class(node.value)
+        if owner is None:
+            return
+        rebind = isinstance(node.ctx, (ast.Store, ast.Del))
+        write = rebind
+        parent = self.src.parent(node)
+        if isinstance(parent, ast.Subscript) and parent.value is node \
+                and isinstance(parent.ctx, (ast.Store, ast.Del)):
+            write = True
+        if isinstance(parent, ast.AugAssign) and parent.target is node:
+            write = rebind = True
+        if isinstance(parent, ast.Attribute) and parent.value is node:
+            gp = self.src.parent(parent)
+            if isinstance(gp, ast.Call) and gp.func is parent \
+                    and parent.attr in _MUTATORS:
+                write = True
+        self.p.accesses.append(AttrAccess(
+            cls=owner.qual, cls_rel=owner.rel, attr=node.attr,
+            func=self.fn.key, rel=self.fn.rel, line=node.lineno,
+            write=write, rebind=rebind, held=held))
+
+
+def _walk_pruned(node: ast.AST):
+    """``ast.walk`` that does NOT descend into deferred-execution
+    bodies: a lambda defined here runs later (often on another thread,
+    with a different lockset) — recording its body with the
+    definition-site lockset would both fabricate blocking-under-lock
+    findings and mask real races as lock-guarded."""
+    if isinstance(node, ast.Lambda):
+        return
+    yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_pruned(child)
+
+
+def _self_attr_assign(node: ast.AST) -> Tuple[Optional[str],
+                                              Optional[ast.AST],
+                                              Optional[ast.AST]]:
+    """(attr, value, annotation) when ``node`` assigns ``self.attr``."""
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                return t.attr, node.value, None
+    elif isinstance(node, ast.AnnAssign):
+        t = node.target
+        if isinstance(t, ast.Attribute) and \
+                isinstance(t.value, ast.Name) and t.value.id == "self":
+            return t.attr, node.value, node.annotation
+    return None, None, None
+
+
+def get_program(repo: RepoIndex) -> ProgramIndex:
+    """The memoized per-RepoIndex ProgramIndex (three passes share it)."""
+    prog = getattr(repo, "_program", None)
+    if prog is None:
+        prog = ProgramIndex(repo)
+        repo._program = prog      # type: ignore[attr-defined]
+    return prog

@@ -556,6 +556,7 @@ class ApiServer:
         return f"{scheme}://{self.host}:{self.port}"
 
     def start(self) -> "ApiServer":
+        # analyze: allow[thread-roots] stdlib serve_forever only accepts sockets; the request threads it spawns are modeled by the http:_Handler root
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         kwargs={"poll_interval": 0.05},
                                         daemon=True, name="apiserver")

@@ -130,7 +130,12 @@ class InMemoryCluster:
                 if event.kind in _kinds:
                     _cb(event)
 
-        self._watchers.append(callback)
+        with self._lock:
+            # registration races the mutation-side fanout (any writer
+            # thread iterates this list): publish the append under the
+            # same lock so a new watcher either sees an event or doesn't
+            # — never a torn list
+            self._watchers.append(callback)
 
     def subscribe_ordered(self, callback: Callable[[WatchEvent], None]) -> None:
         """Register a callback invoked INSIDE the mutation lock, in strict
@@ -138,7 +143,8 @@ class InMemoryCluster:
         assignment and publication must be atomic or concurrent writers can
         publish out of order and a monotonic stream filter drops events).
         Callbacks must be fast and must not call back into the cluster."""
-        self._ordered_watchers.append(callback)
+        with self._lock:
+            self._ordered_watchers.append(callback)
 
     def _record(self, event: WatchEvent) -> None:
         """Publish under the mutation lock (caller holds ``self._lock``):
@@ -149,9 +155,12 @@ class InMemoryCluster:
             cb(event)
 
     def _emit(self, event: WatchEvent) -> None:
-        """Plain-callback fanout (outside the lock where possible, may
-        re-enter the API — the in-process controller wiring)."""
-        for cb in list(self._watchers):
+        """Plain-callback fanout: snapshot the registry under the lock,
+        call OUTSIDE it (callbacks may re-enter the API — the in-process
+        controller wiring)."""
+        with self._lock:
+            cbs = list(self._watchers)
+        for cb in cbs:
             cb(event)
 
     @property

@@ -90,9 +90,13 @@ class NodeAgentLoop:
         self._stop.set()
         with self._cond:
             self._cond.notify_all()
-        for t in self._timers:
+            # snapshot under the condition: the agent thread rebuilds
+            # this list in _schedule_reap — cancelling a concurrent
+            # rebuild's OLD list would let a fresh TTL timer escape and
+            # fire into a torn-down cluster
+            pending, self._timers = self._timers, []
+        for t in pending:
             t.cancel()
-        self._timers.clear()
         if self._thread is not None:
             self._thread.join(timeout=2)
             self._thread = None
@@ -113,8 +117,12 @@ class NodeAgentLoop:
             return
         timer = threading.Timer(delay, self._enqueue, args=(key,))
         timer.daemon = True
-        timer.start()
-        self._timers = [t for t in self._timers if t.is_alive()] + [timer]
+        with self._cond:
+            if self._stop.is_set():
+                return       # racing stop(): its snapshot already ran
+            timer.start()    # start inside the guard, or a timer armed
+            self._timers = [t for t in self._timers if t.is_alive()] \
+                + [timer]    # between snapshot and append escapes cancel
 
     # ------------------------------------------------------------------ engine
     def _set_phase(self, req: ContainerRecreateRequest, phase: str,

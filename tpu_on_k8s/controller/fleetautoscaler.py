@@ -201,6 +201,14 @@ class FleetAutoscaler:
             state.fleet = fleet
             state.apply_to_fleet = apply
 
+    def _fleet_binding(self, state: _ServiceState):
+        """Snapshot ``(fleet, apply_to_fleet)`` under the lock — the
+        tick thread reads them while ``attach_fleet`` (main/watch
+        thread) rebinds them; a torn read could scrape fleet A and
+        apply the decision to fleet B."""
+        with self._lock:
+            return state.fleet, state.apply_to_fleet
+
     # ------------------------------------------------------------ decision loop
     def run_once(self) -> None:
         with self._lock:
@@ -495,12 +503,11 @@ class FleetAutoscaler:
         ps.seq += 1
         fault = chaos.fire(chaos.SITE_AUTOSCALE_SIGNAL, service=key,
                            pool=pool)
+        fleet, _ = self._fleet_binding(state)
         if not isinstance(fault, chaos.SignalOutage) \
-                and state.fleet is not None \
-                and hasattr(state.fleet, "pool"):
+                and fleet is not None and hasattr(fleet, "pool"):
             try:
-                return ps.scraper.scrape(state.fleet.pool(pool),
-                                         seq=ps.seq)
+                return ps.scraper.scrape(fleet.pool(pool), seq=ps.seq)
             # analyze: allow[silent-loss] falls through to the stale_scrapes counter + dead_sample — the outage IS counted
             except Exception:  # noqa: BLE001 — a dying fleet is an outage
                 pass
@@ -579,12 +586,13 @@ class FleetAutoscaler:
              else f"fleet autoscaler[{pool}]")
             + f": {decision.current} -> {decision.target} "
             f"({decision.reason})")
-        if state.fleet is not None and state.apply_to_fleet:
+        fleet, apply_to_fleet = self._fleet_binding(state)
+        if fleet is not None and apply_to_fleet:
             try:
                 if pool is None:
-                    state.fleet.scale_to(decision.target)
+                    fleet.scale_to(decision.target)
                 else:
-                    state.fleet.scale_pool(pool, decision.target)
+                    fleet.scale_pool(pool, decision.target)
             except (RuntimeError, ValueError) as e:
                 # a rollout owns desired_replicas right now; the spec
                 # patch stands and the reconciler/fleet converge later
@@ -632,10 +640,12 @@ class FleetAutoscaler:
             if self.metrics is not None:
                 self.metrics.inc("stale_scrapes")
             return dead_sample(state.seq)
-        if state.fleet is not None:
+        fleet, _ = self._fleet_binding(state)
+        if fleet is not None:
             try:
-                return state.scraper.scrape(state.fleet, seq=state.seq)
-            # analyze: allow[silent-loss] falls through to the stale_scrapes counter + dead_sample — the outage IS counted
+                return state.scraper.scrape(fleet, seq=state.seq)
+            # (no allow needed: the handler touches the stale_scrapes
+            # counter, which silent-loss accepts as accounting)
             except Exception:  # noqa: BLE001 — a dying fleet is an outage
                 if self.metrics is not None:
                     self.metrics.inc("stale_scrapes")

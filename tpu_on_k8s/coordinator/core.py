@@ -81,7 +81,7 @@ class Coordinator:
                     old.remove(unit.uid)
             queue.add_or_update(unit)
             self._uid_to_tenant[unit.uid] = unit.tenant
-        self._mark_queuing(job)
+        self._mark_queuing(job, unit.tenant)
         self._update_depth_gauges()
 
     def dequeue(self, job: TPUJob, *, reason: str = "") -> None:
@@ -222,12 +222,16 @@ class Coordinator:
         return n
 
     # ------------------------------------------------------------- status marks
-    def _mark_queuing(self, job: TPUJob) -> None:
-        """queueStateMarker (coordinator.go:98-113)."""
+    def _mark_queuing(self, job: TPUJob, tenant: str) -> None:
+        """queueStateMarker (coordinator.go:98-113). ``tenant`` is the
+        placement captured under the queue lock by the caller — the
+        mutate closure must not re-read ``_uid_to_tenant`` lock-free
+        (the schedule thread's ``_remove`` pops it concurrently, and a
+        conflict retry would re-read mid-removal)."""
         def mutate(j: TPUJob) -> None:
             conditions.update_job_conditions(
                 j.status, JobConditionType.QUEUING, "JobEnqueued",
-                f"job enqueued in tenant queue {self._uid_to_tenant.get(job.metadata.uid, '')}")
+                f"job enqueued in tenant queue {tenant}")
         self._write_if_changed(job, mutate)
 
     def _mark_dequeued(self, job: TPUJob) -> None:

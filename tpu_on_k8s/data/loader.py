@@ -36,6 +36,7 @@ def _build_lib() -> Optional[ctypes.CDLL]:
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
                "-o", str(so), str(_SRC), "-lpthread"]
         try:
+            # analyze: allow[lock-order] the module build lock EXISTS to serialize this one-time g++ compile; it is bounded (timeout=120) and first-import-only
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         except (OSError, subprocess.SubprocessError):
             return None

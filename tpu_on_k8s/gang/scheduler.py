@@ -374,6 +374,10 @@ class SliceGangAdmission:
         self._free: Dict[str, List[int]] = {
             p.name: list(range(p.num_slices)) for p in (pools or [])}
         self._pool_by_name = {p.name: p for p in (pools or [])}
+        # serializes the recovery REBUILD only (it does cluster I/O, so
+        # it cannot run under the inventory lock); always acquired
+        # before `_lock`, never after — no ordering cycle
+        self._recover_lock = threading.Lock()
         self._recovered = not self.pools  # nothing to recover without pools
         # recover eagerly: free_slices()/metrics must never observe a
         # fully-free inventory while Running gangs still hold slices. A
@@ -390,20 +394,39 @@ class SliceGangAdmission:
                     "sync()", exc_info=True)
 
     def _ensure_recovered(self) -> None:
-        if not self._recovered:
+        """Run recovery exactly once, even when the scheduler-loop tick
+        and a leadership-takeover resync() race here: the flag is read
+        and latched under the inventory lock, the rebuild itself under
+        the recovery lock (double-checked — the loser of the race must
+        not rebuild a second time over fresh allocations)."""
+        with self._lock:
+            if self._recovered:
+                return
+        with self._recover_lock:
+            with self._lock:
+                if self._recovered:       # lost the race: already rebuilt
+                    return
             self._recover_allocations()
-            self._recovered = True
+            with self._lock:
+                self._recovered = True
 
     def resync(self) -> None:
         """Drop the in-memory inventory and rebuild it from cluster state.
         Required on leadership takeover: allocations moved while this
         candidate was not leading, and admitting from a stale inventory is
-        exactly the double-booking hazard leader election exists to stop."""
-        with self._lock:
-            self._allocations.clear()
-            self._free = {p.name: list(range(p.num_slices))
-                          for p in self.pools}
-            self._recovered = not self.pools
+        exactly the double-booking hazard leader election exists to stop.
+
+        The clear runs under the RECOVERY lock too: clearing while a
+        tick's in-flight ``_recover_allocations`` is mid-rebuild would
+        erase the groups it already wrote, after which its
+        ``_recovered = True`` latch makes the loss permanent — the
+        over-reporting free_slices() this method exists to prevent."""
+        with self._recover_lock:
+            with self._lock:
+                self._allocations.clear()
+                self._free = {p.name: list(range(p.num_slices))
+                              for p in self.pools}
+                self._recovered = not self.pools
         self._ensure_recovered()
 
     def _recover_allocations(self) -> None:

@@ -204,10 +204,15 @@ class FleetPrefixStore:
                 self.stats["hits"] += 1
                 self._inc("prefix_store_hits")
                 return pid
+            # capture everything the device work needs NOW: the dict and
+            # the entry are mutated under the lock by concurrent ensure/
+            # evict calls — re-reading them lock-free below would race
             host = e.host
+            length = e.length
+            tokens = e.tokens
         engine_axes = dict(getattr(engine, "mesh_axes", {}) or {})
         if host is not None:
-            pid = engine.import_prefix(host, self._entries[h].length)
+            pid = engine.import_prefix(host, length)
             with self._lock:
                 e.residency[replica] = pid
                 e.replica_used[replica] = self._op
@@ -221,7 +226,7 @@ class FleetPrefixStore:
                     self.stats["cross_mesh_promotes"] += 1
                 self._inc("prefix_store_promotes")
         else:
-            pid = engine.register_prefix(self._entries[h].tokens)
+            pid = engine.register_prefix(tokens)
             cache, lp = engine.export_prefix(pid)
             nbytes = sum(int(leaf.nbytes)
                          for leaf in _tree_leaves(cache))
@@ -306,8 +311,9 @@ class FleetPrefixStore:
 
     def _gauges(self) -> None:
         if self.metrics is not None:
-            self.metrics.set_gauge("prefix_store_overflow_bytes",
-                                   self.stats["overflow_bytes"])
+            with self._lock:      # stats mutate under the lock; callers
+                val = self.stats["overflow_bytes"]   # run outside it
+            self.metrics.set_gauge("prefix_store_overflow_bytes", val)
 
     @property
     def overflow_bytes(self) -> int:

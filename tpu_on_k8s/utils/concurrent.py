@@ -52,6 +52,7 @@ def bounded_map(fn: Callable[[T], R], items: Iterable[T], width: int,
     if not items:
         return out
     with concurrent.futures.ThreadPoolExecutor(max_workers=width) as pool:
+        # analyze: allow[thread-roots] fn is this helper's parameter — each bounded_map CALLER is recorded as the spawn-through root with its real fn
         futures = {pool.submit(fn, item): i for i, item in enumerate(items)}
         for fut in concurrent.futures.as_completed(futures):
             i = futures[fut]
