@@ -7,7 +7,7 @@
 IMG ?= tpu-on-k8s/manager:latest
 
 .PHONY: test test-fast analyze analyze-concurrency lint chaos-soak fleet-soak autoscale-soak \
-        disagg-soak spec-soak shard-soak slo-soak reshard-soak trace-demo native bench dryrun manager samples clean \
+        disagg-soak spec-soak shard-soak slo-soak reshard-soak trace-demo why-demo native bench dryrun manager samples clean \
         docker-build docker-push deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
@@ -21,6 +21,14 @@ SHARD_SEED ?= 1357
 SLO_SEED ?= 9753
 RESHARD_SEED ?= 6172
 TRACE_SEED ?= 8642
+# the why-demo trace: a second breach after the scale-down re-pages the
+# budget; the urgent 2->4 scale-up closes with a LIVE burn recovery
+# (window small enough that the budget formally refills while traffic
+# still flows — a signal that merely goes dark never claims recovery)
+WHY_SEED ?= 2468
+WHY_FLAGS = --autoscale --n-requests 160 --rate 1.0 --burst-start 6 \
+    --burst-len 10 --burst-rate 6.0 --autoscale-slo 0.3 \
+    --autoscale-slo-window 0.8 --flap-guard 2.0 --seed $(WHY_SEED)
 TRACE_FLAGS = --disagg --n-requests 24 --prefix-bucket 8 --prompt-min 4 \
     --prompt-max 12 --new-min 4 --new-max 8 --decode-replicas 2 \
     --shared-prefixes 2 --shared-fraction 0.8 --seed $(TRACE_SEED)
@@ -31,7 +39,7 @@ test: analyze lint  ## invariant gate + lint first — they fail in seconds
 test-fast:  ## skip the slow sharded-compile suites
 	python -m pytest tests/ -q -k "not decode and not ring and not moe"
 
-analyze:  ## the eight invariant passes (docs/static-analysis.md); prints per-pass wall time; exit 0 iff clean
+analyze:  ## the nine invariant passes (docs/static-analysis.md); prints per-pass wall time; exit 0 iff clean
 	python -m tools.analyze
 
 analyze-concurrency:  ## just the three whole-program concurrency passes (iterating on a threading change)
@@ -97,6 +105,19 @@ trace-demo:  ## seeded disagg trace dumped twice: byte-identical span dumps + th
 	    || (echo "TRACE_DEMO_FAILED seed=$(TRACE_SEED): dumps differ"; exit 1)
 	@echo "trace dumps byte-identical (seed=$(TRACE_SEED))"
 	python tools/trace_report.py /tmp/tpu_on_k8s_trace_a.json
+
+why-demo:  ## seeded SLO-paged autoscale burst twice: byte-identical decision ledgers + the resolved page→decision→patch→recovery chain
+	JAX_PLATFORMS=cpu python tools/serve_load.py $(WHY_FLAGS) \
+	    --ledger-out /tmp/tpu_on_k8s_ledger_a.json \
+	    --trace-out /tmp/tpu_on_k8s_why_trace.json > /dev/null
+	JAX_PLATFORMS=cpu python tools/serve_load.py $(WHY_FLAGS) \
+	    --ledger-out /tmp/tpu_on_k8s_ledger_b.json \
+	    --trace-out /tmp/tpu_on_k8s_why_trace_b.json > /dev/null
+	cmp /tmp/tpu_on_k8s_ledger_a.json /tmp/tpu_on_k8s_ledger_b.json \
+	    || (echo "WHY_DEMO_FAILED seed=$(WHY_SEED): ledgers differ"; exit 1)
+	@echo "decision ledgers byte-identical (seed=$(WHY_SEED))"
+	python tools/why_report.py /tmp/tpu_on_k8s_ledger_a.json \
+	    --trace /tmp/tpu_on_k8s_why_trace.json --page --check
 
 native:  ## build the C++ data pipeline explicitly (also built lazily on import)
 	g++ -O2 -std=c++17 -shared -fPIC \

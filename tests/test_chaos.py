@@ -330,7 +330,7 @@ def test_injected_pod_kill_triggers_failover():
         for _ in range(10):
             op.run_once()
             sim.run_all("default")
-    assert inj.events == ["pod_fail(index=2, reason=OOMKilled) "
+    assert inj.events == ["seq=1 pod_fail(index=2, reason=OOMKilled) "
                           "note=kill worker-2 of default/kill"]
     pod = op.cluster.get(Pod, "default", "kill-worker-2")
     assert pod.status.phase == PodPhase.RUNNING     # recreated by failover

@@ -1,0 +1,656 @@
+"""Decision-ledger + loop-kernel tests (ISSUE 15): control-plane
+provenance as one byte-replayable record stream across every loop.
+
+Covers: the unified decision-line serializer (round trips, every
+historical format still parses), the ledger's determinism / NOOP
+neutrality / horizon machinery, the kernel template's contract
+(skip-records, commit-conflict ledgering, horizon dedupe), both
+autoscalers emitting uniformly (byte-identical across runs, decision
+logs bit-compatible with the ledger off), the chaos/SLO trigger joins,
+and `tools/why_report.py` resolving the complete
+page→decision→patch→ready→recovery chain — the ISSUE 15 acceptance
+scenario in-process.
+"""
+import dataclasses
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tpu_on_k8s import chaos
+from tpu_on_k8s.api.core import ObjectMeta
+from tpu_on_k8s.api.inference_types import (
+    AutoscalePolicy,
+    InferenceService,
+    InferenceServiceSpec,
+    SLOObjective,
+    SLOPolicy,
+)
+from tpu_on_k8s.api.types import TPUPolicy
+from tpu_on_k8s.client import InMemoryCluster
+from tpu_on_k8s.controller.config import JobControllerConfig
+from tpu_on_k8s.controller.fleetautoscaler import FleetAutoscaler
+from tpu_on_k8s.controller.loopkernel import (
+    CooldownGate,
+    DecisionLine,
+    LoopKernel,
+    format_commit_failure_line,
+    format_decision_line,
+    parse_decision_line,
+)
+from tpu_on_k8s.metrics.metrics import LedgerMetrics
+from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+from tpu_on_k8s.obs.ledger import (
+    COMMIT_LANDED,
+    DecisionLedger,
+    NOOP,
+    load_ledger,
+)
+from tpu_on_k8s.serve import AdmissionConfig, ProbeConfig, Router, ServingFleet
+
+
+# --------------------------------------------------------------------------
+# fixtures
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = dataclasses.replace(TransformerConfig.tiny(), dtype=jnp.float32,
+                              max_seq_len=64)
+    model = Transformer(cfg)
+    probe = jax.random.randint(jax.random.key(1), (1, 8), 0,
+                               cfg.vocab_size, jnp.int32)
+    params = model.init(jax.random.key(0), probe)["params"]
+    return cfg, params
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+ACC = "tpu-v5-lite-podslice"
+
+
+def _svc(autoscale, slo=None, replicas=1):
+    return InferenceService(
+        metadata=ObjectMeta(name="svc"),
+        spec=InferenceServiceSpec(
+            image="img", replicas=replicas,
+            tpu_policy=TPUPolicy(accelerator=ACC, topology="2x2"),
+            autoscale=autoscale, slo=slo))
+
+
+def _fleet(cfg, params, n, clock):
+    from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+
+    def factory(name):
+        return ContinuousBatchingEngine(cfg, params, n_slots=2, max_len=32,
+                                        clock=clock)
+
+    fleet = ServingFleet(
+        factory, n, admission=AdmissionConfig(max_queue_depth=256),
+        probe=ProbeConfig(slow_start_steps=1),
+        router=Router(prefix_bucket_len=128), clock=clock)
+    for _ in range(3):
+        fleet.step()
+    return fleet
+
+
+def _drive(cfg, params, *, use_ledger=True, slo=None, injector=None):
+    """A compact seeded burst through fleet + autoscaler (heavy load,
+    then an idle tail where horizons close and pages clear). The ledger
+    shares the drive's clock — the wiring serve_load uses. Returns
+    (scaler, ledger-or-None, fleet, cluster)."""
+    clock = FakeClock()
+    fleet = _fleet(cfg, params, 1, clock)
+    cluster = InMemoryCluster()
+    cluster.create(_svc(AutoscalePolicy(
+        min_replicas=1, max_replicas=4, target_ttft_s=0.3,
+        scale_up_cooldown_s=1.0, scale_down_cooldown_s=4.0,
+        flap_guard_s=0.0), slo=slo))
+    led = (DecisionLedger(clock, metrics=LedgerMetrics())
+           if use_ledger else None)
+    scaler = FleetAutoscaler(
+        cluster, config=JobControllerConfig(autoscale_window_scrapes=3,
+                                            autoscale_stale_scrapes=3),
+        clock=clock, ledger=led)
+    scaler.attach_fleet("default", "svc", fleet)
+    rng = np.random.default_rng(7)
+    if injector is not None:
+        chaos.install(injector)
+    try:
+        # burst: breaches pile up, the budget pages, the loop scales up
+        for _ in range(20):
+            for _ in range(4):
+                fleet.submit(rng.integers(0, cfg.vocab_size,
+                                          size=8).astype(np.int32), 4)
+            fleet.step()
+            clock.advance(0.5)
+            scaler.run_once()
+        # recovery: LIGHT live traffic — good observations refill the
+        # burn windows so the budget formally leaves page while the
+        # signal is still live (a dark signal never claims recovery)
+        for i in range(40):
+            if i % 2 == 0:
+                fleet.submit(rng.integers(0, cfg.vocab_size,
+                                          size=8).astype(np.int32), 2)
+            fleet.step()
+            clock.advance(0.5)
+            scaler.run_once()
+        for _ in range(8):
+            fleet.step()
+            clock.advance(0.5)
+            scaler.run_once()
+    finally:
+        if injector is not None:
+            chaos.uninstall(injector)
+    return scaler, led, fleet, cluster
+
+
+# --------------------------------------------------------------------------
+# the one decision-line serializer (satellite: three formats unified)
+# --------------------------------------------------------------------------
+class TestDecisionLineSerde:
+    OLD_LINES = [
+        # FleetAutoscaler service format (unchanged since PR 5)
+        "svc=default/svc seq=3 action=up replicas=1->2 "
+        "reason=ttft_p95=0.900000>slo=0.300000",
+        # per-pool format (PR 6)
+        "svc=default/svc pool=prefill seq=7 action=hold replicas=2->2 "
+        "reason=up_cooldown queue_wait_p95=0.5>slo=0.2",
+        "svc=default/svc pool=decode seq=2 action=down replicas=4->2 "
+        "reason=underutilized ttft_p95=none tokens_per_slot=0.100000",
+        # patch-failure format (service + pool)
+        "svc=default/svc seq=4 patch_failed Conflict",
+        "svc=default/svc pool=decode seq=9 patch_failed HttpError",
+        # bare policy.Decision.line() (unit tests, standalone loops)
+        "seq=1 action=hold replicas=2->2 reason=steady",
+        # the elastic log's format (new, same serializer)
+        "job=default/nj seq=2 action=up replicas=2->4 "
+        "reason=scaling 2 -> 4 hosts",
+    ]
+
+    def test_round_trip_every_historical_format(self):
+        for line in self.OLD_LINES:
+            parsed = parse_decision_line(line)
+            assert parsed is not None, line
+            assert parsed.line() == line
+
+    def test_parse_format_inverse_on_structured_input(self):
+        d = DecisionLine(seq=5, action="up", current=2, target=4,
+                         reason="slo_page ttft_p95=1.000000>slo=0.300000",
+                         scope=(("svc", "ns/x"),))
+        assert parse_decision_line(d.line()) == d
+        f = DecisionLine(seq=6, failure="ConflictRetriesExhausted",
+                         scope=(("svc", "ns/x"), ("pool", "decode")))
+        assert parse_decision_line(f.line()) == f
+
+    def test_rejects_non_decision_lines(self):
+        for junk in ("", "not a decision", "[elastic-metrics] epoch=1 "
+                     "batch=2 latency=0.5", "svc=x action=up",
+                     "svc=x seq=nope action=up replicas=1->2 reason=r",
+                     "seq=1 action=up replicas=oops reason=r",
+                     "seq=1 patch_failed "):
+            assert parse_decision_line(junk) is None, junk
+
+    def test_formatters_match_historical_bytes(self):
+        assert format_decision_line(
+            3, "up", 1, 2, "ttft_p95=0.900000>slo=0.300000",
+            scope=(("svc", "default/svc"),)) == self.OLD_LINES[0]
+        assert format_commit_failure_line(
+            4, "Conflict", scope=(("svc", "default/svc"),)) \
+            == self.OLD_LINES[3]
+
+
+# --------------------------------------------------------------------------
+# the ledger itself
+# --------------------------------------------------------------------------
+class TestDecisionLedger:
+    def _fill(self, led, clock):
+        r1 = led.decision(loop="l", tick=1, action="up", current=1,
+                          target=2, reason="r", commit=COMMIT_LANDED,
+                          signals=(("ttft_p95", "0.5"),),
+                          exemplars=(3,), horizon_open=True)
+        clock.advance(1.0)
+        led.decision(loop="l", tick=2, action="hold", current=2, target=2,
+                     reason="steady", parent=r1.seq)
+        clock.advance(1.0)
+        led.horizon(r1.seq, loop="l", event="replicas_ready",
+                    closing=True)
+
+    def test_monotone_seq_and_deterministic_dump(self, tmp_path):
+        docs = []
+        for _ in range(2):
+            clock = FakeClock()
+            led = DecisionLedger(clock)
+            self._fill(led, clock)
+            path = tmp_path / f"l{len(docs)}.json"
+            led.dump(str(path), extra={"slo_event_log": {}})
+            docs.append(path.read_bytes())
+            seqs = [r.seq for r in led.records]
+            assert seqs == sorted(seqs) == [1, 2, 3]
+        assert docs[0] == docs[1]
+        doc = load_ledger(str(tmp_path / "l0.json"))
+        assert [r["kind"] for r in doc["records"]] == [
+            "decision", "decision", "horizon"]
+        assert doc["records"][0]["signals"] == {"ttft_p95": "0.5"}
+
+    def test_load_rejects_non_ledger_files(self, tmp_path):
+        p = tmp_path / "x.json"
+        p.write_text(json.dumps({"format": "something-else"}))
+        with pytest.raises(ValueError):
+            load_ledger(str(p))
+
+    def test_metrics_feed(self):
+        clock = FakeClock()
+        m = LedgerMetrics()
+        led = DecisionLedger(clock, metrics=m)
+        r = led.decision(loop="l", tick=1, action="up", current=1,
+                         target=2, reason="r", commit="landed",
+                         horizon_open=True)
+        led.decision(loop="l", tick=2, action="up", current=1, target=2,
+                     reason="r", commit="conflict:Conflict")
+        led.decision(loop="l", tick=3, action="skip", current=0, target=0,
+                     reason="await")
+        assert m.counters[("decisions", "l|landed")] == 1
+        assert m.counters[("decisions", "l|conflict")] == 1
+        assert m.counters[("decisions", "l|skip")] == 1
+        assert m.counters[("commit_failures", "")] == 1
+        assert m.gauges[("open_effect_horizons", "")] == 1
+        led.horizon(r.seq, loop="l", event="replicas_ready", closing=True)
+        assert m.gauges[("open_effect_horizons", "")] == 0
+
+    def test_bounded_retention_counts_dropped(self):
+        led = DecisionLedger(FakeClock(), max_records=2)
+        for i in range(4):
+            led.decision(loop="l", tick=i, action="hold", current=1,
+                         target=1, reason="r")
+        assert len(led.records) == 2 and led.dropped == 2
+
+    def test_noop_is_inert(self):
+        assert NOOP.decision(loop="l", tick=1, action="up", current=1,
+                             target=2, reason="r") is None
+        assert NOOP.horizon(1, loop="l", event="x", closing=True) is None
+        assert NOOP.open_horizons() == 0 and NOOP.export() == []
+        with pytest.raises(RuntimeError):
+            NOOP.dump("/tmp/never")
+
+
+class TestCooldownGate:
+    def test_cooldowns_and_flap_guard(self):
+        g = CooldownGate(up_cooldown_s=2.0, down_cooldown_s=4.0,
+                         flap_guard_s=3.0)
+        assert not g.up_in_cooldown(0.0)
+        g.commit("up", 0.0)
+        assert g.up_in_cooldown(1.9) and not g.up_in_cooldown(2.0)
+        # a down right after an up is a flap
+        assert g.flap_blocked("down", 1.0) and not g.flap_blocked("down",
+                                                                  3.0)
+        g.commit("down", 5.0)
+        assert g.down_in_cooldown(8.9) and not g.down_in_cooldown(9.0)
+        assert g.flap_blocked("up", 6.0)
+        g.commit("hold", 10.0)        # holds stamp nothing
+        assert g.last_up_t == 0.0 and g.last_down_t == 5.0
+
+
+# --------------------------------------------------------------------------
+# the kernel template contract
+# --------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class _D:
+    seq: int
+    action: str
+    current: int
+    target: int
+    reason: str
+
+
+class _ToyLoop(LoopKernel):
+    """Scripted hooks: each tick consumes one (obs, decision, commit)
+    entry; exercises skip/conflict/horizon paths deterministically."""
+
+    def __init__(self, script, ledger):
+        super().__init__("toy", ledger=ledger)
+        self.script = list(script)
+        self.committed = []
+
+    def observe(self, ctx):
+        self.seq += 1
+        step = self.script.pop(0)
+        return step if step is not None else None
+
+    def decide(self, pack, ctx):
+        if pack.get("skip"):
+            return self.skip(pack["skip"])
+        return _D(self.seq, pack["action"], pack["current"],
+                  pack["target"], pack.get("reason", "r"))
+
+    def commit(self, pack, decision, ctx):
+        if pack.get("raise"):
+            raise RuntimeError("commit blew up")
+        self.committed.append(decision)
+        return pack.get("outcome", COMMIT_LANDED)
+
+    def horizon_events(self, h, pack, ctx):
+        return pack.get("horizon", ())
+
+
+class TestLoopKernel:
+    def test_skip_lands_a_ledger_record(self):
+        led = DecisionLedger(FakeClock())
+        loop = _ToyLoop([{"skip": "world_assembling"}], led)
+        assert loop.run_tick() is None
+        [rec] = led.records
+        assert rec.action == "skip" and rec.reason == "world_assembling"
+
+    def test_observe_none_records_nothing(self):
+        led = DecisionLedger(FakeClock())
+        loop = _ToyLoop([None], led)
+        assert loop.run_tick() is None and led.records == []
+
+    def test_commit_exception_ledgers_conflict_and_reraises(self):
+        led = DecisionLedger(FakeClock())
+        loop = _ToyLoop([{"action": "up", "current": 1, "target": 2,
+                          "raise": True}], led)
+        with pytest.raises(RuntimeError):
+            loop.run_tick()
+        [rec] = led.records
+        assert rec.commit == "conflict:RuntimeError"
+        assert loop.open_horizon is None and loop.last_committed is None
+
+    def test_horizon_open_close_parent_links_and_supersede(self):
+        led = DecisionLedger(FakeClock())
+        loop = _ToyLoop([
+            {"action": "up", "current": 1, "target": 2},
+            # effect observed: replicas ready closes the horizon
+            {"action": "hold", "current": 2, "target": 2,
+             "horizon": (("replicas_ready", True),)},
+            # a second commit whose horizon is superseded by a third
+            {"action": "up", "current": 2, "target": 4},
+            {"action": "down", "current": 4, "target": 2},
+        ], led)
+        for _ in range(4):
+            loop.run_tick()
+        kinds = [(getattr(r, "action", None), getattr(r, "event", None))
+                 for r in led.records]
+        # the takeover ordering: the new committed decision lands first,
+        # then the stale horizon closes citing the supersession
+        assert kinds == [("up", None), (None, "replicas_ready"),
+                         ("hold", None), ("up", None),
+                         ("down", None), (None, "superseded")]
+        # parent chain: hold's parent = first up; down's parent = 2nd up
+        decisions = [r for r in led.records if hasattr(r, "action")]
+        assert decisions[1].parent == decisions[0].seq
+        assert decisions[3].parent == decisions[2].seq
+        assert led.open_horizons() == 1   # the final down is still open
+
+    def test_noncommitted_decisions_open_no_horizon(self):
+        led = DecisionLedger(FakeClock())
+        loop = _ToyLoop([{"action": "up", "current": 1, "target": 2,
+                          "outcome": "conflict:Conflict"}], led)
+        loop.run_tick()
+        assert loop.open_horizon is None and led.open_horizons() == 0
+
+    def test_horizon_events_deduped(self):
+        led = DecisionLedger(FakeClock())
+        loop = _ToyLoop([
+            {"action": "up", "current": 1, "target": 2},
+            {"action": "hold", "current": 2, "target": 2,
+             "horizon": (("replicas_ready", False),)},
+            {"action": "hold", "current": 2, "target": 2,
+             "horizon": (("replicas_ready", False),
+                         ("burn_recovered", True))},
+        ], led)
+        for _ in range(3):
+            loop.run_tick()
+        events = [r.event for r in led.records if hasattr(r, "event")]
+        assert events == ["replicas_ready", "burn_recovered"]
+
+
+# --------------------------------------------------------------------------
+# the fleet autoscaler emitting uniformly
+# --------------------------------------------------------------------------
+class TestFleetAutoscalerLedger:
+    def test_byte_identical_across_runs_and_noop_neutral(self, tiny):
+        cfg, params = tiny
+        logs = []
+        dumps = []
+        for _ in range(2):
+            scaler, led, _, _ = _drive(cfg, params)
+            logs.append(list(scaler.decision_log))
+            dumps.append(led.lines())
+        assert logs[0] == logs[1]
+        assert dumps[0] == dumps[1] and len(dumps[0]) > 10
+        committed = [line for line in dumps[0] if "commit=landed" in line]
+        assert committed, "the burst must commit at least one scale"
+        assert any("event=replicas_ready" in line for line in dumps[0])
+        # ledger OFF: decision log byte-identical (NOOP neutrality — the
+        # ISSUE 15 acceptance bullet)
+        scaler_off, led_off, _, _ = _drive(cfg, params, use_ledger=False)
+        assert led_off is None
+        assert list(scaler_off.decision_log) == logs[0]
+
+    def test_patch_conflict_lands_as_conflict_outcome(self, tiny):
+        cfg, params = tiny
+        inj = chaos.FaultInjector([chaos.FaultRule(
+            chaos.SITE_AUTOSCALE_PATCH, chaos.on_call(1),
+            chaos.Conflict())], seed=0)
+        scaler, led, _, _ = _drive(cfg, params, injector=inj)
+        conflicts = [r for r in led.records
+                     if getattr(r, "commit", "").startswith("conflict:")]
+        assert conflicts \
+            and conflicts[0].commit == "conflict:ConflictError"
+        assert led.metrics.counters[("commit_failures", "")] >= 1
+        # the decision log still carries the patch_failed line, and it
+        # still parses through the shared serializer
+        failed = [line for line in scaler.decision_log
+                  if "patch_failed" in line]
+        assert failed and parse_decision_line(failed[0]).failure \
+            == "ConflictError"
+
+    def test_chaos_outage_trigger_joins_by_injector_seq(self, tiny):
+        cfg, params = tiny
+        inj = chaos.FaultInjector([chaos.FaultRule(
+            chaos.SITE_AUTOSCALE_SIGNAL, chaos.Trigger(at=(3,)),
+            chaos.SignalOutage())], seed=0)
+        scaler, led, _, _ = _drive(cfg, params, injector=inj)
+        chaos_recs = [r for r in led.records
+                      if getattr(r, "trigger", "").startswith("chaos#")]
+        assert chaos_recs, "the outage tick's decision must cite the fault"
+        n = int(chaos_recs[0].trigger.split("#")[1])
+        assert inj.events[n - 1].startswith(f"seq={n} ")
+        # and the embedded-log transport resolves it end to end
+        from tools.why_report import resolve_trigger
+        doc = {"records": led.export(), "chaos_events": list(inj.events)}
+        trig = resolve_trigger(chaos_recs[0].trigger, doc)
+        assert trig["resolved"] == inj.events[n - 1]
+        assert resolve_trigger("chaos#99", doc)["resolved"] is None
+
+    def test_deregistration_abandons_open_horizons(self, tiny):
+        # regression: a service deleted mid-scale (horizon open) must
+        # not pin the shared ledger's open_effect_horizons gauge forever
+        cfg, params = tiny
+        clock = FakeClock()
+        fleet = _fleet(cfg, params, 1, clock)
+        cluster = InMemoryCluster()
+        svc = _svc(AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                   target_ttft_s=0.3,
+                                   scale_up_cooldown_s=1.0))
+        cluster.create(svc)
+        led = DecisionLedger(clock, metrics=LedgerMetrics())
+        scaler = FleetAutoscaler(
+            cluster, config=JobControllerConfig(
+                autoscale_window_scrapes=3, autoscale_stale_scrapes=3),
+            clock=clock, ledger=led)
+        scaler.attach_fleet("default", "svc", fleet)
+        rng = np.random.default_rng(7)
+        for _ in range(12):
+            for _ in range(4):
+                fleet.submit(rng.integers(0, cfg.vocab_size,
+                                          size=8).astype(np.int32), 4)
+            fleet.step()
+            clock.advance(0.5)
+            scaler.run_once()
+            if led.open_horizons() >= 1:
+                break    # stop right at the commit: horizon still open
+        assert led.open_horizons() >= 1, "a scale-up must be in flight"
+        scaler.deregister(svc)
+        assert led.open_horizons() == 0
+        closes = [r for r in led.records if hasattr(r, "event")]
+        assert closes[-1].event == "abandoned" and closes[-1].closing
+        assert led.metrics.gauges[("open_effect_horizons", "")] == 0
+
+    def test_slo_page_chain_complete_and_resolvable(self, tiny):
+        from tools.why_report import (
+            build_chains,
+            chain_complete,
+            resolve_trigger,
+            why_pages,
+        )
+
+        cfg, params = tiny
+        # window sized so the burst's breaches age out during the
+        # light-traffic recovery phase — the budget leaves page LIVE
+        w = 4.0
+        slo = SLOPolicy(objectives=[SLOObjective(
+            name="ttft", objective="ttft_p95", target=0.3, window_s=w,
+            fast_short_s=w / 60, fast_long_s=w / 20, slow_short_s=w / 12,
+            slow_long_s=w / 4)])
+        scaler, led, _, _ = _drive(cfg, params, slo=slo)
+        doc = {"records": led.export(),
+               "slo_event_log": scaler.slo_event_lines()}
+        chains = build_chains(doc)
+        pages = why_pages(chains)
+        assert pages, "the burst must page and trigger a scale decision"
+        complete = [c for c in pages if chain_complete(c)]
+        assert complete, "page→decision→patch→ready→recovery must close"
+        trig = complete[-1]["trigger"]
+        assert trig["resolved"] is not None
+        assert "->page" in trig["resolved"] \
+            or "->exhausted" in trig["resolved"]
+        # an unknown episode ordinal must NOT resolve
+        bad = resolve_trigger("slo_page:default/svc#99", doc)
+        assert bad["resolved"] is None
+
+
+# --------------------------------------------------------------------------
+# the elastic autoscaler emitting uniformly
+# --------------------------------------------------------------------------
+class TestElasticLedger:
+    def _grow_env(self, ledger):
+        from tests.test_autoscaler import (
+            emit_metrics,
+            make_env,
+            native_job,
+        )
+        from tpu_on_k8s.controller.tpujob import submit_job
+
+        cluster, manager, scaler, sim = make_env()
+        scaler.ledger = ledger
+        submit_job(cluster, native_job(workers=2, hi=8))
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        return cluster, manager, scaler, sim, emit_metrics
+
+    def test_grow_decision_logged_and_ledgered(self):
+        led = DecisionLedger(FakeClock())
+        cluster, manager, scaler, sim, emit = self._grow_env(led)
+        emit(sim, "nj", 5, latency=1.0)
+        scaler.run_once()
+        # decision log: unified serializer, job scope
+        [line] = list(scaler.decision_log)
+        parsed = parse_decision_line(line)
+        assert parsed is not None and parsed.scope == (("job",
+                                                        "default/nj"),)
+        assert parsed.action == "up" and (parsed.current,
+                                          parsed.target) == (2, 4)
+        # ledger: one committed decision with an OPEN horizon
+        decisions = [r for r in led.records if hasattr(r, "action")]
+        assert len(decisions) == 1
+        assert decisions[0].loop == "elasticautoscaler/default/nj"
+        assert decisions[0].commit == COMMIT_LANDED
+        assert decisions[0].horizon == "open"
+        assert ("latency", "1.000000") in decisions[0].signals
+        # the world materializes at 4 hosts + post-scale metrics arrive:
+        # the horizon closes replicas_ready
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        emit(sim, "nj", 5, latency=0.4, start_batch=10)
+        scaler.run_once()
+        closes = [r for r in led.records if hasattr(r, "event")]
+        assert [c.event for c in closes][:1] == ["replicas_ready"]
+
+    def test_freeze_on_regression_is_a_ledgered_decision(self):
+        led = DecisionLedger(FakeClock())
+        cluster, manager, scaler, sim, emit = self._grow_env(led)
+        emit(sim, "nj", 5, latency=1.0)
+        scaler.run_once()                      # grow 2 -> 4
+        manager.run_until_idle()
+        sim.run_all("default")
+        manager.run_until_idle()
+        # regression at 4 hosts: latency-per-replica worse than at 2
+        emit(sim, "nj", 5, latency=4.0, start_batch=10)
+        scaler.run_once()                      # ReachMaxMetric revert
+        reasons = [r.reason for r in led.records if hasattr(r, "action")]
+        assert reasons[-1] == "ReachMaxMetric"
+        assert any("action=down" in line for line in scaler.decision_log)
+        # a freezing rescale opens NO horizon (the frozen loop has no
+        # future tick to close it — an unclosable horizon would pin the
+        # open_effect_horizons gauge as a standing false alert)
+        assert led.records[-1].horizon == "none"
+        assert led.open_horizons() == 0
+
+
+# --------------------------------------------------------------------------
+# why_report rendering
+# --------------------------------------------------------------------------
+class TestWhyReport:
+    def test_merged_timeline_has_control_tracks(self):
+        from tools.why_report import merged_timeline
+
+        led = DecisionLedger(FakeClock())
+        r = led.decision(loop="fleetautoscaler/s", tick=1, action="up",
+                         current=1, target=2, reason="r", commit="landed",
+                         horizon_open=True)
+        led.horizon(r.seq, loop="fleetautoscaler/s",
+                    event="replicas_ready", closing=True)
+        led.decision(loop="fleetautoscaler/s", tick=2, action="hold",
+                     current=2, target=2, reason="steady")
+        doc = {"records": led.export()}
+        tl = merged_timeline([], doc)
+        names = {e["name"] for e in tl["traceEvents"]}
+        assert "thread_name" in names          # the loop's named track
+        assert "up 1->2" in names              # committed slice
+        assert "horizon:replicas_ready" in names
+        assert "hold" in names                 # instant
+        pids = {e["pid"] for e in tl["traceEvents"]}
+        assert pids == {2}                     # control lane
+
+    def test_why_replicas_picks_latest_at_or_before_t(self):
+        from tools.why_report import build_chains, why_replicas
+
+        clock = FakeClock()
+        led = DecisionLedger(clock)
+        led.decision(loop="l", tick=1, action="up", current=1, target=2,
+                     reason="a", commit="landed", horizon_open=True)
+        clock.advance(5.0)
+        led.decision(loop="l", tick=2, action="up", current=2, target=4,
+                     reason="b", commit="landed", horizon_open=True)
+        chains = build_chains({"records": led.export()})
+        assert why_replicas(chains)["decision"]["reason"] == "b"
+        assert why_replicas(chains, at=1.0)["decision"]["reason"] == "a"
+        assert why_replicas(chains, at=-1.0) is None

@@ -36,6 +36,7 @@ from tpu_on_k8s.metrics.metrics import (
     AutoscaleMetrics,
     FleetMetrics,
     JobMetrics,
+    LedgerMetrics,
     ReshardMetrics,
     ServingMetrics,
     ShardMetrics,
@@ -524,11 +525,16 @@ def _populate(m):
         m.inc("reshard_fallbacks")
         m.inc("reshard_ack_failures")
         m.set_gauge("transform_seconds", 0.8)
+    elif isinstance(m, LedgerMetrics):
+        m.inc("decisions", label="fleetautoscaler/default/svc|landed")
+        m.inc("decisions", 3, label="fleetautoscaler/default/svc|hold")
+        m.inc("commit_failures")
+        m.set_gauge("open_effect_horizons", 1.0)
 
 
 _ALL_CLASSES = (JobMetrics, ServingMetrics, SpecMetrics, TrainMetrics,
                 FleetMetrics, AutoscaleMetrics, ShardMetrics, SLOMetrics,
-                ReshardMetrics)
+                ReshardMetrics, LedgerMetrics)
 
 
 class TestExposition:
@@ -736,7 +742,11 @@ def test_observability_doc_exists_and_covers_span_taxonomy():
                             "observability.md")).read()
     for needle in ("trace_report", "first_token", "queue", "prefill",
                    "handoff", "decode", "FlightRecorder", "--trace-out",
-                   "--profile-dir", "exposition"):
+                   "--profile-dir", "exposition",
+                   # decision provenance (ISSUE 15): the ledger, the
+                   # kernel, the causal-query tool, the 10th class
+                   "Decision provenance", "why_report", "--ledger-out",
+                   "LedgerMetrics", "loopkernel", "burn_recovered"):
         assert needle in doc, f"docs/observability.md missing {needle!r}"
 
 

@@ -295,7 +295,8 @@ def test_replica_crash_mid_decode_zero_silent_loss(setup):
             fleet.step()                           # the crash step
     finally:
         chaos.uninstall()
-    assert inj.events == ["replica_crash note=crash replica-1 mid-decode"]
+    assert inj.events == ["seq=1 replica_crash note=crash replica-1 "
+                          "mid-decode"]
     out = fleet.run()
     assert set(out) == set(rids)                   # every request accounted
     states = {rid: out[rid].state for rid in rids}

@@ -1,17 +1,18 @@
 """The invariant passes, keyed by their stable pass ids.
 
 Five intraprocedural passes (PR 8) plus the three whole-program
-concurrency passes (`tools/analyze/program.py` substrate). Each module
-may declare ``GRANULARITY = "file"`` when its findings for a file
-depend on that file alone — the incremental cache re-runs those only
-for changed files; everything else is whole-program and re-runs when
-any production file changes.
+concurrency passes (`tools/analyze/program.py` substrate) plus the
+ledger-coverage pass over the loop-kernel subclasses. Each module may
+declare ``GRANULARITY = "file"`` when its findings for a file depend on
+that file alone — the incremental cache re-runs those only for changed
+files; everything else is whole-program and re-runs when any production
+file changes.
 """
 from __future__ import annotations
 
-from tools.analyze.passes import (chaoscov, determinism, lockorder, locks,
-                                  locksets, metricsschema, silentloss,
-                                  threadroots)
+from tools.analyze.passes import (chaoscov, determinism, ledgercov,
+                                  lockorder, locks, locksets,
+                                  metricsschema, silentloss, threadroots)
 
 #: pass id -> run(repo) callable, in report order
 PASSES = {
@@ -20,6 +21,7 @@ PASSES = {
     silentloss.PASS_ID: silentloss.run,
     chaoscov.PASS_ID: chaoscov.run,
     metricsschema.PASS_ID: metricsschema.run,
+    ledgercov.PASS_ID: ledgercov.run,
     threadroots.PASS_ID: threadroots.run,
     locksets.PASS_ID: locksets.run,
     lockorder.PASS_ID: lockorder.run,
@@ -32,6 +34,7 @@ MODULES = {
     silentloss.PASS_ID: silentloss,
     chaoscov.PASS_ID: chaoscov,
     metricsschema.PASS_ID: metricsschema,
+    ledgercov.PASS_ID: ledgercov,
     threadroots.PASS_ID: threadroots,
     locksets.PASS_ID: locksets,
     lockorder.PASS_ID: lockorder,

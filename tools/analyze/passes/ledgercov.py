@@ -1,0 +1,231 @@
+"""Ledger-coverage pass: every decide/commit path in a loop-kernel
+subclass emits a decision-ledger record.
+
+The loop kernel's contract (`tpu_on_k8s/controller/loopkernel.py`) is
+that ``run_tick`` — and only ``run_tick`` — drives a control loop's
+observe→decide→commit anatomy, appending exactly one
+`obs/ledger.DecisionRecord` per decision. That contract holds only if
+subclasses cannot leak decisions around the template. Three leaks are
+machine-checkable, and each is a finding:
+
+* **a bare-None decide path** — ``decide`` returning ``None`` (bare
+  ``return`` or ``return None``) makes the kernel record NOTHING for
+  the tick; a declined decision must go through ``return
+  self.skip(reason)``, which ledgers the skip. (Returning
+  ``self.skip(...)`` is the one legal None.)
+* **a valueless commit path** — ``commit`` must return the commit
+  outcome string on EVERY path (``landed`` / ``conflict:*`` /
+  ``fallback:*``); a bare return would make a landed patch read as
+  "nothing happened" in the ledger.
+* **a template bypass** — overriding ``run_tick``, or calling
+  ``self.decide(...)`` / ``self.commit(...)`` directly from anywhere
+  but the kernel's own template (``super().decide/commit`` delegation
+  inside the same-named method is fine), executes a decision the
+  ledger never sees.
+
+Subclass detection is name-transitive across the production tree
+(``class X(LoopKernel)``, ``class Y(X)``, attribute bases like
+``loopkernel.LoopKernel`` included), so a new control loop joining the
+kernel is covered the moment it inherits.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.core import Finding, RepoIndex, SourceFile
+
+PASS_ID = "ledger-coverage"
+
+#: the kernel root (class name; defined in controller/loopkernel.py)
+KERNEL_ROOT = "LoopKernel"
+#: the recording template method — the only legal decide/commit caller
+TEMPLATE = "run_tick"
+#: the hooks whose paths must reach the ledger
+HOOKS = ("decide", "commit")
+
+
+def _base_name(node: ast.expr) -> Optional[str]:
+    """The terminal name of a base-class expression (``LoopKernel``,
+    ``loopkernel.LoopKernel`` → ``LoopKernel``)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _kernel_classes(repo: RepoIndex) -> Dict[Tuple[str, str], ast.ClassDef]:
+    """(file, class name) → ClassDef for every class in the kernel
+    family (the root plus name-transitive subclasses)."""
+    classes: List[Tuple[SourceFile, ast.ClassDef]] = []
+    for src in repo.files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                classes.append((src, node))
+    family: Set[str] = {KERNEL_ROOT}
+    changed = True
+    while changed:
+        changed = False
+        for _, cls in classes:
+            if cls.name in family:
+                continue
+            if any(_base_name(b) in family for b in cls.bases):
+                family.add(cls.name)
+                changed = True
+    return {(src.rel, cls.name): cls for src, cls in classes
+            if cls.name in family}
+
+
+def _is_none_return(node: ast.Return) -> bool:
+    return node.value is None or (
+        isinstance(node.value, ast.Constant) and node.value.value is None)
+
+
+def _is_skip_call(node: ast.Return) -> bool:
+    """``return self.skip(...)`` — the one legal None-valued decide
+    return (skip() itself appends the ledger record)."""
+    v = node.value
+    return (isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute)
+            and v.func.attr == "skip"
+            and isinstance(v.func.value, ast.Name)
+            and v.func.value.id == "self")
+
+
+def _definitely_exits(stmts: List[ast.stmt]) -> bool:
+    """Whether a statement list cannot fall off its end (conservative:
+    False when unsure). An implicit fall-through IS a ``return None`` —
+    the same unrecorded-decline / valueless-commit hole the explicit
+    bare-return checks close, so the pass must see it too."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, (ast.Return, ast.Raise)):
+        return True
+    if isinstance(last, ast.If):
+        return (bool(last.orelse) and _definitely_exits(last.body)
+                and _definitely_exits(last.orelse))
+    if isinstance(last, ast.With):
+        return _definitely_exits(last.body)
+    if isinstance(last, ast.Try):
+        body_ok = (_definitely_exits(last.orelse) if last.orelse
+                   else _definitely_exits(last.body))
+        handlers_ok = all(_definitely_exits(h.body)
+                          for h in last.handlers)
+        if last.finalbody and _definitely_exits(last.finalbody):
+            return True
+        return body_ok and handlers_ok
+    if isinstance(last, (ast.While, ast.For)):
+        # `while True:` with no break cannot fall through; anything
+        # else is treated as fallible (conservative)
+        if isinstance(last, ast.While) and isinstance(
+                last.test, ast.Constant) and last.test.value:
+            return not any(isinstance(n, ast.Break)
+                           for n in ast.walk(last))
+        return False
+    return False
+
+
+def _method_returns(fn: ast.FunctionDef) -> List[ast.Return]:
+    """Return statements belonging to ``fn`` itself (nested defs are
+    their own scopes)."""
+    out: List[ast.Return] = []
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Return):
+                out.append(child)
+            walk(child)
+
+    walk(fn)
+    return out
+
+
+def run(repo: RepoIndex) -> List[Finding]:
+    out: List[Finding] = []
+    kernel = _kernel_classes(repo)
+    if not kernel:
+        return out
+    for (rel, cls_name), cls in sorted(kernel.items()):
+        src = repo.file(rel)
+        is_root = cls_name == KERNEL_ROOT
+        for node in cls.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            qual = src.qualname(node) if src is not None else cls_name
+            if node.name == TEMPLATE and not is_root:
+                out.append(Finding(
+                    PASS_ID, rel, node.lineno, qual,
+                    "run-tick-override",
+                    f"{cls_name} overrides {TEMPLATE}() — the kernel "
+                    f"template is the ONE ledger-recording driver; "
+                    f"override the hooks, not the template"))
+            if node.name == "decide" and not is_root:
+                for ret in _method_returns(node):
+                    if _is_none_return(ret) and not _is_skip_call(ret):
+                        out.append(Finding(
+                            PASS_ID, rel, ret.lineno, qual,
+                            "decide-bare-none",
+                            f"{cls_name}.decide returns None without "
+                            f"self.skip(reason) — this tick would leave "
+                            f"no ledger record; a declined decision "
+                            f"must go through skip()"))
+                if not _definitely_exits(node.body):
+                    out.append(Finding(
+                        PASS_ID, rel, node.lineno, qual,
+                        "decide-implicit-return",
+                        f"{cls_name}.decide can fall off the end — an "
+                        f"implicit None return leaves the tick "
+                        f"unrecorded; end every path with a decision "
+                        f"or return self.skip(reason)"))
+            if node.name == "commit" and not is_root:
+                for ret in _method_returns(node):
+                    if _is_none_return(ret):
+                        out.append(Finding(
+                            PASS_ID, rel, ret.lineno, qual,
+                            "commit-bare-return",
+                            f"{cls_name}.commit has a valueless return "
+                            f"— every commit path must return its "
+                            f"outcome string (landed / conflict:* / "
+                            f"fallback:*) for the ledger record"))
+                if not _definitely_exits(node.body):
+                    out.append(Finding(
+                        PASS_ID, rel, node.lineno, qual,
+                        "commit-implicit-return",
+                        f"{cls_name}.commit can fall off the end — an "
+                        f"implicit None is not a commit outcome; end "
+                        f"every path with the outcome string (landed / "
+                        f"conflict:* / fallback:*)"))
+            # template bypass: self.decide(...) / self.commit(...)
+            # anywhere but the root's run_tick; super().<hook>(...)
+            # delegation inside the same-named hook is legal
+            if is_root and node.name == TEMPLATE:
+                continue
+            for call in ast.walk(node):
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr in HOOKS):
+                    continue
+                recv = call.func.value
+                if isinstance(recv, ast.Name) and recv.id == "self":
+                    out.append(Finding(
+                        PASS_ID, rel, call.lineno, qual,
+                        f"direct-call:{call.func.attr}",
+                        f"{qual} calls self.{call.func.attr}() directly "
+                        f"— decisions must flow through "
+                        f"{TEMPLATE}(), which records them in the "
+                        f"ledger"))
+                elif (isinstance(recv, ast.Call)
+                      and isinstance(recv.func, ast.Name)
+                      and recv.func.id == "super"
+                      and node.name != call.func.attr):
+                    out.append(Finding(
+                        PASS_ID, rel, call.lineno, qual,
+                        f"direct-call:{call.func.attr}",
+                        f"{qual} calls super().{call.func.attr}() from "
+                        f"outside the {call.func.attr} hook — decisions "
+                        f"must flow through {TEMPLATE}()"))
+    return out

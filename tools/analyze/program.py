@@ -247,6 +247,15 @@ class ProgramIndex:
         self._index_defs()
         self._index_bodies()
         self._resolve_spawn_roots()
+        #: virtual-dispatch edges (base method key -> override key):
+        #: a call resolved to ``Base.m`` may land on any repo-known
+        #: override, so overrides are reachable (and inherit entry-lock
+        #: contexts) wherever the base method is. Without these, a
+        #: template-method base class (the loop kernel's ``run_tick``
+        #: driving subclass ``observe``/``decide``/``commit``) would
+        #: strand every override on the synthetic main root and the
+        #: lockset pass would misattribute their thread ownership.
+        self.virtual_calls: List[CallSite] = self._virtual_calls()
         self.roots_of: Dict[str, FrozenSet[str]] = self._reachability()
         self.entry_must: Dict[str, FrozenSet[str]] = {}
         self.entry_may: Dict[str, FrozenSet[str]] = {}
@@ -425,9 +434,44 @@ class ProgramIndex:
                             multi=True))
 
     # ------------------------------------------------------------ reachability
+    def _virtual_calls(self) -> List[CallSite]:
+        """One synthetic call site per (ancestor method, override) pair
+        — the dynamic-dispatch closure. The site carries no local locks
+        (dispatch happens at the call, under whatever the caller's
+        entry context guarantees), a line of 0, and the ``<virtual>``
+        name so report-rendering passes can skip it; it participates
+        ONLY in reachability and the entry-lockset fixpoint."""
+        out: List[CallSite] = []
+        for infos in self.classes.values():
+            for info in infos:
+                for name, key in info.methods.items():
+                    if name == "__init__":
+                        continue
+                    seen: Set[str] = set()
+                    stack = [self.class_info(b, info.rel)
+                             for b in info.bases]
+                    while stack:
+                        anc = stack.pop()
+                        if anc is None or anc.qual in seen:
+                            continue
+                        seen.add(anc.qual)
+                        base_key = anc.methods.get(name)
+                        if base_key is not None and base_key != key \
+                                and base_key in self.functions \
+                                and key in self.functions:
+                            out.append(CallSite(
+                                caller=base_key, callee=key,
+                                name="<virtual>", rel=info.rel, line=0,
+                                held=frozenset(), nargs=0,
+                                has_timeout=False, same_instance=True,
+                                receiver_lock=None))
+                        stack.extend(self.class_info(b, anc.rel)
+                                     for b in anc.bases)
+        return out
+
     def _callee_map(self) -> Dict[str, List[str]]:
         adj: Dict[str, List[str]] = {}
-        for c in self.calls:
+        for c in self.calls + self.virtual_calls:
             if c.callee is not None:
                 adj.setdefault(c.caller, []).append(c.callee)
         return adj
@@ -481,7 +525,8 @@ class ProgramIndex:
             for k in self.functions}
         may: Dict[str, FrozenSet[str]] = {k: frozenset()
                                           for k in self.functions}
-        sites = [c for c in self.calls if c.callee in self.functions]
+        sites = [c for c in self.calls + self.virtual_calls
+                 if c.callee in self.functions]
         for _ in range(60):                         # bounded fixpoint
             changed = False
             for c in self.sorted_calls(sites):

@@ -509,6 +509,27 @@ def stage_serve_slo(timeout):
                         "--slo-window", "60"], "serve_slo", timeout)
 
 
+def stage_serve_why(timeout):
+    """Decision provenance on the flagship config: the seeded autoscale
+    burst with an SLO objective attached, the decision ledger enabled,
+    and the span dump captured — the recorded summary carries the
+    resolved page→decision→patch→recovery chain counts
+    (`tools/why_report.py` over the same artifacts), proving the
+    control-plane causal join works on hardware traffic, not just the
+    CPU cost model (virtual-clock decisions, deterministic regardless
+    of chip speed)."""
+    return _json_stage([sys.executable, "tools/serve_load.py", "--bench",
+                        "--autoscale", "--n-slots", "4",
+                        "--n-requests", "96", "--rate", "1.0",
+                        "--burst-start", "6", "--burst-len", "10",
+                        "--burst-rate", "6.0", "--autoscale-slo", "0.3",
+                        "--autoscale-slo-window", "0.8",
+                        "--flap-guard", "2.0",
+                        "--ledger-out", "/tmp/tpu_on_k8s_why_ledger.json",
+                        "--trace-out", "/tmp/tpu_on_k8s_why_trace.json"],
+                       "serve_why", timeout)
+
+
 def stage_train_reshard(timeout):
     """Live mesh reconfiguration measured on hardware: a real in-process
     2→4→2 reshard of a train state (`tools/reshard_soak.py --bench` —
@@ -554,6 +575,7 @@ STAGES = [
     ("serve_disagg", stage_serve_disagg, 1200, ()),
     ("serve_trace", stage_serve_trace, 1200, ()),
     ("serve_slo", stage_serve_slo, 1200, ()),
+    ("serve_why", stage_serve_why, 1200, ()),
 ]
 
 

@@ -121,9 +121,22 @@ def _step_dt(rng, chips: int) -> float:
     return round(base * (1.0 + 0.02 * float(rng.random())), 9)
 
 
+class _CellClock:
+    """Mutable virtual-clock cell the decision ledger reads — the arm
+    updates ``t`` to its own virtual time before each ledger append, so
+    records carry cost-model time, never wall time."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
 def run_arm(seed: int, live: bool, *, steps_total: int = 600,
             rescale_up_at: int = 210, rescale_down_at: int = 410,
-            ckpt_every: int = 50) -> Tuple[List[str], Dict]:
+            ckpt_every: int = 50, ledger=None,
+            lclock: "_CellClock" = None) -> Tuple[List[str], Dict]:
     """One arm of the race: the same timeline (2 chips → 4 before step
     ``rescale_up_at`` → back to 2 before ``rescale_down_at``), rescales
     executed live or via checkpoint-restart. The rescale points sit OFF
@@ -151,6 +164,22 @@ def run_arm(seed: int, live: bool, *, steps_total: int = 600,
         target = pending.pop(step, None)
         if target is not None:
             to_chips, plan = target
+            rec = None
+            if ledger is not None:
+                # one provenance record per rescale decision: the same
+                # Decision/horizon vocabulary the autoscaler loops emit,
+                # on the arm's own virtual clock (byte-identical per
+                # seed — the cost model IS the clock)
+                lclock.t = vclock
+                rec = ledger.decision(
+                    loop=f"reshard/{arm}", tick=step, action="reshard",
+                    current=chips, target=to_chips,
+                    reason=("live transform" if live
+                            else "checkpoint restart"),
+                    commit="landed",
+                    signals=(("bytes", str(plan.bytes_moved if live
+                                           else plan.bytes_total)),),
+                    horizon_open=True)
             if live:
                 pause = plan.bytes_moved / RESHARD_BW + WARM_COMPILE_S
                 acct.pause("reshard", pause)
@@ -181,6 +210,12 @@ def run_arm(seed: int, live: bool, *, steps_total: int = 600,
             chips = to_chips
             vclock += pause
             pause_total += pause
+            if rec is not None:
+                # the rescale's effect horizon closes when the pause
+                # ends and stepping resumes at the new size
+                lclock.t = vclock
+                ledger.horizon(rec.seq, loop=f"reshard/{arm}",
+                               event="rollout_complete", closing=True)
         rng = np.random.default_rng((seed, step))
         dt = _step_dt(rng, chips)
         step += 1
@@ -276,9 +311,18 @@ def run_bench(seed: int) -> Dict:
 
 
 # --------------------------------------------------------------------- main
-def run_all(seed: int) -> Dict:
-    live_events, live = run_arm(seed, live=True)
-    restart_events, restart = run_arm(seed, live=False)
+def run_all(seed: int, ledger_out: str = "") -> Dict:
+    ledger = None
+    lclock = None
+    if ledger_out:
+        from tpu_on_k8s.obs.ledger import DecisionLedger
+
+        lclock = _CellClock()
+        ledger = DecisionLedger(lclock)
+    live_events, live = run_arm(seed, live=True, ledger=ledger,
+                                lclock=lclock)
+    restart_events, restart = run_arm(seed, live=False, ledger=ledger,
+                                      lclock=lclock)
     events = live_events + restart_events
     assert live["pause_s"] < restart["pause_s"], (
         f"live reshard must beat checkpoint-restart on pause seconds: "
@@ -291,7 +335,7 @@ def run_all(seed: int) -> Dict:
         "reshard" not in restart["waste_s"], (
         "the pause must be attributed to the reshard bucket on the live "
         "arm only")
-    return {
+    out = {
         "seed": seed,
         "live": live,
         "restart": restart,
@@ -301,6 +345,16 @@ def run_all(seed: int) -> Dict:
         "events": events,
         "events_crc": f"{zlib.crc32(chr(10).join(events).encode()):08x}",
     }
+    if ledger is not None:
+        from tpu_on_k8s import chaos
+
+        inj = chaos.active()
+        ledger.dump(ledger_out,
+                    extra=({"chaos_events": list(inj.events)}
+                           if inj is not None and inj.events else None))
+        out["ledger_out"] = ledger_out
+        out["ledger_records"] = len(ledger.records)
+    return out
 
 
 def main(argv=None) -> int:
@@ -313,12 +367,17 @@ def main(argv=None) -> int:
     p.add_argument("--bench", action="store_true",
                    help="measure a real in-process 2->4->2 reshard "
                         "instead of the cost model (chip_window stage)")
+    p.add_argument("--ledger-out", default="",
+                   help="write both arms' rescale decisions as a "
+                        "decision ledger (tpu_on_k8s/obs/ledger.py "
+                        "dump, cost-model clock) here")
     args = p.parse_args(argv)
     try:
         if args.bench:
             print(json.dumps(run_bench(args.seed), indent=2))
             return 0
-        runs = [run_all(args.seed) for _ in range(max(args.repeat, 1))]
+        runs = [run_all(args.seed, ledger_out=args.ledger_out)
+                for _ in range(max(args.repeat, 1))]
         for later in runs[1:]:
             assert later["events"] == runs[0]["events"], (
                 "event logs diverged across repeats:\n"

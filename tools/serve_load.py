@@ -398,26 +398,40 @@ class _VirtualClock:
 
 def run_autoscale_trace(args, cfg, params, max_len, *,
                         enabled: bool = True,
-                        trace: bool = False) -> dict:
+                        trace: bool = False,
+                        ledger_out: str = "") -> dict:
     """One seeded bursty trace through ServingFleet + FleetAutoscaler:
     the closed loop scrapes the fleet, patches the InferenceService's
     ``spec.replicas``, and applies the target back to the fleet. Returns
     the summary (decisions, replica trajectory, TTFT percentiles,
     zero-loss accounting). ``enabled=False`` is the control arm: same
     trace, same virtual clock, autoscaler never ticked — the fleet stays
-    at ``min_replicas`` (what "TTFT before autoscaling" means)."""
+    at ``min_replicas`` (what "TTFT before autoscaling" means).
+
+    ``--autoscale-slo`` > 0 adds a ``spec.slo`` TTFT objective at that
+    target (burn windows scaled to ``--autoscale-slo-window`` virtual
+    seconds), so the burst pages the error budget and the page grants
+    the scale-up its one cooldown bypass — the seeded SLO-regression
+    story `make why-demo` asserts. ``ledger_out`` attaches a
+    `obs/ledger.DecisionLedger` on the SAME virtual clock and dumps it
+    (with the SLO budget event log embedded) — byte-identical across
+    runs of one seed, and the input `tools/why_report.py` resolves the
+    page→decision→patch→recovery chain from."""
     from tpu_on_k8s.api.core import ObjectMeta
     from tpu_on_k8s.api.inference_types import (
         AutoscalePolicy,
         InferenceService,
         InferenceServiceSpec,
+        SLOObjective,
+        SLOPolicy,
     )
     from tpu_on_k8s.api.types import TPUPolicy
     from tpu_on_k8s.client import InMemoryCluster
     from tpu_on_k8s.controller.config import JobControllerConfig
     from tpu_on_k8s.controller.fleetautoscaler import FleetAutoscaler
-    from tpu_on_k8s.metrics.metrics import AutoscaleMetrics
+    from tpu_on_k8s.metrics.metrics import AutoscaleMetrics, LedgerMetrics
     from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+    from tpu_on_k8s.obs.ledger import DecisionLedger
     from tpu_on_k8s.serve import (
         AdmissionConfig,
         ProbeConfig,
@@ -430,6 +444,10 @@ def run_autoscale_trace(args, cfg, params, max_len, *,
     # one tracer for fleet AND autoscaler: request spans and
     # autoscale.tick spans interleave on one virtual-clock timeline
     tracer = _make_tracer(args, vclock) if trace else None
+    # the ledger rides the SAME virtual clock: records are a pure
+    # function of (seed, flags) — `make why-demo` byte-compares dumps
+    ledger = (DecisionLedger(vclock, metrics=LedgerMetrics())
+              if ledger_out else None)
 
     def factory(name):
         # the engine's queue/slot timestamps read the SAME virtual clock
@@ -446,6 +464,16 @@ def run_autoscale_trace(args, cfg, params, max_len, *,
         router=Router(prefix_bucket_len=args.prefix_bucket),
         clock=vclock, tracer=tracer)
 
+    slo = None
+    if args.autoscale_slo > 0:
+        # burn windows scaled to the virtual trace, like the --slo mode:
+        # the fast-short window must still cover a few driver steps or
+        # it empties between arrivals and reads as no-data
+        w = args.autoscale_slo_window
+        slo = SLOPolicy(objectives=[SLOObjective(
+            name="ttft", objective="ttft_p95", target=args.autoscale_slo,
+            window_s=w, fast_short_s=w / 60, fast_long_s=w / 20,
+            slow_short_s=w / 12, slow_long_s=w / 4)])
     cluster = InMemoryCluster()
     cluster.create(InferenceService(
         metadata=ObjectMeta(name="load"),
@@ -461,12 +489,14 @@ def run_autoscale_trace(args, cfg, params, max_len, *,
                 hysteresis=0.1, max_step=args.max_scale_step,
                 scale_up_cooldown_s=args.up_cooldown,
                 scale_down_cooldown_s=args.down_cooldown,
-                flap_guard_s=args.flap_guard))))
+                flap_guard_s=args.flap_guard),
+            slo=slo)))
     autoscaler = FleetAutoscaler(
         cluster,
         config=JobControllerConfig(autoscale_window_scrapes=3,
                                    autoscale_stale_scrapes=3),
-        metrics=AutoscaleMetrics(), clock=vclock, tracer=tracer)
+        metrics=AutoscaleMetrics(), clock=vclock, tracer=tracer,
+        ledger=ledger)
     autoscaler.attach_fleet("default", "load", fleet)
 
     rng = np.random.default_rng(args.seed)
@@ -567,7 +597,40 @@ def run_autoscale_trace(args, cfg, params, max_len, *,
         "ttft_ms_p95_post_scale": _pctl(post, 0.95),
         "decisions": list(autoscaler.decision_log),
     }
+    if slo is not None:
+        final_slo = cluster.get(InferenceService, "default",
+                                "load").status.slo
+        summary["slo_final_state"] = {
+            name: st.state for name, st in sorted(final_slo.items())}
+        summary["slo_event_log"] = [
+            line for lines in autoscaler.slo_event_lines().values()
+            for line in lines]
     _dump_trace(tracer, args, summary)
+    if ledger is not None:
+        from tpu_on_k8s import chaos
+
+        # embed the sibling logs why_report joins against: the budget
+        # event log (slo_page triggers) and, when a fault schedule is
+        # installed, the injector's sequence-stamped events (chaos#N
+        # triggers) — the ledger cites both; the dump must carry both
+        extra = {"slo_event_log": autoscaler.slo_event_lines()}
+        inj = chaos.active()
+        if inj is not None and inj.events:
+            extra["chaos_events"] = list(inj.events)
+        ledger.dump(ledger_out, extra=extra)
+        summary["ledger_out"] = ledger_out
+        summary["ledger_records"] = len(ledger.records)
+        # fold the resolved causal chains in: the shape the chip
+        # window's serve_why stage records, and a cheap in-process
+        # pre-check of what `tools/why_report.py --check` gates on
+        from tools.why_report import build_report
+        doc = {"records": ledger.export(), **extra}
+        rep = build_report(
+            doc, tracer.export() if tracer is not None else None)
+        summary["ledger_committed"] = rep["committed"]
+        summary["ledger_page_chains"] = len(rep["pages"])
+        summary["ledger_complete_page_chains"] = len(
+            rep["complete_page_chains"])
     return summary
 
 
@@ -581,7 +644,8 @@ def _autoscale_main(args, cfg, params, max_len) -> dict:
     ``AUTOSCALE_SOAK_FAILED seed=N`` on violation."""
     baseline = run_autoscale_trace(args, cfg, params, max_len,
                                    enabled=False)
-    summary = run_autoscale_trace(args, cfg, params, max_len, trace=True)
+    summary = run_autoscale_trace(args, cfg, params, max_len, trace=True,
+                                  ledger_out=args.ledger_out)
     summary["ttft_ms_p95_static_baseline"] = baseline["ttft_ms_p95"]
     summary["ttft_ms_p50_static_baseline"] = baseline["ttft_ms_p50"]
     summary["baseline_driver_steps"] = baseline["driver_steps"]
@@ -1662,6 +1726,21 @@ def main(argv=None) -> dict:
                    help="mean arrivals per step during the burst")
     p.add_argument("--autoscale-every", type=int, default=2,
                    help="autoscaler tick every N driver steps")
+    p.add_argument("--autoscale-slo", type=float, default=0.0,
+                   help=">0: add a spec.slo TTFT p95 objective at this "
+                        "target (virtual seconds) to the autoscaled "
+                        "service — the burst pages the error budget and "
+                        "the page grants the scale-up its cooldown "
+                        "bypass (--autoscale); 0 is byte-identical to "
+                        "the SLO-free trace")
+    p.add_argument("--autoscale-slo-window", type=float, default=6.0,
+                   help="the SLO compliance window in virtual seconds "
+                        "(burn windows derive from it)")
+    p.add_argument("--ledger-out", default="",
+                   help="write the decision ledger "
+                        "(tpu_on_k8s/obs/ledger.py dump; the "
+                        "tools/why_report.py input) here — autoscale "
+                        "mode, virtual clock, byte-identical per seed")
     p.add_argument("--step-dt", type=float, default=0.05,
                    help="virtual seconds per driver step")
     p.add_argument("--min-replicas", type=int, default=1)

@@ -40,6 +40,10 @@ import dataclasses
 from typing import List, Optional
 
 from tpu_on_k8s.autoscale.signals import FleetObservation
+from tpu_on_k8s.controller.loopkernel import (
+    CooldownGate,
+    format_decision_line,
+)
 from tpu_on_k8s.gang import topology
 
 ACTION_UP = "up"
@@ -49,9 +53,11 @@ ACTION_HOLD = "hold"
 
 @dataclasses.dataclass(frozen=True)
 class Decision:
-    """One recommendation. ``line()`` is the stable decision-log form:
-    only observation-derived values (deterministic under an injected
-    clock) — no wall time, no object ids."""
+    """One recommendation. ``line()`` is the stable decision-log form
+    (the shared `controller/loopkernel` serializer — byte-identical to
+    the historical format): only observation-derived values
+    (deterministic under an injected clock) — no wall time, no object
+    ids."""
 
     seq: int
     action: str
@@ -60,9 +66,8 @@ class Decision:
     reason: str
 
     def line(self) -> str:
-        return (f"seq={self.seq} action={self.action} "
-                f"replicas={self.current}->{self.target} "
-                f"reason={self.reason}")
+        return format_decision_line(self.seq, self.action, self.current,
+                                    self.target, self.reason)
 
 
 def _fmt(v: Optional[float]) -> str:
@@ -81,8 +86,13 @@ class Recommender:
             else policy
         self.accelerator = accelerator if getattr(
             self.policy, "slice_legal", True) else ""
-        self._last_up_t: Optional[float] = None
-        self._last_down_t: Optional[float] = None
+        # tempo state lives in the shared loop-kernel gate: separate
+        # up/down cooldowns + flap damping, stamped only on commit
+        self.gate = CooldownGate(
+            up_cooldown_s=getattr(self.policy, "scale_up_cooldown_s", 0.0),
+            down_cooldown_s=getattr(self.policy, "scale_down_cooldown_s",
+                                    0.0),
+            flap_guard_s=getattr(self.policy, "flap_guard_s", 0.0))
 
     # ------------------------------------------------------------ legality
     def _step_up(self, cur: int) -> Optional[int]:
@@ -155,10 +165,7 @@ class Recommender:
         scale-up."""
         if decision.reason.startswith("warm_floor"):
             return
-        if decision.action == ACTION_UP:
-            self._last_up_t = now
-        elif decision.action == ACTION_DOWN:
-            self._last_down_t = now
+        self.gate.commit(decision.action, now)
 
     # ----------------------------------------------------------- internals
     def _up_reasons(self, obs: FleetObservation) -> List[str]:
@@ -209,8 +216,7 @@ class Recommender:
         if cur >= p.max_replicas:
             return Decision(obs.seq, ACTION_HOLD, cur, cur,
                             f"at_max {reason}")
-        in_cooldown = (self._last_up_t is not None
-                       and now - self._last_up_t < p.scale_up_cooldown_s)
+        in_cooldown = self.gate.up_in_cooldown(now)
         if in_cooldown and not urgent:
             return Decision(obs.seq, ACTION_HOLD, cur, cur,
                             f"up_cooldown {reason}")
@@ -218,8 +224,7 @@ class Recommender:
             # paged through the cooldown: the reason says so, so the
             # decision log attributes the early move to the budget burn
             reason = f"slo_page {reason}"
-        if self._last_down_t is not None \
-                and now - self._last_down_t < p.flap_guard_s:
+        if self.gate.flap_blocked(ACTION_UP, now):
             return Decision(obs.seq, ACTION_HOLD, cur, cur,
                             f"flap_damped {reason}")
         steps = min(p.max_step, max(1, int(self._severity(obs))))
@@ -288,12 +293,10 @@ class Recommender:
                   f"tokens_per_slot={_fmt(obs.tokens_per_slot)}")
         if cur <= floor:
             return Decision(obs.seq, ACTION_HOLD, cur, cur, "at_floor")
-        if self._last_down_t is not None \
-                and now - self._last_down_t < p.scale_down_cooldown_s:
+        if self.gate.down_in_cooldown(now):
             return Decision(obs.seq, ACTION_HOLD, cur, cur,
                             f"down_cooldown {reason}")
-        if self._last_up_t is not None \
-                and now - self._last_up_t < p.flap_guard_s:
+        if self.gate.flap_blocked(ACTION_DOWN, now):
             return Decision(obs.seq, ACTION_HOLD, cur, cur,
                             f"flap_damped {reason}")
         nxt = self._step_down(cur)

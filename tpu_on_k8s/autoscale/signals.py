@@ -63,6 +63,12 @@ class FleetSample:
     slots: int = 0
     ready_replicas: int = 0
     ok: bool = True
+    #: trace ids (`obs/trace.py` counter ids) of the newest retained
+    #: TTFT exemplars at scrape time — the join key from this scrape's
+    #: latency picture back to the request span trees that produced it
+    #: (the decision ledger records these on every decision). Empty when
+    #: tracing is off; the log-scrape plane leaves it empty too.
+    exemplars: Tuple[int, ...] = ()
 
 
 def dead_sample(seq: int) -> FleetSample:
@@ -138,6 +144,7 @@ class FleetScraper:
         ttft = []
         qwait = []
         tpot = []
+        exemplars = []
         slots = 0
         inflight = 0
         ready = 0
@@ -179,11 +186,24 @@ class FleetScraper:
                     # before this scrape — take what survives
                     out.extend(vals[-min(new, len(vals)):])
                 self._seen[mark] = total
+            # newest retained TTFT exemplar trace ids (≤2 per replica):
+            # the decision ledger's span join key. Not delta-read — the
+            # exemplar deque carries no monotone count; "the freshest
+            # evidence at scrape time" is exactly what a decision cites.
+            # (duck-typed like the rest of the scrape: a bare-histogram
+            # metrics stub simply contributes none)
+            mirror = getattr(rep.metrics, "exemplars", None)
+            if mirror is not None:
+                with rep.metrics._lock:
+                    tail = list(mirror["time_to_first_token_seconds"])[-2:]
+                exemplars.extend(int(tid) for _, tid in tail
+                                 if isinstance(tid, int))
         return FleetSample(
             seq=seq, ttft=tuple(ttft), queue_wait=tuple(qwait),
             tpot=tuple(tpot),
             queue_depth=fleet.queue_depth, inflight_tokens=inflight,
-            slots=slots, ready_replicas=ready)
+            slots=slots, ready_replicas=ready,
+            exemplars=tuple(exemplars))
 
 
 def format_observation_line(sample: FleetSample, *, epoch: int,

@@ -75,7 +75,9 @@ from tpu_on_k8s.chaos.injector import (
     active,
     every,
     fire,
+    fire_seq,
     install,
+    last_event_seq,
     on_call,
     uninstall,
     with_prob,
@@ -129,7 +131,9 @@ __all__ = [
     "active",
     "every",
     "fire",
+    "fire_seq",
     "install",
+    "last_event_seq",
     "on_call",
     "uninstall",
     "with_prob",

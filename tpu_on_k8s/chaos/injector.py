@@ -15,10 +15,15 @@ Determinism contract (what makes a seeded run replayable):
   ``(seed, site, rule index)`` — never global randomness, so two injectors
   built from the same schedule+seed fire identically, and an unrelated
   rule added later does not shift another rule's draws.
-* The event log records only the rule's stable description
-  (`faults.describe`) — no wall-clock, no thread-dependent context — so
-  two runs of the same scenario produce byte-identical logs (the
-  acceptance check `tools/chaos_soak.py` enforces).
+* The event log records only a monotone sequence id plus the rule's
+  stable description (`faults.describe`) — no wall-clock, no
+  thread-dependent context — so two runs of the same scenario produce
+  byte-identical logs (the acceptance check `tools/chaos_soak.py`
+  enforces). The sequence id (``seq=N`` prefix, 1-based append order)
+  is the join key the decision ledger (`obs/ledger.py`) records when a
+  control loop's tick was perturbed by an injection: the same seeded
+  schedule produces the same ids every replay, so ledger→fault joins
+  are stable across runs.
 
 Thread-safety: ``fire`` takes the injector lock (watch loops and frontend
 threads hit sites concurrently). Rules fire in schedule order; at most one
@@ -166,7 +171,16 @@ class FaultInjector:
     def fire(self, site: str, **ctx) -> Optional[Fault]:
         """Count this invocation against every matching rule; return the
         first rule's fault elected to fire (or None)."""
+        return self.fire_seq(site, **ctx)[0]
+
+    def fire_seq(self, site: str, **ctx) -> Tuple[Optional[Fault], int]:
+        """Like ``fire``, but also returns THIS invocation's event
+        sequence id (0 when nothing fired) — allocated atomically under
+        the injector lock, so a concurrent fault on another thread can
+        never make a caller cite someone else's event. The join key the
+        decision ledger records as ``chaos#N``."""
         hit: Optional[FaultRule] = None
+        seq = 0
         with self._lock:
             for i, rule in enumerate(self.rules):
                 if rule.site != site:
@@ -184,9 +198,21 @@ class FaultInjector:
                 if self._elects(rule.trigger, st.seen, self._rngs.get(i)):
                     st.fired += 1
                     hit = rule
-                    self.events.append(describe(rule.fault,
-                                                rule.note or None))
-        return hit.fault if hit is not None else None
+                    # seq = 1-based append order: the monotone id the
+                    # decision ledger joins against (stable per seed)
+                    seq = len(self.events) + 1
+                    self.events.append(
+                        f"seq={seq} "
+                        + describe(rule.fault, rule.note or None))
+        return (hit.fault if hit is not None else None, seq)
+
+    @property
+    def last_seq(self) -> int:
+        """Sequence id of the most recently logged injection (0 when
+        nothing fired yet) — a global high-water mark for inspection;
+        callers joining a SPECIFIC injection must use ``fire_seq``."""
+        with self._lock:
+            return len(self.events)
 
     @staticmethod
     def _elects(trigger: Trigger, seen: int,
@@ -258,3 +284,22 @@ def fire(site: str, **ctx) -> Optional[Fault]:
     if inj is None:
         return None
     return inj.fire(site, **ctx)
+
+
+def fire_seq(site: str, **ctx) -> Tuple[Optional[Fault], int]:
+    """``fire`` plus THIS invocation's event seq id (0 = no fault),
+    atomic under the injector lock — what the decision ledger's
+    ``chaos#N`` trigger join uses (a post-hoc ``last_event_seq`` read
+    could cite a concurrent thread's injection)."""
+    inj = _active
+    if inj is None:
+        return None, 0
+    return inj.fire_seq(site, **ctx)
+
+
+def last_event_seq() -> int:
+    """Sequence id of the active injector's newest event (0 with no
+    injector, or nothing fired) — a global high-water mark; use
+    ``fire_seq`` to join a specific injection."""
+    inj = _active
+    return 0 if inj is None else inj.last_seq

@@ -30,18 +30,35 @@ from __future__ import annotations
 
 import math
 import threading
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional
 
 from tpu_on_k8s.api import constants
 from tpu_on_k8s.api.core import Pod, PodPhase
 from tpu_on_k8s.api.types import ElasticStatus, TaskType, TPUJob
+from tpu_on_k8s.autoscale.policy import (
+    ACTION_DOWN,
+    ACTION_HOLD,
+    ACTION_UP,
+    Decision,
+)
 from tpu_on_k8s.autoscale.signals import KV_RE, METRICS_TAG
 from tpu_on_k8s.client.cluster import InMemoryCluster, NotFoundError
 from tpu_on_k8s.controller.config import JobControllerConfig
 from tpu_on_k8s.controller.elastic import ElasticController, apply_host_count
+from tpu_on_k8s.controller.loopkernel import (
+    LoopKernel,
+    OpenHorizon,
+    format_decision_line,
+)
 from tpu_on_k8s.gang import topology
 from tpu_on_k8s.metrics.metrics import JobMetrics
+from tpu_on_k8s.obs.ledger import (
+    COMMIT_LANDED,
+    COMMIT_NONE,
+    HORIZON_REPLICAS_READY,
+)
 from tpu_on_k8s.utils import conditions
 from tpu_on_k8s.utils.logging import get_logger
 
@@ -111,16 +128,213 @@ def is_satisfy_elastic_continue(last_replicas: int, last_latency: float,
     return last_latency / last_replicas > cur_latency / cur_replicas
 
 
-@dataclass
-class _JobState:
-    observations: Dict[int, List[MetricObservation]] = field(default_factory=dict)
-    frozen: bool = False  # ReachMaxMetric / ReachMaxReplicas: stop deciding
-    # Only metric lines strictly newer than this (epoch, batch) watermark count
-    # toward the current replica bucket — worker-0's log tail still holds
-    # pre-scale lines right after a rescale, and deciding on those would race
-    # the scaler to max_replicas on zero post-scale evidence.
-    watermark: Optional[tuple] = None
-    pending_ticks: int = 0  # consecutive ticks with Pending workers at grown size
+@dataclass(frozen=True)
+class _ElasticPack:
+    """One elastic tick's evidence, frozen at observe time (decide
+    mutates the job's ElasticStatus, so the ledger's signal snapshot
+    must be captured before it does)."""
+
+    job: TPUJob
+    status: ElasticStatus
+    cur: int
+    last_replicas: int
+    last_latency: float
+    #: pending-pods revert: the grown size is not materializing and the
+    #: grace ran out — revert to this count (None = normal metric tick)
+    revert_to: Optional[int] = None
+    #: mean latency of the decision window (None on a revert tick)
+    cur_latency: Optional[float] = None
+
+
+class _JobState(LoopKernel):
+    """One elastic job's decision loop on the shared observe→decide→
+    commit kernel (`controller/loopkernel.py`): observe tails worker-0's
+    log into watermarked per-replica buckets, decide runs the
+    latency-per-replica continue test, commit executes the rescale (or
+    freeze) through the cluster client — and every decision lands one
+    ledger record, uniformly with the serving loops."""
+
+    #: the owning controller, TYPED (set before run_tick) — the
+    #: concurrency analyzer's call graph follows hook→controller edges
+    #: through this attribute (see _AutoscaleLoop.owner)
+    owner: Optional["ElasticAutoscaler"] = None
+
+    def bind_owner(self, owner: "ElasticAutoscaler") -> None:
+        self.owner = owner
+
+    def __init__(self, observations: Optional[Dict[int, List[
+            MetricObservation]]] = None, frozen: bool = False,
+            watermark: Optional[tuple] = None,
+            pending_ticks: int = 0) -> None:
+        super().__init__()
+        self.observations: Dict[int, List[MetricObservation]] = (
+            observations if observations is not None else {})
+        #: ReachMaxMetric / ReachMaxReplicas: stop deciding
+        self.frozen = frozen
+        # Only metric lines strictly newer than this (epoch, batch)
+        # watermark count toward the current replica bucket — worker-0's
+        # log tail still holds pre-scale lines right after a rescale,
+        # and deciding on those would race the scaler to max_replicas on
+        # zero post-scale evidence.
+        self.watermark = watermark
+        #: consecutive ticks with Pending workers at grown size
+        self.pending_ticks = pending_ticks
+
+    # ------------------------------------------------------------ kernel hooks
+    def observe(self, ctx) -> Optional[_ElasticPack]:
+        """Everything short of a decision: hold while a scale transaction
+        is in flight, while stale-generation pods linger, while the
+        world assembles, while frozen, and until the decision window is
+        full. None = no decision exists this tick."""
+        a = self.owner
+        job = ctx["job"]
+        worker = job.spec.tasks.get(TaskType.WORKER)
+        ep = job.spec.elastic_policy
+        if worker is None or ep is None:
+            return None
+        status = a._elastic_status(job)
+        cur = worker.num_tasks
+
+        # Hold while a scale transaction is executing (stale pods / inflight).
+        if job.metadata.annotations.get(
+                constants.ANNOTATION_SCALE_STATE) == \
+                constants.SCALE_STATE_INFLIGHT:
+            return None
+        pods = a.cluster.list(Pod, job.metadata.namespace,
+                              {constants.LABEL_JOB_NAME: job.metadata.name})
+        workers = [p for p in pods if p.metadata.labels.get(
+            constants.LABEL_TASK_TYPE) == TaskType.WORKER.value.lower()]
+        if any(int(p.metadata.labels.get(constants.LABEL_JOB_GENERATION,
+                                         "0") or 0)
+               < job.metadata.generation for p in pods):
+            return None
+
+        pending = [p for p in workers if p.status.phase == PodPhase.PENDING]
+        if pending and cur > ep.min_replicas and status.last_replicas > 0:
+            # Grown size not materializing. Grace-period the revert
+            # (reference polls up to 1min, elastic_scale.go:440-474): a
+            # tick landing in a normal seconds-long scheduling window
+            # must not kill autoscaling.
+            self.pending_ticks += 1
+            if self.pending_ticks >= a.config.elastic_pending_grace_ticks:
+                self.seq += 1
+                return _ElasticPack(job, status, cur,
+                                    status.last_replicas,
+                                    status.last_latency,
+                                    revert_to=status.last_replicas)
+            return None
+        self.pending_ticks = 0
+        if len(workers) < cur or pending:
+            return None  # world still assembling
+        if self.frozen:
+            return None  # no decisions → no log tailing either
+
+        obs = a._collect_observations(job, self, cur)
+        if len(obs) < a.config.elastic_metric_count:
+            return None
+        window = obs[-a.config.elastic_metric_count:]
+        cur_latency = sum(o.latency for o in window) / len(window)
+        status.current_latency = cur_latency
+        self.seq += 1
+        return _ElasticPack(job, status, cur, status.last_replicas,
+                            status.last_latency, cur_latency=cur_latency)
+
+    def decide(self, pack: _ElasticPack, ctx) -> Decision:
+        """The throughput continue-test (reference order,
+        elastic_scale.go:186-233: continue-test FIRST — a regression at
+        max replicas must still revert to the last-good size). The
+        decision KIND rides ``ctx`` to commit; the Decision itself is
+        the shared loop vocabulary the log and ledger serialize."""
+        a = self.owner
+        job = ctx["job"]
+        status, cur = pack.status, pack.cur
+        ep = job.spec.elastic_policy
+        if pack.revert_to is not None:
+            ctx["elastic_kind"] = "revert"
+            return Decision(self.seq,
+                            ACTION_DOWN if pack.revert_to < cur
+                            else ACTION_HOLD, cur, pack.revert_to,
+                            "pending pods at grown size; reverting")
+        if is_satisfy_elastic_continue(status.last_replicas,
+                                       status.last_latency,
+                                       cur, pack.cur_latency):
+            nxt = None if cur >= ep.max_replicas else \
+                a._next_host_count(job, cur, ep.max_replicas)
+            if nxt is None:
+                ctx["elastic_kind"] = "freeze_max_replicas"
+                return Decision(self.seq, ACTION_HOLD, cur, cur,
+                                "ReachMaxReplicas")
+            status.last_replicas = cur
+            status.last_latency = pack.cur_latency
+            status.continue_scaling = True
+            status.message = f"scaling {cur} -> {nxt} hosts"
+            ctx["elastic_kind"] = "grow"
+            return Decision(self.seq, ACTION_UP, cur, nxt,
+                            f"scaling {cur} -> {nxt} hosts")
+        # Throughput stopped scaling: best config is the previous one.
+        ctx["elastic_kind"] = "freeze_max_metric"
+        target = status.last_replicas or cur
+        return Decision(self.seq,
+                        ACTION_DOWN if target < cur else ACTION_HOLD,
+                        cur, target, "ReachMaxMetric")
+
+    def record(self, pack: _ElasticPack, decision, ctx) -> None:
+        self.owner.decision_log.append(format_decision_line(
+            decision.seq, decision.action, decision.current,
+            decision.target, decision.reason,
+            scope=(("job", ctx["key"]),)))
+
+    def actionable(self, decision, ctx) -> bool:
+        # every elastic decision executes SOMETHING (a rescale, a
+        # freeze-with-status-write) — the kind dispatch lives in commit
+        return True
+
+    def commit(self, pack: _ElasticPack, decision, ctx) -> str:
+        a = self.owner
+        job = ctx["job"]
+        status = pack.status
+        kind = ctx["elastic_kind"]
+        if kind == "freeze_max_replicas":
+            self.frozen = True
+            status.continue_scaling = False
+            status.message = "ReachMaxReplicas"
+            a._write_status(job)
+            return COMMIT_NONE       # nothing scaled: a frozen hold
+        if kind == "revert":
+            a._rescale(job, status, self, decision.target,
+                       message="pending pods at grown size; reverting",
+                       freeze=True)
+            return COMMIT_LANDED
+        if kind == "freeze_max_metric":
+            status.message = "ReachMaxMetric"
+            a._rescale(job, status, self, decision.target, freeze=True)
+            return COMMIT_LANDED
+        a._rescale(job, status, self, decision.target)
+        return COMMIT_LANDED
+
+    # -------------------------------------------------------- provenance hooks
+    def opens_horizon(self, decision, outcome: str, ctx) -> bool:
+        """A rescale that also FREEZES the loop (pending-revert,
+        ReachMaxMetric) leaves no future tick to observe its effect —
+        opening a horizon there would pin the open_effect_horizons
+        gauge forever and read as a standing 'effects never land'
+        alert on every normally-converged job."""
+        return ctx.get("elastic_kind") == "grow"
+
+    def signals_of(self, pack: _ElasticPack):
+        fmt = (lambda v: "none" if v is None else f"{v:.6f}")
+        return (("latency", fmt(pack.cur_latency)),
+                ("last_latency", fmt(pack.last_latency)),
+                ("last_replicas", str(pack.last_replicas)))
+
+    def horizon_events(self, h: OpenHorizon, pack: _ElasticPack, ctx):
+        # a metric tick only exists once the world assembled at the new
+        # size AND post-scale evidence filled the window — exactly the
+        # "replicas went ready" observation (a revert tick proves the
+        # opposite and must not close anything)
+        if pack.revert_to is None and pack.cur == h.target:
+            return ((HORIZON_REPLICAS_READY, True),)
+        return ()
 
 
 class ElasticAutoscaler:
@@ -130,10 +344,20 @@ class ElasticAutoscaler:
 
     def __init__(self, cluster: InMemoryCluster,
                  config: Optional[JobControllerConfig] = None,
-                 metrics: Optional[JobMetrics] = None) -> None:
+                 metrics: Optional[JobMetrics] = None,
+                 ledger=None) -> None:
         self.cluster = cluster
         self.config = config or JobControllerConfig()
         self.metrics = metrics
+        # the decision ledger (`obs/ledger.DecisionLedger`): every
+        # elastic decision lands one provenance record through the loop
+        # kernel, uniformly with the serving loops. None → NOOP.
+        self.ledger = ledger
+        #: stable one-line-per-decision record in the shared serializer
+        #: format (``job=<ns/name> seq=N action=... replicas=c->t
+        #: reason=...``) — the elastic twin of the FleetAutoscaler's
+        #: byte-comparable log. Bounded like its sibling.
+        self.decision_log: Deque[str] = deque(maxlen=10_000)
         self._lock = threading.Lock()
         self._jobs: Dict[str, _JobState] = {}  # "ns/name" → state
         self._stop = threading.Event()
@@ -152,7 +376,11 @@ class ElasticAutoscaler:
     def deregister(self, job: TPUJob) -> None:
         key = f"{job.metadata.namespace}/{job.metadata.name}"
         with self._lock:
-            self._jobs.pop(key, None)
+            state = self._jobs.pop(key, None)
+        if state is not None:
+            # a deleted-mid-scale job must not leave an unclosable
+            # horizon pinning the shared ledger's gauge
+            state.abandon()
 
     def observe_event(self, event) -> None:
         """Watch glue: register on ADDED, deregister on DELETED."""
@@ -177,79 +405,23 @@ class ElasticAutoscaler:
             if job is None or conditions.is_finished(job.status):
                 with self._lock:
                     self._jobs.pop(key, None)
+                state.abandon()
                 continue
+            # the kernel template drives observe→decide→commit and
+            # lands one ledger record per decision (hooks on _JobState
+            # above). NB `state` stays deliberately untyped here: the
+            # concurrency analyzer's virtual-dispatch closure merges the
+            # type worlds of every kernel subclass reachable from a
+            # root, and typing this call would fuse the elastic and
+            # fleet tick drivers into one multi-root blur (the hooks
+            # reach the controller through the TYPED `owner` attribute,
+            # so the cluster-mutation paths stay in the analyzed graph)
+            state.bind(f"elasticautoscaler/{key}", self.ledger)
+            state.bind_owner(self)
             try:
-                self._decide(job, state)
+                state.run_tick({"job": job, "key": key})
             except NotFoundError:
                 continue
-
-    def _decide(self, job: TPUJob, state: _JobState) -> None:
-        worker = job.spec.tasks.get(TaskType.WORKER)
-        ep = job.spec.elastic_policy
-        if worker is None or ep is None:
-            return
-        status = self._elastic_status(job)
-        cur = worker.num_tasks
-
-        # Hold while a scale transaction is executing (stale pods / inflight).
-        if job.metadata.annotations.get(
-                constants.ANNOTATION_SCALE_STATE) == constants.SCALE_STATE_INFLIGHT:
-            return
-        pods = self.cluster.list(Pod, job.metadata.namespace,
-                                 {constants.LABEL_JOB_NAME: job.metadata.name})
-        workers = [p for p in pods if p.metadata.labels.get(
-            constants.LABEL_TASK_TYPE) == TaskType.WORKER.value.lower()]
-        if any(int(p.metadata.labels.get(constants.LABEL_JOB_GENERATION, "0") or 0)
-               < job.metadata.generation for p in pods):
-            return
-
-        pending = [p for p in workers if p.status.phase == PodPhase.PENDING]
-        if pending and cur > ep.min_replicas and status.last_replicas > 0:
-            # Grown size not materializing. Grace-period the revert (reference
-            # polls up to 1min, elastic_scale.go:440-474): a tick landing in a
-            # normal seconds-long scheduling window must not kill autoscaling.
-            state.pending_ticks += 1
-            if state.pending_ticks >= self.config.elastic_pending_grace_ticks:
-                self._rescale(job, status, state, status.last_replicas,
-                              message="pending pods at grown size; reverting",
-                              freeze=True)
-            return
-        state.pending_ticks = 0
-        if len(workers) < cur or pending:
-            return  # world still assembling
-
-        if state.frozen:
-            return  # no decisions → no log tailing either
-        obs = self._collect_observations(job, state, cur)
-        if len(obs) < self.config.elastic_metric_count:
-            return
-
-        window = obs[-self.config.elastic_metric_count:]
-        cur_latency = sum(o.latency for o in window) / len(window)
-        status.current_latency = cur_latency
-
-        # Continue-test FIRST (reference order, elastic_scale.go:186-233): a
-        # regression at max replicas must still revert to the last-good size.
-        if is_satisfy_elastic_continue(status.last_replicas, status.last_latency,
-                                       cur, cur_latency):
-            nxt = None if cur >= ep.max_replicas else \
-                self._next_host_count(job, cur, ep.max_replicas)
-            if nxt is None:
-                state.frozen = True
-                status.continue_scaling = False
-                status.message = "ReachMaxReplicas"
-                self._write_status(job)
-                return
-            status.last_replicas = cur
-            status.last_latency = cur_latency
-            status.continue_scaling = True
-            status.message = f"scaling {cur} -> {nxt} hosts"
-            self._rescale(job, status, state, nxt)
-        else:
-            # Throughput stopped scaling: best config is the previous one.
-            status.message = "ReachMaxMetric"
-            self._rescale(job, status, state, status.last_replicas or cur,
-                          freeze=True)
 
     def _next_host_count(self, job: TPUJob, cur: int, cap: int) -> Optional[int]:
         """One growth step: multi-slice jobs add a slice (DCN); single-slice
@@ -407,9 +579,11 @@ class ElasticAutoscaler:
 
 def setup_elastic_autoscaler(cluster: InMemoryCluster,
                              config: Optional[JobControllerConfig] = None,
-                             metrics: Optional[JobMetrics] = None) -> ElasticAutoscaler:
+                             metrics: Optional[JobMetrics] = None,
+                             ledger=None) -> ElasticAutoscaler:
     """Wire the autoscaler's job registry to the cluster watch (reference
     SetupWithManager, torchelastic/elastictorchjob_controller.go:128-148)."""
-    scaler = ElasticAutoscaler(cluster, config=config, metrics=metrics)
+    scaler = ElasticAutoscaler(cluster, config=config, metrics=metrics,
+                               ledger=ledger)
     cluster.watch(scaler.observe_event)
     return scaler

@@ -33,9 +33,18 @@ tick instead of sulking through a cooldown it never used.
 ``run_once()`` is the deterministic unit tests and soak drive; ``run()``
 wraps it in a thread at ``serving_autoscale_period_seconds`` cadence,
 wired in `main.py` beside the elastic autoscaler.
+
+Both the service loop and the per-pool loops ride the shared
+observe→decide→commit kernel (`controller/loopkernel.LoopKernel`): the
+kernel's ``run_tick`` template drives the hooks on ``_ServiceState`` /
+``_PoolState`` and lands one decision-ledger record per decision
+(`obs/ledger.py` — signals + trace exemplars, SLO-page/chaos triggers,
+commit outcome, effect horizon), while the decision_log bytes stay
+identical to the pre-kernel format (the soak byte-compares prove it).
 """
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from collections import deque
@@ -48,8 +57,13 @@ from tpu_on_k8s.api.inference_types import (
     InferenceService,
     SLOObjectiveStatus,
 )
-from tpu_on_k8s.autoscale.policy import ACTION_HOLD, ACTION_UP, Recommender
+from tpu_on_k8s.autoscale.policy import (
+    ACTION_DOWN,
+    ACTION_UP,
+    Recommender,
+)
 from tpu_on_k8s.autoscale.signals import (
+    FleetObservation,
     FleetSample,
     FleetScraper,
     SignalAggregator,
@@ -59,43 +73,149 @@ from tpu_on_k8s.autoscale.signals import (
 )
 from tpu_on_k8s.client.cluster import InMemoryCluster, NotFoundError
 from tpu_on_k8s.controller.config import JobControllerConfig
+from tpu_on_k8s.controller.loopkernel import (
+    LoopKernel,
+    OpenHorizon,
+    format_commit_failure_line,
+)
 from tpu_on_k8s.metrics.metrics import AutoscaleMetrics
-from tpu_on_k8s.obs.slo import SLOEngine, SLOSpec
+from tpu_on_k8s.obs.ledger import (
+    COMMIT_LANDED,
+    HORIZON_BURN_RECOVERED,
+    HORIZON_REPLICAS_READY,
+    HORIZON_ROLLOUT_COMPLETE,
+)
+from tpu_on_k8s.obs.slo import SLOEngine, SLOSpec, page_onsets
 from tpu_on_k8s.obs.trace import ensure as ensure_tracer
 from tpu_on_k8s.utils.logging import get_logger
 
 _log = get_logger("fleetautoscaler")
 
 
-class _PoolState:
-    """One pool's decision loop (disaggregated services run two of
-    these — prefill and decode — instead of one service-level loop).
-    Same anatomy as the service loop: the recommender owns cooldown
-    stamps, the aggregator owns the signal window, the scraper owns
-    delta-read positions (per pool — the pools' replicas are disjoint,
-    but a shared scraper would interleave their sequence numbers)."""
+def _fmt_signal(v: Optional[float]) -> str:
+    return "none" if v is None else f"{v:.6f}"
+
+
+@dataclasses.dataclass(frozen=True)
+class _TickPack:
+    """Everything one loop tick observed — the value the kernel's
+    ``observe`` hook hands to ``decide``/``commit`` and the provenance
+    hooks (`controller/loopkernel.LoopKernel`)."""
+
+    sample: FleetSample
+    obs: FleetObservation
+    cur: int
+    now: float
+    urgent: bool = False
+
+
+class _AutoscaleLoop(LoopKernel):
+    """The shared anatomy of the service and per-pool decision loops,
+    riding the observe→decide→commit kernel: the recommender owns the
+    policy + tempo gate, the aggregator owns the signal window, the
+    scraper owns delta-read positions, and the kernel owns the tick
+    counter, ledger emission, and the effect horizon."""
+
+    #: the owning controller, TYPED (set by its tick before run_tick):
+    #: the concurrency analyzer's call graph follows hook→controller
+    #: edges through this attribute — an untyped ctx-dict hop would
+    #: sever the autoscaler thread root from its cluster-mutation paths
+    owner: Optional["FleetAutoscaler"] = None
 
     def __init__(self) -> None:
+        super().__init__()
         self.recommender: Optional[Recommender] = None
         self.policy_key: Optional[Tuple] = None
         self.aggregator: Optional[SignalAggregator] = None
         self.scraper = FleetScraper()
-        self.seq = 0
+        #: chaos event seq drawn THIS tick's collect (0 = none) — the
+        #: ledger's ``chaos#N`` trigger join key
+        self.tick_chaos_seq = 0
+
+    def bind_owner(self, owner: "FleetAutoscaler") -> None:
+        self.owner = owner
+
+    # ------------------------------------------------------------ kernel hooks
+    def decide(self, pack: _TickPack, ctx):
+        decision = self.recommender.decide(pack.obs, pack.cur, pack.now,
+                                           urgent=pack.urgent)
+        ctx["span"].set(action=decision.action, current=pack.cur,
+                        target=decision.target, stale=pack.obs.stale,
+                        queue_depth=pack.obs.queue_depth)
+        return decision
+
+    def record(self, pack: _TickPack, decision, ctx) -> None:
+        self.owner._record(ctx["key"], ctx["svc"], pack.obs, decision,
+                           pool=ctx.get("pool"))
+
+    def commit(self, pack: _TickPack, decision, ctx) -> str:
+        return self.owner._execute(ctx["key"], ctx["svc"], ctx["state"],
+                                   self.recommender, decision, pack.now,
+                                   pool=ctx.get("pool"))
+
+    # -------------------------------------------------------- provenance hooks
+    def tick_of(self, pack: _TickPack) -> int:
+        return pack.obs.seq
+
+    def signals_of(self, pack: _TickPack) -> Tuple[Tuple[str, str], ...]:
+        o = pack.obs
+        return (("ttft_p95", _fmt_signal(o.ttft_p95)),
+                ("queue_wait_p95", _fmt_signal(o.queue_wait_p95)),
+                ("tpot_p95", _fmt_signal(o.tpot_p95)),
+                ("queue_depth", str(o.queue_depth)),
+                ("inflight", str(o.inflight_tokens)),
+                ("slots", str(o.slots)),
+                ("ready", str(o.ready_replicas)),
+                ("stale", str(int(o.stale))))
+
+    def exemplars_of(self, pack: _TickPack) -> Tuple[int, ...]:
+        return pack.sample.exemplars
+
+    def trigger_of(self, pack: _TickPack, ctx) -> str:
+        if self.tick_chaos_seq:
+            return f"chaos#{self.tick_chaos_seq}"
+        return ""
+
+    def horizon_events(self, h: OpenHorizon, pack: _TickPack, ctx):
+        """The observable effect ends: a committed scale-up's replicas
+        going ready, a committed scale-down's drain completing. A stale
+        window proves nothing either way."""
+        obs = pack.obs
+        out = []
+        if obs.stale:
+            return out
+        if h.action == ACTION_UP and obs.ready_replicas >= h.target:
+            out.append((HORIZON_REPLICAS_READY, True))
+        elif h.action == ACTION_DOWN and obs.ready_replicas <= h.target:
+            out.append((HORIZON_ROLLOUT_COMPLETE, True))
+        return out
 
 
-class _ServiceState:
-    """Per-service loop state: the policy's cooldown stamps live in the
+class _PoolState(_AutoscaleLoop):
+    """One pool's decision loop (disaggregated services run two of
+    these — prefill and decode — instead of one service-level loop).
+    The scraper is per pool: the pools' replicas are disjoint, and a
+    shared scraper would interleave their sequence numbers."""
+
+    def observe(self, ctx) -> Optional[_TickPack]:
+        a, key, state = self.owner, ctx["key"], ctx["state"]
+        sample = a._collect_pool(key, state, ctx["pool"], self)
+        a._feed_slo(state, sample)
+        now = a.clock()
+        obs = self.aggregator.record(sample, now=now)
+        cur = max(int(ctx["pspec"].replicas), 1)
+        return _TickPack(sample=sample, obs=obs, cur=cur, now=now)
+
+
+class _ServiceState(_AutoscaleLoop):
+    """Per-service loop state: the policy's tempo gate lives in the
     recommender; the aggregator owns the signal window; ``fleet`` is the
     optional in-process execution target (single-binary serving)."""
 
     def __init__(self) -> None:
-        self.recommender: Optional[Recommender] = None
-        self.policy_key: Optional[Tuple] = None
-        self.aggregator: Optional[SignalAggregator] = None
-        self.scraper = FleetScraper()
+        super().__init__()
         self.fleet = None
         self.apply_to_fleet = True
-        self.seq = 0                 # one counter across live AND dead scrapes
         #: per-pool loops (``spec.pools.<pool>.autoscale`` present)
         self.pools: Dict[str, _PoolState] = {}
         #: newest observation-line batch consumed, PER POD — every pod's
@@ -112,6 +232,81 @@ class _ServiceState:
         self.slo_bypass_used = False
         #: last rendered status.slo (avoids a status write per tick)
         self.slo_written: Optional[Dict] = None
+        #: whether any non-stale objective currently pages, whether the
+        #: last evaluation had a LIVE (non-stale) objective at all, and
+        #: the 1-based page-episode ordinal (the count of page-onset
+        #: transition lines in the budget log — by construction the
+        #: ledger's ``slo_page:<svc>#N`` trigger resolves to a real
+        #: line, even when paging resumes after a stale gap)
+        self.slo_paging = False
+        self.slo_live = False
+        self.page_episode = 0
+        #: ledger seq of the committed scale-UP that answered the
+        #: current page episode — the decision the episode's
+        #: ``burn_recovered`` event will reference, whether or not its
+        #: effect horizon is still open (the capacity loop typically
+        #: moves on — scales down, re-scales — before the backward-
+        #: looking budget window formally refills; recovery belongs to
+        #: the EPISODE, not to one horizon surviving long enough)
+        self.page_up_seq: Optional[int] = None
+
+    # ------------------------------------------------------------ kernel hooks
+    def observe(self, ctx) -> Optional[_TickPack]:
+        a, svc, key = self.owner, ctx["svc"], ctx["key"]
+        sample = a._collect(key, svc, self)
+        now = a.clock()
+        obs = self.aggregator.record(sample, now=now)
+        cur = max(int(svc.spec.replicas), 0)
+        # SLO evaluation rides the same tick: feed the fresh scrape,
+        # evaluate burn rates, publish status.slo, and derive the
+        # severity hint. ``spec.slo`` absent → all of this is a no-op
+        # and the decision path is byte-identical.
+        urgent = a._tick_slo(key, svc, self, sample, ctx["span"])
+        return _TickPack(sample=sample, obs=obs, cur=cur, now=now,
+                         urgent=urgent)
+
+    def commit(self, pack: _TickPack, decision, ctx) -> str:
+        if pack.urgent and decision.action == ACTION_UP \
+                and decision.reason.startswith("slo_page"):
+            # the bypass is spent only when it actually pierced a
+            # cooldown (the policy marks those ``slo_page``) — a
+            # scale-up that was free anyway must not burn the one
+            # escape hatch; it re-arms after the page episode clears
+            self.slo_bypass_used = True
+        return super().commit(pack, decision, ctx)
+
+    def trigger_of(self, pack: _TickPack, ctx) -> str:
+        decision = ctx.get("decision")
+        if self.slo_paging and (decision is None
+                                or decision.action != ACTION_DOWN):
+            # downs during a lingering page are signal-driven (the
+            # queue drained; the backward-looking budget just hasn't
+            # refilled yet) — attributing them to the page would make
+            # why_report claim the page CAUSED a scale-down
+            return f"slo_page:{ctx['key']}#{self.page_episode}"
+        return super().trigger_of(pack, ctx)
+
+    def on_committed(self, rec, decision, outcome: str, ctx) -> None:
+        if decision.action == ACTION_UP and self.slo_paging:
+            # the decision that answered the page: the episode's
+            # burn_recovered event will reference it (latest wins — the
+            # last urgent escalation is the one that held)
+            self.page_up_seq = rec.seq
+
+    def horizon_events(self, h: OpenHorizon, pack: _TickPack, ctx):
+        """On top of the shared ready/drain ends: an SLO-paged scale-up
+        notes ``replicas_ready`` as PROGRESS — the
+        page→decision→patch→recovery chain `tools/why_report.py`
+        renders ends at the burn recovery, which the SLO tick emits as
+        an episode-level event (see ``_evaluate_slo``) so it lands even
+        when a later commit superseded this horizon first."""
+        out = []
+        slo_paged = h.trigger.startswith("slo_page")
+        for event, closing in super().horizon_events(h, pack, ctx):
+            if slo_paged and event == HORIZON_REPLICAS_READY:
+                closing = False
+            out.append((event, closing))
+        return out
 
 
 class FleetAutoscaler:
@@ -122,10 +317,15 @@ class FleetAutoscaler:
                  config: Optional[JobControllerConfig] = None,
                  metrics: Optional[AutoscaleMetrics] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 tracer=None, slo_metrics=None) -> None:
+                 tracer=None, slo_metrics=None, ledger=None) -> None:
         self.cluster = cluster
         self.config = config or JobControllerConfig()
         self.metrics = metrics
+        # the decision ledger (`obs/ledger.DecisionLedger`): every
+        # service/pool loop tick lands one provenance record through the
+        # loop kernel. None → NOOP (bit-for-bit the ledger-free
+        # behavior — decision logs and soak byte-compares see nothing).
+        self.ledger = ledger
         # the SLO telemetry plane (`metrics.SLOMetrics`): burn-rate /
         # budget gauges + transition counters for every service whose
         # spec carries an ``slo`` block. None → mirror-free evaluation
@@ -172,7 +372,18 @@ class FleetAutoscaler:
     def deregister(self, svc: InferenceService) -> None:
         key = f"{svc.metadata.namespace}/{svc.metadata.name}"
         with self._lock:
-            self._services.pop(key, None)
+            state = self._services.pop(key, None)
+        if state is not None:
+            self._abandon_loops(state)
+
+    @staticmethod
+    def _abandon_loops(state: "_ServiceState") -> None:
+        """A retired service's loops close their open effect horizons
+        (service AND pools) — a deleted-mid-scale service must not pin
+        the shared ledger's open_effect_horizons gauge forever."""
+        state.abandon()
+        for ps in state.pools.values():
+            ps.abandon()
 
     def observe_event(self, event) -> None:
         """Watch glue: register on ADDED/MODIFIED (the autoscale block
@@ -225,6 +436,7 @@ class FleetAutoscaler:
                     self._clear_slo_status(svc)
                 with self._lock:
                     self._services.pop(key, None)
+                self._abandon_loops(state)
                 continue
             try:
                 if svc.spec.pools is not None:
@@ -247,33 +459,14 @@ class FleetAutoscaler:
         self._ensure_policy(svc, state)
         if self.metrics is not None:
             self.metrics.inc("ticks")
-
+        # the kernel template (`controller/loopkernel.py`) drives the
+        # observe→decide→commit anatomy and lands one ledger record per
+        # decision; the hooks live on _ServiceState above
+        state.bind(f"fleetautoscaler/{key}", self.ledger)
+        state.bind_owner(self)
         with self._tracer.span("autoscale.tick", svc=key) as sp:
-            sample = self._collect(key, svc, state)
-            now = self.clock()
-            obs = state.aggregator.record(sample, now=now)
-            cur = max(int(svc.spec.replicas), 0)
-            # SLO evaluation rides the same tick: feed the fresh scrape,
-            # evaluate burn rates, publish status.slo, and derive the
-            # severity hint. ``spec.slo`` absent → all of this is a
-            # no-op and the decision path below is byte-identical.
-            urgent = self._tick_slo(key, svc, state, sample, sp)
-            decision = state.recommender.decide(obs, cur, now,
-                                                urgent=urgent)
-            sp.set(action=decision.action, current=cur,
-                   target=decision.target, stale=obs.stale,
-                   queue_depth=obs.queue_depth)
-            self._record(key, svc, obs, decision)
-            if decision.action == ACTION_HOLD or decision.target == cur:
-                return
-            if urgent and decision.action == ACTION_UP \
-                    and decision.reason.startswith("slo_page"):
-                # the bypass is spent only when it actually pierced a
-                # cooldown (the policy marks those ``slo_page``) — a
-                # scale-up that was free anyway must not burn the one
-                # escape hatch; it re-arms after the page episode clears
-                state.slo_bypass_used = True
-            self._execute(key, svc, state, state.recommender, decision, now)
+            state.run_tick({"svc": svc, "key": key,
+                            "state": state, "span": sp})
 
     # ------------------------------------------------------------- SLO plane
     @staticmethod
@@ -323,6 +516,9 @@ class FleetAutoscaler:
                 state.slo_key = None
                 state.slo_bypass_used = False
                 state.slo_written = None
+                state.slo_paging = False
+                state.slo_live = False
+                state.page_up_seq = None
             return False
         norm = pol.normalized()
         skey = tuple(tuple(sorted(vars(o).items()))
@@ -334,6 +530,9 @@ class FleetAutoscaler:
                 metrics=self.slo_metrics, service=key)
             state.slo_bypass_used = False
             state.slo_written = None
+            state.slo_paging = False
+            state.slo_live = False
+            state.page_up_seq = None
         if not state.slo_engine.evaluators:
             # every objective was junk: nothing will ever evaluate, so
             # any previously-published budget state is dead — clear it
@@ -392,6 +591,33 @@ class FleetAutoscaler:
             except NotFoundError:
                 pass
         paging = state.slo_engine.paging(statuses)
+        state.slo_live = any(not st.stale for st in statuses.values())
+        if paging and not state.slo_paging:
+            # paging onset: the episode ordinal is the COUNT of page
+            # onsets in the budget log itself, so the ledger's
+            # ``slo_page:<svc>#N`` trigger resolves to a real transition
+            # line by construction (a resume after a stale gap — no new
+            # transition — keeps the original episode's ordinal)
+            state.page_episode = len(
+                page_onsets(state.slo_engine.event_log)) or 1
+        if not paging and state.slo_live \
+                and state.page_up_seq is not None:
+            # LIVE burn recovery: a non-stale evaluation shows the burn
+            # cleared while a page episode is still unanswered (the
+            # ``page_up_seq`` marker persists through stale flaps — a
+            # signal that merely went dark proves nothing and emits
+            # nothing). The event references the scale-up that answered
+            # the page — closing its horizon if still open, annotating
+            # it otherwise.
+            closing = (state.open_horizon is not None
+                       and state.open_horizon.seq == state.page_up_seq)
+            state.ledger.horizon(state.page_up_seq, loop=state.loop_id,
+                                 event=HORIZON_BURN_RECOVERED,
+                                 closing=closing)
+            if closing:
+                state.open_horizon = None
+            state.page_up_seq = None
+        state.slo_paging = paging
         if not paging:
             state.slo_bypass_used = False   # episode over: re-arm
             return False
@@ -477,22 +703,12 @@ class FleetAutoscaler:
                 window=self.config.autoscale_window_scrapes,
                 stale_after=self.config.autoscale_stale_scrapes,
                 max_age_s=self._signal_max_age())
-
+        ps.bind(f"fleetautoscaler/{key}/{pool}", self.ledger)
+        ps.bind_owner(self)
         with self._tracer.span("autoscale.tick", svc=key, pool=pool) as sp:
-            sample = self._collect_pool(key, state, pool, ps)
-            self._feed_slo(state, sample)
-            now = self.clock()
-            obs = ps.aggregator.record(sample, now=now)
-            cur = max(int(pspec.replicas), 1)
-            decision = ps.recommender.decide(obs, cur, now)
-            sp.set(action=decision.action, current=cur,
-                   target=decision.target, stale=obs.stale,
-                   queue_depth=obs.queue_depth)
-            self._record(key, svc, obs, decision, pool=pool)
-            if decision.action == ACTION_HOLD or decision.target == cur:
-                return
-            self._execute(key, svc, state, ps.recommender, decision, now,
-                          pool=pool)
+            ps.run_tick({"svc": svc, "key": key,
+                         "state": state, "pool": pool, "pspec": pspec,
+                         "span": sp})
 
     def _collect_pool(self, key: str, state: _ServiceState, pool: str,
                       ps: _PoolState) -> FleetSample:
@@ -501,8 +717,14 @@ class FleetAutoscaler:
         log scraping needs pool-labelled pods the reconciler does not
         mint yet."""
         ps.seq += 1
-        fault = chaos.fire(chaos.SITE_AUTOSCALE_SIGNAL, service=key,
-                           pool=pool)
+        ps.tick_chaos_seq = 0
+        fault, fault_seq = chaos.fire_seq(chaos.SITE_AUTOSCALE_SIGNAL,
+                                          service=key, pool=pool)
+        if isinstance(fault, chaos.SignalOutage):
+            # the ledger's fault join key: THIS injection's event seq
+            # (allocated atomically — a concurrent thread's fault can
+            # never be cited by mistake)
+            ps.tick_chaos_seq = fault_seq
         fleet, _ = self._fleet_binding(state)
         if not isinstance(fault, chaos.SignalOutage) \
                 and fleet is not None and hasattr(fleet, "pool"):
@@ -519,16 +741,19 @@ class FleetAutoscaler:
     def _execute(self, key: str, svc: InferenceService,
                  state: _ServiceState, recommender: Recommender,
                  decision, now: float, *, pool: Optional[str] = None
-                 ) -> None:
+                 ) -> str:
         """The committed half of a decision loop, shared by the service
         and per-pool paths: patch the spec — the commit point, so chaos
         (and real conflicts) before it mean the scale never happened and
         no cooldown is burned; next tick retries at full speed — then
         commit cooldown stamps, publish status + event, and apply to an
-        attached in-process fleet."""
+        attached in-process fleet. Returns the `obs/ledger` commit
+        outcome: ``landed``, ``conflict:<Type>`` (the patch never
+        happened), or ``fallback:<Type>`` (the patch landed but the
+        in-process fleet apply deferred to the reconciler)."""
         label = key if pool is None else f"{key}/{pool}"
-        prefix = f"svc={key} " if pool is None \
-            else f"svc={key} pool={pool} "
+        scope = ((("svc", key),) if pool is None
+                 else (("svc", key), ("pool", pool)))
         fault = chaos.fire(chaos.SITE_AUTOSCALE_PATCH, service=label,
                            target=decision.target)
         try:
@@ -547,13 +772,12 @@ class FleetAutoscaler:
                 InferenceService, svc.metadata.namespace,
                 svc.metadata.name, mutate)
         except Exception as e:  # noqa: BLE001 — typed below, loop survives
-            self.decision_log.append(
-                f"{prefix}seq={decision.seq} patch_failed "
-                f"{type(e).__name__}")
+            self.decision_log.append(format_commit_failure_line(
+                decision.seq, type(e).__name__, scope=scope))
             if self.metrics is not None:
                 self.metrics.inc("patch_failures")
             _log.warning("replicas patch for %s failed: %s", label, e)
-            return
+            return f"conflict:{type(e).__name__}"
         recommender.commit(decision, now)
         if self.metrics is not None:
             # the gauge tracks COMMITTED targets only — set after the
@@ -598,6 +822,8 @@ class FleetAutoscaler:
                 # patch stands and the reconciler/fleet converge later
                 _log.warning("fleet apply for %s (-> %d) deferred: %s",
                              label, decision.target, e)
+                return f"fallback:{type(e).__name__}"
+        return COMMIT_LANDED
 
     # --------------------------------------------------------------- signals
     def _signal_max_age(self) -> Optional[float]:
@@ -635,8 +861,13 @@ class FleetAutoscaler:
     def _collect(self, key: str, svc: InferenceService,
                  state: _ServiceState) -> FleetSample:
         state.seq += 1   # one monotone counter: dead scrapes count too
-        fault = chaos.fire(chaos.SITE_AUTOSCALE_SIGNAL, service=key)
+        state.tick_chaos_seq = 0
+        fault, fault_seq = chaos.fire_seq(chaos.SITE_AUTOSCALE_SIGNAL,
+                                          service=key)
         if isinstance(fault, chaos.SignalOutage):
+            # THIS injection's event seq (atomic): the decision made
+            # under this outage carries a ``chaos#N`` ledger trigger
+            state.tick_chaos_seq = fault_seq
             if self.metrics is not None:
                 self.metrics.inc("stale_scrapes")
             return dead_sample(state.seq)
@@ -751,6 +982,21 @@ class FleetAutoscaler:
             m.set_gauge("observed_tokens_per_slot", obs.tokens_per_slot,
                         label=label)
 
+    def slo_event_lines(self) -> Dict[str, List[str]]:
+        """Per-service SLO budget event logs (the transition lines
+        `obs/slo.SLOEngine` appends): what ``--ledger-out`` embeds
+        beside the decision records so `tools/why_report.py` can
+        resolve ``slo_page:<svc>#N`` triggers to their actual
+        ``state=...->page`` transition lines."""
+        with self._lock:
+            items = sorted(self._services.items())
+        out: Dict[str, List[str]] = {}
+        for key, state in items:
+            engine = state.slo_engine
+            if engine is not None and engine.event_log:
+                out[key] = list(engine.event_log)
+        return out
+
     # ----------------------------------------------------------------- run loop
     def run(self) -> None:
         if self._thread is not None:
@@ -789,11 +1035,12 @@ def setup_fleet_autoscaler(cluster: InMemoryCluster,
                            metrics: Optional[AutoscaleMetrics] = None,
                            clock: Callable[[], float] = time.monotonic,
                            tracer=None,
-                           slo_metrics=None) -> FleetAutoscaler:
+                           slo_metrics=None,
+                           ledger=None) -> FleetAutoscaler:
     """Wire the autoscaler's service registry to the cluster watch (the
     serving twin of ``setup_elastic_autoscaler``)."""
     scaler = FleetAutoscaler(cluster, config=config, metrics=metrics,
                              clock=clock, tracer=tracer,
-                             slo_metrics=slo_metrics)
+                             slo_metrics=slo_metrics, ledger=ledger)
     cluster.watch(scaler.observe_event)
     return scaler

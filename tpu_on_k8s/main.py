@@ -41,9 +41,11 @@ from tpu_on_k8s.gang.scheduler import GANG_SCHEDULER_NAME, default_registry
 from tpu_on_k8s.metrics.metrics import (
     AutoscaleMetrics,
     JobMetrics,
+    LedgerMetrics,
     SLOMetrics,
     serve,
 )
+from tpu_on_k8s.obs.ledger import DecisionLedger
 
 
 def parse_port_range(spec: str) -> Tuple[int, int]:
@@ -285,8 +287,16 @@ class Operator:
             self.cluster, self.manager, config=self.config, gates=self.gates,
             gang_scheduler=gang, restarter=restarter, metrics=self.metrics,
             coordinator=self.coordinator, elastic_controller=self.elastic)
+        # decision provenance (obs/ledger.py): ONE ledger shared by the
+        # elastic and fleet autoscalers, so the operator's control-plane
+        # decisions form one causal record stream; its telemetry rides
+        # the operator registry (decisions{loop|outcome}, commit
+        # failures, the open-effect-horizons gauge)
+        self.ledger_metrics = LedgerMetrics(registry=self.metrics.registry)
+        self.ledger = DecisionLedger(metrics=self.ledger_metrics)
         self.autoscaler = setup_elastic_autoscaler(
-            self.cluster, config=self.config, metrics=self.metrics)
+            self.cluster, config=self.config, metrics=self.metrics,
+            ledger=self.ledger)
         self.modelversion = setup_modelversion_controller(
             self.cluster, self.manager, config=self.config)
         self.inferenceservice = setup_inferenceservice_controller(
@@ -304,7 +314,8 @@ class Operator:
         self.fleetautoscaler = setup_fleet_autoscaler(
             self.cluster, config=self.config,
             metrics=self.autoscale_metrics,
-            slo_metrics=self.slo_metrics)
+            slo_metrics=self.slo_metrics,
+            ledger=self.ledger)
         self.scheduler_loop = None
         if getattr(args, "enable_slice_scheduler", False):
             from tpu_on_k8s.gang.scheduler import (

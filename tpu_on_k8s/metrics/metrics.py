@@ -647,6 +647,58 @@ class SLOMetrics(_MetricsBase):
             g.labels(label).set(value)
 
 
+class LedgerMetrics(_MetricsBase):
+    """Decision-ledger telemetry (`tpu_on_k8s/obs/ledger.py`, fed by
+    every control loop riding `controller/loopkernel.LoopKernel`): the
+    per-loop decision counter labelled ``<loop>|<outcome>`` (outcome
+    class: ``landed`` / ``conflict`` / ``fallback`` / ``hold`` /
+    ``skip`` — one combined label because the mirror/fallback
+    exposition schema carries at most one label per family, and the
+    loop×outcome product is what an operator actually filters on),
+    commit failures (patches that never landed — the loop retries at
+    full speed, but a climbing rate means writers are fighting), and
+    the ``open_effect_horizons`` gauge — committed decisions whose
+    effect (replicas ready, rollout complete, burn recovered) has not
+    yet been observed; a climbing gauge means the loops are committing
+    changes whose effects never land. Same prometheus + plain-dict
+    mirror pattern as the other classes; mirror dicts key by
+    ``(name, label)`` like ``AutoscaleMetrics``."""
+
+    _LOOP_COUNTERS = ("decisions",)
+    _PLAIN_COUNTERS = ("commit_failures",)
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        self.counters: Dict[Tuple[str, str], int] = defaultdict(int)
+        self.gauges: Dict[Tuple[str, str], float] = {}
+        if _prom is not None:
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_ledger"
+        for name in self._LOOP_COUNTERS:
+            self._declare(name, f"{ns}_{name}", "counter",
+                          f"Ledger {name}", labels=("loop_outcome",))
+        for name in self._PLAIN_COUNTERS:
+            self._declare(name, f"{ns}_{name}", "counter",
+                          f"Ledger {name}")
+        self._declare("open_effect_horizons", f"{ns}_open_effect_horizons",
+                      "gauge", "Committed decisions whose effect horizon "
+                      "is still open")
+
+    def inc(self, name: str, n: int = 1, label: str = "") -> None:
+        with self._lock:
+            self.counters[(name, label)] += n
+        c = self._prom_counters.get(name)
+        if c is not None:
+            (c.labels(label) if name in self._LOOP_COUNTERS else c).inc(n)
+
+    def set_gauge(self, name: str, value: float, label: str = "") -> None:
+        with self._lock:
+            self.gauges[(name, label)] = value
+        g = self._prom_gauges.get(name)
+        if g is not None:
+            g.set(value)
+
+
 def count_detached_callback(metrics, message: str) -> None:
     """The count-and-warn tail shared by every streaming-callback
     isolation site (engine ``on_token``/``on_retire``, gateway and

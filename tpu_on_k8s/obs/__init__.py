@@ -8,7 +8,12 @@
   over sliding windows into multi-window error-budget burn rates and
   typed ``ok/warn/page/exhausted`` budget states;
 * `account` — goodput + cost accounting: per-tenant good/degraded tokens
-  and chip-seconds (serving), productive-vs-waste step time (training).
+  and chip-seconds (serving), productive-vs-waste step time (training);
+* `ledger` — the decision ledger: one typed, byte-replayable provenance
+  record per control-loop decision (observed signals + trace exemplars,
+  SLO/chaos trigger, commit outcome, effect horizon), emitted uniformly
+  by every loop riding `controller/loopkernel.LoopKernel` and joined
+  into causal chains by `tools/why_report.py`.
 
 Span producers: `serve/gateway.py`, `serve/fleet.py`, `serve/disagg.py`
 (per-request lifecycle), `controller/fleetautoscaler.py` +
@@ -28,6 +33,13 @@ from tpu_on_k8s.obs.export import (
     dump_chrome_trace,
     load_trace,
     to_chrome_trace,
+)
+from tpu_on_k8s.obs.ledger import (
+    LEDGER_FORMAT,
+    DecisionLedger,
+    DecisionRecord,
+    HorizonRecord,
+    load_ledger,
 )
 from tpu_on_k8s.obs.slo import (
     BUDGET_EXHAUSTED,
@@ -55,7 +67,11 @@ __all__ = [
     "BUDGET_OK",
     "BUDGET_PAGE",
     "BUDGET_WARN",
+    "DecisionLedger",
+    "DecisionRecord",
     "FlightRecorder",
+    "HorizonRecord",
+    "LEDGER_FORMAT",
     "NOOP",
     "NOOP_SPAN",
     "STATUS_ERROR",
@@ -72,6 +88,7 @@ __all__ = [
     "dump_chrome_trace",
     "ensure",
     "goodput_from_spans",
+    "load_ledger",
     "load_trace",
     "to_chrome_trace",
 ]

@@ -371,6 +371,25 @@ class SLOEvaluator:
         return status
 
 
+def page_onsets(lines) -> List[str]:
+    """The budget-log lines that BEGIN a page episode, in order: a
+    transition whose ``to`` state pages (``page``/``exhausted``) while
+    its ``from`` state did not. The decision ledger's
+    ``slo_page:<svc>#N`` trigger ordinal indexes this list (1-based) —
+    computed from the log itself on each paging onset, so a paging
+    signal that resumes after a stale gap (no new transition line — the
+    state machine held ``page`` through the dark window) keeps the SAME
+    episode ordinal and the trigger stays resolvable."""
+    out = []
+    for line in lines:
+        fields = dict(part.partition("=")[::2] for part in line.split(" "))
+        frm, _, to = fields.get("state", "").partition("->")
+        if to in (BUDGET_PAGE, BUDGET_EXHAUSTED) \
+                and frm not in (BUDGET_PAGE, BUDGET_EXHAUSTED):
+            out.append(line)
+    return out
+
+
 class SLOEngine:
     """A named set of evaluators sharing one injected clock and ONE
     event log (transitions across objectives interleave in evaluation
