@@ -7,7 +7,7 @@
 IMG ?= tpu-on-k8s/manager:latest
 
 .PHONY: test test-fast analyze analyze-concurrency lint chaos-soak fleet-soak autoscale-soak \
-        disagg-soak spec-soak shard-soak slo-soak reshard-soak trace-demo why-demo native bench dryrun manager samples clean \
+        disagg-soak spec-soak paged-soak shard-soak slo-soak reshard-soak trace-demo why-demo native bench dryrun manager samples clean \
         docker-build docker-push deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
@@ -17,6 +17,7 @@ FLEET_SEED ?= 4321
 AUTOSCALE_SEED ?= 2468
 DISAGG_SEED ?= 8642
 SPEC_SEED ?= 7531
+PAGED_SEED ?= 3141
 SHARD_SEED ?= 1357
 SLO_SEED ?= 9753
 RESHARD_SEED ?= 6172
@@ -76,6 +77,10 @@ spec-soak:  ## speculative vs plain decode on the seeded cost-model trace, spec 
 	JAX_PLATFORMS=cpu python tools/serve_load.py --spec --soak \
 	    --n-requests 32 --rate 2.0 --prompt-min 4 --prompt-max 12 \
 	    --new-min 6 --new-max 16 --seed $(SPEC_SEED)
+
+paged-soak:  ## paged KV engine vs a dense control at the same KV byte budget, paged arm twice: byte-identical event logs + token identity + >=4x peak concurrency + recompute/copy positions strictly down
+	JAX_PLATFORMS=cpu python tools/serve_load.py --paged --soak \
+	    --n-requests 32 --seed $(PAGED_SEED)
 
 shard-soak:  ## mesh-sharded vs single-program decode on the seeded cost-model trace across CPU meshes 1/2/4: byte-identical event logs + token identity + ~linear per-chip memory
 	JAX_PLATFORMS=cpu python tools/serve_load.py --shard --soak \

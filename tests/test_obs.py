@@ -41,6 +41,7 @@ from tpu_on_k8s.metrics.metrics import (
     ServingMetrics,
     ShardMetrics,
     SLOMetrics,
+    PagedKVMetrics,
     SpecMetrics,
     TrainMetrics,
     exposition,
@@ -496,6 +497,13 @@ def _populate(m):
         m.inc("spec_tokens_proposed", 8)
         m.inc("spec_tokens_accepted", 6)
         m.set_gauge("spec_acceptance_rate", 0.75)
+    elif isinstance(m, PagedKVMetrics):
+        m.inc("page_allocs", 5)
+        m.inc("pages_aliased", 3)
+        m.inc("admission_stalls")
+        m.inc("programs_compiled", 2)
+        m.set_gauge("pages_total", 64.0)
+        m.set_gauge("pages_in_use", 11.0)
     elif isinstance(m, TrainMetrics):
         m.inc("host_syncs")
         m.set_gauge("mfu", 0.42)
@@ -532,9 +540,9 @@ def _populate(m):
         m.set_gauge("open_effect_horizons", 1.0)
 
 
-_ALL_CLASSES = (JobMetrics, ServingMetrics, SpecMetrics, TrainMetrics,
-                FleetMetrics, AutoscaleMetrics, ShardMetrics, SLOMetrics,
-                ReshardMetrics, LedgerMetrics)
+_ALL_CLASSES = (JobMetrics, ServingMetrics, SpecMetrics, PagedKVMetrics,
+                TrainMetrics, FleetMetrics, AutoscaleMetrics, ShardMetrics,
+                SLOMetrics, ReshardMetrics, LedgerMetrics)
 
 
 class TestExposition:

@@ -1,0 +1,410 @@
+"""Paged KV pool + radix prefix tree: the paged engine must be
+token-identical to the dense engine (which is itself pinned to plain
+``generate()``) through every composition — staggered admits, slot
+reuse, chunked prefill, speculative accept/rollback, mid-decode
+``export_kv``/``submit_kv``, prefix aliasing — while allocating memory
+proportional to live tokens. The kvstore's radix tree and page-chunk
+dedup are pinned here too: copy-on-write at the fork point means a
+write past the fork never mutates a sibling's pages."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_on_k8s.metrics.metrics import PagedKVMetrics
+from tpu_on_k8s.models.decode import PAGE_TOKENS, generate
+from tpu_on_k8s.models.serving import ContinuousBatchingEngine, _LruPrograms
+from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
+from tpu_on_k8s.serve import kvstore
+from tpu_on_k8s.serve.kvstore import FleetPrefixStore
+
+#: tiny-config page size: max_seq_len 64 < PAGE_TOKENS, so tests shrink
+#: the page to keep several pages per sequence (16 divides 64 and the
+#: 128-token granule — the same alignment rule production configs get
+#: for free)
+PAGE = 16
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = dataclasses.replace(TransformerConfig.tiny(), dtype=jnp.float32,
+                              max_seq_len=64)
+    tok = jax.random.randint(jax.random.key(0), (1, 8), 0, cfg.vocab_size,
+                             jnp.int32)
+    params = Transformer(cfg).init(jax.random.key(1), tok)["params"]
+    return cfg, params
+
+
+def _want(cfg, params, prompt, n):
+    """Oracle: the single-request greedy continuation."""
+    return np.asarray(generate(cfg, params,
+                               jnp.asarray(prompt, jnp.int32)[None, :],
+                               max_new_tokens=n))[0]
+
+
+def _paged(cfg, params, *, kv_pages=24, **kw):
+    kw.setdefault("n_slots", 4)
+    return ContinuousBatchingEngine(cfg, params, kv_pages=kv_pages,
+                                    page_tokens=PAGE, **kw)
+
+
+def _prompts(cfg, rng, sizes):
+    return [rng.integers(0, cfg.vocab_size, size=n).astype(np.int32)
+            for n in sizes]
+
+
+# ------------------------------------------------- shared page constant
+def test_page_size_is_the_position_bucket_everywhere():
+    """One constant: a drifted copy would silently misalign exports and
+    pages. The kvstore fallback (stdlib-only import path) must equal the
+    canonical decode value, and both serve-layer defaults derive from
+    it."""
+    import inspect
+
+    from tpu_on_k8s.serve.disagg import DisaggFleet
+    from tpu_on_k8s.serve.router import Router
+
+    assert kvstore.PAGE_TOKENS == PAGE_TOKENS == 128
+    assert (inspect.signature(Router.__init__)
+            .parameters["prefix_bucket_len"].default == PAGE_TOKENS)
+    assert (inspect.signature(DisaggFleet.__init__)
+            .parameters["prefix_bucket_len"].default == PAGE_TOKENS)
+    assert PAGE_TOKENS % PAGE == 0     # the test page keeps the alignment
+
+
+# ------------------------------------------------------ engine oracles
+def test_staggered_admits_and_slot_reuse_match_dense(setup):
+    """More requests than slots, admitted while others are mid-decode:
+    every continuation equals its solo generate() run, through slot
+    reuse onto pages another request just released."""
+    cfg, params = setup
+    rng = np.random.default_rng(31)
+    sizes = (5, 11, 3, 17, 8, 26)
+    news = (10, 6, 12, 5, 9, 7)
+    prompts = _prompts(cfg, rng, sizes)
+
+    eng = _paged(cfg, params, n_slots=2)
+    ids = [eng.submit(p, n) for p, n in zip(prompts[:3], news[:3])]
+    eng.step()
+    eng.step()
+    ids += [eng.submit(p, n) for p, n in zip(prompts[3:], news[3:])]
+    out = eng.run()
+
+    for rid, p, n in zip(ids, prompts, news):
+        np.testing.assert_array_equal(out[rid], _want(cfg, params, p, n),
+                                      err_msg=f"request {rid}")
+    # everything retired: every page is back in the pool
+    assert eng._pool.in_use == 0
+    assert not eng._tables.any()
+
+
+def test_chunked_prefill_paged_matches_dense(setup):
+    """A long prompt split across engine steps admits into pages exactly
+    once, while short requests decode between its chunks."""
+    cfg, params = setup
+    rng = np.random.default_rng(32)
+    long_p, short_p = _prompts(cfg, rng, (33, 4))
+
+    eng = _paged(cfg, params, n_slots=2, prefill_chunk=7)
+    ra = eng.submit(long_p, 8)
+    rb = eng.submit(short_p, 6)
+    out = eng.run()
+    np.testing.assert_array_equal(out[ra], _want(cfg, params, long_p, 8))
+    np.testing.assert_array_equal(out[rb], _want(cfg, params, short_p, 6))
+
+
+def test_prefix_fork_cow_isolation(setup):
+    """Radix-fork copy-on-write: requests sharing a registered prefix
+    alias its full pages (refcounted, no copy) and write their OWN fork
+    and suffix pages — decode past the fork never mutates a sibling's
+    bytes, so concurrent forks and a later fork over the same prefix all
+    match their full-prompt oracles."""
+    cfg, params = setup
+    rng = np.random.default_rng(33)
+    prefix = rng.integers(0, cfg.vocab_size, size=21).astype(np.int32)
+    suffixes = _prompts(cfg, rng, (4, 9, 2))
+    news = (8, 5, 10)
+
+    eng = _paged(cfg, params, n_slots=2)
+    pid = eng.register_prefix(prefix)
+    pre_pages = list(eng._prefix_pages[pid])
+    assert len(pre_pages) == 21 // PAGE       # only FULL pages shared
+    ids = [eng.submit(s, n, prefix_id=pid)
+           for s, n in zip(suffixes[:2], news[:2])]
+    eng.step()                       # forks alias, never copy: the
+    eng.step()                       # prefix page's refcount climbs
+    assert all(int(eng._pool._refs[p]) >= 2 for p in pre_pages)
+    out = eng.run()
+    # a THIRD fork after the first two retired: the shared pages must
+    # still hold pristine prefix KV
+    r3 = eng.submit(suffixes[2], news[2], prefix_id=pid)
+    out[r3] = eng.run()[r3]
+
+    for rid, s, n in zip(ids + [r3], suffixes, news):
+        full = np.concatenate([prefix, s])
+        np.testing.assert_array_equal(out[rid], _want(cfg, params, full, n),
+                                      err_msg=f"fork {rid}")
+    assert eng.stats["pages_aliased"] >= 3 * len(pre_pages)
+    # all forks retired: prefix pages hold exactly their own reference
+    assert all(int(eng._pool._refs[p]) == 1 for p in pre_pages)
+
+
+def test_spec_decode_accept_rollback_paged(setup):
+    """Speculative rounds over the paged pool: accepts and rollbacks are
+    page-table bookkeeping, token-identical to plain greedy decode."""
+    cfg, params = setup
+    dcfg = dataclasses.replace(cfg, n_layers=1, n_heads=2, d_model=32)
+    tok = jax.random.randint(jax.random.key(2), (1, 8), 0, cfg.vocab_size,
+                             jnp.int32)
+    dparams = Transformer(dcfg).init(jax.random.key(3), tok)["params"]
+    rng = np.random.default_rng(34)
+    prompts = _prompts(cfg, rng, (6, 13))
+
+    eng = _paged(cfg, params, n_slots=2, draft_cfg=dcfg,
+                 draft_params=dparams, spec_k=3)
+    ids = [eng.submit(p, 12) for p in prompts]
+    out = eng.run()
+    for rid, p in zip(ids, prompts):
+        np.testing.assert_array_equal(out[rid], _want(cfg, params, p, 12))
+    assert eng.stats["spec_rounds"] > 0
+
+
+def test_export_submit_kv_between_modes(setup):
+    """Mid-decode migration in every direction — paged→dense,
+    dense→paged, paged→paged — ships bucket-aligned page runs and
+    continues token-identically."""
+    cfg, params = setup
+    rng = np.random.default_rng(35)
+    p = _prompts(cfg, rng, (7,))[0]
+
+    def exporter(paged):
+        eng = (_paged(cfg, params, n_slots=2) if paged
+               else ContinuousBatchingEngine(cfg, params, n_slots=2))
+        r = eng.submit(p, 14)
+        eng.step()
+        eng.step()
+        h = eng.export_kv(r)
+        assert h is not None and h.verify()
+        eng.abort(r)
+        return h
+
+    for src_paged in (True, False):
+        for dst_paged in (True, False):
+            h = exporter(src_paged)
+            dst = (_paged(cfg, params, n_slots=2) if dst_paged
+                   else ContinuousBatchingEngine(cfg, params, n_slots=2))
+            r2 = dst.submit_kv(h, 14)
+            np.testing.assert_array_equal(
+                dst.run()[r2], _want(cfg, params, p, 14),
+                err_msg=f"paged={src_paged}->paged={dst_paged}")
+
+
+def test_pool_exhaustion_stalls_then_drains(setup):
+    """A pool too small for the offered load stalls admissions (counted)
+    instead of failing them; everything still finishes correctly as
+    retiring requests return pages."""
+    cfg, params = setup
+    rng = np.random.default_rng(36)
+    prompts = _prompts(cfg, rng, (20, 22, 24))
+
+    m = PagedKVMetrics()
+    eng = _paged(cfg, params, kv_pages=4, n_slots=4, kv_metrics=m)
+    ids = [eng.submit(p, 8) for p in prompts]
+    out = eng.run()
+    for rid, p in zip(ids, prompts):
+        np.testing.assert_array_equal(out[rid], _want(cfg, params, p, 8))
+    assert eng.stats["admission_stalls"] > 0
+    assert m.counters["admission_stalls"] == eng.stats["admission_stalls"]
+    assert eng._pool.in_use == 0
+
+    tiny = _paged(cfg, params, kv_pages=3, n_slots=2)
+    with pytest.raises(ValueError, match="pages"):
+        # one request alone larger than the whole pool: reject at submit
+        tiny.submit(rng.integers(0, cfg.vocab_size, size=40)
+                    .astype(np.int32), 24)
+
+
+def test_lru_program_cache_bounds_and_counts():
+    """The compiled-program caches are bounded LRUs and every miss feeds
+    the programs_compiled counter."""
+    compiled = []
+    lru = _LruPrograms(2, lambda: compiled.append(1))
+    assert lru.get("a", lambda: "A") == "A"
+    assert lru.get("b", lambda: "B") == "B"
+    assert lru.get("a", lambda: "never") == "A"     # hit refreshes
+    lru.get("c", lambda: "C")                        # evicts LRU = "b"
+    assert list(lru) == ["a", "c"] and "b" not in lru
+    assert lru.get("b", lambda: "B2") == "B2"        # re-miss recompiles
+    assert len(compiled) == 4 and len(lru) == 2
+    with pytest.raises(ValueError, match="cap"):
+        _LruPrograms(0)
+
+
+def test_programs_compiled_counter_via_engine(setup):
+    """The engine wires its program caches to kv_metrics in BOTH modes —
+    dense engines get the retrace-pressure counter too."""
+    cfg, params = setup
+    rng = np.random.default_rng(37)
+    m = PagedKVMetrics()
+    eng = ContinuousBatchingEngine(cfg, params, n_slots=2, kv_metrics=m)
+    r = eng.submit(_prompts(cfg, rng, (5,))[0], 4)
+    eng.run()[r]
+    assert m.counters["programs_compiled"] >= 1
+
+
+# ------------------------------------------------- radix prefix store
+class _StubPagedEngine:
+    """Deterministic fake engine: KV leaves are position-stamped
+    ``[L, 1, pb, d]`` arrays, so chunk dedup and materialization are
+    checked byte-for-byte without a model."""
+
+    PB = 16          # export bucket (a multiple of the store's page)
+
+    def __init__(self, supports_page_alias=False):
+        self.mesh_axes = {}
+        self.supports_page_alias = supports_page_alias
+        self._next = 0
+        self.registered = {}
+        self.imported = []       # (cache, lp, base_pid, base_len)
+        self.dropped = []
+
+    def _cache_for(self, tokens):
+        pb = -(-len(tokens) // self.PB) * self.PB
+        k = np.zeros((2, 1, pb, 4), np.float32)
+        for t, tok in enumerate(tokens):
+            k[:, :, t] = float(tok) + 1.0
+        # padding past the true length is per-export garbage, exactly
+        # like a real prefill bucket
+        k[:, :, len(tokens):] = -np.arange(1, pb - len(tokens) + 1,
+                                           dtype=np.float32)[None, None, :,
+                                                             None]
+        return {"layers": {"k": k, "v": k * 2.0}}
+
+    def register_prefix(self, tokens):
+        pid = self._next
+        self._next += 1
+        self.registered[pid] = np.asarray(tokens, np.int32)
+        return pid
+
+    def export_prefix(self, pid):
+        toks = self.registered[pid]
+        return self._cache_for(toks), int(toks.size)
+
+    def import_prefix(self, cache, lp, base_pid=None, base_len=0):
+        pid = self._next
+        self._next += 1
+        self.imported.append((cache, int(lp), base_pid, base_len))
+        return pid
+
+    def drop_prefix(self, pid):
+        self.dropped.append(pid)
+
+
+def test_radix_match_nested_prefixes():
+    """The radix tree answers longest-strict-prefix through nested and
+    branching registrations — including forks splitting mid-edge."""
+    store = FleetPrefixStore(page_tokens=4)
+    a = store.register([1, 2, 3, 4])
+    b = store.register([1, 2, 3, 4, 5, 6])
+    c = store.register([1, 2, 9, 9])
+    assert store.match([1, 2, 3, 4, 5, 6, 7]) == (b, 6)
+    assert store.match([1, 2, 3, 4, 5]) == (a, 4)    # b is not a prefix
+    assert store.match([1, 2, 3, 4]) is None         # prompt IS a prefix
+    assert store.match([1, 2, 9, 9, 1]) == (c, 4)
+    assert store.match([2, 2, 2]) is None
+
+
+def test_host_tier_page_chunk_dedup_and_materialize():
+    """Two prefixes sharing full pages store those pages ONCE; promotes
+    reassemble the exact original bytes; eviction frees shared chunk
+    bytes only when the last referencing entry drops."""
+    eng = _StubPagedEngine()
+    store = FleetPrefixStore(page_tokens=4)
+    shared = list(range(10, 19))                 # 9 tokens → 2 full pages
+    ha = store.register(shared + [100])          # 10 tokens
+    hb = store.register(shared + [100, 101, 102, 103, 104])   # 14 tokens
+    store.ensure("r0", eng, ha)
+    store.ensure("r0", eng, hb)
+    assert store.stats["page_chunks_stored"] == 3   # 2 shared + b's 3rd
+    assert store.stats["page_chunk_reuses"] == 2
+    assert store.stats["dedup_bytes_saved"] > 0
+
+    # the promote path must reassemble byte-exact host copies
+    eng2 = _StubPagedEngine()
+    store.ensure("r1", eng2, ha)
+    store.ensure("r1", eng2, hb)
+    for (cache, lp, _, _), h in zip(eng2.imported, (ha, hb)):
+        want = eng._cache_for(store.tokens_of(h))
+        for kk in ("k", "v"):
+            np.testing.assert_array_equal(cache["layers"][kk],
+                                          want["layers"][kk], err_msg=kk)
+
+    # dropping one sibling keeps the shared chunks resident for the other
+    before = store.overflow_bytes
+    with store._lock:
+        store._drop_host_locked(store._entries[ha])
+    assert store.overflow_bytes < before
+    with store._lock:
+        assert all(k in store._chunks
+                   for k in store._entries[hb].host.chunk_keys)
+    # the survivor still materializes exactly
+    eng3 = _StubPagedEngine()
+    store.ensure("r2", eng3, hb)
+    want = eng._cache_for(store.tokens_of(hb))
+    np.testing.assert_array_equal(eng3.imported[0][0]["layers"]["k"],
+                                  want["layers"]["k"])
+
+
+def test_base_aliased_promote_on_paged_engines():
+    """Promoting a prefix whose registered ancestor is already resident
+    on a paged replica passes base_pid/base_len so the engine aliases
+    the ancestor's pages instead of re-copying them."""
+    src = _StubPagedEngine()
+    store = FleetPrefixStore(page_tokens=4)
+    ha = store.register(list(range(20, 28)))             # ancestor, len 8
+    hb = store.register(list(range(20, 28)) + [1, 2, 3])  # descendant
+    store.ensure("r0", src, ha)        # misses land host copies
+    store.ensure("r0", src, hb)
+
+    dst = _StubPagedEngine(supports_page_alias=True)
+    pid_a = store.ensure("r1", dst, ha)
+    store.ensure("r1", dst, hb)
+    assert store.stats["base_aliased_promotes"] == 1
+    assert dst.imported[-1][2:] == (pid_a, 8)
+
+    # a plain engine (no supports_page_alias) never sees the kwargs
+    plain = _StubPagedEngine()
+    store.ensure("r2", plain, hb)
+    assert plain.imported[-1][2:] == (None, 0)
+
+
+def test_base_aliased_promote_end_to_end(setup):
+    """The full composition on real engines: a descendant prefix promoted
+    onto a paged replica aliases the resident ancestor's pages, and
+    requests under the imported prefix stay oracle-exact."""
+    cfg, params = setup
+    rng = np.random.default_rng(38)
+    anc = rng.integers(0, cfg.vocab_size, size=17).astype(np.int32)
+    desc = np.concatenate([anc, rng.integers(0, cfg.vocab_size, size=7)
+                           .astype(np.int32)])
+    store = FleetPrefixStore(page_tokens=PAGE)
+    ha, hb = store.register(anc), store.register(desc)
+
+    eng_a = _paged(cfg, params, n_slots=2)
+    store.ensure("r0", eng_a, ha)
+    store.ensure("r0", eng_a, hb)
+    eng_b = _paged(cfg, params, n_slots=2)
+    pid_anc = store.ensure("r1", eng_b, ha)
+    pid_desc = store.ensure("r1", eng_b, hb)
+    assert store.stats["base_aliased_promotes"] == 1
+    # the descendant's record aliases the ancestor's full page
+    assert (eng_b._prefix_pages[pid_desc][:17 // PAGE]
+            == eng_b._prefix_pages[pid_anc][:17 // PAGE])
+
+    suffix = rng.integers(0, cfg.vocab_size, size=5).astype(np.int32)
+    r = eng_b.submit(suffix, 9, prefix_id=pid_desc)
+    np.testing.assert_array_equal(
+        eng_b.run()[r],
+        _want(cfg, params, np.concatenate([desc, suffix]), 9))

@@ -477,6 +477,24 @@ def stage_serve_spec(timeout):
                         "--new-max", "64"], "serve_spec", timeout)
 
 
+def stage_serve_paged(timeout):
+    """The paged-KV concurrency headline on the flagship config: the
+    paged engine vs a dense control spending the same KV bytes as
+    whole-sequence slots, on one seeded shared-prefix burst
+    (serve_load --paged). The recorded summary carries peak concurrency
+    per arm, recompute/copy position counts, page alloc/alias traffic,
+    and greedy token identity — all counters, so the comparison is
+    exact on hardware, not clock-sensitive. Page geometry scales to the
+    flagship's 512-token sequences: 64-token pages, a 48-page pool
+    (dense control: 6 slots), 256-token shared prefixes."""
+    return _json_stage([sys.executable, "tools/serve_load.py", "--bench",
+                        "--paged", "--n-requests", "48",
+                        "--paged-page-tokens", "64",
+                        "--paged-pool-pages", "48",
+                        "--paged-prefix-len", "256",
+                        "--paged-slots", "40"], "serve_paged", timeout)
+
+
 def stage_serve_shard(timeout):
     """Mesh-sharded serving on the chip's own devices: the seeded
     cost-model trace across `model`-axis sizes 1/2/4 with the flagship
@@ -569,6 +587,7 @@ STAGES = [
     ("train_reshard", stage_train_reshard, 1200, ()),
     ("serve_ttft", stage_serve_ttft, 1200, ()),
     ("serve_spec", stage_serve_spec, 1200, ()),
+    ("serve_paged", stage_serve_paged, 1200, ()),
     ("serve_shard", stage_serve_shard, 1200, ()),
     ("serve_fleet", stage_serve_fleet, 1200, ()),
     ("serve_autoscale", stage_serve_autoscale, 1200, ()),

@@ -42,6 +42,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tpu_on_k8s.serve.kvstore import PAGE_TOKENS  # noqa: E402
+
 
 @dataclasses.dataclass
 class Arrival:
@@ -1146,6 +1148,163 @@ def _spec_main(args, cfg, params, max_len) -> dict:
     return summary
 
 
+def run_paged_trace(args, cfg, params, max_len, *, paged=True) -> dict:
+    """One seeded burst trace through a single engine, paged
+    (``kv_pages``: the paged KV pool + shared-prefix page aliasing in
+    `tpu_on_k8s/models/serving.py`) or dense (the control arm — the SAME
+    KV memory spent as whole-sequence slots: ``budget_tokens //
+    max_len`` of them). Every request extends one of
+    ``--shared-prefixes`` fixed prefixes; the paged arm registers them
+    once and submits suffixes, the dense arm submits the full prompt —
+    exactly the recompute/copy the page pool exists to delete.
+
+    All requests arrive at step 0 (a burst): peak concurrency then
+    measures how many requests each arm can hold LIVE inside the same
+    byte budget, which is the paper's memory-proportional-to-live-tokens
+    claim made operational. The headline numbers — peak concurrency,
+    ``prefill_positions`` (recompute) and ``admit_copy_positions``
+    (copy) — are counters, not clock readings, so the comparison is
+    identical on the cost-model and ``--bench`` wall clocks and the
+    event log byte-compares across runs per seed (``--soak``)."""
+    from tpu_on_k8s.metrics.metrics import PagedKVMetrics
+    from tpu_on_k8s.models.serving import ContinuousBatchingEngine
+
+    vclock = _VirtualClock()
+    page = args.paged_page_tokens
+    eff_len = max_len if max_len else cfg.max_seq_len
+    budget_tokens = args.paged_pool_pages * page
+    rng = np.random.default_rng(args.seed)
+    prefixes = [rng.integers(0, cfg.vocab_size,
+                             size=args.paged_prefix_len).astype(np.int32)
+                for _ in range(args.shared_prefixes)]
+    # suffix + new stay inside ONE page past the shared prefix (the
+    # live-token working set the pool charges each request for)
+    reqs = []
+    for _ in range(args.n_requests):
+        pj = int(rng.integers(0, len(prefixes)))
+        suffix = rng.integers(0, cfg.vocab_size,
+                              size=int(rng.integers(2, 4))).astype(np.int32)
+        reqs.append((pj, suffix, int(rng.integers(4, 6))))
+
+    kv_metrics = PagedKVMetrics() if paged else None
+    if paged:
+        engine = ContinuousBatchingEngine(
+            cfg, params, n_slots=args.paged_slots, max_len=max_len,
+            queue_cap=args.n_requests + 8, step_horizon=args.horizon,
+            clock=vclock, kv_pages=args.paged_pool_pages, page_tokens=page,
+            kv_metrics=kv_metrics)
+        pids = [engine.register_prefix(p) for p in prefixes]
+    else:
+        engine = ContinuousBatchingEngine(
+            cfg, params, n_slots=max(1, budget_tokens // eff_len),
+            max_len=max_len, queue_cap=args.n_requests + 8,
+            step_horizon=args.horizon, clock=vclock)
+
+    ids = []
+    for pj, suffix, new in reqs:
+        if paged:
+            ids.append(engine.submit(suffix, new, prefix_id=pids[pj]))
+        else:
+            ids.append(engine.submit(
+                np.concatenate([prefixes[pj], suffix]), new))
+
+    peak = 0
+    event_log: List[str] = []
+    step = 0
+    wall_t0 = time.monotonic()
+    while (engine._queue or engine._kv_queue
+           or engine._prefilling is not None
+           or any(s is not None for s in engine._slots)):
+        engine.step()
+        active = sum(s is not None for s in engine._slots)
+        peak = max(peak, active)
+        vclock.advance(args.step_dt)
+        st = engine.stats
+        event_log.append(
+            f"step={step} active={active} emitted={st['emitted']} "
+            f"admitted={st['admitted']} stalls={st['admission_stalls']} "
+            f"pages={st['pages_allocated']}+{st['pages_aliased']}")
+        step += 1
+    wall_s = time.monotonic() - wall_t0
+    finished = engine.run()          # queue drained: collects results
+
+    st = engine.stats
+    summary = {
+        "metric": "paged_trace" if paged else "paged_control_trace",
+        "requests": len(reqs),
+        "served": len(finished),
+        "slots": engine.n_slots,
+        "pool_pages": args.paged_pool_pages if paged else 0,
+        "page_tokens": page if paged else eff_len,
+        "budget_tokens": budget_tokens,
+        "kv_slot_bytes": int(engine.kv_bytes_per_chip),
+        "peak_concurrency": peak,
+        "driver_steps": step,
+        "virtual_s": round(vclock.t, 6),
+        "wall_s": round(wall_s, 3),
+        "recompute_positions": st["prefill_positions"],
+        "copy_positions": st["admit_copy_positions"],
+        "pages_allocated": st["pages_allocated"],
+        "pages_aliased": st["pages_aliased"],
+        "admission_stalls": st["admission_stalls"],
+        "outputs": {j: tuple(int(t) for t in finished[rid])
+                    for j, rid in enumerate(ids) if rid in finished},
+        "event_log": event_log,
+    }
+    return summary
+
+
+def _paged_main(args, cfg, params, max_len) -> dict:
+    """``--paged``: the paged engine vs a dense control holding the same
+    KV byte budget, on the same seeded shared-prefix burst. With
+    ``--soak`` the paged arm runs TWICE from scratch and the event logs
+    must byte-compare, outputs must be token-identical to the dense arm
+    (the greedy oracle), peak concurrency must reach 4x the control's,
+    and recompute + copy positions must be strictly below it —
+    ``PAGED_SOAK_FAILED seed=N`` on any violation so a red run replays
+    verbatim."""
+    control = run_paged_trace(args, cfg, params, max_len, paged=False)
+    summary = run_paged_trace(args, cfg, params, max_len)
+    event_log = summary.pop("event_log")
+    outputs = summary.pop("outputs")
+    control_outputs = control.pop("outputs")
+    control.pop("event_log")
+    summary["control"] = {k: control[k] for k in
+                          ("slots", "kv_slot_bytes", "peak_concurrency",
+                           "driver_steps", "recompute_positions",
+                           "copy_positions")}
+    summary["token_identical"] = outputs == control_outputs
+    summary["concurrency_ratio"] = round(
+        summary["peak_concurrency"]
+        / max(control["peak_concurrency"], 1), 2)
+    summary["recompute_down"] = (summary["recompute_positions"]
+                                 < control["recompute_positions"])
+    summary["copy_down"] = (summary["copy_positions"]
+                            < control["copy_positions"])
+    if args.soak:
+        rerun = run_paged_trace(args, cfg, params, max_len)
+        replayed = event_log == rerun["event_log"]
+        ok = (summary["served"] == args.n_requests and replayed
+              and summary["token_identical"]
+              and summary["concurrency_ratio"] >= 4.0
+              and summary["recompute_down"] and summary["copy_down"])
+        summary["soak_ok"] = ok
+        summary["event_log_replayed"] = replayed
+        if not ok:
+            print(json.dumps(summary))
+            print(f"PAGED_SOAK_FAILED seed={args.seed} "
+                  f"served={summary['served']}/{args.n_requests} "
+                  f"replayed={replayed} "
+                  f"token_identical={summary['token_identical']} "
+                  f"concurrency_ratio={summary['concurrency_ratio']} "
+                  f"recompute_down={summary['recompute_down']} "
+                  f"copy_down={summary['copy_down']}")
+            raise SystemExit(1)
+        print(f"PAGED_SOAK_OK seed={args.seed}", file=sys.stderr)
+    print(json.dumps(summary))
+    return summary
+
+
 #: explicit device-time cost model for the disagg comparison: an
 #: engine's step costs BASE plus PREFILL_COST per padded prefill
 #: position it executed that step — a monolithic engine's co-resident
@@ -1616,7 +1775,7 @@ def main(argv=None) -> dict:
                    help=">0: route the trace through a ServingFleet of "
                         "this many replicas (router + per-replica "
                         "TTFT/queue-wait breakdown)")
-    p.add_argument("--prefix-bucket", type=int, default=128,
+    p.add_argument("--prefix-bucket", type=int, default=PAGE_TOKENS,
                    help="router prefix-affinity bucket length "
                         "(with --replicas)")
     p.add_argument("--shared-prefixes", type=int, default=3,
@@ -1688,6 +1847,28 @@ def main(argv=None) -> dict:
                         "of the self-draft (--spec): measured acceptance "
                         "instead of the =1 upper bound")
     # --- SLO burn-rate mode (tpu_on_k8s/obs/slo.py engine) ---
+    p.add_argument("--paged", action="store_true",
+                   help="paged-KV concurrency probe: the paged engine "
+                        "vs a dense control spending the SAME KV bytes "
+                        "as whole-sequence slots, on one seeded "
+                        "shared-prefix burst; greedy makes the arms "
+                        "token-identical and the win is peak "
+                        "concurrency + recompute/copy positions")
+    p.add_argument("--paged-pool-pages", type=int, default=40,
+                   help="KV page pool size (--paged); the dense control "
+                        "gets pool_pages*page_tokens // max_len slots")
+    p.add_argument("--paged-page-tokens", type=int, default=8,
+                   help="tokens per page (--paged); must divide the "
+                        "128-token position granule")
+    p.add_argument("--paged-prefix-len", type=int, default=40,
+                   help="shared-prefix length (--paged); each of "
+                        "--shared-prefixes prefixes is registered once "
+                        "on the paged arm, resubmitted whole by the "
+                        "dense arm")
+    p.add_argument("--paged-slots", type=int, default=48,
+                   help="slot count for the paged arm (--paged): set "
+                        "above the pool's reach so PAGES, not slots, "
+                        "bound concurrency")
     p.add_argument("--slo", action="store_true",
                    help="drive a seeded virtual-clock trace with a "
                         "latency regression injected mid-run, watched by "
@@ -1812,6 +1993,8 @@ def main(argv=None) -> dict:
         return _slo_main(args, cfg, params, max_len)
     if args.spec:
         return _spec_main(args, cfg, params, max_len)
+    if args.paged:
+        return _paged_main(args, cfg, params, max_len)
     if args.disagg:
         return _disagg_main(args, cfg, params, max_len)
     if args.autoscale:

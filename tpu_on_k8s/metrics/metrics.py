@@ -309,6 +309,35 @@ class SpecMetrics(_MetricsBase):
                       "(accepted / proposed over the engine's lifetime)")
 
 
+class PagedKVMetrics(_MetricsBase):
+    """Paged-KV observability (`tpu_on_k8s/models/serving.py`
+    ``kv_metrics=``): pool capacity and live-page occupancy gauges (their
+    ratio is the real memory signal every control loop wants instead of
+    a slot count), fresh-page allocations vs prefix-page aliases (the
+    alias counter is the copy-on-write sharing actually happening),
+    admission stalls (a request held in queue because the pool couldn't
+    supply its reservation — the backpressure signal), and the compiled-
+    program counter every LRU program-cache miss feeds (retrace pressure
+    from a long tail of prompt shapes, visible before it becomes host
+    RSS). Same prometheus + plain-dict mirror pattern as
+    ``ServingMetrics``; give the instance to the engine's
+    ``kv_metrics=`` — the programs_compiled counter works in dense mode
+    too."""
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        if _prom is not None:
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_paged"
+        for name in ("page_allocs", "pages_aliased", "admission_stalls",
+                     "programs_compiled"):
+            self._declare(name, f"{ns}_{name}", "counter",
+                          f"Paged KV {name}")
+        for name in ("pages_total", "pages_in_use"):
+            self._declare(name, f"{ns}_{name}", "gauge",
+                          f"Paged KV {name}")
+
+
 class ShardMetrics(_MetricsBase):
     """Mesh-sharded serving observability (`tpu_on_k8s/models/serving.py`
     engine ``shard_metrics=`` + `serve/fleet.py` reshard rollouts): the

@@ -29,6 +29,16 @@ from tpu_on_k8s.models.transformer import Transformer, TransformerConfig
 _W8_TARGETS = frozenset({"wq", "wk", "wv", "wo",
                          "w_gate", "w_up", "w_down", "w_gateup"})
 
+#: The position-bucket granule AND the paged-KV page size, in tokens.
+#: Every cache length the serving stack materializes — prefill buckets,
+#: position-trimmed exports, disagg handoff trims, router affinity buckets,
+#: and the engine's KV pages — is a multiple of this one constant, so
+#: bucket boundaries and page boundaries coincide by construction. A
+#: drifted copy anywhere would silently misalign exports against pages;
+#: import it, never restate it. 128 is also the TPU lane width, so a page
+#: is a whole number of vector tiles along the position axis.
+PAGE_TOKENS = 128
+
 
 def quantize_weights_for_serving(params, quantize=None) -> dict:
     """W8A16 weight conversion for ``cfg.serve_int8_weights`` serving: each
@@ -117,12 +127,15 @@ def init_cache(model: Transformer, batch: int) -> dict:
 
 
 def _bucket_len(total: int, max_seq_len: int) -> int:
-    """Smallest 128-multiple cache length covering ``total`` positions,
-    capped at the model's max. Decode is HBM-bandwidth-bound on cache
-    reads, and every step attends over the WHOLE static cache — so a
+    """Smallest ``PAGE_TOKENS``-multiple cache length covering ``total``
+    positions, capped at the model's max. Decode is HBM-bandwidth-bound on
+    cache reads, and every step attends over the WHOLE static cache — so a
     256-token request on a 1024-max model pays 4× the attention traffic it
-    needs unless the cache is sized to the request."""
-    return min(max_seq_len, max(128, -(-total // 128) * 128))
+    needs unless the cache is sized to the request. The granule doubling
+    as the paged-KV page size means every bucketed export is a whole
+    number of pages."""
+    return min(max_seq_len,
+               max(PAGE_TOKENS, -(-total // PAGE_TOKENS) * PAGE_TOKENS))
 
 
 @functools.lru_cache(maxsize=32)

@@ -79,8 +79,8 @@ import functools
 import itertools
 import threading
 import time
-from collections import deque
-from typing import Any, Dict, List, Optional, Tuple
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -89,6 +89,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from tpu_on_k8s import chaos
 from tpu_on_k8s.models.decode import (
+    PAGE_TOKENS,
     _bucket_len,
     cache_shapes,
     init_cache,
@@ -144,6 +145,11 @@ class _Slot:
                                   # context KV → the spec rounds may
                                   # propose for it (False: plain decode —
                                   # adopted handoffs, imported prefixes)
+    pages: Optional[List[int]] = None   # paged mode: this slot's block
+                                  # table (pages[j] backs positions
+                                  # [j*page, (j+1)*page)); leading entries
+                                  # may ALIAS shared prefix pages —
+                                  # refcounts make release uniform
 
 
 @dataclasses.dataclass
@@ -184,6 +190,11 @@ class _Prefilling:
     done: int                     # positions cached so far (incl. prefix)
     total: int                    # base + prompt length
     dequeued_at: float
+    pages: Optional[List[int]] = None   # paged mode: the block table
+                                  # reserved at dequeue (eager — admission
+                                  # must not fail after chunks ran)
+    fresh_from: int = 0           # leading entries of ``pages`` that
+                                  # alias shared prefix pages
 
 
 def _strip_index(cache: Any) -> Any:
@@ -321,6 +332,120 @@ class _ShardPlan:
         return total
 
 
+class _LruPrograms:
+    """A bounded compiled-program cache: the per-bucket prefill / suffix /
+    admit-range (and paged gather/admit) programs key on shapes drawn from
+    request traffic, so an adversarial long tail of prompt lengths could
+    otherwise grow compile state without bound. LRU keyed on the shape
+    tuple; every miss fires ``on_compile`` (the ``programs_compiled``
+    counter on `metrics.PagedKVMetrics`) so retrace pressure is visible
+    on a dashboard, not discovered as creeping host RSS. Dropping a
+    program costs only a retrace on next use — never correctness."""
+
+    def __init__(self, cap: int = 32,
+                 on_compile: Optional[Callable[[], None]] = None) -> None:
+        if cap < 1:
+            raise ValueError(f"program cache cap must be >= 1, got {cap}")
+        self._cap = cap
+        self._on_compile = on_compile
+        self._d: "OrderedDict[Any, Any]" = OrderedDict()
+
+    def get(self, key, build):
+        fn = self._d.get(key)
+        if fn is not None:
+            self._d.move_to_end(key)
+            return fn
+        fn = build()
+        if self._on_compile is not None:
+            self._on_compile()
+        self._d[key] = fn
+        while len(self._d) > self._cap:
+            self._d.popitem(last=False)
+        return fn
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __iter__(self):
+        """Cached keys, LRU→MRU (tests introspect what compiled)."""
+        return iter(self._d)
+
+    def __contains__(self, key) -> bool:
+        return key in self._d
+
+
+class _PagePool:
+    """Host-side allocator for the paged KV pool: fixed-size pages of
+    ``page`` token positions, refcounted so shared-prefix pages can be
+    aliased into many slots' block tables (copy-on-write happens at the
+    block-table level — a fork writes its OWN fork/suffix pages and only
+    REFERENCES the shared full-prefix pages, so a write past the fork can
+    never touch a sibling's bytes).
+
+    Page id 0 is the reserved null page: permanently zero on device, it
+    backs every unallocated block-table entry, so overshoot appends
+    (horizon/speculative writes past a request's reservation) land there
+    and are wiped after every program that could dirty it. Real pages are
+    handed out ascending from a LIFO free stack — fully deterministic, so
+    seeded replays see identical page placement.
+
+    Lock order: callers hold the engine lock first when they hold both;
+    this lock is a leaf (the pool calls nothing that locks)."""
+
+    def __init__(self, n_pages: int) -> None:
+        if n_pages < 1:
+            raise ValueError(f"kv_pages must be >= 1, got {n_pages}")
+        self.capacity = n_pages
+        # pop() yields 1, 2, 3, ... — ascending first-use order
+        self._free: List[int] = list(range(n_pages, 0, -1))
+        self._refs = np.zeros(n_pages + 1, np.int32)
+        self._lock = threading.Lock()
+
+    def available(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        with self._lock:
+            return self.capacity - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh pages at refcount 1, or None (all-or-nothing) when
+        the pool cannot supply them — the caller stalls admission."""
+        if n == 0:
+            return []
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            pids = [self._free.pop() for _ in range(n)]
+            for p in pids:
+                self._refs[p] = 1
+            return pids
+
+    def retain(self, pids: List[int]) -> None:
+        """Alias already-live pages into another block table."""
+        with self._lock:
+            for p in pids:
+                if self._refs[p] < 1:
+                    raise ValueError(f"retain of dead page {p}")
+                self._refs[p] += 1
+
+    def release(self, pids: List[int]) -> int:
+        """Drop one reference per pid; pages reaching zero return to the
+        free stack (in the given order). Returns the count freed."""
+        freed = 0
+        with self._lock:
+            for p in pids:
+                if self._refs[p] < 1:
+                    raise ValueError(f"release of dead page {p}")
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    self._free.append(p)
+                    freed += 1
+        return freed
+
+
 @dataclasses.dataclass
 class KVHandoff:
     """A completed prefill's KV, host-resident and engine-portable — the
@@ -410,7 +535,8 @@ class _DraftRunner:
     worth speculating with is small enough to replicate."""
 
     def __init__(self, cfg: TransformerConfig, params, n_slots: int,
-                 max_len: int, k: int, mesh=None) -> None:
+                 max_len: int, k: int, mesh=None,
+                 on_compile: Optional[Callable[[], None]] = None) -> None:
         if cfg.pos_emb == "rope":
             cfg = dataclasses.replace(cfg, max_seq_len=max_len)
         elif cfg.max_seq_len < max_len:
@@ -435,8 +561,8 @@ class _DraftRunner:
         if self._rep is not None:
             self.cache = jax.device_put(self.cache, self._rep)
         self.prefixes: Dict[int, Tuple[Any, int]] = {}   # engine pid → KV
-        self._prefill_progs: Dict[int, Any] = {}
-        self._suffix_progs: Dict[int, Any] = {}
+        self._prefill_progs = _LruPrograms(32, on_compile)
+        self._suffix_progs = _LruPrograms(32, on_compile)
         model = self._step_model
 
         @functools.partial(
@@ -486,8 +612,7 @@ class _DraftRunner:
         return np.asarray(out)
 
     def _prefill_fn(self, bucket: int):
-        fn = self._prefill_progs.get(bucket)
-        if fn is None:
+        def build():
             model = self._prefill_model
             shapes = cache_shapes(model, 1)
 
@@ -503,12 +628,12 @@ class _DraftRunner:
                     mutable=["cache"])
                 return upd["cache"]
 
-            fn = self._prefill_progs[bucket] = prefill
-        return fn
+            return prefill
+
+        return self._prefill_progs.get(bucket, build)
 
     def _suffix_fn(self, bucket: int):
-        fn = self._suffix_progs.get(bucket)
-        if fn is None:
+        def build():
             from tpu_on_k8s.models.decode import _set_cursor
             model = self._prefill_model
 
@@ -524,8 +649,9 @@ class _DraftRunner:
                     mutable=["cache"])
                 return upd["cache"]
 
-            fn = self._suffix_progs[bucket] = prefill
-        return fn
+            return prefill
+
+        return self._suffix_progs.get(bucket, build)
 
     def register_prefix(self, pid: int, tokens: np.ndarray) -> None:
         """Draft-prefill a shared prefix under the ENGINE's prefix id, so
@@ -589,7 +715,9 @@ class ContinuousBatchingEngine:
                  clock=time.monotonic,
                  draft_cfg: Optional[TransformerConfig] = None,
                  draft_params=None, spec_k: int = 4, spec_metrics=None,
-                 on_spec_round=None, shard_metrics=None):
+                 on_spec_round=None, shard_metrics=None,
+                 kv_pages: int = 0, page_tokens: Optional[int] = None,
+                 kv_metrics=None):
         if step_horizon < 1:
             raise ValueError(f"step_horizon must be >= 1, got {step_horizon}")
         if queue_cap is not None and queue_cap < 1:
@@ -619,16 +747,52 @@ class ContinuousBatchingEngine:
         self.cfg = cfg
         self.n_slots = n_slots
         self.max_len = max_len
+        # ---- paged KV pool configuration ------------------------------
+        #: optional ``metrics.PagedKVMetrics`` — page occupancy gauges,
+        #: alloc/alias/stall counters, the programs_compiled counter (the
+        #: LRU program caches count compiles in BOTH modes)
+        self.kv_metrics = kv_metrics
+        if kv_pages < 0:
+            raise ValueError(f"kv_pages must be >= 0, got {kv_pages}")
+        #: tokens per KV page. Defaults to the position-bucket granule
+        #: (`decode.PAGE_TOKENS`) so pages and buckets coincide by
+        #: construction; tiny configs (max_len < PAGE_TOKENS) shrink it
+        #: to max_len, and an explicit override must keep the alignment:
+        #: every bucket a request can export is a PAGE_TOKENS multiple or
+        #: max_len itself, so the page must divide both.
+        page = (page_tokens if page_tokens is not None
+                else min(PAGE_TOKENS, max_len))
+        if kv_pages:
+            if page < 1 or max_len % page != 0:
+                raise ValueError(f"page_tokens {page} must divide max_len "
+                                 f"{max_len}")
+            if max_len > PAGE_TOKENS and PAGE_TOKENS % page != 0:
+                raise ValueError(
+                    f"page_tokens {page} must divide the position bucket "
+                    f"granule {PAGE_TOKENS} (exports trim to bucket "
+                    f"multiples; a non-dividing page would misalign them)")
+            if step_horizon > page:
+                raise ValueError(
+                    f"step_horizon {step_horizon} exceeds page_tokens "
+                    f"{page}: a horizon's appends must span at most two "
+                    f"pages (the scatter-back window)")
+        self.page_tokens = page
+        self._nb_total = max_len // page if kv_pages else 0
+        #: True on paged engines: ``import_prefix`` accepts
+        #: ``base_pid``/``base_len`` and aliases the ancestor's full
+        #: pages instead of copying — the prefix store gates its
+        #: reference-moving promote path on this
+        self.supports_page_alias = bool(kv_pages)
         #: > 0: prompts longer than this prefill one chunk per engine step
         #: (in a private cache; the slot admits when the last chunk lands)
         #: instead of one long synchronous prefill — decode for the OTHER
         #: slots continues between chunks, bounding the TTFT spike a long
         #: prompt inflicts on everyone ("chunked prefill"). 0 = whole-prompt
-        #: admission. Chunks pad to 128-token prefill buckets, so at
-        #: production lengths the chunk rounds UP to a 128 multiple — a
+        #: admission. Chunks pad to PAGE_TOKENS prefill buckets, so at
+        #: production lengths the chunk rounds UP to a bucket multiple — a
         #: smaller chunk would pay the full bucket's FLOPs anyway.
-        if prefill_chunk and max_len > 128:
-            prefill_chunk = -(-prefill_chunk // 128) * 128
+        if prefill_chunk and max_len > PAGE_TOKENS:
+            prefill_chunk = -(-prefill_chunk // PAGE_TOKENS) * PAGE_TOKENS
         self.prefill_chunk = prefill_chunk
         self.sampling = SamplingParams(temperature=temperature,
                                        top_k=top_k, top_p=top_p)
@@ -640,8 +804,7 @@ class ContinuousBatchingEngine:
             dataclasses.replace(base, decode_multislot=True))
         self._prefill_model = Transformer(base)
 
-        self._cache = init_cache(self._step_model, n_slots)
-        cache_shardings = token_shardings = None
+        cache_shardings = token_shardings = pool_shardings = None
         plan: Optional[_ShardPlan] = None
         if mesh is not None:
             # Tensor-parallel / expert-parallel serving: params shard by
@@ -649,9 +812,10 @@ class ContinuousBatchingEngine:
             # aware — per-layer all-gather/reduce-scatter over the
             # `model` axis ride ICI, MoE expert tables split on
             # `expert`), the KV pool shards kv-heads on `model` and
-            # slots on `data`, and the per-slot token/position vectors
-            # replicate. Same compiled programs, just sharded — XLA
-            # inserts the collectives; `_ShardPlan` holds every layout.
+            # slots (dense) or pages (paged) on `data`, and the per-slot
+            # token/position vectors replicate. Same compiled programs,
+            # just sharded — XLA inserts the collectives; `_ShardPlan`
+            # holds every layout.
             if rules is None:
                 from tpu_on_k8s.models.transformer import (
                     serving_partition_rules,
@@ -660,11 +824,53 @@ class ContinuousBatchingEngine:
                     int8=cfg.serve_int8_weights)
             plan = _ShardPlan(mesh, params, rules, n_slots)
             params = plan.put_params(params)
-            cache_shardings = plan.cache_shardings(self._cache,
-                                                   slots_on_data=True)
-            self._cache = jax.tree.map(jax.device_put, self._cache,
-                                       cache_shardings)
             token_shardings = plan.replicated
+        self._cache = None
+        self._pool: Optional[_PagePool] = None
+        self._pool_cache = None
+        if kv_pages:
+            # The paged pool: every KV leaf becomes [L, P, page, ...] —
+            # page axis where the dense pool had slots, position axis cut
+            # to one page. P = kv_pages + 1: page id 0 is the null page
+            # (permanently zero; unallocated block-table entries point at
+            # it so overshoot appends drop). The pool shards exactly like
+            # the dense pool — kv-heads on `model` (dim 3 is unchanged),
+            # pages on `data` where slots used to be (dim 1, padded up so
+            # the axis divides) — via the same `_ShardPlan` machinery.
+            total = kv_pages + 1
+            if plan is not None and plan.n_data > 1:
+                total = -(-total // plan.n_data) * plan.n_data
+            self._pool = _PagePool(total - 1)
+            shapes = cache_shapes(self._step_model, n_slots)
+            pool_struct = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(
+                    (s.shape[0], total, page) + tuple(s.shape[3:]),
+                    s.dtype),
+                shapes)
+            if plan is not None:
+                pool_shardings = plan.cache_shardings(pool_struct,
+                                                      slots_on_data=True)
+                self._pool_cache = jax.tree.map(
+                    lambda s, sh: jax.device_put(
+                        jnp.zeros(s.shape, s.dtype), sh),
+                    pool_struct, pool_shardings)
+            else:
+                self._pool_cache = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), pool_struct)
+            #: per-slot block tables, host-resident (int32 page ids; 0 =
+            #: unallocated → null page). One small H2D per program call.
+            self._tables = np.zeros((n_slots, self._nb_total), np.int32)
+            self._prefix_pages: Dict[int, List[int]] = {}
+            if kv_metrics is not None:
+                kv_metrics.set_gauge("pages_total", self._pool.capacity)
+                kv_metrics.set_gauge("pages_in_use", 0)
+        else:
+            self._cache = init_cache(self._step_model, n_slots)
+            if plan is not None:
+                cache_shardings = plan.cache_shardings(self._cache,
+                                                       slots_on_data=True)
+                self._cache = jax.tree.map(jax.device_put, self._cache,
+                                           cache_shardings)
         self.mesh = mesh
         self._plan = plan
         #: {axis: size} of the mesh's non-trivial axes ({} = single
@@ -713,6 +919,85 @@ class ContinuousBatchingEngine:
                 body, (cache, toks, pos), jax.random.split(key, horizon))
             return cache, toks_out
 
+        # ---- paged-mode programs ----------------------------------------
+        # The paged step gathers each slot's block table into the SAME
+        # [L, S, max_len, ...] view the dense step decodes over and runs
+        # the IDENTICAL model apply — token identity with dense mode is
+        # by construction, not by re-derivation (unallocated blocks read
+        # the null page's zeros; positions past a slot's cursor are never
+        # attended either way). Afterwards only each slot's two TAIL
+        # pages (the at-most-two pages a horizon's appends can touch —
+        # validated horizon <= page) scatter back to the pool; every
+        # other gathered page is either unchanged private data or a
+        # shared prefix page that appends can never reach (appends land
+        # at pos >= the fork, and aliased pages all lie below it). The
+        # RESIDENT allocation is the pool — proportional to live tokens;
+        # the gathered view is a transient working set inside the step
+        # (a paged-attention kernel indexing pages in place is the
+        # follow-up optimization, not a correctness requirement).
+        self._pool_shardings = pool_shardings
+        self._gather_view = self._scatter_tails = None
+        if self._pool is not None:
+            nb = self._nb_total
+
+            def _gather_view(pool, tables):
+                def g(pl):
+                    x = pl[:, tables]          # [L, S, nb, page, *rest]
+                    return x.reshape((x.shape[0], tables.shape[0],
+                                      nb * page) + x.shape[4:])
+                return jax.tree.map(g, pool)
+
+            def _scatter_tails(pool, cache, tail_blocks, tail_pids):
+                # tail_blocks [S, 2] block indices, tail_pids [S, 2] their
+                # page ids (0 = free slot / unallocated → the write lands
+                # in the null page, which is re-zeroed last). Duplicate
+                # ids only ever carry identical bytes (b0 == b1) or hit
+                # the re-zeroed null page, so the scatter is
+                # order-insensitive and replays byte-identically.
+                def s(pl, cl):
+                    u = cl.reshape((cl.shape[0], cl.shape[1], nb, page)
+                                   + cl.shape[3:])
+                    idx = tail_blocks.reshape(
+                        (1,) + tail_blocks.shape + (1,) * (u.ndim - 3))
+                    tails = jnp.take_along_axis(u, idx, axis=2)
+                    tails = tails.reshape((tails.shape[0], -1, page)
+                                          + tails.shape[4:])
+                    pl = pl.at[:, tail_pids.reshape(-1)].set(tails)
+                    return pl.at[:, 0].set(jnp.zeros_like(pl[:, 0]))
+                return jax.tree.map(s, pool, cache)
+
+            self._gather_view = _gather_view
+            self._scatter_tails = _scatter_tails
+            paged_in = ((plan.params, pool_shardings, _rep, _rep, _rep,
+                         _rep, _rep, _rep) if plan is not None else None)
+
+            @functools.partial(
+                jax.jit, donate_argnums=(1,),
+                in_shardings=paged_in,
+                out_shardings=((pool_shardings, token_shardings)
+                               if plan is not None else None))
+            def step_paged(params, pool, tables, toks, pos,
+                           tail_blocks, tail_pids, key):
+                """The dense ``step`` over a gathered page view; returns
+                the pool (tail pages scattered back) and the same
+                [horizon, n_slots] token stack."""
+                cache = _gather_view(pool, tables)
+
+                def body(carry, step_key):
+                    cache, tok, p = carry
+                    logits, upd = self._step_model.apply(
+                        {"params": params, "cache": cache}, tok[:, None],
+                        p[:, None], mutable=["cache"])
+                    nxt = _pick(logits[:, -1], step_key, sp)
+                    return (upd["cache"], nxt, p + 1), nxt
+
+                (cache, _, _), toks_out = jax.lax.scan(
+                    body, (cache, toks, pos), jax.random.split(key, horizon))
+                return _scatter_tails(pool, cache, tail_blocks,
+                                      tail_pids), toks_out
+
+            self._step_paged = step_paged
+
         @functools.partial(
             jax.jit, donate_argnums=(0,),
             out_shardings=cache_shardings if mesh is not None else None)
@@ -730,23 +1015,22 @@ class ContinuousBatchingEngine:
                     jnp.where(keep, pre[:, row], shared[:, slot]))
             return jax.tree.map(write, cache, _strip_index(pre_cache))
 
-        admit_range_progs: Dict[int, Any] = {}
+        admit_range_progs = _LruPrograms(32, self._count_compile)
 
         def admit_range_for(pb: int):
             """``admit_range`` program for a pre cache whose position
             axis is trimmed to ``pb`` (export/handoff payloads carry the
-            128-multiple bucket of their live positions, not max_len —
-            the transfer and checksum scale with the request): mask
-            positions ``[lo, hi)`` of a CURSORLESS batch cache's row
+            PAGE_TOKENS-multiple bucket of their live positions, not
+            max_len — the transfer and checksum scale with the request):
+            mask positions ``[lo, hi)`` of a CURSORLESS batch cache's row
             ``row`` into slot ``slot`` (``lo=0`` for a full handoff;
             ``lo=base`` to lay a suffix over locally-seeded prefix
             rows), zero-padding the pre rows back to max_len on device
             first. Positions outside the range keep the slot's bytes,
             same never-attended invariant as ``admit``. One program per
-            position bucket — the same bounded set the prefill programs
-            compile over (``pb == max_len`` is the untrimmed case)."""
-            fn = admit_range_progs.get(pb)
-            if fn is None:
+            position bucket — LRU-bounded like every per-bucket program
+            cache (``pb == max_len`` is the untrimmed case)."""
+            def build():
                 @functools.partial(
                     jax.jit, donate_argnums=(0,),
                     out_shardings=(cache_shardings
@@ -764,14 +1048,16 @@ class ContinuousBatchingEngine:
                         return shared.at[:, slot].set(
                             jnp.where(keep, pre[:, row], shared[:, slot]))
                     return jax.tree.map(write, cache, pre_cache)
-                fn = admit_range_progs[pb] = admit_range
-            return fn
+                return admit_range
+            return admit_range_progs.get(pb, build)
 
         self._step = step
         self._admit = admit
         self._admit_range_for = admit_range_for
-        self._prefill_cache: Dict[tuple, Any] = {}  # (bucket, b) -> program
-        self._suffix_prefill_cache: Dict[int, Any] = {}
+        self._prefill_cache = _LruPrograms(32, self._count_compile)
+        self._suffix_prefill_cache = _LruPrograms(32, self._count_compile)
+        self._paged_admit_progs = _LruPrograms(16, self._count_compile)
+        self._paged_gather_progs = _LruPrograms(16, self._count_compile)
         self._prefixes: Dict[int, Any] = {}   # id → (cache pytree, length)
         self._next_prefix_id = 0
 
@@ -805,11 +1091,17 @@ class ContinuousBatchingEngine:
                 raise ValueError("draft and target must share a vocabulary")
             if spec_k < 1:
                 raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            if self._pool is not None and spec_k + 1 > page:
+                raise ValueError(
+                    f"spec_k + 1 ({spec_k + 1}) exceeds page_tokens "
+                    f"{page}: a verify chunk's appends must span at most "
+                    f"two pages (the scatter-back window)")
             # on a mesh the draft replicates (every chip runs the whole
             # small model) while the sharded target verifies
             # tensor-parallel — the classic big-model serving shape
             self._draft = _DraftRunner(draft_cfg, draft_params, n_slots,
-                                       max_len, spec_k, mesh=mesh)
+                                       max_len, spec_k, mesh=mesh,
+                                       on_compile=self._count_compile)
 
             @functools.partial(
                 jax.jit, donate_argnums=(1,),
@@ -832,6 +1124,35 @@ class ContinuousBatchingEngine:
                     logits, axis=-1).astype(jnp.int32)
 
             self._spec_verify = spec_verify
+            if self._pool is not None:
+                gather_view, scatter_tails = (self._gather_view,
+                                              self._scatter_tails)
+
+                @functools.partial(
+                    jax.jit, donate_argnums=(1,),
+                    in_shardings=((plan.params, pool_shardings, _rep,
+                                   _rep, _rep, _rep, _rep)
+                                  if plan is not None else None),
+                    out_shardings=((pool_shardings, token_shardings)
+                                   if plan is not None else None))
+                def spec_verify_paged(params, pool, tables, chunk,
+                                      positions, tail_blocks, tail_pids):
+                    """``spec_verify`` over the gathered page view; the
+                    k+1 chunk's appends span at most two pages
+                    (validated), so the same tail scatter covers them.
+                    Rejected proposals' K/V lands in the slot's own
+                    PRIVATE tail pages — a rollback can never dirty a
+                    shared prefix page."""
+                    cache = gather_view(pool, tables)
+                    logits, upd = self._step_model.apply(
+                        {"params": params, "cache": cache}, chunk,
+                        positions, mutable=["cache"])
+                    pool = scatter_tails(pool, upd["cache"], tail_blocks,
+                                         tail_pids)
+                    return pool, jnp.argmax(
+                        logits, axis=-1).astype(jnp.int32)
+
+                self._spec_verify_paged = spec_verify_paged
 
         self._slots: List[Optional[_Slot]] = [None] * n_slots
         self._queue: deque[_Pending] = deque()
@@ -862,7 +1183,19 @@ class ContinuousBatchingEngine:
                       "spec_rounds": 0, "spec_proposed": 0,
                       "spec_accepted": 0, "spec_rollbacks": 0,
                       "draft_crashes": 0,
-                      "spec_draft_s": 0.0, "spec_verify_s": 0.0}
+                      "spec_draft_s": 0.0, "spec_verify_s": 0.0,
+                      # admission copy traffic, in cache POSITIONS: dense
+                      # admissions copy the request's full cached span
+                      # into the pool; paged admissions copy only
+                      # freshly-written pages (aliased prefix pages move
+                      # a reference, not bytes) — the serve_load --paged
+                      # arm's copy-bytes comparison reads these
+                      "admit_copy_positions": 0,
+                      # paged mode: pages allocated / aliased over the
+                      # engine's lifetime, and admissions stalled on an
+                      # exhausted pool (the request stays queued)
+                      "pages_allocated": 0, "pages_aliased": 0,
+                      "admission_stalls": 0}
         #: hard bound on requests in flight (queued + prefilling + slots);
         #: ``submit`` past it raises ``EngineOverloadedError``. None keeps
         #: the historical unbounded queue (library use; the gateway bounds
@@ -880,6 +1213,134 @@ class ContinuousBatchingEngine:
         # queue/bookkeeping against the driver — device work itself is
         # single-threaded by design.
         self._lock = threading.Lock()
+
+    # ---- paged-pool helpers ------------------------------------------------
+    def _count_compile(self) -> None:
+        """Every LRU program-cache miss lands here (both modes) — compile
+        pressure from a long tail of shapes is a counter, not a mystery."""
+        if self.kv_metrics is not None:
+            self.kv_metrics.inc("programs_compiled")
+
+    def _pages_for_span(self, end: int) -> int:
+        """Block-table entries needed to back positions [0, end)."""
+        return -(-end // self.page_tokens)
+
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh pages, or None (counted stall — the request stays
+        queued and retries next step as pages free up)."""
+        pids = self._pool.alloc(n)
+        if pids is None:
+            self.stats["admission_stalls"] += 1
+            if self.kv_metrics is not None:
+                self.kv_metrics.inc("admission_stalls")
+            return None
+        if pids:
+            self.stats["pages_allocated"] += len(pids)
+            if self.kv_metrics is not None:
+                self.kv_metrics.inc("page_allocs", len(pids))
+        return pids
+
+    def _alias_pages(self, pids: List[int]) -> List[int]:
+        """Reference shared pages into another block table — the
+        copy-free half of every prefix-seeded paged admission."""
+        self._pool.retain(pids)
+        if pids:
+            self.stats["pages_aliased"] += len(pids)
+            if self.kv_metrics is not None:
+                self.kv_metrics.inc("pages_aliased", len(pids))
+        return list(pids)
+
+    def _release_pages(self, pages: Optional[List[int]]) -> None:
+        if self._pool is not None and pages:
+            self._pool.release(pages)
+
+    def _prefix_alias_blocks(self, prefix_id, plen: int) -> List[int]:
+        """The shared FULL pages of a registered prefix (every block
+        below the fork block ``plen // page``) — what an admission
+        aliases instead of copying. Empty when the prefix carries no
+        page record (pool exhausted at registration, or no full page
+        fits under the fork): the admission then writes every block
+        fresh, exactly as correct, just without the sharing win."""
+        if prefix_id is None or self._pool is None:
+            return []
+        pids = self._prefix_pages.get(prefix_id, [])
+        fb = plen // self.page_tokens
+        return list(pids[:fb]) if len(pids) >= fb else []
+
+    def _paged_admit_fn(self, b: int):
+        """Program writing blocks of row ``row`` of a dense [b]-row
+        prefill cache into the pool pages named by ``pids`` [nb_total]
+        (0 = skip: the write lands in the null page, which the program
+        wipes last). One program per prefill batch size, LRU-bounded."""
+        def build():
+            nb, page = self._nb_total, self.page_tokens
+            out_sh = (self._pool_shardings if self._plan is not None
+                      else None)
+
+            @functools.partial(jax.jit, donate_argnums=(0,),
+                               out_shardings=out_sh)
+            def admit_pages(pool, pre_cache, row, pids):
+                def write(pl, pre):
+                    blocks = pre[:, row].reshape(
+                        (pre.shape[0], nb, page) + pre.shape[3:])
+                    pl = pl.at[:, pids].set(blocks)
+                    return pl.at[:, 0].set(jnp.zeros_like(pl[:, 0]))
+                return jax.tree.map(write, pool, _strip_index(pre_cache))
+            return admit_pages
+        return self._paged_admit_progs.get(b, build)
+
+    def _paged_gather_fn(self, nbp: int):
+        """Program gathering ``nbp`` pages into one cursorless batch-1
+        row [L, 1, nbp*page, ...] — the paged export path ships only
+        REFERENCED pages (table entries past a slot's reservation name
+        the null page, so trailing padding is deterministic zeros)."""
+        def build():
+            page = self.page_tokens
+
+            @jax.jit
+            def gather_rows(pool, table):
+                def g(pl):
+                    x = pl[:, table]           # [L, nbp, page, *rest]
+                    return x.reshape((x.shape[0], 1, nbp * page)
+                                     + x.shape[3:])
+                return jax.tree.map(g, pool)
+            return gather_rows
+        return self._paged_gather_progs.get(nbp, build)
+
+    def _write_pages(self, pre_cache, row: int,
+                     pids_by_block: np.ndarray) -> None:
+        """Scatter a dense prefill row into the pool, block by block."""
+        b = jax.tree.leaves(_strip_index(pre_cache))[0].shape[1]
+        self._pool_cache = self._paged_admit_fn(b)(
+            self._pool_cache, pre_cache, jnp.int32(row),
+            jnp.asarray(pids_by_block))
+
+    def _table_row(self, pages: List[int]) -> np.ndarray:
+        row = np.zeros(self._nb_total, np.int32)
+        if pages:
+            row[:len(pages)] = pages
+        return row
+
+    def _tail_args(self, pos: np.ndarray, span: int):
+        """Per-slot tail blocks/pids for a program appending ``span``
+        positions starting at each slot's ``pos``: the at-most-two
+        blocks the appends can touch (span <= page, validated). Sentinel
+        rows (free slots) and unallocated blocks resolve to page 0 —
+        their writes land in the null page and are wiped. Host-side
+        numpy; two [n_slots, 2] int32 arrays per program call."""
+        nb, page = self._nb_total, self.page_tokens
+        b0 = np.clip(pos // page, 0, nb - 1)
+        b1 = np.clip((pos + span - 1) // page, 0, nb - 1)
+        blocks = np.stack([b0, b1], axis=1).astype(np.int32)
+        pids = np.take_along_axis(self._tables, blocks, axis=1)
+        sentinel = pos >= self.max_len
+        blocks[sentinel] = 0
+        pids[sentinel] = 0
+        return jnp.asarray(blocks), jnp.asarray(pids)
+
+    def _update_page_gauges(self) -> None:
+        if self.kv_metrics is not None and self._pool is not None:
+            self.kv_metrics.set_gauge("pages_in_use", self._pool.in_use)
 
     # ---- request lifecycle -------------------------------------------------
     def register_prefix(self, tokens) -> int:
@@ -912,6 +1373,22 @@ class ContinuousBatchingEngine:
             pid = self._next_prefix_id
             self._next_prefix_id += 1
             self._prefixes[pid] = (cache, lp)
+        if self._pool is not None:
+            # paged: ALSO write the prefix's full pages into the pool so
+            # admissions alias them (refcount, not copy). The partial
+            # tail block (positions [fb*page, lp)) stays only in the
+            # dense prefix cache — each fork writes its own fork page.
+            fb = lp // self.page_tokens
+            pids = (self._pool.alloc(fb) if fb else []) or []
+            if pids:
+                self.stats["pages_allocated"] += len(pids)
+                if self.kv_metrics is not None:
+                    self.kv_metrics.inc("page_allocs", len(pids))
+                row = np.zeros(self._nb_total, np.int32)
+                row[:fb] = pids
+                self._write_pages(cache, 0, row)
+            self._prefix_pages[pid] = pids
+            self._update_page_gauges()
         if self._draft is not None:
             # mirror the prefix through the draft so prefix-seeded
             # admissions can seed their draft rows too
@@ -936,14 +1413,22 @@ class ContinuousBatchingEngine:
         self._export_layout(_cache_nbytes(host))
         return host, lp
 
-    def import_prefix(self, cache, lp: int) -> int:
+    def import_prefix(self, cache, lp: int, base_pid: Optional[int] = None,
+                      base_len: int = 0) -> int:
         """Register an already-computed prefix KV (an ``export_prefix``
         host copy from a same-config engine) without running any prefill
         — a host→device copy instead of compute. Returns the new
         prefix id. No token content travels with an export, so a
         speculative engine cannot mirror it through the draft: requests
         using an imported prefix decode on the plain path (exact, just
-        unaccelerated)."""
+        unaccelerated).
+
+        Paged engines (``supports_page_alias``) additionally accept
+        ``base_pid``/``base_len``: when this prefix EXTENDS an already
+        registered ancestor of ``base_len`` positions, the ancestor's
+        full pages are aliased into the new prefix's page record instead
+        of re-written — a radix-store promote of a descendant prefix
+        moves page references, not bytes."""
         lp = int(lp)
         if lp < 1 or lp > self.max_len - 2:
             raise ValueError(f"prefix length {lp} does not fit under "
@@ -958,6 +1443,33 @@ class ContinuousBatchingEngine:
             pid = self._next_prefix_id
             self._next_prefix_id += 1
             self._prefixes[pid] = (device, lp)
+        if self._pool is not None:
+            fb = lp // self.page_tokens
+            aliased: List[int] = []
+            if base_pid is not None and 0 < base_len <= lp:
+                ab = min(base_len // self.page_tokens, fb)
+                src = self._prefix_pages.get(base_pid, [])
+                if ab and len(src) >= ab:
+                    aliased = self._alias_pages(src[:ab])
+            fresh_n = fb - len(aliased)
+            fresh = self._pool.alloc(fresh_n) if fresh_n else []
+            if fresh is None:
+                # pool exhausted: fall back to a page-less record — the
+                # prefix still works through its dense cache, admissions
+                # just write every block fresh
+                self._release_pages(aliased)
+                pids: List[int] = []
+            else:
+                if fresh:
+                    self.stats["pages_allocated"] += len(fresh)
+                    if self.kv_metrics is not None:
+                        self.kv_metrics.inc("page_allocs", len(fresh))
+                    row = np.zeros(self._nb_total, np.int32)
+                    row[len(aliased):fb] = fresh
+                    self._write_pages(device, 0, row)
+                pids = aliased + fresh
+            self._prefix_pages[pid] = pids
+            self._update_page_gauges()
         return pid
 
     def drop_prefix(self, prefix_id: int) -> bool:
@@ -967,6 +1479,12 @@ class ContinuousBatchingEngine:
         references the id."""
         if self._draft is not None:
             self._draft.drop_prefix(prefix_id)
+        if self._pool is not None:
+            # refcounted: slots still aliasing these pages keep them
+            # live until they retire — only the prefix's own reference
+            # drops here
+            self._release_pages(self._prefix_pages.pop(prefix_id, None))
+            self._update_page_gauges()
         with self._lock:
             return self._prefixes.pop(prefix_id, None) is not None
 
@@ -994,6 +1512,17 @@ class ContinuousBatchingEngine:
                 f"prefix {plen} + prompt {prompt.size} + new "
                 f"{max_new_tokens} exceeds the engine's max_len "
                 f"{self.max_len}")
+        if self._pool is not None:
+            # a request that alone outsizes the pool would stall the
+            # admission loop forever — reject at submission, typed
+            fresh = (self._pages_for_span(
+                plen + int(prompt.size) + max_new_tokens)
+                - plen // self.page_tokens)
+            if fresh > self._pool.capacity:
+                raise ValueError(
+                    f"request needs {fresh} fresh KV pages; the pool "
+                    f"holds {self._pool.capacity} (raise kv_pages or "
+                    f"shrink the request)")
         return prompt
 
     def submit(self, prompt, max_new_tokens: int,
@@ -1064,6 +1593,13 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"cached {handoff.pos} + remaining {remaining} exceeds "
                 f"the engine's max_len {self.max_len}")
+        if self._pool is not None:
+            fresh = (self._pages_for_span(handoff.pos + max(remaining, 0))
+                     - handoff.base // self.page_tokens)
+            if fresh > self._pool.capacity:
+                raise ValueError(
+                    f"handoff needs {fresh} fresh KV pages; the pool "
+                    f"holds {self._pool.capacity}")
         with self._lock:
             if self.queue_cap is not None:
                 inflight = self._inflight_locked()
@@ -1098,12 +1634,20 @@ class ContinuousBatchingEngine:
                 return None
             i, s = found
             pos, emitted = s.pos, tuple(s.emitted)
-        # trim to the 128-bucket of the live positions: the device→host
-        # copy, the checksum, and every hop downstream scale with the
-        # request, not with max_len (garbage past pos was never data)
+        # trim to the position bucket of the live positions: the
+        # device→host copy, the checksum, and every hop downstream scale
+        # with the request, not with max_len (garbage past pos was never
+        # data). Pages and buckets share the PAGE_TOKENS granule, so the
+        # paged gather ships exactly the bucket's worth of pages.
         pb = _bucket_len(pos, self.max_len)
-        row = jax.tree.map(
-            lambda leaf: np.asarray(leaf[:, i:i + 1, :pb]), self._cache)
+        if self._pool is not None:
+            nbp = pb // self.page_tokens
+            row = _host_leaves(self._paged_gather_fn(nbp)(
+                self._pool_cache, jnp.asarray(self._tables[i, :nbp])))
+        else:
+            row = jax.tree.map(
+                lambda leaf: np.asarray(leaf[:, i:i + 1, :pb]),
+                self._cache)
         self.stats["kv_exported"] += 1
         layout = self._export_layout(_cache_nbytes(row))
         return KVHandoff(cache=row, pos=pos, first_token=emitted[0],
@@ -1121,8 +1665,7 @@ class ContinuousBatchingEngine:
         """Prefill ``b`` same-bucket prompts in ONE program: prompts
         [b, bucket], per-row true lengths ``lps`` [b]; returns the [b]-row
         cache plus each row's first token (picked at its own lp-1)."""
-        fn = self._prefill_cache.get((bucket, b))
-        if fn is None:
+        def build():
             model = self._prefill_model
             shapes = cache_shapes(model, b)   # length set by max_len, not lp
             sp = self.sampling
@@ -1146,16 +1689,16 @@ class ContinuousBatchingEngine:
                 rows = jnp.arange(b)
                 return upd["cache"], _pick(logits[rows, lps - 1], key, sp)
 
-            fn = self._prefill_cache[(bucket, b)] = prefill
-        return fn
+            return prefill
+
+        return self._prefill_cache.get((bucket, b), build)
 
     def _suffix_prefill_fn(self, bucket: int):
         """Chunked prefill of a request's suffix into a prefix-seeded cache
         (cursor set to the prefix length, so the append lands after the
         prefix and the exact over-cache attention path serves every suffix
         query — it attends the prefix KV without recomputing it)."""
-        fn = self._suffix_prefill_cache.get(bucket)
-        if fn is None:
+        def build():
             from tpu_on_k8s.models.decode import _set_cursor
             model = self._prefill_model
             sp = self.sampling
@@ -1174,8 +1717,9 @@ class ContinuousBatchingEngine:
                     mutable=["cache"])
                 return upd["cache"], _pick(logits[0, slen - 1], key, sp)
 
-            fn = self._suffix_prefill_cache[bucket] = prefill
-        return fn
+            return prefill
+
+        return self._suffix_prefill_cache.get(bucket, build)
 
     #: batched-admission program sizes (largest that fits is used); a
     #: bounded set so (bucket, b) programs can't proliferate
@@ -1196,20 +1740,46 @@ class ContinuousBatchingEngine:
                         and i not in self._admitting]
                 if not free:
                     return
-                req = self._kv_queue.popleft()
+                req = self._kv_queue[0]
+                pages: Optional[List[int]] = None
+                fb = 0
+                if self._pool is not None:
+                    # eager reservation: the splice must never fail after
+                    # the request leaves the queue. A short pool stalls
+                    # the adoption (counted) until pages free up.
+                    h = req.handoff
+                    remaining = max(req.max_new_tokens - len(h.emitted), 0)
+                    alias = (self._prefix_alias_blocks(req.prefix_id,
+                                                       h.base)
+                             if h.base > 0 else [])
+                    fb = len(alias)
+                    fresh = self._alloc_pages(
+                        self._pages_for_span(h.pos + remaining) - fb)
+                    if fresh is None:
+                        return
+                    pages = self._alias_pages(alias) + fresh
+                self._kv_queue.popleft()
                 self._admitting.add(free[0])
             i = free[0]
             try:
-                self._adopt_into_slot(i, req)
+                self._adopt_into_slot(i, req, pages, fb)
+            except BaseException:
+                self._release_pages(pages)
+                raise
             finally:
                 with self._lock:
                     self._admitting.discard(i)
 
-    def _adopt_into_slot(self, i: int, req: _KVPending) -> None:
+    def _adopt_into_slot(self, i: int, req: _KVPending,
+                         pages: Optional[List[int]] = None,
+                         fb: int = 0) -> None:
         """Splice a handoff's KV into slot ``i`` and activate it. A
         suffix-only handoff lays its rows over the locally registered
         prefix's (identical bytes to what the prefill replica attended —
-        same params, same tokens, same compiled programs)."""
+        same params, same tokens, same compiled programs). Paged mode:
+        the leading ``fb`` entries of ``pages`` alias the prefix's full
+        pages (already counted); only fork + handoff blocks are
+        written."""
         h = req.handoff
         # reshard-on-import: a handoff from an UNLIKE mesh (or a
         # single-program prefill engine) carries the gathered full
@@ -1217,19 +1787,55 @@ class ContinuousBatchingEngine:
         device = (self._plan.put_cache(h.cache) if self._plan is not None
                   else jax.tree.map(jnp.asarray, h.cache))
         pb = jax.tree.leaves(device)[0].shape[2]
-        if h.base > 0:
-            prefix_cache = self._prefixes[req.prefix_id][0]
-            self._cache = self._admit(self._cache, prefix_cache,
-                                      jnp.int32(i), jnp.int32(h.base),
-                                      jnp.int32(0))
-        self._cache = self._admit_range_for(pb)(
-            self._cache, device, jnp.int32(i),
-            jnp.int32(h.base), jnp.int32(h.pos), jnp.int32(0))
+        if self._pool is not None:
+            nbp = self._pages_for_span(h.pos)
+            # stage a dense batch-1 row: prefix bytes below the fork
+            # (from the local dense prefix copy), handoff rows [base,
+            # pos) overlaid — then scatter only blocks [fb, nbp) into
+            # this slot's fresh pages
+            if h.base > 0:
+                staged_base = _strip_index(self._prefixes[req.prefix_id][0])
+            else:
+                staged_base = _strip_index(
+                    init_cache(self._prefill_model, 1))
+            base, pos = h.base, h.pos
+
+            def overlay(baseleaf, hleaf):
+                pad = baseleaf.shape[2] - hleaf.shape[2]
+                if pad > 0:
+                    hleaf = jnp.pad(
+                        hleaf, [(0, 0), (0, 0), (0, pad)]
+                        + [(0, 0)] * (hleaf.ndim - 3))
+                span = jnp.arange(baseleaf.shape[2]).reshape(
+                    (1, -1) + (1,) * (hleaf.ndim - 3))
+                keep = (span >= base) & (span < pos)
+                return jnp.where(keep, hleaf, baseleaf)
+
+            staged = jax.tree.map(overlay, staged_base, device)
+            pids_row = np.zeros(self._nb_total, np.int32)
+            for j in range(fb, nbp):
+                pids_row[j] = pages[j]
+            self._write_pages(staged, 0, pids_row)
+            self._tables[i] = self._table_row(pages)
+            self.stats["admit_copy_positions"] += ((nbp - fb)
+                                                   * self.page_tokens)
+            self._update_page_gauges()
+        else:
+            if h.base > 0:
+                prefix_cache = self._prefixes[req.prefix_id][0]
+                self._cache = self._admit(self._cache, prefix_cache,
+                                          jnp.int32(i), jnp.int32(h.base),
+                                          jnp.int32(0))
+            self._cache = self._admit_range_for(pb)(
+                self._cache, device, jnp.int32(i),
+                jnp.int32(h.base), jnp.int32(h.pos), jnp.int32(0))
+            self.stats["admit_copy_positions"] += h.pos
         with self._lock:
             self._slots[i] = _Slot(req.request_id, h.pos,
                                    int(h.emitted[-1]), list(h.emitted),
                                    req.max_new_tokens, req.eos_id,
-                                   req.submitted_at, req.on_token)
+                                   req.submitted_at, req.on_token,
+                                   pages=pages)
         # pre-emitted tokens are NOT re-fired or re-counted: the prefill
         # engine emitted them and the handoff's owner delivered them
         self.stats["admitted"] += 1
@@ -1269,6 +1875,25 @@ class ContinuousBatchingEngine:
                 if chunked and self._prefilling is not None:
                     return    # strict FIFO: one chunked prefill in flight
                 if chunked or prefix_cache is not None:
+                    head_pages: Optional[List[int]] = None
+                    fresh_from = 0
+                    if self._pool is not None:
+                        # eager reservation: pages for the whole span
+                        # [0, plen+prompt+max_new) are claimed BEFORE the
+                        # request leaves the queue, so admission can
+                        # never half-fail. Full prefix pages alias (CoW:
+                        # the fork block is always written fresh).
+                        alias = (self._prefix_alias_blocks(req.prefix_id,
+                                                           plen)
+                                 if prefix_cache is not None else [])
+                        fresh_from = len(alias)
+                        end = plen + int(req.prompt.size) \
+                            + req.max_new_tokens
+                        fresh = self._alloc_pages(
+                            self._pages_for_span(end) - fresh_from)
+                        if fresh is None:
+                            return    # pool short: stall, retry next step
+                        head_pages = self._alias_pages(alias) + fresh
                     self._queue.popleft()
                     if chunked:
                         # reserve under the lock: free_slots must never
@@ -1277,6 +1902,8 @@ class ContinuousBatchingEngine:
                     else:
                         self._admitting.add(free[0])
                     group = [req]
+                    group_pages = ([head_pages]
+                                   if head_pages is not None else None)
                 else:
                     # plain requests: batch the front FIFO run sharing
                     # this request's prompt bucket into ONE prefill
@@ -1300,6 +1927,28 @@ class ContinuousBatchingEngine:
                     b = max(s for s in self._ADMIT_BATCH_SIZES
                             if s <= min(len(group), len(free)))
                     group = group[:b]
+                    group_pages = None
+                    if self._pool is not None:
+                        # eager per-request reservation bounds the batch
+                        # by what the pool can actually hold
+                        group_pages = []
+                        for r in group:
+                            fresh = self._alloc_pages(
+                                self._pages_for_span(
+                                    int(r.prompt.size)
+                                    + r.max_new_tokens))
+                            if fresh is None:
+                                break
+                            group_pages.append(fresh)
+                        if not group_pages:
+                            return    # head stalled on the pool
+                        if len(group_pages) < len(group):
+                            b = max(s for s in self._ADMIT_BATCH_SIZES
+                                    if s <= len(group_pages))
+                            for pl in group_pages[b:]:
+                                self._release_pages(pl)
+                            group = group[:b]
+                            group_pages = group_pages[:b]
                     for _ in group:
                         self._queue.popleft()
                     self._admitting.update(free[:len(group)])
@@ -1312,9 +1961,12 @@ class ContinuousBatchingEngine:
                              else init_cache(self._prefill_model, 1))
                 self._prefilling = _Prefilling(
                     req, pre_cache, plen, plen,
-                    plen + int(req.prompt.size), self._clock())
+                    plen + int(req.prompt.size), self._clock(),
+                    pages=group_pages[0] if group_pages else None,
+                    fresh_from=fresh_from if group_pages else 0)
                 self._advance_prefill()
                 continue
+            unconsumed = list(group_pages) if group_pages else []
             try:
                 if prefix_cache is not None:
                     dequeued_at = self._clock()
@@ -1331,8 +1983,11 @@ class ContinuousBatchingEngine:
                         self._params, prefix_cache, jnp.asarray(padded),
                         jnp.int32(plen), jnp.int32(slen), key)
                     self.stats["prefill_positions"] += bucket
+                    pages = unconsumed.pop(0) if unconsumed else None
                     self._finish_admission(free[0], req, pre_cache, first,
-                                           plen + slen, dequeued_at)
+                                           plen + slen, dequeued_at,
+                                           pages=pages,
+                                           fresh_from=fresh_from)
                     continue
                 b = len(group)
                 dequeued_at = self._clock()
@@ -1347,12 +2002,16 @@ class ContinuousBatchingEngine:
                 self.stats["prefill_positions"] += bucket * b
                 firsts = np.asarray(firsts)
                 for j, (r, i) in enumerate(zip(group, free)):
+                    pages = unconsumed.pop(0) if unconsumed else None
                     self._finish_admission(i, r, pre_cache, firsts[j],
                                            int(lps[j]), dequeued_at,
-                                           row=j)
+                                           row=j, pages=pages)
             finally:
-                # a failing prefill must not leak reservations (success
-                # clears each slot in _finish_admission)
+                # a failing prefill must not leak reservations or pages
+                # (success clears each slot in _finish_admission and
+                # drains ``unconsumed`` as rows land)
+                for pl in unconsumed:
+                    self._release_pages(pl)
                 with self._lock:
                     self._admitting.difference_update(free)
 
@@ -1381,19 +2040,36 @@ class ContinuousBatchingEngine:
             # filled+reserved overlap UNDERcounts free_slots (safe for
             # admission control); the reverse order would overcount
             self._finish_admission(i, st.req, st.pre_cache, first,
-                                   st.total, st.dequeued_at)
+                                   st.total, st.dequeued_at,
+                                   pages=st.pages,
+                                   fresh_from=st.fresh_from)
             with self._lock:
                 self._reserved_slot = None
 
     def _finish_admission(self, i: int, req: _Pending, pre_cache, first,
                           lp: int, dequeued_at: float,
-                          row: int = 0) -> None:
+                          row: int = 0,
+                          pages: Optional[List[int]] = None,
+                          fresh_from: int = 0) -> None:
         """Copy row ``row`` of a prefilled cache into slot ``i`` and
         activate it; the first token (already sampled by the prefill
-        program) is emitted here."""
-        self._cache = self._admit(self._cache, pre_cache,
-                                  jnp.int32(i), jnp.int32(lp),
-                                  jnp.int32(row))
+        program) is emitted here. Paged mode scatters only the blocks
+        past ``fresh_from`` (aliased prefix pages are already live)."""
+        if self._pool is not None:
+            wb = self._pages_for_span(lp)
+            pids_row = np.zeros(self._nb_total, np.int32)
+            for j in range(fresh_from, wb):
+                pids_row[j] = pages[j]
+            self._write_pages(pre_cache, row, pids_row)
+            self._tables[i] = self._table_row(pages)
+            self.stats["admit_copy_positions"] += ((wb - fresh_from)
+                                                   * self.page_tokens)
+            self._update_page_gauges()
+        else:
+            self._cache = self._admit(self._cache, pre_cache,
+                                      jnp.int32(i), jnp.int32(lp),
+                                      jnp.int32(row))
+            self.stats["admit_copy_positions"] += lp
         first = int(first)   # host sync: the first token IS emitted now
         drafted = False
         if self._draft is not None:
@@ -1406,7 +2082,7 @@ class ContinuousBatchingEngine:
             self._slots[i] = _Slot(req.request_id, lp, first, [first],
                                    req.max_new_tokens, req.eos_id,
                                    req.submitted_at, req.on_token,
-                                   draft=drafted)
+                                   draft=drafted, pages=pages)
             self._admitting.discard(i)
         self._fire_on_token(self._slots[i], first)
         self.stats["admitted"] += 1
@@ -1448,6 +2124,10 @@ class ContinuousBatchingEngine:
             with self._lock:
                 self._finished[slot.request_id] = tokens
                 self._slots[i] = None
+            if self._pool is not None:
+                self._release_pages(slot.pages)
+                self._tables[i, :] = 0
+                self._update_page_gauges()
             if self.metrics is not None:
                 self.metrics.inc("requests_finished")
                 self.metrics.observe("request_latency_seconds",
@@ -1499,13 +2179,20 @@ class ContinuousBatchingEngine:
             st = self._prefilling
             if st is not None and st.req.request_id == request_id:
                 # drop the private prefill cache and the slot reservation;
-                # nothing reached the shared pool yet
+                # nothing reached the shared pool yet (reserved pages go
+                # straight back)
                 self._prefilling = None
                 self._reserved_slot = None
+                self._release_pages(st.pages)
+                self._update_page_gauges()
                 return np.zeros(0, np.int32)
             for i, s in enumerate(self._slots):
                 if s is not None and s.request_id == request_id:
                     self._slots[i] = None
+                    if self._pool is not None:
+                        self._release_pages(s.pages)
+                        self._tables[i, :] = 0
+                        self._update_page_gauges()
                     return np.asarray(s.emitted, np.int32)
         return None
 
@@ -1526,12 +2213,22 @@ class ContinuousBatchingEngine:
             if self._prefilling is not None:
                 lost.append(self._prefilling.req.request_id)
             lost += [s.request_id for s in self._slots if s is not None]
+            if self._pool is not None:
+                # per-request pages go back to the pool; registered
+                # prefixes keep theirs (they survive the crash too)
+                for s in self._slots:
+                    if s is not None:
+                        self._release_pages(s.pages)
+                if self._prefilling is not None:
+                    self._release_pages(self._prefilling.pages)
+                self._tables[:, :] = 0
             self._slots = [None] * self.n_slots
             self._queue.clear()
             self._kv_queue.clear()
             self._prefilling = None
             self._reserved_slot = None
             self._admitting.clear()
+        self._update_page_gauges()
         if self.metrics is not None:
             self.metrics.set_gauge("queue_depth", 0)
             self.metrics.set_gauge("slots_active", 0)
@@ -1573,9 +2270,16 @@ class ContinuousBatchingEngine:
                 toks[i] = self._slots[i].last_token
                 pos[i] = self._slots[i].pos
             self._rng, key = jax.random.split(self._rng)
-            self._cache, out = self._step(self._params, self._cache,
-                                          jnp.asarray(toks),
-                                          jnp.asarray(pos), key)
+            if self._pool is not None:
+                tb, tp = self._tail_args(pos, self.step_horizon)
+                self._pool_cache, out = self._step_paged(
+                    self._params, self._pool_cache,
+                    jnp.asarray(self._tables), jnp.asarray(toks),
+                    jnp.asarray(pos), tb, tp, key)
+            else:
+                self._cache, out = self._step(self._params, self._cache,
+                                              jnp.asarray(toks),
+                                              jnp.asarray(pos), key)
             out = np.asarray(out)               # [horizon, n_slots]
             self.stats["steps"] += self.step_horizon
             emitted_now = 0
@@ -1679,9 +2383,20 @@ class ContinuousBatchingEngine:
                 cpos[i] = s.pos + np.arange(k + 1, dtype=np.int32)
         # no rng split: spec mode is greedy-only by construction, so no
         # key is ever consumed (and degrade-to-plain stays greedy too)
-        self._cache, greedy = self._spec_verify(
-            self._params, self._cache, jnp.asarray(chunk),
-            jnp.asarray(cpos))
+        if self._pool is not None:
+            # the k+1 chunk spans ≤2 tail pages (spec_k+1 ≤ page,
+            # checked at construction); rejected proposals' KV lands in
+            # the slot's OWN tail pages, so rollback stays pure position
+            # bookkeeping even with aliased prefix pages below the fork
+            tb, tp = self._tail_args(cpos[:, 0], k + 1)
+            self._pool_cache, greedy = self._spec_verify_paged(
+                self._params, self._pool_cache,
+                jnp.asarray(self._tables), jnp.asarray(chunk),
+                jnp.asarray(cpos), tb, tp)
+        else:
+            self._cache, greedy = self._spec_verify(
+                self._params, self._cache, jnp.asarray(chunk),
+                jnp.asarray(cpos))
         greedy = np.asarray(greedy)                    # [n_slots, k+1]
         t2 = self._clock()
         self.stats["steps"] += 1
@@ -1784,11 +2499,12 @@ class ContinuousBatchingEngine:
     @property
     def kv_bytes_per_chip(self) -> int:
         """Slot-pool KV bytes per chip (kv-heads split over `model`,
-        slots over `data`); registered prefixes are charged separately
-        by the prefix store."""
+        slots — or pages — over `data`); registered prefixes are charged
+        separately by the prefix store."""
+        pool = self._pool_cache if self._pool is not None else self._cache
         if self._plan is not None:
-            return self._plan.bytes_per_chip(self._cache)
-        return _cache_nbytes(self._cache)
+            return self._plan.bytes_per_chip(pool)
+        return _cache_nbytes(pool)
 
     def shard_report(self) -> Dict[str, Any]:
         """One-line shard accounting for tools (`serve_load --shard`)
@@ -1802,7 +2518,9 @@ class ContinuousBatchingEngine:
             "param_bytes_per_chip": self.param_bytes_per_chip,
             "param_bytes_total": total_params,
             "kv_bytes_per_chip": self.kv_bytes_per_chip,
-            "kv_bytes_total": _cache_nbytes(self._cache),
+            "kv_bytes_total": _cache_nbytes(
+                self._pool_cache if self._pool is not None
+                else self._cache),
         }
 
 

@@ -81,7 +81,7 @@ from tpu_on_k8s.serve.admission import (
 )
 from tpu_on_k8s.serve.gateway import ReplayPolicy
 from tpu_on_k8s.serve.health import ReplicaState
-from tpu_on_k8s.serve.kvstore import FleetPrefixStore
+from tpu_on_k8s.serve.kvstore import PAGE_TOKENS, FleetPrefixStore
 from tpu_on_k8s.serve.lifecycle import (
     LIVE_STATES,
     RequestResult,
@@ -222,7 +222,7 @@ class DisaggFleet:
                  store: Optional[FleetPrefixStore] = None,
                  replay: Optional[ReplayPolicy] = None,
                  handoff_capacity: int = 16,
-                 prefix_bucket_len: int = 128,
+                 prefix_bucket_len: int = PAGE_TOKENS,
                  auto_register_prefixes: bool = True,
                  max_auto_prefixes: int = 64,
                  max_queue_depth: Optional[int] = None,

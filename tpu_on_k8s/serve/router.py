@@ -43,6 +43,7 @@ from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 import numpy as np
 
 from tpu_on_k8s.coordinator.policy import SmoothWRR
+from tpu_on_k8s.serve.kvstore import PAGE_TOKENS
 
 
 def _hash64(data: bytes) -> int:
@@ -55,7 +56,7 @@ class Router:
     own; the fleet serializes access under its lock, exactly as the
     gateway does with its scheduler."""
 
-    def __init__(self, prefix_bucket_len: int = 128, *,
+    def __init__(self, prefix_bucket_len: int = PAGE_TOKENS, *,
                  virtual_nodes: int = 64, spill_tokens: int = 1024,
                  mode: str = "affinity", seed: int = 0) -> None:
         if prefix_bucket_len < 1:
