@@ -40,6 +40,7 @@ from tpu_on_k8s.metrics.metrics import (
     ReshardMetrics,
     ServingMetrics,
     ShardMetrics,
+    SimMetrics,
     SLOMetrics,
     PagedKVMetrics,
     SpecMetrics,
@@ -538,11 +539,17 @@ def _populate(m):
         m.inc("decisions", 3, label="fleetautoscaler/default/svc|hold")
         m.inc("commit_failures")
         m.set_gauge("open_effect_horizons", 1.0)
+    elif isinstance(m, SimMetrics):
+        m.inc("events_processed", 1000)
+        m.inc("requests_simulated", 500)
+        m.set_gauge("virtual_seconds_simulated", 600.0)
+        m.set_gauge("wall_seconds", 0.5)
+        m.set_gauge("speedup", 1200.0)
 
 
 _ALL_CLASSES = (JobMetrics, ServingMetrics, SpecMetrics, PagedKVMetrics,
                 TrainMetrics, FleetMetrics, AutoscaleMetrics, ShardMetrics,
-                SLOMetrics, ReshardMetrics, LedgerMetrics)
+                SLOMetrics, ReshardMetrics, LedgerMetrics, SimMetrics)
 
 
 class TestExposition:

@@ -1,0 +1,269 @@
+"""Digital-twin subsystem tests (`tpu_on_k8s/sim/`).
+
+Three layers, cheapest first: the discrete-event kernel (`sim/clock`),
+the seeded traffic and virtual device layers, and one REAL smoke
+rehearsal (`scenario.smoke()`, ~10 virtual minutes in ~1 wall second)
+whose artifacts are held to the production contract — byte-identical
+replay, the unmodified report tools passing on the dumps, and every
+metrics-cited exemplar resolving into the span dump.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tpu_on_k8s.obs.dumpio import open_dump
+from tpu_on_k8s.sim.clock import EventLoop, SimClock
+from tpu_on_k8s.sim.devices import DeviceCostModel, SimFleet, SimRequest
+from tpu_on_k8s.sim.scenario import ChaosWindow, Scenario, smoke
+from tpu_on_k8s.sim.traffic import (DiurnalProfile, TenantMix,
+                                    build_diurnal_trace)
+from tpu_on_k8s.sim.twin import (LEDGER_FILE, SLO_FILE, SLO_FORMAT,
+                                 SUMMARY_FILE, TRACE_FILE, DigitalTwin,
+                                 run_twin)
+
+
+# ---------------------------------------------------------------- clock
+class TestEventLoop:
+    def test_orders_by_time_then_insertion(self):
+        loop = EventLoop(SimClock())
+        seen = []
+        loop.at(2.0, lambda: seen.append("b"))
+        loop.at(1.0, lambda: seen.append("a"))
+        loop.at(2.0, lambda: seen.append("c"))   # same t: insertion order
+        loop.run()
+        assert seen == ["a", "b", "c"]
+        assert loop.events_processed == 3
+
+    def test_past_scheduling_rejected(self):
+        loop = EventLoop(SimClock())
+        loop.at(5.0, lambda: loop.at(1.0, lambda: None))
+        with pytest.raises(ValueError):
+            loop.run()
+
+    def test_run_until_lands_clock_exactly(self):
+        clock = SimClock()
+        loop = EventLoop(clock)
+        loop.at(1.0, lambda: None)
+        loop.at(99.0, lambda: None)     # beyond the horizon: not run
+        loop.run(until=10.0)
+        assert clock.t == 10.0
+        assert loop.events_processed == 1
+
+    def test_every_respects_start_and_until(self):
+        clock = SimClock()
+        loop = EventLoop(clock)
+        ticks = []
+        loop.every(2.0, lambda: ticks.append(clock.t), start_at=0.0,
+                   until=6.0)
+        loop.run()
+        assert ticks == [0.0, 2.0, 4.0, 6.0]
+
+
+# -------------------------------------------------------------- traffic
+class TestDiurnalTrace:
+    def _build(self, seed=7):
+        rng = np.random.default_rng(seed)
+        return build_diurnal_trace(
+            rng,
+            profile=DiurnalProfile(base_rate=5.0, amplitude=0.5,
+                                   period_s=120.0, peak_at_s=60.0,
+                                   bursts=((30.0, 10.0, 3.0),)),
+            tenants=TenantMix(names=("a", "b"), weights=(3.0, 1.0)),
+            duration_s=120.0, tick_s=1.0,
+            prompt_lens=(4, 24), new_tokens=(4, 16))
+
+    def test_same_seed_same_trace(self):
+        t1, t2 = self._build(), self._build()
+        assert np.array_equal(t1.tenant, t2.tenant)
+        assert np.array_equal(t1.prompt_len, t2.prompt_len)
+        assert np.array_equal(t1.new_tokens, t2.new_tokens)
+
+    def test_ticks_partition_all_rows(self):
+        tr = self._build()
+        n = sum(len(tr.rows_for_tick(i)) for i in range(tr.n_ticks))
+        assert n == len(tr.tenant) > 0
+
+    def test_tenant_mix_weighted(self):
+        tr = self._build()
+        counts = np.bincount(tr.tenant, minlength=2)
+        assert counts[0] > counts[1] > 0    # 3:1 weights
+
+
+# -------------------------------------------------------------- devices
+class TestSimFleet:
+    def _fleet(self, **kw):
+        loop = EventLoop(SimClock())
+        cost = DeviceCostModel(step_s=0.1, compile_s=5.0, n_slots=2)
+        return loop, SimFleet(loop, cost=cost, replicas=1, **kw)
+
+    def test_timeline_priced_by_cost_model(self):
+        loop, fleet = self._fleet()
+        done = []
+        fleet.on_complete = lambda r: done.append(r) or None
+        req = SimRequest(0, "a", prompt_len=10, new_tokens=4, submit_t=0.0)
+        assert fleet.submit(req)
+        loop.run()
+        cost = fleet.cost
+        assert req.dispatch_t == 0.0
+        assert req.prefill_end_t == pytest.approx(cost.prefill_s(10))
+        assert req.first_token_t == pytest.approx(
+            req.prefill_end_t + cost.step_s)
+        assert req.finish_t == pytest.approx(
+            req.prefill_end_t + cost.decode_s(4))
+        assert done == [req] and fleet.served == 1
+
+    def test_scale_up_waits_for_compile(self):
+        loop, fleet = self._fleet()
+        fleet.scale_to(2)
+        assert fleet.size == 2 and fleet.ready_count == 1
+        loop.run()                           # compile_s elapses
+        assert fleet.ready_count == 2
+        assert fleet.stats["scale_ups"] == 1
+
+    def test_preempt_replays_inflight(self):
+        loop, fleet = self._fleet()
+        req = SimRequest(0, "a", 4, 50, submit_t=0.0)
+        fleet.submit(req)
+        name = req.replica
+        assert fleet.preempt_replica(name) == 1
+        assert req.replays == 1 and fleet.replayed == 1
+        fleet.scale_to(1)
+        loop.run()
+        assert fleet.served == 1             # replay completed once
+
+    def test_queue_depth_rejects(self):
+        loop, fleet = self._fleet(max_queue_depth=1)
+        for r in fleet.replicas.values():
+            r.routable = False               # force queueing
+        assert fleet.submit(SimRequest(0, "a", 4, 4, 0.0))
+        assert not fleet.submit(SimRequest(1, "a", 4, 4, 0.0))
+        assert fleet.rejected == 1
+
+
+# ------------------------------------------------------------- scenario
+class TestScenario:
+    def test_outage_window_compiles_to_tick_ordinals(self):
+        sc = smoke()
+        rules = sc.fault_rules()
+        assert len(rules) == 1
+        # smoke: outage at 120s for 15s, scrape every 5s -> ticks 24..26
+        assert rules[0].trigger.at == (24, 25, 26)
+
+    def test_unknown_chaos_kind_rejected(self):
+        with pytest.raises(ValueError):
+            ChaosWindow(at_s=1.0, kind="meteor")
+
+    def test_preempt_times_listed(self):
+        sc = smoke()
+        assert [t for t, _ in sc.preempt_times()] == [420.0]
+
+
+# ----------------------------------------------------------------- twin
+@pytest.fixture(scope="module")
+def smoke_runs(tmp_path_factory):
+    """One smoke rehearsal, twice (run A wall-clocked, run B pure), the
+    fixture every artifact-contract test shares."""
+    base = tmp_path_factory.mktemp("twin")
+    dir_a, dir_b = str(base / "a"), str(base / "b")
+    summary = run_twin(smoke(), dir_a, wall_clock=time.perf_counter)
+    run_twin(smoke(), dir_b)
+    return summary, dir_a, dir_b
+
+
+class TestTwinSmoke:
+    def test_accounting_closes(self, smoke_runs):
+        s, _, _ = smoke_runs
+        assert s["served"] == s["requests"] > 1000
+        assert s["rejected"] == 0
+        assert s["spans_dropped"] == 0
+
+    def test_story_beats(self, smoke_runs):
+        s, _, _ = smoke_runs
+        assert s["pages"] >= 1                  # the burst paged
+        assert s["budget_transitions"] >= 2     # ... and recovered
+        assert s["scale_ups"] >= 1
+        assert s["preemptions"] == 1
+        assert s["chaos_events"] >= 1           # scrape outage fired
+        assert s["train_final_workers"] == 4    # grow, regress, revert
+        assert s["train_frozen"] is True
+
+    def test_faster_than_real_time(self, smoke_runs):
+        s, _, _ = smoke_runs
+        assert s["perf"]["speedup"] > 100.0
+
+    def test_byte_identical_replay(self, smoke_runs):
+        import os
+        _, dir_a, dir_b = smoke_runs
+        for f in (TRACE_FILE, LEDGER_FILE, SLO_FILE, SUMMARY_FILE):
+            with open(os.path.join(dir_a, f), "rb") as fa, \
+                    open(os.path.join(dir_b, f), "rb") as fb:
+                assert fa.read() == fb.read(), f"{f} differs across runs"
+
+    def test_slo_format_matches_production(self):
+        from tools.slo_report import SLO_FORMAT as PROD_FORMAT
+        assert SLO_FORMAT == PROD_FORMAT
+
+    def test_production_reports_pass_unmodified(self, smoke_runs, capsys):
+        import os
+        from tools import slo_report, trace_report, why_report
+        _, dir_a, _ = smoke_runs
+        trace = os.path.join(dir_a, TRACE_FILE)
+        assert trace_report.main([trace, "--json"]) == 0
+        assert why_report.main([os.path.join(dir_a, LEDGER_FILE),
+                                "--trace", trace, "--check"]) == 0
+        assert slo_report.main([os.path.join(dir_a, SLO_FILE),
+                                "--check"]) == 0
+        capsys.readouterr()
+
+    def test_page_exemplars_resolve_in_trace(self, smoke_runs):
+        import os
+        from tpu_on_k8s.obs.export import load_trace
+        _, dir_a, _ = smoke_runs
+        spans = load_trace(os.path.join(dir_a, TRACE_FILE))
+        ids = {s["trace"] for s in spans}
+        with open_dump(os.path.join(dir_a, SLO_FILE)) as f:
+            doc = json.load(f)
+        assert doc["pages"]
+        for page in doc["pages"]:
+            assert page["exemplars"]
+            for _v, tid in page["exemplars"]:
+                assert tid in ids
+
+    def test_gzip_dumps_roundtrip(self, smoke_runs):
+        import os
+        _, dir_a, _ = smoke_runs
+        with open_dump(os.path.join(dir_a, TRACE_FILE)) as f:
+            doc = json.load(f)
+        assert doc["spans"]
+
+
+class TestTwinSampling:
+    def _tiny(self, sample_every):
+        return Scenario(
+            name="tiny", seed=11, duration_s=60.0, tick_s=0.5,
+            profile=DiurnalProfile(base_rate=8.0, amplitude=0.0,
+                                   period_s=60.0, peak_at_s=0.0),
+            cost=DeviceCostModel(step_s=0.05, compile_s=5.0, n_slots=8),
+            slo_window_s=30.0, train_workers=0,
+            sample_every=sample_every)
+
+    def test_sampling_sheds_spans_but_never_citations(self):
+        full = DigitalTwin(self._tiny(1))
+        full.run()
+        sampled = DigitalTwin(self._tiny(4))
+        sampled.run()
+        assert full.summary["served"] == sampled.summary["served"] > 0
+        assert full.tracer.sampled_out == 0
+        assert sampled.tracer.sampled_out > 0
+        assert len(sampled.tracer.spans) < len(full.tracer.spans)
+        # every exemplar the metrics retained must exist in the dump
+        ids = {s.trace_id for s in sampled.tracer.spans}
+        for rep in sampled.fleet.replicas.values():
+            for _v, tid in rep.metrics.exemplars[
+                    "time_to_first_token_seconds"]:
+                if tid is not None:
+                    assert tid in ids

@@ -43,74 +43,10 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tpu_on_k8s.serve.kvstore import PAGE_TOKENS  # noqa: E402
-
-
-@dataclasses.dataclass
-class Arrival:
-    """One scheduled request of the trace."""
-
-    step: int
-    tenant: str
-    prompt: np.ndarray
-    max_new_tokens: int
-    priority: int = 0
-    deadline_s: Optional[float] = None
-
-
-def build_workload(rng: np.random.Generator, n_requests: int, *,
-                   rate: float = 2.0,
-                   prompt_lens: Sequence[int] = (4, 24),
-                   new_tokens: Sequence[int] = (4, 16),
-                   tenants: Sequence[str] = ("tenant-a", "tenant-b",
-                                             "tenant-c"),
-                   vocab_size: int = 256,
-                   deadline_s: Optional[float] = None,
-                   deadline_fraction: float = 0.0,
-                   shared_prefixes: int = 0,
-                   shared_prefix_len: int = 0,
-                   shared_fraction: float = 0.0,
-                   burst_start: int = 0,
-                   burst_len: int = 0,
-                   burst_rate: float = 0.0) -> List[Arrival]:
-    """A reproducible trace: Poisson(``rate``) arrivals per engine step
-    (the seeded ``rng`` is passed IN — the caller owns determinism), mixed
-    uniform prompt/output lengths, tenants round-tripped through the same
-    rng. ``deadline_fraction`` of requests carry ``deadline_s``. With
-    ``shared_prefixes`` > 0, ``shared_fraction`` of requests prepend one
-    of that many fixed ``shared_prefix_len``-token prefixes (the
-    system-prompt shape real traffic has — what the fleet router's prefix
-    affinity exists to exploit; fully independent prompts would leave
-    that path structurally cold). With ``burst_len`` > 0, steps in
-    ``[burst_start, burst_start + burst_len)`` arrive at ``burst_rate``
-    instead of ``rate`` — the bursty trace the SLO autoscaler's reactive
-    loop is measured against."""
-    pool = [rng.integers(0, vocab_size,
-                         size=shared_prefix_len).astype(np.int32)
-            for _ in range(shared_prefixes)] if shared_prefix_len else []
-    arrivals: List[Arrival] = []
-    step = 0
-    while len(arrivals) < n_requests:
-        step_rate = (burst_rate if burst_len > 0
-                     and burst_start <= step < burst_start + burst_len
-                     else rate)
-        for _ in range(min(int(rng.poisson(step_rate)),
-                           n_requests - len(arrivals))):
-            lp = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
-            prompt = rng.integers(0, vocab_size, size=lp).astype(np.int32)
-            if pool and rng.random() < shared_fraction:
-                prompt = np.concatenate(
-                    [pool[int(rng.integers(len(pool)))], prompt])
-            arrivals.append(Arrival(
-                step=step,
-                tenant=str(tenants[int(rng.integers(len(tenants)))]),
-                prompt=prompt,
-                max_new_tokens=int(rng.integers(new_tokens[0],
-                                                new_tokens[1] + 1)),
-                deadline_s=(deadline_s
-                            if deadline_s is not None
-                            and rng.random() < deadline_fraction else None)))
-        step += 1
-    return arrivals
+# The seeded generator moved to the sim package (the digital twin shares
+# it); re-exported here so every existing import site and seeded trace
+# replays unchanged, byte for byte.
+from tpu_on_k8s.sim.traffic import Arrival, build_workload  # noqa: E402,F401
 
 
 def _make_tracer(args, clock):

@@ -31,13 +31,15 @@ from typing import Any, Dict, List, Optional
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tools.trace_report import SEGMENTS, decompose  # noqa: E402
+from tpu_on_k8s.obs.dumpio import open_dump  # noqa: E402
 from tpu_on_k8s.obs.export import load_trace  # noqa: E402
 
 SLO_FORMAT = "tpu-on-k8s-slo/v1"
 
 
 def load_slo(path: str) -> Dict[str, Any]:
-    with open(path) as f:
+    """Read an SLO budget dump, ``.json`` or ``.json.gz``."""
+    with open_dump(path) as f:
         doc = json.load(f)
     if doc.get("format") != SLO_FORMAT:
         raise ValueError(f"{path}: not a {SLO_FORMAT} dump "
@@ -142,6 +144,12 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     slo = load_slo(args.slo)
     trace_path = args.trace or slo.get("trace_file")
+    if trace_path and not args.trace and not os.path.isabs(trace_path):
+        # a relative trace_file names a sibling of the slo dump (what
+        # the digital twin writes, so its artifact set relocates and
+        # byte-compares); absolute paths pass through untouched
+        trace_path = os.path.join(os.path.dirname(os.path.abspath(
+            args.slo)), trace_path)
     spans = load_trace(trace_path) if trace_path else None
     report = build_join(slo, spans)
     if args.json:

@@ -728,6 +728,30 @@ class LedgerMetrics(_MetricsBase):
             g.set(value)
 
 
+class SimMetrics(_MetricsBase):
+    """Digital-twin observability (`tpu_on_k8s/sim/twin.py`): how much
+    virtual time the event loop covered, how many events and requests it
+    processed, and — when the driver injects a wall clock
+    (`tools/twin_soak.py` passes ``time.perf_counter``; the twin itself
+    never reads wall time, per the determinism gate) — the wall seconds
+    spent and the ``speedup`` gauge (virtual/wall), the >1000x headline
+    the twin-soak acceptance asserts. Same prometheus + plain-dict
+    mirror pattern as the other classes."""
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        if _prom is not None:
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_sim"
+        for name in ("events_processed", "requests_simulated"):
+            self._declare(name, f"{ns}_{name}", "counter",
+                          f"Digital twin {name}")
+        for name in ("virtual_seconds_simulated", "wall_seconds",
+                     "speedup"):
+            self._declare(name, f"{ns}_{name}", "gauge",
+                          f"Digital twin {name}")
+
+
 def count_detached_callback(metrics, message: str) -> None:
     """The count-and-warn tail shared by every streaming-callback
     isolation site (engine ``on_token``/``on_retire``, gateway and

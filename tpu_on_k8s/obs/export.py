@@ -82,9 +82,10 @@ def dump_chrome_trace(spans: Iterable, path: str, *,
 
 def load_trace(path: str) -> List[Dict[str, Any]]:
     """Read a ``Tracer.dump`` file back into span dicts (what
-    `tools/trace_report.py` consumes); raises ``ValueError`` on a file
-    that is not a trace dump."""
-    with open(path) as f:
+    `tools/trace_report.py` consumes), ``.json`` or ``.json.gz``;
+    raises ``ValueError`` on a file that is not a trace dump."""
+    from tpu_on_k8s.obs.dumpio import open_dump
+    with open_dump(path) as f:
         doc = json.load(f)
     if not isinstance(doc, dict) or doc.get("format") != TRACE_FORMAT:
         raise ValueError(f"{path} is not a {TRACE_FORMAT} dump")

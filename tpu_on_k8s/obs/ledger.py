@@ -318,22 +318,25 @@ class DecisionLedger:
         them). ``extra`` carries the sibling logs `tools/why_report.py`
         joins against (per-service SLO event logs, the chaos injector's
         sequence-stamped event log). File I/O happens outside the
-        ledger lock."""
+        ledger lock. A ``.gz`` path gzips deterministically
+        (`obs/dumpio.py`)."""
+        from tpu_on_k8s.obs.dumpio import open_dump
         doc: Dict[str, Any] = {"format": LEDGER_FORMAT,
                                "dropped": self.dropped,
                                "records": self.export()}
         if extra:
             doc.update(extra)
-        with open(path, "w") as f:
+        with open_dump(path, "w") as f:
             json.dump(doc, f, sort_keys=True, separators=(",", ":"))
             f.write("\n")
 
 
 def load_ledger(path: str) -> Dict[str, Any]:
     """Read a ``DecisionLedger.dump`` file back (the whole doc — records
-    plus any embedded sibling logs); raises ``ValueError`` on a file
-    that is not a ledger dump."""
-    with open(path) as f:
+    plus any embedded sibling logs, ``.json`` or ``.json.gz``); raises
+    ``ValueError`` on a file that is not a ledger dump."""
+    from tpu_on_k8s.obs.dumpio import open_dump
+    with open_dump(path) as f:
         doc = json.load(f)
     if not isinstance(doc, dict) or doc.get("format") != LEDGER_FORMAT:
         raise ValueError(f"{path} is not a {LEDGER_FORMAT} dump")

@@ -44,7 +44,8 @@ import contextlib
 import json
 import threading
 import time
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import (Any, Callable, Dict, Iterator, List, Optional,
+                    Sequence)
 
 #: terminal statuses a span may carry; anything else is treated as a
 #: domain-specific status string (e.g. a RequestState value)
@@ -79,10 +80,16 @@ class Span:
         self.attrs.update(attrs)
         return self
 
-    def event(self, name: str, /, **attrs: Any) -> "Span":
+    def event(self, name: str, /, at: Optional[float] = None,
+              **attrs: Any) -> "Span":
         """A point-in-time marker on this span's timeline (first token,
-        chaos injection, replay decision)."""
-        ev: Dict[str, Any] = {"name": name, "t": self._tracer.clock()}
+        chaos injection, replay decision). ``at`` backdates the marker —
+        the digital twin mints a request's whole span tree at its
+        completion event, stamping each point from the virtual timeline
+        it already computed."""
+        ev: Dict[str, Any] = {"name": name,
+                              "t": self._tracer.clock() if at is None
+                              else at}
         if attrs:
             ev["attrs"] = attrs
         self.events.append(ev)
@@ -154,7 +161,8 @@ class _NoopSpan:
     def set(self, **attrs: Any) -> "_NoopSpan":
         return self
 
-    def event(self, name: str, /, **attrs: Any) -> "_NoopSpan":
+    def event(self, name: str, /, at: Optional[float] = None,
+              **attrs: Any) -> "_NoopSpan":
         return self
 
     def finish(self, status: str = STATUS_OK,
@@ -186,9 +194,15 @@ class _NoopTracer:
     def clock(self) -> float:
         return 0.0
 
-    def start(self, name: str, /, parent: Any = None, **attrs: Any
-              ) -> _NoopSpan:
+    def start(self, name: str, /, parent: Any = None,
+              at: Optional[float] = None, **attrs: Any) -> _NoopSpan:
         return NOOP_SPAN
+
+    def keep(self, span: Any) -> None:
+        return None
+
+    def is_sampled(self, trace_id: int) -> bool:
+        return False
 
     @contextlib.contextmanager
     def span(self, name: str, /, parent: Any = None, **attrs: Any
@@ -224,38 +238,67 @@ class Tracer:
     ``max_spans`` bounds retention: a long-lived server must not grow an
     unbounded span list — past the cap, finished spans still feed the
     flight recorder's ring (which is the crash artifact) but are dropped
-    from the export list, and ``dropped`` counts them."""
+    from the export list, and ``dropped`` counts them.
+
+    ``sample_every`` is the head-sampling knob a million-request twin
+    run needs: keep every Nth root whose name is in ``sample_names``
+    (and its whole trace); shed the rest at collect time, counted by
+    ``sampled_out``. ``keep(span)`` pins a trace regardless of the
+    sample phase — the twin pins SLO-breaching and chaos-adjacent
+    traces so every exemplar a page cites still resolves in the dump.
+    Sampling decides *retention only*: ids and clock reads are
+    allocated identically either way, so a sampled run's kept spans are
+    byte-identical to the same spans of an unsampled run, and the
+    default (``sample_every=1``) is exactly the pre-knob tracer."""
 
     enabled = True
 
     def __init__(self, clock: Callable[[], float] = time.monotonic, *,
                  recorder=None, service: str = "tpu-on-k8s",
-                 max_spans: int = 200_000) -> None:
+                 max_spans: int = 200_000, sample_every: int = 1,
+                 sample_names: Sequence[str] = ("request",)) -> None:
         if max_spans < 1:
             raise ValueError(f"max_spans must be >= 1, got {max_spans}")
+        if sample_every < 1:
+            raise ValueError(
+                f"sample_every must be >= 1, got {sample_every}")
         self.clock = clock
         self.service = service
         self.recorder = recorder
         self.max_spans = max_spans
+        self.sample_every = int(sample_every)
+        self.sample_names = tuple(sample_names)
         self.spans: List[Span] = []       # finished spans, in finish order
         self.dropped = 0
+        self.sampled_out = 0              # spans shed by the sampling knob
         self._lock = threading.Lock()
         self._next_id = 1
+        self._sampled_roots = 0           # roots subject to the knob so far
+        self._unsampled: set = set()      # live trace ids being shed
 
     # ---------------------------------------------------------------- spans
     def start(self, name: str, /, parent: Optional[Span] = None,
-              **attrs: Any) -> Span:
+              at: Optional[float] = None, **attrs: Any) -> Span:
         """Begin a span. With ``parent`` the new span joins its trace;
         without, it roots a new trace whose id IS the span id (counter-
-        derived — no uuid, no wall clock)."""
+        derived — no uuid, no wall clock). ``at`` backdates the start
+        (the twin mints finished timelines); id allocation and the
+        sampling decision are unaffected by it."""
         with self._lock:
             sid = self._next_id
             self._next_id += 1
-        if parent is not None and parent.trace_id:
+            root = parent is None or not parent.trace_id
+            if root and self.sample_every > 1 \
+                    and name in self.sample_names:
+                self._sampled_roots += 1
+                if (self._sampled_roots - 1) % self.sample_every != 0:
+                    self._unsampled.add(sid)
+        if not root:
             tid, pid = parent.trace_id, parent.span_id
         else:
             tid, pid = sid, None
-        return Span(self, name, tid, sid, pid, self.clock(), dict(attrs))
+        return Span(self, name, tid, sid, pid,
+                    self.clock() if at is None else at, dict(attrs))
 
     @contextlib.contextmanager
     def span(self, name: str, /, parent: Optional[Span] = None,
@@ -270,8 +313,32 @@ class Tracer:
             raise
         sp.finish()
 
+    def keep(self, span) -> None:
+        """Pin a trace through the sampling knob: the SLO-page /
+        chaos-adjacent escape hatch. Accepts a span or a trace id; must
+        be called before the trace's spans finish (shed spans are gone,
+        not resurrectable). No-op when the trace is already kept."""
+        tid = span if isinstance(span, int) else span.trace_id
+        with self._lock:
+            self._unsampled.discard(tid)
+
+    def is_sampled(self, trace_id: int) -> bool:
+        """False while the sampling knob is shedding this trace — the
+        gate exemplar emission sits behind, so metrics never cite a
+        trace id the dump will not contain."""
+        with self._lock:
+            return trace_id not in self._unsampled
+
     def _collect(self, span: Span) -> None:
         with self._lock:
+            if span.trace_id in self._unsampled:
+                self.sampled_out += 1
+                if span.span_id == span.trace_id:
+                    # the root is the last word on its trace: once it
+                    # collects, drop the shed-set entry so memory stays
+                    # bounded by LIVE traces, not all traces ever shed
+                    self._unsampled.discard(span.trace_id)
+                return
             if len(self.spans) < self.max_spans:
                 self.spans.append(span)
             else:
@@ -292,10 +359,13 @@ class Tracer:
     def dump(self, path: str) -> None:
         """Write the canonical trace file. ``sort_keys`` + fixed
         separators + no wall-clock metadata: two seeded runs produce
-        byte-identical files (`make trace-demo` byte-compares them)."""
+        byte-identical files (`make trace-demo` byte-compares them).
+        A ``.gz`` path gzips deterministically (`obs/dumpio.py`) — the
+        compressed bytes stay a pure function of the spans."""
+        from tpu_on_k8s.obs.dumpio import open_dump
         doc = {"format": TRACE_FORMAT, "service": self.service,
                "dropped": self.dropped, "spans": self.export()}
-        with open(path, "w") as f:
+        with open_dump(path, "w") as f:
             json.dump(doc, f, sort_keys=True, separators=(",", ":"))
             f.write("\n")
 

@@ -1,0 +1,68 @@
+"""Whole-cluster digital twin: a deterministic cluster-in-a-process
+simulator (ROADMAP item 2, second half).
+
+The kernel half landed first: every control loop is a `LoopKernel`
+subclass with an injectable clock, one `DecisionLedger`, byte-identical
+seeded replays. This package is the other half — the thing those
+injection seams exist *for*. It stands a virtual device layer in for
+TPU slices (VirtualFlow's decoupling move, PAPERS.md) and drives the
+REAL control plane — `FleetAutoscaler`, `ElasticAutoscaler`,
+`SLOEngine`, the `tpujob`/`inferenceservice` reconcilers — against
+seeded million-request, multi-tenant, diurnal traffic on one shared
+virtual clock, at >1000x real time.
+
+Layout (each module's docstring carries its own contract):
+
+* `clock`    — `SimClock` + the discrete-event `EventLoop` that advances
+  the clock to the next due event instead of ticking fixed periods (this
+  is what buys the >1000x).
+* `traffic`  — the seeded generators. `build_workload`/`Arrival` moved
+  here verbatim from `tools/serve_load.py` (which re-imports them);
+  `build_diurnal_trace` is the vectorized million-scale variant.
+* `devices`  — the virtual device/slice layer: per-replica slot
+  capacity, compile/prefill/decode latencies priced by the same cost
+  model constants `serve_load`'s virtual modes use, preemption.
+* `scenario` — the scenario DSL: traffic phases + chaos schedules
+  compiled onto the existing `FaultRule` machinery (no new chaos
+  sites), plus the seeded presets `make twin-soak` runs.
+* `twin`     — the harness wiring InMemoryCluster, reconcilers,
+  autoscalers, SLO engines, tracer, and ledger together and emitting
+  the SAME dump formats as production, so `trace_report`, `why_report`,
+  and `slo_report` run unmodified on twin output.
+
+Determinism contract: everything observable is a pure function of the
+scenario seed. Wall-clock only ever enters through the *injected*
+``wall_clock`` callable (the `tools/twin_soak.py` driver passes
+``time.perf_counter``; the default is "no wall timing") and lands only
+in the perf side-channel, never in byte-compared artifacts.
+"""
+from tpu_on_k8s.sim.clock import EventLoop, SimClock
+from tpu_on_k8s.sim.devices import (DeviceCostModel, SimFleet, SimReplica,
+                                    SimRequest)
+from tpu_on_k8s.sim.scenario import ChaosWindow, Scenario, million_diurnal, smoke
+from tpu_on_k8s.sim.traffic import (Arrival, ArrivalTrace, DiurnalProfile,
+                                    TenantMix, build_diurnal_trace,
+                                    build_workload, diurnal_rate)
+from tpu_on_k8s.sim.twin import DigitalTwin, run_twin
+
+__all__ = [
+    "Arrival",
+    "ArrivalTrace",
+    "ChaosWindow",
+    "DeviceCostModel",
+    "DigitalTwin",
+    "DiurnalProfile",
+    "EventLoop",
+    "Scenario",
+    "SimClock",
+    "SimFleet",
+    "SimReplica",
+    "SimRequest",
+    "TenantMix",
+    "build_diurnal_trace",
+    "build_workload",
+    "diurnal_rate",
+    "million_diurnal",
+    "run_twin",
+    "smoke",
+]

@@ -1,0 +1,199 @@
+"""Scenario DSL: one frozen description of everything a twin run does.
+
+A `Scenario` composes the traffic phases (`sim/traffic.DiurnalProfile`
+curve + burst windows), the virtual hardware (`sim/devices.DeviceCostModel`),
+the serving control-plane knobs (autoscale band, SLO objective and its
+scaled burn windows — the same ``window/60`` … ``window/4`` ratios
+`serve_load --autoscale-slo` uses), the elastic-training side, and a
+chaos schedule. Chaos windows are declared in VIRTUAL TIME and compiled
+onto the existing `chaos/injector.FaultRule` machinery, which triggers
+by site-hit ordinal: the autoscaler fires ``SITE_AUTOSCALE_SIGNAL``
+exactly once per service tick, so a window ``[at_s, at_s+duration_s)``
+maps to the tick ordinals inside it — no new chaos sites (the
+``SITE_REGISTRY`` gate stays untouched), no new trigger semantics.
+
+``replica_preempt`` windows have no production chaos site (the device
+layer is the twin's own); the twin schedules `SimFleet.preempt_replica`
+directly at ``at_s`` and logs it into the same chaos event list.
+
+Presets: `smoke()` is the seconds-scale tier-1 scenario;
+`million_diurnal()` is the 24-virtual-hour ≥1M-request acceptance
+scenario `make twin-soak` replays twice and byte-compares.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Tuple
+
+from tpu_on_k8s.chaos import (SITE_AUTOSCALE_SIGNAL, FaultRule,
+                              SignalOutage, Trigger)
+from tpu_on_k8s.sim.devices import DeviceCostModel
+from tpu_on_k8s.sim.traffic import DiurnalProfile, TenantMix
+
+CHAOS_SIGNAL_OUTAGE = "signal_outage"
+CHAOS_REPLICA_PREEMPT = "replica_preempt"
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosWindow:
+    """One chaos phase, in virtual time. ``kind`` is
+    ``signal_outage`` (the autoscaler's scrape goes dark for
+    ``duration_s``) or ``replica_preempt`` (the highest-named live
+    replica is killed at ``at_s``; duration ignored)."""
+
+    at_s: float
+    kind: str = CHAOS_SIGNAL_OUTAGE
+    duration_s: float = 0.0
+    note: str = ""
+
+    def __post_init__(self):
+        if self.kind not in (CHAOS_SIGNAL_OUTAGE, CHAOS_REPLICA_PREEMPT):
+            raise ValueError(f"unknown chaos kind {self.kind!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """The whole rehearsal, declaratively. Everything downstream —
+    trace, ledger, budget log, summary — is a pure function of this
+    object plus its ``seed``."""
+
+    name: str
+    seed: int
+    duration_s: float
+    profile: DiurnalProfile
+    tenants: TenantMix = TenantMix()
+    prompt_lens: Tuple[int, int] = (4, 24)
+    new_tokens: Tuple[int, int] = (4, 16)
+    tick_s: float = 1.0
+    cost: DeviceCostModel = DeviceCostModel()
+
+    # serving control plane
+    min_replicas: int = 2
+    max_replicas: int = 8
+    min_warm: int = 0
+    target_ttft_s: float = 0.5
+    slo_ttft_s: float = 0.6
+    slo_window_s: float = 600.0
+    scrape_period_s: float = 5.0
+    reconcile_period_s: float = 15.0
+    max_queue_depth: int = 50_000
+    max_step: int = 2
+    up_cooldown_s: float = 60.0
+    down_cooldown_s: float = 600.0
+    flap_guard_s: float = 30.0
+
+    # elastic training side (0 workers disables it); the latency plan
+    # maps worker count -> the [elastic-metrics] latency the virtual job
+    # reports at that size, scripting the grow/grow/regress-freeze story
+    train_workers: int = 2
+    train_topology: str = "2x4"
+    train_max_hosts: int = 8
+    train_obs_period_s: float = 30.0
+    train_scale_period_s: float = 60.0
+    train_latency_plan: Tuple[Tuple[int, float], ...] = (
+        (2, 1.0), (4, 0.6), (8, 2.0))
+
+    # chaos
+    chaos: Tuple[ChaosWindow, ...] = ()
+
+    # tracer retention knob for the run (1 = keep everything)
+    sample_every: int = 1
+
+    def __post_init__(self):
+        if self.duration_s <= 0 or self.tick_s <= 0:
+            raise ValueError("duration_s and tick_s must be > 0")
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError("need 1 <= min_replicas <= max_replicas")
+
+    # ---------------------------------------------------------- compilation
+    def signal_tick_of(self, at_s: float) -> int:
+        """The 1-based ``SITE_AUTOSCALE_SIGNAL`` hit ordinal of the
+        service tick at or after virtual time ``at_s`` (ticks fire at
+        ``scrape_period_s, 2*scrape_period_s, …``)."""
+        return max(1, int(math.ceil(at_s / self.scrape_period_s)))
+
+    def fault_rules(self) -> List[FaultRule]:
+        """Compile the ``signal_outage`` windows onto the production
+        FaultRule machinery (see module doc for the time→ordinal map)."""
+        rules: List[FaultRule] = []
+        for w in self.chaos:
+            if w.kind != CHAOS_SIGNAL_OUTAGE:
+                continue
+            first = self.signal_tick_of(w.at_s)
+            last = max(first, self.signal_tick_of(w.at_s + w.duration_s) - 1)
+            rules.append(FaultRule(
+                SITE_AUTOSCALE_SIGNAL,
+                Trigger(at=tuple(range(first, last + 1))),
+                SignalOutage(),
+                note=w.note or f"{self.name}:outage@{w.at_s:g}s"))
+        return rules
+
+    def preempt_times(self) -> List[Tuple[float, str]]:
+        """(virtual time, note) of every ``replica_preempt`` window."""
+        return [(w.at_s, w.note or f"{self.name}:preempt@{w.at_s:g}s")
+                for w in self.chaos if w.kind == CHAOS_REPLICA_PREEMPT]
+
+
+# ---------------------------------------------------------------- presets
+def smoke(seed: int = 2468) -> Scenario:
+    """The tier-1 smoke scenario: ~10 virtual minutes, a few thousand
+    requests, one burst that pages the TTFT budget and scales the fleet,
+    a mid-burst signal outage, and one replica preemption — every twin
+    mechanism exercised in well under a wall second."""
+    return Scenario(
+        name="smoke",
+        seed=seed,
+        duration_s=600.0,
+        tick_s=0.25,
+        profile=DiurnalProfile(base_rate=6.0, amplitude=0.3,
+                               period_s=600.0, peak_at_s=300.0,
+                               bursts=((180.0, 90.0, 6.0),)),
+        cost=DeviceCostModel(step_s=0.05, compile_s=20.0, n_slots=8),
+        min_replicas=2, max_replicas=8,
+        # window << duration: the burst must SLIDE OUT of the budget
+        # window before the run ends, or the budget stays exhausted and
+        # the why-chain never closes with burn_recovered
+        target_ttft_s=0.5, slo_ttft_s=0.6, slo_window_s=150.0,
+        scrape_period_s=5.0, flap_guard_s=20.0,
+        train_obs_period_s=20.0, train_scale_period_s=40.0,
+        chaos=(ChaosWindow(at_s=120.0, kind=CHAOS_SIGNAL_OUTAGE,
+                           duration_s=15.0, note="smoke:scrape-dark"),
+               ChaosWindow(at_s=420.0, kind=CHAOS_REPLICA_PREEMPT,
+                           note="smoke:preempt")),
+    )
+
+
+def million_diurnal(seed: int = 97) -> Scenario:
+    """The acceptance scenario: 24 virtual hours, ≥1M requests across
+    three tenants on a diurnal curve, two flash-crowd bursts (the
+    second one pages the budget and forces an urgent scale-up whose
+    burn recovery closes the why-chain), a scrape outage riding the
+    first burst, and an afternoon replica preemption. 1-in-64 trace
+    sampling keeps the span dump at report scale; breach/chaos traces
+    are pinned, so every cited exemplar still resolves."""
+    return Scenario(
+        name="million_diurnal",
+        seed=seed,
+        duration_s=86_400.0,
+        tick_s=0.25,
+        profile=DiurnalProfile(
+            base_rate=12.5, amplitude=0.6, period_s=86_400.0,
+            peak_at_s=0.6 * 86_400.0,
+            bursts=((4.0 * 3600.0, 1200.0, 6.0),
+                    (15.0 * 3600.0, 1800.0, 3.0))),
+        tenants=TenantMix(names=("tenant-a", "tenant-b", "tenant-c"),
+                          weights=(3.0, 2.0, 1.0)),
+        cost=DeviceCostModel(step_s=0.05, compile_s=30.0, n_slots=8),
+        min_replicas=2, max_replicas=10,
+        target_ttft_s=0.5, slo_ttft_s=0.6, slo_window_s=1800.0,
+        scrape_period_s=5.0, flap_guard_s=60.0,
+        train_obs_period_s=30.0, train_scale_period_s=60.0,
+        chaos=(ChaosWindow(at_s=4.0 * 3600.0 + 300.0,
+                           kind=CHAOS_SIGNAL_OUTAGE, duration_s=30.0,
+                           note="million:burst1-scrape-dark"),
+               ChaosWindow(at_s=13.0 * 3600.0,
+                           kind=CHAOS_REPLICA_PREEMPT,
+                           note="million:afternoon-preempt")),
+        sample_every=64,
+    )
