@@ -7,7 +7,7 @@
 IMG ?= tpu-on-k8s/manager:latest
 
 .PHONY: test test-fast analyze analyze-concurrency lint chaos-soak fleet-soak autoscale-soak \
-        disagg-soak spec-soak paged-soak shard-soak slo-soak reshard-soak twin-soak trace-demo why-demo native bench dryrun manager samples clean \
+        disagg-soak spec-soak paged-soak shard-soak slo-soak reshard-soak twin-soak broker-soak trace-demo why-demo native bench dryrun manager samples clean \
         docker-build docker-push deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
@@ -22,6 +22,7 @@ SHARD_SEED ?= 1357
 SLO_SEED ?= 9753
 RESHARD_SEED ?= 6172
 TWIN_SEED ?= 97
+BROKER_SEED ?= 1357
 TRACE_SEED ?= 8642
 # the why-demo trace: a second breach after the scale-down re-pages the
 # budget; the urgent 2->4 scale-up closes with a LIVE burn recovery
@@ -101,6 +102,10 @@ slo-soak:  ## burn-rate SLO engine vs static-threshold control on a seeded regre
 twin-soak:  ## 24-virtual-hour million-request digital-twin rehearsal, twice: byte-identical artifact set + all three production reports pass + >1000x real time
 	JAX_PLATFORMS=cpu python tools/twin_soak.py million_diurnal \
 	    --seed $(TWIN_SEED) --check --min-speedup 1000
+
+broker-soak:  ## burst + training + batch backlog contending for 12 chips, twice: byte-identical artifact set + nonzero batch goodput + zero silent loss + every preemption why-resolved
+	JAX_PLATFORMS=cpu python tools/broker_soak.py broker_contention \
+	    --seed $(BROKER_SEED) --check
 
 reshard-soak:  ## live mesh reshard vs checkpoint-restart on the seeded cost model, twice: byte-identical event logs + pause & goodput wins
 	JAX_PLATFORMS=cpu python tools/reshard_soak.py --seed $(RESHARD_SEED) \

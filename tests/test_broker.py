@@ -1,0 +1,576 @@
+"""Capacity broker: the slice market, its escalation ladder, and the
+batch lane (`tpu_on_k8s/coordinator/broker.py`, `tpu_on_k8s/serve/batchlane.py`).
+
+What must hold:
+  each ladder rung fires in isolation AND in sequence — degrade before
+  harvest before preempt before refuse — with every transition a ledger
+  record carrying the requester's trigger; a refused scale-up burns no
+  cooldown (the `Recommender` gate is never stamped and the SLO-page
+  bypass is not spent); admission is delta-based so pooled sub-views
+  never double-count; a fill is earmarked so the bid-lag window cannot
+  overcommit the market; a chaos-faulted grant apply rejects the whole
+  transition with no partial state; and the batch lane never silently
+  loses an item through any harvest sequence.
+"""
+import pytest
+
+from tpu_on_k8s import chaos
+from tpu_on_k8s.api.core import ObjectMeta
+from tpu_on_k8s.api.inference_types import (AutoscalePolicy, BrokerPolicy,
+                                            InferenceService,
+                                            InferenceServiceSpec)
+from tpu_on_k8s.api.types import TPUPolicy
+from tpu_on_k8s.autoscale.policy import ACTION_UP, Decision, Recommender
+from tpu_on_k8s.client import InMemoryCluster
+from tpu_on_k8s.controller.config import JobControllerConfig
+from tpu_on_k8s.controller.fleetautoscaler import (FleetAutoscaler,
+                                                   _TickPack)
+from tpu_on_k8s.coordinator.broker import (KIND_BATCH, KIND_SERVING,
+                                           KIND_TRAINING, PRIORITY_BATCH,
+                                           PRIORITY_SERVING,
+                                           PRIORITY_TRAINING, Bid,
+                                           CapacityBroker)
+from tpu_on_k8s.metrics.metrics import AutoscaleMetrics, BrokerMetrics
+from tpu_on_k8s.obs.ledger import DecisionLedger, DecisionRecord
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class _ScriptLane:
+    """A scriptable consumer: a bid that mirrors ``current``, an apply
+    that (honestly or not) moves it, and an optional degrade script."""
+
+    def __init__(self, name, kind, priority, current, *, floor=0,
+                 desired=None, unit=1, cost=0.0, util=0.0, variants=(),
+                 honest=True):
+        self.name = name
+        self.kind = kind
+        self.priority = priority
+        self.current = current
+        self.floor = floor
+        self.desired = current if desired is None else desired
+        self.unit = unit
+        self.cost = cost
+        self.util = util
+        self.variants = list(variants)
+        self.flips = []
+        self.applied = []
+        self.honest = honest
+
+    def bid(self):
+        return Bid(name=self.name, kind=self.kind, priority=self.priority,
+                   current=self.current, desired=self.desired,
+                   floor=self.floor, unit=self.unit,
+                   marginal_utility=self.util, preemption_cost=self.cost)
+
+    def apply(self, target, reason):
+        self.applied.append((target, reason))
+        if self.honest:
+            self.current = target
+        return True
+
+    def degrade(self, do_apply):
+        if not self.variants:
+            return ""
+        v = self.variants[0]
+        if do_apply:
+            self.variants.pop(0)
+            self.flips.append(v)
+        return v
+
+
+def _broker(capacity, clock=None, **kw):
+    clock = clock or _Clock()
+    led = DecisionLedger(clock)
+    b = CapacityBroker(capacity, ledger=led, metrics=BrokerMetrics(), **kw)
+    return b, led, clock
+
+
+def _reasons(broker):
+    out = []
+    for line in broker.decision_log:
+        for f in line.split():
+            if f.startswith("reason="):
+                out.append(f[len("reason="):])
+    return out
+
+
+def _decisions(led):
+    return [r for r in led.records if isinstance(r, DecisionRecord)]
+
+
+# ------------------------------------------------------------- admission
+class TestAdmission:
+    def test_disabled_unregistered_and_shrinks_always_admit(self):
+        b, _, _ = _broker(0)
+        assert b.request_capacity("nobody", 2, 8)      # capacity <= 0
+        b2, _, _ = _broker(1)
+        assert b2.request_capacity("nobody", 2, 8)     # unregistered
+        lane = _ScriptLane("a", KIND_SERVING, PRIORITY_SERVING, 4)
+        b2.register("a", lane.bid)
+        assert b2.request_capacity("a", 4, 2)          # shrink
+        assert b2.request_capacity("a", 4, 4)          # no-op
+
+    def test_grant_within_free_capacity_then_announced(self):
+        b, led, _ = _broker(8)
+        lane = _ScriptLane("a", KIND_SERVING, PRIORITY_SERVING, 2)
+        b.register("a", lane.bid)
+        b.run_once()
+        assert b.request_capacity("a", 2, 4, trigger="slo_page:s#1")
+        b.run_once()
+        assert any("reason=grant:+2" in l and "action=up" in l
+                   for l in b.decision_log)
+        recs = [r for r in _decisions(led) if r.reason == "grant:+2"]
+        assert recs and recs[0].trigger == "slo_page:s#1"
+        # the consumer scales into its grant: the reservation retires
+        lane.current = 4
+        b.run_once()
+        assert _reasons(b)[-1] == "steady"
+        # and a repeat request inside the satisfied grant is a no-op
+        assert b.request_capacity("a", 2, 4)
+
+    def test_grant_retires_with_announcement_when_bid_catches_up_first(self):
+        b, _, _ = _broker(8)
+        lane = _ScriptLane("a", KIND_SERVING, PRIORITY_SERVING, 2)
+        b.register("a", lane.bid)
+        b.run_once()
+        assert b.request_capacity("a", 2, 4)
+        lane.current = 4          # scaled before the broker could tick
+        b.run_once()
+        # still one ledgered acknowledgment — "who got the chips" never
+        # loses its record to a fast requester
+        assert any("reason=grant:+2" in l for l in b.decision_log)
+
+    def test_grant_expires_when_requester_never_scales(self):
+        b, _, _ = _broker(8, max_grant_ticks=2)
+        lane = _ScriptLane("a", KIND_SERVING, PRIORITY_SERVING, 2)
+        b.register("a", lane.bid)
+        b.run_once()
+        assert b.request_capacity("a", 2, 4)
+        for _ in range(5):
+            b.run_once()
+        assert "grant_expired" in _reasons(b)
+        assert b.metrics.counters[("grant_expired", "")] == 1
+        # the chips are free again
+        assert b.request_capacity("a", 2, 4)
+
+    def test_delta_admission_for_pooled_subviews(self):
+        # the lane's bid holds 6 (two pools, 2+4); a pool asks for +2 on
+        # its OWN sub-view (2 -> 4) — the market must price the delta
+        # against the lane total, not re-admit the whole lane
+        b, _, _ = _broker(8)
+        lane = _ScriptLane("p", KIND_SERVING, PRIORITY_SERVING, 6)
+        b.register("p", lane.bid)
+        b.run_once()
+        assert b.request_capacity("p", 2, 4)
+        b.run_once()
+        assert any("replicas=6->8 reason=grant:+2" in l
+                   for l in b.decision_log)
+        # a retry for the same total rides the standing reservation
+        assert b.request_capacity("p", 2, 4)
+        lane.current = 8                   # the pool patch landed
+        b.run_once()                       # reservation retires
+        # the OTHER pool's +2 on top must now be refused: 8 + 2 > 8
+        assert not b.request_capacity("p", 4, 6)
+
+    def test_fill_is_earmarked_against_stale_bid_overcommit(self):
+        # regression: a request landing between a fill push and the
+        # lane's next bid must see the filled chips as used
+        b, _, _ = _broker(10)
+        srv = _ScriptLane("srv", KIND_SERVING, PRIORITY_SERVING, 4)
+        bat = _ScriptLane("bat", KIND_BATCH, PRIORITY_BATCH, 0, desired=6)
+        b.register("srv", srv.bid)
+        b.register("bat", bat.bid, apply_fn=bat.apply, managed=True)
+        b.run_once()
+        assert bat.current == 6                       # filled
+        # bids are now stale (bat still shows 0 in _last_bids): without
+        # the earmark this would admit 6 more chips onto a full market
+        assert not b.request_capacity("srv", 4, 10)
+
+    def test_refusal_opens_pressure_episode(self):
+        b, _, _ = _broker(4)
+        a = _ScriptLane("a", KIND_SERVING, PRIORITY_SERVING, 2)
+        c = _ScriptLane("c", KIND_SERVING, PRIORITY_SERVING, 2)
+        b.register("a", a.bid)
+        b.register("c", c.bid)
+        b.run_once()
+        assert not b.request_capacity("a", 2, 4)
+        assert b.metrics.counters[("refusals", "")] == 1
+
+
+# ------------------------------------------------------- the ladder rungs
+class TestLadderRungs:
+    def _full_market(self, capacity, requester, *others, **kw):
+        b, led, clock = _broker(capacity, **kw)
+        b.register(requester.name, requester.bid,
+                   degrade_fn=(requester.degrade
+                               if requester.variants else None))
+        for o in others:
+            b.register(o.name, o.bid, apply_fn=o.apply)
+        b.run_once()
+        return b, led, clock
+
+    def test_rung1_degrade_postpones_refusal_one_tick(self):
+        a = _ScriptLane("a", KIND_SERVING, PRIORITY_SERVING, 2,
+                        variants=("int8",))
+        peer = _ScriptLane("peer", KIND_SERVING, PRIORITY_SERVING, 2)
+        b, led, _ = self._full_market(4, a, peer)
+        assert not b.request_capacity("a", 2, 4)
+        b.run_once()
+        # rung 1 fired, refusal postponed: the flip deserves one tick
+        assert a.flips == ["int8"]
+        assert any("action=degrade" in l and "reason=degrade:int8" in l
+                   for l in b.decision_log)
+        assert not any("refuse" in r for r in _reasons(b))
+        assert b.metrics.counters[("degrades", "")] == 1
+        # the degrade did not help: the next refused tick is final
+        assert not b.request_capacity("a", 2, 4)
+        b.run_once()
+        assert any(r.startswith("refuse:capacity_exhausted")
+                   for r in _reasons(b))
+        assert a.flips == ["int8"]        # once per episode, never again
+
+    def test_rung2_harvest_then_relief_then_grant(self):
+        a = _ScriptLane("a", KIND_SERVING, PRIORITY_SERVING, 2)
+        bat = _ScriptLane("bat", KIND_BATCH, PRIORITY_BATCH, 4)
+        b, led, _ = self._full_market(6, a, bat)
+        assert not b.request_capacity("a", 2, 4, trigger="slo_page:s#1")
+        b.run_once()
+        assert bat.applied == [(2, "harvest:a")]
+        assert bat.current == 2
+        assert any("reason=pressure_wait short=2" in l
+                   for l in b.decision_log)
+        b.run_once()
+        assert _reasons(b)[-2:].count("pressure_relieved") == 1
+        assert b.request_capacity("a", 2, 4)          # freed chips admit
+        b.run_once()
+        assert any("reason=grant:+2" in l for l in b.decision_log)
+        # provenance: the harvest inherited the requester's page trigger
+        recs = [r for r in _decisions(led) if r.reason == "harvest:a"]
+        assert recs and recs[0].trigger == "slo_page:s#1"
+        assert b.metrics.counters[("harvests", "")] == 1
+
+    def test_rung3_preempts_training_never_below_floor(self):
+        a = _ScriptLane("a", KIND_SERVING, PRIORITY_SERVING, 2)
+        tr = _ScriptLane("tr", KIND_TRAINING, PRIORITY_TRAINING, 6,
+                         floor=4)
+        b, led, _ = self._full_market(8, a, tr)
+        assert not b.request_capacity("a", 2, 4)
+        b.run_once()
+        assert tr.applied == [(4, "preempt:a")]       # down to the floor
+        assert b.metrics.counters[("preempts", "")] == 1
+        # asking past what the floor allows: refuse, and no partial cut
+        a2 = _ScriptLane("a", KIND_SERVING, PRIORITY_SERVING, 2)
+        tr2 = _ScriptLane("tr", KIND_TRAINING, PRIORITY_TRAINING, 6,
+                          floor=4)
+        b2, _, _ = self._full_market(8, a2, tr2)
+        assert not b2.request_capacity("a", 2, 5)     # needs 3, avail 2
+        b2.run_once()
+        assert tr2.applied == []
+        assert any("reason=refuse:capacity_exhausted short=1" in l
+                   for l in b2.decision_log)
+
+    def test_rung4_refuse_with_no_victims(self):
+        a = _ScriptLane("a", KIND_SERVING, PRIORITY_SERVING, 2)
+        peer = _ScriptLane("peer", KIND_SERVING, PRIORITY_SERVING, 2)
+        b, led, _ = self._full_market(4, a, peer)
+        assert not b.request_capacity("a", 2, 4)
+        b.run_once()
+        assert any("reason=refuse:capacity_exhausted short=2" in l
+                   for l in b.decision_log)
+        assert peer.applied == []        # equal priority is never a victim
+        assert b.metrics.counters[("refuse_final", "")] == 1
+
+    def test_pressure_timeout_when_victims_never_actually_yield(self):
+        a = _ScriptLane("a", KIND_SERVING, PRIORITY_SERVING, 2)
+        liar = _ScriptLane("liar", KIND_BATCH, PRIORITY_BATCH, 2,
+                           honest=False)
+        b, _, _ = self._full_market(4, a, liar, max_pressure_ticks=3)
+        for _ in range(6):
+            b.request_capacity("a", 2, 4)      # keep the episode fresh
+            b.run_once()
+        assert any(r.startswith("refuse:pressure_timeout")
+                   for r in _reasons(b))
+
+    def test_pressure_lapses_when_requester_stops_asking(self):
+        a = _ScriptLane("a", KIND_SERVING, PRIORITY_SERVING, 2)
+        liar = _ScriptLane("liar", KIND_BATCH, PRIORITY_BATCH, 2,
+                           honest=False)
+        b, _, _ = self._full_market(4, a, liar)
+        assert not b.request_capacity("a", 2, 4)
+        for _ in range(4):                     # never re-requested
+            b.run_once()
+        assert "pressure_lapsed" in _reasons(b)
+        assert not any("refuse" in r for r in _reasons(b))
+
+
+# --------------------------------------------------- the ladder in sequence
+class TestLadderSequence:
+    def _market(self):
+        a = _ScriptLane("a", KIND_SERVING, PRIORITY_SERVING, 4,
+                        variants=("int8", "spec_k:4"))
+        bat = _ScriptLane("bat", KIND_BATCH, PRIORITY_BATCH, 4)
+        tr = _ScriptLane("tr", KIND_TRAINING, PRIORITY_TRAINING, 4,
+                         floor=2)
+        b, led, clock = _broker(12)
+        b.register("a", a.bid, degrade_fn=a.degrade)
+        b.register("bat", bat.bid, apply_fn=bat.apply)
+        b.register("tr", tr.bid, apply_fn=tr.apply)
+        b.run_once()
+        return b, led, a, bat, tr
+
+    def test_degrade_then_harvest_then_preempt_then_grant(self):
+        b, led, a, bat, tr = self._market()
+        assert not b.request_capacity("a", 4, 10, urgent=True,
+                                      trigger="slo_page:s#1")
+        b.run_once()
+        # one tick climbed three rungs: flip the requester cheaper,
+        # empty the batch lane, shrink training to its floor
+        assert a.flips == ["int8"]
+        assert bat.applied == [(0, "harvest:a")]
+        assert tr.applied == [(2, "preempt:a")]
+        seq = [r for r in _reasons(b)
+               if r.startswith(("degrade", "harvest", "preempt"))]
+        assert seq == ["degrade:int8", "harvest:a", "preempt:a"]
+        # every victim record carries the requester's page trigger
+        for r in _decisions(led):
+            if r.reason in ("harvest:a", "preempt:a"):
+                assert r.trigger == "slo_page:s#1"
+        b.run_once()
+        assert "pressure_relieved" in _reasons(b)
+        assert b.request_capacity("a", 4, 10)
+        b.run_once()
+        assert any("reason=grant:+6" in l for l in b.decision_log)
+
+    def test_final_refusal_when_even_the_full_ladder_cannot_cover(self):
+        b, led, a, bat, tr = self._market()
+        assert not b.request_capacity("a", 4, 12)     # needs 8, max 6
+        b.run_once()
+        assert a.flips == ["int8"]        # rung 1 still gets its tick
+        assert not any("refuse" in r for r in _reasons(b))
+        assert not b.request_capacity("a", 4, 12)
+        b.run_once()
+        # refusal is typed and total: no partial cuts were made
+        assert any("reason=refuse:capacity_exhausted short=2" in l
+                   for l in b.decision_log)
+        assert bat.applied == [] and tr.applied == []
+
+    def test_decision_log_deterministic_across_runs(self):
+        logs = []
+        for _ in range(2):
+            b, led, a, bat, tr = self._market()
+            b.request_capacity("a", 4, 10, urgent=True,
+                               trigger="slo_page:s#1")
+            for _ in range(3):
+                b.run_once()
+            b.request_capacity("a", 4, 10)
+            b.run_once()
+            logs.append(list(b.decision_log))
+        assert logs[0] == logs[1] and len(logs[0]) > 8
+
+
+# ------------------------------------------------------------------ chaos
+@pytest.mark.chaos
+class TestBrokerChaos:
+    def test_faulted_grant_apply_rejects_whole_transition(self):
+        b, led, _ = _broker(8)
+        bat = _ScriptLane("bat", KIND_BATCH, PRIORITY_BATCH, 0, desired=4)
+        b.register("bat", bat.bid, apply_fn=bat.apply, managed=True)
+        inj = chaos.FaultInjector([chaos.FaultRule(
+            chaos.SITE_BROKER_GRANT, chaos.on_call(1), chaos.StaleBid(),
+            note="first fill hits a stale bid")], seed=0)
+        with inj:
+            b.run_once()
+            # no partial apply: the consumer was never touched and the
+            # fill's earmarked reservation was dropped
+            assert bat.applied == [] and bat.current == 0
+            assert any("patch_failed StaleBidError" in l
+                       for l in b.decision_log)
+            recs = [r for r in _decisions(led)
+                    if r.commit == "conflict:StaleBidError"]
+            assert recs and recs[0].trigger.startswith("chaos#")
+            assert b.metrics.counters[("lane_conflicts", "")] == 1
+            # the market re-clears from fresh bids: next tick lands
+            b.run_once()
+            assert bat.applied == [(4, "fill:idle_capacity")]
+            assert bat.current == 4
+
+
+# ------------------------------------------------- fleet gate: no cooldown
+def _service(replicas=2):
+    return InferenceService(
+        metadata=ObjectMeta(name="svc"),
+        spec=InferenceServiceSpec(
+            image="inproc", replicas=replicas,
+            tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice",
+                                 topology="2x2"),
+            autoscale=AutoscalePolicy(
+                min_replicas=1, max_replicas=8, target_ttft_s=0.3,
+                scale_up_cooldown_s=10.0, flap_guard_s=0.0)))
+
+
+def _fleet_env(capacity):
+    clock = _Clock()
+    cluster = InMemoryCluster()
+    svc = cluster.create(_service())
+    broker = CapacityBroker(capacity, ledger=DecisionLedger(clock))
+    scaler = FleetAutoscaler(
+        cluster, config=JobControllerConfig(autoscale_window_scrapes=3,
+                                            autoscale_stale_scrapes=3),
+        metrics=AutoscaleMetrics(), clock=clock, broker=broker)
+    scaler.register(svc)
+    state = scaler._services["default/svc"]
+    rec = Recommender(svc.spec.autoscale)
+    return clock, cluster, svc, broker, scaler, state, rec
+
+
+class TestFleetBrokerGate:
+    def test_registration_makes_the_service_a_bidder(self):
+        _, _, _, broker, scaler, _, _ = _fleet_env(8)
+        assert broker.consumers() == ["serve/default/svc"]
+
+    def test_refused_scaleup_burns_no_cooldown(self):
+        clock, cluster, svc, broker, scaler, state, rec = _fleet_env(1)
+        d = Decision(1, ACTION_UP, 2, 4, "slo_page ttft_p95 breach")
+        outcome = scaler._execute("default/svc", svc, state, rec, d,
+                                  clock())
+        assert outcome == "conflict:BrokerRefused"
+        assert "patch_failed BrokerRefused" in scaler.decision_log[-1]
+        # the patch never happened and the cooldown gate is untouched:
+        # the retry next tick runs at full speed
+        assert cluster.get(InferenceService, "default",
+                           "svc").spec.replicas == 2
+        assert not rec.gate.up_in_cooldown(clock())
+        assert scaler.metrics.counters[("patch_failures", "")] == 1
+
+    def test_admitted_scaleup_lands_and_stamps_cooldown(self):
+        clock, cluster, svc, broker, scaler, state, rec = _fleet_env(8)
+        d = Decision(1, ACTION_UP, 2, 4, "slo_page ttft_p95 breach")
+        outcome = scaler._execute("default/svc", svc, state, rec, d,
+                                  clock())
+        assert outcome == "landed"
+        assert cluster.get(InferenceService, "default",
+                           "svc").spec.replicas == 4
+        assert rec.gate.up_in_cooldown(clock())
+
+    def test_slo_bypass_not_spent_on_broker_refusal(self):
+        # regression: the one-per-episode cooldown bypass must survive a
+        # refused patch — spending it would strand the page episode
+        # behind the cooldown it was meant to pierce
+        clock, cluster, svc, broker, scaler, state, rec = _fleet_env(1)
+        state.bind_owner(scaler)
+        state.recommender = rec
+        pack = _TickPack(sample=None, obs=None, cur=2, now=clock(),
+                         urgent=True)
+        d = Decision(1, ACTION_UP, 2, 4, "slo_page ttft_p95 breach")
+        ctx = {"key": "default/svc", "svc": svc, "state": state}
+        assert state.commit(pack, d, ctx) == "conflict:BrokerRefused"
+        assert state.slo_bypass_used is False
+        # with capacity the same commit lands and the bypass is spent
+        clock2, cl2, svc2, _, scaler2, state2, rec2 = _fleet_env(8)
+        state2.bind_owner(scaler2)
+        state2.recommender = rec2
+        pack2 = _TickPack(sample=None, obs=None, cur=2, now=clock2(),
+                          urgent=True)
+        ctx2 = {"key": "default/svc", "svc": svc2, "state": state2}
+        assert state2.commit(pack2, d, ctx2) == "landed"
+        assert state2.slo_bypass_used is True
+
+
+# -------------------------------------------------------------- batch lane
+class TestBatchLane:
+    def test_harvest_preserves_progress_and_loses_nothing(self):
+        from tpu_on_k8s.serve.batchlane import BatchLane
+        lane = BatchLane(slots_per_unit=2, default_work=3)
+        for _ in range(6):
+            lane.submit()
+        lane.apply(2, "fill:idle_capacity")
+        lane.step()                        # 4 in flight, work 3 -> 2
+        assert lane.snapshot()["in_flight"] == 4
+        lane.apply(1, "harvest:svc")       # yield within this call
+        snap = lane.snapshot()
+        assert snap["in_flight"] == 2 and snap["yields"] == 2
+        assert lane.intact()
+        # preempted items kept their progress: front of backlog, work 2
+        assert lane._backlog[0].work == 2
+        steps = 0
+        while lane.snapshot()["completed"] < 6:
+            lane.step()
+            steps += 1
+            assert lane.intact()
+            assert steps < 50
+        assert lane.snapshot() == {"submitted": 6, "completed": 6,
+                                   "backlog": 0, "in_flight": 0,
+                                   "granted": 1, "yields": 2}
+
+    def test_bid_wants_backlog_capped_by_max_units(self):
+        from tpu_on_k8s.serve.batchlane import BatchLane
+        lane = BatchLane(slots_per_unit=2, max_units=3)
+        for _ in range(100):
+            lane.submit()
+        bid = lane.bid()
+        assert bid.desired == 3 and bid.floor == 0
+        assert bid.priority == PRIORITY_BATCH and bid.kind == KIND_BATCH
+
+    def test_gateway_bridge_pumps_polls_and_yields(self):
+        from tpu_on_k8s.serve.batchlane import (BATCH_GATEWAY_PRIORITY,
+                                                BatchGatewayBridge,
+                                                BatchLane)
+
+        class _FakeGateway:
+            def __init__(self):
+                self.next_rid = 1
+                self.live = {}
+                self.done = {}
+                self.cancelled = []
+                self.priorities = []
+
+            def submit(self, prompt, max_new_tokens, tenant="",
+                       priority=0):
+                rid = self.next_rid
+                self.next_rid += 1
+                self.live[rid] = prompt
+                self.priorities.append(priority)
+                return rid
+
+            def result(self, rid):
+                return self.done.get(rid)
+
+            def cancel(self, rid):
+                self.live.pop(rid, None)
+                self.cancelled.append(rid)
+
+        gw = _FakeGateway()
+        lane = BatchLane(slots_per_unit=1)
+        for _ in range(5):
+            lane.submit()
+        bridge = BatchGatewayBridge(lane, gw)
+        lane.apply(3, "fill:idle_capacity")
+        assert bridge.pump(lambda item: f"item-{item.item_id}") == 3
+        assert all(p == BATCH_GATEWAY_PRIORITY for p in gw.priorities)
+        gw.done[1] = "ok"
+        assert bridge.poll() == 1
+        assert lane.snapshot()["completed"] == 1
+        # a harvest cancels the NEWEST submissions and requeues them
+        lane.apply(1, "harvest:svc")
+        assert bridge.yield_excess() == 1
+        assert gw.cancelled == [3]
+        assert lane.intact()
+
+
+# ------------------------------------------------------------- CRD surface
+class TestBrokerPolicy:
+    def test_normalized_clamps(self):
+        bp = BrokerPolicy(priority=5, unit_chips=0,
+                          preemption_cost=-2.0).normalized()
+        assert bp.unit_chips == 1 and bp.preemption_cost == 0.0
+        assert BrokerPolicy().degrade is True

@@ -34,6 +34,7 @@ from tpu_on_k8s.autoscale.signals import (
 )
 from tpu_on_k8s.metrics.metrics import (
     AutoscaleMetrics,
+    BrokerMetrics,
     FleetMetrics,
     JobMetrics,
     LedgerMetrics,
@@ -545,11 +546,26 @@ def _populate(m):
         m.set_gauge("virtual_seconds_simulated", 600.0)
         m.set_gauge("wall_seconds", 0.5)
         m.set_gauge("speedup", 1200.0)
+    elif isinstance(m, BrokerMetrics):
+        m.inc("grants")
+        m.inc("refusals", 2)
+        m.inc("degrades")
+        m.inc("harvests")
+        m.inc("preempts")
+        m.inc("refuse_final")
+        m.inc("fills", 3)
+        m.inc("grant_expired")
+        m.inc("lane_conflicts")
+        m.inc("tick_errors")
+        m.set_gauge("free_chips", 4.0)
+        m.set_gauge("pressure_lanes", 1.0)
+        m.set_gauge("capacity_chips", 12.0)
 
 
 _ALL_CLASSES = (JobMetrics, ServingMetrics, SpecMetrics, PagedKVMetrics,
                 TrainMetrics, FleetMetrics, AutoscaleMetrics, ShardMetrics,
-                SLOMetrics, ReshardMetrics, LedgerMetrics, SimMetrics)
+                SLOMetrics, ReshardMetrics, LedgerMetrics, SimMetrics,
+                BrokerMetrics)
 
 
 class TestExposition:

@@ -1,0 +1,164 @@
+"""Broker soak driver: the contention rehearsal, twice, gate-checked.
+
+`tools/twin_soak.py` proves the twin replays; this driver proves the
+CAPACITY MARKET holds its contract while a traffic burst, an elastic
+training job, and a batch backlog all want the same 12 chips:
+
+1. **Replayability** — `sim/scenario.broker_contention` runs twice into
+   sibling directories and all four artifacts (span dump, decision
+   ledger — broker lane records included — SLO budget dump, summary)
+   must byte-compare. Any drift prints ``BROKER_SOAK_FAILED seed=N``
+   with the offending file, so a red run replays verbatim from the
+   printed seed (the `make *-soak` contract).
+2. **Market gates** — from the run-A summary: the serving SLO paged at
+   most briefly (zero rejected interactive requests), the batch lane's
+   goodput is NONZERO (the market filled idle chips into it), the
+   zero-silent-loss invariant ``submitted == completed + backlog +
+   in_flight`` held through every harvest, and the escalation ladder
+   actually fired (at least one harvest — a run where nothing contends
+   proves nothing).
+3. **Report gates** (``--check``) — the UNMODIFIED production tools
+   (`tools/trace_report.py`, `tools/why_report.py --check`,
+   `tools/slo_report.py --check`) accept the dumps; `why_report
+   --check` resolves every broker preemption to its triggering cause
+   through the ``slo_page:`` / ``chaos#`` refs the lanes carry.
+
+Usage:
+    python tools/broker_soak.py --check
+    python tools/broker_soak.py --seed 7 --outdir /tmp/broker
+"""
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_on_k8s.sim.scenario import broker_contention  # noqa: E402
+from tpu_on_k8s.sim.twin import (LEDGER_FILE, SLO_FILE, SUMMARY_FILE,  # noqa: E402
+                                 TRACE_FILE, run_twin)
+
+PRESETS = {"broker_contention": broker_contention}
+ARTIFACTS = (TRACE_FILE, LEDGER_FILE, SLO_FILE, SUMMARY_FILE)
+
+
+def _identical(a: str, b: str) -> bool:
+    with open(a, "rb") as fa, open(b, "rb") as fb:
+        return fa.read() == fb.read()
+
+
+def _market_gates(summary) -> list:
+    """The broker-specific acceptance gates, from the deterministic
+    summary alone. Returns the list of violated gate descriptions."""
+    bad = []
+    batch = summary.get("batch", {})
+    if summary.get("rejected", 0) != 0:
+        bad.append(f"interactive requests rejected: {summary['rejected']}")
+    if batch.get("completed", 0) <= 0:
+        bad.append("batch goodput is zero — the fill phase never ran")
+    if not summary.get("batch_intact", False):
+        bad.append("batch lane lost work: submitted != "
+                   "completed + backlog + in_flight")
+    if summary.get("broker_ticks", 0) <= 0:
+        bad.append("broker never ticked")
+    if batch.get("yields", 0) <= 0:
+        bad.append("no harvest ever fired — the scenario did not contend")
+    return bad
+
+
+def _report_gates(outdir: str) -> int:
+    """Run the three production report tools on the run-A dumps,
+    in-process, output swallowed — only the exit codes gate."""
+    from tools import slo_report, trace_report, why_report
+    trace = os.path.join(outdir, TRACE_FILE)
+    gates = (
+        ("trace_report", trace_report.main, [trace, "--json"]),
+        ("why_report", why_report.main,
+         [os.path.join(outdir, LEDGER_FILE), "--trace", trace, "--check"]),
+        ("slo_report", slo_report.main,
+         [os.path.join(outdir, SLO_FILE), "--check"]),
+    )
+    failed = 0
+    for name, fn, argv in gates:
+        with contextlib.redirect_stdout(io.StringIO()):
+            rc = fn(argv)
+        print(f"  {name}: {'OK' if rc == 0 else f'FAILED rc={rc}'}")
+        failed += rc != 0
+    return failed
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="run the capacity-market contention scenario twice, "
+                    "byte-compare the artifact set, and gate the "
+                    "market's acceptance invariants")
+    p.add_argument("scenario", nargs="?", default="broker_contention",
+                   choices=sorted(PRESETS),
+                   help="scenario preset (default: broker_contention)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="override the preset's seed")
+    p.add_argument("--outdir", default=None,
+                   help="base directory for the two runs' artifacts "
+                        "(default: a fresh temp dir)")
+    p.add_argument("--check", action="store_true",
+                   help="also gate trace_report / why_report --check / "
+                        "slo_report --check on the run-A dumps")
+    p.add_argument("--json", action="store_true",
+                   help="print the run-A summary as one JSON line")
+    args = p.parse_args(argv)
+
+    sc = (PRESETS[args.scenario](args.seed) if args.seed is not None
+          else PRESETS[args.scenario]())
+    base = args.outdir or tempfile.mkdtemp(prefix=f"broker_{sc.name}_")
+    dir_a = os.path.join(base, "a")
+    dir_b = os.path.join(base, "b")
+
+    summary = run_twin(sc, dir_a, wall_clock=time.perf_counter)
+    run_twin(sc, dir_b)                      # replay: no wall clock at all
+
+    for f in ARTIFACTS:
+        if not _identical(os.path.join(dir_a, f), os.path.join(dir_b, f)):
+            print(f"BROKER_SOAK_FAILED seed={sc.seed}: {f} differs "
+                  f"between {dir_a} and {dir_b}", file=sys.stderr)
+            return 1
+    print(f"BROKER_SOAK_OK seed={sc.seed}: {len(ARTIFACTS)} artifact(s) "
+          f"byte-identical across two runs ({base})")
+
+    violations = _market_gates(summary)
+    for v in violations:
+        print(f"BROKER_SOAK_FAILED seed={sc.seed}: {v}", file=sys.stderr)
+    if violations:
+        return 1
+
+    perf = summary.pop("perf", {})
+    batch = summary.get("batch", {})
+    if args.json:
+        print(json.dumps(dict(summary, perf=perf), sort_keys=True))
+    else:
+        print(f"  scenario={sc.name} requests={summary['requests']} "
+              f"served={summary['served']} pages={summary['pages']} "
+              f"broker_ticks={summary['broker_ticks']} "
+              f"broker_decisions={summary['broker_decisions']}")
+        print(f"  batch: completed={batch.get('completed')} "
+              f"backlog={batch.get('backlog')} "
+              f"yields={batch.get('yields')} "
+              f"intact={summary.get('batch_intact')}")
+        if perf:
+            print(f"  virtual_s={summary['virtual_s']} "
+                  f"wall_s={perf['wall_s']} speedup={perf['speedup']}x")
+
+    if args.check and _report_gates(dir_a):
+        print(f"BROKER_SOAK_FAILED seed={sc.seed}: report gate(s) failed",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

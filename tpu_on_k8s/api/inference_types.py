@@ -360,6 +360,33 @@ class PoolsSpec:
 
 
 @dataclass
+class BrokerPolicy:
+    """How the service participates in the capacity market
+    (`tpu_on_k8s/coordinator/broker.py`). ``priority`` orders the
+    broker's victim search — a lane only ever loses chips to a
+    STRICTLY higher-priority lane under pressure. ``unit_chips`` is
+    the chips one replica occupies (the bid's allocation-unit size);
+    ``preemption_cost`` is the tie-breaker among equal-priority
+    victims (cheapest eviction first). ``degrade`` gates the rung-1
+    pressure valve: allowed, the broker may flip this service onto
+    cheaper ``DecodePolicy`` variants (int8 weights, deeper
+    speculation) before taking anyone's chips. Absent ⇒ serving
+    defaults (top priority, 1 chip per replica, degradable)."""
+
+    priority: int = 100
+    unit_chips: int = 1
+    preemption_cost: float = 1.0
+    degrade: bool = True
+
+    def normalized(self) -> "BrokerPolicy":
+        return BrokerPolicy(
+            priority=int(self.priority),
+            unit_chips=max(int(self.unit_chips), 1),
+            preemption_cost=max(float(self.preemption_cost), 0.0),
+            degrade=bool(self.degrade))
+
+
+@dataclass
 class InferenceServiceSpec:
     """``model_name`` follows that Model's ``status.latest_image`` (the
     closed train → image → deploy loop); ``image`` pins an explicit image
@@ -400,6 +427,11 @@ class InferenceServiceSpec:
     #: scraped signals, writes ``status.slo``, and treats a paging
     #: objective as a scale-up severity hint. Absent ⇒ behavior-neutral.
     slo: Optional[SLOPolicy] = None
+    #: present = explicit capacity-market terms for the broker
+    #: (`coordinator/broker.py`); absent ⇒ serving defaults. Only
+    #: consulted when the operator runs a broker at all — with none,
+    #: this block is inert.
+    broker: Optional[BrokerPolicy] = None
 
 
 class ServicePhase(str, enum.Enum):

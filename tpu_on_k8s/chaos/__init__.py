@@ -30,6 +30,7 @@ from tpu_on_k8s.chaos.faults import (
     SITE_APISERVER_WATCH,
     SITE_AUTOSCALE_PATCH,
     SITE_AUTOSCALE_SIGNAL,
+    SITE_BROKER_GRANT,
     SITE_FLEET_REPLICA,
     SITE_FLEET_ROLLOUT,
     SITE_KV_HANDOFF,
@@ -64,6 +65,8 @@ from tpu_on_k8s.chaos.faults import (
     SaveFailure,
     SignalOutage,
     SlicePreempt,
+    StaleBid,
+    StaleBidError,
     StepFailure,
     TimeoutFault,
     WatchDrop,
@@ -88,6 +91,7 @@ __all__ = [
     "SITE_APISERVER_WATCH",
     "SITE_AUTOSCALE_PATCH",
     "SITE_AUTOSCALE_SIGNAL",
+    "SITE_BROKER_GRANT",
     "SITE_FLEET_REPLICA",
     "SITE_FLEET_ROLLOUT",
     "SITE_KV_HANDOFF",
@@ -124,6 +128,8 @@ __all__ = [
     "SaveFailure",
     "SignalOutage",
     "SlicePreempt",
+    "StaleBid",
+    "StaleBidError",
     "StepFailure",
     "TimeoutFault",
     "Trigger",

@@ -22,6 +22,7 @@ API.
 | serve.kv.handoff       | DisaggFleet prefill→decode transfer | HandoffLoss, HandoffCorrupt |
 | autoscale.signal       | FleetAutoscaler signal scrape       | SignalOutage |
 | autoscale.patch        | FleetAutoscaler spec.replicas patch | Conflict, HttpError, TimeoutFault |
+| broker.grant           | CapacityBroker grant apply          | StaleBid, Conflict |
 | train.step             | TrainLoop.run (per dispatch)        | StepFailure |
 | train.save             | TrainLoop._enqueue_save             | SaveFailure |
 | train.preempt          | TrainLoop.run (per iteration)       | PreemptNotice |
@@ -54,6 +55,7 @@ SITE_TRAIN_PREEMPT = "train.preempt"
 SITE_RESHARD = "train.reshard"
 SITE_AUTOSCALE_SIGNAL = "autoscale.signal"
 SITE_AUTOSCALE_PATCH = "autoscale.patch"
+SITE_BROKER_GRANT = "broker.grant"
 
 #: Machine-readable site catalog: site -> (fires in, fault class names,
 #: recovery under test). The single source of the `docs/resilience.md`
@@ -131,6 +133,10 @@ SITE_REGISTRY = {
         "`controller/fleetautoscaler.py` patch",
         ("Conflict", "HttpError"),
         "failed patch burns no cooldown"),
+    SITE_BROKER_GRANT: (
+        "`coordinator/broker.py` grant apply",
+        ("StaleBid", "Conflict"),
+        "re-clear next tick; no partial apply, no cooldown burned"),
 }
 
 
@@ -145,6 +151,11 @@ class ChaosSaveError(OSError):
 
 class ChaosReshardError(RuntimeError):
     """An injected live-reshard abort (``ReshardAbort``)."""
+
+
+class StaleBidError(RuntimeError):
+    """An injected stale-bid rejection (``StaleBid``): a consumer's bid no
+    longer matches its live state when the broker applies the grant."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -381,6 +392,19 @@ class ReshardAbort(Fault):
 
     def to_exception(self) -> Exception:
         return ChaosReshardError("chaos injected reshard abort")
+
+
+@dataclasses.dataclass(frozen=True)
+class StaleBid(Fault):
+    """A consumer's bid went stale between clearing and apply (the consumer
+    scaled itself, died, or re-bid concurrently). The broker must reject
+    the WHOLE grant — no partial apply — ledger the conflict, and re-clear
+    from fresh bids next tick; the refused requester burns no cooldown."""
+
+    kind: ClassVar[str] = "stale_bid"
+
+    def to_exception(self) -> Exception:
+        return StaleBidError("chaos injected stale bid")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -50,6 +50,7 @@ from tpu_on_k8s.controller.elastic import ElasticController, apply_host_count
 from tpu_on_k8s.controller.loopkernel import (
     LoopKernel,
     OpenHorizon,
+    format_commit_failure_line,
     format_decision_line,
 )
 from tpu_on_k8s.gang import topology
@@ -309,6 +310,17 @@ class _JobState(LoopKernel):
             status.message = "ReachMaxMetric"
             a._rescale(job, status, self, decision.target, freeze=True)
             return COMMIT_LANDED
+        if a.broker is not None and not a.broker.request_capacity(
+                f"train/{ctx['key']}", decision.current, decision.target):
+            # the capacity-market gate, pre-rescale: a refusal means the
+            # grow never happened — no watermark reset, no status write,
+            # no freeze — and the loop re-decides at full speed next
+            # tick while the broker's ladder works the shortfall; the
+            # grant lands whenever pressure clears
+            a.decision_log.append(format_commit_failure_line(
+                decision.seq, "BrokerRefused",
+                scope=(("job", ctx["key"]),)))
+            return "conflict:BrokerRefused"
         a._rescale(job, status, self, decision.target)
         return COMMIT_LANDED
 
@@ -345,10 +357,18 @@ class ElasticAutoscaler:
     def __init__(self, cluster: InMemoryCluster,
                  config: Optional[JobControllerConfig] = None,
                  metrics: Optional[JobMetrics] = None,
-                 ledger=None) -> None:
+                 ledger=None, broker=None) -> None:
         self.cluster = cluster
         self.config = config or JobControllerConfig()
         self.metrics = metrics
+        # the capacity broker (`coordinator/broker.CapacityBroker`):
+        # set, every grow asks for chips before the rescale (a refusal
+        # is ``conflict:BrokerRefused`` — the loop retries next tick)
+        # and the job becomes a bidder (``train/<key>``) the broker's
+        # rung-3 preemption can shrink through ``shrink_to`` — the
+        # live-reshard path with its cold-restart fallback, never a
+        # kill. None → market-free operation, byte-identical.
+        self.broker = broker
         # the decision ledger (`obs/ledger.DecisionLedger`): every
         # elastic decision lands one provenance record through the loop
         # kernel, uniformly with the serving loops. None → NOOP.
@@ -372,6 +392,7 @@ class ElasticAutoscaler:
         key = f"{job.metadata.namespace}/{job.metadata.name}"
         with self._lock:
             self._jobs.setdefault(key, _JobState())
+        self._broker_register(key)
 
     def deregister(self, job: TPUJob) -> None:
         key = f"{job.metadata.namespace}/{job.metadata.name}"
@@ -381,6 +402,7 @@ class ElasticAutoscaler:
             # a deleted-mid-scale job must not leave an unclosable
             # horizon pinning the shared ledger's gauge
             state.abandon()
+            self._broker_deregister(key)
 
     def observe_event(self, event) -> None:
         """Watch glue: register on ADDED, deregister on DELETED."""
@@ -406,6 +428,7 @@ class ElasticAutoscaler:
                 with self._lock:
                     self._jobs.pop(key, None)
                 state.abandon()
+                self._broker_deregister(key)
                 continue
             # the kernel template drives observe→decide→commit and
             # lands one ledger record per decision (hooks on _JobState
@@ -422,6 +445,84 @@ class ElasticAutoscaler:
                 state.run_tick({"job": job, "key": key})
             except NotFoundError:
                 continue
+
+    # --------------------------------------------------------- capacity market
+    def _broker_register(self, key: str) -> None:
+        """Make the job a bidder on the capacity market (idempotent —
+        re-registering would reset the lane's ledger loop). The bid and
+        shrink closures run on the BROKER's tick thread and touch only
+        the cluster client and ``shrink_to`` — which takes this
+        autoscaler's lock briefly for the state lookup, never while the
+        broker holds its own, so no lock-order cycle exists."""
+        broker = self.broker
+        if broker is None:
+            return
+        name = f"train/{key}"
+        if name in broker.consumers():
+            return
+        broker.register(
+            name,
+            lambda: self._training_bid(key),
+            apply_fn=lambda target, reason: self.shrink_to(
+                key, target, reason=reason))
+
+    def _broker_deregister(self, key: str) -> None:
+        if self.broker is not None:
+            self.broker.deregister(f"train/{key}")
+
+    def _training_bid(self, key: str):
+        """The job's standing bid: hold its current worker gang (growth
+        arrives through the ``request_capacity`` gate in commit),
+        floored at ``elastic_policy.min_replicas`` — the broker's
+        rung-3 preemption can shrink the gang down to the floor but
+        never below, and never touches a non-elastic job at all."""
+        from tpu_on_k8s.coordinator.broker import (
+            KIND_TRAINING, PRIORITY_TRAINING, Bid)
+        ns, name = key.split("/", 1)
+        job = self.cluster.try_get(TPUJob, ns, name)
+        if job is None or conditions.is_finished(job.status):
+            return None
+        ep = job.spec.elastic_policy
+        worker = job.spec.tasks.get(TaskType.WORKER)
+        if ep is None or worker is None:
+            return None
+        cur = max(int(worker.num_tasks), 0)
+        return Bid(name=f"train/{key}", kind=KIND_TRAINING,
+                   priority=PRIORITY_TRAINING, current=cur, desired=cur,
+                   floor=max(int(ep.min_replicas), 0), unit=1,
+                   preemption_cost=float(cur))
+
+    def shrink_to(self, key: str, hosts: int, *, reason: str = "") -> bool:
+        """Broker-pushed preemption (ladder rung 3): shrink the job's
+        worker gang to ``hosts`` through the SAME path an elastic
+        decision takes — ``apply_host_count`` slice legality, a
+        live-reshard request when the policy allows one, the
+        checkpoint-restart fallback otherwise — WITHOUT freezing the
+        continue-test: when pressure clears, the loop's next grow asks
+        the broker again and wins its chips back. Clamped to
+        ``min_replicas``; already at/below target is a success."""
+        ns, name = key.split("/", 1)
+        with self._lock:
+            state = self._jobs.get(key)
+        job = self.cluster.try_get(TPUJob, ns, name)
+        if state is None or job is None \
+                or conditions.is_finished(job.status):
+            return False
+        ep = job.spec.elastic_policy
+        worker = job.spec.tasks.get(TaskType.WORKER)
+        if ep is None or worker is None:
+            return False
+        target = max(int(hosts), int(ep.min_replicas))
+        if target >= worker.num_tasks:
+            return True
+        status = self._elastic_status(job)
+        try:
+            self._rescale(job, status, state, target,
+                          message=reason
+                          or f"broker preempt to {target} hosts")
+        except NotFoundError:
+            return False
+        return True
 
     def _next_host_count(self, job: TPUJob, cur: int, cap: int) -> Optional[int]:
         """One growth step: multi-slice jobs add a slice (DCN); single-slice
@@ -580,10 +681,10 @@ class ElasticAutoscaler:
 def setup_elastic_autoscaler(cluster: InMemoryCluster,
                              config: Optional[JobControllerConfig] = None,
                              metrics: Optional[JobMetrics] = None,
-                             ledger=None) -> ElasticAutoscaler:
+                             ledger=None, broker=None) -> ElasticAutoscaler:
     """Wire the autoscaler's job registry to the cluster watch (reference
     SetupWithManager, torchelastic/elastictorchjob_controller.go:128-148)."""
     scaler = ElasticAutoscaler(cluster, config=config, metrics=metrics,
-                               ledger=ledger)
+                               ledger=ledger, broker=broker)
     cluster.watch(scaler.observe_event)
     return scaler

@@ -54,6 +54,7 @@ from tpu_on_k8s import chaos
 from tpu_on_k8s.api import constants
 from tpu_on_k8s.api.core import Pod
 from tpu_on_k8s.api.inference_types import (
+    DecodePolicy,
     InferenceService,
     SLOObjectiveStatus,
 )
@@ -84,6 +85,7 @@ from tpu_on_k8s.obs.ledger import (
     HORIZON_BURN_RECOVERED,
     HORIZON_REPLICAS_READY,
     HORIZON_ROLLOUT_COMPLETE,
+    committed,
 )
 from tpu_on_k8s.obs.slo import SLOEngine, SLOSpec, page_onsets
 from tpu_on_k8s.obs.trace import ensure as ensure_tracer
@@ -266,14 +268,19 @@ class _ServiceState(_AutoscaleLoop):
                          urgent=urgent)
 
     def commit(self, pack: _TickPack, decision, ctx) -> str:
-        if pack.urgent and decision.action == ACTION_UP \
+        outcome = super().commit(pack, decision, ctx)
+        if committed(outcome) and pack.urgent \
+                and decision.action == ACTION_UP \
                 and decision.reason.startswith("slo_page"):
             # the bypass is spent only when it actually pierced a
-            # cooldown (the policy marks those ``slo_page``) — a
-            # scale-up that was free anyway must not burn the one
-            # escape hatch; it re-arms after the page episode clears
+            # cooldown (the policy marks those ``slo_page``) AND the
+            # commit landed — a patch the chaos layer or the capacity
+            # broker refused never scaled anything, so the episode
+            # keeps its one escape hatch and retries at full urgency
+            # next tick (the cooldown twin of the failed-patch
+            # no-burn rule); it re-arms after the page episode clears
             self.slo_bypass_used = True
-        return super().commit(pack, decision, ctx)
+        return outcome
 
     def trigger_of(self, pack: _TickPack, ctx) -> str:
         decision = ctx.get("decision")
@@ -317,10 +324,21 @@ class FleetAutoscaler:
                  config: Optional[JobControllerConfig] = None,
                  metrics: Optional[AutoscaleMetrics] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 tracer=None, slo_metrics=None, ledger=None) -> None:
+                 tracer=None, slo_metrics=None, ledger=None,
+                 broker=None) -> None:
         self.cluster = cluster
         self.config = config or JobControllerConfig()
         self.metrics = metrics
+        # the capacity broker (`coordinator/broker.CapacityBroker`):
+        # set, every scale-UP asks for chips BEFORE the spec patch —
+        # a refusal returns ``conflict:BrokerRefused`` from the same
+        # pre-patch position as a chaos fault, so no cooldown is ever
+        # burned on capacity the market would not grant. Each
+        # registered service also becomes a bidder (``serve/<key>``):
+        # its standing bid is what the broker's ladder degrades
+        # (DecodePolicy valves) or — for lower-priority services —
+        # harvests. None → market-free operation, byte-identical.
+        self.broker = broker
         # the decision ledger (`obs/ledger.DecisionLedger`): every
         # service/pool loop tick lands one provenance record through the
         # loop kernel. None → NOOP (bit-for-bit the ledger-free
@@ -368,6 +386,7 @@ class FleetAutoscaler:
         key = f"{svc.metadata.namespace}/{svc.metadata.name}"
         with self._lock:
             self._services.setdefault(key, _ServiceState())
+        self._broker_register(key)
 
     def deregister(self, svc: InferenceService) -> None:
         key = f"{svc.metadata.namespace}/{svc.metadata.name}"
@@ -375,6 +394,7 @@ class FleetAutoscaler:
             state = self._services.pop(key, None)
         if state is not None:
             self._abandon_loops(state)
+            self._broker_deregister(key)
 
     @staticmethod
     def _abandon_loops(state: "_ServiceState") -> None:
@@ -411,6 +431,7 @@ class FleetAutoscaler:
             state = self._services.setdefault(key, _ServiceState())
             state.fleet = fleet
             state.apply_to_fleet = apply
+        self._broker_register(key)
 
     def _fleet_binding(self, state: _ServiceState):
         """Snapshot ``(fleet, apply_to_fleet)`` under the lock — the
@@ -419,6 +440,127 @@ class FleetAutoscaler:
         apply the decision to fleet B."""
         with self._lock:
             return state.fleet, state.apply_to_fleet
+
+    # --------------------------------------------------------- capacity market
+    def _broker_register(self, key: str) -> None:
+        """Make the service a bidder on the capacity market (idempotent
+        — re-registering would reset the lane's ledger loop). The
+        bid/apply/degrade closures run on the BROKER's tick thread and
+        touch only the cluster client (its own lock) — never this
+        autoscaler's lock, so no lock-order edge exists between the two
+        control loops."""
+        broker = self.broker
+        if broker is None:
+            return
+        name = f"serve/{key}"
+        if name in broker.consumers():
+            return
+        broker.register(
+            name,
+            lambda: self._serving_bid(key),
+            apply_fn=lambda target, reason: self._broker_apply(
+                key, target, reason),
+            degrade_fn=lambda apply: self._broker_degrade(key, apply))
+
+    def _broker_deregister(self, key: str) -> None:
+        if self.broker is not None:
+            self.broker.deregister(f"serve/{key}")
+
+    def _serving_bid(self, key: str):
+        """The service's standing bid: hold what the spec holds (it
+        expresses no future want — growth arrives through the
+        ``request_capacity`` gate in ``_execute``), floored at the
+        autoscale minimum plus the warm floor so a harvest can never
+        cut below what ``warm_floor`` scale-downs already protect."""
+        from tpu_on_k8s.coordinator.broker import (
+            KIND_SERVING, PRIORITY_SERVING, Bid)
+        ns, svc_name = key.split("/", 1)
+        svc = self.cluster.try_get(InferenceService, ns, svc_name)
+        if svc is None:
+            return None
+        if svc.spec.pools is not None:
+            sp = svc.spec.pools.normalized()
+            cur = max(int(sp.prefill.replicas), 0) \
+                + max(int(sp.decode.replicas), 0)
+            floors = [max(p.autoscale.min_replicas, p.autoscale.min_warm)
+                      for p in (sp.prefill, sp.decode)
+                      if p.autoscale is not None]
+            floor = sum(floors) if floors else cur
+        else:
+            cur = max(int(svc.spec.replicas), 0)
+            ap = svc.spec.autoscale
+            floor = (max(ap.min_replicas, ap.min_warm)
+                     if ap is not None else cur)
+        bp = svc.spec.broker
+        return Bid(
+            name=f"serve/{key}", kind=KIND_SERVING,
+            priority=bp.priority if bp is not None else PRIORITY_SERVING,
+            current=cur, desired=cur, floor=min(floor, cur) if cur else 0,
+            unit=bp.unit_chips if bp is not None else 1,
+            preemption_cost=(bp.preemption_cost if bp is not None
+                             else float(cur)))
+
+    def _broker_apply(self, key: str, target_units: int,
+                      reason: str) -> bool:
+        """Execute a broker-pushed harvest: patch ``spec.replicas``
+        down and let the reconciler's drain machinery do the rest. The
+        broker never pushes below the bid's floor, and only ever
+        harvests a serving lane to feed a HIGHER-priority one."""
+        ns, svc_name = key.split("/", 1)
+
+        def mutate(s: InferenceService) -> None:
+            if s.spec.pools is not None:
+                raise NotFoundError("pooled service: harvest unsupported")
+            s.spec.replicas = max(0, int(target_units))
+        try:
+            self.cluster.update_with_retry(
+                InferenceService, ns, svc_name, mutate)
+        except NotFoundError:
+            return False
+        if self.metrics is not None:
+            self.metrics.inc("broker_harvests")
+        return True
+
+    def _broker_degrade(self, key: str, apply: bool) -> str:
+        """The rung-1 pressure valve: flip the service onto a cheaper
+        ``DecodePolicy`` variant instead of taking anyone's chips —
+        first int8 weights (~half the weight bytes per decode step),
+        then deeper speculation when a draft model is configured (more
+        accepted tokens per target verify). ``apply=False`` peeks the
+        next variant without flipping; '' = nothing left to flip. The
+        spec patch rides the same rolling-update machinery as any
+        decode-policy edit."""
+        ns, svc_name = key.split("/", 1)
+        svc = self.cluster.try_get(InferenceService, ns, svc_name)
+        if svc is None:
+            return ""
+        bp = svc.spec.broker
+        if bp is not None and not bp.degrade:
+            return ""
+        dp = (svc.spec.decode or DecodePolicy()).normalized()
+        if not dp.int8_weights:
+            variant, spec_k = "int8", dp.spec_k
+        elif dp.draft_model and dp.spec_k < 8:
+            spec_k = min(dp.spec_k * 2, 8)
+            variant = f"spec_k:{spec_k}"
+        else:
+            return ""
+        if not apply:
+            return variant
+
+        def mutate(s: InferenceService) -> None:
+            d = (s.spec.decode or DecodePolicy()).normalized()
+            s.spec.decode = DecodePolicy(
+                draft_model=d.draft_model, spec_k=spec_k,
+                int8_weights=True)
+        try:
+            self.cluster.update_with_retry(
+                InferenceService, ns, svc_name, mutate)
+        except NotFoundError:
+            return ""
+        if self.metrics is not None:
+            self.metrics.inc("broker_degrades")
+        return variant
 
     # ------------------------------------------------------------ decision loop
     def run_once(self) -> None:
@@ -437,6 +579,7 @@ class FleetAutoscaler:
                 with self._lock:
                     self._services.pop(key, None)
                 self._abandon_loops(state)
+                self._broker_deregister(key)
                 continue
             try:
                 if svc.spec.pools is not None:
@@ -754,6 +897,25 @@ class FleetAutoscaler:
         label = key if pool is None else f"{key}/{pool}"
         scope = ((("svc", key),) if pool is None
                  else (("svc", key), ("pool", pool)))
+        if self.broker is not None and decision.action == ACTION_UP:
+            # the capacity market gate: ask BEFORE the patch, from the
+            # same pre-commit position as a chaos fault — a refusal
+            # means the scale never happened, no cooldown is burned
+            # (``recommender.commit`` below never runs), and the
+            # broker's pressure ladder (degrade → harvest → preempt)
+            # works the shortfall so next tick's retry can land
+            if not self.broker.request_capacity(
+                    f"serve/{key}", decision.current, decision.target,
+                    urgent=decision.reason.startswith("slo_page"),
+                    trigger=(f"slo_page:{key}#{state.page_episode}"
+                             if state.slo_paging else "")):
+                self.decision_log.append(format_commit_failure_line(
+                    decision.seq, "BrokerRefused", scope=scope))
+                if self.metrics is not None:
+                    self.metrics.inc("patch_failures")
+                _log.warning("broker refused %s scale %d -> %d", label,
+                             decision.current, decision.target)
+                return "conflict:BrokerRefused"
         fault = chaos.fire(chaos.SITE_AUTOSCALE_PATCH, service=label,
                            target=decision.target)
         try:
@@ -1036,11 +1198,13 @@ def setup_fleet_autoscaler(cluster: InMemoryCluster,
                            clock: Callable[[], float] = time.monotonic,
                            tracer=None,
                            slo_metrics=None,
-                           ledger=None) -> FleetAutoscaler:
+                           ledger=None,
+                           broker=None) -> FleetAutoscaler:
     """Wire the autoscaler's service registry to the cluster watch (the
     serving twin of ``setup_elastic_autoscaler``)."""
     scaler = FleetAutoscaler(cluster, config=config, metrics=metrics,
                              clock=clock, tracer=tracer,
-                             slo_metrics=slo_metrics, ledger=ledger)
+                             slo_metrics=slo_metrics, ledger=ledger,
+                             broker=broker)
     cluster.watch(scaler.observe_event)
     return scaler

@@ -148,7 +148,7 @@ def format_commit_failure_line(seq: int, failure: str, *,
 
 
 #: scope keys a decision line may carry, in their canonical order
-_SCOPE_KEYS = ("svc", "job", "pool")
+_SCOPE_KEYS = ("svc", "job", "pool", "lane")
 
 
 def parse_decision_line(line: str) -> Optional[DecisionLine]:

@@ -4,8 +4,26 @@ Analog of /root/reference/pkg/coordinator/ (SURVEY §2.7). On TPU, tenant queues
 double as the multi-slice coordination surface: each queue maps to a slice pool
 and the smooth-WRR selector apportions dequeues across pools (BASELINE.md's
 "two WRR-coordinated queues on multi-slice v5e").
+
+`broker` adds the chip-capacity layer UNDER the queues: one slice market
+every consumer — serving fleets, elastic training, the warm floor, and
+the preemptible batch lane — bids on, cleared each tick with a
+degrade-before-take escalation ladder and every grant/preempt/refusal
+on the decision ledger.
 """
 
+from tpu_on_k8s.coordinator.broker import (
+    KIND_BATCH,
+    KIND_SERVING,
+    KIND_TRAINING,
+    KIND_WARM,
+    PRIORITY_BATCH,
+    PRIORITY_SERVING,
+    PRIORITY_TRAINING,
+    PRIORITY_WARM,
+    Bid,
+    CapacityBroker,
+)
 from tpu_on_k8s.coordinator.core import (
     DEFAULT_SCHEDULING_PERIOD_SECONDS,
     Coordinator,

@@ -35,11 +35,13 @@ from tpu_on_k8s.controller.inferenceservice import (
 from tpu_on_k8s.controller.modelversion import setup_modelversion_controller
 from tpu_on_k8s.controller.runtime import Manager
 from tpu_on_k8s.controller.tpujob import setup_tpujob_controller
+from tpu_on_k8s.coordinator.broker import CapacityBroker
 from tpu_on_k8s.coordinator.core import Coordinator
 from tpu_on_k8s.features import features
 from tpu_on_k8s.gang.scheduler import GANG_SCHEDULER_NAME, default_registry
 from tpu_on_k8s.metrics.metrics import (
     AutoscaleMetrics,
+    BrokerMetrics,
     JobMetrics,
     LedgerMetrics,
     SLOMetrics,
@@ -83,6 +85,16 @@ def build_parser() -> argparse.ArgumentParser:
                    default=15.0,
                    help="Tick period of the serving SLO autoscaler "
                         "(InferenceServices with spec.autoscale set)")
+    p.add_argument("--broker-capacity-chips", type=int, default=0,
+                   help="Total chip capacity of the capacity broker's "
+                        "slice market (coordinator/broker.py): serving "
+                        "fleets, elastic training, and the batch lane "
+                        "bid for one shared pool, with degrade-before-"
+                        "take pressure valves and graceful preemption "
+                        "(0 = no broker, market-free operation)")
+    p.add_argument("--broker-period-seconds", type=float, default=10.0,
+                   help="Tick period of the capacity broker's market "
+                        "clearing loop")
     p.add_argument("--once", action="store_true",
                    help="Pump controllers to quiescence and exit (smoke mode)")
     p.add_argument("--leader-elect", default=False,
@@ -294,9 +306,25 @@ class Operator:
         # failures, the open-effect-horizons gauge)
         self.ledger_metrics = LedgerMetrics(registry=self.metrics.registry)
         self.ledger = DecisionLedger(metrics=self.ledger_metrics)
+        # the capacity broker (coordinator/broker.py): one slice market
+        # both autoscalers bid on — scale-ups ask it for chips before
+        # they patch, and its escalation ladder (degrade → harvest →
+        # preempt → typed refusal) lands every transition on the same
+        # ledger. Opt-in by capacity: 0 chips = no broker, and both
+        # autoscalers run market-free, byte-identical to before.
+        self.broker = None
+        self.broker_metrics = None
+        capacity = getattr(args, "broker_capacity_chips", 0)
+        if capacity > 0:
+            self.broker_metrics = BrokerMetrics(
+                registry=self.metrics.registry)
+            self.broker = CapacityBroker(
+                capacity, ledger=self.ledger,
+                metrics=self.broker_metrics,
+                period_s=getattr(args, "broker_period_seconds", 10.0))
         self.autoscaler = setup_elastic_autoscaler(
             self.cluster, config=self.config, metrics=self.metrics,
-            ledger=self.ledger)
+            ledger=self.ledger, broker=self.broker)
         self.modelversion = setup_modelversion_controller(
             self.cluster, self.manager, config=self.config)
         self.inferenceservice = setup_inferenceservice_controller(
@@ -315,7 +343,7 @@ class Operator:
             self.cluster, config=self.config,
             metrics=self.autoscale_metrics,
             slo_metrics=self.slo_metrics,
-            ledger=self.ledger)
+            ledger=self.ledger, broker=self.broker)
         self.scheduler_loop = None
         if getattr(args, "enable_slice_scheduler", False):
             from tpu_on_k8s.gang.scheduler import (
@@ -358,6 +386,8 @@ class Operator:
                 self.coordinator.run()
             self.autoscaler.run()
             self.fleetautoscaler.run()
+            if self.broker is not None:
+                self.broker.run()
             if self.scheduler_loop is not None:
                 self.scheduler_loop.run()
 
@@ -373,6 +403,8 @@ class Operator:
                 self.coordinator.stop()
             self.autoscaler.stop()
             self.fleetautoscaler.stop()
+            if self.broker is not None:
+                self.broker.stop()
             if self.scheduler_loop is not None:
                 self.scheduler_loop.stop()
             self.manager.stop()

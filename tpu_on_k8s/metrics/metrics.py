@@ -579,7 +579,7 @@ class AutoscaleMetrics(_MetricsBase):
 
     _ACTION_COUNTERS = ("decisions",)
     _PLAIN_COUNTERS = ("patch_failures", "stale_scrapes", "ticks",
-                       "tick_errors")
+                       "tick_errors", "broker_harvests", "broker_degrades")
     _SERVICE_GAUGES = ("desired_replicas", "current_replicas",
                        "observed_ttft_p95", "observed_queue_wait_p95",
                        "observed_tpot_p95",
@@ -719,6 +719,53 @@ class LedgerMetrics(_MetricsBase):
         c = self._prom_counters.get(name)
         if c is not None:
             (c.labels(label) if name in self._LOOP_COUNTERS else c).inc(n)
+
+    def set_gauge(self, name: str, value: float, label: str = "") -> None:
+        with self._lock:
+            self.gauges[(name, label)] = value
+        g = self._prom_gauges.get(name)
+        if g is not None:
+            g.set(value)
+
+
+class BrokerMetrics(_MetricsBase):
+    """Capacity-market telemetry (`tpu_on_k8s/coordinator/broker.py`):
+    clearing counters — grants admitted through the ``request_capacity``
+    gate, refusals (pressure opened), degrades (rung 1), harvests /
+    preempts (rungs 2–3), final typed refusals (rung 4), managed-lane
+    fills, expired grants, lane commit conflicts, and crashed clearing
+    ticks — next to the
+    market gauges: free chips after clearing, lanes under pressure, and
+    the configured capacity. One label-free family each: the market is
+    one per operator, and per-lane attribution already lives in the
+    decision ledger's ``broker/<lane>`` loops. Mirror dicts key by
+    ``(name, label)`` like ``AutoscaleMetrics``."""
+
+    _PLAIN_COUNTERS = ("grants", "refusals", "degrades", "harvests",
+                       "preempts", "refuse_final", "fills",
+                       "grant_expired", "lane_conflicts", "tick_errors")
+    _MARKET_GAUGES = ("free_chips", "pressure_lanes", "capacity_chips")
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        self.counters: Dict[Tuple[str, str], int] = defaultdict(int)
+        self.gauges: Dict[Tuple[str, str], float] = {}
+        if _prom is not None:
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_broker"
+        for name in self._PLAIN_COUNTERS:
+            self._declare(name, f"{ns}_{name}", "counter",
+                          f"Broker {name}")
+        for name in self._MARKET_GAUGES:
+            self._declare(name, f"{ns}_{name}", "gauge",
+                          f"Broker {name}")
+
+    def inc(self, name: str, n: int = 1, label: str = "") -> None:
+        with self._lock:
+            self.counters[(name, label)] += n
+        c = self._prom_counters.get(name)
+        if c is not None:
+            c.inc(n)
 
     def set_gauge(self, name: str, value: float, label: str = "") -> None:
         with self._lock:

@@ -100,6 +100,14 @@ class Scenario:
     # tracer retention knob for the run (1 = keep everything)
     sample_every: int = 1
 
+    # capacity broker + batch lane (0 capacity disables the market and
+    # the lane entirely — the presets above stay byte-identical)
+    broker_capacity_chips: int = 0
+    broker_period_s: float = 10.0
+    batch_backlog: int = 0
+    batch_max_units: int = 0
+    batch_work: int = 2
+
     def __post_init__(self):
         if self.duration_s <= 0 or self.tick_s <= 0:
             raise ValueError("duration_s and tick_s must be > 0")
@@ -161,6 +169,46 @@ def smoke(seed: int = 2468) -> Scenario:
                            duration_s=15.0, note="smoke:scrape-dark"),
                ChaosWindow(at_s=420.0, kind=CHAOS_REPLICA_PREEMPT,
                            note="smoke:preempt")),
+    )
+
+
+def broker_contention(seed: int = 1357) -> Scenario:
+    """The capacity-market rehearsal: a 12-chip cluster where everyone
+    wants the same slices at once. At rest the market is nearly full —
+    serving holds 2, training holds 2, and the broker fills the batch
+    lane's 400-item backlog into the remaining idle chips (up to 6
+    units). Then the burst pages the TTFT budget (serving demands up to
+    8 via urgent scale-ups), the training job's latency plan scripts a
+    grow to 4, and the escalation ladder has to arbitrate: degrade
+    first, harvest the batch lane within one tick, shrink training
+    toward its floor of 2, refuse only when the market is truly dry.
+    A mid-burst scrape outage and a replica preemption ride along so
+    the ladder clears under chaos too. Every grant/preempt/refusal is
+    one ledger record; `make broker-soak` replays this twice and
+    byte-compares the artifact set."""
+    return Scenario(
+        name="broker_contention",
+        seed=seed,
+        duration_s=600.0,
+        tick_s=0.25,
+        profile=DiurnalProfile(base_rate=6.0, amplitude=0.3,
+                               period_s=600.0, peak_at_s=300.0,
+                               bursts=((180.0, 90.0, 6.0),)),
+        cost=DeviceCostModel(step_s=0.05, compile_s=20.0, n_slots=8),
+        min_replicas=2, max_replicas=8,
+        target_ttft_s=0.5, slo_ttft_s=0.6, slo_window_s=150.0,
+        scrape_period_s=5.0, flap_guard_s=20.0,
+        train_obs_period_s=20.0, train_scale_period_s=40.0,
+        chaos=(ChaosWindow(at_s=200.0, kind=CHAOS_SIGNAL_OUTAGE,
+                           duration_s=15.0,
+                           note="broker:mid-burst-scrape-dark"),
+               ChaosWindow(at_s=420.0, kind=CHAOS_REPLICA_PREEMPT,
+                           note="broker:preempt")),
+        broker_capacity_chips=12,
+        broker_period_s=5.0,
+        batch_backlog=400,
+        batch_max_units=6,
+        batch_work=2,
     )
 
 

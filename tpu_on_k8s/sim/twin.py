@@ -52,11 +52,13 @@ from tpu_on_k8s.controller.inferenceservice import (
     setup_inferenceservice_controller)
 from tpu_on_k8s.controller.runtime import Manager, Workqueue
 from tpu_on_k8s.controller.tpujob import setup_tpujob_controller, submit_job
-from tpu_on_k8s.metrics.metrics import (AutoscaleMetrics, LedgerMetrics,
-                                        SimMetrics)
+from tpu_on_k8s.coordinator.broker import CapacityBroker
+from tpu_on_k8s.metrics.metrics import (AutoscaleMetrics, BrokerMetrics,
+                                        LedgerMetrics, SimMetrics)
 from tpu_on_k8s.obs.ledger import DecisionLedger
 from tpu_on_k8s.obs.slo import page_onsets
 from tpu_on_k8s.obs.trace import Tracer
+from tpu_on_k8s.serve.batchlane import BatchLane
 from tpu_on_k8s.sim.clock import EventLoop, SimClock
 from tpu_on_k8s.sim.devices import SimFleet, SimRequest
 from tpu_on_k8s.sim.scenario import Scenario
@@ -121,6 +123,28 @@ class DigitalTwin:
         sc = self.scenario
         self.cluster = InMemoryCluster()
         self.manager = Manager()
+        # The capacity market, on the virtual clock: the broker's tick
+        # thread is never started — `_broker_tick` drives `run_once`
+        # as a scheduled event, so clearing order is deterministic.
+        self.broker: Optional[CapacityBroker] = None
+        self.broker_metrics: Optional[BrokerMetrics] = None
+        self.batch_lane: Optional[BatchLane] = None
+        if sc.broker_capacity_chips > 0:
+            self.broker_metrics = BrokerMetrics()
+            self.broker = CapacityBroker(
+                sc.broker_capacity_chips, ledger=self.ledger,
+                metrics=self.broker_metrics,
+                period_s=sc.broker_period_s)
+            if sc.batch_max_units > 0:
+                self.batch_lane = BatchLane(
+                    max_units=sc.batch_max_units,
+                    default_work=sc.batch_work)
+                for _ in range(sc.batch_backlog):
+                    self.batch_lane.submit()
+                self.broker.register(self.batch_lane.name,
+                                     self.batch_lane.bid,
+                                     apply_fn=self.batch_lane.apply,
+                                     managed=True)
         setup_inferenceservice_controller(self.cluster, self.manager,
                                           clock=self.clock)
         elastic = ElasticController(self.cluster,
@@ -134,7 +158,8 @@ class DigitalTwin:
                                 config=job_cfg,
                                 elastic_controller=elastic)
         self.train_scaler = setup_elastic_autoscaler(self.cluster,
-                                                     ledger=self.ledger)
+                                                     ledger=self.ledger,
+                                                     broker=self.broker)
         self.kubelet = KubeletSim(self.cluster)
         # every reconciler workqueue onto the virtual clock (tpujob's
         # default is wall monotonic — delayed requeues would otherwise
@@ -168,7 +193,7 @@ class DigitalTwin:
             config=JobControllerConfig(autoscale_window_scrapes=3,
                                        autoscale_stale_scrapes=3),
             metrics=AutoscaleMetrics(), clock=self.clock,
-            tracer=self.tracer, ledger=self.ledger)
+            tracer=self.tracer, ledger=self.ledger, broker=self.broker)
 
         if sc.train_workers > 0:
             template = PodTemplateSpec(spec=PodSpec(
@@ -215,6 +240,9 @@ class DigitalTwin:
                             start_at=sc.train_obs_period_s, until=end)
             self.loop.every(sc.train_scale_period_s, self._train_tick,
                             start_at=sc.train_scale_period_s, until=end)
+        if self.broker is not None:
+            self.loop.every(sc.broker_period_s, self._broker_tick,
+                            start_at=sc.broker_period_s, until=end)
         for at_s, note in sc.preempt_times():
             self.loop.at(at_s, lambda n=note: self._preempt(n))
 
@@ -298,6 +326,15 @@ class DigitalTwin:
             es = job.status.elastic_statuses.get(TaskType.WORKER)
             if es is not None and es.continue_scaling is False:
                 self._train_frozen = True
+
+    def _broker_tick(self) -> None:
+        """One market clearing + one batch-lane pump on the virtual
+        clock. The pump runs AFTER the clearing so a harvest lands
+        before the lane admits more backlog into the doomed slots —
+        the within-one-tick yield the lane promises."""
+        self.broker.run_once()
+        if self.batch_lane is not None:
+            self.batch_lane.step()
 
     def _preempt(self, note: str) -> None:
         """Device-layer chaos: kill the newest live replica. No
@@ -422,6 +459,12 @@ class DigitalTwin:
                 job.spec.tasks[TaskType.WORKER].num_tasks
                 if job is not None else 0)
             out["train_frozen"] = self._train_frozen
+        if self.broker is not None:
+            out["broker_ticks"] = self.broker.tick_count()
+            out["broker_decisions"] = len(self.broker.decision_lines())
+        if self.batch_lane is not None:
+            out["batch"] = self.batch_lane.snapshot()
+            out["batch_intact"] = self.batch_lane.intact()
         return out
 
     # ------------------------------------------------------------- output
@@ -440,6 +483,8 @@ class DigitalTwin:
             "slo_event_log": self.autoscaler.slo_event_lines()}
         if self.chaos_events:
             extra["chaos_events"] = self.chaos_events
+        if self.broker is not None:
+            extra["broker_decision_log"] = self.broker.decision_lines()
         self.ledger.dump(paths["ledger"], extra=extra)
         svc = self.cluster.get(InferenceService, SERVICE_NS, SERVICE_NAME)
         slo_status = svc.status.slo or {}
