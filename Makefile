@@ -7,7 +7,7 @@
 IMG ?= tpu-on-k8s/manager:latest
 
 .PHONY: test test-fast analyze analyze-concurrency lint chaos-soak fleet-soak autoscale-soak \
-        disagg-soak spec-soak paged-soak shard-soak slo-soak reshard-soak twin-soak broker-soak trace-demo why-demo native bench dryrun manager samples clean \
+        disagg-soak spec-soak paged-soak shard-soak slo-soak reshard-soak twin-soak broker-soak multimodel-soak trace-demo why-demo native bench dryrun manager samples clean \
         docker-build docker-push deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
@@ -23,6 +23,7 @@ SLO_SEED ?= 9753
 RESHARD_SEED ?= 6172
 TWIN_SEED ?= 97
 BROKER_SEED ?= 1357
+MULTIMODEL_SEED ?= 7531
 TRACE_SEED ?= 8642
 # the why-demo trace: a second breach after the scale-down re-pages the
 # budget; the urgent 2->4 scale-up closes with a LIVE burn recovery
@@ -106,6 +107,10 @@ twin-soak:  ## 24-virtual-hour million-request digital-twin rehearsal, twice: by
 broker-soak:  ## burst + training + batch backlog contending for 12 chips, twice: byte-identical artifact set + nonzero batch goodput + zero silent loss + every preemption why-resolved
 	JAX_PLATFORMS=cpu python tools/broker_soak.py broker_contention \
 	    --seed $(BROKER_SEED) --check
+
+multimodel-soak:  ## 50 zipf-weighted models pooled on one fleet, twice: byte-identical artifact set + whole catalog served under swap churn + per-model budgets hold + peak chips strictly under the one-replica-per-model control arm
+	JAX_PLATFORMS=cpu python tools/multimodel_soak.py multi_model_density \
+	    --seed $(MULTIMODEL_SEED) --check
 
 reshard-soak:  ## live mesh reshard vs checkpoint-restart on the seeded cost model, twice: byte-identical event logs + pause & goodput wins
 	JAX_PLATFORMS=cpu python tools/reshard_soak.py --seed $(RESHARD_SEED) \

@@ -38,6 +38,7 @@ from tpu_on_k8s.metrics.metrics import (
     FleetMetrics,
     JobMetrics,
     LedgerMetrics,
+    ModelPoolMetrics,
     ReshardMetrics,
     ServingMetrics,
     ShardMetrics,
@@ -560,12 +561,25 @@ def _populate(m):
         m.set_gauge("free_chips", 4.0)
         m.set_gauge("pressure_lanes", 1.0)
         m.set_gauge("capacity_chips", 12.0)
+    elif isinstance(m, ModelPoolMetrics):
+        m.inc("model_requests", label="model-00")
+        m.inc("model_tokens", 64, label="model-00")
+        m.inc("model_requests", label="model-01")
+        m.inc("swaps", 3)
+        m.inc("swap_failures")
+        m.inc("swap_retries")
+        m.inc("evictions", 2)
+        m.inc("prefix_flushes", 2)
+        m.observe("swap_seconds", 0.05)
+        m.observe("swap_seconds", 0.25)
+        m.set_gauge("resident_models", 4.0)
+        m.set_gauge("queued_requests", 2.0)
 
 
 _ALL_CLASSES = (JobMetrics, ServingMetrics, SpecMetrics, PagedKVMetrics,
                 TrainMetrics, FleetMetrics, AutoscaleMetrics, ShardMetrics,
                 SLOMetrics, ReshardMetrics, LedgerMetrics, SimMetrics,
-                BrokerMetrics)
+                BrokerMetrics, ModelPoolMetrics)
 
 
 class TestExposition:

@@ -186,6 +186,13 @@ class AutoscalePolicy:
     #: pool's scaling signal in disaggregated serving — queue-wait says
     #: "prefill cannot keep up", TPOT says "decode cannot keep up"
     target_tpot_s: float = 0.0
+    #: model swap-in latency p95 SLO (seconds; 0 disables) for
+    #: multi-model replicas (`serve/modelpool.py`): swap-in is the
+    #: pool's cold-start cost, and when its p95 breaches this target the
+    #: density bet has failed — models are fighting over too few
+    #: replicas and the fleet needs more residency, exactly like a TTFT
+    #: breach says it needs more decode seats
+    target_swap_s: float = 0.0
     util_high: float = 0.0
     util_low: float = 0.0
     hysteresis: float = 0.1
@@ -208,6 +215,7 @@ class AutoscalePolicy:
             target_ttft_s=max(float(self.target_ttft_s), 0.0),
             target_queue_wait_s=max(float(self.target_queue_wait_s), 0.0),
             target_tpot_s=max(float(self.target_tpot_s), 0.0),
+            target_swap_s=max(float(self.target_swap_s), 0.0),
             util_high=max(float(self.util_high), 0.0),
             util_low=max(float(self.util_low), 0.0),
             hysteresis=max(float(self.hysteresis), 0.0),
@@ -322,6 +330,62 @@ class SLOObjectiveStatus:
 
 
 @dataclass
+class ModelRef:
+    """One model of a multi-model service (``spec.models``): a replica's
+    ``ModelPool`` (`serve/modelpool.py`) hosts ALL of them behind one
+    engine and hot-swaps the active params. ``name`` keys everything —
+    the pool's request lanes, ledger ``model_swap`` records, metric
+    labels, and ``status.models``. ``model_name`` follows that
+    ``Model``'s ``status.latest_image`` (the same closed loop as
+    ``spec.model_name``); ``image`` pins an explicit image and wins.
+    All pooled models MUST share the service's config shape — a
+    params-tree replace cannot change architecture (the pool's swap path
+    enforces it; a mismatched ref surfaces as a swap failure, never a
+    silent misload).
+
+    ``token_budget`` is a per-model tokens/sec admission budget riding
+    the tenant accounting plane (`serve/admission.py` — the model id is
+    the tenant key; 0 = unlimited). ``slo`` carries per-MODEL objectives
+    the fleet autoscaler evaluates into ``status.models[name].slo``
+    beside the service-level ``spec.slo``."""
+
+    name: str = ""
+    model_name: str = ""
+    image: str = ""
+    token_budget: int = 0
+    slo: Optional[SLOPolicy] = None
+
+    def normalized(self) -> Optional["ModelRef"]:
+        """Defaulted-and-clamped copy, or None for an unkeyable ref
+        (empty ``name``) — the same drop-dead-entries posture as
+        ``SLOObjective``."""
+        if not str(self.name):
+            return None
+        return ModelRef(
+            name=str(self.name),
+            model_name=str(self.model_name),
+            image=str(self.image),
+            token_budget=max(int(self.token_budget), 0),
+            slo=self.slo.normalized() if self.slo is not None else None)
+
+
+@dataclass
+class ModelStatus:
+    """One pooled model's observed state in ``status.models``: the
+    ``image`` the reconciler resolved for it (model-ref indirection
+    follows ``Model.status.latest_image`` — pool membership converges by
+    WEIGHT HOT-SWAP from here, never a pod rollout), a coarse ``phase``
+    (``Pending`` while no image exists to load), and the per-model
+    ``slo`` budget states the fleet autoscaler's tick writes (same shape
+    as the service-level ``status.slo``)."""
+
+    name: str = ""
+    image: str = ""
+    phase: str = "Pending"
+    slo: Dict[str, SLOObjectiveStatus] = field(default_factory=dict)
+
+
+@dataclass
 class PoolSpec:
     """One pool of a disaggregated service (`tpu_on_k8s/serve/disagg.py`).
     ``replicas`` is that pool's size — hand-set, or owned by the fleet
@@ -432,6 +496,28 @@ class InferenceServiceSpec:
     #: consulted when the operator runs a broker at all — with none,
     #: this block is inert.
     broker: Optional[BrokerPolicy] = None
+    #: non-empty = multi-model density: every replica hosts a
+    #: ``ModelPool`` over these refs (`serve/modelpool.py`) and the
+    #: router multiplexes by model (`serve/router.route_model`).
+    #: MEMBERSHIP edits converge by weight hot-swap through
+    #: ``status.models`` — they never roll the fleet; only toggling the
+    #: block on/off does (the replica runtime must be built
+    #: pool-capable, which is part of the replica identity).
+    models: List[ModelRef] = field(default_factory=list)
+
+    def models_normalized(self) -> List[ModelRef]:
+        """The live model refs: dead entries dropped, duplicate names
+        de-duplicated (first wins — a duplicate would make the pool's
+        lanes and ``status.models`` ambiguous)."""
+        out: List[ModelRef] = []
+        seen = set()
+        for ref in self.models:
+            norm = ref.normalized()
+            if norm is None or norm.name in seen:
+                continue
+            seen.add(norm.name)
+            out.append(norm)
+        return out
 
 
 class ServicePhase(str, enum.Enum):
@@ -469,6 +555,11 @@ class InferenceServiceStatus:
     #: by the fleet autoscaler's tick — objective name → burn rates,
     #: budget remaining, typed state, staleness
     slo: Dict[str, SLOObjectiveStatus] = field(default_factory=dict)
+    #: per-model observed state (``spec.models`` non-empty): the
+    #: reconciler writes each entry's resolved ``image``/``phase`` (pool
+    #: membership converges by hot-swap from here), the fleet autoscaler
+    #: writes each entry's ``slo`` budget states
+    models: Dict[str, ModelStatus] = field(default_factory=dict)
 
 
 @dataclass

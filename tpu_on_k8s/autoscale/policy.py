@@ -185,6 +185,16 @@ class Recommender:
                 and obs.tpot_p95 > tpot_slo * h:
             reasons.append(f"tpot_p95={_fmt(obs.tpot_p95)}"
                            f">slo={_fmt(tpot_slo)}")
+        # model swap-in latency: the multi-model cold-start signal — a
+        # breach means models are churning through too little residency
+        # and the fleet needs more replicas (duck-typed getattr like
+        # tpot, so policy stubs without the knob keep working)
+        swap_slo = getattr(p, "target_swap_s", 0.0)
+        swap_p95 = getattr(obs, "swap_p95", None)
+        if swap_slo > 0 and swap_p95 is not None \
+                and swap_p95 > swap_slo * h:
+            reasons.append(f"swap_p95={_fmt(swap_p95)}"
+                           f">slo={_fmt(swap_slo)}")
         util = obs.tokens_per_slot
         if p.util_high > 0 and util is not None and util > p.util_high:
             reasons.append(f"tokens_per_slot={_fmt(util)}"
@@ -204,6 +214,10 @@ class Recommender:
         tpot_slo = getattr(p, "target_tpot_s", 0.0)
         if tpot_slo > 0 and obs.tpot_p95 is not None:
             worst = max(worst, obs.tpot_p95 / tpot_slo)
+        swap_slo = getattr(p, "target_swap_s", 0.0)
+        swap_p95 = getattr(obs, "swap_p95", None)
+        if swap_slo > 0 and swap_p95 is not None:
+            worst = max(worst, swap_p95 / swap_slo)
         util = obs.tokens_per_slot
         if p.util_high > 0 and util is not None:
             worst = max(worst, util / p.util_high)
@@ -250,9 +264,10 @@ class Recommender:
         p = self.policy
         h = 1.0 - p.hysteresis
         tpot_slo = getattr(p, "target_tpot_s", 0.0)
+        swap_slo = getattr(p, "target_swap_s", 0.0)
         idle = obs.queue_depth == 0 and obs.inflight_tokens == 0
         if not (p.target_ttft_s > 0 or p.target_queue_wait_s > 0
-                or tpot_slo > 0 or p.util_low > 0):
+                or tpot_slo > 0 or swap_slo > 0 or p.util_low > 0):
             # no scale-down signal configured at all: a zero-signal
             # policy must hold, not ratchet a live fleet to min on
             # "queue happens to be empty"
@@ -278,6 +293,14 @@ class Recommender:
                 if not idle:
                     return False
             elif obs.tpot_p95 >= tpot_slo * h:
+                return False
+        if swap_slo > 0:
+            # a breaching swap p95 blocks shrink; NO swap data does not
+            # (an all-warm pool that never swaps is the goal state, not
+            # missing evidence — unlike request latency, absence of
+            # swaps under live traffic is itself a healthy signal)
+            swap_p95 = getattr(obs, "swap_p95", None)
+            if swap_p95 is not None and swap_p95 >= swap_slo * h:
                 return False
         if p.util_low > 0:
             util = obs.tokens_per_slot

@@ -58,6 +58,12 @@ class FleetSample:
     ttft: Tuple[float, ...] = ()
     queue_wait: Tuple[float, ...] = ()
     tpot: Tuple[float, ...] = ()
+    #: model swap-in latencies (seconds) since the previous scrape —
+    #: multi-model replicas (`serve/modelpool.py`) mirror their pool's
+    #: ``swap_seconds`` histogram into the replica metrics; swap-in is
+    #: the pool's cold-start cost and a first-class scaling signal
+    #: beside TTFT. Single-model fleets never populate it.
+    swap: Tuple[float, ...] = ()
     queue_depth: int = 0
     inflight_tokens: int = 0
     slots: int = 0
@@ -96,6 +102,10 @@ class FleetObservation:
     #: signal in disaggregated serving; defaulted so pre-disagg
     #: constructors (and their tests) stay source-compatible
     tpot_p95: Optional[float] = None
+    #: model swap-in latency p95 (seconds) — the multi-model cold-start
+    #: signal (`policy.target_swap_s`); defaulted for the same
+    #: source-compatibility reason as ``tpot_p95``
+    swap_p95: Optional[float] = None
 
     @property
     def tokens_per_slot(self) -> Optional[float]:
@@ -144,6 +154,7 @@ class FleetScraper:
         ttft = []
         qwait = []
         tpot = []
+        swap = []
         exemplars = []
         slots = 0
         inflight = 0
@@ -165,7 +176,11 @@ class FleetScraper:
                 continue
             for key, out in (("time_to_first_token_seconds", ttft),
                              ("queue_wait_seconds", qwait),
-                             ("time_per_output_token_seconds", tpot)):
+                             ("time_per_output_token_seconds", tpot),
+                             # multi-model replicas mirror their pool's
+                             # swap-in latency here; the mirror is a
+                             # defaultdict, so plain fleets read empty
+                             ("swap_seconds", swap)):
                 # snapshot under the mirror lock: the gateway appends
                 # from the driver thread while this scrape runs in the
                 # autoscaler's. Position by the monotone observation
@@ -200,7 +215,7 @@ class FleetScraper:
                                  if isinstance(tid, int))
         return FleetSample(
             seq=seq, ttft=tuple(ttft), queue_wait=tuple(qwait),
-            tpot=tuple(tpot),
+            tpot=tuple(tpot), swap=tuple(swap),
             queue_depth=fleet.queue_depth, inflight_tokens=inflight,
             slots=slots, ready_replicas=ready,
             exemplars=tuple(exemplars))
@@ -228,7 +243,7 @@ def format_observation_line(sample: FleetSample, *, epoch: int,
             f"queue_depth={sample.queue_depth} "
             f"inflight={sample.inflight_tokens} "
             f"slots={sample.slots} ready={sample.ready_replicas} "
-            f"tpot={p95(sample.tpot):.6f}")
+            f"tpot={p95(sample.tpot):.6f} swap={p95(sample.swap):.6f}")
 
 
 def sample_from_line(line: str, seq: int) -> Optional[FleetSample]:
@@ -262,7 +277,7 @@ def sample_from_line(line: str, seq: int) -> Optional[FleetSample]:
 
     return FleetSample(
         seq=seq, ttft=_lat("latency"), queue_wait=_lat("queue_wait"),
-        tpot=_lat("tpot"),
+        tpot=_lat("tpot"), swap=_lat("swap"),
         queue_depth=_int("queue_depth"), inflight_tokens=_int("inflight"),
         slots=_int("slots"), ready_replicas=_int("ready"))
 
@@ -343,6 +358,7 @@ class SignalAggregator:
         ttft = [v for s in live for v in s.ttft]
         qwait = [v for s in live for v in s.queue_wait]
         tpot = [v for s in live for v in s.tpot]
+        swap = [v for s in live for v in s.swap]
         latest = live[-1] if live else None
         stale = self._dead_streak >= self.stale_after or latest is None
         return FleetObservation(
@@ -350,9 +366,10 @@ class SignalAggregator:
             ttft_p95=percentile(ttft, 0.95),
             queue_wait_p95=percentile(qwait, 0.95),
             tpot_p95=percentile(tpot, 0.95),
+            swap_p95=percentile(swap, 0.95),
             queue_depth=latest.queue_depth if latest else 0,
             inflight_tokens=latest.inflight_tokens if latest else 0,
             slots=latest.slots if latest else 0,
             ready_replicas=latest.ready_replicas if latest else 0,
-            samples=len(ttft) + len(qwait) + len(tpot),
+            samples=len(ttft) + len(qwait) + len(tpot) + len(swap),
             stale=stale)

@@ -34,6 +34,7 @@ from tpu_on_k8s.chaos.faults import (
     SITE_FLEET_REPLICA,
     SITE_FLEET_ROLLOUT,
     SITE_KV_HANDOFF,
+    SITE_MODEL_SWAP,
     SITE_RECONCILE,
     SITE_RESHARD,
     SITE_REST_REQUEST,
@@ -68,6 +69,7 @@ from tpu_on_k8s.chaos.faults import (
     StaleBid,
     StaleBidError,
     StepFailure,
+    SwapFailure,
     TimeoutFault,
     WatchDrop,
 )
@@ -95,6 +97,7 @@ __all__ = [
     "SITE_FLEET_REPLICA",
     "SITE_FLEET_ROLLOUT",
     "SITE_KV_HANDOFF",
+    "SITE_MODEL_SWAP",
     "SITE_RECONCILE",
     "SITE_RESHARD",
     "SITE_REST_REQUEST",
@@ -131,6 +134,7 @@ __all__ = [
     "StaleBid",
     "StaleBidError",
     "StepFailure",
+    "SwapFailure",
     "TimeoutFault",
     "Trigger",
     "WatchDrop",

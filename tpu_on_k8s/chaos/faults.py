@@ -20,6 +20,7 @@ API.
 | serve.fleet.replica    | ServingFleet.step (per replica)     | ReplicaCrash, ReadinessFlap |
 | serve.fleet.rollout    | ServingFleet rollout transitions    | RolloutInterrupt |
 | serve.kv.handoff       | DisaggFleet prefill→decode transfer | HandoffLoss, HandoffCorrupt |
+| serve.model.swap       | ModelPool.activate params replace   | SwapFailure |
 | autoscale.signal       | FleetAutoscaler signal scrape       | SignalOutage |
 | autoscale.patch        | FleetAutoscaler spec.replicas patch | Conflict, HttpError, TimeoutFault |
 | broker.grant           | CapacityBroker grant apply          | StaleBid, Conflict |
@@ -49,6 +50,7 @@ SITE_SPEC_DRAFT = "serve.engine.spec_draft"
 SITE_FLEET_REPLICA = "serve.fleet.replica"
 SITE_FLEET_ROLLOUT = "serve.fleet.rollout"
 SITE_KV_HANDOFF = "serve.kv.handoff"
+SITE_MODEL_SWAP = "serve.model.swap"
 SITE_TRAIN_STEP = "train.step"
 SITE_TRAIN_SAVE = "train.save"
 SITE_TRAIN_PREEMPT = "train.preempt"
@@ -109,6 +111,11 @@ SITE_REGISTRY = {
         "`serve/disagg.py` prefill→decode transfer",
         ("HandoffLoss", "HandoffCorrupt"),
         "checksum reject + replay; token-identical oracle"),
+    SITE_MODEL_SWAP: (
+        "`serve/modelpool.py` params-tree replace",
+        ("SwapFailure",),
+        "previous params stay live; swap counted and retried, "
+        "zero silent request loss"),
     SITE_TRAIN_STEP: (
         "`train/loop.py` dispatched step",
         ("StepFailure",),
@@ -343,6 +350,21 @@ class HandoffCorrupt(Fault):
     re-prefill replay path instead of decoding garbage."""
 
     kind: ClassVar[str] = "handoff_corrupt"
+
+
+@dataclasses.dataclass(frozen=True)
+class SwapFailure(Fault):
+    """A model hot-swap dies mid-replace (a torn orbax read, an OOM while
+    staging the incoming tree, a device_put that never lands). The swap
+    is a params-tree replace with the new tree fully validated and staged
+    BEFORE the engine's pointer moves — so the recovery under test is
+    atomicity: the PREVIOUS model's params stay live and keep serving,
+    the failure is counted (``ModelPoolMetrics.swap_failures``) and the
+    swap retried on the scheduler's next pass, and every request queued
+    for the incoming model still reaches a typed terminal state — zero
+    silent loss."""
+
+    kind: ClassVar[str] = "swap_failure"
 
 
 @dataclasses.dataclass(frozen=True)

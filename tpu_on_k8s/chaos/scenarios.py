@@ -213,6 +213,53 @@ def autoscale_under_crash(replica: str = "replica-1", *,
     return Scenario("autoscale-under-crash", tuple(rules), seed)
 
 
+def model_swap_failure(*, at_swap: int = 2, model: str = "",
+                       seed: int = 0) -> Scenario:
+    """Fail the ``at_swap``-th model hot-swap mid-replace (counted per
+    `serve/modelpool.ModelPool` activation; ``model`` narrows it to one
+    model's swap-ins). The fault fires BEFORE the engine's params
+    pointer moves, so the recovery under test is atomicity: the
+    PREVIOUS model keeps serving, the failure is counted and ledgered
+    with its ``chaos#N`` trigger ref, the swap retries on the next
+    scheduler pass, and every request queued for the incoming model
+    still reaches a typed terminal state — zero silent loss."""
+    match = {"model": model} if model else {}
+    return Scenario("model-swap-failure", (
+        FaultRule(faults.SITE_MODEL_SWAP,
+                  Trigger(at=(at_swap,), match=match),
+                  faults.SwapFailure(),
+                  note=(f"fail swap #{at_swap}"
+                        + (f" into {model}" if model else ""))),
+    ), seed)
+
+
+def broker_grant_under_crash(replica: str = "replica-1", *,
+                             grant_at: Tuple[int, ...] = (1,),
+                             crash_at: int = 3, consumer: str = "",
+                             seed: int = 0) -> Scenario:
+    """The market under compound weather: the ``grant_at``-th broker
+    grant applies against a stale bid (``consumer`` narrows it to one
+    lane) WHILE a serving replica dies mid-burst (fleet step
+    ``crash_at`` of ``replica``). Recovery under test: the faulted
+    grant rejects the WHOLE lane transition — no partial apply, the
+    conflict is ledgered, the refused lane burns no cooldown and the
+    market re-clears from fresh bids next tick — while the crashed
+    replica's requests re-route under the replay budget with zero
+    silent loss; neither failure is allowed to mask the other."""
+    match = {"consumer": consumer} if consumer else {}
+    return Scenario("broker-grant-under-crash", (
+        FaultRule(faults.SITE_BROKER_GRANT,
+                  Trigger(at=grant_at, match=match),
+                  faults.StaleBid(),
+                  note=("stale-bid the grant apply"
+                        + (f" of {consumer}" if consumer else ""))),
+        FaultRule(faults.SITE_FLEET_REPLICA,
+                  Trigger(at=(crash_at,), match={"replica": replica}),
+                  faults.ReplicaCrash(),
+                  note=f"crash {replica} mid-burst"),
+    ), seed)
+
+
 def live_reshard_abort(at_transform: int = 1, *, seed: int = 0) -> Scenario:
     """Abort the ``at_transform``-th live mesh reshard mid-transform
     (counted per transfer-plan execution, `parallel/reshard.py`). The

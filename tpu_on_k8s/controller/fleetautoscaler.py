@@ -56,6 +56,7 @@ from tpu_on_k8s.api.core import Pod
 from tpu_on_k8s.api.inference_types import (
     DecodePolicy,
     InferenceService,
+    ModelStatus,
     SLOObjectiveStatus,
 )
 from tpu_on_k8s.autoscale.policy import (
@@ -164,6 +165,7 @@ class _AutoscaleLoop(LoopKernel):
         return (("ttft_p95", _fmt_signal(o.ttft_p95)),
                 ("queue_wait_p95", _fmt_signal(o.queue_wait_p95)),
                 ("tpot_p95", _fmt_signal(o.tpot_p95)),
+                ("swap_p95", _fmt_signal(o.swap_p95)),
                 ("queue_depth", str(o.queue_depth)),
                 ("inflight", str(o.inflight_tokens)),
                 ("slots", str(o.slots)),
@@ -251,6 +253,15 @@ class _ServiceState(_AutoscaleLoop):
         #: looking budget window formally refills; recovery belongs to
         #: the EPISODE, not to one horizon surviving long enough)
         self.page_up_seq: Optional[int] = None
+        # --- per-model SLO evaluation (``spec.models[].slo``) ---
+        #: model name → its own SLOEngine, fed through the autoscaler's
+        #: ``observe_model_latency`` and published to
+        #: ``status.models[name].slo`` — a model can burn its budget
+        #: while the service-level aggregate looks healthy (zipf
+        #: traffic: the head models drown the tail in every aggregate)
+        self.model_slo: Dict[str, SLOEngine] = {}
+        self.model_slo_key: Optional[Tuple] = None
+        self.model_slo_written: Optional[Dict] = None
 
     # ------------------------------------------------------------ kernel hooks
     def observe(self, ctx) -> Optional[_TickPack]:
@@ -375,6 +386,8 @@ class FleetAutoscaler:
         disaggregated — when either pool carries an autoscale block."""
         if svc.spec.autoscale is not None or svc.spec.slo is not None:
             return True
+        if any(m.slo is not None for m in svc.spec.models):
+            return True   # per-model SLOs: the tick evaluates them too
         pools = svc.spec.pools
         return pools is not None and (
             pools.prefill.autoscale is not None
@@ -591,6 +604,7 @@ class FleetAutoscaler:
 
     def _tick(self, key: str, svc: InferenceService,
               state: _ServiceState) -> None:
+        self._tick_model_slo(key, svc, state)
         if svc.spec.autoscale is None:
             # SLO-only service (``spec.slo`` without ``spec.autoscale``):
             # the tick still scrapes and evaluates — status.slo is the
@@ -766,6 +780,110 @@ class FleetAutoscaler:
             return False
         return not state.slo_bypass_used
 
+    # --------------------------------------------------------- per-model SLOs
+    def observe_model_latency(self, namespace: str, name: str, model: str,
+                              kind: str, seconds: float) -> None:
+        """Feed one per-MODEL latency observation (``ttft`` /
+        ``queue_wait`` / ``tpot``, seconds) into that model's SLO engine
+        — the in-process wiring for multi-model replicas: the pool/twin
+        attributes each request to its model and calls this per
+        completion. The pod-log scrape plane carries no per-model lines
+        yet, so unfed engines age into STALENESS (never zero — the same
+        no-data discipline as every other signal here)."""
+        key = f"{namespace}/{name}"
+        # the engine map AND the engine's windows are guarded by _lock:
+        # feeds arrive on caller threads while the tick thread rebuilds
+        # the map / evaluates the windows (SLOEngine has no lock of its
+        # own)
+        with self._lock:
+            state = self._services.get(key)
+            engine = (state.model_slo.get(model)
+                      if state is not None else None)
+            if engine is not None:
+                engine.observe_latency(kind, seconds)
+
+    def _ensure_model_slo(self, key: str, svc: InferenceService,
+                          state: _ServiceState) -> bool:
+        """(Re)build the per-model SLO engines when any ref's ``slo``
+        block changes — one engine per model carrying objectives, keyed
+        ``<service>/<model>`` so the SLO metrics plane labels them
+        apart. Same no-carryover rule as the service engine: window
+        contents do not survive a policy edit."""
+        refs = [m for m in svc.spec.models_normalized()
+                if m.slo is not None and m.slo.objectives]
+        mkey = tuple(
+            (m.name, tuple(tuple(sorted(vars(o).items()))
+                           for o in m.slo.objectives))
+            for m in refs)
+        if state.model_slo_key != mkey:
+            engines = {
+                m.name: SLOEngine(self._slo_specs(m.slo), clock=self.clock,
+                                  metrics=self.slo_metrics,
+                                  service=f"{key}/{m.name}")
+                for m in refs}
+            with self._lock:
+                state.model_slo_key = mkey
+                state.model_slo = engines
+                state.model_slo_written = None
+        with self._lock:
+            return bool(state.model_slo)
+
+    def _tick_model_slo(self, key: str, svc: InferenceService,
+                        state: _ServiceState) -> None:
+        """Evaluate every model's objectives and publish them to
+        ``status.models[<model>].slo`` — write-on-change, exactly like
+        the service-level ``status.slo``. The entry merge is field-
+        scoped: the reconciler owns ``image``/``phase``, this tick owns
+        ``slo``; neither write clobbers the other's fields."""
+        if not self._ensure_model_slo(key, svc, state):
+            if state.model_slo_written:
+                # per-model SLOs removed: frozen budget states must not
+                # linger on the CRD (the model entries themselves stay —
+                # they're the reconciler's)
+                def clear(s: InferenceService) -> None:
+                    for entry in s.status.models.values():
+                        entry.slo = {}
+                try:
+                    self.cluster.update_with_retry(
+                        InferenceService, svc.metadata.namespace,
+                        svc.metadata.name, clear, subresource="status")
+                except NotFoundError:
+                    pass
+                state.model_slo_written = None
+            return
+        rendered: Dict[str, Dict[str, SLOObjectiveStatus]] = {}
+        with self._lock:
+            evaluated = {model: state.model_slo[model].evaluate()
+                         for model in sorted(state.model_slo)}
+        for model, statuses in evaluated.items():
+            rendered[model] = {
+                name: SLOObjectiveStatus(
+                    objective=st.objective, target=st.target,
+                    state=st.state,
+                    burn_fast=(-1.0 if st.burn_fast is None
+                               else round(st.burn_fast, 4)),
+                    burn_slow=(-1.0 if st.burn_slow is None
+                               else round(st.burn_slow, 4)),
+                    budget_remaining=round(st.budget_remaining, 4),
+                    stale=st.stale)
+                for name, st in statuses.items()}
+        if rendered == state.model_slo_written:
+            return
+
+        def mutate(s: InferenceService) -> None:
+            for model, slo in rendered.items():
+                entry = s.status.models.get(model)
+                if entry is None:
+                    entry = s.status.models[model] = ModelStatus(name=model)
+                entry.slo = slo
+        try:
+            self.cluster.update_with_retry(
+                InferenceService, svc.metadata.namespace,
+                svc.metadata.name, mutate, subresource="status")
+            state.model_slo_written = rendered
+        except NotFoundError:
+            pass
+
     # ------------------------------------------------------------ pool loops
     def _tick_pools(self, key: str, svc: InferenceService,
                     state: _ServiceState) -> None:
@@ -779,6 +897,7 @@ class FleetAutoscaler:
         scraped exactly like a fleet); with none attached the window
         goes stale and the policy holds — per-pool log scraping needs
         pool-labelled pods the reconciler does not mint yet."""
+        self._tick_model_slo(key, svc, state)
         spec_pools = svc.spec.pools.normalized()
         pools = [p for p in ("prefill", "decode")
                  if getattr(spec_pools, p).autoscale is not None]

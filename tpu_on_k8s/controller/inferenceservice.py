@@ -57,6 +57,7 @@ from tpu_on_k8s.api.core import (
 )
 from tpu_on_k8s.api.inference_types import (
     InferenceService,
+    ModelStatus,
     RolloutPolicy,
     ServicePhase,
 )
@@ -88,7 +89,8 @@ def image_hash(image: str) -> str:
     return hashlib.sha1(image.encode()).hexdigest()[:8]
 
 
-def decode_variant(image: str, decode, sharding=None) -> str:
+def decode_variant(image: str, decode, sharding=None, *,
+                   pooled: bool = False) -> str:
     """The rollout identity of (image, DecodePolicy, ShardingPolicy):
     the decode policy and the mesh shape are part of what a replica
     RUNS (int8 weights, a speculative draft, the parallelism its
@@ -111,6 +113,12 @@ def decode_variant(image: str, decode, sharding=None) -> str:
         if not s.is_trivial():
             tags.append(f"mesh=d{s.data}m{s.model}e{s.expert}"
                         f",rules={s.rules}")
+    if pooled:
+        # ONLY the mode bit, never the member list: pool membership
+        # converges by weight hot-swap through status.models — folding
+        # the refs in would roll the fleet on every membership edit,
+        # defeating the hot-swap entirely
+        tags.append("pool=1")
     if not tags:
         return image
     return image + "#" + ";".join(tags)
@@ -190,6 +198,8 @@ class InferenceServiceReconciler:
                                      f"an image")
             return Result(requeue_after=self.config.sync_period_seconds)
 
+        models = svc.spec.models_normalized()
+        self._reconcile_models(svc, models)
         policy = svc.spec.rollout.normalized()
         desired = max(int(svc.spec.replicas), 0)
         hosts = topology.hosts_per_slice(svc.spec.tpu_policy.accelerator,
@@ -197,7 +207,8 @@ class InferenceServiceReconciler:
         groups = self._observed_groups(svc, hosts)
         sp.set(desired=desired, observed=len(groups))
         target_hash = image_hash(decode_variant(image, svc.spec.decode,
-                                                svc.spec.sharding))
+                                                svc.spec.sharding,
+                                                pooled=bool(models)))
         new = [g for g in groups if g.hash == target_hash]
         old = [g for g in groups if g.hash != target_hash]
 
@@ -275,6 +286,54 @@ class InferenceServiceReconciler:
                                         max(min(deadlines) - now, 0.01))
         return res
 
+    # ---------------------------------------------------------- model pool
+    def _reconcile_models(self, svc: InferenceService, models) -> None:
+        """Converge ``status.models`` onto the resolved spec refs: each
+        ref's image (explicit pin wins, else the named ``Model``'s
+        ``latest_image``) and a coarse phase. The replica pools follow
+        THIS map by weight hot-swap — resolving a new image here is the
+        whole deployment action for a pooled model; no pod rolls. The
+        autoscaler-owned ``slo`` sub-field of each entry is preserved,
+        and removed refs drop their entries (stale budget states must
+        not outlive their model)."""
+        if not models and not svc.status.models:
+            return
+        want: Dict[str, Tuple[str, str]] = {}
+        for ref in models:
+            img = ref.image
+            if not img and ref.model_name:
+                model = self.cluster.try_get(Model, svc.metadata.namespace,
+                                             ref.model_name)
+                img = model.status.latest_image if model is not None else ""
+            want[ref.name] = (img, "Ready" if img else "Pending")
+        have = {name: (st.image, st.phase)
+                for name, st in svc.status.models.items()}
+        if want == have:
+            return
+
+        def mutate(s: InferenceService) -> None:
+            for name in list(s.status.models):
+                if name not in want:
+                    del s.status.models[name]
+            for name, (img, phase) in want.items():
+                entry = s.status.models.get(name)
+                if entry is None:
+                    entry = s.status.models[name] = ModelStatus(name=name)
+                entry.image = img
+                entry.phase = phase
+        try:
+            self.cluster.update_with_retry(
+                InferenceService, svc.metadata.namespace,
+                svc.metadata.name, mutate, subresource="status")
+        except NotFoundError:
+            return
+        mutate(svc)   # keep this pass's snapshot coherent
+        self.cluster.record_event(
+            svc, "Normal", "ModelPoolResolved",
+            "model pool: " + ", ".join(
+                f"{n}={img or '<pending>'}"
+                for n, (img, _) in sorted(want.items())))
+
     # ------------------------------------------------------------- observed
     def _target_image(self, svc: InferenceService) -> str:
         if svc.spec.image:
@@ -318,6 +377,11 @@ class InferenceServiceReconciler:
         gang = self._gang_name(svc, hash_, index)
         serve_args = ["--serve", f"--n-slots={svc.spec.n_slots}",
                       f"--prefix-bucket-len={svc.spec.prefix_bucket_len}"]
+        if svc.spec.models_normalized():
+            # the mode bit only — the replica runtime builds a
+            # ModelPool and follows status.models for the member list
+            # (membership converges by hot-swap, never by pod args)
+            serve_args.append("--model-pool")
         if svc.spec.decode is not None:
             # thread the decode policy to the replica runtime as args —
             # the serving image's declared contract, like --serve and

@@ -799,6 +799,64 @@ class SimMetrics(_MetricsBase):
                           f"Digital twin {name}")
 
 
+class ModelPoolMetrics(_MetricsBase):
+    """Multi-model density telemetry (`tpu_on_k8s/serve/modelpool.py`):
+    the hot-swap plane one replica gang runs when it hosts several
+    ModelVersion serving trees. Counters: swaps applied (a params-tree
+    replace, no recompile), swap failures (the replace died mid-flight —
+    previous params stayed live; a climbing rate means the artifact
+    store or staging path is sick), swap retries, residency evictions
+    (a model pushed out of the LRU set — its prefix pages flushed
+    surgically), and the per-model token/request counters (labelled by
+    model, the tenant-accounting join key). The ``swap_seconds``
+    histogram is the measured swap-in latency — the cold-start signal
+    the FleetAutoscaler reads beside TTFT. Gauges: models resident
+    (prefixes warm on device) and queued requests across the per-model
+    lanes. Same prometheus + plain-dict mirror pattern as the other
+    classes; mirror dicts key by ``(name, label)`` like
+    ``AutoscaleMetrics``."""
+
+    _MODEL_COUNTERS = ("model_tokens", "model_requests")
+    _PLAIN_COUNTERS = ("swaps", "swap_failures", "swap_retries",
+                       "evictions", "prefix_flushes")
+    _PLAIN_GAUGES = ("resident_models", "queued_requests")
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        self.counters: Dict[Tuple[str, str], int] = defaultdict(int)
+        self.gauges: Dict[Tuple[str, str], float] = {}
+        if _prom is not None:
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_modelpool"
+        for name in self._MODEL_COUNTERS:
+            self._declare(name, f"{ns}_{name}", "counter",
+                          f"Model pool {name}", labels=("model",))
+        for name in self._PLAIN_COUNTERS:
+            self._declare(name, f"{ns}_{name}", "counter",
+                          f"Model pool {name}")
+        for name in self._PLAIN_GAUGES:
+            self._declare(name, f"{ns}_{name}", "gauge",
+                          f"Model pool {name}")
+        self._declare("swap_seconds", f"{ns}_swap_seconds", "histogram",
+                      "Model pool swap_seconds (swap-in latency: the "
+                      "cold-start signal beside TTFT)",
+                      buckets=_SERVING_BUCKETS)
+
+    def inc(self, name: str, n: int = 1, label: str = "") -> None:
+        with self._lock:
+            self.counters[(name, label)] += n
+        c = self._prom_counters.get(name)
+        if c is not None:
+            (c.labels(label) if name in self._MODEL_COUNTERS else c).inc(n)
+
+    def set_gauge(self, name: str, value: float, label: str = "") -> None:
+        with self._lock:
+            self.gauges[(name, label)] = value
+        g = self._prom_gauges.get(name)
+        if g is not None:
+            g.set(value)
+
+
 def count_detached_callback(metrics, message: str) -> None:
     """The count-and-warn tail shared by every streaming-callback
     isolation site (engine ``on_token``/``on_retire``, gateway and

@@ -1195,7 +1195,10 @@ class ContinuousBatchingEngine:
                       # engine's lifetime, and admissions stalled on an
                       # exhausted pool (the request stays queued)
                       "pages_allocated": 0, "pages_aliased": 0,
-                      "admission_stalls": 0}
+                      "admission_stalls": 0,
+                      # model hot-swap: params-tree replaces applied
+                      # (`replace_params` — the multi-model density path)
+                      "param_swaps": 0}
         #: hard bound on requests in flight (queued + prefilling + slots);
         #: ``submit`` past it raises ``EngineOverloadedError``. None keeps
         #: the historical unbounded queue (library use; the gateway bounds
@@ -1213,6 +1216,62 @@ class ContinuousBatchingEngine:
         # queue/bookkeeping against the driver — device work itself is
         # single-threaded by design.
         self._lock = threading.Lock()
+
+    # ---- model hot-swap ----------------------------------------------------
+    def replace_params(self, params, *, quantized: bool = False):
+        """Hot-swap the serving parameters: a params-tree REPLACE, never a
+        re-init. Every compiled program takes params as an argument, so a
+        tree with the identical structure and leaf shapes/dtypes swaps in
+        with ZERO recompilation — this is what lets one replica gang host
+        several ModelVersion trees (`serve/modelpool.py`) and change the
+        active model in milliseconds instead of a process restart.
+
+        The incoming tree rides the ctor's exact preparation path: int8
+        conversion when this engine serves int8 (skip with
+        ``quantized=True`` if the caller already converted), then the
+        shard plan's ``put_params`` when the engine runs on a mesh. The
+        same-config-shape contract is ENFORCED — a structure or
+        shape/dtype mismatch raises before anything is touched, so the
+        previous params always stay live on a refused swap.
+
+        The engine must be idle (no queued, prefilling, or in-slot
+        requests): a mid-request swap would splice two models into one
+        decode stream. The caller (the model pool's swap scheduler)
+        drains first; this check makes the contract self-enforcing.
+
+        Returns the previous (prepared) params tree so the caller can
+        keep it resident for the swap back."""
+        if self._draft is not None:
+            raise ValueError(
+                "replace_params on a speculative engine would desync the "
+                "draft from the target; model pools run plain engines")
+        if self.cfg.serve_int8_weights and not quantized:
+            params = quantize_weights_for_serving(params)
+        if self._plan is not None:
+            params = self._plan.put_params(params)
+        old_leaves, old_def = jax.tree.flatten(self._params)
+        new_leaves, new_def = jax.tree.flatten(params)
+        if new_def != old_def:
+            raise ValueError(
+                f"replace_params: tree structure mismatch (got {new_def}, "
+                f"engine serves {old_def}) — model pools host same-config "
+                f"trees only")
+        for old, new in zip(old_leaves, new_leaves):
+            if old.shape != new.shape or old.dtype != new.dtype:
+                raise ValueError(
+                    f"replace_params: leaf mismatch {new.shape}/{new.dtype}"
+                    f" vs {old.shape}/{old.dtype} — same config shape is "
+                    f"the swap contract")
+        with self._lock:
+            if (self._queue or self._kv_queue
+                    or self._prefilling is not None or self._admitting
+                    or any(s is not None for s in self._slots)):
+                raise RuntimeError(
+                    "replace_params on a busy engine: drain in-flight "
+                    "requests first (the swap scheduler's job)")
+            prev, self._params = self._params, params
+            self.stats["param_swaps"] += 1
+        return prev
 
     # ---- paged-pool helpers ------------------------------------------------
     def _count_compile(self) -> None:

@@ -20,7 +20,10 @@ program. This package is the missing layer between that and a service:
 * `disagg`     — ``DisaggFleet``: prefill and decode as separately-scaled
   pools with checksummed KV handoff between them;
 * `kvstore`    — ``FleetPrefixStore``: fleet-wide content-addressed
-  prefix/KV cache with a host-RAM overflow tier.
+  prefix/KV cache with a host-RAM overflow tier;
+* `modelpool`  — ``ModelPool``: several same-config models multiplexed
+  over one engine with params-tree hot-swap, LRU residency, and a
+  deterministic per-model-lane swap scheduler (multi-model density).
 """
 from tpu_on_k8s.serve.admission import (
     AdmissionConfig,
@@ -42,6 +45,7 @@ from tpu_on_k8s.serve.lifecycle import (
     RequestResult,
     RequestState,
 )
+from tpu_on_k8s.serve.modelpool import ModelPool
 from tpu_on_k8s.serve.router import Router
 from tpu_on_k8s.serve.scheduler import FairScheduler
 
@@ -57,6 +61,7 @@ __all__ = [
     "PoolReplica",
     "prefix_hash",
     "HealthMonitor",
+    "ModelPool",
     "ProbeConfig",
     "Rejected",
     "Replica",

@@ -29,6 +29,21 @@ in order per request:
 ``mode="random"`` replaces 2–3 with a seeded uniform pick — the control
 arm the prefix-affinity acceptance test compares against.
 
+**Model multiplexing** (`route_model`) stacks a fourth concern UNDER the
+three above when replicas host several models behind a ``ModelPool``
+(`serve/modelpool.py`): the affinity key is salted with the model id, so
+each model's traffic coheres onto its own ring point — batching a
+model's requests on few replicas is what lets the pool's swap scheduler
+amortize one swap-in across a whole lane instead of paying it per
+request. Candidate replicas are first filtered to those whose pool
+already holds the model **resident** (`set_resident`, fed from
+``ModelPool.resident_models``): a resident landing is a params pointer
+swap at worst and a no-op at best, while a non-resident landing pays a
+cold weight load plus an eviction elsewhere. Only when no ready replica
+holds the model resident does routing fall back to the full ready set —
+somebody has to take the cold swap, and the ring decides whom
+deterministically.
+
 The router holds no request state; the fleet feeds it the ready set and
 per-replica outstanding tokens each call, so it is trivially correct
 under replica churn (ejection, rollout surge/drain).
@@ -88,6 +103,9 @@ class Router:
         #: registered shared-prefix contents, keyed by length: the
         #: affinity key prefers these over the raw head bucket
         self._prefix_keys: Dict[int, set] = {}
+        #: replica → models its pool holds resident (`set_resident`);
+        #: absent = single-model replica, eligible for every model
+        self._resident: Dict[str, frozenset] = {}
 
     # ------------------------------------------------------------- topology
     def add_replica(self, name: str, version: str) -> None:
@@ -119,8 +137,22 @@ class Router:
     def _load(self, name: str, outstanding: Mapping[str, int]) -> float:
         return outstanding.get(name, 0) / self._capacity.get(name, 1.0)
 
+    def set_resident(self, name: str, models: Iterable[str]) -> None:
+        """Declare which models ``name``'s pool currently holds resident
+        (`ModelPool.resident_models`). The fleet refreshes this after
+        every pool step — residency drifts as pools evict — and
+        ``route_model`` prefers resident replicas so a request rarely
+        pays a cold weight load."""
+        self._resident[name] = frozenset(models)
+
+    def resident_of(self, name: str) -> frozenset:
+        """Models declared resident on ``name`` (empty when never
+        declared — which ``route_model`` reads as 'hosts anything')."""
+        return self._resident.get(name, frozenset())
+
     def remove_replica(self, name: str) -> None:
         self._capacity.pop(name, None)
+        self._resident.pop(name, None)
         if self._replicas.pop(name, None) is None:
             return
         self._ring = [(p, n) for p, n in self._ring if n != name]
@@ -235,6 +267,35 @@ class Router:
                 > self._load(least, outstanding) + self.spill_tokens):
             return least                      # bounded load: spill
         return aff
+
+    # --------------------------------------------------- model multiplexing
+    def model_key(self, model: str, key: int) -> int:
+        """Salt a prefix-affinity ``key`` with the model id. Two models'
+        identical prompts must NOT share a ring point: the prefix KV
+        under model A's params is useless (and unsafe) for model B, and
+        keeping each model's traffic on its own point is what batches a
+        lane for the pool's swap scheduler."""
+        return _hash64(model.encode() + key.to_bytes(8, "big"))
+
+    def route_model(self, model: str, prompt, ready: Sequence[str],
+                    outstanding: Mapping[str, int],
+                    exclude: Iterable[str] = (),
+                    key: Optional[int] = None) -> Optional[str]:
+        """``route`` for a multi-model fleet: prefer ready replicas whose
+        pool holds ``model`` resident (a warm landing), fall back to the
+        whole ready set when none does (someone must take the cold
+        swap). Replicas with no declared residency count as hosting
+        everything — a single-model fleet behaves exactly as ``route``
+        with a model-salted key. ``key`` is a precomputed
+        ``bucket_key(prompt)`` (UNsalted; salting happens here)."""
+        banned = set(exclude)
+        candidates = [r for r in ready if r not in banned]
+        warm = [r for r in candidates
+                if (res := self._resident.get(r)) is None or model in res]
+        pool = warm or candidates
+        raw = self.bucket_key(prompt) if key is None else key
+        return self.route(prompt, pool, outstanding,
+                          key=self.model_key(model, raw))
 
     def _ring_lookup(self, key: int, candidates: Sequence[str]
                      ) -> Optional[str]:

@@ -75,13 +75,24 @@ class _EngineStub:
 class DeviceCostModel:
     """Latency pricing for one virtual slice. ``step_s`` is the decode
     step wall-time; everything else is priced in step-times by the
-    serve_load constants above."""
+    serve_load constants above.
+
+    Multi-model pricing mirrors `serve/modelpool.ModelPool`'s two-tier
+    residency: dispatching a request for a model that is RESIDENT but
+    not active costs ``swap_s`` (a params-tree pointer replace), one
+    that is not resident costs ``swap_cold_s`` (host load + prepare)
+    and evicts the LRU resident when the pool is at
+    ``max_resident_models``. Both default to 0 with residency unbounded,
+    so every single-model scenario prices exactly as before."""
 
     step_s: float = 0.05
     step_base: float = STEP_BASE
     prefill_cost: float = PREFILL_COST
     compile_s: float = 30.0
     n_slots: int = 8
+    swap_s: float = 0.0
+    swap_cold_s: float = 0.0
+    max_resident_models: int = 0            # 0 = unbounded residency
 
     def prefill_s(self, prompt_len: int) -> float:
         return self.step_s * self.prefill_cost * prompt_len
@@ -101,12 +112,14 @@ class SimRequest:
 
     __slots__ = ("rid", "tenant", "prompt_len", "new_tokens", "submit_t",
                  "dispatch_t", "prefill_end_t", "first_token_t",
-                 "finish_t", "replica", "replays", "gen")
+                 "finish_t", "replica", "replays", "gen", "model")
 
     def __init__(self, rid: int, tenant: str, prompt_len: int,
-                 new_tokens: int, submit_t: float) -> None:
+                 new_tokens: int, submit_t: float,
+                 model: str = "") -> None:
         self.rid = rid
         self.tenant = tenant
+        self.model = model
         self.prompt_len = int(prompt_len)
         self.new_tokens = max(int(new_tokens), 1)
         self.submit_t = submit_t
@@ -131,10 +144,13 @@ class SimReplica:
     """One virtual serving replica: the scraper-facing attribute set
     plus slot bookkeeping. ``engine`` is None until the compile
     finishes — a starting replica contributes no slot capacity, exactly
-    like a real replica whose engine has not come up."""
+    like a real replica whose engine has not come up. ``active_model``
+    / ``resident`` mirror the model pool: one active params tree, an
+    LRU set of resident ones (insertion-ordered dict, oldest first)."""
 
     __slots__ = ("name", "cost", "state", "engine", "metrics",
-                 "outstanding", "routable", "inflight")
+                 "outstanding", "routable", "inflight", "active_model",
+                 "resident")
 
     def __init__(self, name: str, cost: DeviceCostModel) -> None:
         self.name = name
@@ -145,6 +161,8 @@ class SimReplica:
         self.outstanding = 0
         self.routable = False
         self.inflight: Dict[int, SimRequest] = {}   # rid -> request
+        self.active_model = ""
+        self.resident: Dict[str, None] = {}         # LRU, oldest first
 
     @property
     def free_slots(self) -> int:
@@ -174,7 +192,9 @@ class SimFleet:
         self.on_complete = on_complete
         self.replicas: Dict[str, SimReplica] = {}
         self.queue: Deque[SimRequest] = deque()
-        self.stats = {"scale_ups": 0, "scale_downs": 0, "preemptions": 0}
+        self.stats = {"scale_ups": 0, "scale_downs": 0, "preemptions": 0,
+                      "model_swaps": 0, "model_loads": 0,
+                      "model_evictions": 0}
         self.served = 0
         self.rejected = 0
         self.replayed = 0
@@ -291,27 +311,70 @@ class SimFleet:
         self._dispatch()
         return True
 
-    def _pick_replica(self) -> Optional[SimReplica]:
+    def _pick_replica(self, model: str = "") -> Optional[SimReplica]:
         """Most-free-slots routing, name tie-break — deterministic and
-        balancing, the shape the router's least-loaded policy has."""
+        balancing, the shape the router's least-loaded policy has. With
+        a model, affinity ranks first (active model beats resident beats
+        cold), the model-key salting the fleet router's ``route_model``
+        applies: swaps happen only when no warm replica has room."""
         best: Optional[SimReplica] = None
+        best_rank = None
         for name in sorted(self.replicas):
             rep = self.replicas[name]
-            if rep.free_slots > 0 and (best is None
-                                       or rep.free_slots > best.free_slots):
-                best = rep
+            if rep.free_slots <= 0:
+                continue
+            if model and rep.active_model != model:
+                affinity = 1 if model in rep.resident else 2
+            else:
+                affinity = 0
+            rank = (affinity, -rep.free_slots)
+            if best is None or rank < best_rank:
+                best, best_rank = rep, rank
         return best
+
+    def _swap_in(self, rep: SimReplica, model: str) -> float:
+        """Price one model activation on ``rep`` and update its
+        residency LRU. Returns the swap-in delay: ``swap_s`` when the
+        model was already resident (pointer replace), ``swap_cold_s``
+        when it had to be loaded — evicting the LRU resident (and, in
+        the real pool, surgically flushing its prefixes) when the pool
+        is at ``max_resident_models``."""
+        cost = self.cost
+        warm = model in rep.resident
+        delay = cost.swap_s if warm else cost.swap_cold_s
+        if warm:
+            del rep.resident[model]         # move-to-end: refresh LRU
+        else:
+            self.stats["model_loads"] += 1
+            cap = cost.max_resident_models
+            if cap > 0:
+                while len(rep.resident) >= cap:
+                    victim = next(iter(rep.resident))
+                    del rep.resident[victim]
+                    self.stats["model_evictions"] += 1
+        rep.resident[model] = None
+        rep.active_model = model
+        self.stats["model_swaps"] += 1
+        rep.metrics.observe("swap_seconds", delay)
+        return delay
 
     def _dispatch(self) -> None:
         now = self.loop.clock.t
         while self.queue:
-            rep = self._pick_replica()
+            req = self.queue[0]
+            rep = self._pick_replica(req.model)
             if rep is None:
                 return
-            req = self.queue.popleft()
+            self.queue.popleft()
             cost = self.cost
+            swap = 0.0
+            if req.model and rep.active_model != req.model:
+                swap = self._swap_in(rep, req.model)
+            elif req.model:
+                del rep.resident[req.model]  # refresh LRU on every hit
+                rep.resident[req.model] = None
             req.dispatch_t = now
-            req.prefill_end_t = now + cost.prefill_s(req.prompt_len)
+            req.prefill_end_t = now + swap + cost.prefill_s(req.prompt_len)
             req.first_token_t = req.prefill_end_t + cost.step_s
             req.finish_t = (req.prefill_end_t
                             + cost.decode_s(req.new_tokens))

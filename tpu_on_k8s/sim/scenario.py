@@ -29,7 +29,7 @@ from typing import List, Tuple
 from tpu_on_k8s.chaos import (SITE_AUTOSCALE_SIGNAL, FaultRule,
                               SignalOutage, Trigger)
 from tpu_on_k8s.sim.devices import DeviceCostModel
-from tpu_on_k8s.sim.traffic import DiurnalProfile, TenantMix
+from tpu_on_k8s.sim.traffic import DiurnalProfile, ModelMix, TenantMix
 
 CHAOS_SIGNAL_OUTAGE = "signal_outage"
 CHAOS_REPLICA_PREEMPT = "replica_preempt"
@@ -107,6 +107,21 @@ class Scenario:
     batch_backlog: int = 0
     batch_max_units: int = 0
     batch_work: int = 2
+
+    # multi-model density (0 models disables: no model column is drawn
+    # from the rng, no spec.models, every earlier preset byte-identical).
+    # The catalog is zipf-weighted — a few hot models, a long cold tail.
+    # ``model_slo_ttft_s`` > 0 gives EVERY catalog model a per-model
+    # TTFT objective on the CRD; ``target_swap_s`` > 0 arms the
+    # autoscaler's swap-latency cold-start signal.
+    n_models: int = 0
+    model_zipf_s: float = 1.05
+    model_slo_ttft_s: float = 0.0
+    target_swap_s: float = 0.0
+
+    def model_mix(self) -> ModelMix:
+        """The zipf catalog (call only when ``n_models`` > 0)."""
+        return ModelMix.zipf(self.n_models, s=self.model_zipf_s)
 
     def __post_init__(self):
         if self.duration_s <= 0 or self.tick_s <= 0:
@@ -209,6 +224,44 @@ def broker_contention(seed: int = 1357) -> Scenario:
         batch_backlog=400,
         batch_max_units=6,
         batch_work=2,
+    )
+
+
+def multi_model_density(seed: int = 7531) -> Scenario:
+    """The model-pool rehearsal: 50 small models behind one fleet,
+    zipf-weighted traffic (a few hot heads, a long cold tail), and a
+    residency cap that forces real swap churn — every cold-tail request
+    risks a ``swap_cold_s`` load that evicts the LRU resident, exactly
+    the `serve/modelpool.ModelPool` economics. Every model carries a
+    per-model TTFT objective (looser than the fleet SLO — the swap tax
+    is priced in), the autoscaler's ``target_swap_s`` cold-start signal
+    is armed, and a mid-run burst plus a replica preemption stress the
+    pool under churn. The acceptance question is density: the warm
+    chip floor must come in far under the one-replica-per-model control
+    arm (50 models x one 2x2 slice each) while the per-model budgets
+    hold. `make multimodel-soak` replays this twice and byte-compares
+    the artifact set."""
+    return Scenario(
+        name="multi_model_density",
+        seed=seed,
+        duration_s=600.0,
+        tick_s=0.25,
+        profile=DiurnalProfile(base_rate=8.0, amplitude=0.3,
+                               period_s=600.0, peak_at_s=300.0,
+                               bursts=((240.0, 90.0, 4.0),)),
+        cost=DeviceCostModel(step_s=0.05, compile_s=20.0, n_slots=8,
+                             swap_s=0.05, swap_cold_s=0.25,
+                             max_resident_models=8),
+        min_replicas=3, max_replicas=8,
+        target_ttft_s=0.6, slo_ttft_s=0.8, slo_window_s=150.0,
+        scrape_period_s=5.0, flap_guard_s=20.0,
+        train_workers=0,
+        chaos=(ChaosWindow(at_s=420.0, kind=CHAOS_REPLICA_PREEMPT,
+                           note="multimodel:preempt"),),
+        n_models=50,
+        model_zipf_s=1.05,
+        model_slo_ttft_s=1.5,
+        target_swap_s=0.4,
     )
 
 

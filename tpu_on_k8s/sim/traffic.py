@@ -146,13 +146,43 @@ def diurnal_rate(profile: DiurnalProfile, t: float) -> float:
 
 
 @dataclasses.dataclass(frozen=True)
+class ModelMix:
+    """Named models and their relative traffic weights — the
+    multi-model analogue of `TenantMix`. `zipf` builds the canonical
+    long-tail catalog (weight ``1/rank^s``): a handful of hot models
+    and a cold tail, the shape that makes one-replica-per-model
+    deployments waste chips and model pooling pay."""
+
+    names: Tuple[str, ...]
+    weights: Tuple[float, ...]
+
+    def __post_init__(self):
+        if len(self.names) != len(self.weights) or not self.names:
+            raise ValueError("ModelMix needs matching non-empty "
+                             "names/weights")
+        if min(self.weights) < 0 or sum(self.weights) <= 0:
+            raise ValueError("ModelMix weights must be >= 0, sum > 0")
+
+    @staticmethod
+    def zipf(n: int, s: float = 1.05, prefix: str = "model") -> "ModelMix":
+        if n <= 0:
+            raise ValueError("zipf catalog needs n >= 1")
+        return ModelMix(
+            names=tuple(f"{prefix}-{i:02d}" for i in range(n)),
+            weights=tuple(1.0 / (i + 1) ** s for i in range(n)))
+
+
+@dataclasses.dataclass(frozen=True)
 class ArrivalTrace:
     """A million-scale trace as flat numpy columns, one row per request,
     sorted by tick. Prompt *lengths* only — the simulated device layer
     prices prefill by length and never reads token values, and a million
     per-request ndarrays is exactly the allocation cost this generator
     exists to avoid. ``tick_offsets[i] : tick_offsets[i+1]`` slices the
-    rows arriving at tick ``i`` (len = n_ticks + 1)."""
+    rows arriving at tick ``i`` (len = n_ticks + 1). The ``model``
+    column exists only for multi-model traces (``models`` passed to the
+    builder); single-model traces leave it None and draw nothing extra
+    from the rng, so their bytes are unchanged."""
 
     tick_s: float
     tick: np.ndarray                        # int64 tick index per request
@@ -161,6 +191,8 @@ class ArrivalTrace:
     tenant: np.ndarray                      # int16 index into tenant_names
     tenant_names: Tuple[str, ...]
     tick_offsets: np.ndarray                # int64, len n_ticks + 1
+    model: Optional[np.ndarray] = None      # int16 index into model_names
+    model_names: Tuple[str, ...] = ()
 
     @property
     def n(self) -> int:
@@ -180,6 +212,20 @@ class ArrivalTrace:
         return {name: int(counts[i])
                 for i, name in enumerate(self.tenant_names)}
 
+    def model_of(self, j: int) -> str:
+        """Model name of request row ``j`` ('' on single-model traces)."""
+        if self.model is None:
+            return ""
+        return self.model_names[int(self.model[j])]
+
+    def model_counts(self):
+        """{model name: request count} ({} on single-model traces)."""
+        if self.model is None:
+            return {}
+        counts = np.bincount(self.model, minlength=len(self.model_names))
+        return {name: int(counts[i])
+                for i, name in enumerate(self.model_names)}
+
 
 def build_diurnal_trace(rng: np.random.Generator, *,
                         profile: DiurnalProfile,
@@ -187,12 +233,15 @@ def build_diurnal_trace(rng: np.random.Generator, *,
                         duration_s: float,
                         tick_s: float = 1.0,
                         prompt_lens: Sequence[int] = (4, 24),
-                        new_tokens: Sequence[int] = (4, 16)) -> ArrivalTrace:
+                        new_tokens: Sequence[int] = (4, 16),
+                        models: Optional[ModelMix] = None) -> ArrivalTrace:
     """The vectorized diurnal trace: per-tick rates off the profile
     curve, one Poisson draw per tick (vectorized), then single vectorized
     uniform draws for every per-request column. Draw order is fixed —
-    (counts, prompt_len, new_tokens, tenant) — so a trace is a pure
-    function of (seed, parameters); same seed, same bytes."""
+    (counts, prompt_len, new_tokens, tenant[, model]) — so a trace is a
+    pure function of (seed, parameters); same seed, same bytes. The
+    model column draws LAST and only when ``models`` is given, so every
+    pre-existing single-model trace keeps its exact bytes."""
     n_ticks = int(math.ceil(duration_s / tick_s))
     if n_ticks <= 0:
         raise ValueError("duration_s must cover at least one tick")
@@ -217,9 +266,19 @@ def build_diurnal_trace(rng: np.random.Generator, *,
     tenant = np.searchsorted(edges, rng.random(total),
                              side="right").astype(np.int16)
     np.minimum(tenant, len(tenants.names) - 1, out=tenant)
+    model = None
+    model_names: Tuple[str, ...] = ()
+    if models is not None:
+        mw = np.asarray(models.weights, dtype=np.float64)
+        medges = np.cumsum(mw / mw.sum())
+        model = np.searchsorted(medges, rng.random(total),
+                                side="right").astype(np.int16)
+        np.minimum(model, len(models.names) - 1, out=model)
+        model_names = tuple(models.names)
     offsets = np.zeros(n_ticks + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
     return ArrivalTrace(tick_s=float(tick_s), tick=tick, prompt_len=lp,
                         new_tokens=nt, tenant=tenant,
                         tenant_names=tuple(tenants.names),
-                        tick_offsets=offsets)
+                        tick_offsets=offsets, model=model,
+                        model_names=model_names)

@@ -39,7 +39,8 @@ from tpu_on_k8s.api.core import (Container, ObjectMeta, PodSpec,
 from tpu_on_k8s.api.inference_types import (AutoscalePolicy,
                                             InferenceService,
                                             InferenceServiceSpec,
-                                            SLOObjective, SLOPolicy)
+                                            ModelRef, SLOObjective,
+                                            SLOPolicy)
 from tpu_on_k8s.api.types import (ElasticPolicy, TaskSpec, TaskType,
                                   TPUJob, TPUJobSpec, TPUPolicy)
 from tpu_on_k8s.client import InMemoryCluster, KubeletSim
@@ -53,6 +54,7 @@ from tpu_on_k8s.controller.inferenceservice import (
 from tpu_on_k8s.controller.runtime import Manager, Workqueue
 from tpu_on_k8s.controller.tpujob import setup_tpujob_controller, submit_job
 from tpu_on_k8s.coordinator.broker import CapacityBroker
+from tpu_on_k8s.gang.topology import chips_in_topology
 from tpu_on_k8s.metrics.metrics import (AutoscaleMetrics, BrokerMetrics,
                                         LedgerMetrics, SimMetrics)
 from tpu_on_k8s.obs.ledger import DecisionLedger
@@ -70,6 +72,9 @@ SLO_FORMAT = "tpu-on-k8s-slo/v1"
 SERVICE_NS = "default"
 SERVICE_NAME = "twin"
 TRAIN_JOB = "train"
+
+#: the serving fleet's slice shape — one replica owns one of these
+REPLICA_TOPOLOGY = "2x2"
 
 #: spans whose request started within this many virtual seconds of a
 #: chaos window are pinned through the sampling knob — "chaos-adjacent"
@@ -109,6 +114,9 @@ class DigitalTwin:
         self._train_frozen = False
         self._svc_key = f"{SERVICE_NS}/{SERVICE_NAME}"
         sc = scenario
+        self._peak_replicas = sc.min_replicas
+        self.model_served: Dict[str, int] = {}
+        self._model_breaches: Dict[str, int] = {}
         self._keep_windows: List[Tuple[float, float]] = [
             (w.at_s - CHAOS_KEEP_MARGIN_S,
              w.at_s + w.duration_s + CHAOS_KEEP_MARGIN_S)
@@ -168,26 +176,38 @@ class DigitalTwin:
             c.queue = Workqueue(clock=self.clock)
 
         w = sc.slo_window_s
-        slo = SLOPolicy(objectives=[SLOObjective(
-            name="ttft", objective="ttft_p95", target=sc.slo_ttft_s,
-            window_s=w, fast_short_s=w / 60, fast_long_s=w / 20,
-            slow_short_s=w / 12, slow_long_s=w / 4)])
+
+        def ttft_slo(target: float) -> SLOPolicy:
+            return SLOPolicy(objectives=[SLOObjective(
+                name="ttft", objective="ttft_p95", target=target,
+                window_s=w, fast_short_s=w / 60, fast_long_s=w / 20,
+                slow_short_s=w / 12, slow_long_s=w / 4)])
+        # the model-pool catalog: every model on the CRD plane, each
+        # with its own (looser — the swap tax is priced in) TTFT budget
+        models = []
+        if sc.n_models > 0:
+            per_model = (ttft_slo(sc.model_slo_ttft_s)
+                         if sc.model_slo_ttft_s > 0 else None)
+            models = [ModelRef(name=m, image="inproc", slo=per_model)
+                      for m in sc.model_mix().names]
         self.cluster.create(InferenceService(
             metadata=ObjectMeta(name=SERVICE_NAME),
             spec=InferenceServiceSpec(
                 image="inproc", replicas=sc.min_replicas,
                 tpu_policy=TPUPolicy(accelerator="tpu-v5-lite-podslice",
-                                     topology="2x2"),
+                                     topology=REPLICA_TOPOLOGY),
                 autoscale=AutoscalePolicy(
                     min_replicas=sc.min_replicas,
                     max_replicas=sc.max_replicas,
                     min_warm=sc.min_warm,
                     target_ttft_s=sc.target_ttft_s,
+                    target_swap_s=sc.target_swap_s,
                     hysteresis=0.1, max_step=sc.max_step,
                     scale_up_cooldown_s=sc.up_cooldown_s,
                     scale_down_cooldown_s=sc.down_cooldown_s,
                     flap_guard_s=sc.flap_guard_s),
-                slo=slo)))
+                slo=ttft_slo(sc.slo_ttft_s),
+                models=models)))
         self.autoscaler = FleetAutoscaler(
             self.cluster,
             config=JobControllerConfig(autoscale_window_scrapes=3,
@@ -224,7 +244,8 @@ class DigitalTwin:
         self.trace = build_diurnal_trace(
             rng, profile=sc.profile, tenants=sc.tenants,
             duration_s=sc.duration_s, tick_s=sc.tick_s,
-            prompt_lens=sc.prompt_lens, new_tokens=sc.new_tokens)
+            prompt_lens=sc.prompt_lens, new_tokens=sc.new_tokens,
+            models=sc.model_mix() if sc.n_models > 0 else None)
 
     def _schedule(self) -> None:
         sc = self.scenario
@@ -254,7 +275,8 @@ class DigitalTwin:
         now = self.clock.t
         for j in tr.rows_for_tick(i):
             req = SimRequest(j, tr.tenant_names[tr.tenant[j]],
-                             tr.prompt_len[j], tr.new_tokens[j], now)
+                             tr.prompt_len[j], tr.new_tokens[j], now,
+                             model=tr.model_of(j))
             self._submitted += 1
             if not self.fleet.submit(req):
                 self.rejected += 1
@@ -272,6 +294,7 @@ class DigitalTwin:
 
     def _autoscale_tick(self) -> None:
         self.autoscaler.run_once()
+        self._peak_replicas = max(self._peak_replicas, self.fleet.size)
         lines = self.autoscaler.slo_event_lines().get(self._svc_key, [])
         onsets = page_onsets(lines)
         if len(onsets) > self._onsets_seen:
@@ -363,6 +386,17 @@ class DigitalTwin:
         check reads exactly 0). Returns the trace id to cite as the
         TTFT exemplar, or None when the sampling knob shed the trace —
         metrics must never cite a span the dump will not contain."""
+        if req.model:
+            # per-model accounting rides every completion (sampled or
+            # not): the CRD-plane SLO engines and the density summary
+            # must see the full population, not the retained traces
+            self.model_served[req.model] = \
+                self.model_served.get(req.model, 0) + 1
+            self.autoscaler.observe_model_latency(
+                SERVICE_NS, SERVICE_NAME, req.model, "ttft", req.ttft)
+            if req.ttft > self.scenario.model_slo_ttft_s > 0:
+                self._model_breaches[req.model] = \
+                    self._model_breaches.get(req.model, 0) + 1
         t = self.tracer
         root = t.start("request", at=req.submit_t, rid=req.rid,
                        tenant=req.tenant)
@@ -465,7 +499,42 @@ class DigitalTwin:
         if self.batch_lane is not None:
             out["batch"] = self.batch_lane.snapshot()
             out["batch_intact"] = self.batch_lane.intact()
+        if self.scenario.n_models > 0:
+            out["models"] = self._model_summary(svc)
         return out
+
+    def _model_summary(self, svc) -> Dict[str, Any]:
+        """The density verdict: swap churn, per-model SLO final states
+        off the CRD plane, and the chip-cost comparison against the
+        one-replica-per-model control arm (the deployment shape the
+        model pool exists to beat). ``chips`` prices the fleet's
+        actual peak; ``control_arm_chips`` prices a dedicated
+        ``REPLICA_TOPOLOGY`` slice per catalog model."""
+        sc = self.scenario
+        chips_per_replica = chips_in_topology(REPLICA_TOPOLOGY)
+        slo_states: Dict[str, str] = {}
+        if svc is not None:
+            for mname, mst in sorted(svc.status.models.items()):
+                for oname, ost in sorted(mst.slo.items()):
+                    slo_states[f"{mname}/{oname}"] = ost.state
+        exhausted = sorted(k for k, s in slo_states.items()
+                           if s == "exhausted")
+        top = sorted(self.model_served.items(),
+                     key=lambda kv: (-kv[1], kv[0]))[:5]
+        return {
+            "catalog": sc.n_models,
+            "served_models": len(self.model_served),
+            "swaps": self.fleet.stats["model_swaps"],
+            "loads": self.fleet.stats["model_loads"],
+            "evictions": self.fleet.stats["model_evictions"],
+            "top_served": [[m, n] for m, n in top],
+            "slo_engines": len(slo_states),
+            "slo_exhausted": exhausted,
+            "breaches": sum(self._model_breaches.values()),
+            "peak_replicas": self._peak_replicas,
+            "chips": self._peak_replicas * chips_per_replica,
+            "control_arm_chips": sc.n_models * chips_per_replica,
+        }
 
     # ------------------------------------------------------------- output
     def write(self, outdir: str) -> Dict[str, str]:
