@@ -7,7 +7,7 @@
 IMG ?= tpu-on-k8s/manager:latest
 
 .PHONY: test test-fast analyze analyze-concurrency lint chaos-soak fleet-soak autoscale-soak \
-        disagg-soak spec-soak paged-soak shard-soak slo-soak reshard-soak twin-soak broker-soak multimodel-soak trace-demo why-demo native bench dryrun manager samples clean \
+        disagg-soak spec-soak paged-soak shard-soak slo-soak reshard-soak twin-soak broker-soak multimodel-soak fuzz-smoke fuzz-soak trace-demo why-demo native bench dryrun manager samples clean \
         docker-build docker-push deploy undeploy
 
 # fixed seed so a red run is replayable verbatim; the soak itself prints
@@ -24,6 +24,7 @@ RESHARD_SEED ?= 6172
 TWIN_SEED ?= 97
 BROKER_SEED ?= 1357
 MULTIMODEL_SEED ?= 7531
+FUZZ_SEED ?= 1122
 TRACE_SEED ?= 8642
 # the why-demo trace: a second breach after the scale-down re-pages the
 # budget; the urgent 2->4 scale-up closes with a LIVE burn recovery
@@ -37,7 +38,7 @@ TRACE_FLAGS = --disagg --n-requests 24 --prefix-bucket 8 --prompt-min 4 \
     --prompt-max 12 --new-min 4 --new-max 8 --decode-replicas 2 \
     --shared-prefixes 2 --shared-fraction 0.8 --seed $(TRACE_SEED)
 
-test: analyze lint  ## invariant gate + lint first — they fail in seconds
+test: analyze lint fuzz-smoke  ## invariant gate + lint + fuzz acceptance first — they fail in seconds
 	python -m pytest tests/ -q
 
 test-fast:  ## skip the slow sharded-compile suites
@@ -111,6 +112,13 @@ broker-soak:  ## burst + training + batch backlog contending for 12 chips, twice
 multimodel-soak:  ## 50 zipf-weighted models pooled on one fleet, twice: byte-identical artifact set + whole catalog served under swap churn + per-model budgets hold + peak chips strictly under the one-replica-per-model control arm
 	JAX_PLATFORMS=cpu python tools/multimodel_soak.py multi_model_density \
 	    --seed $(MULTIMODEL_SEED) --check
+
+fuzz-smoke:  ## fixed-seed fixed-budget adversarial search over the twin: must find the planted regression, shrink it, and replay it byte-identically (prints FUZZ_SMOKE_FAILED seed=... on any failure)
+	JAX_PLATFORMS=cpu python tools/fuzz_run.py --smoke --seed $(FUZZ_SEED)
+
+fuzz-soak:  ## the budgeted campaign over every registered preset; confirmed minimized failures land in tests/fuzz_corpus/
+	JAX_PLATFORMS=cpu python tools/fuzz_run.py --soak --budget 64 \
+	    --seed $(FUZZ_SEED) --workers 4 --corpus-dir tests/fuzz_corpus
 
 reshard-soak:  ## live mesh reshard vs checkpoint-restart on the seeded cost model, twice: byte-identical event logs + pause & goodput wins
 	JAX_PLATFORMS=cpu python tools/reshard_soak.py --seed $(RESHARD_SEED) \

@@ -574,3 +574,98 @@ class TestBrokerPolicy:
                           preemption_cost=-2.0).normalized()
         assert bp.unit_chips == 1 and bp.preemption_cost == 0.0
         assert BrokerPolicy().degrade is True
+
+    def test_normalized_preserves_priced(self):
+        assert BrokerPolicy().normalized().priced is False
+        assert BrokerPolicy(priced=True).normalized().priced is True
+
+
+# ----------------------------------------------------------- priced bids
+def _obs(queue_depth, slots, seq=1):
+    from tpu_on_k8s.autoscale.signals import FleetObservation
+    return FleetObservation(seq=seq, ttft_p95=0.1, queue_wait_p95=0.01,
+                            queue_depth=queue_depth, inflight_tokens=0,
+                            slots=slots, ready_replicas=2, samples=3,
+                            stale=False)
+
+
+def _priced_env(capacity, priced):
+    clock = _Clock()
+    cluster = InMemoryCluster()
+    svc = _service()
+    svc.spec.broker = BrokerPolicy(priority=PRIORITY_SERVING,
+                                   preemption_cost=4.0, priced=priced)
+    svc = cluster.create(svc)
+    broker = CapacityBroker(capacity, ledger=DecisionLedger(clock))
+    scaler = FleetAutoscaler(
+        cluster, config=JobControllerConfig(autoscale_window_scrapes=3,
+                                            autoscale_stale_scrapes=3),
+        metrics=AutoscaleMetrics(), clock=clock, broker=broker)
+    scaler.register(svc)
+    return svc, scaler
+
+
+class TestPricedBids:
+    """`BrokerPolicy.priced`: marginal utility from live SLO burn +
+    queue pressure instead of the static 0.0 — and the regression
+    guarantee that unpriced configs never see the board."""
+
+    def test_static_config_bid_is_byte_identical(self):
+        # the board may fill (the autoscaler always writes it) but an
+        # unpriced bid must render exactly as it did before the feature
+        svc, scaler = _priced_env(8, priced=False)
+        hold = Decision(1, "hold", 2, 2, "within_band")
+        scaler._record("default/svc", svc, _obs(500, 4), hold)
+        with scaler._price_lock:
+            scaler._bid_prices.setdefault("default/svc", {})["burn"] = 9.9
+        bid = scaler._serving_bid("default/svc")
+        assert bid.marginal_utility == 0.0
+        assert bid.preemption_cost == 4.0
+
+    def test_priced_bid_prices_burn_and_queue(self):
+        svc, scaler = _priced_env(8, priced=True)
+        bid = scaler._serving_bid("default/svc")
+        assert bid.marginal_utility == 0.0     # no observations yet
+        hold = Decision(1, "hold", 2, 2, "within_band")
+        scaler._record("default/svc", svc, _obs(12, 4), hold)
+        with scaler._price_lock:
+            scaler._bid_prices["default/svc"]["burn"] = 2.5
+        bid = scaler._serving_bid("default/svc")
+        assert bid.marginal_utility == pytest.approx(2.5 + 12 / 4)
+
+    def test_pool_records_never_touch_the_service_price(self):
+        svc, scaler = _priced_env(8, priced=True)
+        hold = Decision(1, "hold", 2, 2, "within_band")
+        scaler._record("default/svc", svc, _obs(8, 4), hold)
+        scaler._record("default/svc", svc, _obs(999, 1), hold,
+                       pool="decode")
+        assert scaler._serving_bid(
+            "default/svc").marginal_utility == pytest.approx(2.0)
+
+    def test_deregister_clears_the_board(self):
+        svc, scaler = _priced_env(8, priced=True)
+        hold = Decision(1, "hold", 2, 2, "within_band")
+        scaler._record("default/svc", svc, _obs(8, 4), hold)
+        scaler._broker_deregister("default/svc")
+        with scaler._price_lock:
+            assert "default/svc" not in scaler._bid_prices
+        assert scaler._serving_bid("default/svc").marginal_utility == 0.0
+
+    def test_priced_utility_spares_the_busier_victim(self):
+        # two equal-priority equal-cost batch lanes; the one whose bid
+        # prices in live pressure must be harvested LAST ("the
+        # cheapest-to-preempt, least-useful chip goes first")
+        serve = _ScriptLane("serve", KIND_SERVING, PRIORITY_SERVING, 2)
+        idle = _ScriptLane("bat/idle", KIND_BATCH, PRIORITY_BATCH, 2,
+                           cost=1.0, util=0.0)
+        hot = _ScriptLane("bat/hot", KIND_BATCH, PRIORITY_BATCH, 2,
+                          cost=1.0, util=5.5)
+        b, led, clock = _broker(6)
+        b.register(serve.name, serve.bid)
+        b.register(idle.name, idle.bid, apply_fn=idle.apply)
+        b.register(hot.name, hot.bid, apply_fn=hot.apply)
+        b.run_once()
+        assert not b.request_capacity("serve", 2, 4)
+        b.run_once()
+        assert idle.applied and idle.applied[0][0] < 2
+        assert not hot.applied       # the priced-in lane was spared

@@ -1,0 +1,181 @@
+"""Cost-model calibration (`tpu_on_k8s/sim/calibrate.py`): fitting
+`DeviceCostModel` constants from chip-window measurement docs.
+
+What must hold:
+  extraction survives the real measurement-doc shapes — stage dicts
+  with `error`/nonzero-`rc` stages contributing nothing, flat
+  BENCH-style docs with a single `parsed` metric row — and never
+  invents evidence (an all-error doc fits nothing, every unfitted
+  constant keeps the base model's value); the closed-form fit recovers
+  known constants from synthetic samples (median step, least-squares
+  prefill slope through the origin, median compile); a Calibration
+  survives its doc round trip; and `CostBounds.around(...).clamp(...)`
+  confines mutated cost models to the calibrated band — the contract
+  the fuzzer's cost mutator relies on.
+"""
+import json
+
+import pytest
+
+from tpu_on_k8s.sim.calibrate import (CALIBRATION_FORMAT, Calibration,
+                                      CostBounds, Measurements,
+                                      calibration_from_doc,
+                                      extract_measurements, fit, fit_files,
+                                      main)
+from tpu_on_k8s.sim.devices import DeviceCostModel
+
+
+# ------------------------------------------------------------- extraction
+class TestExtraction:
+    def test_error_stages_contribute_nothing(self):
+        # the real CHIPWINDOW_r05.json shape: every stage dead
+        doc = {
+            "headline": {"metric": "decode_step_ms", "value": 4.2,
+                         "unit": "ms", "error": "oom"},
+            "decode": {"error": "device lost"},
+            "sweep_stage_a": {"err": "timeout"},
+            "longcontext": {"rc": 1, "tail": "...",
+                            "decode_steps": [0.05, 0.05]},
+            "updated": "2026-08-01",
+        }
+        m = extract_measurements(doc)
+        assert m == Measurements()
+
+    def test_live_stage_samples_and_metric_rows(self):
+        doc = {
+            "decode": {"rc": 0, "decode_steps": [0.04, 0.05, 0.06],
+                       "compiles": [21.0]},
+            "prefill": {"prefills": [[128, 0.32], [256, 0.64]]},
+            "headline": {"metric": "decode_step_ms", "value": 50.0,
+                         "unit": "ms"},
+        }
+        m = extract_measurements(doc)
+        assert m.decode_steps == (0.04, 0.05, 0.06, 0.05)  # ms converted
+        assert m.compiles == (21.0,)
+        assert m.prefills == ((128.0, 0.32), (256.0, 0.64))
+
+    def test_flat_bench_doc_shape(self):
+        # the real BENCH_r0N.json shape: one flat stage, parsed row
+        doc = {"n": 1, "cmd": "bench decode", "rc": 0, "tail": "ok",
+               "parsed": {"metric": "decode_step_s", "value": 0.045,
+                          "unit": "s", "vs_baseline": "1.0x"}}
+        assert extract_measurements(doc).decode_steps == (0.045,)
+
+    def test_flat_bench_doc_nonzero_rc_is_dead(self):
+        doc = {"n": 1, "cmd": "bench decode", "rc": 2,
+               "parsed": {"metric": "decode_step_s", "value": 0.045}}
+        assert extract_measurements(doc) == Measurements()
+
+    def test_garbage_values_are_skipped(self):
+        doc = {"s": {"decode_steps": [0.05, -1, "x", None, 0],
+                     "prefills": [[128], [0, 0.5], ["a", "b"], [64, 0.1]],
+                     "parsed": {"metric": "unknown_metric", "value": 3}}}
+        m = extract_measurements(doc)
+        assert m.decode_steps == (0.05,)
+        assert m.prefills == ((64.0, 0.1),)
+
+
+# -------------------------------------------------------------------- fit
+class TestFit:
+    def test_fit_recovers_planted_constants(self):
+        step = 0.05
+        m = Measurements(
+            decode_steps=(0.04, step, 0.06),              # median: 0.05
+            prefills=tuple((l, l * 0.002) for l in (64.0, 128.0, 256.0)),
+            compiles=(18.0, 22.0, 20.0))                  # median: 20.0
+        cal = fit(m)
+        assert cal.step_s == pytest.approx(step)
+        # slope 0.002 s/token over step_s 0.05 -> prefill_cost 0.04
+        assert cal.prefill_cost == pytest.approx(0.002 / step)
+        assert cal.compile_s == pytest.approx(20.0)
+        assert cal.fitted == ["step_s", "prefill_cost", "compile_s"]
+
+    def test_unfitted_constants_keep_the_base(self):
+        base = DeviceCostModel(step_s=0.07, prefill_cost=0.09,
+                               compile_s=33.0)
+        cal = fit(Measurements(), base)
+        assert cal.fitted == []
+        assert cal.cost_model(base) == base
+
+    def test_partial_evidence_partial_fit(self):
+        base = DeviceCostModel(step_s=0.07, prefill_cost=0.09,
+                               compile_s=33.0)
+        cal = fit(Measurements(decode_steps=(0.05,)), base)
+        assert cal.fitted == ["step_s"]
+        cm = cal.cost_model(base)
+        assert cm.step_s == pytest.approx(0.05)
+        assert cm.prefill_cost == 0.09 and cm.compile_s == 33.0
+
+    def test_direct_slopes_pool_with_pair_fit(self):
+        m = Measurements(decode_steps=(0.05,),
+                         prefills=((100.0, 0.2),),      # slope 0.002
+                         prefill_slopes=(0.004,))       # pooled: 0.003
+        cal = fit(m)
+        assert cal.prefill_cost == pytest.approx(0.003 / 0.05)
+        assert cal.n_prefills == 2
+
+    def test_fit_files_merges_docs(self, tmp_path):
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps(
+            {"s": {"decode_steps": [0.05]}}))
+        b.write_text(json.dumps(
+            {"n": 1, "rc": 0,
+             "parsed": {"metric": "compile_s", "value": 19.0}}))
+        cal = fit_files([str(a), str(b)])
+        assert cal.fitted == ["step_s", "compile_s"]
+        assert cal.compile_s == pytest.approx(19.0)
+
+
+# ------------------------------------------------------------- round trip
+class TestCalibrationDocs:
+    def test_round_trip(self):
+        cal = fit(Measurements(decode_steps=(0.05,), compiles=(20.0,)))
+        doc = cal.to_doc()
+        assert doc["format"] == CALIBRATION_FORMAT
+        assert calibration_from_doc(json.loads(json.dumps(doc))) == cal
+
+    def test_wrong_format_is_an_error(self):
+        with pytest.raises(ValueError, match="fmt"):
+            calibration_from_doc({"format": "fmt", "step_s": 1,
+                                  "prefill_cost": 1, "compile_s": 1})
+
+    def test_round_trip_preserves_evidence_counts(self):
+        cal = Calibration(step_s=0.05, prefill_cost=0.04, compile_s=20.0,
+                          n_steps=3, n_prefills=2, n_compiles=1)
+        assert calibration_from_doc(cal.to_doc()) == cal
+
+
+# ------------------------------------------------------------ cost bounds
+class TestCostBounds:
+    def test_clamp_confines_to_the_band(self):
+        base = DeviceCostModel(step_s=0.05, prefill_cost=0.05,
+                               compile_s=30.0)
+        bounds = CostBounds.around(base, spread=0.5)
+        wild = DeviceCostModel(step_s=1.0, prefill_cost=0.0001,
+                               compile_s=30.0)
+        clamped = bounds.clamp(wild)
+        assert clamped.step_s == pytest.approx(0.075)       # 0.05 * 1.5
+        assert clamped.prefill_cost == pytest.approx(0.05 / 1.5)
+        assert clamped.compile_s == 30.0                    # in band
+
+    def test_clamp_is_idempotent_inside_the_band(self):
+        base = DeviceCostModel()
+        bounds = CostBounds.around(base, spread=0.5)
+        assert bounds.clamp(base) == base
+
+
+# -------------------------------------------------------------------- CLI
+class TestCli:
+    def test_cli_fits_and_prints_json(self, tmp_path, capsys):
+        p = tmp_path / "m.json"
+        p.write_text(json.dumps({"s": {"decode_steps": [0.05, 0.05]}}))
+        assert main([str(p)]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["fitted"] == ["step_s"]
+
+    def test_cli_strict_fails_on_no_evidence(self, tmp_path, capsys):
+        p = tmp_path / "m.json"
+        p.write_text(json.dumps({"decode": {"error": "dead"}}))
+        assert main([str(p)]) == 0
+        assert main([str(p), "--strict"]) == 3

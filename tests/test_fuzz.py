@@ -1,0 +1,376 @@
+"""Scenario fuzzer (`tpu_on_k8s/sim/fuzz/`): the mutation engine, the
+failure oracle, the delta-debugging shrinker, and the regression corpus.
+
+What must hold:
+  a Scenario survives its JSON doc round trip byte-exactly (the corpus
+  depends on it) and a misspelled knob is an error, not a silent
+  default; mutation is a pure function of the RNG (same seed, same
+  mutant) and never escapes the virtual-time ceiling; every oracle
+  check fires on a synthetic record set built to trip it and stays
+  silent one notch below its threshold; the registered presets that
+  are supposed to pass really do judge clean while the planted
+  `slo_regression` preset really does fail; shrinking the same failing
+  scenario twice yields the same minimal scenario via the same pass
+  sequence; and every corpus entry in `tests/fuzz_corpus/` replays
+  byte-identically to its pinned verdict under the production report
+  gates — the whole point of checking a minimized failure in.
+"""
+import dataclasses
+import os
+import random
+
+import pytest
+
+from tpu_on_k8s.sim import fuzz as fz
+from tpu_on_k8s.sim.devices import DeviceCostModel
+from tpu_on_k8s.sim.fuzz import oracle as _oracle
+from tpu_on_k8s.sim.fuzz.mutate import MUTATORS, mutator_names
+from tpu_on_k8s.sim.fuzz.shrink import complexity
+from tpu_on_k8s.sim.scenario import (PRESETS, SCENARIO_FORMAT, ChaosWindow,
+                                     preset, preset_names, scenario_from_doc,
+                                     scenario_to_doc, slo_regression)
+from tpu_on_k8s.sim.traffic import DiurnalProfile, TenantMix
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "fuzz_corpus")
+
+
+def _tiny(**over):
+    """The smallest scenario the oracle reliably convicts (~0.3s wall):
+    a pinned single replica under an 8x flash crowd with a budget
+    window three times the run — `slo_budget_exhausted` by t=90."""
+    base = dict(
+        name="tiny_regression", seed=99, duration_s=90.0, tick_s=0.25,
+        profile=DiurnalProfile(base_rate=6.0, amplitude=0.0,
+                               period_s=90.0, peak_at_s=45.0,
+                               bursts=((20.0, 60.0, 8.0),)),
+        cost=DeviceCostModel(step_s=0.05, compile_s=20.0, n_slots=8),
+        min_replicas=1, max_replicas=1,
+        target_ttft_s=0.5, slo_ttft_s=0.6, slo_window_s=300.0,
+        scrape_period_s=5.0, flap_guard_s=20.0, train_workers=0)
+    base.update(over)
+    from tpu_on_k8s.sim.scenario import Scenario
+    return Scenario(**base)
+
+
+# ----------------------------------------------------------- serialization
+class TestScenarioDocs:
+    @pytest.mark.parametrize("name", sorted(PRESETS))
+    def test_every_preset_round_trips(self, name):
+        sc = preset(name)
+        doc = scenario_to_doc(sc)
+        assert doc["format"] == SCENARIO_FORMAT
+        assert scenario_from_doc(doc) == sc
+
+    def test_nested_structures_round_trip(self):
+        sc = _tiny(chaos=(ChaosWindow(at_s=10.0, kind="signal_outage",
+                                      duration_s=5.0, note="fuzzed"),),
+                   tenants=TenantMix(names=("a", "b"),
+                                     weights=(3.0, 1.0)))
+        assert scenario_from_doc(scenario_to_doc(sc)) == sc
+
+    def test_unknown_field_is_an_error(self):
+        doc = scenario_to_doc(_tiny())
+        doc["max_replicsa"] = 4                      # the typo must not
+        with pytest.raises(ValueError, match="max_replicsa"):
+            scenario_from_doc(doc)                   # become a default
+
+    def test_unknown_nested_field_is_an_error(self):
+        doc = scenario_to_doc(_tiny())
+        doc["cost"]["step_z"] = 1.0
+        with pytest.raises(ValueError, match="step_z"):
+            scenario_from_doc(doc)
+
+    def test_missing_field_takes_the_default(self):
+        # forward compat: an old corpus entry written before the DSL
+        # grew a knob keeps replaying with that knob at its default
+        doc = scenario_to_doc(_tiny())
+        removed = doc.pop("sample_every")
+        sc = scenario_from_doc(doc)
+        default = {f.name: f.default
+                   for f in dataclasses.fields(sc)}["sample_every"]
+        assert sc.sample_every == default
+        assert removed is not None
+
+    def test_wrong_format_is_an_error(self):
+        doc = scenario_to_doc(_tiny())
+        doc["format"] = "tpu-on-k8s-scenario/v999"
+        with pytest.raises(ValueError, match="v999"):
+            scenario_from_doc(doc)
+
+
+# --------------------------------------------------------------- registry
+class TestPresetRegistry:
+    def test_all_presets_registered(self):
+        assert set(preset_names()) >= {
+            "smoke", "million_diurnal", "broker_contention",
+            "multi_model_density", "slo_regression"}
+
+    def test_preset_seed_override(self):
+        assert preset("smoke").seed != preset("smoke", seed=7).seed == 7
+
+    def test_unknown_preset_is_an_error(self):
+        with pytest.raises(ValueError, match="no_such_scenario"):
+            preset("no_such_scenario")
+
+
+# --------------------------------------------------------------- mutation
+class TestMutate:
+    def test_same_seed_same_mutant(self):
+        base = preset("smoke")
+        a = fz.mutate(random.Random(42), base, 3)
+        b = fz.mutate(random.Random(42), base, 3)
+        assert a == b
+        assert a[0] != base and len(a[1]) == 3
+
+    def test_different_seeds_diverge(self):
+        base = preset("smoke")
+        outs = {fz.mutate(random.Random(s), base, 2)[0] for s in range(8)}
+        assert len(outs) > 1
+
+    def test_applied_names_come_from_the_catalog(self):
+        names = set(mutator_names())
+        assert len(names) == len(MUTATORS)      # no duplicate keys
+        _, applied = fz.mutate(random.Random(1), preset("smoke"), 4)
+        assert set(applied) <= names
+
+    def test_duration_never_escapes_the_ceiling(self):
+        cfg = fz.MutationConfig(max_virtual_s=120.0)
+        base = _tiny(duration_s=90.0)
+        for s in range(24):
+            sc, _ = fz.mutate(random.Random(s), base, 3, cfg)
+            assert sc.duration_s <= 120.0
+
+    def test_cost_mutations_respect_calibrated_bounds(self):
+        from tpu_on_k8s.sim.calibrate import CostBounds
+        base = _tiny()
+        bounds = CostBounds.around(base.cost, spread=0.25)
+        cfg = fz.MutationConfig(cost_bounds=bounds)
+        for s in range(48):
+            sc, applied = fz.mutate(random.Random(s), base, 2, cfg)
+            if "cost" in applied:
+                assert bounds.clamp(sc.cost) == sc.cost
+
+
+# ---------------------------------------------------- oracle (synthetic)
+def _decision(seq, t, action, *, loop="fleetautoscaler/default/twin",
+              commit="landed", horizon="none"):
+    return {"kind": "decision", "seq": seq, "t": t, "loop": loop,
+            "action": action, "commit": commit, "horizon": horizon,
+            "current": 2, "target": 3}
+
+
+class TestOracleChecks:
+    def test_thrash_fires_on_reversals_in_window(self):
+        recs = [_decision(i, 40.0 * i, a) for i, a in
+                enumerate(["up", "down", "up", "down"])]
+        cfg = fz.OracleConfig(thrash_reversals=3, thrash_window_s=300.0)
+        fails = _oracle._check_thrash(recs, cfg)
+        assert [f.kind for f in fails] == [fz.FAIL_THRASH]
+
+    def test_thrash_silent_one_notch_below(self):
+        recs = [_decision(i, 40.0 * i, a) for i, a in
+                enumerate(["up", "down", "up"])]          # 2 reversals
+        cfg = fz.OracleConfig(thrash_reversals=3, thrash_window_s=300.0)
+        assert _oracle._check_thrash(recs, cfg) == []
+
+    def test_thrash_ignores_refused_and_foreign_loops(self):
+        recs = [_decision(i, 10.0 * i, a, commit="patch_failed")
+                for i, a in enumerate(["up", "down", "up", "down"])]
+        recs += [_decision(10 + i, 10.0 * i, a, loop="broker/market")
+                 for i, a in enumerate(["up", "down", "up", "down"])]
+        assert _oracle._check_thrash(recs, fz.OracleConfig()) == []
+
+    def test_horizon_leak_and_grace(self):
+        sc = _tiny(duration_s=200.0)       # grace = 2*20 + 5 + 10 = 55
+        leak = _decision(1, 10.0, "up", horizon="open")
+        late = _decision(2, 160.0, "up", horizon="open")
+        closed = [_decision(3, 20.0, "up", horizon="open"),
+                  {"kind": "horizon", "decision": 3, "closing": True}]
+        cfg = fz.OracleConfig()
+        fails = _oracle._check_horizons([leak, late] + closed, sc, cfg)
+        assert len(fails) == 1 and "seq=1" in fails[0].detail
+        assert "seq=2" not in fails[0].detail       # inside the grace
+
+    def test_accounting_breaks(self):
+        ok = {"requests": 10, "served": 8, "rejected": 2,
+              "spans_dropped": 0, "batch_intact": True}
+        assert _oracle._check_accounting(ok) == []
+        bad = dict(ok, served=7)
+        assert [f.kind for f in _oracle._check_accounting(bad)] == [
+            fz.FAIL_ACCOUNTING]
+        assert len(_oracle._check_accounting(
+            dict(ok, spans_dropped=3, batch_intact=False))) == 2
+
+    def test_refusals(self):
+        assert _oracle._check_refusals({"rejected": 0}) == []
+        fails = _oracle._check_refusals({"rejected": 5})
+        assert [f.kind for f in fails] == [fz.FAIL_REFUSALS]
+
+    def test_verdict_dedups_and_sorts_kinds(self):
+        v = fz.Verdict.of([fz.Failure("b", "1"), fz.Failure("a", "2"),
+                           fz.Failure("b", "3")])
+        assert v.kinds == ("a", "b") and v.failing
+
+
+# -------------------------------------------------- oracle (end to end)
+class TestOracleOnPresets:
+    def test_smoke_judges_clean(self):
+        verdict, summary = fz.run_and_judge(preset("smoke"))
+        assert not verdict.failing, verdict.failures
+        assert summary["requests"] > 0
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("name", ["broker_contention",
+                                      "multi_model_density",
+                                      "million_diurnal"])
+    def test_blessed_presets_judge_clean(self, name):
+        # the oracle's calibration contract (`OracleConfig` docs):
+        # every passing registered preset is clean at the defaults
+        verdict, _ = fz.run_and_judge(preset(name))
+        assert not verdict.failing, (name, verdict.failures)
+
+    def test_planted_regression_is_convicted(self):
+        verdict, _ = fz.run_and_judge(slo_regression())
+        assert fz.FAIL_SLO_EXHAUSTED in verdict.kinds
+
+    def test_tiny_regression_is_convicted(self):
+        verdict, _ = fz.run_and_judge(_tiny())
+        assert verdict.kinds == (fz.FAIL_SLO_EXHAUSTED,)
+
+
+# ----------------------------------------------------------------- shrink
+class TestShrink:
+    def test_complexity_orders_obvious_simplifications(self):
+        sc = _tiny()
+        assert complexity(dataclasses.replace(sc, duration_s=60.0)) \
+            < complexity(sc)
+        assert complexity(dataclasses.replace(sc, chaos=(
+            ChaosWindow(at_s=1.0, kind="signal_outage",
+                        duration_s=2.0),))) > complexity(sc)
+
+    def test_shrink_is_deterministic_and_minimizing(self):
+        base = _tiny(chaos=(ChaosWindow(at_s=5.0, kind="signal_outage",
+                                        duration_s=3.0),),
+                     tenants=TenantMix(names=("a", "b"),
+                                       weights=(3.0, 1.0)))
+        verdict, _ = fz.run_and_judge(base)
+        assert verdict.failing
+
+        def judge(sc):
+            return fz.run_and_judge(sc)[0]
+
+        a = fz.shrink(base, verdict, judge, budget=10)
+        b = fz.shrink(base, verdict, judge, budget=10)
+        assert scenario_to_doc(a.scenario) == scenario_to_doc(b.scenario)
+        assert a.steps == b.steps and a.steps
+        assert complexity(a.scenario) < complexity(base)
+        assert fz.FAIL_SLO_EXHAUSTED in a.verdict.kinds
+        assert a.scenario.chaos == ()        # the noise got deleted
+
+    def test_shrink_respects_the_budget(self):
+        base = _tiny()
+        verdict, _ = fz.run_and_judge(base)
+        calls = []
+
+        def judge(sc):
+            calls.append(sc)
+            return fz.run_and_judge(sc)[0]
+
+        res = fz.shrink(base, verdict, judge, budget=3)
+        assert res.evals == len(calls) <= 3
+
+    def test_shrink_requires_a_failing_verdict(self):
+        with pytest.raises(ValueError):
+            fz.shrink(_tiny(), fz.Verdict.of([]), lambda sc: None)
+
+
+# ----------------------------------------------------------------- corpus
+class TestCorpus:
+    def _entry(self, sc, verdict):
+        return fz.make_entry(sc, verdict, base="tiny", fuzz_seed=1,
+                             mutations=("band",), shrink_steps=(),
+                             evals=1)
+
+    def test_entry_name_is_stable_and_content_addressed(self):
+        sc = _tiny()
+        v = fz.Verdict.of([fz.Failure(fz.FAIL_SLO_EXHAUSTED, "d")])
+        e1, e2 = self._entry(sc, v), self._entry(sc, v)
+        assert e1["name"] == e2["name"]
+        assert fz.FAIL_SLO_EXHAUSTED.replace(":", "_") in e1["name"]
+        e3 = self._entry(dataclasses.replace(sc, seed=100), v)
+        assert e3["name"] != e1["name"]
+
+    def test_write_load_round_trip(self, tmp_path):
+        sc = _tiny()
+        v = fz.Verdict.of([fz.Failure(fz.FAIL_SLO_EXHAUSTED, "d")])
+        path = fz.write_entry(str(tmp_path), self._entry(sc, v))
+        loaded = fz.load_entries(str(tmp_path))
+        assert [p for p, _ in loaded] == [path]
+        assert scenario_from_doc(loaded[0][1]["scenario"]) == sc
+        assert loaded[0][1]["oracle"]["kinds"] == [fz.FAIL_SLO_EXHAUSTED]
+
+    def test_bad_format_rejected_on_load(self, tmp_path):
+        (tmp_path / "x.json").write_text('{"format": "nope/v1"}')
+        with pytest.raises(ValueError, match="nope/v1"):
+            fz.load_entries(str(tmp_path))
+
+    def test_replay_is_byte_identical_and_verdict_pinned(self):
+        sc = _tiny()
+        verdict, _ = fz.run_and_judge(sc)
+        rep = fz.replay(self._entry(sc, verdict), fz.OracleConfig())
+        assert rep.byte_identical, rep.details
+        assert rep.kinds_match and rep.ok
+        assert set(rep.artifacts_sha256) == set(fz.ARTIFACTS)
+
+    def test_replay_flags_a_verdict_drift(self):
+        # pin a kind the scenario does not produce: replay must refuse
+        sc = preset("smoke")
+        v = fz.Verdict.of([fz.Failure(fz.FAIL_THRASH, "pinned wrong")])
+        rep = fz.replay(self._entry(sc, v), fz.OracleConfig())
+        assert rep.byte_identical and not rep.kinds_match and not rep.ok
+
+
+# ------------------------------------------------------------------ search
+class TestSearch:
+    def test_campaign_is_deterministic_and_finds_the_plant(self):
+        kwargs = dict(seed=7, budget=4, gen_size=2, shrink_budget=2)
+        a = fz.fuzz([_tiny()], **kwargs)
+        b = fz.fuzz([_tiny()], **kwargs)
+        assert a.to_doc() == b.to_doc()
+        assert a.entries and a.failures_found >= 1
+        assert a.evals <= a.budget
+        e = a.entries[0]
+        assert e["provenance"]["base"] == "tiny_regression"
+        assert fz.FAIL_SLO_EXHAUSTED in e["oracle"]["kinds"]
+
+    def test_campaign_counts_in_metrics(self):
+        from tpu_on_k8s.metrics.metrics import FuzzMetrics
+        m = FuzzMetrics()
+        fz.fuzz([_tiny()], seed=7, budget=3, gen_size=2,
+                shrink_budget=1, metrics=m)
+        assert m.counters["evals"] >= 2
+        assert m.counters["failures_found"] >= 1
+
+
+# ---------------------------------------------------- the checked-in corpus
+def _corpus_entries():
+    if not os.path.isdir(CORPUS_DIR):
+        return []
+    return fz.load_entries(CORPUS_DIR)
+
+
+@pytest.mark.parametrize(
+    "path,entry", _corpus_entries(),
+    ids=[e["name"] for _, e in _corpus_entries()] or None)
+def test_corpus_entry_replays_to_its_pinned_verdict(path, entry):
+    """The regression corpus: every minimized failure the fuzzer ever
+    checked in must still replay byte-identically to the exact verdict
+    pinned at check-in time — under the PRODUCTION report gates."""
+    from tools.fuzz_run import oracle_config
+    rep = fz.replay(entry, oracle_config())
+    assert rep.byte_identical, (path, rep.details)
+    assert rep.kinds_match, (path, rep.observed_kinds, rep.pinned_kinds)
+
+
+def test_corpus_is_not_empty():
+    assert _corpus_entries(), "tests/fuzz_corpus/ must hold at least one entry"

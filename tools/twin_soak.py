@@ -39,11 +39,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tpu_on_k8s.sim.scenario import million_diurnal, smoke  # noqa: E402
+from tpu_on_k8s.sim.scenario import PRESETS, preset  # noqa: E402
 from tpu_on_k8s.sim.twin import (LEDGER_FILE, SLO_FILE, SUMMARY_FILE,  # noqa: E402
                                  TRACE_FILE, run_twin)
 
-PRESETS = {"smoke": smoke, "million_diurnal": million_diurnal}
 ARTIFACTS = (TRACE_FILE, LEDGER_FILE, SLO_FILE, SUMMARY_FILE)
 
 
@@ -80,9 +79,13 @@ def main(argv=None) -> int:
         description="run a twin scenario twice, byte-compare the "
                     "artifact set, optionally gate the production "
                     "reports and the real-time speedup")
-    p.add_argument("scenario", nargs="?", default="smoke",
+    p.add_argument("scenario", nargs="?", default=None,
                    choices=sorted(PRESETS),
                    help="scenario preset (default: smoke)")
+    p.add_argument("--scenario", dest="scenario_opt", default=None,
+                   choices=sorted(PRESETS), metavar="NAME",
+                   help="scenario preset, as an option (overrides the "
+                        "positional form)")
     p.add_argument("--seed", type=int, default=None,
                    help="override the preset's seed")
     p.add_argument("--outdir", default=None,
@@ -98,8 +101,8 @@ def main(argv=None) -> int:
                    help="print the run-A summary as one JSON line")
     args = p.parse_args(argv)
 
-    sc = (PRESETS[args.scenario](args.seed) if args.seed is not None
-          else PRESETS[args.scenario]())
+    name = args.scenario_opt or args.scenario or "smoke"
+    sc = preset(name, seed=args.seed)
     base = args.outdir or tempfile.mkdtemp(prefix=f"twin_{sc.name}_")
     dir_a = os.path.join(base, "a")
     dir_b = os.path.join(base, "b")

@@ -435,19 +435,29 @@ class BrokerPolicy:
     pressure valve: allowed, the broker may flip this service onto
     cheaper ``DecodePolicy`` variants (int8 weights, deeper
     speculation) before taking anyone's chips. Absent ⇒ serving
-    defaults (top priority, 1 chip per replica, degradable)."""
+    defaults (top priority, 1 chip per replica, degradable).
+
+    ``priced`` opts the service into OBSERVED-signal bid pricing: the
+    fleet autoscaler derives the bid's ``marginal_utility`` from the
+    live SLO fast-burn rate plus queue depth per slot instead of the
+    static 0.0 every unpriced bid carries — a burning, backed-up
+    service becomes strictly more expensive to pick as a victim among
+    equal-priority bids. Default off: all-static configs produce
+    byte-identical broker decisions to pre-``priced`` builds."""
 
     priority: int = 100
     unit_chips: int = 1
     preemption_cost: float = 1.0
     degrade: bool = True
+    priced: bool = False
 
     def normalized(self) -> "BrokerPolicy":
         return BrokerPolicy(
             priority=int(self.priority),
             unit_chips=max(int(self.unit_chips), 1),
             preemption_cost=max(float(self.preemption_cost), 0.0),
-            degrade=bool(self.degrade))
+            degrade=bool(self.degrade),
+            priced=bool(self.priced))
 
 
 @dataclass

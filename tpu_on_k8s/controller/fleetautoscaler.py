@@ -374,6 +374,15 @@ class FleetAutoscaler:
         self.decision_log: Deque[str] = deque(maxlen=10_000)
         self._lock = threading.Lock()
         self._services: Dict[str, _ServiceState] = {}
+        # the bid price board for ``spec.broker.priced`` services:
+        # ``key -> {"burn": ..., "queue": ...}``, written by the tick
+        # thread (burn from `_evaluate_slo`, queue-per-slot from
+        # `_record`), read by `_serving_bid` on the BROKER's tick
+        # thread. Guarded by its own LEAF lock — always acquired alone,
+        # so the bid path still never touches this autoscaler's `_lock`
+        # (no lock-order edge between the two control loops)
+        self._price_lock = threading.Lock()
+        self._bid_prices: Dict[str, Dict[str, float]] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -459,9 +468,9 @@ class FleetAutoscaler:
         """Make the service a bidder on the capacity market (idempotent
         — re-registering would reset the lane's ledger loop). The
         bid/apply/degrade closures run on the BROKER's tick thread and
-        touch only the cluster client (its own lock) — never this
-        autoscaler's lock, so no lock-order edge exists between the two
-        control loops."""
+        touch only the cluster client (its own lock) and the
+        ``_price_lock`` leaf — never this autoscaler's lock, so no
+        lock-order edge exists between the two control loops."""
         broker = self.broker
         if broker is None:
             return
@@ -478,13 +487,24 @@ class FleetAutoscaler:
     def _broker_deregister(self, key: str) -> None:
         if self.broker is not None:
             self.broker.deregister(f"serve/{key}")
+        with self._price_lock:
+            self._bid_prices.pop(key, None)
 
     def _serving_bid(self, key: str):
         """The service's standing bid: hold what the spec holds (it
         expresses no future want — growth arrives through the
         ``request_capacity`` gate in ``_execute``), floored at the
         autoscale minimum plus the warm floor so a harvest can never
-        cut below what ``warm_floor`` scale-downs already protect."""
+        cut below what ``warm_floor`` scale-downs already protect.
+
+        With ``spec.broker.priced``, ``marginal_utility`` is the live
+        price off the board: SLO fast-burn rate plus queue depth per
+        slot, as of this autoscaler's last tick. The broker's victim
+        sort already orders equal-priority victims by ascending
+        utility, so a burning service keeps its chips while an idle
+        equal-priority one is harvested first. Unpriced bids keep the
+        static 0.0 — broker decisions for all-static configs are
+        byte-identical with or without this feature."""
         from tpu_on_k8s.coordinator.broker import (
             KIND_SERVING, PRIORITY_SERVING, Bid)
         ns, svc_name = key.split("/", 1)
@@ -505,11 +525,18 @@ class FleetAutoscaler:
             floor = (max(ap.min_replicas, ap.min_warm)
                      if ap is not None else cur)
         bp = svc.spec.broker
+        utility = 0.0
+        if bp is not None and bp.priced:
+            with self._price_lock:
+                price = dict(self._bid_prices.get(key) or ())
+            utility = round(price.get("burn", 0.0)
+                            + price.get("queue", 0.0), 6)
         return Bid(
             name=f"serve/{key}", kind=KIND_SERVING,
             priority=bp.priority if bp is not None else PRIORITY_SERVING,
             current=cur, desired=cur, floor=min(floor, cur) if cur else 0,
             unit=bp.unit_chips if bp is not None else 1,
+            marginal_utility=utility,
             preemption_cost=(bp.preemption_cost if bp is not None
                              else float(cur)))
 
@@ -727,6 +754,12 @@ class FleetAutoscaler:
         """Evaluate every objective, publish ``status.slo`` when it
         changed, and return the severity hint (see ``_tick_slo``)."""
         statuses = state.slo_engine.evaluate(span=span)
+        burn = max((st.burn_fast for st in statuses.values()
+                    if st.burn_fast is not None and not st.stale),
+                   default=0.0)
+        with self._price_lock:
+            self._bid_prices.setdefault(key, {})["burn"] = round(
+                max(burn, 0.0), 6)
         rendered = {
             name: SLOObjectiveStatus(
                 objective=st.objective, target=st.target, state=st.state,
@@ -1238,6 +1271,12 @@ class FleetAutoscaler:
         full signal set (every observed gauge is a valid policy input on
         either loop)."""
         label = key if pool is None else f"{key}/{pool}"
+        if pool is None:
+            # queue pressure per serving slot: the second term of the
+            # priced bid's marginal utility (see _serving_bid)
+            with self._price_lock:
+                self._bid_prices.setdefault(key, {})["queue"] = round(
+                    obs.queue_depth / max(obs.slots, 1), 6)
         self.decision_log.append(
             (f"svc={key} " if pool is None else f"svc={key} pool={pool} ")
             + decision.line())

@@ -576,7 +576,7 @@ class CapacityBroker:
                    and v not in self._pressure and v not in self._grants]
         victims.sort(key=lambda v: (bids[v].priority,
                                     bids[v].preemption_cost,
-                                    -bids[v].marginal_utility, v))
+                                    bids[v].marginal_utility, v))
         planned: List[Tuple[str, int]] = []
         remaining = shortfall
         for v in victims:

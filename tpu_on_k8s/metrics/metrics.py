@@ -799,6 +799,26 @@ class SimMetrics(_MetricsBase):
                           f"Digital twin {name}")
 
 
+class FuzzMetrics(_MetricsBase):
+    """Scenario-fuzz campaign telemetry (`tpu_on_k8s/sim/fuzz/`):
+    twin evaluations spent (exploration + shrink combined count here;
+    ``shrink_evals`` separates the minimization share), failures the
+    oracle confirmed, failures de-duplicated away as repeats of an
+    already-recorded (base, kind-set) signature, and corpus entries
+    emitted. All counters: a campaign is a batch run, the interesting
+    rates are per-campaign deltas, and the driver prints the totals."""
+
+    def __init__(self, registry=None) -> None:
+        super().__init__()
+        if _prom is not None:
+            self.registry = registry or _prom.CollectorRegistry()
+        ns = "tpu_on_k8s_fuzz"
+        for name in ("evals", "failures_found", "dedup_skipped",
+                     "shrink_evals", "corpus_entries"):
+            self._declare(name, f"{ns}_{name}", "counter",
+                          f"Scenario fuzzer {name}")
+
+
 class ModelPoolMetrics(_MetricsBase):
     """Multi-model density telemetry (`tpu_on_k8s/serve/modelpool.py`):
     the hot-swap plane one replica gang runs when it hosts several

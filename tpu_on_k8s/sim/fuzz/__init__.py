@@ -1,0 +1,48 @@
+"""Scenario fuzzing: seeded adversarial search over the `Scenario`
+DSL, run entirely against the digital twin.
+
+The pipeline is four stages, one module each:
+
+* `mutate`  — a seeded mutation engine that perturbs every knob the
+  DSL exposes (traffic curve, bursts, tenant/model mixes, chaos
+  windows, autoscale band, broker capacity, cost-model constants
+  within calibrated bounds);
+* `oracle`  — scores one twin run for *genuine* failures: SLO budget
+  exhaustion, autoscaler thrash, interactive refusals, zero-silent-loss
+  accounting breaks, open-horizon leaks on the decision ledger, and
+  production report-gate failures;
+* `shrink`  — a delta-debugging minimizer that simplifies a failing
+  scenario (drop chaos, drop bursts, shorten, halve traffic) while the
+  oracle still fires the same failure kinds;
+* `corpus`  — serializes minimized failures into ``tests/fuzz_corpus/``
+  entries that replay byte-identically and pin the oracle verdict;
+* `search`  — the budgeted driver tying them together.
+
+Everything is deterministic given (bases, seed, budget): the package
+lives under ``tpu_on_k8s/sim`` on purpose, so the determinism analyzer
+gates it like the twin itself — no wall clock, no ambient entropy.
+"""
+from tpu_on_k8s.sim.fuzz.corpus import (ARTIFACTS, CORPUS_FORMAT,
+                                        STATUS_GUARD, STATUS_WEAKNESS,
+                                        entry_name, load_entries,
+                                        make_entry, replay, write_entry)
+from tpu_on_k8s.sim.fuzz.mutate import (MUTATORS, MutationConfig, mutate,
+                                        mutator_names)
+from tpu_on_k8s.sim.fuzz.oracle import (FAIL_ACCOUNTING, FAIL_HORIZON,
+                                        FAIL_REFUSALS, FAIL_REPORT_PREFIX,
+                                        FAIL_SLO_EXHAUSTED, FAIL_THRASH,
+                                        Failure, OracleConfig, Verdict,
+                                        judge_run, run_and_judge)
+from tpu_on_k8s.sim.fuzz.search import FuzzResult, fuzz
+from tpu_on_k8s.sim.fuzz.shrink import complexity, shrink
+
+__all__ = [
+    "ARTIFACTS", "CORPUS_FORMAT", "STATUS_GUARD", "STATUS_WEAKNESS",
+    "entry_name",
+    "load_entries", "make_entry", "replay", "write_entry",
+    "MUTATORS", "MutationConfig", "mutate", "mutator_names",
+    "FAIL_ACCOUNTING", "FAIL_HORIZON", "FAIL_REFUSALS",
+    "FAIL_REPORT_PREFIX", "FAIL_SLO_EXHAUSTED", "FAIL_THRASH",
+    "Failure", "OracleConfig", "Verdict", "judge_run", "run_and_judge",
+    "FuzzResult", "fuzz", "complexity", "shrink",
+]

@@ -24,12 +24,14 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import List, Tuple
+from typing import Any, Callable, Dict, List, Tuple
 
 from tpu_on_k8s.chaos import (SITE_AUTOSCALE_SIGNAL, FaultRule,
                               SignalOutage, Trigger)
 from tpu_on_k8s.sim.devices import DeviceCostModel
 from tpu_on_k8s.sim.traffic import DiurnalProfile, ModelMix, TenantMix
+
+SCENARIO_FORMAT = "tpu-on-k8s-scenario/v1"
 
 CHAOS_SIGNAL_OUTAGE = "signal_outage"
 CHAOS_REPLICA_PREEMPT = "replica_preempt"
@@ -159,6 +161,34 @@ class Scenario:
 
 
 # ---------------------------------------------------------------- presets
+# Named registry: soak drivers select a base with --scenario=<name> and
+# the fuzzer enumerates these as mutation bases. Registration order is
+# definition order, which keeps any "iterate all presets" loop seeded
+# deterministically.
+PRESETS: Dict[str, Callable[..., Scenario]] = {}
+
+
+def register_preset(fn: Callable[..., Scenario]) -> Callable[..., Scenario]:
+    """Class the function as a named scenario preset (key = its name)."""
+    PRESETS[fn.__name__] = fn
+    return fn
+
+
+def preset(name: str, seed: int = None) -> Scenario:
+    """Build the named preset, optionally overriding its default seed."""
+    try:
+        fn = PRESETS[name]
+    except KeyError:
+        raise ValueError(f"unknown scenario preset {name!r}; "
+                         f"known: {', '.join(PRESETS)}") from None
+    return fn() if seed is None else fn(seed=seed)
+
+
+def preset_names() -> List[str]:
+    return list(PRESETS)
+
+
+@register_preset
 def smoke(seed: int = 2468) -> Scenario:
     """The tier-1 smoke scenario: ~10 virtual minutes, a few thousand
     requests, one burst that pages the TTFT budget and scales the fleet,
@@ -187,6 +217,7 @@ def smoke(seed: int = 2468) -> Scenario:
     )
 
 
+@register_preset
 def broker_contention(seed: int = 1357) -> Scenario:
     """The capacity-market rehearsal: a 12-chip cluster where everyone
     wants the same slices at once. At rest the market is nearly full —
@@ -227,6 +258,7 @@ def broker_contention(seed: int = 1357) -> Scenario:
     )
 
 
+@register_preset
 def multi_model_density(seed: int = 7531) -> Scenario:
     """The model-pool rehearsal: 50 small models behind one fleet,
     zipf-weighted traffic (a few hot heads, a long cold tail), and a
@@ -265,6 +297,7 @@ def multi_model_density(seed: int = 7531) -> Scenario:
     )
 
 
+@register_preset
 def million_diurnal(seed: int = 97) -> Scenario:
     """The acceptance scenario: 24 virtual hours, ≥1M requests across
     three tenants on a diurnal curve, two flash-crowd bursts (the
@@ -298,3 +331,103 @@ def million_diurnal(seed: int = 97) -> Scenario:
                            note="million:afternoon-preempt")),
         sample_every=64,
     )
+
+
+@register_preset
+def slo_regression(seed: int = 6151) -> Scenario:
+    """The deliberately planted failing scenario: a pinned replica band
+    (min == max, so the autoscaler cannot add capacity) under a long 8x
+    flash crowd, with a budget window wider than the run — the TTFT
+    budget exhausts and can never recover. The fuzz smoke run keeps this
+    base in its enumeration precisely so the oracle always has one
+    genuine failure to find, shrink, and pin into the corpus."""
+    return Scenario(
+        name="slo_regression",
+        seed=seed,
+        duration_s=240.0,
+        tick_s=0.25,
+        profile=DiurnalProfile(base_rate=8.0, amplitude=0.2,
+                               period_s=240.0, peak_at_s=120.0,
+                               bursts=((60.0, 150.0, 8.0),)),
+        cost=DeviceCostModel(step_s=0.05, compile_s=20.0, n_slots=8),
+        min_replicas=2, max_replicas=2,
+        # window >> duration: once the burst exhausts the budget it
+        # stays exhausted through the end of the run
+        target_ttft_s=0.5, slo_ttft_s=0.6, slo_window_s=600.0,
+        scrape_period_s=5.0, flap_guard_s=20.0,
+        train_workers=0,
+    )
+
+
+# ---------------------------------------------------------- serialization
+# A Scenario is the unit the fuzzer mutates, shrinks, and checks into
+# tests/fuzz_corpus/ — so it needs a stable JSON round trip. Docs are
+# tolerant of MISSING fields (they take the dataclass default), which
+# lets old corpus entries keep replaying after the DSL grows a knob;
+# unknown fields are an error (a corpus entry that spells a knob wrong
+# must not silently replay a different scenario).
+
+def _plain(v: Any) -> Any:
+    """Tuples -> lists, recursively (JSON has no tuple)."""
+    if isinstance(v, tuple):
+        return [_plain(x) for x in v]
+    return v
+
+
+def _tupled(v: Any) -> Any:
+    """Lists -> tuples, recursively (dataclass fields are tuples)."""
+    if isinstance(v, list):
+        return tuple(_tupled(x) for x in v)
+    return v
+
+
+def _sub_doc(obj: Any) -> Dict[str, Any]:
+    return {f.name: _plain(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)}
+
+
+def _sub_from(cls: type, doc: Dict[str, Any]) -> Any:
+    known = {f.name for f in dataclasses.fields(cls)}
+    bad = sorted(set(doc) - known)
+    if bad:
+        raise ValueError(f"unknown {cls.__name__} fields {bad}")
+    return cls(**{k: _tupled(v) for k, v in doc.items()})
+
+
+def scenario_to_doc(sc: Scenario) -> Dict[str, Any]:
+    """The scenario as a JSON-ready dict (format-stamped)."""
+    doc: Dict[str, Any] = {"format": SCENARIO_FORMAT}
+    for f in dataclasses.fields(Scenario):
+        v = getattr(sc, f.name)
+        if f.name in ("profile", "tenants", "cost"):
+            doc[f.name] = _sub_doc(v)
+        elif f.name == "chaos":
+            doc[f.name] = [_sub_doc(w) for w in v]
+        else:
+            doc[f.name] = _plain(v)
+    return doc
+
+
+def scenario_from_doc(doc: Dict[str, Any]) -> Scenario:
+    """Rebuild a Scenario from `scenario_to_doc` output."""
+    fmt = doc.get("format")
+    if fmt != SCENARIO_FORMAT:
+        raise ValueError(f"not a scenario doc (format={fmt!r})")
+    fields = {f.name for f in dataclasses.fields(Scenario)}
+    bad = sorted(set(doc) - fields - {"format"})
+    if bad:
+        raise ValueError(f"unknown Scenario fields {bad}")
+    kw: Dict[str, Any] = {}
+    for name in fields & set(doc):
+        v = doc[name]
+        if name == "profile":
+            kw[name] = _sub_from(DiurnalProfile, v)
+        elif name == "tenants":
+            kw[name] = _sub_from(TenantMix, v)
+        elif name == "cost":
+            kw[name] = _sub_from(DeviceCostModel, v)
+        elif name == "chaos":
+            kw[name] = tuple(_sub_from(ChaosWindow, w) for w in v)
+        else:
+            kw[name] = _tupled(v)
+    return Scenario(**kw)
